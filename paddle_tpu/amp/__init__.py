@@ -1,0 +1,15 @@
+"""AMP (reference: python/paddle/amp/ — auto_cast.py:1006, grad_scaler.py:657).
+
+On TPU the low-precision dtype is bfloat16 (MXU-native, same exponent range
+as fp32), so GradScaler is a functional no-op by default (kept for parity and
+for float16 experiments); auto_cast drives the per-op cast lists through the
+dispatch-layer AMP hook (the eager_gen.py:645 AMP-cast analog).
+"""
+from __future__ import annotations
+
+from .auto_cast import auto_cast, amp_guard, decorate, amp_decorate, white_list, black_list
+from .grad_scaler import GradScaler, AmpScaler
+from . import debugging
+
+__all__ = ["auto_cast", "amp_guard", "decorate", "GradScaler", "AmpScaler",
+           "debugging", "white_list", "black_list"]

@@ -1,0 +1,124 @@
+"""auto_cast context (reference: python/paddle/amp/auto_cast.py:1006 and
+amp_lists.py per-op white/black lists).
+
+Implementation: registers a hook in the op dispatch layer that casts the
+jax-value inputs of white-list ops to the amp dtype and black-list ops to
+float32 before the kernel runs — exactly where the reference's generated
+AmpAutoCasts calls sit (eager_gen.py:645).
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+from typing import Optional, Set
+
+import jax.numpy as jnp
+
+from ..core import dispatch
+from ..core import dtype as dtype_mod
+
+# Reference amp_lists.py: ops that are numerically safe & fast in low precision
+WHITE_LIST: Set[str] = {
+    "matmul", "linear", "conv1d", "conv2d", "conv3d", "conv1d_transpose",
+    "conv2d_transpose", "conv3d_transpose", "bmm", "mm", "mv", "einsum",
+    "flash_attention", "flash_attention_causal", "flash_attn_unpadded",
+    "addmm",
+}
+# Ops that must run in fp32 (reductions / losses / norms / exp-family)
+BLACK_LIST: Set[str] = {
+    "exp", "square", "log", "log2", "log10", "log1p", "mean", "sum", "cos_sim",
+    "softmax", "log_softmax", "cross_entropy", "bce", "bce_logits",
+    "layer_norm", "batch_norm_train", "batch_norm_infer", "group_norm",
+    "instance_norm", "rms_norm", "norm", "logsumexp", "cumsum", "prod",
+    "l1_loss", "mse_loss", "nll_loss", "kl_div", "smooth_l1", "softmax_with_cross_entropy",
+    "erf", "erfinv", "pow", "rsqrt", "sqrt", "std", "var", "dist", "sigmoid_focal",
+}
+
+
+def white_list():
+    return set(WHITE_LIST)
+
+
+def black_list():
+    return set(BLACK_LIST)
+
+
+class _AmpState:
+    enabled = False
+    dtype = None
+    level = "O1"
+    custom_white = set()
+    custom_black = set()
+
+
+def _amp_hook(op_name, vals, tensor_idx):
+    if not _AmpState.enabled:
+        return vals
+    target = None
+    if op_name in WHITE_LIST or op_name in _AmpState.custom_white:
+        target = _AmpState.dtype
+    elif op_name in BLACK_LIST or op_name in _AmpState.custom_black:
+        target = jnp.float32
+    elif _AmpState.level == "O2":
+        target = _AmpState.dtype
+    if target is None:
+        return vals
+    out = list(vals)
+    for i in tensor_idx:
+        v = out[i]
+        if hasattr(v, "dtype") and jnp.issubdtype(v.dtype, jnp.floating) \
+                and v.dtype != jnp.dtype(target):
+            out[i] = v.astype(target)
+    return out
+
+
+dispatch._set_amp_hook(_amp_hook)
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16", use_promote=True):
+    """paddle.amp.auto_cast parity."""
+    prev = (_AmpState.enabled, _AmpState.dtype, _AmpState.level,
+            _AmpState.custom_white, _AmpState.custom_black)
+    _AmpState.enabled = bool(enable)
+    _AmpState.dtype = dtype_mod.convert_dtype(dtype)
+    _AmpState.level = level
+    _AmpState.custom_white = set(custom_white_list or ())
+    _AmpState.custom_black = set(custom_black_list or ())
+    try:
+        yield
+    finally:
+        (_AmpState.enabled, _AmpState.dtype, _AmpState.level,
+         _AmpState.custom_white, _AmpState.custom_black) = prev
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
+             master_weight=None, save_dtype=None, master_grad=False,
+             excluded_layers=None):
+    """paddle.amp.decorate parity (auto_cast.py:1091): O2 casts model params
+    to the amp dtype (norm layers kept fp32 via excluded_layers)."""
+    from ..nn.layer import Layer
+    single = isinstance(models, Layer)
+    model_list = [models] if single else list(models)
+    if level == "O2":
+        d = dtype_mod.convert_dtype(dtype)
+        from ..nn import norm as norm_layers
+        excluded = tuple(excluded_layers) if excluded_layers else (
+            norm_layers._BatchNormBase, norm_layers.LayerNorm, norm_layers.GroupNorm)
+        for m in model_list:
+            for sub in m.sublayers(include_self=True):
+                if isinstance(sub, excluded):
+                    continue
+                for p in sub._parameters.values():
+                    if p is not None and jnp.issubdtype(p._value.dtype, jnp.floating):
+                        p._set_value(p._value.astype(d))
+    if optimizers is None:
+        return models if single else model_list
+    return (models if single else model_list), optimizers
+
+
+amp_decorate = decorate

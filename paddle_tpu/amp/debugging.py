@@ -1,0 +1,55 @@
+"""AMP numeric debugging (reference: python/paddle/amp/debugging.py —
+TensorChecker, op precision compare). TPU analog: flag-driven NaN/Inf scan in
+dispatch + jax.debug_nans under jit.
+"""
+from __future__ import annotations
+
+import contextlib
+
+from .. import flags
+
+__all__ = ["enable_operator_stats_collection", "disable_operator_stats_collection",
+           "collect_operator_stats", "enable_tensor_checker", "disable_tensor_checker",
+           "check_numerics", "DebugMode"]
+
+
+class DebugMode:
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL = 2
+
+
+_op_stats = {}
+
+
+def enable_operator_stats_collection():
+    _op_stats.clear()
+
+
+def disable_operator_stats_collection():
+    pass
+
+
+@contextlib.contextmanager
+def collect_operator_stats():
+    enable_operator_stats_collection()
+    yield
+    disable_operator_stats_collection()
+
+
+def enable_tensor_checker(checker_config=None):
+    flags.set_flags({"check_nan_inf": True})
+
+
+def disable_tensor_checker():
+    flags.set_flags({"check_nan_inf": False})
+
+
+def check_numerics(tensor, op_type="", var_name="", debug_mode=None):
+    import jax.numpy as jnp
+    import numpy as np
+    v = tensor._value if hasattr(tensor, "_value") else tensor
+    arr = np.asarray(v)
+    if not np.all(np.isfinite(arr)):
+        raise FloatingPointError(f"NaN/Inf in {op_type}:{var_name}")
+    return tensor

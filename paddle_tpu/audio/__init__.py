@@ -1,0 +1,4 @@
+"""paddle.audio parity (reference: python/paddle/audio/ — spectral features)."""
+from . import functional
+
+__all__ = ["functional"]

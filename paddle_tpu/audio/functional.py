@@ -1,0 +1,103 @@
+"""Audio features (reference: python/paddle/audio/functional/ — window fns,
+mel filterbank, spectrogram pieces) implemented over jnp FFT."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..core.dispatch import op_call
+
+__all__ = ["get_window", "create_dct", "compute_fbank_matrix", "hz_to_mel",
+           "mel_to_hz", "power_to_db"]
+
+
+def get_window(window, win_length, fftbins=True, dtype="float64"):
+    n = win_length
+    if isinstance(window, tuple):
+        window = window[0]
+    if window in ("hann", "hanning"):
+        w = np.hanning(n + 1)[:-1] if fftbins else np.hanning(n)
+    elif window == "hamming":
+        w = np.hamming(n + 1)[:-1] if fftbins else np.hamming(n)
+    elif window == "blackman":
+        w = np.blackman(n + 1)[:-1] if fftbins else np.blackman(n)
+    elif window in ("rect", "boxcar", "rectangular"):
+        w = np.ones(n)
+    else:
+        raise ValueError(f"unknown window {window}")
+    return Tensor(jnp.asarray(w, dtype=jnp.float32))
+
+
+def hz_to_mel(freq, htk=False):
+    f = np.asarray(freq, dtype=np.float64)
+    if htk:
+        out = 2595.0 * np.log10(1.0 + f / 700.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        mels = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = np.log(6.4) / 27.0
+        mels = np.where(f >= min_log_hz,
+                        min_log_mel + np.log(np.maximum(f, 1e-10) / min_log_hz) / logstep,
+                        mels)
+        out = mels
+    return float(out) if np.isscalar(freq) else Tensor(jnp.asarray(out, jnp.float32))
+
+
+def mel_to_hz(mel, htk=False):
+    m = np.asarray(mel, dtype=np.float64)
+    if htk:
+        out = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        freqs = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = np.log(6.4) / 27.0
+        out = np.where(m >= min_log_mel,
+                       min_log_hz * np.exp(logstep * (m - min_log_mel)), freqs)
+    return float(out) if np.isscalar(mel) else Tensor(jnp.asarray(out, jnp.float32))
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None, htk=False,
+                         norm="slaney", dtype="float32"):
+    f_max = f_max or sr / 2.0
+    n_freqs = n_fft // 2 + 1
+    fft_freqs = np.linspace(0, sr / 2, n_freqs)
+    mel_min = hz_to_mel(f_min, htk)
+    mel_max = hz_to_mel(f_max, htk)
+    mels = np.linspace(mel_min, mel_max, n_mels + 2)
+    hz = np.array([mel_to_hz(float(m), htk) for m in mels])
+    weights = np.zeros((n_mels, n_freqs))
+    fdiff = np.diff(hz)
+    ramps = hz[:, None] - fft_freqs[None, :]
+    for i in range(n_mels):
+        lower = -ramps[i] / fdiff[i]
+        upper = ramps[i + 2] / fdiff[i + 1]
+        weights[i] = np.maximum(0, np.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (hz[2:n_mels + 2] - hz[:n_mels])
+        weights *= enorm[:, None]
+    return Tensor(jnp.asarray(weights, jnp.float32))
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):
+    n = np.arange(n_mels)
+    k = np.arange(n_mfcc)[:, None]
+    dct = np.cos(np.pi / n_mels * (n + 0.5) * k)
+    if norm == "ortho":
+        dct[0] *= 1.0 / np.sqrt(2)
+        dct *= np.sqrt(2.0 / n_mels)
+    return Tensor(jnp.asarray(dct.T, jnp.float32))
+
+
+def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0):
+    def impl(s):
+        log_spec = 10.0 * jnp.log10(jnp.maximum(s, amin))
+        log_spec = log_spec - 10.0 * jnp.log10(jnp.maximum(ref_value, amin))
+        if top_db is not None:
+            log_spec = jnp.maximum(log_spec, jnp.max(log_spec) - top_db)
+        return log_spec
+    return op_call("power_to_db", impl, spect)

@@ -1,0 +1,10 @@
+"""Autograd public API (reference: python/paddle/autograd/)."""
+from __future__ import annotations
+
+from ..core.autograd import backward, grad
+from ..core.dispatch import no_grad, enable_grad, is_grad_enabled, set_grad_enabled
+from .py_layer import PyLayer, PyLayerContext
+from .saved_tensors_hooks import saved_tensors_hooks
+
+__all__ = ["backward", "grad", "no_grad", "enable_grad", "is_grad_enabled",
+           "set_grad_enabled", "PyLayer", "PyLayerContext", "saved_tensors_hooks"]

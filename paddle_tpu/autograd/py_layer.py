@@ -1,0 +1,111 @@
+"""PyLayer: user-defined forward/backward (reference:
+python/paddle/autograd/py_layer.py, C++ side paddle/fluid/eager/pylayer/).
+
+TPU-native design: the user's static forward/backward become the fwd/bwd of
+the recorded GradNode directly — the tape calls `backward` with upstream
+grads, so arbitrary Python (including non-jax code) is allowed in eager mode;
+under jit tracing both fwd and bwd must be traceable.
+"""
+from __future__ import annotations
+
+from typing import Any, List
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.autograd import GradNode
+from ..core.dispatch import is_grad_enabled
+
+__all__ = ["PyLayer", "PyLayerContext"]
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self._materialize_grads = True
+        self.not_inplace_tensors = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tuple(tensors)
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+    def mark_not_inplace(self, *tensors):
+        self.not_inplace_tensors = tuple(tensors)
+
+    def set_materialize_grads(self, value: bool):
+        self._materialize_grads = bool(value)
+
+
+class _PyLayerNodeVjp:
+    """Adapter giving a PyLayer's backward the GradNode vjp_fn interface."""
+
+    def __init__(self, cls, ctx, n_diff_inputs):
+        self.cls = cls
+        self.ctx = ctx
+        self.n = n_diff_inputs
+
+    def __call__(self, cotangents):
+        cts = cotangents if isinstance(cotangents, tuple) else (cotangents,)
+        grads = self.cls.backward(self.ctx, *[Tensor(c) for c in cts])
+        if not isinstance(grads, (tuple, list)):
+            grads = (grads,)
+        out = []
+        for g in grads:
+            if g is None:
+                out.append(jnp.zeros(()))  # dropped below via float0-like skip
+            else:
+                out.append(g._value if isinstance(g, Tensor) else g)
+        return tuple(out[: self.n])
+
+
+class PyLayer:
+    """Subclass with @staticmethod forward(ctx, *args) and backward(ctx, *grads).
+
+    Example (identity with scaled grad):
+        class Scale(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                return x
+            @staticmethod
+            def backward(ctx, dy):
+                return 2 * dy
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *args):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        outs = cls.forward(ctx, *args, **kwargs)
+        single = not isinstance(outs, (tuple, list))
+        outs_t = (outs,) if single else tuple(outs)
+
+        diff_inputs = [a for a in args if isinstance(a, Tensor)]
+        need_grad = is_grad_enabled() and any(not t.stop_gradient for t in diff_inputs)
+        if need_grad:
+            tensor_outs = [o for o in outs_t if isinstance(o, Tensor)]
+            node = GradNode(
+                name=f"pylayer_{cls.__name__}",
+                vjp_fn=_PyLayerNodeVjp(cls, ctx, len(diff_inputs)),
+                inputs=diff_inputs,
+                out_avals=[(tuple(o.shape), o._value.dtype) for o in tensor_outs],
+                multi=len(tensor_outs) > 1,
+            )
+            for k, o in enumerate(tensor_outs):
+                o.stop_gradient = False
+                o._grad_node = node
+                o._out_index = k
+                node.attach_output(k, o)
+        return outs_t[0] if single else outs_t

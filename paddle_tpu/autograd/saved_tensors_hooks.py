@@ -1,0 +1,27 @@
+"""saved_tensors_hooks parity (reference python/paddle/autograd/saved_tensors_hooks.py).
+
+On TPU the residuals live inside jax.vjp closures; the hook pair is applied to
+PyLayer ctx.save_for_backward tensors (the user-visible saved-tensor path).
+Registered globally; pack runs at save time, unpack at backward time.
+"""
+from __future__ import annotations
+
+_hooks_stack = []
+
+
+class saved_tensors_hooks:
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        _hooks_stack.append((self.pack_hook, self.unpack_hook))
+        return self
+
+    def __exit__(self, *exc):
+        _hooks_stack.pop()
+        return False
+
+
+def current_hooks():
+    return _hooks_stack[-1] if _hooks_stack else None

@@ -1,0 +1,6 @@
+from . import dtype  # noqa: F401
+from . import tensor  # noqa: F401
+from . import dispatch  # noqa: F401
+from . import autograd  # noqa: F401
+from . import random  # noqa: F401
+from . import device  # noqa: F401

@@ -1,0 +1,247 @@
+"""Tape-based eager autograd engine.
+
+TPU-native analog of the reference's eager backward engine
+(paddle/fluid/eager/backward.cc:106 RunBackward — in-degree map + ready-queue
+BFS; grad_node_info.h:197 GradNodeBase; accumulation/ leaf AccumulationNode).
+
+Design: each recorded op holds a ``jax.vjp`` residual closure (the
+TensorWrapper analog — residuals live on-device inside the closure). Backward
+walks the node graph in reverse with dependency counting exactly like the
+reference's ready-queue loop, accumulating output-grad contributions per node
+and depositing leaf grads into ``Tensor.grad``. Node bodies are jax functions,
+so the whole backward can also run under ``jit`` tracing.
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from .tensor import Tensor
+
+__all__ = ["GradNode", "backward", "grad"]
+
+
+class GradNode:
+    """One recorded op on the tape (GradNodeBase analog)."""
+    __slots__ = ("name", "vjp_fn", "inputs", "out_avals", "multi",
+                 "out_grads", "out_tensors")
+
+    def __init__(self, name, vjp_fn, inputs: List[Tensor], out_avals, multi):
+        self.name = name
+        self.vjp_fn = vjp_fn
+        self.inputs = inputs            # Tensors we differentiate w.r.t.
+        self.out_avals = out_avals      # [(shape, dtype), ...]
+        self.multi = multi
+        self.out_grads: List = [None] * len(out_avals)
+        self.out_tensors: List = [None] * len(out_avals)  # weakrefs (hooks)
+
+    def attach_output(self, index, tensor):
+        import weakref
+        self.out_tensors[index] = weakref.ref(tensor)
+
+    def release(self):
+        self.vjp_fn = None
+        self.out_grads = [None] * len(self.out_avals)
+
+    def accumulate_out_grad(self, index, g):
+        if self.out_grads[index] is None:
+            self.out_grads[index] = g
+        else:
+            self.out_grads[index] = self.out_grads[index] + g
+
+
+def _is_float0(g):
+    return hasattr(g, "dtype") and g.dtype == jax.dtypes.float0
+
+
+def _run_hooks(t: Tensor, g):
+    if t._backward_hooks:
+        for hook in list(t._backward_hooks):
+            out = hook(Tensor(g))
+            if out is not None:
+                g = out._value if isinstance(out, Tensor) else out
+    return g
+
+
+def _topo_collect(roots: Sequence[GradNode]):
+    """BFS the reachable node graph; count consumer references per node
+    (the in-degree map of backward.cc:36)."""
+    indeg = {}
+    seen = set()
+    q = deque()
+    for n in roots:
+        if id(n) not in seen:
+            seen.add(id(n))
+            indeg[id(n)] = indeg.get(id(n), 0)
+            q.append(n)
+    nodes = {id(n): n for n in roots}
+    while q:
+        n = q.popleft()
+        for t in n.inputs:
+            p = t._grad_node
+            if p is None or t.stop_gradient:
+                continue
+            indeg[id(p)] = indeg.get(id(p), 0) + 1
+            if id(p) not in seen:
+                seen.add(id(p))
+                nodes[id(p)] = p
+                q.append(p)
+    return nodes, indeg
+
+
+def _engine(out_tensors: Sequence[Tensor], out_grads: Sequence,
+            retain_graph: bool,
+            capture: Optional[dict] = None,
+            accumulate_leaf: bool = True):
+    """Core ready-queue loop (backward.cc:255 analog).
+
+    capture: optional {id(tensor): slot} — when a grad flows into one of these
+    tensors, store it there (used by paddle_tpu.grad partial grads).
+
+    Hooks fire ONCE per tensor on the fully-accumulated gradient (reference
+    GradientAccumulator semantics): leaf grads buffer locally until the walk
+    finishes; intermediate-tensor hooks run when their node becomes ready.
+    """
+    leaf_acc = {}  # id(t) -> [tensor, value]
+
+    def deposit_leaf(t, g):
+        slot = leaf_acc.get(id(t))
+        if slot is None:
+            leaf_acc[id(t)] = [t, g]
+        else:
+            slot[1] = slot[1] + g
+
+    roots = []
+    for t, g in zip(out_tensors, out_grads):
+        node = t._grad_node
+        if node is None:
+            # output is a leaf: its grad is just g
+            if capture is not None and id(t) in capture:
+                capture[id(t)].append(g)
+            elif accumulate_leaf and not t.stop_gradient:
+                deposit_leaf(t, g)
+            continue
+        node.accumulate_out_grad(t._out_index, g)
+        roots.append(node)
+
+    if not roots and not leaf_acc:
+        return
+    nodes, indeg = _topo_collect(roots)
+    ready = deque(n for n in nodes.values() if indeg[id(n)] == 0)
+
+    while ready:
+        node = ready.popleft()
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                f"Trying to backward through node '{node.name}' a second time "
+                "(set retain_graph=True to allow).")
+        # zero-fill missing output grads; cast to the primal-output dtype
+        # (AMP: upstream fp32 grads meet bf16 outputs); run output-tensor
+        # hooks once on the accumulated grad
+        cts = []
+        for k, ((shape, dt), g) in enumerate(zip(node.out_avals, node.out_grads)):
+            if g is None:
+                g = jnp.zeros(shape, dt)
+            else:
+                if hasattr(g, "dtype") and g.dtype != dt:
+                    g = g.astype(dt)
+                ref = node.out_tensors[k]
+                t_out = ref() if ref is not None else None
+                if t_out is not None:
+                    g = _run_hooks(t_out, g)
+            cts.append(g)
+        ct = tuple(cts) if node.multi else cts[0]
+        in_grads = node.vjp_fn(ct)
+        if not retain_graph:
+            node.release()
+        else:
+            node.out_grads = [None] * len(node.out_avals)
+        for t, g in zip(node.inputs, in_grads):
+            if _is_float0(g):
+                continue
+            parent = t._grad_node
+            if capture is not None and id(t) in capture:
+                capture[id(t)].append(g)
+                # still propagate further (tensor may also be upstream of others)
+            if parent is None or t.stop_gradient:
+                if t.stop_gradient:
+                    continue
+                if accumulate_leaf and (capture is None or id(t) not in capture):
+                    deposit_leaf(t, g)
+                continue
+            parent.accumulate_out_grad(t._out_index, g)
+            indeg[id(parent)] -= 1
+            if indeg[id(parent)] == 0:
+                ready.append(parent)
+
+    # finalize leaves: hooks once on the total, then accumulate into .grad
+    for t, g in leaf_acc.values():
+        g = _run_hooks(t, g)
+        t._grad = Tensor(g) if t._grad is None else Tensor(t._grad._value + g)
+
+
+def _default_grad(t: Tensor):
+    if not jnp.issubdtype(t._value.dtype, jnp.inexact):
+        raise RuntimeError("backward() root must be floating point")
+    return jnp.ones(t._value.shape, t._value.dtype)
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """paddle.autograd.backward parity."""
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    outs, gs = [], []
+    for t, g in zip(tensors, grad_tensors):
+        if g is None:
+            g = _default_grad(t)
+        elif isinstance(g, Tensor):
+            g = g._value
+        outs.append(t)
+        gs.append(g)
+    _engine(outs, gs, retain_graph=retain_graph)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    """paddle.grad parity (reference general_grad.h partial-graph grads).
+
+    Note: create_graph (higher-order through the tape) is supported by
+    functional re-derivation: use paddle_tpu.incubate.autograd or nest
+    jax-level transforms for higher-order; the tape itself records first-order.
+    """
+    single = isinstance(outputs, Tensor)
+    outputs = [outputs] if single else list(outputs)
+    single_in = isinstance(inputs, Tensor)
+    inputs = [inputs] if single_in else list(inputs)
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+    grad_outputs = [(_default_grad(o) if g is None else
+                     (g._value if isinstance(g, Tensor) else g))
+                    for o, g in zip(outputs, grad_outputs)]
+
+    capture = {id(t): [] for t in inputs}
+    retain = bool(retain_graph) if retain_graph is not None else bool(create_graph)
+    _engine(outputs, grad_outputs, retain_graph=retain, capture=capture,
+            accumulate_leaf=False)
+
+    results = []
+    for t in inputs:
+        contribs = capture[id(t)]
+        if not contribs:
+            if not allow_unused:
+                raise RuntimeError(
+                    "One of the differentiated tensors appears unused in the "
+                    "graph (pass allow_unused=True to return None for it).")
+            results.append(None)
+        else:
+            acc = contribs[0]
+            for c in contribs[1:]:
+                acc = acc + c
+            results.append(Tensor(acc))
+    return results[0] if single_in else results

@@ -1,0 +1,236 @@
+"""Eager op dispatch.
+
+TPU-native analog of the reference's kernel dispatch + generated dygraph
+forward functions (paddle/phi/core/kernel_factory.h:316 KernelFactory,
+eager_gen.py generated ``*_ad_func``): every functional op funnels through
+:func:`op_call`, which
+
+1. resolves the kernel implementation from the registry (default = jax/XLA;
+   Pallas overrides register under the same op name — the
+   ``PD_REGISTER_KERNEL`` analog, paddle/phi/core/kernel_registry.h:196),
+2. applies AMP auto-cast when an amp context is active (eager_gen.py:645),
+3. unwraps Tensor arguments to jax values,
+4. when grad is required, runs the op under ``jax.vjp`` and records a GradNode
+   on the tape (eager_gen.py:1175 GenerateNodeCreationCodes analog),
+5. wraps outputs back into Tensors,
+6. optionally NaN/Inf-checks outputs (FLAGS_check_nan_inf, eager_gen.py:749).
+
+Because jax values may be tracers, the same dispatch path works inside
+``jit``-traced step functions; in that case the "eager" ops stage XLA HLO
+instead of executing immediately — the executor role collapses into XLA.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .tensor import Tensor
+from .. import flags
+
+__all__ = ["op_call", "register_kernel", "get_kernel", "no_grad",
+           "enable_grad", "is_grad_enabled", "set_grad_enabled", "defop"]
+
+# --------------------------------------------------------------------------
+# Kernel registry: op name -> {impl_name: fn}. "default" = jax/XLA impl;
+# "pallas" overrides win when FLAGS_use_pallas_kernels is on.
+# --------------------------------------------------------------------------
+_KERNELS: Dict[str, Dict[str, Callable]] = {}
+
+
+def register_kernel(name: str, impl: str = "default"):
+    """PD_REGISTER_KERNEL analog (kernel_registry.h:196)."""
+    def deco(fn):
+        _KERNELS.setdefault(name, {})[impl] = fn
+        return fn
+    return deco
+
+
+def get_kernel(name: str, default: Optional[Callable] = None) -> Optional[Callable]:
+    impls = _KERNELS.get(name)
+    if not impls:
+        return default
+    if flags.get_flag("use_pallas_kernels") and "pallas" in impls:
+        return impls["pallas"]
+    return impls.get("default", default)
+
+
+# --------------------------------------------------------------------------
+# Grad mode (reference: python/paddle/base/dygraph/base.py no_grad_,
+# egr::Controller::HasGrad)
+# --------------------------------------------------------------------------
+class _GradMode:
+    enabled = True
+
+
+class _GradGuard:
+    def __init__(self, mode: bool):
+        self._mode = mode
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = _GradMode.enabled
+        _GradMode.enabled = self._mode
+        return self
+
+    def __exit__(self, *exc):
+        _GradMode.enabled = self._prev
+        return False
+
+    def __call__(self, fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with _GradGuard(self._mode):
+                return fn(*args, **kwargs)
+        return wrapper
+
+
+def no_grad(func=None):
+    """Usable as context manager or decorator (paddle.no_grad parity)."""
+    g = _GradGuard(False)
+    if func is not None:
+        return g(func)
+    return g
+
+
+def enable_grad(func=None):
+    g = _GradGuard(True)
+    if func is not None:
+        return g(func)
+    return g
+
+
+def is_grad_enabled() -> bool:
+    return _GradMode.enabled
+
+
+class _SetGradEnabled:
+    """paddle.set_grad_enabled parity: takes effect immediately AND works as
+    a context manager that restores the previous mode on exit."""
+
+    def __init__(self, mode: bool):
+        self._prev = _GradMode.enabled
+        _GradMode.enabled = bool(mode)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        _GradMode.enabled = self._prev
+        return False
+
+
+def set_grad_enabled(mode: bool):
+    return _SetGradEnabled(mode)
+
+
+# --------------------------------------------------------------------------
+# AMP hook (filled in by paddle_tpu.amp to avoid an import cycle).
+# --------------------------------------------------------------------------
+_amp_cast_hook = [None]  # fn(op_name, tensor_values:list, tensor_idx) -> values
+
+
+def _set_amp_hook(fn):
+    _amp_cast_hook[0] = fn
+
+
+def _check_numerics(name, vals):
+    import numpy as np
+    for v in vals:
+        if hasattr(v, "dtype") and jnp.issubdtype(v.dtype, jnp.inexact):
+            try:
+                arr = np.asarray(v)
+            except Exception:
+                return  # tracer: skip (use jax.debug_nans under jit)
+            if not np.all(np.isfinite(arr)):
+                msg = f"NaN/Inf detected in output of op '{name}'"
+                if flags.get_flag("check_nan_inf_level") >= 1:
+                    import warnings
+                    warnings.warn(msg)
+                else:
+                    raise FloatingPointError(msg)
+
+
+# --------------------------------------------------------------------------
+# The dispatch entry.
+# --------------------------------------------------------------------------
+def op_call(name: str, fn: Callable, *args, nondiff: bool = False, **static_kwargs):
+    """Execute op `name` with jax-level impl `fn`.
+
+    Positional args may be Tensors (differentiable inputs) or raw values;
+    static_kwargs are non-differentiable config. Returns Tensor or tuple of
+    Tensors mirroring fn's output structure.
+    """
+    impl = get_kernel(name, fn)
+
+    tensor_idx = []
+    vals = []
+    for i, a in enumerate(args):
+        if isinstance(a, Tensor):
+            vals.append(a._value)
+            tensor_idx.append(i)
+        else:
+            vals.append(a)
+
+    if _amp_cast_hook[0] is not None:
+        vals = _amp_cast_hook[0](name, vals, tensor_idx)
+
+    need_grad = (not nondiff) and _GradMode.enabled and any(
+        not args[i].stop_gradient for i in tensor_idx)
+
+    if need_grad:
+        # differentiate only w.r.t. inexact-dtype tensor inputs
+        diff_idx = [i for i in tensor_idx
+                    if jnp.issubdtype(jnp.result_type(vals[i]), jnp.inexact)]
+        need_grad = bool(diff_idx)
+
+    if not need_grad:
+        out = impl(*vals, **static_kwargs)
+        multi = isinstance(out, (tuple, list))
+        outs = tuple(out) if multi else (out,)
+        if flags.get_flag("check_nan_inf"):
+            _check_numerics(name, outs)
+        wrapped = tuple(Tensor(o, stop_gradient=True) if not isinstance(o, Tensor) else o
+                        for o in outs)
+        return wrapped if multi else wrapped[0]
+
+    def f(*diff_vals):
+        vv = list(vals)
+        for i, dv in zip(diff_idx, diff_vals):
+            vv[i] = dv
+        return impl(*vv, **static_kwargs)
+
+    primals = [vals[i] for i in diff_idx]
+    out, vjp_fn = jax.vjp(f, *primals)
+    multi = isinstance(out, (tuple, list))
+    outs = tuple(out) if multi else (out,)
+    if flags.get_flag("check_nan_inf"):
+        _check_numerics(name, outs)
+
+    from .autograd import GradNode
+    in_tensors = [args[i] for i in diff_idx]
+    node = GradNode(name=name, vjp_fn=vjp_fn, inputs=in_tensors,
+                    out_avals=[(o.shape, o.dtype) for o in outs], multi=multi)
+    wrapped = []
+    for k, o in enumerate(outs):
+        t = Tensor(o, stop_gradient=False)
+        t._grad_node = node
+        t._out_index = k
+        node.attach_output(k, t)
+        wrapped.append(t)
+    wrapped = tuple(wrapped)
+    return wrapped if multi else wrapped[0]
+
+
+def defop(name: str, nondiff: bool = False):
+    """Decorator: lift a jax-level function into a Tensor-level op going
+    through dispatch. The single-source op-spec analog of ops.yaml."""
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            return op_call(name, fn, *args, nondiff=nondiff, **kwargs)
+        wrapper.__wrapped_jax_impl__ = fn
+        return wrapper
+    return deco

@@ -1,0 +1,102 @@
+"""Dtype registry for paddle_tpu.
+
+TPU-native dtype system: thin aliases over numpy/jax dtypes with the same
+surface the reference exposes through ``paddle.dtype`` (reference:
+paddle/phi/common/data_type.h, python/paddle/framework/dtype.py). On TPU,
+bfloat16 is the preferred compute dtype (MXU-native); float32 is the default
+parameter dtype.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical dtypes (jnp dtype objects double as the public `paddle_tpu.float32`
+# style aliases).
+bool_ = jnp.bool_
+uint8 = jnp.uint8
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+float8_e4m3fn = jnp.float8_e4m3fn
+float8_e5m2 = jnp.float8_e5m2
+
+_STR_TO_DTYPE = {
+    "bool": bool_,
+    "uint8": uint8,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "float16": float16,
+    "bfloat16": bfloat16,
+    "bf16": bfloat16,
+    "float32": float32,
+    "float64": float64,
+    "complex64": complex64,
+    "complex128": complex128,
+    "float8_e4m3fn": float8_e4m3fn,
+    "float8_e5m2": float8_e5m2,
+    "fp16": float16,
+    "fp32": float32,
+    "fp64": float64,
+}
+
+_DEFAULT_DTYPE = [jnp.float32]
+
+
+def convert_dtype(dtype):
+    """Normalize a dtype spec (str / np dtype / jnp dtype / paddle-style) to a
+    numpy dtype object usable by jax."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        key = dtype.lower().replace("paddle.", "")
+        if key not in _STR_TO_DTYPE:
+            raise ValueError(f"Unknown dtype string: {dtype!r}")
+        return np.dtype(_STR_TO_DTYPE[key])
+    try:
+        return np.dtype(dtype)
+    except TypeError:
+        raise ValueError(f"Cannot interpret {dtype!r} as a dtype")
+
+
+def dtype_name(dtype) -> str:
+    return np.dtype(dtype).name
+
+
+def set_default_dtype(dtype):
+    """paddle.set_default_dtype equivalent (reference:
+    python/paddle/framework/framework.py)."""
+    d = convert_dtype(dtype)
+    if not np.issubdtype(d, np.floating) and d != np.dtype(jnp.bfloat16):
+        raise TypeError(f"default dtype must be floating, got {dtype}")
+    _DEFAULT_DTYPE[0] = d
+    return d
+
+
+def get_default_dtype():
+    return np.dtype(_DEFAULT_DTYPE[0]).name
+
+
+def default_float_dtype():
+    return _DEFAULT_DTYPE[0]
+
+
+def is_floating_point_dtype(dtype) -> bool:
+    return jnp.issubdtype(np.dtype(dtype), jnp.floating)
+
+
+def is_integer_dtype(dtype) -> bool:
+    return jnp.issubdtype(np.dtype(dtype), jnp.integer)
+
+
+def is_inexact_dtype(dtype) -> bool:
+    return jnp.issubdtype(np.dtype(dtype), jnp.inexact)

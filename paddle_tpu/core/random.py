@@ -1,0 +1,166 @@
+"""Seeded RNG state.
+
+TPU-native analog of the reference's Generator (paddle/phi/core/generator.h:32)
+and the fleet RNGStatesTracker for parallel-deterministic dropout
+(python/paddle/distributed/fleet/layers/mpu/random.py). jax's counter-based
+``jax.random`` keys replace stateful Philox offsets: a global default
+generator holds a key and splits on every draw; named trackers derive
+per-mesh-axis keys so TP/PP ranks get deterministic, distinct dropout masks.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["seed", "get_rng_state", "set_rng_state", "default_generator",
+           "Generator", "RNGStatesTracker", "get_rng_state_tracker", "split_key"]
+
+_DEFAULT_SEED = 0
+
+
+class Generator:
+    """Stateful key holder; ``next_key()`` splits (the seed/offset analog)."""
+
+    def __init__(self, seed: int = 0):
+        self._lock = threading.Lock()
+        self.manual_seed(seed)
+
+    def manual_seed(self, s: int):
+        self._seed = int(s)
+        self._key = jax.random.PRNGKey(int(s))
+        return self
+
+    def initial_seed(self) -> int:
+        return self._seed
+
+    def next_key(self):
+        with self._lock:
+            self._key, sub = jax.random.split(self._key)
+            return sub
+
+    def get_state(self):
+        return self._key
+
+    def set_state(self, state):
+        self._key = jnp.asarray(state, dtype=jnp.uint32)
+        return self
+
+
+default_generator = Generator(_DEFAULT_SEED)
+
+
+def seed(s: int):
+    """paddle.seed parity: reseed the default generator (and all trackers)."""
+    default_generator.manual_seed(s)
+    _TRACKER.reset(s)
+    return default_generator
+
+
+_TRACE_KEYS = []
+
+
+class trace_rng:
+    """Route RNG draws to a traced key while compiling (used by jit.to_static
+    and compiled train steps): inside the context, split_key() derives from
+    the supplied (possibly tracer) key so dropout masks are part of the traced
+    computation instead of baked-in constants."""
+
+    def __init__(self, key):
+        self._key = key
+
+    def __enter__(self):
+        _TRACE_KEYS.append(self._key)
+        return self
+
+    def __exit__(self, *exc):
+        _TRACE_KEYS.pop()
+        return False
+
+
+def split_key():
+    """Draw a fresh subkey from the active RNG source (eager: the default
+    generator; traced: the trace key stack)."""
+    if _TRACE_KEYS:
+        key, sub = jax.random.split(_TRACE_KEYS[-1])
+        _TRACE_KEYS[-1] = key
+        return sub
+    return default_generator.next_key()
+
+
+def get_rng_state():
+    return [default_generator.get_state()]
+
+
+def set_rng_state(state):
+    if isinstance(state, (list, tuple)):
+        state = state[0]
+    default_generator.set_state(state)
+
+
+class RNGStatesTracker:
+    """Named RNG states for hybrid parallel determinism
+    (mpu/random.py RNGStatesTracker analog): e.g. 'global_seed' shared across
+    the TP group vs 'local_seed' distinct per TP rank, so dropout inside
+    column-parallel regions is per-rank while elsewhere replicated."""
+
+    def __init__(self):
+        self._states: Dict[str, Generator] = {}
+
+    def reset(self, base_seed: int = 0):
+        for name, gen in self._states.items():
+            gen.manual_seed(_mix(base_seed, name))
+
+    def add(self, name: str, seed: int):
+        if name in self._states:
+            raise ValueError(f"rng state {name} already exists")
+        self._states[name] = Generator(seed)
+
+    def states(self):
+        return {k: g.get_state() for k, g in self._states.items()}
+
+    def set_states(self, states):
+        for k, s in states.items():
+            self._states.setdefault(k, Generator(0)).set_state(s)
+
+    def key(self, name: str):
+        if name not in self._states:
+            self.add(name, _mix(default_generator.initial_seed(), name))
+        return self._states[name].next_key()
+
+    def rng_state(self, name: str = "global_seed"):
+        """Context manager: routes default-generator draws to a named state
+        (mpu/random.py get_rng_state_tracker().rng_state() parity)."""
+        tracker = self
+
+        class _Ctx:
+            def __enter__(self_ctx):
+                global default_generator
+                if name not in tracker._states:
+                    tracker.add(name, _mix(default_generator.initial_seed(), name))
+                self_ctx._saved = default_generator
+                _swap(tracker._states[name])
+                return self_ctx
+
+            def __exit__(self_ctx, *exc):
+                _swap(self_ctx._saved)
+                return False
+        return _Ctx()
+
+
+def _mix(seed: int, name: str) -> int:
+    return (hash((int(seed), name)) & 0x7FFFFFFF)
+
+
+def _swap(gen: Generator):
+    global default_generator
+    default_generator = gen
+
+
+_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _TRACKER

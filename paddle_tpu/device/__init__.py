@@ -1,0 +1,15 @@
+"""paddle.device parity namespace."""
+from __future__ import annotations
+
+from ..core.device import (  # noqa: F401
+    set_device, get_device, device_count, get_all_device_type,
+    is_compiled_with_cuda, is_compiled_with_tpu, is_compiled_with_rocm,
+    is_compiled_with_xpu, synchronize, Stream, Event, current_stream,
+    local_device_count,
+)
+
+from . import cuda  # noqa: F401
+from . import tpu  # noqa: F401
+
+__all__ = ["set_device", "get_device", "device_count", "synchronize",
+           "Stream", "Event", "current_stream", "cuda", "tpu"]

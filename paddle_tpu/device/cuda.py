@@ -1,0 +1,19 @@
+"""paddle.device.cuda compat shim — maps onto the TPU backend so reference
+scripts using cuda memory/stream APIs run unmodified."""
+from __future__ import annotations
+
+from .tpu import (  # noqa: F401
+    device_count, memory_allocated, max_memory_allocated, memory_reserved,
+    max_memory_reserved, get_device_properties, synchronize, empty_cache,
+)
+from ..core.device import Stream, Event, current_stream  # noqa: F401
+
+
+def stream_guard(stream):
+    class _Guard:
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            return False
+    return _Guard()

@@ -1,0 +1,61 @@
+"""TPU device queries: memory stats from the PJRT runtime.
+
+Analog of paddle.device.cuda memory stats backed by
+paddle/phi/core/memory/stats.h — here XLA owns HBM, so stats come from
+jax device memory introspection.
+"""
+from __future__ import annotations
+
+import jax
+
+__all__ = ["device_count", "memory_allocated", "max_memory_allocated",
+           "memory_reserved", "max_memory_reserved", "get_device_properties",
+           "synchronize", "empty_cache"]
+
+
+def device_count():
+    return len([d for d in jax.devices() if d.platform == "tpu"]) or jax.device_count()
+
+
+def _stats(device=None):
+    dev = jax.devices()[device if isinstance(device, int) else 0]
+    try:
+        return dev.memory_stats() or {}
+    except Exception:
+        return {}
+
+
+def memory_allocated(device=None):
+    return int(_stats(device).get("bytes_in_use", 0))
+
+
+def max_memory_allocated(device=None):
+    s = _stats(device)
+    return int(s.get("peak_bytes_in_use", s.get("bytes_in_use", 0)))
+
+
+def memory_reserved(device=None):
+    s = _stats(device)
+    return int(s.get("bytes_reserved", s.get("bytes_in_use", 0)))
+
+
+def max_memory_reserved(device=None):
+    return max_memory_allocated(device)
+
+
+def get_device_properties(device=None):
+    dev = jax.devices()[device if isinstance(device, int) else 0]
+    class _Props:
+        name = getattr(dev, "device_kind", str(dev))
+        total_memory = int(_stats(device).get("bytes_limit", 0))
+        multi_processor_count = getattr(dev, "core_count", 1)
+    return _Props()
+
+
+def synchronize(device=None):
+    from ..core.device import synchronize as s
+    s()
+
+
+def empty_cache():
+    pass

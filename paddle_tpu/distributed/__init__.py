@@ -1,0 +1,35 @@
+"""paddle.distributed parity namespace (full inventory: SURVEY.md §2.3)."""
+from __future__ import annotations
+
+from .env import init_parallel_env, get_rank, get_world_size, is_initialized
+from .parallel import DataParallel, ParallelEnv, spawn
+from .communication import (Group, new_group, get_group, destroy_process_group,
+                            wait, barrier, get_backend, all_reduce, all_gather,
+                            all_gather_object, reduce, broadcast, scatter,
+                            reduce_scatter, all_to_all, all_to_all_single,
+                            send, recv, isend, irecv, batch_isend_irecv, P2POp,
+                            gather, ReduceOp)
+from . import topology
+from . import fleet
+from . import auto_parallel
+from .auto_parallel.api import (shard_tensor, reshard, shard_layer, shard_optimizer,
+                                dtensor_from_fn, unshard_dtensor)
+from .auto_parallel.process_mesh import ProcessMesh
+from .auto_parallel.placement_type import Shard, Replicate, Partial
+from . import checkpoint
+from .checkpoint.save_state_dict import save_state_dict
+from .checkpoint.load_state_dict import load_state_dict
+from . import sharding
+from . import utils
+
+__all__ = [
+    "init_parallel_env", "get_rank", "get_world_size", "is_initialized",
+    "DataParallel", "ParallelEnv", "spawn", "Group", "new_group", "get_group",
+    "destroy_process_group", "wait", "barrier", "get_backend", "all_reduce",
+    "all_gather", "all_gather_object", "reduce", "broadcast", "scatter",
+    "reduce_scatter", "all_to_all", "all_to_all_single", "send", "recv",
+    "isend", "irecv", "batch_isend_irecv", "P2POp", "gather", "ReduceOp",
+    "fleet", "ProcessMesh", "shard_tensor", "reshard", "shard_layer",
+    "shard_optimizer", "Shard", "Replicate", "Partial", "save_state_dict",
+    "load_state_dict",
+]

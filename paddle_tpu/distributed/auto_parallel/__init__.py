@@ -1,0 +1,5 @@
+from .process_mesh import ProcessMesh, get_mesh, set_mesh  # noqa: F401
+from .placement_type import Shard, Replicate, Partial, Placement  # noqa: F401
+from .api import (shard_tensor, reshard, shard_layer, shard_optimizer,  # noqa: F401
+                  dtensor_from_fn, unshard_dtensor, is_dist_tensor,
+                  shard_dataloader, Strategy, to_static)

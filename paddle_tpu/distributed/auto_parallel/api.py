@@ -1,0 +1,192 @@
+"""Semi-auto parallel DTensor API (reference:
+python/paddle/distributed/auto_parallel/api.py — shard_tensor :220, reshard
+:797, shard_layer :908, shard_optimizer :1735; C++ DistTensor dist_tensor.h +
+119 SPMD rule files + reshard funcs).
+
+TPU-native collapse (SURVEY.md §2.1): a "DistTensor" is simply a Tensor whose
+jax.Array carries a NamedSharding; GSPMD does sharding propagation (replacing
+the SPMD rules) and `reshard` is `jax.device_put` with a new sharding (the
+r2s/s2r/p2r reshard function family collapses into one primitive).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ...core.tensor import Tensor, Parameter
+from .process_mesh import ProcessMesh, get_mesh
+from .placement_type import Placement, Shard, Replicate, Partial, to_partition_spec
+
+__all__ = ["shard_tensor", "reshard", "shard_layer", "shard_optimizer",
+           "dtensor_from_fn", "unshard_dtensor", "is_dist_tensor",
+           "get_placements", "shard_dataloader", "ShardDataloader",
+           "to_static", "Strategy"]
+
+
+def _named_sharding(mesh: ProcessMesh, placements, ndim) -> NamedSharding:
+    spec = to_partition_spec(placements, mesh, ndim)
+    return NamedSharding(mesh.to_jax(), spec)
+
+
+def shard_tensor(data, mesh: ProcessMesh, placements: Sequence[Placement],
+                 dtype=None, place=None, stop_gradient=None):
+    """reference api.py:220. Places the tensor's jax.Array with the requested
+    NamedSharding (device_put distributes shards across the mesh)."""
+    t = data if isinstance(data, Tensor) else Tensor(jnp.asarray(data))
+    sh = _named_sharding(mesh, placements, t._value.ndim)
+    v = jax.device_put(t._value, sh)
+    if isinstance(t, Parameter):
+        out = Parameter(v, trainable=t.trainable, name=t.name)
+        out.stop_gradient = t.stop_gradient
+    else:
+        out = Tensor(v, stop_gradient=t.stop_gradient if stop_gradient is None
+                     else stop_gradient, name=t.name)
+    out._dist_mesh = mesh
+    out._placements = list(placements)
+    return out
+
+
+def reshard(dist_tensor, mesh: ProcessMesh, placements: Sequence[Placement]):
+    """reference api.py:797 — all reshard transforms (s2r/r2s/p2r/x-mesh) are
+    jax.device_put with the target sharding; XLA emits the collectives."""
+    t = dist_tensor
+    # Partial → materialize reduction first is implicit: jax arrays never hold
+    # unreduced partials eagerly.
+    sh = _named_sharding(mesh, placements, t._value.ndim)
+    v = jax.device_put(t._value, sh)
+    out = Tensor(v, stop_gradient=t.stop_gradient, name=t.name)
+    out._grad_node = t._grad_node
+    out._out_index = t._out_index
+    out._dist_mesh = mesh
+    out._placements = list(placements)
+    return out
+
+
+def is_dist_tensor(t) -> bool:
+    try:
+        return isinstance(t._value.sharding, NamedSharding)
+    except Exception:
+        return False
+
+
+def get_placements(t):
+    return getattr(t, "_placements", None)
+
+
+def shard_layer(layer, process_mesh: ProcessMesh,
+                shard_fn: Optional[Callable] = None,
+                input_fn: Optional[Callable] = None,
+                output_fn: Optional[Callable] = None):
+    """reference api.py:908: apply shard_fn(name, sublayer, mesh) to every
+    sublayer (default: replicate all params over the mesh)."""
+    def default_shard_fn(name, sublayer, mesh):
+        for pname, p in list(sublayer._parameters.items()):
+            if p is None:
+                continue
+            rep = [Replicate() for _ in range(mesh.ndim)]
+            sublayer._parameters[pname] = shard_tensor(p, mesh, rep)
+    fn = shard_fn or default_shard_fn
+    for name, sub in layer.named_sublayers(include_self=True):
+        fn(name, sub, process_mesh)
+    if input_fn is not None:
+        layer.register_forward_pre_hook(lambda l, inp: input_fn(inp, process_mesh))
+    if output_fn is not None:
+        layer.register_forward_post_hook(lambda l, inp, out: output_fn(out, process_mesh))
+    return layer
+
+
+def shard_optimizer(optimizer, shard_fn=None, gradient_accumulation_steps=1):
+    """reference api.py:1735: shard optimizer states across the mesh. The
+    functional analog: accumulator arrays inherit their parameter's sharding
+    (or shard_fn's choice) when first created — on TPU this happens lazily at
+    first step(); we pre-place existing states here."""
+    for p in optimizer._parameter_list:
+        state = optimizer._accumulators.get(id(p))
+        if state is None:
+            continue
+        try:
+            sh = p._value.sharding
+        except Exception:
+            continue
+        for k, v in state.items():
+            if hasattr(v, "shape") and v.shape == p._value.shape:
+                state[k] = jax.device_put(v, sh)
+    return optimizer
+
+
+def dtensor_from_fn(fn, mesh: ProcessMesh, placements, *args, **kwargs):
+    t = fn(*args, **kwargs)
+    return shard_tensor(t, mesh, placements)
+
+
+def unshard_dtensor(dist_tensor):
+    v = dist_tensor._value
+    full = jax.device_put(v, jax.devices()[0])
+    return Tensor(full, stop_gradient=dist_tensor.stop_gradient)
+
+
+class ShardDataloader:
+    """reference api.py:3475 shard_dataloader: wraps a DataLoader so each
+    batch lands sharded over the mesh's dp-like axis."""
+
+    def __init__(self, dataloader, meshes, input_keys=None, shard_dims=None,
+                 is_dataset_splitted=False):
+        self._loader = dataloader
+        self._mesh = meshes if isinstance(meshes, ProcessMesh) else meshes[0]
+        self._shard_dims = shard_dims
+
+    def __len__(self):
+        return len(self._loader)
+
+    def __iter__(self):
+        dim = self._shard_dims if isinstance(self._shard_dims, str) else \
+            (self._mesh.dim_names[0])
+        for batch in self._loader:
+            yield jax.tree_util.tree_map(
+                lambda t: shard_tensor(
+                    t, self._mesh,
+                    [Shard(0) if n == dim else Replicate()
+                     for n in self._mesh.dim_names])
+                if isinstance(t, Tensor) else t,
+                batch, is_leaf=lambda x: isinstance(x, Tensor))
+
+
+def shard_dataloader(dataloader, meshes, input_keys=None, shard_dims=None,
+                     is_dataset_splitted=False):
+    return ShardDataloader(dataloader, meshes, input_keys, shard_dims,
+                           is_dataset_splitted)
+
+
+class Strategy:
+    """auto-parallel Strategy config holder (reference api.py:1973)."""
+
+    def __init__(self, config=None):
+        self.sharding = _SubCfg(enable=False, stage=1, degree=1)
+        self.fused_passes = _SubCfg(enable=False, fused_passes_list=[])
+        self.gradient_merge = _SubCfg(enable=False, k_steps=1, avg=True)
+        self.pipeline = _SubCfg(enable=False, schedule_mode="1F1B",
+                                micro_batch_size=1, accumulate_steps=1)
+        self.amp = _SubCfg(enable=False, dtype="bfloat16", level="O1")
+        self.recompute = _SubCfg(enable=False)
+        if config:
+            for k, v in config.items():
+                if hasattr(self, k) and isinstance(v, dict):
+                    for kk, vv in v.items():
+                        setattr(getattr(self, k), kk, vv)
+
+
+class _SubCfg:
+    def __init__(self, **kw):
+        self.__dict__.update(kw)
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None,
+              input_spec=None):
+    """reference api.py:2952 — returns a DistModel-like compiled trainer.
+    Initial implementation delegates to jit.to_static for the forward; the
+    full static Engine lands with the pipeline/schedule pass work."""
+    from ...jit.api import to_static as jit_to_static
+    return jit_to_static(layer)

@@ -1,0 +1,90 @@
+"""Placements (reference: python/paddle/distributed/auto_parallel/
+placement_type.py; C++ placement_types.h): Shard(dim) / Replicate / Partial.
+They translate to jax PartitionSpec entries.
+"""
+from __future__ import annotations
+
+__all__ = ["Placement", "Shard", "Replicate", "Partial", "to_partition_spec"]
+
+
+class Placement:
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicated(self):
+        return False
+
+    def is_partial(self):
+        return False
+
+
+class Shard(Placement):
+    def __init__(self, dim):
+        self.dim = int(dim)
+
+    def is_shard(self, dim=None):
+        return True if dim is None else dim == self.dim
+
+    def get_dim(self):
+        return self.dim
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other.dim == self.dim
+
+    def __hash__(self):
+        return hash(("shard", self.dim))
+
+
+class Replicate(Placement):
+    def is_replicated(self):
+        return True
+
+    def __repr__(self):
+        return "Replicate()"
+
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
+    def __hash__(self):
+        return hash("replicate")
+
+
+class Partial(Placement):
+    """Pending-reduction placement. XLA tracks partial sums implicitly inside
+    compiled fns; an eager DTensor marked Partial is reduced on first use
+    (reference reshard p_to_r)."""
+
+    def __init__(self, reduce_type="sum"):
+        self.reduce_type = reduce_type
+
+    def is_partial(self):
+        return True
+
+    def __repr__(self):
+        return f"Partial({self.reduce_type})"
+
+    def __eq__(self, other):
+        return isinstance(other, Partial) and other.reduce_type == self.reduce_type
+
+    def __hash__(self):
+        return hash(("partial", self.reduce_type))
+
+
+def to_partition_spec(placements, mesh, ndim):
+    """[Placement,...] (one per mesh dim) -> PartitionSpec over tensor dims."""
+    from jax.sharding import PartitionSpec
+    entries = [None] * ndim
+    for mesh_dim, p in enumerate(placements):
+        if isinstance(p, Shard):
+            axis_name = mesh.dim_names[mesh_dim]
+            cur = entries[p.dim]
+            if cur is None:
+                entries[p.dim] = axis_name
+            elif isinstance(cur, tuple):
+                entries[p.dim] = cur + (axis_name,)
+            else:
+                entries[p.dim] = (cur, axis_name)
+    return PartitionSpec(*entries)

@@ -1,0 +1,104 @@
+"""ProcessMesh (reference: python/paddle/distributed/auto_parallel/
+process_mesh.py:85; C++ paddle/phi/core/distributed/auto_parallel/process_mesh.h).
+
+TPU-native: a named view over jax devices that lowers to jax.sharding.Mesh.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+__all__ = ["ProcessMesh", "get_mesh", "set_mesh"]
+
+_GLOBAL_MESH: Optional["ProcessMesh"] = None
+
+
+class ProcessMesh:
+    def __init__(self, mesh=None, dim_names: Optional[Sequence[str]] = None,
+                 shape=None, process_ids=None):
+        if mesh is not None:
+            arr = np.asarray(mesh)
+        else:
+            arr = np.asarray(process_ids).reshape(shape)
+        self._ids = arr
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        self._dim_names = list(dim_names)
+        self._jax_mesh = None
+
+    @property
+    def shape(self):
+        return list(self._ids.shape)
+
+    @property
+    def ndim(self):
+        return self._ids.ndim
+
+    @property
+    def dim_names(self):
+        return list(self._dim_names)
+
+    @property
+    def process_ids(self):
+        return self._ids.reshape(-1).tolist()
+
+    @property
+    def mesh(self):
+        return self._ids
+
+    def get_dim_size(self, name):
+        return self._ids.shape[self._dim_names.index(name)]
+
+    def get_rank_by_dim_and_process_id(self, dim, process_id):
+        axis = self._dim_names.index(dim) if isinstance(dim, str) else dim
+        coords = np.argwhere(self._ids == process_id)
+        return int(coords[0][axis]) if len(coords) else -1
+
+    def to_jax(self) -> Mesh:
+        """Lower to jax.sharding.Mesh over the matching device objects."""
+        if self._jax_mesh is None:
+            devices = jax.devices()
+            grid = np.empty(self._ids.shape, dtype=object)
+            for idx in np.ndindex(self._ids.shape):
+                grid[idx] = devices[int(self._ids[idx]) % len(devices)]
+            self._jax_mesh = Mesh(grid, axis_names=tuple(self._dim_names))
+        return self._jax_mesh
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh)
+                and np.array_equal(self._ids, other._ids)
+                and self._dim_names == other._dim_names)
+
+    def __hash__(self):
+        return hash((self._ids.tobytes(), tuple(self._dim_names)))
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self.shape}, dim_names={self._dim_names})"
+
+    def __enter__(self):
+        global _GLOBAL_MESH
+        self._prev = _GLOBAL_MESH
+        _GLOBAL_MESH = self
+        return self
+
+    def __exit__(self, *exc):
+        global _GLOBAL_MESH
+        _GLOBAL_MESH = self._prev
+        return False
+
+
+def get_mesh() -> Optional[ProcessMesh]:
+    global _GLOBAL_MESH
+    if _GLOBAL_MESH is None:
+        n = jax.device_count()
+        _GLOBAL_MESH = ProcessMesh(np.arange(n), dim_names=["dp"])
+    return _GLOBAL_MESH
+
+
+def set_mesh(mesh: ProcessMesh):
+    global _GLOBAL_MESH
+    _GLOBAL_MESH = mesh
+    return mesh

@@ -1,0 +1,2 @@
+from .save_state_dict import save_state_dict  # noqa: F401
+from .load_state_dict import load_state_dict  # noqa: F401

@@ -1,0 +1,251 @@
+"""Collective communication API (reference:
+python/paddle/distributed/communication/*.py → ProcessGroupNCCL
+process_group_nccl.h:37; AllReduce :105).
+
+TPU-native dual dispatch (SURVEY.md §2.3): the same `dist.all_reduce(t)` call
+
+1. **traced** (inside a shard_map'ped/pjit'ted step fn): lowers directly to the
+   XLA collective (`lax.psum` / `all_gather` / `ppermute`) over the group's
+   mesh axis — compiled, fused, and overlap-scheduled by XLA over ICI.
+2. **eager on a sharded global array**: wraps the collective in a cached
+   shard_map jit over the array's mesh (eager-mode collectives analog).
+3. **eager single-participant**: identity (world_size 1).
+
+ReduceOp matches the reference's enum (communication/reduce.py).
+"""
+from __future__ import annotations
+
+import functools
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ...core.tensor import Tensor
+from .group import Group, get_group
+
+__all__ = ["ReduceOp", "all_reduce", "all_gather", "all_gather_object", "reduce",
+           "broadcast", "scatter", "reduce_scatter", "all_to_all",
+           "all_to_all_single", "send", "recv", "isend", "irecv",
+           "batch_isend_irecv", "P2POp", "gather"]
+
+
+class ReduceOp:
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+    AVG = 4
+
+
+def _is_traced(v):
+    return isinstance(v, jax.core.Tracer)
+
+
+def _axis(group: Optional[Group]) -> str:
+    if group is not None and group.axis_name:
+        return group.axis_name
+    return "dp"
+
+
+def _reduce_traced(v, op, axis):
+    if op == ReduceOp.SUM:
+        return jax.lax.psum(v, axis)
+    if op == ReduceOp.MAX:
+        return jax.lax.pmax(v, axis)
+    if op == ReduceOp.MIN:
+        return jax.lax.pmin(v, axis)
+    if op == ReduceOp.AVG:
+        return jax.lax.pmean(v, axis)
+    if op == ReduceOp.PROD:
+        return jnp.exp(jax.lax.psum(jnp.log(v), axis))
+    raise ValueError(f"unsupported reduce op {op}")
+
+
+def _mesh_of(v) -> Optional[Mesh]:
+    try:
+        sh = v.sharding
+        if isinstance(sh, NamedSharding):
+            return sh.mesh
+    except Exception:
+        pass
+    return None
+
+
+@functools.lru_cache(maxsize=256)
+def _eager_allreduce_fn(mesh, spec, op, axis):
+    from jax import shard_map
+    def body(x):
+        return _reduce_traced(x, op, axis)
+    fn = shard_map(body, mesh=mesh, in_specs=(spec,), out_specs=spec)
+    return jax.jit(fn)
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """In place on the Tensor (reference semantics)."""
+    v = tensor._value
+    axis = _axis(group)
+    if _is_traced(v):
+        out = _reduce_traced(v, op, axis)
+        tensor._set_value(out)
+        return tensor
+    mesh = _mesh_of(v)
+    if mesh is not None and axis in mesh.axis_names and mesh.shape[axis] > 1:
+        spec = v.sharding.spec
+        out = _eager_allreduce_fn(mesh, spec, op, axis)(v)
+        tensor._set_value(out)
+        return tensor
+    # single participant: identity
+    return tensor
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    # XLA collectives are all-to-all-symmetric; reduce == all_reduce with the
+    # result visible on every participant (superset of the contract)
+    return all_reduce(tensor, op, group, sync_op)
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True):
+    v = tensor._value
+    axis = _axis(group)
+    if _is_traced(v):
+        gathered = jax.lax.all_gather(v, axis)  # [n, ...]
+        n = gathered.shape[0]
+        if isinstance(tensor_list, list):
+            tensor_list.extend(Tensor(gathered[i]) for i in range(n))
+        return Tensor(gathered)
+    # eager: single participant
+    if isinstance(tensor_list, list):
+        tensor_list.append(Tensor(v))
+    return Tensor(v[None])
+
+
+def all_gather_object(object_list, obj, group=None):
+    object_list.append(obj)
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    v = tensor._value
+    axis = _axis(group)
+    if _is_traced(v):
+        n = jax.lax.psum(1, axis)
+        src_local = get_group(0).get_group_rank(src) if group is None else \
+            group.get_group_rank(src)
+        src_local = max(src_local, 0)
+        # select src's shard on every member: gather then index
+        gathered = jax.lax.all_gather(v, axis)
+        tensor._set_value(gathered[src_local])
+        return tensor
+    return tensor
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    if tensor_list:
+        idx = 0 if group is None else max(group.rank, 0)
+        tensor._set_value(tensor_list[idx]._value)
+    return tensor
+
+
+def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None, sync_op=True):
+    axis = _axis(group)
+    if isinstance(tensor_list, (list, tuple)):
+        stacked = jnp.stack([t._value for t in tensor_list])
+    else:
+        stacked = tensor_list._value
+    if _is_traced(stacked):
+        out = jax.lax.psum_scatter(stacked, axis, scatter_dimension=0, tiled=False)
+        tensor._set_value(out)
+        return tensor
+    tensor._set_value(stacked.sum(0) if op == ReduceOp.SUM else stacked.max(0))
+    return tensor
+
+
+def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    axis = _axis(group)
+    vals = [t._value for t in in_tensor_list]
+    if vals and _is_traced(vals[0]):
+        stacked = jnp.stack(vals)  # [n, ...] one slot per peer
+        out = jax.lax.all_to_all(stacked, axis, split_axis=0, concat_axis=0, tiled=False)
+        for i in range(out.shape[0]):
+            out_tensor_list.append(Tensor(out[i]))
+        return
+    out_tensor_list.extend(Tensor(v) for v in vals)
+
+
+def all_to_all_single(out_tensor, in_tensor, out_split_sizes=None,
+                      in_split_sizes=None, group=None, sync_op=True):
+    v = in_tensor._value
+    axis = _axis(group)
+    if _is_traced(v):
+        out = jax.lax.all_to_all(v, axis, split_axis=0, concat_axis=0, tiled=True)
+        out_tensor._set_value(out)
+        return out_tensor
+    out_tensor._set_value(v)
+    return out_tensor
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    """In-graph p2p via ppermute (pipeline stages); eager send between
+    processes is expressed through the pipeline schedule's compiled steps on
+    TPU (no raw NCCL-like eager p2p)."""
+    v = tensor._value
+    axis = _axis(group)
+    if _is_traced(v):
+        n = 1
+        perm = None  # ring shift to neighbor: dst relative
+        return Tensor(jax.lax.ppermute(v, axis, _ring_perm(axis, +1)))
+    return tensor
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    v = tensor._value
+    axis = _axis(group)
+    if _is_traced(v):
+        return Tensor(jax.lax.ppermute(v, axis, _ring_perm(axis, +1)))
+    return tensor
+
+
+def _ring_perm(axis, shift):
+    # resolved at trace time using the bound mesh
+    from ..topology import get_default_mesh
+    mesh = get_default_mesh()
+    n = mesh.shape[axis] if axis in mesh.axis_names else 1
+    return [(i, (i + shift) % n) for i in range(n)]
+
+
+class P2POp:
+    def __init__(self, op, tensor, peer, group=None):
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def isend(tensor, dst=0, group=None):
+    send(tensor, dst, group)
+    class _Task:
+        def wait(self):
+            pass
+    return _Task()
+
+
+def irecv(tensor, src=0, group=None):
+    recv(tensor, src, group)
+    class _Task:
+        def wait(self):
+            pass
+    return _Task()
+
+
+def batch_isend_irecv(p2p_op_list):
+    tasks = []
+    for op in p2p_op_list:
+        tasks.append(op.op(op.tensor, op.peer, op.group))
+    return tasks
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    if gather_list is not None:
+        gather_list.append(Tensor(tensor._value))
+    return tensor

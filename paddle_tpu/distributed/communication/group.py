@@ -1,0 +1,103 @@
+"""Process groups (reference: python/paddle/distributed/communication/group.py:29).
+
+TPU-native: a Group names a mesh axis (or an explicit rank list) of the global
+mesh; collectives over the group compile to XLA collectives over that axis.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+__all__ = ["Group", "new_group", "get_group", "is_initialized",
+           "destroy_process_group", "wait", "barrier", "get_backend"]
+
+_groups = {}
+_next_gid = [1]
+
+
+class Group:
+    def __init__(self, ranks: List[int], gid: int = 0, axis_name: Optional[str] = None):
+        self.ranks = list(ranks)
+        self.id = gid
+        self.axis_name = axis_name
+
+    @property
+    def nranks(self):
+        return len(self.ranks)
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    @property
+    def rank(self):
+        from ..env import global_rank
+        r = global_rank()
+        return self.ranks.index(r) if r in self.ranks else -1
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    def is_member(self):
+        from ..env import global_rank
+        return global_rank() in self.ranks
+
+    @property
+    def process_group(self):
+        return self
+
+    def __repr__(self):
+        return f"Group(id={self.id}, ranks={self.ranks}, axis={self.axis_name})"
+
+
+def _world_group():
+    from ..env import get_world_size
+    import jax
+    n = max(get_world_size(), 1)
+    if 0 not in _groups:
+        _groups[0] = Group(list(range(jax.device_count())), 0, axis_name=None)
+    return _groups[0]
+
+
+def new_group(ranks=None, backend=None, timeout=None):
+    import jax
+    if ranks is None:
+        ranks = list(range(jax.device_count()))
+    gid = _next_gid[0]
+    _next_gid[0] += 1
+    g = Group(list(ranks), gid)
+    _groups[gid] = g
+    return g
+
+
+def get_group(gid=0):
+    if gid == 0:
+        return _world_group()
+    return _groups.get(gid)
+
+
+def is_initialized():
+    from ..env import is_initialized as f
+    return f()
+
+
+def destroy_process_group(group=None):
+    if group is None:
+        _groups.clear()
+    else:
+        _groups.pop(group.id, None)
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    import jax
+    if hasattr(tensor, "_value"):
+        jax.block_until_ready(tensor._value)
+
+
+def barrier(group=None):
+    import jax
+    import jax.numpy as jnp
+    jax.block_until_ready(jnp.zeros(()))
+
+
+def get_backend(group=None):
+    return "xla"

@@ -1,0 +1,5 @@
+"""communication.stream parity — the reference exposes stream variants of each
+collective (communication/stream/); XLA has no user streams, so these alias
+the sync collectives."""
+from .collectives import (all_reduce, all_gather, reduce, broadcast, scatter,  # noqa: F401
+                          reduce_scatter, all_to_all, all_to_all_single, send, recv)

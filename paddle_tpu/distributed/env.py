@@ -1,0 +1,66 @@
+"""Distributed environment (reference: python/paddle/distributed/parallel.py
+env handling — PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM set by the launch CLI,
+launch/controllers/collective.py:76).
+
+TPU-native: jax's multi-controller runtime. Each process drives its local TPU
+chips; `init_parallel_env` maps to `jax.distributed.initialize` (the TCPStore
+rendezvous analog — reference store/tcp_store.h:121) using the same PADDLE_*
+env contract so the launch CLI works unchanged.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+_initialized = [False]
+
+
+def get_rank(group=None) -> int:
+    if group is not None:
+        return group.get_group_rank(global_rank())
+    return global_rank()
+
+
+def global_rank() -> int:
+    if _initialized[0]:
+        return jax.process_index()
+    return int(os.environ.get("PADDLE_TRAINER_ID", os.environ.get("RANK", 0)))
+
+
+def get_world_size(group=None) -> int:
+    if group is not None:
+        return group.nranks
+    if _initialized[0]:
+        return jax.process_count()
+    return int(os.environ.get("PADDLE_TRAINERS_NUM", os.environ.get("WORLD_SIZE", 1)))
+
+
+def is_initialized() -> bool:
+    return _initialized[0]
+
+
+def init_parallel_env():
+    """reference parallel.py:978 init_parallel_env. Single-host multi-chip
+    needs no rendezvous (one process drives all chips); multi-host uses the
+    coordination service."""
+    if _initialized[0]:
+        return
+    n_procs = int(os.environ.get("PADDLE_TRAINERS_NUM", os.environ.get("WORLD_SIZE", 1)))
+    if n_procs > 1:
+        endpoints = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        coord = os.environ.get("PADDLE_MASTER",
+                               endpoints.split(",")[0] if endpoints else None)
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", os.environ.get("RANK", 0)))
+        jax.distributed.initialize(coordinator_address=coord,
+                                   num_processes=n_procs, process_id=rank)
+        _initialized[0] = True
+    else:
+        _initialized[0] = True
+    from . import topology
+    topology.reset_default_mesh()
+    return
+
+
+def parallel_device_count() -> int:
+    return jax.device_count()

@@ -1,0 +1,25 @@
+"""Fleet hybrid-parallel orchestration (reference:
+python/paddle/distributed/fleet/ — fleet.init, distributed_model model.py:33,
+HybridParallelOptimizer, topology.py HybridCommunicateGroup).
+"""
+from __future__ import annotations
+
+from .base.distributed_strategy import DistributedStrategy
+from .fleet_api import (init, distributed_model, distributed_optimizer,
+                        get_hybrid_communicate_group, worker_num, worker_index,
+                        is_first_worker, barrier_worker, _get_fleet)
+from . import meta_parallel
+from .meta_parallel import (ColumnParallelLinear, RowParallelLinear,
+                            VocabParallelEmbedding, ParallelCrossEntropy,
+                            PipelineLayer, LayerDesc, SharedLayerDesc,
+                            TensorParallel, PipelineParallel)
+from .recompute import recompute, recompute_sequential
+from .utils import hybrid_parallel_util
+
+__all__ = ["DistributedStrategy", "init", "distributed_model",
+           "distributed_optimizer", "get_hybrid_communicate_group",
+           "worker_num", "worker_index", "is_first_worker", "barrier_worker",
+           "meta_parallel", "ColumnParallelLinear", "RowParallelLinear",
+           "VocabParallelEmbedding", "ParallelCrossEntropy", "PipelineLayer",
+           "LayerDesc", "SharedLayerDesc", "TensorParallel", "PipelineParallel",
+           "recompute", "recompute_sequential"]

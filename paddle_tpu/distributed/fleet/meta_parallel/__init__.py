@@ -1,0 +1,47 @@
+"""meta_parallel (reference: fleet/meta_parallel/)."""
+from __future__ import annotations
+
+from .mp_layers import (VocabParallelEmbedding, ColumnParallelLinear,  # noqa: F401
+                        RowParallelLinear, ParallelCrossEntropy)
+from .pp_layers import PipelineLayer, LayerDesc, SharedLayerDesc, SegmentLayers  # noqa: F401
+from .pipeline_parallel import PipelineParallel  # noqa: F401
+
+from ....nn.layer import Layer
+from ....core.random import get_rng_state_tracker  # noqa: F401 (mpu/random.py parity)
+
+
+class TensorParallel(Layer):
+    """reference meta_parallel/tensor_parallel.py:28: wrapper that broadcasts
+    non-distributed params across the mp group at init. On TPU the GSPMD
+    sharding attached by the mp layers already pins placement; replicated
+    params are consistent by construction (single controller), so this wrapper
+    only preserves the interface."""
+
+    def __init__(self, layers, hcg=None, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+
+class SegmentParallel(Layer):
+    """reference meta_parallel/segment_parallel.py:26 — sequence split over the
+    'sep' axis; activations are sharded on the sequence dim via constraints."""
+
+    def __init__(self, layers, hcg=None, strategy=None):
+        super().__init__()
+        self._layers = layers
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)

@@ -1,0 +1,202 @@
+"""Tensor-parallel layers (reference: python/paddle/distributed/fleet/layers/
+mpu/mp_layers.py — VocabParallelEmbedding :49, ColumnParallelLinear :336,
+RowParallelLinear :543, ParallelCrossEntropy :744; identity/allreduce PyLayers
+mp_ops.py:40,239).
+
+TPU-native design: two modes share one layer class.
+
+* **GSPMD mode** (default, inside pjit): weights carry a NamedSharding over
+  the 'mp' mesh axis and activations get `with_sharding_constraint`; XLA's
+  partitioner inserts exactly the identity/allreduce pattern the reference
+  hand-writes (f/g ops of Megatron). This is how the 119 C++ SPMD rules
+  collapse into the compiler.
+* **explicit mode** (inside shard_map, where the mesh axis is a named axis in
+  scope): forward uses `lax.psum` directly, matching the reference PyLayers
+  one-for-one.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ....core.tensor import Tensor, Parameter
+from ....core.dispatch import op_call
+from ....nn.layer import Layer
+from ....nn import functional as F_nn
+from ...topology import get_default_mesh
+
+__all__ = ["VocabParallelEmbedding", "ColumnParallelLinear", "RowParallelLinear",
+           "ParallelCrossEntropy", "mp_axis_in_scope", "shard_param",
+           "constrain"]
+
+
+def mp_axis_in_scope(axis="mp") -> bool:
+    """True when called inside shard_map over `axis` (explicit-collective mode)."""
+    try:
+        jax.lax.axis_index(axis)
+        return True
+    except Exception:
+        return False
+
+
+def shard_param(p: Parameter, spec_entries) -> Parameter:
+    """Attach a NamedSharding over the default mesh to a parameter's value."""
+    mesh = get_default_mesh()
+    entries = [e if (e is None or e in mesh.axis_names) else None
+               for e in spec_entries]
+    if all(e is None for e in entries):
+        return p
+    try:
+        sh = NamedSharding(mesh, P(*entries))
+        p._set_value(jax.device_put(p._value, sh))
+    except Exception:
+        pass  # mesh may not cover all devices in tests; weights stay replicated
+    return p
+
+
+def constrain(x, *entries, axis_filter=None):
+    """with_sharding_constraint on a Tensor when tracing under pjit."""
+    mesh = get_default_mesh()
+    ee = tuple(e if (e is None or e in mesh.axis_names) else None for e in entries)
+    def impl(v):
+        try:
+            return jax.lax.with_sharding_constraint(v, NamedSharding(mesh, P(*ee)))
+        except Exception:
+            return v
+    return op_call("shard_constraint", impl, x)
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding with the vocab dim sharded over 'mp' (reference :49)."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self._num = num_embeddings
+        self._dim = embedding_dim
+        from ....nn.initializer import Normal
+        from ....param_attr import ParamAttr
+        attr = ParamAttr._to_attr(weight_attr)
+        if isinstance(attr, ParamAttr) and attr.initializer is None:
+            attr.initializer = Normal(0.0, 0.02)
+        self.weight = self.create_parameter((num_embeddings, embedding_dim), attr=attr)
+        shard_param(self.weight, ("mp", None))
+
+    def forward(self, x):
+        if mp_axis_in_scope("mp"):
+            # explicit Megatron path: mask out-of-shard ids, psum results
+            def impl(w, ids):
+                n = jax.lax.psum(1, "mp")
+                r = jax.lax.axis_index("mp")
+                per = w.shape[0]
+                lo = r * 0 + 0  # local weights are already the shard
+                ids32 = ids.astype(jnp.int32)
+                local = ids32 - r * per
+                ok = (local >= 0) & (local < per)
+                safe = jnp.where(ok, local, 0)
+                emb = w[safe]
+                emb = jnp.where(ok[..., None], emb, 0.0)
+                return jax.lax.psum(emb, "mp")
+            return op_call("vocab_parallel_embedding", impl, self.weight, x)
+        out = F_nn.embedding(x, self.weight)
+        return constrain(out, *([None] * (out.ndim - 1)), None)
+
+
+class ColumnParallelLinear(Layer):
+    """Linear with out_features sharded over 'mp' (reference :336)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=None, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self._in = in_features
+        self._out = out_features
+        self.gather_output = gather_output
+        self.weight = self.create_parameter((in_features, out_features), attr=weight_attr)
+        self.bias = self.create_parameter((out_features,), is_bias=True) \
+            if has_bias in (True, None) else None
+        shard_param(self.weight, (None, "mp"))
+        if self.bias is not None:
+            shard_param(self.bias, ("mp",))
+
+    def forward(self, x):
+        if mp_axis_in_scope("mp"):
+            def impl(v, w, *b):
+                out = v @ w  # local shard of columns
+                if b:
+                    out = out + b[0]
+                return out
+            args = [x, self.weight] + ([self.bias] if self.bias is not None else [])
+            out = op_call("column_parallel_linear", impl, *args)
+            if self.gather_output:
+                def gather(v):
+                    g = jax.lax.all_gather(v, "mp")  # [mp, ..., out/mp]
+                    return jnp.moveaxis(g, 0, -2).reshape(v.shape[:-1] + (-1,))
+                out = op_call("mp_allgather", gather, out)
+            return out
+        out = F_nn.linear(x, self.weight, self.bias)
+        if not self.gather_output:
+            out = constrain(out, *([None] * (out.ndim - 1)), "mp")
+        return out
+
+
+class RowParallelLinear(Layer):
+    """Linear with in_features sharded over 'mp' (reference :543)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=True,
+                 input_is_parallel=False, fuse_matmul_bias=False, mp_group=None,
+                 name=None):
+        super().__init__()
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter((in_features, out_features), attr=weight_attr)
+        self.bias = self.create_parameter((out_features,), is_bias=True) if has_bias else None
+        shard_param(self.weight, ("mp", None))
+
+    def forward(self, x):
+        if mp_axis_in_scope("mp"):
+            def impl(v, w, *b):
+                part = v @ w
+                out = jax.lax.psum(part, "mp")
+                if b:
+                    out = out + b[0]
+                return out
+            args = [x, self.weight] + ([self.bias] if self.bias is not None else [])
+            return op_call("row_parallel_linear", impl, *args)
+        if self.input_is_parallel:
+            x = constrain(x, *([None] * (x.ndim - 1)), "mp")
+        out = F_nn.linear(x, self.weight, self.bias)
+        return constrain(out, *([None] * out.ndim))
+
+
+class ParallelCrossEntropy(Layer):
+    """Vocab-parallel softmax CE (reference :744): logits sharded on the class
+    dim over 'mp'; loss computed without materializing full logits."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        if mp_axis_in_scope("mp"):
+            def impl(logits, lab):
+                per = logits.shape[-1]
+                r = jax.lax.axis_index("mp")
+                # stable logsumexp over the sharded class dim
+                lmax = jax.lax.pmax(jnp.max(logits, -1, keepdims=True), "mp")
+                z = jnp.exp(logits - lmax)
+                denom = jax.lax.psum(jnp.sum(z, -1, keepdims=True), "mp")
+                lse = jnp.log(denom) + lmax
+                ids = lab.astype(jnp.int32)
+                local = ids - r * per
+                ok = (local >= 0) & (local < per)
+                safe = jnp.where(ok, local, 0)
+                picked = jnp.take_along_axis(logits, safe[..., None], -1)[..., 0]
+                picked = jnp.where(ok, picked, 0.0)
+                picked = jax.lax.psum(picked, "mp")
+                return (lse[..., 0] - picked)[..., None]
+            return op_call("parallel_cross_entropy", impl, input, label)
+        loss = F_nn.cross_entropy(input, label, reduction="none",
+                                  ignore_index=self.ignore_index)
+        from ....tensor.manipulation import unsqueeze
+        return unsqueeze(loss, -1)

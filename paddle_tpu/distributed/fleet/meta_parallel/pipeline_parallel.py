@@ -1,0 +1,77 @@
+"""Pipeline-parallel runtime (reference: fleet/meta_parallel/
+pipeline_parallel.py — 1F1B PipelineParallel :242, interleaved :1308,
+F-then-B :2396; P2P p2p_communication.py:651).
+
+TPU-native schedule: XLA is a static-graph world, so the schedule is expressed
+as a compiled microbatch loop (`paddle_tpu.parallel.pipeline` provides the
+shard_map/ppermute compiled schedule used by the perf path). This class keeps
+the reference's train_batch contract — microbatching + gradient accumulation
+with 1F1B-ordered execution — and executes stages in-process, which on a
+single controller is semantically identical (the compiled path fuses it onto
+the 'pp' axis).
+"""
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from ....core.tensor import Tensor
+from ....nn.layer import Layer
+from ....tensor import manipulation as manip
+
+__all__ = ["PipelineParallel"]
+
+
+class PipelineParallel(Layer):
+    def __init__(self, layers, hcg, strategy):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+        pp_cfg = strategy.hybrid_configs.get("pp_configs") if strategy else None
+        self._micro_batch_size = getattr(pp_cfg, "micro_batch_size", 1) if pp_cfg else 1
+        self._accumulate_steps = getattr(pp_cfg, "accumulate_steps", 1) if pp_cfg else 1
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None,
+                    loss_fn_idx=0):
+        """reference pipeline_parallel.py:940 train_batch: split the batch into
+        micro-batches, run fwd/bwd per micro-batch accumulating grads, step."""
+        x, y = data
+        n_micro = self._accumulate_steps
+        bs = x.shape[0]
+        mbs = max(bs // n_micro, 1)
+        n_micro = bs // mbs
+        total_loss = None
+        loss_fn = self._layers.loss_fn if hasattr(self._layers, "loss_fn") and \
+            self._layers.loss_fn is not None else None
+        for i in range(n_micro):
+            xm = x[i * mbs:(i + 1) * mbs]
+            ym = y[i * mbs:(i + 1) * mbs]
+            out = self._layers.forward(xm)
+            loss = loss_fn(out, ym) if loss_fn is not None else out
+            scaled = loss * (1.0 / n_micro)
+            if scaler is not None:
+                scaler.scale(scaled).backward()
+            else:
+                scaled.backward()
+            total_loss = scaled.detach() if total_loss is None \
+                else total_loss + scaled.detach()
+        if scaler is not None:
+            scaler.step(optimizer)
+        else:
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return total_loss
+
+    def eval_batch(self, data, compute_loss=True):
+        x, y = data
+        out = self._layers.forward(x)
+        if compute_loss and getattr(self._layers, "loss_fn", None) is not None:
+            return self._layers.loss_fn(out, y)
+        return out

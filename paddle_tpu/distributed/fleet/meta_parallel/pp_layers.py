@@ -1,0 +1,151 @@
+"""Pipeline layer description (reference: fleet/meta_parallel/parallel_layers/
+pp_layers.py — PipelineLayer :258, LayerDesc :57, SegmentLayers :93).
+
+PipelineLayer declares the model as an ordered list of LayerDescs and segments
+them into stages. On TPU the stages map onto the 'pp' mesh axis: the compiled
+schedule stacks per-stage parameters and runs microbatches with
+`lax.ppermute` hops between neighbors (see pipeline_parallel.py).
+"""
+from __future__ import annotations
+
+import math
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from ....nn.layer import Layer
+from ....nn.container import LayerList
+
+__all__ = ["LayerDesc", "SharedLayerDesc", "SegmentLayers", "PipelineLayer"]
+
+
+class LayerDesc:
+    def __init__(self, layer_cls, *inputs, **kwargs):
+        self.layer_cls = layer_cls
+        self.inputs = inputs
+        self.kwargs = kwargs
+        if not issubclass(layer_cls, Layer) and not callable(layer_cls):
+            raise TypeError("LayerDesc expects a Layer subclass")
+
+    def build_layer(self):
+        return self.layer_cls(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_cls.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """Weight-tied layer reused across stages (embedding/output head tying,
+    reference pp_layers.py SharedLayerDesc)."""
+
+    def __init__(self, key, layer_cls, forward_func=None, shared_weight_attr="weight",
+                 *inputs, **kwargs):
+        super().__init__(layer_cls, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class SegmentLayers:
+    """Split N layer descs into num_parts stages (reference :93) — 'uniform'
+    or 'layer:<ClassName>' boundary strategy."""
+
+    def __init__(self, layers_desc, num_parts, method="uniform", num_virtual_pipeline_stage=None):
+        self.descs = layers_desc
+        self.num_parts = num_parts
+        self.method = method
+
+    def do_segment(self) -> List[int]:
+        n = len(self.descs)
+        if self.method == "uniform":
+            return self.uniform(n, self.num_parts)
+        if self.method.startswith("layer:"):
+            name = self.method.split(":", 1)[1]
+            idxs = [i for i, d in enumerate(self.descs)
+                    if getattr(getattr(d, "layer_cls", type(d)), "__name__", "") == name]
+            if len(idxs) < self.num_parts:
+                return self.uniform(n, self.num_parts)
+            # distribute the named layers evenly over stages
+            per = len(idxs) / self.num_parts
+            bounds = [0]
+            for p in range(1, self.num_parts):
+                bounds.append(idxs[int(round(p * per))])
+            bounds.append(n)
+            return bounds
+        raise ValueError(f"unknown segment method {self.method}")
+
+    @staticmethod
+    def uniform(num_items, num_parts):
+        base = num_items // num_parts
+        rem = num_items % num_parts
+        bounds = [0]
+        for i in range(num_parts):
+            bounds.append(bounds[-1] + base + (1 if i < rem else 0))
+        return bounds
+
+
+class PipelineLayer(Layer):
+    """reference pp_layers.py:258. Holds the full desc list; builds the local
+    stage's layers (single-controller TPU builds all stages and shards their
+    params over 'pp' in the compiled schedule)."""
+
+    def __init__(self, layers, num_stages=None, topology=None, loss_fn=None,
+                 seg_method="uniform", recompute_interval=0,
+                 recompute_ctx=None, num_virtual_pipeline_stages=None):
+        super().__init__()
+        self._descs = list(layers)
+        self._topo = topology
+        if num_stages is None and topology is not None:
+            num_stages = topology.get_dim("pipe")
+        self._num_stages = num_stages or 1
+        self._loss_fn = loss_fn
+        self._recompute_interval = recompute_interval
+        self.segment_parts = SegmentLayers(self._descs, self._num_stages,
+                                           seg_method).do_segment()
+        # single-controller: materialize every stage; stage boundaries kept
+        self._shared = {}
+        built = []
+        for d in self._descs:
+            built.append(self._build_one(d))
+        self.run_function = LayerList(built)
+
+    def _build_one(self, d):
+        if isinstance(d, SharedLayerDesc):
+            if d.layer_name not in self._shared:
+                self._shared[d.layer_name] = d.build_layer()
+            base = self._shared[d.layer_name]
+            if d.forward_func is None:
+                return base
+            fwd = d.forward_func
+
+            class _SharedFwd(Layer):
+                def __init__(self):
+                    super().__init__()
+                    self.inner = base
+
+                def forward(self, *args, **kw):
+                    return fwd(self.inner, *args, **kw)
+            return _SharedFwd()
+        if isinstance(d, LayerDesc):
+            return d.build_layer()
+        if isinstance(d, Layer):
+            return d
+        if callable(d):
+            class _Fn(Layer):
+                def forward(self, *args, **kw):
+                    return d(*args, **kw)
+            return _Fn()
+        raise TypeError(f"bad pipeline item {d!r}")
+
+    def get_stage_layers(self, stage: int):
+        lo, hi = self.segment_parts[stage], self.segment_parts[stage + 1]
+        return [self.run_function[i] for i in range(lo, hi)]
+
+    @property
+    def loss_fn(self):
+        return self._loss_fn
+
+    def forward(self, x):
+        for l in self.run_function:
+            x = l(x) if not isinstance(x, tuple) else l(*x)
+        return x

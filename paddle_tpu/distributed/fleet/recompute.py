@@ -1,0 +1,128 @@
+"""Activation recomputation (reference: fleet/recompute/recompute.py —
+RecomputeFunction :128, recompute() :463, recompute_sequential :630,
+non-reentrant :327, RNG tracker replay :116).
+
+TPU-native: eager mode uses the reentrant PyLayer pattern — forward runs under
+no_grad (drops activations), backward re-runs forward with grad and routes
+upstream grads through the fresh subgraph (param grads accumulate via the
+tape's leaf accumulation, matching the reference). RNG state is snapshotted
+and replayed so dropout masks match. Compiled train steps should instead use
+``jax.checkpoint`` via paddle_tpu.parallel.compile helpers — same semantics,
+handled by XLA rematerialization.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+
+from ...core.tensor import Tensor
+from ...core.dispatch import no_grad, is_grad_enabled
+from ...core.autograd import GradNode, backward as tape_backward
+from ...core import random as random_mod
+
+__all__ = ["recompute", "recompute_sequential"]
+
+
+class _RecomputeVjp:
+    def __init__(self, function, args, kwargs, rng_state, n_outputs):
+        self.function = function
+        self.args = args
+        self.kwargs = kwargs
+        self.rng_state = rng_state
+        self.n_outputs = n_outputs
+
+    def __call__(self, cotangents):
+        cts = cotangents if isinstance(cotangents, tuple) else (cotangents,)
+        # replay RNG so dropout masks match the first forward
+        saved = random_mod.get_rng_state()
+        random_mod.set_rng_state(self.rng_state)
+        try:
+            detached = [a.detach() if isinstance(a, Tensor) else a for a in self.args]
+            for d, a in zip(detached, self.args):
+                if isinstance(a, Tensor):
+                    d.stop_gradient = a.stop_gradient
+            outs = self.function(*detached, **self.kwargs)
+        finally:
+            random_mod.set_rng_state(saved)
+        outs_t = outs if isinstance(outs, (tuple, list)) else (outs,)
+        tensor_outs = [o for o in outs_t if isinstance(o, Tensor)]
+        grads = [Tensor(c) for c in cts[: len(tensor_outs)]]
+        tape_backward(tensor_outs, grads)
+        in_grads = []
+        for d in detached:
+            if isinstance(d, Tensor) and d._grad is not None:
+                in_grads.append(d._grad._value)
+            elif isinstance(d, Tensor):
+                import jax.numpy as jnp
+                in_grads.append(jnp.zeros(d._value.shape, d._value.dtype))
+        return tuple(in_grads)
+
+
+def recompute(function, *args, **kwargs):
+    """reference recompute.py:463."""
+    preserve = kwargs.pop("preserve_rng_state", True)
+    use_reentrant = kwargs.pop("use_reentrant", True)
+    if not is_grad_enabled():
+        return function(*args, **kwargs)
+    rng_state = random_mod.get_rng_state() if preserve else None
+    with no_grad():
+        outs = function(*args, **kwargs)
+    outs_t = outs if isinstance(outs, (tuple, list)) else (outs,)
+    tensor_outs = [o for o in outs_t if isinstance(o, Tensor)]
+    diff_inputs = [a for a in args if isinstance(a, Tensor) and not a.stop_gradient]
+    if not diff_inputs and not any(not p.stop_gradient for p in _touched_params(function)):
+        return outs
+    tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+    node = GradNode(
+        name="recompute",
+        vjp_fn=_RecomputeVjp(function, args, kwargs, rng_state, len(tensor_outs)),
+        inputs=tensor_inputs,
+        out_avals=[(tuple(o.shape), o._value.dtype) for o in tensor_outs],
+        multi=len(tensor_outs) > 1,
+    )
+    for k, o in enumerate(tensor_outs):
+        o.stop_gradient = False
+        o._grad_node = node
+        o._out_index = k
+        node.attach_output(k, o)
+    return outs
+
+
+def _touched_params(function):
+    obj = getattr(function, "__self__", None)
+    from ...nn.layer import Layer
+    if isinstance(obj, Layer):
+        return obj.parameters()
+    if isinstance(function, Layer):
+        return function.parameters()
+    return []
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """reference recompute.py:630: chunk a Sequential into segments and
+    recompute each."""
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    if hasattr(functions, "_sub_layers"):
+        fns = list(functions._sub_layers.values())
+    else:
+        fns = list(functions)
+    n = len(fns)
+    per = max(n // segments, 1)
+
+    def seg_forward(lo, hi):
+        def run(*inp):
+            out = inp[0] if len(inp) == 1 else inp
+            for f in fns[lo:hi]:
+                out = f(out) if not isinstance(out, tuple) else f(*out)
+            return out
+        return run
+
+    out = args
+    i = 0
+    while i < n:
+        hi = min(i + per, n)
+        run = seg_forward(i, hi)
+        out = recompute(run, *(out if isinstance(out, tuple) else (out,)), **kwargs)
+        i = hi
+    return out

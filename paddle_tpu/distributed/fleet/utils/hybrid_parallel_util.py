@@ -1,0 +1,46 @@
+"""Hybrid-parallel helpers (reference: fleet/utils/hybrid_parallel_util.py —
+fused_allreduce_gradients :262, broadcast_*_parameters)."""
+from __future__ import annotations
+
+from ....core.tensor import Tensor
+from ...communication.collectives import all_reduce, ReduceOp, broadcast
+
+__all__ = ["fused_allreduce_gradients", "broadcast_mp_parameters",
+           "broadcast_dp_parameters", "broadcast_sharding_parameters",
+           "broadcast_sep_parameters"]
+
+
+def fused_allreduce_gradients(parameter_list, hcg):
+    """All-reduce grads over the dp group (XLA fuses the per-tensor collectives
+    like the reference's fused buckets)."""
+    group = hcg.get_data_parallel_group() if hcg is not None else None
+    n = group.nranks if group is not None else 1
+    if n <= 1:
+        return
+    for p in parameter_list:
+        if p._grad is not None:
+            all_reduce(p._grad, op=ReduceOp.SUM, group=group)
+            p._grad._set_value(p._grad._value / n)
+
+
+def broadcast_mp_parameters(model, hcg):
+    _broadcast_params(model, hcg.get_model_parallel_group())
+
+
+def broadcast_dp_parameters(model, hcg):
+    _broadcast_params(model, hcg.get_data_parallel_group())
+
+
+def broadcast_sharding_parameters(model, hcg):
+    _broadcast_params(model, hcg.get_sharding_parallel_group())
+
+
+def broadcast_sep_parameters(model, hcg):
+    _broadcast_params(model, hcg.get_sep_parallel_group())
+
+
+def _broadcast_params(model, group):
+    if group is None or group.nranks <= 1:
+        return
+    for p in model.parameters():
+        broadcast(p, src=group.ranks[0], group=group)

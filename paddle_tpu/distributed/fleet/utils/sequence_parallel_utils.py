@@ -1,0 +1,111 @@
+"""Megatron sequence parallelism (reference: fleet/utils/
+sequence_parallel_utils.py — ScatterOp/GatherOp/AllGatherOp/ReduceScatterOp
+PyLayers :85-250, ColumnSequenceParallelLinear / RowSequenceParallelLinear
+:336-564, overlap variant SPInnerOverlapLinear :257).
+
+TPU-native: in GSPMD mode the scatter/gather pair is a pair of sharding
+constraints on the sequence dim (XLA inserts all-gather/reduce-scatter and
+overlaps them with the matmuls — the hand-written SPInnerOverlapLinear
+overlap comes free). In shard_map mode the explicit collectives are used.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....core.tensor import Tensor
+from ....core.dispatch import op_call
+from ....nn.layer import Layer
+from ....nn import functional as F_nn
+from ..meta_parallel.mp_layers import mp_axis_in_scope, constrain, shard_param
+
+__all__ = ["ScatterOp", "GatherOp", "AllGatherOp", "ReduceScatterOp",
+           "mark_as_sequence_parallel_parameter",
+           "ColumnSequenceParallelLinear", "RowSequenceParallelLinear",
+           "create_fused_allreduce_gradient_hooks"]
+
+
+class ScatterOp:
+    """Split activations along sequence dim across 'mp' (reference :85)."""
+
+    @staticmethod
+    def apply(x, axis=0):
+        if mp_axis_in_scope("mp"):
+            def impl(v):
+                n = jax.lax.psum(1, "mp")
+                r = jax.lax.axis_index("mp")
+                per = v.shape[axis] // n
+                return jax.lax.dynamic_slice_in_dim(v, r * per, per, axis)
+            return op_call("sp_scatter", impl, x)
+        return constrain(x, *(["mp" if i == axis else None for i in range(x.ndim)]))
+
+
+class GatherOp:
+    """Inverse of ScatterOp (reference :~120)."""
+
+    @staticmethod
+    def apply(x, axis=0):
+        if mp_axis_in_scope("mp"):
+            def impl(v):
+                g = jax.lax.all_gather(v, "mp")  # [n, ...]
+                return jnp.concatenate([g[i] for i in range(g.shape[0])], axis=axis)
+            return op_call("sp_gather", impl, x)
+        return constrain(x, *([None] * x.ndim))
+
+
+class AllGatherOp:
+    """all-gather along sequence in fwd, reduce-scatter in bwd (reference :176)."""
+
+    @staticmethod
+    def apply(x, axis=0):
+        return GatherOp.apply(x, axis)
+
+
+class ReduceScatterOp:
+    @staticmethod
+    def apply(x, axis=0):
+        if mp_axis_in_scope("mp"):
+            def impl(v):
+                return jax.lax.psum_scatter(v, "mp", scatter_dimension=axis, tiled=True)
+            return op_call("sp_reduce_scatter", impl, x)
+        return ScatterOp.apply(x, axis)
+
+
+def mark_as_sequence_parallel_parameter(param):
+    param.sequence_parallel = True
+
+
+class ColumnSequenceParallelLinear(Layer):
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=None,
+                 gather_output=False, fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self.weight = self.create_parameter((in_features, out_features), attr=weight_attr)
+        self.bias = self.create_parameter((out_features,), is_bias=True) \
+            if has_bias in (True, None) else None
+        shard_param(self.weight, (None, "mp"))
+
+    def forward(self, x):
+        # input is sequence-sharded; gather sequence, compute column shard
+        full = AllGatherOp.apply(x, axis=0 if x.ndim == 3 else 0)
+        out = F_nn.linear(full, self.weight, self.bias)
+        return constrain(out, *([None] * (out.ndim - 1)), "mp")
+
+
+class RowSequenceParallelLinear(Layer):
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=True,
+                 input_is_parallel=True, fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self.weight = self.create_parameter((in_features, out_features), attr=weight_attr)
+        self.bias = self.create_parameter((out_features,), is_bias=True) if has_bias else None
+        shard_param(self.weight, ("mp", None))
+
+    def forward(self, x):
+        out = F_nn.linear(x, self.weight, None)
+        out = ReduceScatterOp.apply(out, axis=0)
+        if self.bias is not None:
+            out = out + self.bias
+        return out
+
+
+def create_fused_allreduce_gradient_hooks(model, accumulation_steps=1):
+    return []

@@ -1,0 +1,137 @@
+"""DataParallel + helpers (reference: python/paddle/distributed/parallel.py:219
+DataParallel backed by C++ EagerReducer gradient bucketing reducer.h:88).
+
+TPU-native: in the compiled train step, DP gradient sync is a by-product of
+sharding the batch over the 'dp' mesh axis (XLA inserts the reduce-scatter/
+all-reduce and overlaps it with backward — the EagerReducer's bucketing+overlap
+role). Eager mode attaches grad hooks that all-reduce over 'dp' when grads
+materialize, preserving the reference's semantics.
+"""
+from __future__ import annotations
+
+import jax
+
+from ..nn.layer import Layer
+from ..core.tensor import Tensor
+from .env import init_parallel_env, get_rank, get_world_size  # noqa: F401
+from .communication.collectives import all_reduce, ReduceOp
+from .communication.group import Group
+
+__all__ = ["DataParallel", "init_parallel_env", "get_rank", "get_world_size",
+           "ParallelEnv"]
+
+
+class ParallelEnv:
+    """reference parallel.py ParallelEnv env-var view."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def device_id(self):
+        return 0
+
+    @property
+    def current_endpoint(self):
+        import os
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+        r = get_rank()
+        return eps[r] if r < len(eps) else ""
+
+    @property
+    def trainer_endpoints(self):
+        import os
+        return os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+
+
+class DataParallel(Layer):
+    """Wraps a Layer for data-parallel training (reference parallel.py:219).
+
+    comm_buffer_size / last_comm_buffer_size accepted for API parity; XLA's
+    scheduler performs the fusion the reference's EagerReducer buckets do.
+    """
+
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None, process_group=None):
+        super().__init__()
+        self._layers = layers
+        self._group = group or process_group
+        self.find_unused_parameters = find_unused_parameters
+        self._grad_hooks = []
+        if get_world_size(self._group) > 1:
+            self._register_grad_hooks()
+
+    def _register_grad_hooks(self):
+        n = get_world_size(self._group)
+        for p in self._layers.parameters():
+            if p.stop_gradient:
+                continue
+            def hook(g, _n=n):
+                t = g if isinstance(g, Tensor) else Tensor(g)
+                all_reduce(t, op=ReduceOp.SUM, group=self._group)
+                return t * (1.0 / _n)
+            self._grad_hooks.append(p.register_hook(hook))
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    # passthrough for state access
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        pass
+
+    @property
+    def _inner_layer(self):
+        return self._layers
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """paddle.distributed.spawn parity (reference spawn.py): fork one process
+    per rank with PADDLE_* env."""
+    import multiprocessing as mp
+    import os
+    if nprocs == -1:
+        nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+    ctx = mp.get_context("spawn")
+    procs = []
+    base_port = int(options.get("started_port", 37000))
+    endpoints = ",".join(f"127.0.0.1:{base_port + i}" for i in range(nprocs))
+    for rank in range(nprocs):
+        env = dict(os.environ)
+        env.update({"PADDLE_TRAINER_ID": str(rank),
+                    "PADDLE_TRAINERS_NUM": str(nprocs),
+                    "PADDLE_TRAINER_ENDPOINTS": endpoints,
+                    "PADDLE_MASTER": endpoints.split(",")[0]})
+        p = ctx.Process(target=_spawn_entry, args=(func, args, env), daemon=daemon)
+        p.start()
+        procs.append(p)
+    if join:
+        for p in procs:
+            p.join()
+    return procs
+
+
+def _spawn_entry(func, args, env):
+    import os
+    os.environ.update(env)
+    func(*args)

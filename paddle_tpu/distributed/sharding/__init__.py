@@ -1,0 +1,104 @@
+"""Group sharded (ZeRO) API (reference: python/paddle/distributed/sharding/
+group_sharded.py group_sharded_parallel + fleet meta_parallel/sharding/ —
+stage2 optimizer/model, stage3 group_sharded_stage3.py; mechanics in
+SURVEY.md §2.1 "ZeRO-3 mechanics").
+
+TPU-native: ZeRO states are sharding specs, not runtime machinery —
+* stage 1: optimizer state arrays placed with NamedSharding over 'sharding'
+* stage 2: + gradients reduce-scattered (XLA emits reduce-scatter when the
+  grad spec is sharded in the compiled step)
+* stage 3: + parameters sharded, re-gathered per-layer inside the step
+  (explicit all_gather in the sharded step fn + XLA buffer donation frees the
+  gathered copy — the forward-prehook gather / posthook release analog).
+
+The eager wrapper shards the optimizer accumulators; the compiled path in
+paddle_tpu.parallel.sharded applies the specs to the whole train step.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...core.tensor import Tensor
+from ..topology import get_default_mesh
+
+__all__ = ["group_sharded_parallel", "save_group_sharded_model",
+           "shard_accumulator_specs"]
+
+
+def _shard_axis_for(value, mesh, axis="sharding"):
+    """Choose the largest tensor dim divisible by the axis size (the flat
+    per-rank slice buffer analog of _param2buffer, group_sharded_stage3.py:174)."""
+    if axis not in mesh.axis_names or mesh.shape[axis] <= 1:
+        return None
+    n = mesh.shape[axis]
+    for d, s in enumerate(value.shape):
+        if s % n == 0 and s >= n:
+            return d
+    return None
+
+
+def shard_accumulator_specs(params, mesh=None, axis="sharding"):
+    """{name: PartitionSpec} for optimizer accumulators (stage-1 layout)."""
+    mesh = mesh or get_default_mesh()
+    specs = {}
+    for name, v in params.items():
+        d = _shard_axis_for(v, mesh, axis)
+        entries = [None] * v.ndim
+        if d is not None:
+            entries[d] = axis
+        specs[name] = P(*entries)
+    return specs
+
+
+def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,
+                           offload=False, sync_buffers=False, buffer_max_size=2 ** 23,
+                           segment_size=2 ** 20, sync_comm=False,
+                           dp_group=None, exclude_layer=None):
+    """reference group_sharded.py group_sharded_parallel(level='os'|'os_g'|'p_g_os')."""
+    mesh = get_default_mesh()
+    axis = "sharding" if "sharding" in mesh.axis_names and mesh.shape.get("sharding", 1) > 1 \
+        else ("dp" if "dp" in mesh.axis_names else None)
+    if axis is None or mesh.shape[axis] <= 1:
+        return model, optimizer, scaler
+
+    # stage >= 1: shard existing/future optimizer accumulators
+    orig_init_state = optimizer._init_state
+
+    def sharded_init_state(value):
+        state = orig_init_state(value)
+        d = _shard_axis_for(value, mesh, axis)
+        if d is None:
+            return state
+        entries = [None] * value.ndim
+        entries[d] = axis
+        sh = NamedSharding(mesh, P(*entries))
+        out = {}
+        for k, v in state.items():
+            if hasattr(v, "shape") and v.shape == value.shape:
+                out[k] = jax.device_put(v, sh)
+            else:
+                out[k] = v
+        return out
+    optimizer._init_state = sharded_init_state
+
+    if level in ("p_g_os", "p_g_os3", 3, "stage3"):
+        # stage 3: shard parameters themselves
+        for p in model.parameters():
+            d = _shard_axis_for(p._value, mesh, axis)
+            if d is None:
+                continue
+            entries = [None] * p._value.ndim
+            entries[d] = axis
+            p._set_value(jax.device_put(p._value, NamedSharding(mesh, P(*entries))))
+    return model, optimizer, scaler
+
+
+def save_group_sharded_model(model, output, optimizer=None):
+    import os
+    from ... import framework
+    os.makedirs(output, exist_ok=True)
+    framework.save(model.state_dict(), os.path.join(output, "model.pdparams"))
+    if optimizer is not None:
+        framework.save(optimizer.state_dict(), os.path.join(output, "model.pdopt"))

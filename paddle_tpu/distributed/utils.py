@@ -1,0 +1,15 @@
+"""Misc distributed utils."""
+from __future__ import annotations
+
+import jax
+
+__all__ = ["get_local_rank", "get_node_count"]
+
+
+def get_local_rank():
+    import os
+    return int(os.environ.get("PADDLE_LOCAL_RANK", 0))
+
+
+def get_node_count():
+    return max(jax.process_count(), 1)

@@ -1,0 +1,114 @@
+"""Global flag registry.
+
+TPU-native analog of the reference's gflags-style registry
+(paddle/common/flags.h:38 PD_DEFINE_*, flags.cc 183 exported FLAGS_*,
+paddle/common/flags.h:336 ExportedFlagInfoMap). Flags are plain Python state:
+registered with a type + default + help string, overridable from the
+environment (``FLAGS_check_nan_inf=1``) exactly like the reference, and
+settable at runtime via :func:`set_flags` (``paddle.set_flags`` parity).
+"""
+from __future__ import annotations
+
+import builtins
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+_LOCK = threading.RLock()
+
+
+@dataclass
+class FlagInfo:
+    name: str
+    type: type
+    default: Any
+    value: Any
+    help: str = ""
+    is_writable: bool = True
+    on_change: Optional[Callable[[Any], None]] = None
+
+
+_REGISTRY: Dict[str, FlagInfo] = {}
+
+
+def _coerce(ftype: type, value: Any) -> Any:
+    if ftype is bool:
+        if isinstance(value, str):
+            return value.strip().lower() in ("1", "true", "yes", "on")
+        return bool(value)
+    return ftype(value)
+
+
+def define_flag(name: str, default: Any, help: str = "", type: Optional[type] = None,
+                on_change: Optional[Callable[[Any], None]] = None) -> None:
+    """Register a flag. Environment variable ``FLAGS_<name>`` overrides the
+    default at registration time (env parity with the reference)."""
+    ftype = type if type is not None else builtins.type(default)
+    with _LOCK:
+        env = os.environ.get(f"FLAGS_{name}")
+        value = _coerce(ftype, env) if env is not None else default
+        _REGISTRY[name] = FlagInfo(name=name, type=ftype, default=default,
+                                   value=value, help=help, on_change=on_change)
+        if env is not None and on_change is not None:
+            on_change(value)
+
+
+def get_flags(flags=None) -> Dict[str, Any]:
+    """paddle.get_flags parity: return {name: value} for the requested flags
+    (all flags when None)."""
+    with _LOCK:
+        if flags is None:
+            return {k: v.value for k, v in _REGISTRY.items()}
+        if isinstance(flags, str):
+            flags = [flags]
+        out = {}
+        for name in flags:
+            key = name[6:] if name.startswith("FLAGS_") else name
+            if key not in _REGISTRY:
+                raise ValueError(f"Flag {name} not registered")
+            out[name] = _REGISTRY[key].value
+        return out
+
+
+def set_flags(flags: Dict[str, Any]) -> None:
+    """paddle.set_flags parity."""
+    with _LOCK:
+        for name, value in flags.items():
+            key = name[6:] if name.startswith("FLAGS_") else name
+            if key not in _REGISTRY:
+                raise ValueError(f"Flag {name} not registered")
+            info = _REGISTRY[key]
+            if not info.is_writable:
+                raise ValueError(f"Flag {name} is not writable at runtime")
+            info.value = _coerce(info.type, value)
+            if info.on_change is not None:
+                info.on_change(info.value)
+
+
+def get_flag(name: str) -> Any:
+    key = name[6:] if name.startswith("FLAGS_") else name
+    return _REGISTRY[key].value
+
+
+def exported_flags_info() -> Dict[str, FlagInfo]:
+    """ExportedFlagInfoMap analog (paddle/common/flags.h:336)."""
+    with _LOCK:
+        return dict(_REGISTRY)
+
+
+# ---------------------------------------------------------------------------
+# Core flags (subset of the reference's 183 with TPU-meaningful semantics).
+# ---------------------------------------------------------------------------
+define_flag("check_nan_inf", False, "Scan op outputs for NaN/Inf after every eager op "
+            "(reference FLAGS_check_nan_inf; generated sites eager_gen.py:749).", type=bool)
+define_flag("check_nan_inf_level", 0, "0: abort on NaN/Inf; >=1: warn only.", type=int)
+define_flag("benchmark", False, "Block on every op for accurate timing.", type=bool)
+define_flag("paddle_tpu_deterministic", False, "Force deterministic kernels.", type=bool)
+define_flag("use_pallas_kernels", True, "Enable Pallas kernel overrides for hot ops.", type=bool)
+define_flag("log_level", 0, "VLOG-style verbosity.", type=int)
+define_flag("amp_dtype", "bfloat16", "Default AMP low-precision dtype on TPU.", type=str)
+define_flag("allocator_strategy", "xla", "Informational: HBM is managed by XLA.", type=str,
+            )
+define_flag("embedding_deterministic", False, "Deterministic embedding grad scatter.", type=bool)
+define_flag("cudnn_deterministic", False, "Accepted for reference compat; no-op on TPU.", type=bool)

@@ -1,0 +1,115 @@
+"""Framework-level helpers: save/load, places, mode queries.
+
+Reference: python/paddle/framework/io.py (save :773, load :1020) — nested
+state_dict pickling with tensors converted to numpy; python/paddle/base/
+framework.py places.
+"""
+from __future__ import annotations
+
+import io as _io
+import os
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .core.tensor import Tensor, Parameter
+from .flags import get_flags, set_flags  # re-export
+
+__all__ = ["save", "load", "CPUPlace", "TPUPlace", "CUDAPlace", "XPUPlace",
+           "in_dynamic_mode", "set_grad_enabled", "get_flags", "set_flags"]
+
+
+class _Place:
+    def __init__(self, idx=0):
+        self._idx = idx
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._idx})"
+
+
+class CPUPlace(_Place):
+    pass
+
+
+class TPUPlace(_Place):
+    pass
+
+
+class CUDAPlace(TPUPlace):
+    """Accepted for reference-script compat; maps to the TPU device."""
+
+
+class XPUPlace(TPUPlace):
+    pass
+
+
+def in_dynamic_mode():
+    return True
+
+
+def set_grad_enabled(mode):
+    from .core.dispatch import set_grad_enabled as f
+    return f(mode)
+
+
+def _to_saveable(obj):
+    """Recursively convert Tensors to numpy for pickling (paddle.save
+    parity: nested dict/list/tuple of tensors + python objects)."""
+    if isinstance(obj, Tensor):
+        return {"__paddle_tpu_tensor__": True,
+                "data": np.asarray(jax.device_get(obj._value)),
+                "stop_gradient": obj.stop_gradient,
+                "is_parameter": isinstance(obj, Parameter),
+                "name": obj.name}
+    if isinstance(obj, dict):
+        return {k: _to_saveable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_to_saveable(v) for v in obj)
+    if isinstance(obj, (jnp.ndarray, jax.Array)):
+        return {"__paddle_tpu_tensor__": True, "data": np.asarray(jax.device_get(obj)),
+                "stop_gradient": True, "is_parameter": False, "name": None}
+    return obj
+
+
+def _from_saveable(obj, return_numpy=False):
+    if isinstance(obj, dict):
+        if obj.get("__paddle_tpu_tensor__"):
+            data = obj["data"]
+            if return_numpy:
+                return data
+            cls = Parameter if obj.get("is_parameter") else Tensor
+            if cls is Parameter:
+                t = Parameter(jnp.asarray(data), name=obj.get("name"))
+                t.stop_gradient = obj.get("stop_gradient", False)
+                return t
+            return Tensor(jnp.asarray(data), stop_gradient=obj.get("stop_gradient", True),
+                          name=obj.get("name"))
+        return {k: _from_saveable(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_from_saveable(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    """paddle.save parity (framework/io.py:773)."""
+    if hasattr(path, "write"):
+        pickle.dump(_to_saveable(obj), path, protocol=protocol)
+        return
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_saveable(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **configs):
+    """paddle.load parity (framework/io.py:1020)."""
+    if hasattr(path, "read"):
+        obj = pickle.load(path)
+    else:
+        with open(path, "rb") as f:
+            obj = pickle.load(f)
+    return _from_saveable(obj, return_numpy=return_numpy)

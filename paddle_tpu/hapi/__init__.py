@@ -1,0 +1,7 @@
+"""High-level API (reference: python/paddle/hapi/ — Model.fit model.py:1472,
+callbacks, summary)."""
+from .model import Model
+from .summary import summary
+from . import callbacks
+
+__all__ = ["Model", "summary", "callbacks"]

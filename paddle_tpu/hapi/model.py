@@ -1,0 +1,194 @@
+"""hapi.Model (reference: python/paddle/hapi/model.py — fit :1472,
+evaluate, predict, save/load)."""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+from ..io import DataLoader, Dataset
+from .. import framework
+from .callbacks import CallbackList, ProgBarLogger
+
+__all__ = ["Model"]
+
+
+class Model:
+    def __init__(self, network: Layer, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self.stop_training = False
+
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = metrics if isinstance(metrics, (list, tuple)) else \
+            ([metrics] if metrics is not None else [])
+        return self
+
+    def _to_loader(self, data, batch_size, shuffle):
+        if data is None:
+            return None
+        if isinstance(data, DataLoader):
+            return data
+        if isinstance(data, Dataset):
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle)
+        return data
+
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        outputs = self.network(*inputs)
+        losses = []
+        if self._loss is not None and labels is not None:
+            labels_l = labels if isinstance(labels, (list, tuple)) else [labels]
+            loss = self._loss(outputs, *labels_l)
+            loss.backward()
+            if update:
+                self._optimizer.step()
+                self._optimizer.clear_grad()
+            losses.append(float(loss.numpy()))
+        metrics = []
+        if labels is not None:
+            for m in self._metrics:
+                labels_l = labels if isinstance(labels, (list, tuple)) else [labels]
+                corr = m.compute(outputs, *labels_l)
+                metrics.append(m.update(corr))
+        return (losses, metrics) if metrics else losses
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        from ..core.dispatch import no_grad
+        with no_grad():
+            outputs = self.network(*inputs)
+            losses = []
+            if self._loss is not None and labels is not None:
+                labels_l = labels if isinstance(labels, (list, tuple)) else [labels]
+                losses.append(float(self._loss(outputs, *labels_l).numpy()))
+            metrics = []
+            for m in self._metrics:
+                labels_l = labels if isinstance(labels, (list, tuple)) else [labels]
+                corr = m.compute(outputs, *labels_l)
+                metrics.append(m.update(corr))
+        return (losses, metrics) if metrics else losses
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        from ..core.dispatch import no_grad
+        with no_grad():
+            out = self.network(*inputs)
+        return out
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        train_loader = self._to_loader(train_data, batch_size, shuffle)
+        eval_loader = self._to_loader(eval_data, batch_size, False)
+        cbks = CallbackList(callbacks or [ProgBarLogger(log_freq, verbose=verbose)])
+        cbks.set_model(self)
+        cbks.on_begin("train", {"epochs": epochs,
+                                "steps": _safe_len(train_loader),
+                                "metrics": self._metric_names()})
+        it = 0
+        for epoch in range(epochs):
+            for m in self._metrics:
+                m.reset()
+            cbks.on_epoch_begin(epoch)
+            logs = {}
+            for step, batch in enumerate(train_loader):
+                cbks.on_batch_begin("train", step, logs)
+                x, y = self._split_batch(batch)
+                res = self.train_batch(x, y)
+                logs = self._pack_logs(res)
+                cbks.on_batch_end("train", step, logs)
+                it += 1
+                if num_iters is not None and it >= num_iters:
+                    break
+            cbks.on_epoch_end(epoch, logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_loader, batch_size=batch_size, verbose=0)
+            if save_dir is not None and (epoch + 1) % save_freq == 0:
+                self.save(f"{save_dir}/epoch_{epoch}")
+            if self.stop_training or (num_iters is not None and it >= num_iters):
+                break
+        cbks.on_end("train", logs)
+        return self
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_iters=None):
+        loader = self._to_loader(eval_data, batch_size, False)
+        for m in self._metrics:
+            m.reset()
+        logs = {}
+        for step, batch in enumerate(loader):
+            x, y = self._split_batch(batch)
+            res = self.eval_batch(x, y)
+            logs = self._pack_logs(res)
+            if num_iters is not None and step + 1 >= num_iters:
+                break
+        for m in self._metrics:
+            logs[m.name()] = m.accumulate()
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                verbose=1, callbacks=None):
+        loader = self._to_loader(test_data, batch_size, False)
+        outs = []
+        for batch in loader:
+            x, _ = self._split_batch(batch, labeled=False)
+            outs.append(self.predict_batch(x))
+        return outs
+
+    def save(self, path, training=True):
+        framework.save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            framework.save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        sd = framework.load(path + ".pdparams")
+        self.network.set_state_dict(sd)
+        import os
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(framework.load(path + ".pdopt"))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        from .summary import summary as s
+        return s(self.network, input_size, dtypes=dtype)
+
+    def _split_batch(self, batch, labeled=True):
+        if isinstance(batch, (list, tuple)) and len(batch) >= 2:
+            return batch[0], batch[1] if labeled else None
+        return batch, None
+
+    def _metric_names(self):
+        return ["loss"] + [m.name() for m in self._metrics]
+
+    def _pack_logs(self, res):
+        logs = {}
+        if isinstance(res, tuple):
+            losses, metrics = res
+            if losses:
+                logs["loss"] = losses[0]
+            for m, v in zip(self._metrics, metrics):
+                logs[m.name()] = v
+        elif isinstance(res, list) and res:
+            logs["loss"] = res[0]
+        return logs
+
+
+def _safe_len(loader):
+    try:
+        return len(loader)
+    except Exception:
+        return None

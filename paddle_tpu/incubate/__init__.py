@@ -1,0 +1,7 @@
+"""paddle.incubate parity (reference: python/paddle/incubate/ — fused ops,
+MoE models, asp). The fused functional surface maps to framework ops whose
+Pallas overrides provide the fusion on TPU."""
+from . import nn
+from . import autograd
+
+__all__ = ["nn", "autograd"]

@@ -1,0 +1,83 @@
+"""incubate.autograd: functional transforms (reference:
+python/paddle/incubate/autograd/ — jvp/vjp/Jacobian/Hessian primitives).
+These expose jax's transform stack directly over Tensor pytrees."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["jvp", "vjp", "Jacobian", "Hessian", "forward_grad", "grad"]
+
+
+def _uw(tree):
+    return jax.tree_util.tree_map(
+        lambda t: t._value if isinstance(t, Tensor) else t, tree,
+        is_leaf=lambda x: isinstance(x, Tensor))
+
+
+def _w(tree):
+    return jax.tree_util.tree_map(Tensor, tree)
+
+
+def _lift(func):
+    def fn(*vals):
+        args = [Tensor(v) for v in vals]
+        out = func(*args)
+        return _uw(out)
+    return fn
+
+
+def vjp(func, xs, v=None):
+    xs = xs if isinstance(xs, (list, tuple)) else [xs]
+    out, vjp_fn = jax.vjp(_lift(func), *_uw(list(xs)))
+    if v is None:
+        v = jnp.ones_like(out)
+    else:
+        v = _uw(v)
+    grads = vjp_fn(v)
+    return _w(out), _w(list(grads))
+
+
+def jvp(func, xs, v=None):
+    xs = xs if isinstance(xs, (list, tuple)) else [xs]
+    primals = _uw(list(xs))
+    tangents = _uw(v) if v is not None else [jnp.ones_like(p) for p in primals]
+    if not isinstance(tangents, (list, tuple)):
+        tangents = [tangents]
+    out, jv = jax.jvp(_lift(func), tuple(primals), tuple(tangents))
+    return _w(out), _w(jv)
+
+
+class Jacobian:
+    def __init__(self, func, xs, is_batched=False):
+        self._xs = xs if isinstance(xs, (list, tuple)) else [xs]
+        self._J = jax.jacobian(_lift(func), argnums=tuple(range(len(self._xs))))(
+            *_uw(list(self._xs)))
+
+    def __getitem__(self, idx):
+        J = self._J[0] if isinstance(self._J, tuple) and len(self._J) == 1 else self._J
+        return Tensor(jnp.asarray(J)[idx])
+
+    @property
+    def shape(self):
+        J = self._J[0] if isinstance(self._J, tuple) and len(self._J) == 1 else self._J
+        return list(jnp.asarray(J).shape)
+
+
+class Hessian:
+    def __init__(self, func, xs, is_batched=False):
+        self._xs = xs if isinstance(xs, (list, tuple)) else [xs]
+        self._H = jax.hessian(_lift(func))(*_uw(list(self._xs)))
+
+    def __getitem__(self, idx):
+        return Tensor(jnp.asarray(self._H)[idx])
+
+
+def forward_grad(func, xs, v=None):
+    return jvp(func, xs, v)[1]
+
+
+def grad(func, xs, v=None):
+    return vjp(func, xs, v)[1]

@@ -1,0 +1,134 @@
+"""Fused functional ops (reference: python/paddle/incubate/nn/functional/ —
+fused_rms_norm, fused_rotary_position_embedding, fused_swiglu, fused_moe,
+masked_multihead_attention, variable_length_memory_efficient_attention).
+
+On TPU the "fusion" is delivered by the kernel registry: these entry points
+call the same op names the Pallas kernels override; without overrides XLA's
+fusion already merges the elementwise chains.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import op_call
+from ...core.tensor import Tensor
+from ...nn.functional.norm import rms_norm as _rms_norm
+from ...nn.functional.norm import layer_norm as _layer_norm
+from ...nn.functional.activation import swiglu as _swiglu
+
+__all__ = ["fused_rms_norm", "fused_layer_norm", "fused_rotary_position_embedding",
+           "swiglu", "fused_swiglu", "fused_linear", "fused_bias_act",
+           "fused_dropout_add", "masked_multihead_attention",
+           "variable_length_memory_efficient_attention", "fused_moe"]
+
+
+def fused_rms_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1, **kw):
+    out = _rms_norm(x, norm_weight, epsilon)
+    if norm_bias is not None:
+        out = out + norm_bias
+    return out, None
+
+
+def fused_layer_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-5, **kw):
+    shape = (x.shape[-1],)
+    return _layer_norm(x, shape, norm_weight, norm_bias, epsilon), None
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style=True,
+                                    time_major=False, rotary_emb_base=10000.0):
+    """RoPE (reference fused_rotary_position_embedding). Layout [B, S, H, D]."""
+    def rope_one(t, sin_v, cos_v):
+        def impl(x, s, c):
+            if use_neox_rotary_style:
+                half = x.shape[-1] // 2
+                x1, x2 = x[..., :half], x[..., half:]
+                rot = jnp.concatenate([-x2, x1], axis=-1)
+            else:
+                x1 = x[..., 0::2]
+                x2 = x[..., 1::2]
+                rot = jnp.stack([-x2, x1], axis=-1).reshape(x.shape)
+            return x * c + rot * s
+        return op_call("rope", impl, t, sin_v, cos_v)
+
+    if sin is None or cos is None:
+        S = q.shape[1]
+        D = q.shape[-1]
+        inv = 1.0 / (rotary_emb_base ** (jnp.arange(0, D, 2, dtype=jnp.float32) / D))
+        pos = jnp.arange(S, dtype=jnp.float32)
+        freqs = jnp.outer(pos, inv)
+        emb = jnp.concatenate([freqs, freqs], axis=-1) if use_neox_rotary_style \
+            else jnp.repeat(freqs, 2, axis=-1)
+        sin = Tensor(jnp.sin(emb)[None, :, None, :])
+        cos = Tensor(jnp.cos(emb)[None, :, None, :])
+    outs = [rope_one(t, sin, cos) if t is not None else None for t in (q, k, v)]
+    return tuple(outs)
+
+
+swiglu = _swiglu
+
+
+def fused_swiglu(x, y=None):
+    return _swiglu(x, y)
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    from ...nn.functional.common import linear
+    if transpose_weight:
+        from ...tensor.manipulation import t as transpose_t
+        weight = transpose_t(weight)
+    return linear(x, weight, bias)
+
+
+def fused_bias_act(x, bias=None, act_method="gelu", **kw):
+    from ...nn import functional as F
+    if bias is not None:
+        x = x + bias
+    return getattr(F, act_method)(x)
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train", name=None):
+    from ...nn.functional.common import dropout
+    return dropout(x, p, training=training, mode=mode) + y
+
+
+def masked_multihead_attention(x, cache_kv=None, src_mask=None, **kw):
+    raise NotImplementedError("masked_multihead_attention lands with the "
+                              "serving-decode path (KV-cache attention kernel)")
+
+
+def variable_length_memory_efficient_attention(query, key, value, seq_lens=None,
+                                               kv_seq_lens=None, mask=None,
+                                               scale=None, causal=False):
+    from ...nn.functional.attention import scaled_dot_product_attention
+    return scaled_dot_product_attention(query, key, value, attn_mask=mask,
+                                        is_causal=causal)
+
+
+def fused_moe(x, gate_weight, expert_weights1, expert_bias1, expert_weights2,
+              expert_bias2, quant_method="None", moe_topk=2, norm_topk_prob=True):
+    """Dense-compute MoE (reference incubate/nn/functional/fused_moe.py):
+    every token × every expert with a top-k mask — the XLA-friendly
+    formulation; the EP all-to-all variant lives in
+    paddle_tpu.incubate.distributed.models.moe."""
+    def impl(xv, gw, w1, b1, w2, b2):
+        B = xv.shape[:-1]
+        d = xv.shape[-1]
+        logits = xv @ gw  # [..., E]
+        E = logits.shape[-1]
+        probs = jax.nn.softmax(logits, axis=-1)
+        topv, topi = jax.lax.top_k(probs, moe_topk)
+        if norm_topk_prob:
+            topv = topv / jnp.sum(topv, -1, keepdims=True)
+        # dense: compute all experts, weight by routing mask
+        h = jnp.einsum("...d,edh->...eh", xv, w1) + b1
+        h = jax.nn.silu(h[..., : h.shape[-1] // 2]) * h[..., h.shape[-1] // 2:] \
+            if w2.shape[-2] * 2 == h.shape[-1] else jax.nn.gelu(h)
+        out_e = jnp.einsum("...eh,ehd->...ed", h, w2) + b2
+        mask = jnp.zeros(B + (E,), xv.dtype)
+        mask = jnp.sum(jax.nn.one_hot(topi, E, dtype=xv.dtype) * topv[..., None], axis=-2)
+        return jnp.einsum("...ed,...e->...d", out_e, mask)
+    return op_call("fused_moe", impl, x, gate_weight, expert_weights1,
+                   expert_bias1, expert_weights2, expert_bias2)

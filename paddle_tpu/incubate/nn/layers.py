@@ -1,0 +1,54 @@
+"""Fused transformer layers (reference: python/paddle/incubate/nn/layer/
+fused_transformer.py). On TPU these alias the standard layers — XLA + Pallas
+deliver the fusion the reference's fused CUDA kernels provide."""
+from __future__ import annotations
+
+from ...nn.transformer import MultiHeadAttention, TransformerEncoderLayer
+from ...nn.layer import Layer
+from ...nn import Linear, Dropout, LayerNorm
+from ...nn import functional as F
+
+__all__ = ["FusedMultiHeadAttention", "FusedFeedForward",
+           "FusedTransformerEncoderLayer"]
+
+
+class FusedMultiHeadAttention(MultiHeadAttention):
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5, attn_dropout_rate=0.5,
+                 kdim=None, vdim=None, normalize_before=False, need_weights=False,
+                 qkv_weight_attr=None, qkv_bias_attr=None, linear_weight_attr=None,
+                 linear_bias_attr=None, pre_ln_scale_attr=None, pre_ln_bias_attr=None,
+                 ln_scale_attr=None, ln_bias_attr=None, epsilon=1e-5,
+                 nranks=1, ring_id=-1, transpose_qkv_wb=False, name=None):
+        super().__init__(embed_dim, num_heads, attn_dropout_rate, kdim, vdim,
+                         need_weights)
+
+
+class FusedFeedForward(Layer):
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1, epsilon=1e-5,
+                 activation="relu", act_dropout_rate=None, normalize_before=False,
+                 linear1_weight_attr=None, linear1_bias_attr=None,
+                 linear2_weight_attr=None, linear2_bias_attr=None,
+                 ln1_scale_attr=None, ln1_bias_attr=None, ln2_scale_attr=None,
+                 ln2_bias_attr=None, nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self.linear1 = Linear(d_model, dim_feedforward, linear1_weight_attr,
+                              linear1_bias_attr)
+        self.linear2 = Linear(dim_feedforward, d_model, linear2_weight_attr,
+                              linear2_bias_attr)
+        self.norm = LayerNorm(d_model, epsilon=epsilon)
+        self.dropout = Dropout(dropout_rate)
+        self.act = getattr(F, activation)
+        self.normalize_before = normalize_before
+
+    def forward(self, x):
+        residual = x
+        if self.normalize_before:
+            x = self.norm(x)
+        x = residual + self.dropout(self.linear2(self.act(self.linear1(x))))
+        if not self.normalize_before:
+            x = self.norm(x)
+        return x
+
+
+class FusedTransformerEncoderLayer(TransformerEncoderLayer):
+    pass

@@ -1,0 +1,17 @@
+"""paddle.jit parity (reference: python/paddle/jit/ — to_static api.py:197,
+jit.save/load translated_layer.py, SOT bytecode capture).
+
+TPU-native design (SURVEY.md §7.4): the AST/SOT transpilers + PIR interpreter
++ CINN collapse into `jax.jit` — dygraph Tensor ops executed under a trace
+stage XLA HLO directly; the executor cache is jax's compilation cache keyed by
+abstract signature (the _ExecutorCache analog, reference base/executor.py:850).
+`jit.save` exports the traced computation as serialized StableHLO plus a
+weights archive; `jit.load` restores a callable TranslatedLayer.
+"""
+from __future__ import annotations
+
+from .api import to_static, not_to_static, ignore_module, StaticFunction
+from .save_load import save, load, TranslatedLayer
+
+__all__ = ["to_static", "not_to_static", "save", "load", "TranslatedLayer",
+           "StaticFunction", "ignore_module"]

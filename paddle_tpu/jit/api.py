@@ -1,0 +1,132 @@
+"""to_static: compile a dygraph function/Layer with jax.jit.
+
+Reference: python/paddle/jit/api.py:197 (to_static), dy2static
+program_translator.py. Here "program capture" is jax tracing: the wrapped
+callable runs once per new input signature; Tensor pytree flattening threads
+values in/out; Layer parameters and buffers are lifted to explicit jit inputs
+via functional_state so weight updates don't trigger recompilation and buffer
+mutations (BN stats) round-trip. RNG inside the trace is keyed by an explicit
+key drawn per call (deterministic under paddle.seed).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core import random as random_mod
+from ..nn.layer import Layer, functional_state
+
+__all__ = ["to_static", "not_to_static", "StaticFunction", "ignore_module"]
+
+
+def _find_layer(fn):
+    self_obj = getattr(fn, "__self__", None)
+    if isinstance(self_obj, Layer):
+        return self_obj
+    if isinstance(fn, Layer):
+        return fn
+    return None
+
+
+class StaticFunction:
+    """Compiled callable with a per-signature cache (the _ExecutorCache /
+    guard-cache analog)."""
+
+    def __init__(self, function: Callable, layer: Optional[Layer] = None,
+                 input_spec=None, build_strategy=None, backend=None,
+                 full_graph=True, donate_buffers=False):
+        self._raw_fn = function
+        self._layer = layer if layer is not None else _find_layer(function)
+        self._input_spec = input_spec
+        self._donate = donate_buffers
+        self._jitted = jax.jit(self._traced_call)
+        functools.update_wrapper(self, function if not isinstance(function, Layer)
+                                 else function.forward)
+
+    # pure function of (state, rng, args, kwargs)
+    def _traced_call(self, state, rng, args, kwargs):
+        with random_mod.trace_rng(rng):
+            if self._layer is not None:
+                with functional_state(self._layer, state) as fs:
+                    out = self._call_raw(*args, **kwargs)
+                    new_state = fs.collect()
+            else:
+                out = self._call_raw(*args, **kwargs)
+                new_state = {}
+        return out, new_state
+
+    def _call_raw(self, *args, **kwargs):
+        if isinstance(self._raw_fn, Layer):
+            return self._raw_fn.forward(*args, **kwargs)
+        return self._raw_fn(*args, **kwargs)
+
+    def __call__(self, *args, **kwargs):
+        state = {}
+        if self._layer is not None:
+            state = {name: p._value for name, p in self._layer.named_parameters()}
+            state.update({name: b._value for name, b in self._layer.named_buffers()})
+        rng = random_mod.split_key()
+        out, new_state = self._jitted(state, rng, args, kwargs)
+        if self._layer is not None and new_state:
+            # only buffers actually mutate during forward (BN running stats)
+            buffer_map = dict(self._layer.named_buffers())
+            for name, v in new_state.items():
+                t = buffer_map.get(name)
+                if t is not None and t._value is not v:
+                    t._set_value(v)
+        return out
+
+    # -- introspection parity ---------------------------------------------
+    @property
+    def code(self):
+        import inspect
+        try:
+            return inspect.getsource(self._raw_fn if not isinstance(self._raw_fn, Layer)
+                                     else self._raw_fn.forward)
+        except Exception:
+            return "<source unavailable>"
+
+    def concrete_program_specify_input_spec(self, input_spec=None):
+        return self
+
+    def get_concrete_program(self, *args, **kwargs):
+        return self, None
+
+    @property
+    def forward(self):
+        return self
+
+
+def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
+              full_graph=True, **kwargs):
+    """paddle.jit.to_static parity. Under the TPU design full_graph=True and
+    False converge: jax tracing handles arbitrary python control flow by
+    unrolling (AST-transpiler analog); data-dependent branching should use
+    paddle_tpu.static.nn.cond / while_loop (lax control flow)."""
+    def deco(fn):
+        if isinstance(fn, Layer):
+            # capture the ORIGINAL forward before rebinding (else sf recurses)
+            orig_forward = fn.forward
+            sf = StaticFunction(orig_forward, layer=fn, input_spec=input_spec,
+                                full_graph=full_graph)
+            fn.forward = sf
+            return fn
+        return StaticFunction(fn, input_spec=input_spec, full_graph=full_graph)
+    if function is not None:
+        return deco(function)
+    return deco
+
+
+def not_to_static(fn=None):
+    if fn is None:
+        return lambda f: f
+    fn._not_to_static = True
+    return fn
+
+
+def ignore_module(modules):
+    pass

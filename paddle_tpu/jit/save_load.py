@@ -1,0 +1,97 @@
+"""jit.save / jit.load (reference: python/paddle/jit/api.py save :980,
+translated_layer.py TranslatedLayer; C++ deploy runtime paddle/fluid/jit/).
+
+Artifact format: `<path>.pdmodel.stablehlo` — serialized jax.export artifact
+(StableHLO bytes, the inference-model analog) + `<path>.pdiparams` — pickled
+state dict. TranslatedLayer reloads both and is callable like a Layer (the
+jit::Layer / PredictorEngine analog, AOT-compiled by XLA on first call).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer import Layer, functional_state
+from .. import framework
+
+__all__ = ["save", "load", "TranslatedLayer"]
+
+
+def _spec_to_sds(spec):
+    from ..static.input_spec import InputSpec
+    if isinstance(spec, InputSpec):
+        shape = tuple(1 if (s is None or s == -1) else int(s) for s in spec.shape)
+        return jax.ShapeDtypeStruct(shape, spec.dtype or jnp.float32)
+    if isinstance(spec, Tensor):
+        return jax.ShapeDtypeStruct(tuple(spec.shape), spec._value.dtype)
+    if hasattr(spec, "shape"):
+        return jax.ShapeDtypeStruct(tuple(spec.shape), getattr(spec, "dtype", jnp.float32))
+    raise TypeError(f"cannot build input spec from {spec!r}")
+
+
+def save(layer, path, input_spec=None, **configs):
+    """Export layer as StableHLO + weights."""
+    if not isinstance(layer, Layer):
+        raise TypeError("jit.save expects a Layer")
+    layer.eval()
+    state = {name: p._value for name, p in layer.named_parameters()}
+    state.update({name: b._value for name, b in layer.named_buffers()})
+
+    def pure_fn(state, *args):
+        with functional_state(layer, state):
+            out = layer.forward(*[Tensor(a) for a in args])
+        return jax.tree_util.tree_map(
+            lambda t: t._value if isinstance(t, Tensor) else t, out,
+            is_leaf=lambda x: isinstance(x, Tensor))
+
+    if input_spec is None:
+        raise ValueError("jit.save requires input_spec on the TPU backend "
+                         "(static shapes are part of the exported artifact)")
+    sds = [_spec_to_sds(s) for s in input_spec]
+    state_sds = jax.tree_util.tree_map(lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype), state)
+    exported = jax.export.export(jax.jit(pure_fn))(state_sds, *sds)
+    blob = exported.serialize()
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path + ".pdmodel.stablehlo", "wb") as f:
+        f.write(blob)
+    framework.save({k: np.asarray(v) for k, v in state.items()}, path + ".pdiparams")
+    with open(path + ".pdmodel.meta", "wb") as f:
+        pickle.dump({"n_inputs": len(sds)}, f)
+
+
+class TranslatedLayer(Layer):
+    """Reloaded exported model (reference translated_layer.py:?) — callable,
+    eval-only (training=False semantics like the reference's inference
+    programs)."""
+
+    def __init__(self, exported, state, meta):
+        super().__init__()
+        self._exported = exported
+        self._state = state
+        self._meta = meta
+        self.eval()
+
+    def forward(self, *args):
+        vals = [a._value if isinstance(a, Tensor) else jnp.asarray(a) for a in args]
+        out = self._exported.call(self._state, *vals)
+        return jax.tree_util.tree_map(Tensor, out)
+
+
+def load(path, **configs):
+    with open(path + ".pdmodel.stablehlo", "rb") as f:
+        exported = jax.export.deserialize(f.read())
+    state = framework.load(path + ".pdiparams", return_numpy=True)
+    state = {k: jnp.asarray(v) for k, v in state.items()}
+    try:
+        with open(path + ".pdmodel.meta", "rb") as f:
+            meta = pickle.load(f)
+    except FileNotFoundError:
+        meta = {}
+    return TranslatedLayer(exported, state, meta)

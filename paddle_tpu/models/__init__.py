@@ -1,0 +1,3 @@
+"""Model zoo (the PaddleNLP/PaddleMIX-config analog for the benchmark set)."""
+from . import llama  # noqa: F401
+from .llama import LlamaConfig, LlamaForCausalLM, build_functional_llama  # noqa: F401

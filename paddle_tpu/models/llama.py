@@ -1,0 +1,316 @@
+"""LLaMA model family (BASELINE.json config #4: LLaMA-2 7B/13B TP+PP).
+
+Two forms:
+ * `LlamaForCausalLM` — eager Layer (dygraph parity; PaddleNLP-style config),
+   using the framework attention dispatch (Pallas flash-attn override) and
+   optional fleet TP layers when mp_degree > 1.
+ * `build_functional_llama` — pure param-pytree + apply fns matching
+   paddle_tpu.parallel.PipelineTrainStep's (embed, block, head) contract,
+   used by the hybrid dp×pp×mp compiled train step, bench.py, and
+   __graft_entry__.dryrun_multichip.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, Parameter
+from ..nn.layer import Layer
+from ..nn import Linear, Embedding, RMSNorm, LayerList
+from ..nn import functional as F
+from ..tensor import manipulation as manip
+from ..incubate.nn.functional import fused_rotary_position_embedding
+
+__all__ = ["LlamaConfig", "LlamaForCausalLM", "LlamaModel", "LlamaDecoderLayer",
+           "build_functional_llama", "llama_config_7b", "llama_config_tiny"]
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+    use_flash_attention: bool = True
+    tensor_parallel_degree: int = 1
+    dtype: str = "float32"
+
+
+def llama_config_7b():
+    return LlamaConfig()
+
+
+def llama_config_tiny(vocab=1024, hidden=128, layers=2, heads=4, seq=128):
+    return LlamaConfig(vocab_size=vocab, hidden_size=hidden,
+                      intermediate_size=hidden * 3, num_hidden_layers=layers,
+                      num_attention_heads=heads, num_key_value_heads=heads,
+                      max_position_embeddings=seq)
+
+
+def _rope_tables(seq_len, head_dim, theta, dtype=jnp.float32):
+    inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    pos = jnp.arange(seq_len, dtype=jnp.float32)
+    freqs = jnp.outer(pos, inv)
+    emb = jnp.concatenate([freqs, freqs], axis=-1)
+    return jnp.sin(emb).astype(dtype), jnp.cos(emb).astype(dtype)
+
+
+def _apply_rope(x, sin, cos):
+    # x: [B, S, H, D]; sin/cos: [S, D]
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    rot = jnp.concatenate([-x2, x1], axis=-1)
+    return x * cos[None, :, None, :] + rot * sin[None, :, None, :]
+
+
+class LlamaAttention(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        c = config
+        self.num_heads = c.num_attention_heads
+        self.num_kv = c.num_key_value_heads
+        self.head_dim = c.hidden_size // c.num_attention_heads
+        self.config = c
+        tp = c.tensor_parallel_degree
+        if tp > 1:
+            from ..distributed.fleet.meta_parallel import (ColumnParallelLinear,
+                                                           RowParallelLinear)
+            self.q_proj = ColumnParallelLinear(c.hidden_size,
+                                               self.num_heads * self.head_dim,
+                                               has_bias=False, gather_output=False)
+            self.k_proj = ColumnParallelLinear(c.hidden_size,
+                                               self.num_kv * self.head_dim,
+                                               has_bias=False, gather_output=False)
+            self.v_proj = ColumnParallelLinear(c.hidden_size,
+                                               self.num_kv * self.head_dim,
+                                               has_bias=False, gather_output=False)
+            self.o_proj = RowParallelLinear(self.num_heads * self.head_dim,
+                                            c.hidden_size, has_bias=False,
+                                            input_is_parallel=True)
+        else:
+            self.q_proj = Linear(c.hidden_size, self.num_heads * self.head_dim,
+                                 bias_attr=False)
+            self.k_proj = Linear(c.hidden_size, self.num_kv * self.head_dim,
+                                 bias_attr=False)
+            self.v_proj = Linear(c.hidden_size, self.num_kv * self.head_dim,
+                                 bias_attr=False)
+            self.o_proj = Linear(self.num_heads * self.head_dim, c.hidden_size,
+                                 bias_attr=False)
+
+    def forward(self, x, sin=None, cos=None):
+        b, s, _ = x.shape
+        q = manip.reshape(self.q_proj(x), [b, s, -1, self.head_dim])
+        k = manip.reshape(self.k_proj(x), [b, s, -1, self.head_dim])
+        v = manip.reshape(self.v_proj(x), [b, s, -1, self.head_dim])
+        if sin is not None:
+            from ..core.dispatch import op_call
+            q = op_call("rope", lambda qq: _apply_rope(qq, sin, cos), q)
+            k = op_call("rope", lambda kk: _apply_rope(kk, sin, cos), k)
+        n_rep = self.num_heads // self.num_kv
+        if n_rep > 1:
+            k = manip.repeat_interleave(k, n_rep, axis=2)
+            v = manip.repeat_interleave(v, n_rep, axis=2)
+        out = F.scaled_dot_product_attention(q, k, v, is_causal=True,
+                                             training=self.training)
+        out = manip.reshape(out, [b, s, -1])
+        return self.o_proj(out)
+
+
+class LlamaMLP(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        c = config
+        tp = c.tensor_parallel_degree
+        if tp > 1:
+            from ..distributed.fleet.meta_parallel import (ColumnParallelLinear,
+                                                           RowParallelLinear)
+            self.gate_proj = ColumnParallelLinear(c.hidden_size, c.intermediate_size,
+                                                  has_bias=False, gather_output=False)
+            self.up_proj = ColumnParallelLinear(c.hidden_size, c.intermediate_size,
+                                                has_bias=False, gather_output=False)
+            self.down_proj = RowParallelLinear(c.intermediate_size, c.hidden_size,
+                                               has_bias=False, input_is_parallel=True)
+        else:
+            self.gate_proj = Linear(c.hidden_size, c.intermediate_size, bias_attr=False)
+            self.up_proj = Linear(c.hidden_size, c.intermediate_size, bias_attr=False)
+            self.down_proj = Linear(c.intermediate_size, c.hidden_size, bias_attr=False)
+
+    def forward(self, x):
+        return self.down_proj(F.swiglu(self.gate_proj(x), self.up_proj(x)))
+
+
+class LlamaDecoderLayer(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.input_layernorm = RMSNorm(config.hidden_size, config.rms_norm_eps)
+        self.self_attn = LlamaAttention(config)
+        self.post_attention_layernorm = RMSNorm(config.hidden_size, config.rms_norm_eps)
+        self.mlp = LlamaMLP(config)
+
+    def forward(self, x, sin=None, cos=None):
+        x = x + self.self_attn(self.input_layernorm(x), sin, cos)
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        return x
+
+
+class LlamaModel(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        if config.tensor_parallel_degree > 1:
+            from ..distributed.fleet.meta_parallel import VocabParallelEmbedding
+            self.embed_tokens = VocabParallelEmbedding(config.vocab_size,
+                                                       config.hidden_size)
+        else:
+            self.embed_tokens = Embedding(config.vocab_size, config.hidden_size)
+        self.layers = LayerList([LlamaDecoderLayer(config)
+                                 for _ in range(config.num_hidden_layers)])
+        self.norm = RMSNorm(config.hidden_size, config.rms_norm_eps)
+        head_dim = config.hidden_size // config.num_attention_heads
+        sin, cos = _rope_tables(config.max_position_embeddings, head_dim,
+                                config.rope_theta)
+        self._sin, self._cos = sin, cos
+
+    def forward(self, input_ids):
+        x = self.embed_tokens(input_ids)
+        s = x.shape[1]
+        sin, cos = self._sin[:s], self._cos[:s]
+        for layer in self.layers:
+            x = layer(x, sin, cos)
+        return self.norm(x)
+
+
+class LlamaForCausalLM(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.llama = self.model = LlamaModel(config)
+        self.lm_head = Linear(config.hidden_size, config.vocab_size, bias_attr=False)
+        if config.tie_word_embeddings:
+            self.lm_head.weight = self.model.embed_tokens.weight
+
+    def forward(self, input_ids, labels=None):
+        h = self.model(input_ids)
+        logits = self.lm_head(h)
+        if labels is not None:
+            loss = F.cross_entropy(
+                manip.reshape(logits, [-1, self.config.vocab_size]),
+                manip.reshape(labels, [-1]))
+            return loss, logits
+        return logits
+
+
+# ---------------------------------------------------------------------------
+# Functional form (pipeline/bench path)
+# ---------------------------------------------------------------------------
+def build_functional_llama(config: LlamaConfig, key=None, dtype=None,
+                           n_micro: int = 1, mp_axis: str = None):
+    """Returns (embed_params, block_params_stacked, head_params,
+    embed_apply, block_apply, head_loss_apply).
+
+    block_params leaves have leading dim num_hidden_layers (stackable over
+    'pp'). batch = (input_ids[B,S], labels[B,S]); embed_apply splits B into
+    n_micro microbatches. When mp_axis is set, matmul outputs get sharding
+    constraints over that axis (GSPMD tensor parallelism).
+    """
+    c = config
+    d = jnp.dtype(dtype) if dtype is not None else jnp.float32
+    key = key if key is not None else jax.random.PRNGKey(0)
+    head_dim = c.hidden_size // c.num_attention_heads
+    ks = jax.random.split(key, 16)
+
+    def init(k, shape, scale=None):
+        scale = scale if scale is not None else 1.0 / math.sqrt(shape[0])
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(d)
+
+    L = c.num_hidden_layers
+    kv_dim = c.num_key_value_heads * head_dim
+    embed_params = {"tok": init(ks[0], (c.vocab_size, c.hidden_size), 0.02)}
+    block_params = {
+        "ln1": jnp.ones((L, c.hidden_size), d),
+        "wq": jnp.stack([init(jax.random.fold_in(ks[1], i),
+                              (c.hidden_size, c.hidden_size)) for i in range(L)]),
+        "wk": jnp.stack([init(jax.random.fold_in(ks[2], i),
+                              (c.hidden_size, kv_dim)) for i in range(L)]),
+        "wv": jnp.stack([init(jax.random.fold_in(ks[3], i),
+                              (c.hidden_size, kv_dim)) for i in range(L)]),
+        "wo": jnp.stack([init(jax.random.fold_in(ks[4], i),
+                              (c.hidden_size, c.hidden_size)) for i in range(L)]),
+        "ln2": jnp.ones((L, c.hidden_size), d),
+        "wgate": jnp.stack([init(jax.random.fold_in(ks[5], i),
+                                 (c.hidden_size, c.intermediate_size)) for i in range(L)]),
+        "wup": jnp.stack([init(jax.random.fold_in(ks[6], i),
+                               (c.hidden_size, c.intermediate_size)) for i in range(L)]),
+        "wdown": jnp.stack([init(jax.random.fold_in(ks[7], i),
+                                 (c.intermediate_size, c.hidden_size)) for i in range(L)]),
+    }
+    head_params = {"ln_f": jnp.ones((c.hidden_size,), d),
+                   "lm": init(ks[8], (c.hidden_size, c.vocab_size), 0.02)}
+
+    sin_t, cos_t = _rope_tables(c.max_position_embeddings, head_dim, c.rope_theta, d)
+
+    def rms(x, w, eps=c.rms_norm_eps):
+        ms = jnp.mean(jnp.square(x.astype(jnp.float32)), -1, keepdims=True)
+        return (x * jax.lax.rsqrt(ms + eps)).astype(x.dtype) * w
+
+    def embed_apply(p, batch):
+        ids, labels = batch
+        # [B, S] -> [n_micro, mbs, S, H]
+        x = p["tok"][ids]
+        B = x.shape[0]
+        mbs = B // n_micro
+        return x.reshape((n_micro, mbs) + x.shape[1:])
+
+    def block_apply(lp, x):
+        # x: [mbs, S, H] (one microbatch)
+        B, S, H = x.shape
+        nh, nkv = c.num_attention_heads, c.num_key_value_heads
+        h = rms(x, lp["ln1"])
+        q = (h @ lp["wq"]).reshape(B, S, nh, head_dim)
+        k = (h @ lp["wk"]).reshape(B, S, nkv, head_dim)
+        v = (h @ lp["wv"]).reshape(B, S, nkv, head_dim)
+        sin, cos = sin_t[:S], cos_t[:S]
+        q = _apply_rope(q, sin, cos)
+        k = _apply_rope(k, sin, cos)
+        if nh != nkv:
+            rep = nh // nkv
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        from ..core.dispatch import get_kernel
+        attn_impl = get_kernel("flash_attention_causal")
+        if attn_impl is not None:
+            o = attn_impl(q, k, v)
+        else:
+            logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(head_dim)
+            mask = jnp.tril(jnp.ones((S, S), bool))
+            logits = jnp.where(mask, logits.astype(jnp.float32), -jnp.inf)
+            w = jax.nn.softmax(logits, -1).astype(x.dtype)
+            o = jnp.einsum("bhqk,bkhd->bqhd", w, v)
+        o = o.reshape(B, S, H) @ lp["wo"]
+        x = x + o
+        h = rms(x, lp["ln2"])
+        ff = jax.nn.silu(h @ lp["wgate"]) * (h @ lp["wup"])
+        return x + ff @ lp["wdown"]
+
+    def head_loss_apply(p, y, batch):
+        # y: [n_micro, mbs, S, H]
+        ids, labels = batch
+        B = labels.shape[0]
+        mbs = B // n_micro
+        lab = labels.reshape(n_micro, mbs, -1)
+        h = rms(y, p["ln_f"])
+        logits = h @ p["lm"]
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+        nll = -jnp.take_along_axis(logp, lab[..., None].astype(jnp.int32), -1)
+        return jnp.mean(nll)
+
+    return embed_params, block_params, head_params, embed_apply, block_apply, head_loss_apply

@@ -1,0 +1,70 @@
+"""Activation layers (reference: python/paddle/nn/layer/activation.py)."""
+from __future__ import annotations
+
+from .layer import Layer
+from . import functional as F
+from .initializer import Constant
+
+__all__ = ["ReLU", "ReLU6", "GELU", "Sigmoid", "Silu", "Swish", "Mish",
+           "Softplus", "Softsign", "Hardshrink", "Softshrink", "Tanhshrink",
+           "Hardsigmoid", "Hardswish", "Hardtanh", "ELU", "CELU", "SELU",
+           "LeakyReLU", "PReLU", "RReLU", "GLU", "Softmax", "LogSoftmax",
+           "Maxout", "Tanh", "LogSigmoid", "ThresholdedReLU"]
+
+
+def _mk(name, fn, **defaults):
+    class _Act(Layer):
+        def __init__(self, *args, **kw):
+            super().__init__()
+            merged = dict(defaults)
+            names = list(defaults)
+            for i, a in enumerate(args):
+                merged[names[i]] = a
+            merged.update({k: v for k, v in kw.items() if k in merged})
+            self._kw = merged
+
+        def forward(self, x):
+            return fn(x, **self._kw)
+    _Act.__name__ = name
+    return _Act
+
+
+ReLU = _mk("ReLU", F.relu)
+ReLU6 = _mk("ReLU6", F.relu6)
+GELU = _mk("GELU", F.gelu, approximate=False)
+Sigmoid = _mk("Sigmoid", F.sigmoid)
+Silu = _mk("Silu", F.silu)
+Swish = _mk("Swish", F.swish)
+Mish = _mk("Mish", F.mish)
+Softplus = _mk("Softplus", F.softplus, beta=1.0, threshold=20.0)
+Softsign = _mk("Softsign", F.softsign)
+Hardshrink = _mk("Hardshrink", F.hardshrink, threshold=0.5)
+Softshrink = _mk("Softshrink", F.softshrink, threshold=0.5)
+Tanhshrink = _mk("Tanhshrink", F.tanhshrink)
+Hardsigmoid = _mk("Hardsigmoid", F.hardsigmoid)
+Hardswish = _mk("Hardswish", F.hardswish)
+Hardtanh = _mk("Hardtanh", F.hardtanh, min=-1.0, max=1.0)
+ELU = _mk("ELU", F.elu, alpha=1.0)
+CELU = _mk("CELU", F.celu, alpha=1.0)
+SELU = _mk("SELU", F.selu)
+LeakyReLU = _mk("LeakyReLU", F.leaky_relu, negative_slope=0.01)
+RReLU = _mk("RReLU", F.rrelu, lower=0.125, upper=1.0 / 3.0)
+GLU = _mk("GLU", F.glu, axis=-1)
+Softmax = _mk("Softmax", F.softmax, axis=-1)
+LogSoftmax = _mk("LogSoftmax", F.log_softmax, axis=-1)
+Maxout = _mk("Maxout", F.maxout, groups=2, axis=1)
+Tanh = _mk("Tanh", F.tanh)
+LogSigmoid = _mk("LogSigmoid", F.log_sigmoid)
+ThresholdedReLU = _mk("ThresholdedReLU", F.thresholded_relu, threshold=1.0, value=0.0)
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self._data_format = data_format
+        self.weight = self.create_parameter((num_parameters,), attr=weight_attr,
+                                            default_initializer=Constant(init))
+
+    def forward(self, x):
+        return F.prelu(x, self.weight, self._data_format)

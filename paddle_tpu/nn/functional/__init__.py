@@ -1,0 +1,12 @@
+"""paddle.nn.functional parity namespace."""
+from __future__ import annotations
+
+from .activation import *  # noqa: F401,F403
+from .common import *  # noqa: F401,F403
+from .conv import *  # noqa: F401,F403
+from .norm import *  # noqa: F401,F403
+from .pooling import *  # noqa: F401,F403
+from .loss import *  # noqa: F401,F403
+from .attention import *  # noqa: F401,F403
+
+from . import flash_attention  # noqa: F401

@@ -1,0 +1,213 @@
+"""Activation functionals (reference: python/paddle/nn/functional/activation.py).
+
+All map onto jax.nn / jnp primitives; XLA fuses them into adjacent matmuls
+(HBM-bandwidth win on TPU).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import op_call
+from ...core.tensor import Tensor
+
+__all__ = ["relu", "relu_", "relu6", "gelu", "sigmoid", "silu", "swish", "mish",
+           "softplus", "softsign", "hardshrink", "softshrink", "tanhshrink",
+           "hardsigmoid", "hardswish", "hardtanh", "elu", "elu_", "celu", "selu",
+           "leaky_relu", "prelu", "rrelu", "glu", "softmax", "softmax_",
+           "log_softmax", "gumbel_softmax", "maxout", "tanh", "tanh_",
+           "log_sigmoid", "thresholded_relu", "swiglu"]
+
+
+def relu(x, name=None):
+    return op_call("relu", jax.nn.relu, x)
+
+
+def relu_(x, name=None):
+    return x._set_value(jax.nn.relu(x._value))
+
+
+def relu6(x, name=None):
+    return op_call("relu6", jax.nn.relu6, x)
+
+
+def gelu(x, approximate=False, name=None):
+    return op_call("gelu", lambda v: jax.nn.gelu(v, approximate=approximate), x)
+
+
+def sigmoid(x, name=None):
+    return op_call("sigmoid", jax.nn.sigmoid, x)
+
+
+def silu(x, name=None):
+    return op_call("silu", jax.nn.silu, x)
+
+
+def swish(x, name=None):
+    return op_call("swish", jax.nn.silu, x)
+
+
+def mish(x, name=None):
+    return op_call("mish", lambda v: v * jnp.tanh(jax.nn.softplus(v)), x)
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return op_call("softplus",
+                   lambda v: jnp.where(v * beta > threshold, v,
+                                       jnp.log1p(jnp.exp(-jnp.abs(beta * v))) / beta
+                                       + jnp.maximum(v, 0)), x)
+
+
+def softsign(x, name=None):
+    return op_call("softsign", jax.nn.soft_sign, x)
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return op_call("hardshrink",
+                   lambda v: jnp.where(jnp.abs(v) > threshold, v, 0.0).astype(v.dtype), x)
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return op_call("softshrink",
+                   lambda v: jnp.where(v > threshold, v - threshold,
+                                       jnp.where(v < -threshold, v + threshold, 0.0)).astype(v.dtype), x)
+
+
+def tanhshrink(x, name=None):
+    return op_call("tanhshrink", lambda v: v - jnp.tanh(v), x)
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return op_call("hardsigmoid",
+                   lambda v: jnp.clip(v * slope + offset, 0.0, 1.0), x)
+
+
+def hardswish(x, name=None):
+    return op_call("hardswish", lambda v: v * jnp.clip(v + 3.0, 0.0, 6.0) / 6.0, x)
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return op_call("hardtanh", lambda v: jnp.clip(v, min, max), x)
+
+
+def elu(x, alpha=1.0, name=None):
+    return op_call("elu", lambda v: jax.nn.elu(v, alpha=alpha), x)
+
+
+def elu_(x, alpha=1.0, name=None):
+    return x._set_value(jax.nn.elu(x._value, alpha=alpha))
+
+
+def celu(x, alpha=1.0, name=None):
+    return op_call("celu", lambda v: jax.nn.celu(v, alpha=alpha), x)
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return op_call("selu", lambda v: scale * jnp.where(v > 0, v, alpha * jnp.expm1(v)), x)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return op_call("leaky_relu", lambda v: jax.nn.leaky_relu(v, negative_slope), x)
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def impl(v, w):
+        if w.size == 1:
+            ww = w.reshape(())
+        else:
+            # per-channel: broadcast along channel dim
+            ch_dim = 1 if data_format == "NCHW" else v.ndim - 1
+            shape = [1] * v.ndim
+            shape[ch_dim] = w.size
+            ww = w.reshape(shape)
+        return jnp.where(v > 0, v, ww * v)
+    return op_call("prelu", impl, x, weight)
+
+
+def rrelu(x, lower=0.125, upper=0.3333333333333333, training=False, name=None):
+    from ...core.random import split_key
+    def impl(v):
+        if training:
+            a = jax.random.uniform(split_key(), v.shape, jnp.float32, lower, upper).astype(v.dtype)
+        else:
+            a = jnp.asarray((lower + upper) / 2.0, v.dtype)
+        return jnp.where(v >= 0, v, a * v)
+    return op_call("rrelu", impl, x)
+
+
+def glu(x, axis=-1, name=None):
+    return op_call("glu", lambda v: jax.nn.glu(v, axis=axis), x)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    from ...core.dtype import convert_dtype
+    d = convert_dtype(dtype)
+    def impl(v):
+        vv = v.astype(d) if d is not None else v
+        return jax.nn.softmax(vv, axis=axis)
+    return op_call("softmax", impl, x)
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    return x._set_value(softmax(x.detach(), axis, dtype)._value)
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    from ...core.dtype import convert_dtype
+    d = convert_dtype(dtype)
+    def impl(v):
+        vv = v.astype(d) if d is not None else v
+        return jax.nn.log_softmax(vv, axis=axis)
+    return op_call("log_softmax", impl, x)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...core.random import split_key
+    def impl(v):
+        g = -jnp.log(-jnp.log(jax.random.uniform(split_key(), v.shape, jnp.float32,
+                                                 1e-20, 1.0))).astype(v.dtype)
+        y = jax.nn.softmax((v + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            onehot = (jnp.arange(y.shape[axis]).reshape(
+                [-1 if i == axis % y.ndim else 1 for i in range(y.ndim)]) == idx).astype(y.dtype)
+            y = onehot + y - jax.lax.stop_gradient(y)
+        return y
+    return op_call("gumbel_softmax", impl, x)
+
+
+def maxout(x, groups, axis=1, name=None):
+    def impl(v):
+        ax = axis % v.ndim
+        c = v.shape[ax]
+        new_shape = v.shape[:ax] + (c // groups, groups) + v.shape[ax + 1:]
+        return jnp.max(v.reshape(new_shape), axis=ax + 1)
+    return op_call("maxout", impl, x)
+
+
+def tanh(x, name=None):
+    return op_call("tanh", jnp.tanh, x)
+
+
+def tanh_(x, name=None):
+    return x._set_value(jnp.tanh(x._value))
+
+
+def log_sigmoid(x, name=None):
+    return op_call("log_sigmoid", jax.nn.log_sigmoid, x)
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return op_call("thresholded_relu",
+                   lambda v: jnp.where(v > threshold, v, value).astype(v.dtype), x)
+
+
+def swiglu(x, y=None, name=None):
+    """SwiGLU fused activation (reference incubate fused_swiglu): silu(x) * y;
+    when y is None, x is split in half along the last axis."""
+    if y is None:
+        def impl(v):
+            a, b = jnp.split(v, 2, axis=-1)
+            return jax.nn.silu(a) * b
+        return op_call("swiglu", impl, x)
+    return op_call("swiglu", lambda a, b: jax.nn.silu(a) * b, x, y)

@@ -1,0 +1,116 @@
+"""Attention functionals.
+
+Reference: python/paddle/nn/functional/flash_attention.py (flash_attention
+:358, scaled_dot_product_attention :756, flash_attn_unpadded) backed by the
+CUDA FA2 kernel (paddle/phi/kernels/gpu/flash_attn_kernel.cu). Here the
+default impl is the fused-softmax jnp path (XLA already fuses it well) and the
+Pallas flash-attention kernel registers an override under op name
+'flash_attention' (paddle_tpu/ops/pallas/flash_attention.py).
+
+Layout convention matches the reference: [batch, seqlen, num_heads, head_dim].
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import op_call, get_kernel
+from ...core.tensor import Tensor
+from ...core.random import split_key
+
+__all__ = ["scaled_dot_product_attention", "flash_attention",
+           "flash_attn_unpadded", "sdp_kernel"]
+
+
+def _sdpa_ref(q, k, v, mask=None, dropout=0.0, causal=False, scale=None,
+              dropout_key=None):
+    """Reference math: q,k,v [B, S, H, D] -> [B, S, H, D]."""
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    # [B, H, Sq, Sk]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * s
+    logits = logits.astype(jnp.float32)
+    if causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        cmask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        logits = jnp.where(cmask, logits, -jnp.inf)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(mask, logits, -jnp.inf)
+        else:
+            logits = logits + mask.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    if dropout > 0.0 and dropout_key is not None:
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout), 0.0).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False, training=True,
+                                 name=None):
+    """reference flash_attention.py:756 — dispatches to flash when available."""
+    dk = split_key() if (dropout_p > 0.0 and training) else None
+    def impl(q, k, v, *rest):
+        m = rest[0] if rest else None
+        return _sdpa_ref(q, k, v, mask=m, dropout=dropout_p if training else 0.0,
+                         causal=is_causal, dropout_key=dk)
+    args = [query, key, value] if attn_mask is None else [query, key, value, attn_mask]
+    return op_call("flash_attention", impl, *args)
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None, rng_name="",
+                    training=True, name=None):
+    """reference flash_attention.py:358. Returns (out, softmax_lse-like None)."""
+    dk = split_key() if (dropout > 0.0 and training) else None
+    def impl(q, k, v):
+        return _sdpa_ref(q, k, v, dropout=dropout if training else 0.0,
+                         causal=causal, dropout_key=dk)
+    out = op_call("flash_attention_causal" if causal else "flash_attention",
+                  impl, query, key, value)
+    return out, None
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q, max_seqlen_k, scale=None, dropout=0.0,
+                        causal=False, return_softmax=False,
+                        fixed_seed_offset=None, rng_name="", training=True,
+                        name=None):
+    """Varlen attention over packed sequences (reference flash_attn_unpadded):
+    [total_tokens, H, D] + cumulative seqlen boundaries. Implemented by
+    building a block-diagonal mask (segment ids) — XLA-friendly static shape."""
+    cu_q = cu_seqlens_q._value if isinstance(cu_seqlens_q, Tensor) else cu_seqlens_q
+    cu_k = cu_seqlens_k._value if isinstance(cu_seqlens_k, Tensor) else cu_seqlens_k
+    def impl(q, k, v):
+        tq = q.shape[0]
+        tk = k.shape[0]
+        seg_q = jnp.cumsum(jnp.zeros(tq, jnp.int32).at[cu_q[1:-1]].add(1))
+        seg_k = jnp.cumsum(jnp.zeros(tk, jnp.int32).at[cu_k[1:-1]].add(1))
+        mask = seg_q[:, None] == seg_k[None, :]
+        if causal:
+            pos_q = jnp.arange(tq) - cu_q[seg_q]
+            pos_k = jnp.arange(tk) - cu_k[seg_k]
+            mask = mask & (pos_q[:, None] >= pos_k[None, :])
+        out = _sdpa_ref(q[None], k[None], v[None], mask=mask[None, None],
+                        dropout=dropout if training else 0.0, causal=False,
+                        scale=scale)
+        return out[0]
+    out = op_call("flash_attn_unpadded", impl, query, key, value)
+    return out, None
+
+
+class sdp_kernel:
+    """Context manager parity for torch-style backend selection; on TPU the
+    kernel registry decides (pallas vs xla)."""
+
+    def __init__(self, enable_flash=True, enable_math=True, enable_mem_efficient=True):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False

@@ -1,0 +1,355 @@
+"""Common functionals: linear, dropout, embedding, one_hot, interpolate, ...
+(reference: python/paddle/nn/functional/common.py, input.py)
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import op_call
+from ...core.tensor import Tensor
+from ...core.random import split_key
+from ...core import dtype as dtype_mod
+
+__all__ = ["linear", "dropout", "dropout2d", "dropout3d", "alpha_dropout",
+           "embedding", "one_hot", "label_smooth", "pad", "interpolate",
+           "upsample", "bilinear", "cosine_similarity", "pixel_shuffle",
+           "pixel_unshuffle", "channel_shuffle", "unfold", "fold", "normalize",
+           "zeropad2d", "class_center_sample"]
+
+from ...tensor.manipulation import pad  # padding shared with tensor namespace
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W (+ b); paddle weight layout [in_features, out_features]
+    (reference common.py linear). Lowers to a single MXU matmul."""
+    if bias is None:
+        return op_call("linear", lambda v, w: v @ w, x, weight)
+    return op_call("linear", lambda v, w, b: v @ w + b, x, weight, bias)
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    if not training or p == 0.0:
+        return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+    key = split_key()
+    def impl(v):
+        if axis is None:
+            mask_shape = v.shape
+        else:
+            axes = axis if isinstance(axis, (list, tuple)) else [axis]
+            mask_shape = tuple(v.shape[i] if i in [a % v.ndim for a in axes] else 1
+                               for i in range(v.ndim))
+        keep = jax.random.bernoulli(key, 1.0 - p, mask_shape)
+        if mode == "upscale_in_train":
+            return jnp.where(keep, v / (1.0 - p), 0.0).astype(v.dtype)
+        return jnp.where(keep, v, 0.0).astype(v.dtype)
+    return op_call("dropout", impl, x)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    ch_axis = 1 if data_format == "NCHW" else 3
+    return dropout(x, p, axis=[0, ch_axis], training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    ch_axis = 1 if data_format == "NCDHW" else 4
+    return dropout(x, p, axis=[0, ch_axis], training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    key = split_key()
+    def impl(v):
+        alpha = 1.6732632423543772
+        scale = 1.0507009873554805
+        alpha_p = -alpha * scale
+        keep = jax.random.bernoulli(key, 1.0 - p, v.shape)
+        a = (1.0 / np.sqrt((1.0 - p) * (1.0 + p * alpha_p ** 2))).astype(np.float32)
+        b = -a * alpha_p * p
+        return (a * jnp.where(keep, v, alpha_p) + b).astype(v.dtype)
+    return op_call("alpha_dropout", impl, x)
+
+
+def _embedding_impl(padding_idx):
+    @jax.custom_vjp
+    def emb(ids, w):
+        return w[ids]
+
+    def fwd(ids, w):
+        # residual holds w itself (no copy — it's the live parameter buffer),
+        # giving bwd its shape/dtype without non-array residuals
+        return w[ids], (ids, w)
+
+    def bwd(res, g):
+        ids, w = res
+        gw = jnp.zeros(w.shape, g.dtype).at[ids].add(g)
+        if padding_idx is not None:
+            gw = gw.at[padding_idx].set(0.0)
+        return None, gw.astype(w.dtype)
+
+    emb.defvjp(fwd, bwd)
+    return emb
+
+
+def embedding(x, weight, padding_idx=None, max_norm=None, norm_type=2.0,
+              sparse=False, scale_grad_by_freq=False, name=None):
+    """Lookup with padding_idx grad masking (reference functional/input.py
+    embedding; grad-scatter kernel embedding_grad_kernel.cu analog is the
+    XLA scatter-add in the custom vjp)."""
+    emb = _embedding_impl(padding_idx)
+    def impl(w, ids_v):
+        ids_i = ids_v.astype(jnp.int32)
+        ww = w
+        if max_norm is not None:
+            norms = jnp.linalg.norm(ww, ord=norm_type, axis=-1, keepdims=True)
+            ww = ww * jnp.minimum(1.0, max_norm / (norms + 1e-12))
+        return emb(ids_i, ww)
+    # note: ids passed as second positional but non-differentiable (int dtype)
+    return op_call("embedding", impl, weight, x)
+
+
+def one_hot(x, num_classes, name=None):
+    n = int(num_classes._value) if isinstance(num_classes, Tensor) else int(num_classes)
+    return op_call("one_hot",
+                   lambda v: jax.nn.one_hot(v.astype(jnp.int32), n, dtype=jnp.float32),
+                   x, nondiff=True)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def impl(v, *rest):
+        n = v.shape[-1]
+        if rest:
+            return (1 - epsilon) * v + epsilon * rest[0]
+        return (1 - epsilon) * v + epsilon / n
+    if prior_dist is not None:
+        return op_call("label_smooth", impl, label, prior_dist)
+    return op_call("label_smooth", impl, label)
+
+
+def _resize_1d(v, out_size, axis, mode, align_corners, align_mode=0):
+    """Differentiable 1-D resize along `axis` via gather-based interpolation."""
+    in_size = v.shape[axis]
+    if mode == "nearest":
+        if align_corners:
+            idx = jnp.round(jnp.linspace(0, in_size - 1, out_size)).astype(jnp.int32)
+        else:
+            scale = in_size / out_size
+            idx = jnp.floor(jnp.arange(out_size) * scale).astype(jnp.int32)
+        return jnp.take(v, jnp.clip(idx, 0, in_size - 1), axis=axis)
+    # linear family
+    if align_corners:
+        pos = jnp.linspace(0.0, in_size - 1.0, out_size)
+    elif align_mode == 1:
+        pos = jnp.arange(out_size) * (in_size / out_size)
+    else:
+        scale = in_size / out_size
+        pos = (jnp.arange(out_size) + 0.5) * scale - 0.5
+    pos = jnp.clip(pos, 0.0, in_size - 1.0)
+    lo = jnp.floor(pos).astype(jnp.int32)
+    hi = jnp.clip(lo + 1, 0, in_size - 1)
+    w = (pos - lo).astype(v.dtype)
+    shape = [1] * v.ndim
+    shape[axis] = out_size
+    w = w.reshape(shape)
+    lo_v = jnp.take(v, lo, axis=axis)
+    hi_v = jnp.take(v, hi, axis=axis)
+    return lo_v * (1 - w) + hi_v * w
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format=None, name=None):
+    """reference functional/common.py interpolate: nearest/bilinear/trilinear/
+    bicubic/linear/area over NCHW (default) or channel-last layouts."""
+    mode = mode.lower()
+    def impl(v):
+        nd = v.ndim
+        df = data_format or {3: "NCW", 4: "NCHW", 5: "NCDHW"}[nd]
+        channel_last = df in ("NWC", "NHWC", "NDHWC")
+        spatial_axes = list(range(1, nd - 1)) if channel_last else list(range(2, nd))
+        in_sizes = [v.shape[a] for a in spatial_axes]
+        if size is not None:
+            sz = size
+            if isinstance(sz, Tensor):
+                sz = sz.numpy().tolist()
+            sz = [int(s._value) if isinstance(s, Tensor) else int(s) for s in
+                  (sz if isinstance(sz, (list, tuple)) else [sz] * len(spatial_axes))]
+        else:
+            sf = scale_factor if isinstance(scale_factor, (list, tuple)) \
+                else [scale_factor] * len(spatial_axes)
+            sz = [int(np.floor(i * float(s))) for i, s in zip(in_sizes, sf)]
+        if mode == "area":
+            # adaptive average pooling semantics
+            out = v
+            for a, s in zip(spatial_axes, sz):
+                n = out.shape[a]
+                if n % s == 0:
+                    k = n // s
+                    new_shape = out.shape[:a] + (s, k) + out.shape[a + 1:]
+                    out = jnp.mean(out.reshape(new_shape), axis=a + 1)
+                else:
+                    out = _resize_1d(out, s, a, "linear", False)
+            return out
+        m = {"nearest": "nearest", "bilinear": "linear", "trilinear": "linear",
+             "linear": "linear", "bicubic": "cubic"}[mode]
+        if m == "cubic":
+            # route through jax.image for cubic
+            full = list(v.shape)
+            for a, s in zip(spatial_axes, sz):
+                full[a] = s
+            return jax.image.resize(v, tuple(full), method="cubic").astype(v.dtype)
+        out = v
+        for a, s in zip(spatial_axes, sz):
+            out = _resize_1d(out, s, a, m, align_corners, align_mode)
+        return out
+    return op_call("interpolate", impl, x)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+             align_mode=0, data_format=None, name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode, data_format)
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def impl(a, b, w, *rest):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if rest:
+            out = out + rest[0]
+        return out
+    if bias is not None:
+        return op_call("bilinear", impl, x1, x2, weight, bias)
+    return op_call("bilinear", impl, x1, x2, weight)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def impl(a, b):
+        num = jnp.sum(a * b, axis=axis)
+        d1 = jnp.sqrt(jnp.sum(a * a, axis=axis))
+        d2 = jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return num / jnp.maximum(d1 * d2, eps)
+    return op_call("cosine_similarity", impl, x1, x2)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+    def impl(v):
+        if data_format == "NCHW":
+            b, c, h, w = v.shape
+            v2 = v.reshape(b, c // (r * r), r, r, h, w)
+            v2 = jnp.transpose(v2, (0, 1, 4, 2, 5, 3))
+            return v2.reshape(b, c // (r * r), h * r, w * r)
+        b, h, w, c = v.shape
+        v2 = v.reshape(b, h, w, r, r, c // (r * r))
+        v2 = jnp.transpose(v2, (0, 1, 3, 2, 4, 5))
+        return v2.reshape(b, h * r, w * r, c // (r * r))
+    return op_call("pixel_shuffle", impl, x)
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+    def impl(v):
+        if data_format == "NCHW":
+            b, c, h, w = v.shape
+            v2 = v.reshape(b, c, h // r, r, w // r, r)
+            v2 = jnp.transpose(v2, (0, 1, 3, 5, 2, 4))
+            return v2.reshape(b, c * r * r, h // r, w // r)
+        b, h, w, c = v.shape
+        v2 = v.reshape(b, h // r, r, w // r, r, c)
+        v2 = jnp.transpose(v2, (0, 2, 4, 1, 3, 5))
+        return v2.reshape(b, h // r, w // r, c * r * r)
+    return op_call("pixel_unshuffle", impl, x)
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def impl(v):
+        if data_format == "NCHW":
+            b, c, h, w = v.shape
+            v2 = v.reshape(b, groups, c // groups, h, w)
+            return jnp.swapaxes(v2, 1, 2).reshape(b, c, h, w)
+        b, h, w, c = v.shape
+        v2 = v.reshape(b, h, w, groups, c // groups)
+        return jnp.swapaxes(v2, 3, 4).reshape(b, h, w, c)
+    return op_call("channel_shuffle", impl, x)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (reference unfold): NCHW -> [N, C*kh*kw, L]."""
+    kh, kw = (kernel_sizes, kernel_sizes) if isinstance(kernel_sizes, int) else kernel_sizes
+    sh, sw = (strides, strides) if isinstance(strides, int) else strides
+    dh, dw = (dilations, dilations) if isinstance(dilations, int) else dilations
+    if isinstance(paddings, int):
+        pt = pb = pl = pr = paddings
+    elif len(paddings) == 2:
+        pt = pb = paddings[0]
+        pl = pr = paddings[1]
+    else:
+        pt, pl, pb, pr = paddings
+    def impl(v):
+        b, c, h, w = v.shape
+        vp = jnp.pad(v, ((0, 0), (0, 0), (pt, pb), (pl, pr)))
+        hp, wp = vp.shape[2], vp.shape[3]
+        oh = (hp - (dh * (kh - 1) + 1)) // sh + 1
+        ow = (wp - (dw * (kw - 1) + 1)) // sw + 1
+        patches = jax.lax.conv_general_dilated_patches(
+            vp, (kh, kw), (sh, sw), "VALID", rhs_dilation=(dh, dw),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        # patches: [N, C*kh*kw, oh, ow]
+        return patches.reshape(b, c * kh * kw, oh * ow)
+    return op_call("unfold", impl, x)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    oh, ow = (output_sizes, output_sizes) if isinstance(output_sizes, int) else output_sizes
+    kh, kw = (kernel_sizes, kernel_sizes) if isinstance(kernel_sizes, int) else kernel_sizes
+    sh, sw = (strides, strides) if isinstance(strides, int) else strides
+    dh, dw = (dilations, dilations) if isinstance(dilations, int) else dilations
+    if isinstance(paddings, int):
+        pt = pb = pl = pr = paddings
+    elif len(paddings) == 2:
+        pt = pb = paddings[0]
+        pl = pr = paddings[1]
+    else:
+        pt, pl, pb, pr = paddings
+    def impl(v):
+        b, ckk, L = v.shape
+        c = ckk // (kh * kw)
+        hp, wp = oh + pt + pb, ow + pl + pr
+        nh = (hp - (dh * (kh - 1) + 1)) // sh + 1
+        nw = (wp - (dw * (kw - 1) + 1)) // sw + 1
+        out = jnp.zeros((b, c, hp, wp), v.dtype)
+        vv = v.reshape(b, c, kh, kw, nh, nw)
+        for i in range(kh):
+            for j in range(kw):
+                hs = i * dh
+                ws = j * dw
+                out = out.at[:, :, hs:hs + nh * sh:sh, ws:ws + nw * sw:sw].add(vv[:, :, i, j])
+        return out[:, :, pt:pt + oh, pl:pl + ow]
+    return op_call("fold", impl, x)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def impl(v):
+        norm = jnp.sum(jnp.abs(v) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return v / jnp.maximum(norm, epsilon)
+    return op_call("normalize", impl, x)
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return pad(x, padding, mode="constant", value=0.0, data_format=data_format)
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """Sampled-class subset for large-softmax training (reference
+    functional/common.py class_center_sample); single-device variant."""
+    lv = np.asarray(label._value)
+    pos = np.unique(lv)
+    if len(pos) >= num_samples:
+        sampled = pos
+    else:
+        rest = np.setdiff1d(np.arange(num_classes), pos)
+        extra = np.random.default_rng(0).choice(rest, num_samples - len(pos), replace=False)
+        sampled = np.sort(np.concatenate([pos, extra]))
+    remap = -np.ones(num_classes, dtype=np.int64)
+    remap[sampled] = np.arange(len(sampled))
+    return Tensor(jnp.asarray(remap[lv])), Tensor(jnp.asarray(sampled))

@@ -1,0 +1,171 @@
+"""Convolution functionals (reference: python/paddle/nn/functional/conv.py).
+
+All convs lower to a single `lax.conv_general_dilated` HLO — XLA tiles it onto
+the MXU. Paddle layouts are kept at the API (NCHW default, weight OIHW); on
+TPU XLA canonicalizes layouts internally, so no manual transposes are needed
+for performance.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import op_call
+
+__all__ = ["conv1d", "conv2d", "conv3d", "conv1d_transpose", "conv2d_transpose",
+           "conv3d_transpose"]
+
+
+def _tuple(v, n):
+    if isinstance(v, (list, tuple)):
+        if len(v) == n:
+            return tuple(int(x) for x in v)
+        if len(v) == 1:
+            return tuple(int(v[0]) for _ in range(n))
+    return tuple(int(v) for _ in range(n))
+
+
+def _padding(padding, n, strides=None):
+    """Paddle padding spec -> lax padding list [(lo, hi)] * n or 'SAME'/'VALID'."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, (list, tuple)):
+        flat = list(padding)
+        if len(flat) == n:
+            return [(int(p), int(p)) for p in flat]
+        if len(flat) == 2 * n:
+            return [(int(flat[2 * i]), int(flat[2 * i + 1])) for i in range(n)]
+        if len(flat) == 1:
+            return [(int(flat[0]), int(flat[0]))] * n
+    return [(int(padding), int(padding))] * n
+
+
+def _dim_numbers(n, channel_last):
+    if n == 1:
+        return ("NWC", "WIO", "NWC") if channel_last else ("NCW", "OIW", "NCW")
+    if n == 2:
+        return ("NHWC", "HWIO", "NHWC") if channel_last else ("NCHW", "OIHW", "NCHW")
+    return ("NDHWC", "DHWIO", "NDHWC") if channel_last else ("NCDHW", "OIDHW", "NCDHW")
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, n, data_format):
+    channel_last = data_format in ("NWC", "NHWC", "NDHWC", "NLC")
+    strides = _tuple(stride, n)
+    dil = _tuple(dilation, n)
+    pad = _padding(padding, n)
+    dn = _dim_numbers(n, channel_last)
+
+    def impl(v, w, *rest):
+        # paddle weight layout is always [out_c, in_c/groups, *k]
+        if channel_last:
+            # lax wants e.g. HWIO for NHWC
+            perm = tuple(range(2, 2 + n)) + (1, 0)
+            w = jnp.transpose(w, perm)
+        out = jax.lax.conv_general_dilated(
+            v, w, window_strides=strides, padding=pad,
+            rhs_dilation=dil, dimension_numbers=dn,
+            feature_group_count=groups,
+            preferred_element_type=jnp.float32 if v.dtype == jnp.bfloat16 else None)
+        out = out.astype(v.dtype)
+        if rest:
+            b = rest[0]
+            shape = [1] * out.ndim
+            shape[-1 if channel_last else 1] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+    args = (x, weight) if bias is None else (x, weight, bias)
+    return op_call(f"conv{n}d", impl, *args)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    df = "NWC" if data_format in ("NLC", "NWC") else "NCW"
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1, df)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2, data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3, data_format)
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                    groups, n, data_format, output_size):
+    channel_last = data_format in ("NWC", "NHWC", "NDHWC", "NLC")
+    strides = _tuple(stride, n)
+    dil = _tuple(dilation, n)
+    opad = _tuple(output_padding, n) if output_padding is not None else (0,) * n
+    pad_spec = _padding(padding, n)
+    dn = _dim_numbers(n, channel_last)
+
+    def impl(v, w, *rest):
+        # paddle transpose-conv weight layout: [in_c, out_c/groups, *k]
+        # grad-of-conv formulation: lhs_dilation = stride
+        k_eff = [dil[i] * (w.shape[2 + i] - 1) + 1 for i in range(n)]
+        if isinstance(pad_spec, str):
+            if pad_spec == "VALID":
+                pads = [(0, 0)] * n
+            else:  # SAME
+                pads = []
+                for i in range(n):
+                    size_in = v.shape[1 + i if channel_last else 2 + i]
+                    total = max(k_eff[i] - strides[i], 0)
+                    pads.append((total // 2, total - total // 2))
+        else:
+            pads = pad_spec
+        conv_pads = []
+        for i in range(n):
+            lo = k_eff[i] - 1 - pads[i][0]
+            hi = k_eff[i] - 1 - pads[i][1] + opad[i]
+            conv_pads.append((lo, hi))
+        # flip spatial dims & swap in/out channels: OIHW with O=out
+        spatial_axes = tuple(range(2, 2 + n))
+        wf = jnp.flip(w, spatial_axes)
+        # w: [in_c, out_c/groups, *k] -> [out_c, in_c/groups, *k]
+        if groups == 1:
+            wt = jnp.swapaxes(wf, 0, 1)
+        else:
+            ic, ocg = wf.shape[0], wf.shape[1]
+            wg = wf.reshape((groups, ic // groups, ocg) + wf.shape[2:])
+            wg = jnp.swapaxes(wg, 1, 2)  # [g, out/g, in/g, *k]
+            wt = wg.reshape((groups * ocg, ic // groups) + wf.shape[2:])
+        if channel_last:
+            perm = tuple(range(2, 2 + n)) + (1, 0)
+            wt = jnp.transpose(wt, perm)
+        out = jax.lax.conv_general_dilated(
+            v, wt, window_strides=(1,) * n, padding=conv_pads,
+            lhs_dilation=strides, rhs_dilation=dil, dimension_numbers=dn,
+            feature_group_count=groups)
+        out = out.astype(v.dtype)
+        if rest:
+            b = rest[0]
+            shape = [1] * out.ndim
+            shape[-1 if channel_last else 1] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+    args = (x, weight) if bias is None else (x, weight, bias)
+    return op_call(f"conv{n}d_transpose", impl, *args)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCL", name=None):
+    df = "NWC" if data_format in ("NLC", "NWC") else "NCW"
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 1, df, output_size)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, data_format="NCHW", output_size=None, name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 2, data_format, output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, data_format="NCDHW", output_size=None, name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 3, data_format, output_size)

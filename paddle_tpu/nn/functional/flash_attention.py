@@ -1,0 +1,7 @@
+"""paddle.nn.functional.flash_attention submodule parity — reference keeps
+flash attention in its own module (python/paddle/nn/functional/flash_attention.py)."""
+from .attention import (  # noqa: F401
+    flash_attention, flash_attn_unpadded, scaled_dot_product_attention, sdp_kernel,
+)
+
+flash_attn_qkvpacked = None  # provided via flash_attention on unpacked views

@@ -1,0 +1,328 @@
+"""Loss functionals (reference: python/paddle/nn/functional/loss.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import op_call
+from ...core.tensor import Tensor
+
+__all__ = ["cross_entropy", "softmax_with_cross_entropy", "mse_loss", "l1_loss",
+           "nll_loss", "binary_cross_entropy", "binary_cross_entropy_with_logits",
+           "smooth_l1_loss", "kl_div", "margin_ranking_loss", "cosine_embedding_loss",
+           "hinge_embedding_loss", "triplet_margin_loss", "log_loss", "square_error_cost",
+           "sigmoid_focal_loss", "dice_loss", "ctc_loss", "poisson_nll_loss",
+           "gaussian_nll_loss", "multi_label_soft_margin_loss", "soft_margin_loss",
+           "margin_cross_entropy"]
+
+
+def _reduce(v, reduction):
+    if reduction == "mean":
+        return jnp.mean(v)
+    if reduction == "sum":
+        return jnp.sum(v)
+    return v
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0,
+                  name=None):
+    """reference loss.py cross_entropy: hard or soft labels, optional class
+    weights, ignore_index, label smoothing."""
+    def impl(logits, lab, *rest):
+        ax = axis % logits.ndim
+        if use_softmax:
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=ax)
+        else:
+            logp = jnp.log(jnp.clip(logits.astype(jnp.float32), 1e-30, None))
+        n_classes = logits.shape[ax]
+        if soft_label:
+            soft = lab.astype(jnp.float32)
+            if label_smoothing > 0.0:
+                soft = (1 - label_smoothing) * soft + label_smoothing / n_classes
+            loss = -jnp.sum(soft * logp, axis=ax)
+            if rest:
+                w = rest[0]
+                wt = jnp.sum(soft * w.reshape([-1 if i == ax else 1 for i in range(logits.ndim)]), axis=ax)
+                loss = loss * wt
+            return _reduce(loss, reduction)
+        ids = lab.astype(jnp.int32)
+        if ids.ndim == logits.ndim:
+            ids = jnp.squeeze(ids, axis=ax)
+        valid = ids != ignore_index
+        safe_ids = jnp.where(valid, ids, 0)
+        picked = jnp.take_along_axis(logp, jnp.expand_dims(safe_ids, ax), axis=ax)
+        picked = jnp.squeeze(picked, axis=ax)
+        if label_smoothing > 0.0:
+            smooth_term = jnp.mean(logp, axis=ax)
+            nll = -(1 - label_smoothing) * picked - label_smoothing * smooth_term
+        else:
+            nll = -picked
+        if rest:
+            w = rest[0]
+            wv = w[safe_ids]
+            nll = nll * wv
+            nll = jnp.where(valid, nll, 0.0)
+            if reduction == "mean":
+                denom = jnp.sum(jnp.where(valid, wv, 0.0))
+                return jnp.sum(nll) / jnp.maximum(denom, 1e-12)
+            return _reduce(nll, reduction)
+        nll = jnp.where(valid, nll, 0.0)
+        if reduction == "mean":
+            denom = jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+            return jnp.sum(nll) / denom
+        return _reduce(nll, reduction)
+    args = [input, label] if weight is None else [input, label, weight]
+    return op_call("cross_entropy", impl, *args)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               numeric_stable_mode=True, return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none", axis=axis)
+    from .activation import softmax as softmax_fn
+    if return_softmax:
+        return loss, softmax_fn(logits, axis=axis)
+    return loss
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return op_call("mse_loss", lambda a, b: _reduce((a - b) ** 2, reduction), input, label)
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return op_call("l1_loss", lambda a, b: _reduce(jnp.abs(a - b), reduction), input, label)
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    def impl(logp, lab, *rest):
+        ids = lab.astype(jnp.int32)
+        valid = ids != ignore_index
+        safe = jnp.where(valid, ids, 0)
+        # class dim is axis 1 for ndim>1
+        picked = jnp.take_along_axis(logp, jnp.expand_dims(safe, 1), axis=1)
+        picked = jnp.squeeze(picked, axis=1)
+        loss = -picked
+        if rest:
+            wv = rest[0][safe]
+            loss = loss * wv
+            loss = jnp.where(valid, loss, 0.0)
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(jnp.sum(jnp.where(valid, wv, 0.0)), 1e-12)
+        else:
+            loss = jnp.where(valid, loss, 0.0)
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+        return _reduce(loss, reduction)
+    args = [input, label] if weight is None else [input, label, weight]
+    return op_call("nll_loss", impl, *args)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    def impl(p, y, *rest):
+        p = jnp.clip(p, 1e-12, 1.0 - 1e-12)
+        loss = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+        if rest:
+            loss = loss * rest[0]
+        return _reduce(loss, reduction)
+    args = [input, label] if weight is None else [input, label, weight]
+    return op_call("bce", impl, *args)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    def impl(z, y, *rest):
+        i = 0
+        w = pw = None
+        if weight is not None:
+            w = rest[i]; i += 1
+        if pos_weight is not None:
+            pw = rest[i]; i += 1
+        max_val = jnp.clip(-z, 0, None)
+        if pw is not None:
+            log_w = (pw - 1) * y + 1
+            loss = (1 - y) * z + log_w * (jnp.log1p(jnp.exp(-jnp.abs(z))) + max_val)
+        else:
+            loss = (1 - y) * z + jnp.log1p(jnp.exp(-jnp.abs(z))) + max_val
+        if w is not None:
+            loss = loss * w
+        return _reduce(loss, reduction)
+    args = [logit, label]
+    if weight is not None:
+        args.append(weight)
+    if pos_weight is not None:
+        args.append(pos_weight)
+    return op_call("bce_logits", impl, *args)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def impl(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+        return _reduce(loss, reduction)
+    return op_call("smooth_l1", impl, input, label)
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    def impl(logp, t):
+        if log_target:
+            loss = jnp.exp(t) * (t - logp)
+        else:
+            loss = t * (jnp.log(jnp.clip(t, 1e-12, None)) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce(loss, reduction)
+    return op_call("kl_div", impl, input, label)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    def impl(a, b, y):
+        return _reduce(jnp.clip(-y * (a - b) + margin, 0, None), reduction)
+    return op_call("margin_ranking", impl, input, other, label)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean", name=None):
+    def impl(a, b, y):
+        cos = jnp.sum(a * b, -1) / (jnp.linalg.norm(a, axis=-1) *
+                                    jnp.linalg.norm(b, axis=-1) + 1e-12)
+        loss = jnp.where(y == 1, 1 - cos, jnp.clip(cos - margin, 0, None))
+        return _reduce(loss, reduction)
+    return op_call("cosine_embedding", impl, input1, input2, label)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    def impl(x, y):
+        loss = jnp.where(y == 1, x, jnp.clip(margin - x, 0, None))
+        return _reduce(loss, reduction)
+    return op_call("hinge_embedding", impl, input, label)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0, epsilon=1e-6,
+                        swap=False, reduction="mean", name=None):
+    def impl(a, pos, neg):
+        dp = jnp.sum(jnp.abs(a - pos) ** p + epsilon, axis=-1) ** (1 / p)
+        dn = jnp.sum(jnp.abs(a - neg) ** p + epsilon, axis=-1) ** (1 / p)
+        if swap:
+            dn2 = jnp.sum(jnp.abs(pos - neg) ** p + epsilon, axis=-1) ** (1 / p)
+            dn = jnp.minimum(dn, dn2)
+        return _reduce(jnp.clip(dp - dn + margin, 0, None), reduction)
+    return op_call("triplet_margin", impl, input, positive, negative)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    def impl(p, y):
+        return -y * jnp.log(p + epsilon) - (1 - y) * jnp.log(1 - p + epsilon)
+    return op_call("log_loss", impl, input, label)
+
+
+def square_error_cost(input, label):
+    return op_call("square_error_cost", lambda a, b: (a - b) ** 2, input, label)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def impl(z, y, *rest):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.log1p(jnp.exp(-jnp.abs(z))) + jnp.clip(-z, 0, None)
+        ce = (1 - y) * z + ce
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * ((1 - p_t) ** gamma) * ce
+        if rest:
+            loss = loss / rest[0]
+        return _reduce(loss, reduction)
+    args = [logit, label] if normalizer is None else [logit, label, normalizer]
+    return op_call("sigmoid_focal", impl, *args)
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    def impl(p, y):
+        yf = jax.nn.one_hot(y.squeeze(-1).astype(jnp.int32), p.shape[-1], dtype=p.dtype)
+        red = tuple(range(1, p.ndim))
+        inter = jnp.sum(p * yf, axis=red)
+        union = jnp.sum(p, axis=red) + jnp.sum(yf, axis=red)
+        return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
+    return op_call("dice", impl, input, label)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC via optax (jax-native forward-backward)."""
+    import optax
+    def impl(lp, lab, il, ll):
+        # paddle: lp is [T, B, C] logits; optax wants [B, T, C] log-probs
+        logits = jnp.transpose(lp, (1, 0, 2))
+        B, T, C = logits.shape
+        labmax = lab.shape[1]
+        logitpad = jnp.arange(T)[None, :] >= il[:, None]
+        labpad = jnp.arange(labmax)[None, :] >= ll[:, None]
+        per_seq = optax.ctc_loss(logits, logitpad.astype(jnp.float32),
+                                 lab.astype(jnp.int32), labpad.astype(jnp.float32),
+                                 blank_id=blank)
+        if reduction == "mean":
+            return jnp.mean(per_seq / jnp.maximum(ll.astype(jnp.float32), 1.0))
+        return _reduce(per_seq, reduction)
+    return op_call("ctc_loss", impl, log_probs, labels, input_lengths, label_lengths)
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    def impl(x, y):
+        if log_input:
+            loss = jnp.exp(x) - y * x
+        else:
+            loss = x - y * jnp.log(x + epsilon)
+        if full:
+            stirling = y * jnp.log(y + epsilon) - y + 0.5 * jnp.log(2 * jnp.pi * (y + epsilon))
+            loss = loss + jnp.where(y > 1, stirling, 0.0)
+        return _reduce(loss, reduction)
+    return op_call("poisson_nll", impl, input, label)
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    def impl(mu, y, var):
+        var = jnp.clip(var, epsilon, None)
+        loss = 0.5 * (jnp.log(var) + (y - mu) ** 2 / var)
+        if full:
+            loss = loss + 0.5 * jnp.log(2 * jnp.asarray(jnp.pi))
+        return _reduce(loss, reduction)
+    return op_call("gaussian_nll", impl, input, label, variance)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean", name=None):
+    def impl(x, y, *rest):
+        loss = -(y * jax.nn.log_sigmoid(x) + (1 - y) * jax.nn.log_sigmoid(-x))
+        if rest:
+            loss = loss * rest[0]
+        return _reduce(jnp.mean(loss, axis=-1), reduction)
+    args = [input, label] if weight is None else [input, label, weight]
+    return op_call("ml_soft_margin", impl, *args)
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    def impl(x, y):
+        return _reduce(jnp.log1p(jnp.exp(-y * x)), reduction)
+    return op_call("soft_margin", impl, input, label)
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5, margin3=0.0,
+                         scale=64.0, group=None, return_softmax=False,
+                         reduction="mean"):
+    """ArcFace-style margin softmax (reference loss.py margin_cross_entropy),
+    single-group variant."""
+    def impl(z, lab):
+        ids = lab.astype(jnp.int32).reshape(-1)
+        onehot = jax.nn.one_hot(ids, z.shape[-1], dtype=z.dtype)
+        theta = jnp.arccos(jnp.clip(z, -1.0, 1.0))
+        target = jnp.cos(margin1 * theta + margin2) - margin3
+        zz = jnp.where(onehot > 0, target, z) * scale
+        logp = jax.nn.log_softmax(zz, axis=-1)
+        loss = -jnp.sum(onehot * logp, axis=-1)
+        return _reduce(loss, reduction)
+    loss = op_call("margin_ce", impl, logits, label)
+    if return_softmax:
+        from .activation import softmax as softmax_fn
+        return loss, softmax_fn(logits, axis=-1)
+    return loss

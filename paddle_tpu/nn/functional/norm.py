@@ -1,0 +1,197 @@
+"""Normalization functionals (reference: python/paddle/nn/functional/norm.py).
+
+batch_norm returns updated running stats through the buffer tensors passed in
+(eager: in-place update; under functional_call tracing the updates are
+harvested into new_state).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import op_call
+from ...core.tensor import Tensor
+
+__all__ = ["batch_norm", "layer_norm", "instance_norm", "group_norm",
+           "local_response_norm", "rms_norm"]
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5, data_format="NCHW",
+               use_global_stats=None, name=None):
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    if use_global_stats is None:
+        use_global_stats = not training
+
+    def stats_axes(v):
+        ch = v.ndim - 1 if channel_last else (1 if v.ndim > 1 else 0)
+        return tuple(i for i in range(v.ndim) if i != ch), ch
+
+    if use_global_stats:
+        def impl(v, m, var, *rest):
+            axes, ch = stats_axes(v)
+            shape = [1] * v.ndim
+            shape[ch] = v.shape[ch]
+            out = (v - m.reshape(shape)) * jax.lax.rsqrt(var.reshape(shape) + epsilon)
+            if rest:
+                out = out * rest[0].reshape(shape)
+                if len(rest) > 1:
+                    out = out + rest[1].reshape(shape)
+            return out
+        args = [x, running_mean, running_var]
+        if weight is not None:
+            args.append(weight)
+            if bias is not None:
+                args.append(bias)
+        return op_call("batch_norm_infer", impl, *args)
+
+    # training: compute batch stats, update running buffers
+    def impl(v, *rest):
+        axes, ch = stats_axes(v)
+        shape = [1] * v.ndim
+        shape[ch] = v.shape[ch]
+        mean = jnp.mean(v, axis=axes)
+        var = jnp.var(v, axis=axes)
+        out = (v - mean.reshape(shape)) * jax.lax.rsqrt(var.reshape(shape) + epsilon)
+        if rest:
+            out = out * rest[0].reshape(shape)
+            if len(rest) > 1:
+                out = out + rest[1].reshape(shape)
+        return out, mean, var
+    args = [x]
+    if weight is not None:
+        args.append(weight)
+        if bias is not None:
+            args.append(bias)
+    out, bmean, bvar = op_call("batch_norm_train", impl, *args)
+    if running_mean is not None:
+        # unbiased variance for running stats (paddle semantics)
+        n = x.size // bmean.size
+        unbias = bvar._value * (n / max(n - 1, 1))
+        running_mean._set_value(momentum * running_mean._value +
+                                (1 - momentum) * bmean._value)
+        running_var._set_value(momentum * running_var._value + (1 - momentum) * unbias)
+    return out
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    n_axes = len(tuple(normalized_shape))
+
+    def impl(v, *rest):
+        axes = tuple(range(v.ndim - n_axes, v.ndim))
+        mean = jnp.mean(v.astype(jnp.float32), axis=axes, keepdims=True)
+        var = jnp.var(v.astype(jnp.float32), axis=axes, keepdims=True)
+        out = ((v - mean) * jax.lax.rsqrt(var + epsilon)).astype(v.dtype)
+        if rest:
+            out = out * rest[0]
+            if len(rest) > 1:
+                out = out + rest[1]
+        return out
+    args = [x]
+    if weight is not None:
+        args.append(weight)
+        if bias is not None:
+            args.append(bias)
+    return op_call("layer_norm", impl, *args)
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """RMSNorm (reference incubate fused_rms_norm) — LLaMA's norm; Pallas
+    override registers under op name 'rms_norm'."""
+    def impl(v, *rest):
+        ms = jnp.mean(jnp.square(v.astype(jnp.float32)), axis=-1, keepdims=True)
+        out = (v * jax.lax.rsqrt(ms + epsilon)).astype(v.dtype)
+        if rest:
+            out = out * rest[0]
+        return out
+    args = [x] if weight is None else [x, weight]
+    return op_call("rms_norm", impl, *args)
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
+                  use_input_stats=True, momentum=0.9, eps=1e-5,
+                  data_format="NCHW", name=None):
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+
+    def impl(v, *rest):
+        if channel_last:
+            ch = v.ndim - 1
+            axes = tuple(range(1, v.ndim - 1))
+        else:
+            ch = 1
+            axes = tuple(range(2, v.ndim))
+        mean = jnp.mean(v, axis=axes, keepdims=True)
+        var = jnp.var(v, axis=axes, keepdims=True)
+        out = (v - mean) * jax.lax.rsqrt(var + eps)
+        if rest:
+            shape = [1] * v.ndim
+            shape[ch] = v.shape[ch]
+            out = out * rest[0].reshape(shape)
+            if len(rest) > 1:
+                out = out + rest[1].reshape(shape)
+        return out
+    args = [x]
+    if weight is not None:
+        args.append(weight)
+        if bias is not None:
+            args.append(bias)
+    return op_call("instance_norm", impl, *args)
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+
+    def impl(v, *rest):
+        if channel_last:
+            ch = v.ndim - 1
+        else:
+            ch = 1
+        c = v.shape[ch]
+        g = num_groups
+        if channel_last:
+            new_shape = v.shape[:-1] + (g, c // g)
+            vv = v.reshape(new_shape)
+            axes = tuple(range(1, v.ndim - 1)) + (v.ndim,)
+            mean = jnp.mean(vv, axis=axes, keepdims=True)
+            var = jnp.var(vv, axis=axes, keepdims=True)
+            out = ((vv - mean) * jax.lax.rsqrt(var + epsilon)).reshape(v.shape)
+        else:
+            new_shape = (v.shape[0], g, c // g) + v.shape[2:]
+            vv = v.reshape(new_shape)
+            axes = tuple(range(2, vv.ndim))
+            mean = jnp.mean(vv, axis=axes, keepdims=True)
+            var = jnp.var(vv, axis=axes, keepdims=True)
+            out = ((vv - mean) * jax.lax.rsqrt(var + epsilon)).reshape(v.shape)
+        if rest:
+            shape = [1] * v.ndim
+            shape[ch] = c
+            out = out * rest[0].reshape(shape)
+            if len(rest) > 1:
+                out = out + rest[1].reshape(shape)
+        return out
+    args = [x]
+    if weight is not None:
+        args.append(weight)
+        if bias is not None:
+            args.append(bias)
+    return op_call("group_norm", impl, *args)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    def impl(v):
+        ch = 1 if data_format.startswith("NC") else v.ndim - 1
+        sq = jnp.square(v)
+        moved = jnp.moveaxis(sq, ch, -1)
+        pad_lo = (size - 1) // 2
+        pad_hi = size - 1 - pad_lo
+        padded = jnp.pad(moved, [(0, 0)] * (moved.ndim - 1) + [(pad_lo, pad_hi)])
+        win = jnp.stack([padded[..., i:i + moved.shape[-1]] for i in range(size)], axis=0)
+        s = jnp.sum(win, axis=0)
+        s = jnp.moveaxis(s, -1, ch)
+        div = (k + alpha * s) ** beta
+        return v / div
+    return op_call("local_response_norm", impl, x)

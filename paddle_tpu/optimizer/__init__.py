@@ -1,0 +1,10 @@
+"""paddle.optimizer parity namespace."""
+from __future__ import annotations
+
+from .optimizer import Optimizer
+from .optimizers import (SGD, Momentum, Adam, AdamW, Adamax, Adagrad, RMSProp,
+                         Adadelta, Lamb, NAdam, RAdam, ASGD, Rprop)
+from . import lr
+
+__all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax", "Adagrad",
+           "RMSProp", "Adadelta", "Lamb", "NAdam", "RAdam", "ASGD", "Rprop", "lr"]

@@ -1,0 +1,338 @@
+"""Concrete optimizers (reference: python/paddle/optimizer/{sgd,momentum,adam,
+adamw,adamax,adagrad,rmsprop,adadelta,lamb}.py; fused CUDA kernels
+paddle/phi/kernels/gpu/adamw_kernel.cu — here the per-param update is a short
+elementwise chain XLA fuses into one HBM pass).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+__all__ = ["SGD", "Momentum", "Adam", "AdamW", "Adamax", "Adagrad", "RMSProp",
+           "Adadelta", "Lamb", "NAdam", "RAdam", "ASGD", "Rprop"]
+
+
+def _f32(v):
+    return v.astype(jnp.float32)
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+
+    def _update(self, p, g, state, lr):
+        return (p - lr * g.astype(p.dtype)).astype(p.dtype), state
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, rescale_grad=1.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _init_state(self, value):
+        return {"velocity": jnp.zeros_like(_f32(value))}
+
+    def _update(self, p, g, state, lr):
+        g32 = _f32(g)
+        v = self._momentum * state["velocity"] + g32
+        if self._nesterov:
+            upd = g32 + self._momentum * v
+        else:
+            upd = v
+        return (p - lr * upd.astype(p.dtype)).astype(p.dtype), {"velocity": v}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, use_multi_tensor=False, amsgrad=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._amsgrad = amsgrad
+
+    def _init_state(self, value):
+        s = {"moment1": jnp.zeros_like(_f32(value)),
+             "moment2": jnp.zeros_like(_f32(value)),
+             "beta1_pow": jnp.ones((), jnp.float32),
+             "beta2_pow": jnp.ones((), jnp.float32)}
+        if getattr(self, "_amsgrad", False):
+            s["moment2_max"] = jnp.zeros_like(_f32(value))
+        return s
+
+    def _adam_core(self, p, g, state, lr, decoupled_wd=0.0):
+        g32 = _f32(g)
+        b1, b2 = self._beta1, self._beta2
+        b1p = state["beta1_pow"] * b1
+        b2p = state["beta2_pow"] * b2
+        m1 = b1 * state["moment1"] + (1 - b1) * g32
+        m2 = b2 * state["moment2"] + (1 - b2) * g32 * g32
+        new = {"moment1": m1, "moment2": m2, "beta1_pow": b1p, "beta2_pow": b2p}
+        m1h = m1 / (1 - b1p)
+        if self._amsgrad:
+            m2max = jnp.maximum(state["moment2_max"], m2)
+            new["moment2_max"] = m2max
+            m2h = m2max / (1 - b2p)
+        else:
+            m2h = m2 / (1 - b2p)
+        p32 = _f32(p)
+        if decoupled_wd:
+            p32 = p32 * (1 - lr * decoupled_wd)
+        out = p32 - lr * m1h / (jnp.sqrt(m2h) + self._eps)
+        return out.astype(p.dtype), new
+
+    def _update(self, p, g, state, lr):
+        return self._adam_core(p, g, state, lr)
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference python/paddle/optimizer/adamw.py)."""
+
+    _decoupled_wd = True
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=0.01, lr_ratio=None,
+                 apply_decay_param_fun=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, amsgrad=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, lazy_mode, multi_precision,
+                         amsgrad=amsgrad, name=name)
+        self._wd = weight_decay
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._weight_decay = None  # handled decoupled
+
+    def _update(self, p, g, state, lr):
+        wd = self._wd if isinstance(self._wd, float) else float(self._wd)
+        return self._adam_core(p, g, state, lr, decoupled_wd=wd)
+
+    def step(self):
+        # per-param decay exemption via apply_decay_param_fun
+        if self._apply_decay_param_fun is None:
+            return super().step()
+        fn = self._apply_decay_param_fun
+        names = self._param_names()
+        real_wd = self._wd
+        lr = self.get_lr()
+        params_grads = [(p, p._grad) for p in self._parameter_list
+                        if p._grad is not None and p.trainable]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        for p, g in params_grads:
+            state = self._get_state(p)
+            wd = real_wd if fn(names[id(p)]) else 0.0
+            new_p, new_state = self._adam_core(p._value, g._value, state, lr,
+                                               decoupled_wd=wd)
+            p._set_value(new_p)
+            self._accumulators[id(p)] = new_state
+        self._global_step += 1
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def _init_state(self, value):
+        return {"moment": jnp.zeros_like(_f32(value)),
+                "inf_norm": jnp.zeros_like(_f32(value)),
+                "beta1_pow": jnp.ones((), jnp.float32)}
+
+    def _update(self, p, g, state, lr):
+        g32 = _f32(g)
+        b1p = state["beta1_pow"] * self._beta1
+        m = self._beta1 * state["moment"] + (1 - self._beta1) * g32
+        u = jnp.maximum(self._beta2 * state["inf_norm"], jnp.abs(g32))
+        out = _f32(p) - (lr / (1 - b1p)) * m / (u + self._eps)
+        return out.astype(p.dtype), {"moment": m, "inf_norm": u, "beta1_pow": b1p}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._eps = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _init_state(self, value):
+        return {"moment": jnp.full_like(_f32(value), self._init_acc)}
+
+    def _update(self, p, g, state, lr):
+        g32 = _f32(g)
+        m = state["moment"] + g32 * g32
+        out = _f32(p) - lr * g32 / (jnp.sqrt(m) + self._eps)
+        return out.astype(p.dtype), {"moment": m}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho, self._eps = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _init_state(self, value):
+        s = {"mean_square": jnp.zeros_like(_f32(value)),
+             "momentum": jnp.zeros_like(_f32(value))}
+        if self._centered:
+            s["mean_grad"] = jnp.zeros_like(_f32(value))
+        return s
+
+    def _update(self, p, g, state, lr):
+        g32 = _f32(g)
+        ms = self._rho * state["mean_square"] + (1 - self._rho) * g32 * g32
+        new = {"mean_square": ms}
+        if self._centered:
+            mg = self._rho * state["mean_grad"] + (1 - self._rho) * g32
+            new["mean_grad"] = mg
+            denom = jnp.sqrt(ms - mg * mg + self._eps)
+        else:
+            denom = jnp.sqrt(ms + self._eps)
+        mom = self._momentum * state["momentum"] + lr * g32 / denom
+        new["momentum"] = mom
+        return (_f32(p) - mom).astype(p.dtype), new
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._eps, self._rho = epsilon, rho
+
+    def _init_state(self, value):
+        return {"avg_squared_grad": jnp.zeros_like(_f32(value)),
+                "avg_squared_update": jnp.zeros_like(_f32(value))}
+
+    def _update(self, p, g, state, lr):
+        g32 = _f32(g)
+        asg = self._rho * state["avg_squared_grad"] + (1 - self._rho) * g32 * g32
+        upd = g32 * jnp.sqrt(state["avg_squared_update"] + self._eps) / jnp.sqrt(asg + self._eps)
+        asu = self._rho * state["avg_squared_update"] + (1 - self._rho) * upd * upd
+        return (_f32(p) - lr * upd).astype(p.dtype), \
+            {"avg_squared_grad": asg, "avg_squared_update": asu}
+
+
+class Lamb(Optimizer):
+    """Layer-wise adaptive moments (reference python/paddle/optimizer/lamb.py)."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._lamb_wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _init_state(self, value):
+        return {"moment1": jnp.zeros_like(_f32(value)),
+                "moment2": jnp.zeros_like(_f32(value)),
+                "beta1_pow": jnp.ones((), jnp.float32),
+                "beta2_pow": jnp.ones((), jnp.float32)}
+
+    def _update(self, p, g, state, lr, wd=None):
+        g32 = _f32(g)
+        b1, b2 = self._beta1, self._beta2
+        b1p = state["beta1_pow"] * b1
+        b2p = state["beta2_pow"] * b2
+        m1 = b1 * state["moment1"] + (1 - b1) * g32
+        m2 = b2 * state["moment2"] + (1 - b2) * g32 * g32
+        m1h = m1 / (1 - b1p)
+        m2h = m2 / (1 - b2p)
+        wd = self._lamb_wd if wd is None else wd
+        r = m1h / (jnp.sqrt(m2h) + self._eps) + wd * _f32(p)
+        w_norm = jnp.linalg.norm(_f32(p))
+        r_norm = jnp.linalg.norm(r)
+        ratio = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        out = _f32(p) - lr * ratio * r
+        return out.astype(p.dtype), {"moment1": m1, "moment2": m2,
+                                     "beta1_pow": b1p, "beta2_pow": b2p}
+
+
+class NAdam(Adam):
+    def _update(self, p, g, state, lr):
+        g32 = _f32(g)
+        b1, b2 = self._beta1, self._beta2
+        b1p = state["beta1_pow"] * b1
+        b2p = state["beta2_pow"] * b2
+        m1 = b1 * state["moment1"] + (1 - b1) * g32
+        m2 = b2 * state["moment2"] + (1 - b2) * g32 * g32
+        m1h = (b1 * m1 + (1 - b1) * g32) / (1 - b1p * b1)
+        m2h = m2 / (1 - b2p)
+        out = _f32(p) - lr * m1h / (jnp.sqrt(m2h) + self._eps)
+        return out.astype(p.dtype), {"moment1": m1, "moment2": m2,
+                                     "beta1_pow": b1p, "beta2_pow": b2p}
+
+
+class RAdam(Adam):
+    def _update(self, p, g, state, lr):
+        g32 = _f32(g)
+        b1, b2 = self._beta1, self._beta2
+        b1p = state["beta1_pow"] * b1
+        b2p = state["beta2_pow"] * b2
+        t = jnp.log(b2p) / jnp.log(b2)  # step count
+        m1 = b1 * state["moment1"] + (1 - b1) * g32
+        m2 = b2 * state["moment2"] + (1 - b2) * g32 * g32
+        m1h = m1 / (1 - b1p)
+        rho_inf = 2.0 / (1 - b2) - 1
+        rho_t = rho_inf - 2 * t * b2p / (1 - b2p)
+        def rect(_):
+            r = jnp.sqrt(((rho_t - 4) * (rho_t - 2) * rho_inf) /
+                         ((rho_inf - 4) * (rho_inf - 2) * rho_t))
+            return r * m1h / (jnp.sqrt(m2 / (1 - b2p)) + self._eps)
+        def norect(_):
+            return m1h
+        upd = jnp.where(rho_t > 5.0, rect(None), norect(None))
+        out = _f32(p) - lr * upd
+        return out.astype(p.dtype), {"moment1": m1, "moment2": m2,
+                                     "beta1_pow": b1p, "beta2_pow": b2p}
+
+
+class ASGD(Optimizer):
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._batch_num = batch_num
+
+    def _init_state(self, value):
+        return {"d": jnp.zeros_like(_f32(value)),
+                "ys": jnp.zeros((self._batch_num,) + value.shape, jnp.float32),
+                "idx": jnp.zeros((), jnp.int32)}
+
+    def _update(self, p, g, state, lr):
+        g32 = _f32(g)
+        i = state["idx"] % self._batch_num
+        old_y = state["ys"][i]
+        d = state["d"] - old_y + g32
+        ys = state["ys"].at[i].set(g32)
+        out = _f32(p) - lr * d / self._batch_num
+        return out.astype(p.dtype), {"d": d, "ys": ys, "idx": state["idx"] + 1}
+
+
+class Rprop(Optimizer):
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50.0),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._lr_range = learning_rate_range
+        self._etas = etas
+
+    def _init_state(self, value):
+        return {"prev_grad": jnp.zeros_like(_f32(value)),
+                "lrs": jnp.full_like(_f32(value), float(self._learning_rate)
+                                     if not callable(self._learning_rate) else 1e-2)}
+
+    def _update(self, p, g, state, lr):
+        g32 = _f32(g)
+        sign = jnp.sign(g32 * state["prev_grad"])
+        eta_m, eta_p = self._etas
+        factor = jnp.where(sign > 0, eta_p, jnp.where(sign < 0, eta_m, 1.0))
+        lrs = jnp.clip(state["lrs"] * factor, self._lr_range[0], self._lr_range[1])
+        g_eff = jnp.where(sign < 0, 0.0, g32)
+        out = _f32(p) - lrs * jnp.sign(g_eff)
+        return out.astype(p.dtype), {"prev_grad": g_eff, "lrs": lrs}

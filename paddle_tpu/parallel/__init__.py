@@ -1,0 +1,18 @@
+"""Compiled training machinery — the performance path.
+
+This is the TPU-native replacement for the reference's executor stack
+(SURVEY.md §3.3): instead of an instruction interpreter, the WHOLE train step
+(forward + backward + optimizer + collectives) is one jitted, buffer-donated
+XLA program. Parallelism is expressed as shardings on the step's inputs:
+
+* dp     — batch sharded over 'dp' (grad all-reduce emitted by XLA)
+* ZeRO   — optimizer state / grads / params sharded over 'sharding'
+* tp/sp  — layer-level sharding constraints (fleet.meta_parallel.mp_layers)
+* pp     — stage-stacked params + ppermute microbatch schedule (pipeline.py)
+"""
+from __future__ import annotations
+
+from .train_step import TrainStep, compile_train_step
+from .pipeline import PipelineTrainStep
+
+__all__ = ["TrainStep", "compile_train_step", "PipelineTrainStep"]

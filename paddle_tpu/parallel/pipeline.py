@@ -1,0 +1,250 @@
+"""Compiled SPMD pipeline parallelism over the 'pp' mesh axis.
+
+Reference: fleet/meta_parallel/pipeline_parallel.py (1F1B :242) + P2P helper
+(p2p_communication.py:651) + zero-bubble schedule pass
+(pipeline_zero_bubble.py:62).
+
+TPU-native design: XLA is a static-graph world, so the schedule is a
+differentiable program — a `lax.scan` over ticks where every stage computes
+its microbatch and hands activations to the next stage with `lax.ppermute`
+(ICI neighbor hop). `jax.grad` through the scan yields the reverse schedule
+automatically (backward ppermutes run opposite the ring), which XLA overlaps
+with compute. This is the GPipe/1F1B-equivalent steady-state with the same
+bubble fraction (n_stages-1)/(n_micro+n_stages-1).
+
+The model is expressed in three functional pieces (the LayerDesc segmentation
+analog for the common LM case):
+  embed_apply(embed_params, batch)        -> activations  (runs on stage 0)
+  block_apply(one_block_params, act)      -> activations  (layers_per_stage per stage)
+  head_loss_apply(head_params, act, batch)-> scalar loss  (runs on last stage)
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor
+
+__all__ = ["spmd_pipeline", "PipelineTrainStep"]
+
+
+def spmd_pipeline(block_fn, stage_params, x, n_micro: int, axis: str = "pp",
+                  varying_axes=("dp", "pp", "mp")):
+    """Run x ([n_micro, mbs, ...]) through n_stages stages connected in a ring.
+
+    Must be called inside shard_map with `axis` in scope; `stage_params` are
+    this stage's parameters. block_fn(stage_params, act) -> act.
+    Returns [n_micro, mbs, ...] outputs (valid on the LAST stage).
+    """
+    n = jax.lax.psum(1, axis)
+    r = jax.lax.axis_index(axis)
+    mb_shape = x.shape[1:]
+    total = n_micro + n - 1
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    state = jnp.zeros(mb_shape, x.dtype)      # incoming activation
+    outputs = jnp.zeros((n_micro,) + mb_shape, x.dtype)
+    # mark carries as axis-varying so scan carry typing matches per-shard values
+    va = _axes_in_scope(varying_axes)
+    if va:
+        state = jax.lax.pcast(state, va, to="varying")
+        outputs = jax.lax.pcast(outputs, va, to="varying")
+
+    def tick(carry, t):
+        state, outputs = carry
+        # stage 0 ingests microbatch t (clamped); later stages consume `state`
+        inp = jnp.where(r == 0, x[jnp.minimum(t, n_micro - 1)], state)
+        out = block_fn(stage_params, inp)
+        # last stage records its result for microbatch (t - (n-1))
+        idx = t - (n - 1)
+        write = (r == n - 1) & (idx >= 0)
+        updated = outputs.at[jnp.clip(idx, 0, n_micro - 1)].set(out)
+        outputs = jnp.where(write, updated, outputs)
+        # rotate activations around the ring
+        state = jax.lax.ppermute(out, axis, perm)
+        return (state, outputs), None
+
+    (state, outputs), _ = jax.lax.scan(tick, (state, outputs), jnp.arange(total))
+    return outputs
+
+
+def _axes_in_scope(names):
+    out = []
+    for n in names:
+        try:
+            jax.lax.axis_index(n)
+            out.append(n)
+        except Exception:
+            pass
+    return tuple(out)
+
+
+class PipelineTrainStep:
+    """Hybrid dp×pp(×mp via constraints) compiled train step for LM-shaped
+    models. Parameters:
+
+      embed_params: pytree (replicated over pp; used on stage 0)
+      block_params: pytree with leading dim L = n_pp * layers_per_stage,
+                    sharded over 'pp' on that dim
+      head_params:  pytree (used on last stage)
+
+    The step scans layers_per_stage blocks inside each pipeline stage.
+    """
+
+    def __init__(self, mesh: Mesh, embed_apply, block_apply, head_loss_apply,
+                 embed_params, block_params, head_params, optimizer,
+                 n_micro: int, batch_spec=P("dp"), donate=True):
+        self.mesh = mesh
+        self.n_micro = n_micro
+        self.embed_apply = embed_apply
+        self.block_apply = block_apply
+        self.head_loss_apply = head_loss_apply
+        self.opt = optimizer
+
+        n_pp = mesh.shape.get("pp", 1)
+        self.n_pp = n_pp
+
+        def place(tree, spec_fn):
+            return jax.tree_util.tree_map(
+                lambda v: jax.device_put(v, NamedSharding(mesh, spec_fn(v))), tree)
+
+        rep = lambda v: P(*([None] * v.ndim))
+        stacked = lambda v: P(*(["pp"] + [None] * (v.ndim - 1)))
+        self.embed_params = place(embed_params, rep)
+        self.block_params = place(block_params, stacked)
+        self.head_params = place(head_params, rep)
+        self.opt_state = {
+            "embed": self.opt.init_opt_state(_flatten(self.embed_params)),
+            "block": self.opt.init_opt_state(_flatten(self.block_params)),
+            "head": self.opt.init_opt_state(_flatten(self.head_params)),
+        }
+        # keep opt state co-sharded with params
+        self.opt_state = jax.tree_util.tree_map(lambda v: v, self.opt_state)
+
+        from jax import shard_map
+
+        blk_spec = jax.tree_util.tree_map(lambda v: P(*(["pp"] + [None] * (v.ndim - 1))),
+                                          self.block_params)
+        rep_spec_e = jax.tree_util.tree_map(lambda v: P(*([None] * v.ndim)),
+                                            self.embed_params)
+        rep_spec_h = jax.tree_util.tree_map(lambda v: P(*([None] * v.ndim)),
+                                            self.head_params)
+
+        def loss_fn(embed_p, block_p, head_p, batch):
+            # inside shard_map: block_p leading dim = layers_per_stage
+            x = self.embed_apply(embed_p, batch)           # [n_micro, mbs, ...]
+            def stage(bp, act):
+                def one(act, layer_p):
+                    return self.block_apply(layer_p, act), None
+                out, _ = jax.lax.scan(lambda a, p: one(a, p), act, bp)
+                return out
+            y = spmd_pipeline(stage, block_p, x, self.n_micro)
+            loss = self.head_loss_apply(head_p, y, batch)  # valid on last stage
+            n = jax.lax.psum(1, "pp")
+            r = jax.lax.axis_index("pp")
+            loss = jnp.where(r == n - 1, loss, 0.0)
+            loss = jax.lax.psum(loss, "pp")                # broadcast last-stage loss
+            for ax in mesh.axis_names:
+                if ax != "pp":
+                    loss = jax.lax.pmean(loss, ax)
+            return loss
+
+        def grad_step(embed_p, block_p, head_p, eo, bo, ho, lr, batch):
+            loss, g = jax.value_and_grad(loss_fn, argnums=(0, 1, 2))(
+                embed_p, block_p, head_p, batch)
+            ge, gb, gh = g
+            # embed/head grads live on their owning stage only → share over pp
+            # (the broadcast_*_parameters analog, done on grads)
+            ge, gh = jax.tree_util.tree_map(
+                lambda v: jax.lax.psum(v, "pp"), (ge, gh))
+            # dp gradient sync (XLA fuses/overlaps with backward)
+            if "dp" in mesh.axis_names and mesh.shape["dp"] > 1:
+                ge, gb, gh = jax.tree_util.tree_map(
+                    lambda v: jax.lax.pmean(v, "dp"), (ge, gb, gh))
+            # mp axis unused by the scalar program here: grads already equal;
+            # pmean makes replication explicit for the partitioner
+            if "mp" in mesh.axis_names and mesh.shape["mp"] > 1:
+                ge, gb, gh = jax.tree_util.tree_map(
+                    lambda v: jax.lax.pmean(v, "mp"), (ge, gb, gh))
+            ne, neo = self.opt.apply_gradients_functional(
+                _flatten(embed_p), _flatten(ge), eo, lr=lr)
+            nb, nbo = self.opt.apply_gradients_functional(
+                _flatten(block_p), _flatten(gb), bo, lr=lr)
+            nh, nho = self.opt.apply_gradients_functional(
+                _flatten(head_p), _flatten(gh), ho, lr=lr)
+            return (_unflatten(ne, embed_p), _unflatten(nb, block_p),
+                    _unflatten(nh, head_p), neo, nbo, nho, loss)
+
+        batch_in_spec = batch_spec
+        state_spec_e = rep_spec_e
+        opt_spec = lambda ps: jax.tree_util.tree_map(lambda v: P(*([None] * v.ndim)), ps)
+
+        sm = shard_map(
+            grad_step, mesh=mesh,
+            in_specs=(rep_spec_e, blk_spec, rep_spec_h,
+                      _opt_specs(self.opt_state["embed"], None),
+                      _opt_specs(self.opt_state["block"], "pp"),
+                      _opt_specs(self.opt_state["head"], None),
+                      P(), batch_in_spec),
+            out_specs=(rep_spec_e, blk_spec, rep_spec_h,
+                       _opt_specs(self.opt_state["embed"], None),
+                       _opt_specs(self.opt_state["block"], "pp"),
+                       _opt_specs(self.opt_state["head"], None),
+                       P()))
+        donate_args = tuple(range(6)) if donate else ()
+        self._step = jax.jit(sm, donate_argnums=donate_args)
+
+    def __call__(self, batch):
+        v = jax.tree_util.tree_map(
+            lambda b: b._value if isinstance(b, Tensor) else jnp.asarray(b), batch,
+            is_leaf=lambda x: isinstance(x, Tensor))
+        lr = jnp.asarray(self.opt.get_lr(), jnp.float32)
+        (self.embed_params, self.block_params, self.head_params,
+         self.opt_state["embed"], self.opt_state["block"], self.opt_state["head"],
+         loss) = self._step(self.embed_params, self.block_params, self.head_params,
+                            self.opt_state["embed"], self.opt_state["block"],
+                            self.opt_state["head"], lr, v)
+        return Tensor(loss)
+
+
+def _flatten(tree, prefix=""):
+    out = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            key = f"{prefix}.{k}" if prefix else str(k)
+            if isinstance(v, dict):
+                out.update(_flatten(v, key))
+            else:
+                out[key] = v
+        return out
+    leaves, _ = jax.tree_util.tree_flatten(tree)
+    return {f"{prefix}.{i}" if prefix else str(i): l for i, l in enumerate(leaves)}
+
+
+def _unflatten(flat, like):
+    if isinstance(like, dict):
+        out = {}
+        for k, v in like.items():
+            if isinstance(v, dict):
+                sub = {kk[len(str(k)) + 1:]: vv for kk, vv in flat.items()
+                       if kk.startswith(f"{k}.")}
+                out[k] = _unflatten(sub, v)
+            else:
+                out[k] = flat[str(k)]
+        return out
+    leaves, treedef = jax.tree_util.tree_flatten(like)
+    return jax.tree_util.tree_unflatten(
+        treedef, [flat[str(i)] for i in range(len(leaves))])
+
+
+def _opt_specs(opt_state, stack_axis):
+    def spec(v):
+        nd = getattr(v, "ndim", 0)
+        if stack_axis and nd >= 1:
+            return P(*([stack_axis] + [None] * (nd - 1)))
+        return P(*([None] * nd))
+    return jax.tree_util.tree_map(spec, opt_state)

@@ -1,0 +1,112 @@
+"""Fused train step: one jit = fwd + bwd + optimizer update.
+
+The analog of the reference's fused-kernel + interpreter hot loop
+(SURVEY.md §3.1-3.2): Paddle pays per-op dispatch in C++; here the per-op
+Python dispatch happens once at trace time and the steady-state loop is a
+single XLA executable with donated buffers (params/opt-state update in place
+in HBM).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core import random as random_mod
+from ..nn.layer import Layer, functional_state
+from ..optimizer.optimizer import Optimizer
+from ..optimizer.lr import LRScheduler
+
+__all__ = ["TrainStep", "compile_train_step"]
+
+
+class TrainStep:
+    """Holds functional state (params, buffers, opt state) and a compiled
+    step(batch) -> loss. Mutates the Layer's tensors only on `sync_to_model`.
+    """
+
+    def __init__(self, model: Layer, opt: Optimizer, loss_fn: Callable,
+                 donate: bool = True, in_shardings=None, with_amp=False,
+                 amp_dtype="bfloat16", grad_accum: int = 1):
+        self.model = model
+        self.opt = opt
+        self.loss_fn = loss_fn
+        self.with_amp = with_amp
+        self.amp_dtype = amp_dtype
+        self.params = {n: p._value for n, p in model.named_parameters()
+                       if not p.stop_gradient}
+        self._lr_scales = {
+            n: float(p.optimize_attr.get("learning_rate", 1.0))
+            for n, p in model.named_parameters()
+            if hasattr(p, "optimize_attr")
+            and p.optimize_attr.get("learning_rate", 1.0) != 1.0}
+        self.frozen = {n: p._value for n, p in model.named_parameters()
+                       if p.stop_gradient}
+        self.buffers = {n: b._value for n, b in model.named_buffers()}
+        self.opt_state = opt.init_opt_state(self.params)
+        self._rng = random_mod.split_key()
+
+        donate_args = (0, 1, 2) if donate else ()
+        self._step = jax.jit(self._step_impl, donate_argnums=donate_args)
+
+    # pure: (params, opt_state, buffers, rng, lr, *batch) -> (loss, ...)
+    def _step_impl(self, params, opt_state, buffers, rng, lr, *batch):
+        def loss_of(p):
+            state = {}
+            state.update(p)
+            state.update(self.frozen)
+            state.update(buffers)
+            with random_mod.trace_rng(rng):
+                if self.with_amp:
+                    from ..amp import auto_cast
+                    ctx = auto_cast(dtype=self.amp_dtype)
+                else:
+                    import contextlib
+                    ctx = contextlib.nullcontext()
+                with ctx, functional_state(self.model, state) as fs:
+                    batch_t = [Tensor(b) for b in batch]
+                    loss = self.loss_fn(self.model, *batch_t)
+                    new_state = fs.collect()
+            new_buffers = {k: new_state[k] for k in buffers}
+            lv = loss._value if isinstance(loss, Tensor) else loss
+            return lv, new_buffers
+
+        (loss_v, new_buffers), grads = jax.value_and_grad(loss_of, has_aux=True)(params)
+        new_params, new_opt = self.opt.apply_gradients_functional(
+            params, grads, opt_state, lr=lr, lr_scales=self._lr_scales or None)
+        return new_params, new_opt, new_buffers, loss_v
+
+    def __call__(self, *batch):
+        vals = [b._value if isinstance(b, Tensor) else jnp.asarray(b) for b in batch]
+        self._rng, sub = jax.random.split(self._rng)
+        lr = jnp.asarray(self.opt.get_lr(), jnp.float32)
+        self.params, self.opt_state, self.buffers, loss = self._step(
+            self.params, self.opt_state, self.buffers, sub, lr, *vals)
+        if isinstance(self.opt._learning_rate, LRScheduler):
+            self.opt._learning_rate.step()
+        self.opt._global_step += 1
+        return Tensor(loss)
+
+    def sync_to_model(self):
+        """Write the functional state back into the Layer/Optimizer objects
+        (checkpointing, eval interop)."""
+        targets = dict(self.model.named_parameters())
+        for n, v in self.params.items():
+            if n in targets:
+                targets[n]._set_value(v)
+        btargets = dict(self.model.named_buffers())
+        for n, v in self.buffers.items():
+            if n in btargets:
+                btargets[n]._set_value(v)
+        names = {n: p for n, p in self.model.named_parameters()}
+        for n, st in self.opt_state.items():
+            p = names.get(n)
+            if p is not None:
+                self.opt._accumulators[id(p)] = dict(st)
+
+
+def compile_train_step(model, opt, loss_fn, **kw) -> TrainStep:
+    """loss_fn(model, *batch_tensors) -> scalar Tensor."""
+    return TrainStep(model, opt, loss_fn, **kw)

@@ -1,0 +1,239 @@
+"""Profiler (reference: python/paddle/profiler/ — Profiler profiler.py:358
+with scheduler states, RecordEvent event_tracing.h, timer.py throughput).
+
+TPU-native: device tracing delegates to jax.profiler (XPlane → TensorBoard /
+perfetto, the CUPTI-chrome-trace analog); host annotations map RecordEvent →
+jax.profiler.TraceAnnotation + named_scope so they appear in the same trace.
+The benchmark `Timer` reproduces timer.py's ips accounting (used by bench.py).
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from enum import Enum
+from typing import Callable, Iterable, Optional
+
+import jax
+
+__all__ = ["Profiler", "ProfilerTarget", "ProfilerState", "RecordEvent",
+           "make_scheduler", "export_chrome_tracing", "load_profiler_result",
+           "benchmark", "Timer", "SummaryView"]
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    GPU = 1
+    TPU = 2
+    CUSTOM_DEVICE = 3
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+def make_scheduler(closed=0, ready=0, record=1, repeat=0, skip_first=0):
+    """reference scheduler_fn: maps step -> ProfilerState."""
+    period = closed + ready + record
+
+    def scheduler(step):
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat and s >= repeat * period:
+            return ProfilerState.CLOSED
+        pos = s % period
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == period - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+    return scheduler
+
+
+class RecordEvent:
+    """Host annotation (reference phi/api/profiler/event_tracing.h RecordEvent)."""
+
+    def __init__(self, name: str, event_type=None):
+        self.name = name
+        self._ctx = None
+
+    def begin(self):
+        self._ctx = jax.profiler.TraceAnnotation(self.name)
+        self._ctx.__enter__()
+
+    def end(self):
+        if self._ctx is not None:
+            self._ctx.__exit__(None, None, None)
+            self._ctx = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+class Profiler:
+    """reference profiler.py:358."""
+
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
+                 timer_only=False, record_shapes=False, profile_memory=False,
+                 with_flops=False, emit_nvtx=False):
+        self._scheduler = scheduler if callable(scheduler) else (
+            make_scheduler(record=scheduler[1] - scheduler[0], closed=scheduler[0])
+            if isinstance(scheduler, (tuple, list)) else (lambda step: ProfilerState.RECORD))
+        self._on_trace_ready = on_trace_ready
+        self._timer_only = timer_only
+        self._step = 0
+        self._dir = "/tmp/paddle_tpu_profile"
+        self._active = False
+        self.timer = Timer()
+
+    def start(self):
+        self.timer.begin()
+        if self._timer_only:
+            return
+        state = self._scheduler(self._step)
+        if state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN):
+            jax.profiler.start_trace(self._dir)
+            self._active = True
+
+    def stop(self):
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+            if self._on_trace_ready:
+                self._on_trace_ready(self)
+
+    def step(self, num_samples=None):
+        self.timer.step(num_samples)
+        if self._timer_only:
+            self._step += 1
+            return
+        prev = self._scheduler(self._step)
+        self._step += 1
+        cur = self._scheduler(self._step)
+        if prev in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN) and \
+                cur in (ProfilerState.CLOSED, ProfilerState.READY):
+            if self._active:
+                jax.profiler.stop_trace()
+                self._active = False
+        elif cur in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN) and \
+                not self._active:
+            jax.profiler.start_trace(self._dir)
+            self._active = True
+
+    def step_info(self, unit="samples"):
+        return self.timer.step_info(unit)
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms", views=None):
+        return "profiler summary: see TensorBoard XPlane trace at " + self._dir
+
+    def export(self, path, format="json"):
+        pass
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+class SummaryView(Enum):
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+    MemoryManipulationView = 7
+    UDFView = 8
+
+
+def export_chrome_tracing(dir_name, worker_name=None):
+    def handler(prof):
+        prof._dir = dir_name
+    return handler
+
+
+def load_profiler_result(path):
+    return None
+
+
+class Timer:
+    """Throughput benchmark (reference python/paddle/profiler/timer.py):
+    tracks step latency + ips with warmup skipping."""
+
+    def __init__(self, skip_steps=10):
+        self.skip = skip_steps
+        self.reset()
+
+    def reset(self):
+        self._count = 0
+        self._total_time = 0.0
+        self._total_samples = 0
+        self._last = None
+        self._step_time = 0.0
+
+    def begin(self):
+        self._last = time.perf_counter()
+
+    def step(self, num_samples=None):
+        now = time.perf_counter()
+        if self._last is not None:
+            dt = now - self._last
+            self._step_time = dt
+            self._count += 1
+            if self._count > self.skip:
+                self._total_time += dt
+                if num_samples:
+                    self._total_samples += num_samples
+        self._last = now
+
+    @property
+    def ips(self):
+        if self._total_time <= 0:
+            return 0.0
+        n = self._count - self.skip
+        if self._total_samples:
+            return self._total_samples / self._total_time
+        return n / self._total_time
+
+    @property
+    def avg_step_time(self):
+        n = max(self._count - self.skip, 1)
+        return self._total_time / n if self._total_time else self._step_time
+
+    def step_info(self, unit="samples"):
+        return (f"avg_step_time: {self.avg_step_time * 1000:.2f} ms, "
+                f"ips: {self.ips:.2f} {unit}/s")
+
+
+class benchmark:
+    """`paddle.profiler.benchmark()` style helper."""
+
+    def __init__(self):
+        self.timer = Timer()
+
+    def begin(self):
+        self.timer.begin()
+
+    def step(self, num_samples=None):
+        self.timer.step(num_samples)
+
+    def end(self):
+        pass
+
+    def step_info(self, unit="samples"):
+        return self.timer.step_info(unit)

@@ -1,0 +1,76 @@
+"""Quantization (reference: python/paddle/quantization/ — QAT/PTQ factories,
+observers). Initial TPU surface: fake-quant ops (int8/fp8 simulated) +
+QuantConfig/QAT wrappers; native fp8 matmul lands with the Pallas quant
+kernels (pallas_guide 'Quantization Kernels' pattern).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import op_call
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+
+__all__ = ["QuantConfig", "QAT", "PTQ", "quanter", "fake_quant_abs_max"]
+
+
+def fake_quant_abs_max(x, bit_length=8):
+    def impl(v):
+        qmax = 2.0 ** (bit_length - 1) - 1
+        scale = jnp.max(jnp.abs(v)) / qmax
+        q = jnp.round(v / jnp.maximum(scale, 1e-8))
+        q = jnp.clip(q, -qmax - 1, qmax)
+        deq = q * scale
+        # straight-through estimator
+        return v + jax.lax.stop_gradient(deq - v)
+    return op_call("fake_quant_abs_max", impl, x)
+
+
+class QuantConfig:
+    def __init__(self, activation=None, weight=None):
+        self.activation = activation
+        self.weight = weight
+        self._layer_configs = {}
+
+    def add_layer_config(self, layer, activation=None, weight=None):
+        self._layer_configs[id(layer)] = (activation, weight)
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        self._layer_configs[layer_type] = (activation, weight)
+
+
+def quanter(name=None, **kwargs):
+    def deco(cls):
+        return cls
+    return deco
+
+
+class _FakeQuantLinearHook:
+    def __init__(self, bits=8):
+        self.bits = bits
+
+    def __call__(self, layer, inputs):
+        return tuple(fake_quant_abs_max(i, self.bits) if isinstance(i, Tensor) else i
+                     for i in inputs)
+
+
+class QAT:
+    """Quantization-aware training: wraps Linear/Conv with fake-quant hooks."""
+
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model: Layer, inplace=False):
+        from ..nn import Linear, Conv2D
+        for sub in model.sublayers(include_self=True):
+            if isinstance(sub, (Linear, Conv2D)):
+                sub.register_forward_pre_hook(_FakeQuantLinearHook())
+        return model
+
+    def convert(self, model: Layer, inplace=False):
+        return model
+
+
+class PTQ(QAT):
+    pass

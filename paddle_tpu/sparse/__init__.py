@@ -1,0 +1,113 @@
+"""Sparse tensors (reference: python/paddle/sparse/ — COO/CSR API over
+SparseCooTensor/SparseCsrTensor, paddle/phi/core/sparse_*_tensor.h).
+
+TPU-native: backed by jax.experimental.sparse.BCOO (XLA-lowered sparse ops).
+CSR round-trips through BCOO (TPU kernels are COO-oriented).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from ..core.tensor import Tensor
+
+__all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
+           "is_same_shape", "add", "matmul", "masked_matmul", "nn"]
+
+
+class SparseCooTensor(Tensor):
+    """Tensor whose value is a BCOO; dense ops densify on demand."""
+
+    def __init__(self, bcoo, stop_gradient=True):
+        super().__init__(bcoo.todense(), stop_gradient=stop_gradient)
+        self._bcoo = bcoo
+
+    def indices(self):
+        return Tensor(self._bcoo.indices.T)
+
+    def values(self):
+        return Tensor(self._bcoo.data)
+
+    def to_dense(self):
+        return Tensor(self._bcoo.todense(), stop_gradient=self.stop_gradient)
+
+    def is_sparse(self):
+        return True
+
+    def is_sparse_coo(self):
+        return True
+
+    @property
+    def nnz(self):
+        return self._bcoo.nse
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True):
+    idx = indices._value if isinstance(indices, Tensor) else jnp.asarray(indices)
+    val = values._value if isinstance(values, Tensor) else jnp.asarray(values)
+    bcoo = jsparse.BCOO((val, idx.T.astype(jnp.int32)),
+                        shape=tuple(shape) if shape else None)
+    return SparseCooTensor(bcoo, stop_gradient=stop_gradient)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True):
+    crows_v = np.asarray(crows._value if isinstance(crows, Tensor) else crows)
+    cols_v = np.asarray(cols._value if isinstance(cols, Tensor) else cols)
+    vals_v = np.asarray(values._value if isinstance(values, Tensor) else values)
+    rows = np.repeat(np.arange(len(crows_v) - 1), np.diff(crows_v))
+    idx = np.stack([rows, cols_v])
+    return sparse_coo_tensor(idx, vals_v, shape, dtype, place, stop_gradient)
+
+
+def is_same_shape(x, y):
+    return tuple(x.shape) == tuple(y.shape)
+
+
+def add(x, y, name=None):
+    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+        out = jsparse.bcoo_add_batch_dim if False else None
+        s = jsparse.BCOO.sum_duplicates(
+            jsparse.BCOO((jnp.concatenate([x._bcoo.data, y._bcoo.data]),
+                          jnp.concatenate([x._bcoo.indices, y._bcoo.indices])),
+                         shape=x._bcoo.shape))
+        return SparseCooTensor(s)
+    from ..tensor.math import add as dense_add
+    return dense_add(x if not isinstance(x, SparseCooTensor) else x.to_dense(),
+                     y if not isinstance(y, SparseCooTensor) else y.to_dense())
+
+
+def matmul(x, y, name=None):
+    if isinstance(x, SparseCooTensor):
+        yv = y._value if isinstance(y, Tensor) else jnp.asarray(y)
+        return Tensor(x._bcoo @ yv)
+    from ..tensor.linalg import matmul as dense_mm
+    return dense_mm(x, y)
+
+
+def masked_matmul(x, y, mask, name=None):
+    from ..tensor.linalg import matmul as dense_mm
+    dense = dense_mm(x, y)
+    m = mask
+    if isinstance(m, SparseCooTensor):
+        out = jsparse.BCOO.fromdense(dense._value * (m._bcoo.todense() != 0))
+        return SparseCooTensor(out)
+    return dense
+
+
+class _SparseNN:
+    """paddle.sparse.nn subset (ReLU on sparse values)."""
+
+    @staticmethod
+    def relu(x):
+        if isinstance(x, SparseCooTensor):
+            return SparseCooTensor(jsparse.BCOO(
+                (jax.nn.relu(x._bcoo.data), x._bcoo.indices), shape=x._bcoo.shape))
+        from ..nn.functional import relu as dense_relu
+        return dense_relu(x)
+
+
+nn = _SparseNN()

@@ -1,0 +1,70 @@
+"""paddle.static parity (reference: python/paddle/static/).
+
+TPU-native collapse: the static graph IS the jaxpr/StableHLO that jax.jit
+traces (SURVEY.md L4b→XLA). This namespace keeps the user-facing pieces that
+still matter: InputSpec, structured control flow (lax-backed cond/while_loop —
+the controlflow-ops analog), and save/load_inference_model delegating to
+jit.save/load.
+"""
+from __future__ import annotations
+
+from .input_spec import InputSpec
+from . import nn
+
+__all__ = ["InputSpec", "nn", "save_inference_model", "load_inference_model",
+           "Program", "program_guard", "default_main_program",
+           "default_startup_program", "gradients"]
+
+
+class Program:
+    """Shim: programs are traced jaxprs; kept for scripts that construct
+    Program() handles."""
+
+    def __init__(self):
+        self.random_seed = 0
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        return Program()
+
+
+_main = Program()
+_startup = Program()
+
+
+def default_main_program():
+    return _main
+
+
+def default_startup_program():
+    return _startup
+
+
+class program_guard:
+    def __init__(self, main_program=None, startup_program=None):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    raise NotImplementedError(
+        "On the TPU backend use paddle_tpu.jit.save(layer, path, input_spec) — "
+        "the StableHLO export is the inference model artifact.")
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    from ..jit import load as jit_load
+    return jit_load(path_prefix)
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    from ..core.autograd import grad
+    return grad(targets, inputs, grad_outputs=target_gradients, allow_unused=True)

@@ -1,0 +1,89 @@
+"""Structured control flow (reference: paddle.static.nn.cond/while_loop backed
+by paddle/fluid/operators/controlflow/). TPU-native: lax.cond / lax.while_loop
+/ lax.scan — jit-compatible data-dependent control flow.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dispatch import op_call
+
+__all__ = ["cond", "while_loop", "case", "switch_case", "scan"]
+
+
+def _wrap_tree(tree):
+    return jax.tree_util.tree_map(
+        lambda v: Tensor(v) if not isinstance(v, Tensor) else v, tree)
+
+
+def _unwrap_tree(tree):
+    return jax.tree_util.tree_map(
+        lambda t: t._value if isinstance(t, Tensor) else t, tree,
+        is_leaf=lambda x: isinstance(x, Tensor))
+
+
+def cond(pred, true_fn, false_fn, name=None):
+    p = pred._value if isinstance(pred, Tensor) else pred
+    def impl(pv):
+        def tf(_):
+            return _unwrap_tree(true_fn())
+        def ff(_):
+            return _unwrap_tree(false_fn())
+        return jax.lax.cond(jnp.asarray(pv).astype(bool).reshape(()), tf, ff, 0)
+    out = impl(p)
+    return _wrap_tree(out)
+
+
+def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
+    init = _unwrap_tree(list(loop_vars))
+    def c(vals):
+        out = cond_fn(*_wrap_tree(vals))
+        return (out._value if isinstance(out, Tensor) else out).reshape(()).astype(bool)
+    def b(vals):
+        out = body_fn(*_wrap_tree(vals))
+        return _unwrap_tree(list(out))
+    final = jax.lax.while_loop(c, b, init)
+    return _wrap_tree(final)
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    for pred, fn in pred_fn_pairs:
+        p = bool(pred._value) if isinstance(pred, Tensor) and not isinstance(
+            pred._value, jax.core.Tracer) else None
+        if p is True:
+            return fn()
+        if p is None:
+            # traced: chain lax.cond
+            rest = pred_fn_pairs[pred_fn_pairs.index((pred, fn)) + 1:]
+            nxt = (lambda: case(rest, default)) if (rest or default) else fn
+            return cond(pred, fn, nxt if rest or default else fn)
+    if default is not None:
+        return default()
+    raise ValueError("no branch taken and no default")
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    idx = branch_index._value if isinstance(branch_index, Tensor) else branch_index
+    fns = dict(branch_fns) if not isinstance(branch_fns, dict) else branch_fns
+    keys = sorted(fns)
+    def impl(iv):
+        branches = [lambda _, f=fns[k]: _unwrap_tree(f()) for k in keys]
+        if default is not None:
+            branches.append(lambda _, f=default: _unwrap_tree(f()))
+        sel = jnp.searchsorted(jnp.asarray(keys), iv.reshape(()).astype(jnp.int32))
+        ok = jnp.isin(iv.reshape(()).astype(jnp.int32), jnp.asarray(keys))
+        which = jnp.where(ok, sel, len(keys) if default is not None else 0)
+        return jax.lax.switch(jnp.clip(which, 0, len(branches) - 1), branches, 0)
+    return _wrap_tree(impl(jnp.asarray(idx)))
+
+
+def scan(f, init, xs, length=None, reverse=False, unroll=1):
+    """lax.scan exposed at the framework level (the fused-RNN building block)."""
+    def body(carry, x):
+        c, y = f(_wrap_tree(carry), _wrap_tree(x))
+        return _unwrap_tree(c), _unwrap_tree(y)
+    carry, ys = jax.lax.scan(body, _unwrap_tree(init), _unwrap_tree(xs),
+                             length=length, reverse=reverse, unroll=unroll)
+    return _wrap_tree(carry), _wrap_tree(ys)

@@ -1,0 +1,199 @@
+"""Functional tensor ops + Tensor method patching.
+
+Mirrors the reference's monkey-patching of math methods onto Tensor
+(python/paddle/tensor/__init__.py + pybind eager_method.cc): every public
+functional op whose first parameter is a tensor is attached as a Tensor
+method, and the arithmetic dunders route through dispatch so they record on
+the autograd tape.
+"""
+from __future__ import annotations
+
+import builtins
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, Parameter, to_tensor, is_tensor
+from ..core.dispatch import op_call
+
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .stat import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+
+from . import creation, math, manipulation, linalg, logic, search, stat, random
+
+
+# ---------------------------------------------------------------------------
+# Indexing
+# ---------------------------------------------------------------------------
+def _process_index(idx):
+    """Convert Tensor components of an index to jax arrays."""
+    def conv(i):
+        if isinstance(i, Tensor):
+            v = i._value
+            if jnp.issubdtype(v.dtype, jnp.integer):
+                return v.astype(jnp.int32)
+            return v
+        if isinstance(i, (list, np.ndarray)):
+            return jnp.asarray(i)
+        return i
+    if isinstance(idx, tuple):
+        return tuple(conv(i) for i in idx)
+    return conv(idx)
+
+
+def _getitem(self, idx):
+    pidx = _process_index(idx)
+    return op_call("getitem", lambda v: v[pidx], self)
+
+
+def _setitem(self, idx, value):
+    pidx = _process_index(idx)
+    if isinstance(value, Tensor):
+        out = op_call("setitem", lambda v, val: v.at[pidx].set(val.astype(v.dtype)
+                                                               if val.dtype != v.dtype else val),
+                      self, value)
+    else:
+        out = op_call("setitem", lambda v: v.at[pidx].set(jnp.asarray(value, v.dtype)), self)
+    # rebind: the tensor now aliases the updated value and its grad node
+    self._value = out._value
+    self._grad_node = out._grad_node
+    self._out_index = out._out_index
+    self.stop_gradient = out.stop_gradient
+
+
+Tensor.__getitem__ = _getitem
+Tensor.__setitem__ = _setitem
+
+
+# ---------------------------------------------------------------------------
+# Arithmetic dunders
+# ---------------------------------------------------------------------------
+def _coerce(other):
+    if isinstance(other, Tensor):
+        return other
+    return other  # raw scalars/arrays pass straight into jnp
+
+
+def _binary(name, fn, reflexive=False):
+    def method(self, other):
+        other = _coerce(other)
+        if reflexive:
+            return op_call(name, lambda a, b: fn(b, a), self, other) \
+                if isinstance(other, Tensor) else op_call(name, lambda a: fn(other, a), self)
+        return op_call(name, fn, self, other)
+    return method
+
+
+Tensor.__add__ = _binary("add", jnp.add)
+Tensor.__radd__ = _binary("add", jnp.add)
+Tensor.__sub__ = _binary("subtract", jnp.subtract)
+Tensor.__rsub__ = _binary("subtract", jnp.subtract, reflexive=True)
+Tensor.__mul__ = _binary("multiply", jnp.multiply)
+Tensor.__rmul__ = _binary("multiply", jnp.multiply)
+Tensor.__truediv__ = _binary("divide", jnp.true_divide)
+Tensor.__rtruediv__ = _binary("divide", jnp.true_divide, reflexive=True)
+Tensor.__floordiv__ = _binary("floor_divide", jnp.floor_divide)
+Tensor.__rfloordiv__ = _binary("floor_divide", jnp.floor_divide, reflexive=True)
+Tensor.__mod__ = _binary("mod", jnp.mod)
+Tensor.__rmod__ = _binary("mod", jnp.mod, reflexive=True)
+Tensor.__pow__ = _binary("pow", jnp.power)
+Tensor.__rpow__ = _binary("pow", jnp.power, reflexive=True)
+Tensor.__matmul__ = _binary("matmul", jnp.matmul)
+Tensor.__rmatmul__ = _binary("matmul", jnp.matmul, reflexive=True)
+
+
+def _neg(self):
+    return op_call("neg", jnp.negative, self)
+
+
+def _abs(self):
+    return op_call("abs", jnp.abs, self)
+
+
+Tensor.__neg__ = _neg
+Tensor.__abs__ = _abs
+
+
+def _cmp_method(name, fn):
+    def method(self, other):
+        return op_call(name, fn, self, other, nondiff=True)
+    return method
+
+
+Tensor.__eq__ = _cmp_method("equal", jnp.equal)
+Tensor.__ne__ = _cmp_method("not_equal", jnp.not_equal)
+Tensor.__lt__ = _cmp_method("less_than", jnp.less)
+Tensor.__le__ = _cmp_method("less_equal", jnp.less_equal)
+Tensor.__gt__ = _cmp_method("greater_than", jnp.greater)
+Tensor.__ge__ = _cmp_method("greater_equal", jnp.greater_equal)
+
+Tensor.__invert__ = lambda self: op_call("invert", lambda v: ~v, self, nondiff=True)
+Tensor.__and__ = _cmp_method("and", lambda a, b: a & b)
+Tensor.__or__ = _cmp_method("or", lambda a, b: a | b)
+Tensor.__xor__ = _cmp_method("xor", lambda a, b: a ^ b)
+Tensor.__lshift__ = _cmp_method("lshift", jnp.left_shift)
+Tensor.__rshift__ = _cmp_method("rshift", jnp.right_shift)
+
+# re-register hash (defining __eq__ via class attr assignment clears it on
+# some python versions only at class creation; ensure identity hash stays)
+Tensor.__hash__ = lambda self: id(self)
+
+
+# ---------------------------------------------------------------------------
+# Attach functional ops as methods
+# ---------------------------------------------------------------------------
+_METHOD_SOURCES = [creation, math, manipulation, linalg, logic, search, stat, random]
+_SKIP = {"to_tensor", "meshgrid", "broadcast_tensors", "multi_dot", "einsum",
+         "concat", "stack", "assign", "zeros", "ones", "full", "arange",
+         "linspace", "logspace", "eye", "rand", "randn", "randint", "randperm",
+         "uniform", "normal", "create_parameter", "tril_indices", "triu_indices",
+         "broadcast_shape", "scatter_nd", "histogram_bin_edges", "combinations",
+         "empty", "log_normal", "standard_normal"}
+
+for _mod in _METHOD_SOURCES:
+    for _name in getattr(_mod, "__all__", []):
+        if _name in _SKIP:
+            continue
+        _fn = getattr(_mod, _name)
+        if callable(_fn) and not hasattr(Tensor, _name):
+            setattr(Tensor, _name, _fn)
+
+# paddle-style aliases
+Tensor.add = math.add
+Tensor.add_ = math.add_
+Tensor.multiply = math.multiply
+Tensor.pow = math.pow
+Tensor.abs = math.abs
+Tensor.sum = math.sum
+Tensor.mean = math.mean
+Tensor.max = math.max
+Tensor.min = math.min
+Tensor.matmul = linalg.matmul
+Tensor.mm = linalg.mm
+Tensor.norm = linalg.norm
+Tensor.reshape = manipulation.reshape
+Tensor.transpose = manipulation.transpose
+Tensor.flatten = manipulation.flatten
+Tensor.split = manipulation.split
+Tensor.chunk = manipulation.chunk
+Tensor.squeeze = manipulation.squeeze
+Tensor.unsqueeze = manipulation.unsqueeze
+Tensor.expand = manipulation.expand
+Tensor.tile = manipulation.tile
+Tensor.gather = manipulation.gather
+Tensor.scatter = manipulation.scatter
+Tensor.topk = search.topk
+Tensor.argmax = search.argmax
+Tensor.argmin = search.argmin
+Tensor.argsort = search.argsort
+Tensor.sort = search.sort
+Tensor.unbind = manipulation.unbind
+Tensor.T = property(lambda self: op_call("T", lambda v: v.T, self))
+Tensor.mT = property(lambda self: op_call("mT", lambda v: jnp.swapaxes(v, -1, -2), self))

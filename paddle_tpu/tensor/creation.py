@@ -1,0 +1,224 @@
+"""Tensor creation ops (reference: python/paddle/tensor/creation.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, to_tensor
+from ..core.dispatch import op_call
+from ..core import dtype as dtype_mod
+from ..core import random as random_mod
+
+__all__ = [
+    "to_tensor", "zeros", "ones", "full", "zeros_like", "ones_like",
+    "full_like", "empty", "empty_like", "arange", "linspace", "logspace",
+    "eye", "diag", "diagflat", "tril", "triu", "meshgrid", "assign",
+    "clone", "tril_indices", "triu_indices", "complex", "polar",
+    "create_parameter", "diag_embed",
+]
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.numpy().tolist()
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s._value) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def _dt(dtype, default=None):
+    d = dtype_mod.convert_dtype(dtype)
+    if d is None:
+        d = default if default is not None else dtype_mod.default_float_dtype()
+    return d
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value._value
+    if dtype is None:
+        v = jnp.full(_shape(shape), fill_value)
+        if jnp.issubdtype(v.dtype, jnp.floating):
+            v = v.astype(dtype_mod.default_float_dtype())
+    else:
+        v = jnp.full(_shape(shape), fill_value, _dt(dtype))
+    return Tensor(v)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return op_call("zeros_like", lambda v: jnp.zeros_like(v, dtype=dtype_mod.convert_dtype(dtype)), x, nondiff=True)
+
+
+def ones_like(x, dtype=None, name=None):
+    return op_call("ones_like", lambda v: jnp.ones_like(v, dtype=dtype_mod.convert_dtype(dtype)), x, nondiff=True)
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value._value
+    return op_call("full_like", lambda v: jnp.full_like(v, fill_value, dtype=dtype_mod.convert_dtype(dtype)), x, nondiff=True)
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def val(v):
+        return v._value.item() if isinstance(v, Tensor) else v
+    start, end, step = val(start), val(end), val(step)
+    if end is None:
+        start, end = 0, start
+    d = dtype_mod.convert_dtype(dtype)
+    if d is None:
+        if any(isinstance(v, float) for v in (start, end, step)):
+            d = dtype_mod.default_float_dtype()
+        else:
+            d = np.dtype("int64")
+    return Tensor(jnp.arange(start, end, step, dtype=d))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    def val(v):
+        return v._value.item() if isinstance(v, Tensor) else v
+    d = _dt(dtype)
+    return Tensor(jnp.linspace(val(start), val(stop), int(val(num)), dtype=d))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    def val(v):
+        return v._value.item() if isinstance(v, Tensor) else v
+    return Tensor(jnp.logspace(val(start), val(stop), int(val(num)), base=val(base),
+                               dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(int(num_rows), None if num_columns is None else int(num_columns),
+                          dtype=_dt(dtype)))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    def impl(v):
+        if v.ndim == 1:
+            out = jnp.diag(v, k=offset)
+            if padding_value != 0:
+                mask = jnp.diag(jnp.ones_like(v, dtype=bool), k=offset)
+                out = jnp.where(mask, out, jnp.asarray(padding_value, out.dtype))
+            return out
+        return jnp.diagonal(v, offset=offset)
+    return op_call("diag", impl, x)
+
+
+def diagflat(x, offset=0, name=None):
+    return op_call("diagflat", lambda v: jnp.diagflat(v, k=offset), x)
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    def impl(v):
+        n = v.shape[-1] + abs(offset)
+        base = jnp.zeros(v.shape[:-1] + (n, n), v.dtype)
+        idx = jnp.arange(v.shape[-1])
+        r = idx + max(-offset, 0)
+        c = idx + max(offset, 0)
+        out = base.at[..., r, c].set(v)
+        nd = out.ndim
+        d1 = dim1 % nd
+        d2 = dim2 % nd
+        if (d1, d2) != (nd - 2, nd - 1):
+            perm = [i for i in range(nd) if i not in (nd - 2, nd - 1)]
+            order = list(range(nd - 2))
+            full_perm = []
+            src = {d1: nd - 2, d2: nd - 1}
+            rest = iter(order)
+            for i in range(nd):
+                if i == d1:
+                    full_perm.append(nd - 2)
+                elif i == d2:
+                    full_perm.append(nd - 1)
+                else:
+                    full_perm.append(next(rest))
+            out = jnp.transpose(out, full_perm)
+        return out
+    return op_call("diag_embed", impl, x)
+
+
+def tril(x, diagonal=0, name=None):
+    return op_call("tril", lambda v: jnp.tril(v, k=diagonal), x)
+
+
+def triu(x, diagonal=0, name=None):
+    return op_call("triu", lambda v: jnp.triu(v, k=diagonal), x)
+
+
+def tril_indices(row, col, offset=0, dtype="int64"):
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=_dt(dtype, np.dtype("int64"))))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    col = row if col is None else col
+    r, c = np.triu_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=_dt(dtype, np.dtype("int64"))))
+
+
+def meshgrid(*args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    vals = [a._value if isinstance(a, Tensor) else jnp.asarray(a) for a in args]
+    outs = jnp.meshgrid(*vals, indexing="ij")
+    return [Tensor(o) for o in outs]
+
+
+def assign(x, output=None):
+    v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    if output is not None:
+        output._set_value(v)
+        return output
+    return Tensor(v)
+
+
+def clone(x, name=None):
+    return op_call("clone", lambda v: v + jnp.zeros((), v.dtype) if jnp.issubdtype(v.dtype, jnp.inexact) else jnp.array(v), x)
+
+
+def complex(real, imag, name=None):
+    return op_call("complex", lambda r, i: jax.lax.complex(r, i), real, imag)
+
+
+def polar(abs_t, angle, name=None):
+    return op_call("polar", lambda a, th: jax.lax.complex(a * jnp.cos(th), a * jnp.sin(th)),
+                   abs_t, angle)
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from ..core.tensor import Parameter
+    d = _dt(dtype)
+    key = random_mod.split_key()
+    if default_initializer is not None:
+        t = Parameter(jnp.zeros(_shape(shape), d), name=name)
+        default_initializer(t)
+        return t
+    if is_bias:
+        v = jnp.zeros(_shape(shape), d)
+    else:
+        # Xavier/Glorot uniform default, matching reference create_parameter
+        shp = _shape(shape)
+        fan_in = shp[0] if shp else 1
+        fan_out = shp[1] if len(shp) > 1 else 1
+        limit = float(np.sqrt(6.0 / (fan_in + fan_out)))
+        v = jax.random.uniform(key, shp, d, -limit, limit)
+    return Parameter(v, name=name)

@@ -1,0 +1,305 @@
+"""Linear algebra ops (reference: python/paddle/tensor/linalg.py; matmul at
+linalg.py:220). matmul/einsum hit the MXU; decompositions route to
+jax.numpy.linalg (XLA custom calls).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..core.dispatch import op_call
+
+__all__ = [
+    "matmul", "mm", "bmm", "mv", "norm", "vector_norm", "matrix_norm", "dist",
+    "cholesky", "cholesky_solve", "qr", "svd", "svdvals", "inv", "solve",
+    "lstsq", "det", "slogdet", "pinv", "matrix_power", "matrix_rank", "eig",
+    "eigh", "eigvals", "eigvalsh", "lu", "lu_unpack", "triangular_solve",
+    "multi_dot", "einsum", "cov", "corrcoef", "histogram", "histogramdd",
+    "cdist", "householder_product", "pca_lowrank", "matrix_exp", "ormqr",
+]
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def impl(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim >= 2 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim >= 2 else b
+        return jnp.matmul(a, b)
+    return op_call("matmul", impl, x, y)
+
+
+def mm(input, mat2, name=None):
+    return op_call("matmul", jnp.matmul, input, mat2)
+
+
+def bmm(x, y, name=None):
+    return op_call("bmm", jnp.matmul, x, y)
+
+
+def mv(x, vec, name=None):
+    return op_call("mv", jnp.matmul, x, vec)
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    def impl(v):
+        pp = p
+        if axis is None and pp is None:
+            return jnp.linalg.norm(v.reshape(-1))
+        if axis is None:
+            return jnp.linalg.norm(v.reshape(-1), ord=None if pp == "fro" else pp)
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        if pp is None:
+            pp = "fro" if isinstance(ax, tuple) else 2
+        if isinstance(ax, tuple) and len(ax) == 1:
+            ax = ax[0]
+        return jnp.linalg.norm(v, ord=pp, axis=ax, keepdims=keepdim)
+    return op_call("norm", impl, x)
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    def impl(v):
+        if ax is None:
+            return jnp.linalg.norm(v.reshape(-1), ord=p)
+        return jnp.linalg.norm(v, ord=p, axis=ax, keepdims=keepdim)
+    return op_call("vector_norm", impl, x)
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    return op_call("matrix_norm",
+                   lambda v: jnp.linalg.norm(v, ord=p, axis=tuple(axis), keepdims=keepdim), x)
+
+
+def dist(x, y, p=2, name=None):
+    def impl(a, b):
+        d = (a - b).reshape(-1)
+        if p == 0:
+            return jnp.count_nonzero(d).astype(a.dtype)
+        if np.isinf(p):
+            return jnp.max(jnp.abs(d)) if p > 0 else jnp.min(jnp.abs(d))
+        return jnp.sum(jnp.abs(d) ** p) ** (1.0 / p)
+    return op_call("dist", impl, x, y)
+
+
+def cholesky(x, upper=False, name=None):
+    def impl(v):
+        L = jnp.linalg.cholesky(v)
+        return jnp.swapaxes(L, -1, -2) if upper else L
+    return op_call("cholesky", impl, x)
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def impl(b, chol):
+        return jax.scipy.linalg.cho_solve((chol, not upper), b)
+    return op_call("cholesky_solve", impl, x, y)
+
+
+def qr(x, mode="reduced", name=None):
+    outs = op_call("qr", lambda v: tuple(jnp.linalg.qr(v, mode=mode)), x) \
+        if mode != "r" else (op_call("qr_r", lambda v: jnp.linalg.qr(v, mode="r"), x),)
+    return outs if len(outs) > 1 else outs[0]
+
+
+def svd(x, full_matrices=False, name=None):
+    return op_call("svd", lambda v: tuple(jnp.linalg.svd(v, full_matrices=full_matrices)), x)
+
+
+def svdvals(x, name=None):
+    return op_call("svdvals", lambda v: jnp.linalg.svd(v, compute_uv=False), x)
+
+
+def inv(x, name=None):
+    return op_call("inv", jnp.linalg.inv, x)
+
+
+def solve(x, y, name=None):
+    return op_call("solve", jnp.linalg.solve, x, y)
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    def impl(a, b):
+        sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol, res, rank, sv
+    return op_call("lstsq", impl, x, y)
+
+
+def det(x, name=None):
+    return op_call("det", jnp.linalg.det, x)
+
+
+def slogdet(x, name=None):
+    def impl(v):
+        sign, logdet = jnp.linalg.slogdet(v)
+        return jnp.stack([sign, logdet])
+    return op_call("slogdet", impl, x)
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return op_call("pinv", lambda v: jnp.linalg.pinv(v, rcond=rcond, hermitian=hermitian), x)
+
+
+def matrix_power(x, n, name=None):
+    return op_call("matrix_power", lambda v: jnp.linalg.matrix_power(v, n), x)
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return op_call("matrix_rank",
+                   lambda v: jnp.linalg.matrix_rank(v, tol=tol),
+                   x, nondiff=True)
+
+
+def matrix_exp(x, name=None):
+    return op_call("matrix_exp", jax.scipy.linalg.expm, x)
+
+
+def eig(x, name=None):
+    # CPU-only in XLA: route via host numpy for parity (reference supports it
+    # only on CPU-backed LAPACK too)
+    v = np.asarray(x._value)
+    w, vecs = np.linalg.eig(v)
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(vecs))
+
+
+def eigvals(x, name=None):
+    v = np.asarray(x._value)
+    return Tensor(jnp.asarray(np.linalg.eigvals(v)))
+
+
+def eigh(x, UPLO="L", name=None):
+    return op_call("eigh", lambda v: tuple(jnp.linalg.eigh(v, UPLO=UPLO)), x)
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return op_call("eigvalsh", lambda v: jnp.linalg.eigvalsh(v, UPLO=UPLO), x)
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    def impl(v):
+        lu_, piv = jax.scipy.linalg.lu_factor(v)
+        return lu_, piv.astype(jnp.int32) + 1  # paddle returns 1-based pivots
+    lu_t, piv = op_call("lu", impl, x)
+    if get_infos:
+        return lu_t, piv, Tensor(jnp.zeros(x.shape[:-2], jnp.int32))
+    return lu_t, piv
+
+
+def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True, name=None):
+    def impl(lu_v, piv):
+        m, n = lu_v.shape[-2], lu_v.shape[-1]
+        k = min(m, n)
+        L = jnp.tril(lu_v[..., :, :k], -1) + jnp.eye(m, k, dtype=lu_v.dtype)
+        U = jnp.triu(lu_v[..., :k, :])
+        # build permutation from 1-based pivots
+        piv0 = piv.astype(jnp.int32) - 1
+        def perm_one(pv):
+            perm = jnp.arange(m)
+            def body(i, perm):
+                j = pv[i]
+                a, b = perm[i], perm[j]
+                perm = perm.at[i].set(b).at[j].set(a)
+                return perm
+            return jax.lax.fori_loop(0, pv.shape[0], body, perm)
+        if piv0.ndim == 1:
+            perm = perm_one(piv0)
+            P = jnp.eye(m, dtype=lu_v.dtype)[perm].T
+        else:
+            flatp = piv0.reshape(-1, piv0.shape[-1])
+            perms = jax.vmap(perm_one)(flatp)
+            P = jax.vmap(lambda p: jnp.eye(m, dtype=lu_v.dtype)[p].T)(perms)
+            P = P.reshape(lu_v.shape[:-2] + (m, m))
+        return P, L, U
+    return op_call("lu_unpack", impl, lu_data, lu_pivots)
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    def impl(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular)
+    return op_call("triangular_solve", impl, x, y)
+
+
+def multi_dot(tensors, name=None):
+    return op_call("multi_dot", lambda *vs: jnp.linalg.multi_dot(list(vs)), *tensors)
+
+
+def einsum(equation, *operands):
+    if len(operands) == 1 and isinstance(operands[0], (list, tuple)):
+        operands = tuple(operands[0])
+    return op_call("einsum", lambda *vs: jnp.einsum(equation, *vs), *operands)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    fw = fweights._value if isinstance(fweights, Tensor) else fweights
+    aw = aweights._value if isinstance(aweights, Tensor) else aweights
+    return op_call("cov", lambda v: jnp.cov(v, rowvar=rowvar, ddof=1 if ddof else 0,
+                                            fweights=fw, aweights=aw), x)
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return op_call("corrcoef", lambda v: jnp.corrcoef(v, rowvar=rowvar), x)
+
+
+def histogram(input, bins=100, min=0, max=0, weight=None, density=False, name=None):
+    v = np.asarray(input._value)
+    rng = None if (min == 0 and max == 0) else (min, max)
+    w = np.asarray(weight._value) if weight is not None else None
+    hist, _ = np.histogram(v, bins=bins, range=rng, weights=w, density=density)
+    return Tensor(jnp.asarray(hist if density or w is not None else hist.astype(np.int64)))
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None, name=None):
+    v = np.asarray(x._value)
+    w = np.asarray(weights._value) if weights is not None else None
+    hist, edges = np.histogramdd(v, bins=bins, range=ranges, density=density, weights=w)
+    return Tensor(jnp.asarray(hist)), [Tensor(jnp.asarray(e)) for e in edges]
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary", name=None):
+    def impl(a, b):
+        diff = a[..., :, None, :] - b[..., None, :, :]
+        if p == 2.0:
+            return jnp.sqrt(jnp.sum(diff * diff, axis=-1) + 1e-30)
+        return jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
+    return op_call("cdist", impl, x, y)
+
+
+def householder_product(x, tau, name=None):
+    def impl(a, t):
+        m, n = a.shape[-2], a.shape[-1]
+        def one(av, tv):
+            Q = jnp.eye(m, dtype=av.dtype)
+            def body(i, Q):
+                v = jnp.where(jnp.arange(m) < i, 0.0, av[:, i])
+                v = v.at[i].set(1.0)
+                H = jnp.eye(m, dtype=av.dtype) - tv[i] * jnp.outer(v, v)
+                return Q @ H
+            Q = jax.lax.fori_loop(0, tv.shape[0], body, Q)
+            return Q[:, :n]
+        if a.ndim == 2:
+            return one(a, t)
+        flat_a = a.reshape((-1,) + a.shape[-2:])
+        flat_t = t.reshape((-1,) + t.shape[-1:])
+        out = jax.vmap(one)(flat_a, flat_t)
+        return out.reshape(a.shape[:-2] + out.shape[-2:])
+    return op_call("householder_product", impl, x, tau)
+
+
+def ormqr(x, tau, y, left=True, transpose=False, name=None):
+    Q = householder_product(x, tau)
+    def impl(q, other):
+        qq = jnp.swapaxes(q, -1, -2) if transpose else q
+        return jnp.matmul(qq, other) if left else jnp.matmul(other, qq)
+    return op_call("ormqr", impl, Q, y)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    def impl(v):
+        vv = v - jnp.mean(v, axis=-2, keepdims=True) if center else v
+        u, s, vt = jnp.linalg.svd(vv, full_matrices=False)
+        k = q if q is not None else min(6, v.shape[-1])
+        return u[..., :k], s[..., :k], jnp.swapaxes(vt, -1, -2)[..., :k]
+    return op_call("pca_lowrank", impl, x)

@@ -1,0 +1,85 @@
+"""Comparison / logical ops (reference: python/paddle/tensor/logic.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..core.dispatch import op_call
+
+__all__ = [
+    "equal", "not_equal", "less_than", "less_equal", "greater_than",
+    "greater_equal", "logical_and", "logical_or", "logical_not", "logical_xor",
+    "isclose", "allclose", "equal_all", "is_empty", "isreal", "iscomplex",
+    "isposinf", "isneginf", "is_floating_point", "is_integer", "is_complex",
+]
+
+
+def _cmp(name, fn):
+    def op(x, y, name=None):
+        return op_call(name, fn, x, y, nondiff=True)
+    op.__name__ = name
+    return op
+
+
+equal = _cmp("equal", jnp.equal)
+not_equal = _cmp("not_equal", jnp.not_equal)
+less_than = _cmp("less_than", jnp.less)
+less_equal = _cmp("less_equal", jnp.less_equal)
+greater_than = _cmp("greater_than", jnp.greater)
+greater_equal = _cmp("greater_equal", jnp.greater_equal)
+logical_and = _cmp("logical_and", jnp.logical_and)
+logical_or = _cmp("logical_or", jnp.logical_or)
+logical_xor = _cmp("logical_xor", jnp.logical_xor)
+
+
+def logical_not(x, out=None, name=None):
+    return op_call("logical_not", jnp.logical_not, x, nondiff=True)
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return op_call("isclose",
+                   lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+                   x, y, nondiff=True)
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return op_call("allclose",
+                   lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+                   x, y, nondiff=True)
+
+
+def equal_all(x, y, name=None):
+    return op_call("equal_all", lambda a, b: jnp.array_equal(a, b), x, y, nondiff=True)
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(x.size == 0))
+
+
+def isreal(x, name=None):
+    return op_call("isreal", jnp.isreal, x, nondiff=True)
+
+
+def iscomplex(x, name=None):
+    return Tensor(jnp.asarray(jnp.issubdtype(x._value.dtype, jnp.complexfloating)))
+
+
+def isposinf(x, name=None):
+    return op_call("isposinf", jnp.isposinf, x, nondiff=True)
+
+
+def isneginf(x, name=None):
+    return op_call("isneginf", jnp.isneginf, x, nondiff=True)
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(x._value.dtype, jnp.floating)
+
+
+def is_integer(x):
+    return jnp.issubdtype(x._value.dtype, jnp.integer)
+
+
+def is_complex(x):
+    return jnp.issubdtype(x._value.dtype, jnp.complexfloating)

@@ -1,0 +1,610 @@
+"""Shape/layout manipulation ops (reference: python/paddle/tensor/manipulation.py).
+
+On TPU these are mostly free: XLA fuses reshapes/transposes into consumers;
+gather/scatter lower to efficient dynamic-slice HLO.
+"""
+from __future__ import annotations
+
+import builtins
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..core.dispatch import op_call
+from ..core import dtype as dtype_mod
+
+__all__ = [
+    "reshape", "reshape_", "flatten", "squeeze", "squeeze_", "unsqueeze",
+    "unsqueeze_", "concat", "stack", "split", "tensor_split", "vsplit", "hsplit",
+    "dsplit", "chunk", "tile", "expand", "expand_as", "broadcast_to",
+    "broadcast_tensors", "gather", "gather_nd", "scatter", "scatter_",
+    "scatter_nd", "scatter_nd_add", "index_select", "index_add", "index_put",
+    "masked_select", "masked_fill", "masked_scatter", "where", "roll", "flip",
+    "rot90", "repeat_interleave", "take_along_axis", "put_along_axis", "unbind",
+    "unstack", "strided_slice", "slice", "crop", "pad", "transpose", "transpose_",
+    "moveaxis", "swapaxes", "swapdims", "t", "as_strided", "view", "view_as",
+    "unfold", "cast", "cast_", "unique", "unique_consecutive", "flip_",
+    "fill_diagonal_", "diagonal", "kron", "rank", "shard_index",
+    "tolist", "atleast_1d", "atleast_2d", "atleast_3d", "select_scatter",
+    "index_fill", "tensordot", "as_complex", "as_real", "numel",
+]
+
+
+def _axes(axis):
+    if isinstance(axis, Tensor):
+        a = axis.numpy().tolist()
+        return a if isinstance(a, list) else int(a)
+    return axis
+
+
+def reshape(x, shape, name=None):
+    shape = tuple(int(s._value) if isinstance(s, Tensor) else int(s) for s in shape) \
+        if not isinstance(shape, Tensor) else tuple(shape.numpy().tolist())
+    # paddle semantics: 0 means copy dim from input
+    xs = x.shape if isinstance(x, Tensor) else list(np.shape(x))
+    shape = tuple(xs[i] if s == 0 and i < len(xs) else s for i, s in enumerate(shape))
+    return op_call("reshape", lambda v: jnp.reshape(v, shape), x)
+
+
+def reshape_(x, shape, name=None):
+    return x._set_value(reshape(x.detach(), shape)._value)
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    d = dtype_mod.convert_dtype(shape_or_dtype)
+    return op_call("view_dtype", lambda v: v.view(d), x, nondiff=True)
+
+
+def view_as(x, other, name=None):
+    return reshape(x, other.shape)
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def impl(v):
+        nd = v.ndim
+        s = start_axis % nd if nd else 0
+        e = stop_axis % nd if nd else 0
+        new_shape = v.shape[:s] + (-1,) + v.shape[e + 1:]
+        return jnp.reshape(v, new_shape)
+    return op_call("flatten", impl, x)
+
+
+def squeeze(x, axis=None, name=None):
+    ax = _axes(axis)
+    def impl(v):
+        if ax is None:
+            return jnp.squeeze(v)
+        axes = ax if isinstance(ax, (list, tuple)) else [ax]
+        axes = tuple(a % v.ndim for a in axes if v.shape[a % v.ndim] == 1)
+        return jnp.squeeze(v, axis=axes) if axes else v
+    return op_call("squeeze", impl, x)
+
+
+def squeeze_(x, axis=None, name=None):
+    return x._set_value(squeeze(x.detach(), axis)._value)
+
+
+def unsqueeze(x, axis, name=None):
+    ax = _axes(axis)
+    axes = ax if isinstance(ax, (list, tuple)) else [ax]
+    return op_call("unsqueeze", lambda v: jnp.expand_dims(v, tuple(axes)), x)
+
+
+def unsqueeze_(x, axis, name=None):
+    return x._set_value(unsqueeze(x.detach(), axis)._value)
+
+
+def concat(x, axis=0, name=None):
+    tensors = list(x)
+    ax = int(axis._value) if isinstance(axis, Tensor) else int(axis)
+    return op_call("concat", lambda *vs: jnp.concatenate(vs, axis=ax), *tensors)
+
+
+def stack(x, axis=0, name=None):
+    tensors = list(x)
+    return op_call("stack", lambda *vs: jnp.stack(vs, axis=axis), *tensors)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    ax = int(axis._value) if isinstance(axis, Tensor) else int(axis)
+    n = x.shape[ax]
+    if isinstance(num_or_sections, int):
+        if n % num_or_sections != 0:
+            raise ValueError(
+                f"paddle.split: axis {ax} length {n} is not divisible by "
+                f"num_or_sections={num_or_sections} (use tensor_split for "
+                f"uneven splits)")
+        sizes = [n // num_or_sections] * num_or_sections
+    else:
+        sizes = [int(s) for s in num_or_sections]
+        if any(s == -1 for s in sizes):
+            rest = n - sum(s for s in sizes if s != -1)
+            sizes = [rest if s == -1 else s for s in sizes]
+    offsets = np.cumsum([0] + sizes[:-1])
+    outs = []
+    for off, sz in zip(offsets, sizes):
+        outs.append(op_call("slice", lambda v, o=off, s=sz: jax.lax.slice_in_dim(v, o, o + s, axis=ax), x))
+    return outs
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    ax = int(axis)
+    n = x.shape[ax]
+    if isinstance(num_or_indices, int):
+        k, m = divmod(n, num_or_indices)
+        sizes = [k + 1] * m + [k] * (num_or_indices - m)
+    else:
+        idx = [0] + [int(i) for i in num_or_indices] + [n]
+        sizes = [idx[i + 1] - idx[i] for i in range(len(idx) - 1)]
+    return split(x, sizes, axis=ax)
+
+
+def vsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=0)
+
+
+def hsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=1 if x.ndim > 1 else 0)
+
+
+def dsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=2)
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return tensor_split(x, chunks, axis=axis)
+
+
+def tile(x, repeat_times, name=None):
+    reps = tuple(int(r._value) if isinstance(r, Tensor) else int(r) for r in repeat_times) \
+        if not isinstance(repeat_times, Tensor) else tuple(repeat_times.numpy().tolist())
+    return op_call("tile", lambda v: jnp.tile(v, reps), x)
+
+
+def expand(x, shape, name=None):
+    shp = tuple(int(s._value) if isinstance(s, Tensor) else int(s) for s in shape) \
+        if not isinstance(shape, Tensor) else tuple(shape.numpy().tolist())
+    def impl(v):
+        tgt = list(shp)
+        # -1 means keep input dim
+        off = len(tgt) - v.ndim
+        for i in range(len(tgt)):
+            if tgt[i] == -1:
+                tgt[i] = v.shape[i - off]
+        return jnp.broadcast_to(v, tuple(tgt))
+    return op_call("expand", impl, x)
+
+
+def expand_as(x, y, name=None):
+    return expand(x, y.shape)
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def broadcast_tensors(inputs, name=None):
+    vals = [t._value for t in inputs]
+    shape = np.broadcast_shapes(*[v.shape for v in vals])
+    return [op_call("broadcast_to", lambda v, s=shape: jnp.broadcast_to(v, s), t)
+            for t in inputs]
+
+
+def cast(x, dtype):
+    d = dtype_mod.convert_dtype(dtype)
+    return op_call("cast", lambda v: v.astype(d), x)
+
+
+def cast_(x, dtype):
+    return x._set_value(cast(x.detach(), dtype)._value)
+
+
+def gather(x, index, axis=0, name=None):
+    ax = int(axis._value) if isinstance(axis, Tensor) else int(axis)
+    return op_call("gather", lambda v, i: jnp.take(v, i.astype(jnp.int32), axis=ax), x, index)
+
+
+def gather_nd(x, index, name=None):
+    def impl(v, idx):
+        idx = idx.astype(jnp.int32)
+        k = idx.shape[-1]
+        comps = tuple(idx[..., i] for i in range(k))
+        return v[comps]
+    return op_call("gather_nd", impl, x, index)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def impl(v, idx, upd):
+        idx = idx.astype(jnp.int32).reshape(-1)
+        if overwrite:
+            return v.at[idx].set(upd)
+        # paddle overwrite=False: zero target rows then accumulate
+        zeroed = v.at[idx].set(jnp.zeros_like(upd))
+        return zeroed.at[idx].add(upd)
+    return op_call("scatter", impl, x, index, updates)
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    return x._set_value(scatter(x.detach(), index, updates, overwrite)._value)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    shp = tuple(int(s) for s in shape)
+    def impl(idx, upd):
+        idx = idx.astype(jnp.int32)
+        k = idx.shape[-1]
+        base = jnp.zeros(shp, upd.dtype)
+        comps = tuple(idx[..., i] for i in range(k))
+        return base.at[comps].add(upd)
+    return op_call("scatter_nd", impl, index, updates)
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def impl(v, idx, upd):
+        idx = idx.astype(jnp.int32)
+        k = idx.shape[-1]
+        comps = tuple(idx[..., i] for i in range(k))
+        return v.at[comps].add(upd)
+    return op_call("scatter_nd_add", impl, x, index, updates)
+
+
+def index_select(x, index, axis=0, name=None):
+    return gather(x, index, axis)
+
+
+def index_add(x, index, axis, value, name=None):
+    def impl(v, idx, val):
+        idx = idx.astype(jnp.int32)
+        moved = jnp.moveaxis(v, axis, 0)
+        val_m = jnp.moveaxis(val, axis, 0)
+        out = moved.at[idx].add(val_m)
+        return jnp.moveaxis(out, 0, axis)
+    return op_call("index_add", impl, x, index, value)
+
+
+def index_fill(x, index, axis, value, name=None):
+    def impl(v, idx):
+        idx = idx.astype(jnp.int32)
+        moved = jnp.moveaxis(v, axis, 0)
+        fill = jnp.asarray(value, v.dtype)
+        out = moved.at[idx].set(jnp.broadcast_to(fill, (idx.shape[0],) + moved.shape[1:]))
+        return jnp.moveaxis(out, 0, axis)
+    return op_call("index_fill", impl, x, index)
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    idx_vals = tuple(i._value.astype(jnp.int32) if isinstance(i, Tensor) else i for i in indices)
+    def impl(v, val):
+        if accumulate:
+            return v.at[idx_vals].add(val)
+        return v.at[idx_vals].set(val)
+    return op_call("index_put", impl, x, value)
+
+
+def masked_select(x, mask, name=None):
+    # dynamic output shape: computed eagerly via numpy (not jittable — same
+    # caveat as reference dygraph-only ops)
+    v = np.asarray(x._value)
+    m = np.asarray(mask._value)
+    return Tensor(jnp.asarray(v[m]))
+
+
+def masked_fill(x, mask, value, name=None):
+    val = value._value if isinstance(value, Tensor) else value
+    return op_call("masked_fill", lambda v, m: jnp.where(m, jnp.asarray(val, v.dtype), v), x, mask)
+
+
+def masked_scatter(x, mask, value, name=None):
+    def impl(v, m, val):
+        flat_val = val.reshape(-1)
+        mi = jnp.cumsum(m.reshape(-1).astype(jnp.int32)) - 1
+        picked = flat_val[jnp.clip(mi, 0, flat_val.shape[0] - 1)].reshape(v.shape)
+        return jnp.where(m, picked, v)
+    return op_call("masked_scatter", impl, x, mask, value)
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return op_call("where", lambda c, a, b: jnp.where(c, a, b), condition, x, y)
+
+
+def nonzero(x, as_tuple=False):
+    v = np.asarray(x._value)
+    nz = np.nonzero(v)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i, dtype=jnp.int64)) for i in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1), dtype=jnp.int64))
+
+
+def roll(x, shifts, axis=None, name=None):
+    sh = tuple(shifts) if isinstance(shifts, (list, tuple)) else int(shifts)
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return op_call("roll", lambda v: jnp.roll(v, sh, axis=ax), x)
+
+
+def flip(x, axis, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+    return op_call("flip", lambda v: jnp.flip(v, axis=ax), x)
+
+
+def flip_(x, axis, name=None):
+    return x._set_value(flip(x.detach(), axis)._value)
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return op_call("rot90", lambda v: jnp.rot90(v, k=k, axes=tuple(axes)), x)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        reps = np.asarray(repeats._value)
+        v = np.asarray(x._value)
+        return Tensor(jnp.asarray(np.repeat(v, reps, axis=axis)))
+    return op_call("repeat_interleave", lambda v: jnp.repeat(v, repeats, axis=axis), x)
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    return op_call("take_along_axis",
+                   lambda v, i: jnp.take_along_axis(v, i.astype(jnp.int32), axis=axis),
+                   arr, indices)
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", include_self=True,
+                   broadcast=True, name=None):
+    def impl(v, idx, val):
+        idx = idx.astype(jnp.int32)
+        val = jnp.broadcast_to(jnp.asarray(val, v.dtype), idx.shape)
+        if reduce == "assign":
+            return jnp.put_along_axis(v, idx, val, axis=axis, inplace=False)
+        mode = {"add": "add", "multiply": "multiply", "mul": "multiply",
+                "amin": "min", "amax": "max"}[reduce]
+        moved = jnp.moveaxis(v, axis, -1)
+        midx = jnp.moveaxis(idx, axis, -1)
+        mval = jnp.moveaxis(val, axis, -1)
+        upd = getattr(moved.at[..., 0], "set")  # placeholder; use scatter via at
+        # scatter along last axis with batch dims
+        def scat(row, irow, vrow):
+            if mode == "add":
+                return row.at[irow].add(vrow)
+            if mode == "multiply":
+                return row.at[irow].multiply(vrow)
+            if mode == "min":
+                return row.at[irow].min(vrow)
+            return row.at[irow].max(vrow)
+        flat_m = moved.reshape(-1, moved.shape[-1])
+        flat_i = midx.reshape(-1, midx.shape[-1])
+        flat_v = mval.reshape(-1, mval.shape[-1])
+        out = jax.vmap(scat)(flat_m, flat_i, flat_v)
+        return jnp.moveaxis(out.reshape(moved.shape), -1, axis)
+    if isinstance(values, (int, float)):
+        values = Tensor(jnp.asarray(values))
+    return op_call("put_along_axis", impl, arr, indices, values)
+
+
+def unbind(x, axis=0, name=None):
+    n = x.shape[axis]
+    outs = op_call("unbind", lambda v: tuple(jnp.squeeze(s, axis=axis) for s in
+                                             jnp.split(v, n, axis=axis)), x)
+    return list(outs)
+
+
+def unstack(x, axis=0, num=None, name=None):
+    return unbind(x, axis)
+
+
+def slice(x, axes, starts, ends, name=None):
+    def impl(v):
+        idx = [builtins_slice(None)] * v.ndim
+        for a, s, e in zip(axes, starts, ends):
+            s = int(s._value) if isinstance(s, Tensor) else int(s)
+            e = int(e._value) if isinstance(e, Tensor) else int(e)
+            idx[a] = builtins_slice(s, e)
+        return v[tuple(idx)]
+    return op_call("slice", impl, x)
+
+
+builtins_slice = builtins.slice
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    def impl(v):
+        idx = [builtins_slice(None)] * v.ndim
+        for a, s, e, st in zip(axes, starts, ends, strides):
+            idx[a] = builtins_slice(int(s), int(e), int(st))
+        return v[tuple(idx)]
+    return op_call("strided_slice", impl, x)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    shp = [int(s) for s in (shape or x.shape)]
+    offs = [int(o) for o in (offsets or [0] * x.ndim)]
+    shp = [x.shape[i] if s == -1 else s for i, s in enumerate(shp)]
+    def impl(v):
+        return jax.lax.dynamic_slice(v, offs, shp)
+    return op_call("crop", impl, x)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    """paddle.nn.functional.pad semantics: `pad` is per-axis pairs, innermost
+    last when len(pad) < 2*ndim (torch convention used by paddle)."""
+    if isinstance(pad, Tensor):
+        pad = pad.numpy().tolist()
+    pad = [int(p) for p in pad]
+    def impl(v):
+        nd = v.ndim
+        if len(pad) == 2 * nd:
+            widths = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+        else:
+            # innermost-dims-last convention
+            k = len(pad) // 2
+            widths = [(0, 0)] * (nd - k) + [
+                (pad[2 * (k - 1 - i)], pad[2 * (k - 1 - i) + 1]) for i in range(k)]
+            if data_format in ("NHWC", "NLC", "NDHWC") and k < nd - 1:
+                # channel-last: pad spatial dims (all but first and last)
+                widths = [(0, 0)] + widths[2:] + [(0, 0)] if len(widths) == nd else widths
+        jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge",
+                 "circular": "wrap"}[mode]
+        if jmode == "constant":
+            return jnp.pad(v, widths, mode=jmode, constant_values=value)
+        return jnp.pad(v, widths, mode=jmode)
+    return op_call("pad", impl, x)
+
+
+def transpose(x, perm, name=None):
+    perm = tuple(int(p) for p in perm)
+    return op_call("transpose", lambda v: jnp.transpose(v, perm), x)
+
+
+def transpose_(x, perm, name=None):
+    return x._set_value(transpose(x.detach(), perm)._value)
+
+
+def moveaxis(x, source, destination, name=None):
+    return op_call("moveaxis", lambda v: jnp.moveaxis(v, source, destination), x)
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return op_call("swapaxes", lambda v: jnp.swapaxes(v, axis0, axis1), x)
+
+
+swapdims = swapaxes
+
+
+def t(x, name=None):
+    def impl(v):
+        if v.ndim < 2:
+            return v
+        return v.T
+    return op_call("t", impl, x)
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    def impl(v):
+        flat = v.reshape(-1)
+        idx = np.zeros(tuple(shape), dtype=np.int64) + offset
+        for d, (s, st) in enumerate(zip(shape, stride)):
+            r = np.arange(s) * st
+            idx = idx + r.reshape([-1 if i == d else 1 for i in range(len(shape))])
+        return flat[jnp.asarray(idx)]
+    return op_call("as_strided", impl, x)
+
+
+def unfold(x, axis, size, step, name=None):
+    def impl(v):
+        n = v.shape[axis]
+        num = (n - size) // step + 1
+        starts = np.arange(num) * step
+        slices = [jax.lax.slice_in_dim(v, int(s), int(s) + size, axis=axis) for s in starts]
+        return jnp.stack(slices, axis=axis if axis >= 0 else v.ndim + axis)
+    return op_call("unfold", impl, x)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    v = np.asarray(x._value)
+    res = np.unique(v, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not (return_index or return_inverse or return_counts):
+        return Tensor(jnp.asarray(res))
+    outs = [Tensor(jnp.asarray(r)) for r in res]
+    return tuple(outs)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    v = np.asarray(x._value)
+    if axis is None:
+        v = v.reshape(-1)
+        keep = np.concatenate([[True], v[1:] != v[:-1]])
+        out = v[keep]
+        inv = np.cumsum(keep) - 1
+        counts = np.diff(np.concatenate([np.nonzero(keep)[0], [len(v)]]))
+    else:
+        raise NotImplementedError("unique_consecutive with axis")
+    res = [Tensor(jnp.asarray(out))]
+    if return_inverse:
+        res.append(Tensor(jnp.asarray(inv)))
+    if return_counts:
+        res.append(Tensor(jnp.asarray(counts)))
+    return res[0] if len(res) == 1 else tuple(res)
+
+
+def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
+    v = x._value
+    n = min(v.shape[-2:]) - builtins.abs(offset)
+    i = jnp.arange(n)
+    r = i + max(-offset, 0)
+    c = i + max(offset, 0)
+    x._set_value(v.at[..., r, c].set(value))
+    return x
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return op_call("diagonal", lambda v: jnp.diagonal(v, offset=offset, axis1=axis1, axis2=axis2), x)
+
+
+def kron(x, y, name=None):
+    return op_call("kron", lambda a, b: jnp.kron(a, b), x, y)
+
+
+def rank(x):
+    return Tensor(jnp.asarray(x.ndim, dtype=jnp.int32))
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(x.size, dtype=jnp.int64))
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    shard_size = (index_num + nshards - 1) // nshards
+    def impl(v):
+        in_shard = (v // shard_size) == shard_id
+        return jnp.where(in_shard, v % shard_size, ignore_value)
+    return op_call("shard_index", impl, input, nondiff=True)
+
+
+def tolist(x):
+    return x.numpy().tolist()
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [op_call("atleast_1d", jnp.atleast_1d, t) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [op_call("atleast_2d", jnp.atleast_2d, t) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [op_call("atleast_3d", jnp.atleast_3d, t) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def select_scatter(x, values, axis, index, name=None):
+    def impl(v, val):
+        idx = [builtins_slice(None)] * v.ndim
+        idx[axis] = index
+        return v.at[tuple(idx)].set(val)
+    return op_call("select_scatter", impl, x, values)
+
+
+def tensordot(x, y, axes=2, name=None):
+    ax = axes
+    if isinstance(ax, Tensor):
+        ax = ax.numpy().tolist()
+    return op_call("tensordot", lambda a, b: jnp.tensordot(a, b, axes=ax), x, y)
+
+
+def as_complex(x, name=None):
+    return op_call("as_complex", lambda v: jax.lax.complex(v[..., 0], v[..., 1]), x)
+
+
+def as_real(x, name=None):
+    return op_call("as_real", lambda v: jnp.stack([jnp.real(v), jnp.imag(v)], axis=-1), x)

@@ -1,0 +1,418 @@
+"""Math ops (reference: python/paddle/tensor/math.py, ops.yaml entries).
+
+All ops funnel through op_call dispatch (kernel-override capable) onto
+jax.numpy/lax impls, which XLA fuses on TPU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, to_tensor
+from ..core.dispatch import op_call
+from ..core import dtype as dtype_mod
+
+__all__ = [
+    "add", "subtract", "multiply", "divide", "floor_divide", "mod", "remainder",
+    "pow", "float_power", "sqrt", "rsqrt", "exp", "expm1", "log", "log2", "log10", "log1p",
+    "abs", "neg", "sign", "floor", "ceil", "round", "trunc", "frac",
+    "sin", "cos", "tan", "asin", "acos", "atan", "atan2", "sinh", "cosh", "tanh",
+    "asinh", "acosh", "atanh", "deg2rad", "rad2deg",
+    "clip", "maximum", "minimum", "fmax", "fmin", "reciprocal", "square",
+    "lerp", "erf", "erfinv", "logit", "logaddexp", "hypot",
+    "isnan", "isinf", "isfinite", "nan_to_num", "nansum", "nanmean",
+    "sum", "mean", "max", "min", "amax", "amin", "prod", "logsumexp",
+    "cumsum", "cumprod", "cummax", "cummin", "count_nonzero",
+    "multiply_", "add_", "subtract_", "scale", "scale_", "increment",
+    "stanh", "softplus_math", "addmm", "outer", "inner", "cross", "dot",
+    "gcd", "lcm", "heaviside", "digamma", "lgamma", "multigammaln",
+    "i0", "i0e", "i1", "i1e", "trapezoid", "diff", "angle", "conj", "real", "imag",
+    "broadcast_shape", "renorm", "ldexp", "copysign", "nextafter",
+    "take", "vander", "combinations", "bucketize",
+    "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
+    "bitwise_left_shift", "bitwise_right_shift",
+]
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        a = axis.numpy().tolist()
+        return tuple(a) if isinstance(a, list) else int(a)
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def _binop(name, fn):
+    def op(x, y, name=None):
+        return op_call(name, fn, x, y)
+    op.__name__ = name
+    return op
+
+
+def _unop(name, fn, nondiff=False):
+    def op(x, name=None):
+        return op_call(name, fn, x, nondiff=nondiff)
+    op.__name__ = name
+    return op
+
+
+add = _binop("add", jnp.add)
+subtract = _binop("subtract", jnp.subtract)
+multiply = _binop("multiply", jnp.multiply)
+divide = _binop("divide", jnp.true_divide)
+floor_divide = _binop("floor_divide", jnp.floor_divide)
+mod = _binop("mod", jnp.mod)
+remainder = mod
+atan2 = _binop("atan2", jnp.arctan2)
+maximum = _binop("maximum", jnp.maximum)
+minimum = _binop("minimum", jnp.minimum)
+fmax = _binop("fmax", jnp.fmax)
+fmin = _binop("fmin", jnp.fmin)
+logaddexp = _binop("logaddexp", jnp.logaddexp)
+hypot = _binop("hypot", jnp.hypot)
+copysign = _binop("copysign", jnp.copysign)
+nextafter = _binop("nextafter", jnp.nextafter)
+heaviside = _binop("heaviside", jnp.heaviside)
+gcd = _binop("gcd", jnp.gcd)
+lcm = _binop("lcm", jnp.lcm)
+bitwise_and = _binop("bitwise_and", lambda x, y: jnp.bitwise_and(x, y))
+bitwise_or = _binop("bitwise_or", lambda x, y: jnp.bitwise_or(x, y))
+bitwise_xor = _binop("bitwise_xor", lambda x, y: jnp.bitwise_xor(x, y))
+bitwise_left_shift = _binop("bitwise_left_shift", jnp.left_shift)
+bitwise_right_shift = _binop("bitwise_right_shift", jnp.right_shift)
+bitwise_not = _unop("bitwise_not", jnp.bitwise_not, nondiff=True)
+
+
+def pow(x, y, name=None):
+    return op_call("pow", jnp.power, x, y)
+
+
+float_power = _binop("float_power", jnp.float_power)
+
+sqrt = _unop("sqrt", jnp.sqrt)
+rsqrt = _unop("rsqrt", jax.lax.rsqrt)
+exp = _unop("exp", jnp.exp)
+expm1 = _unop("expm1", jnp.expm1)
+log = _unop("log", jnp.log)
+log2 = _unop("log2", jnp.log2)
+log10 = _unop("log10", jnp.log10)
+log1p = _unop("log1p", jnp.log1p)
+abs = _unop("abs", jnp.abs)
+neg = _unop("neg", jnp.negative)
+sign = _unop("sign", jnp.sign)
+floor = _unop("floor", jnp.floor)
+ceil = _unop("ceil", jnp.ceil)
+round = _unop("round", jnp.round)
+trunc = _unop("trunc", jnp.trunc)
+frac = _unop("frac", lambda v: v - jnp.trunc(v))
+sin = _unop("sin", jnp.sin)
+cos = _unop("cos", jnp.cos)
+tan = _unop("tan", jnp.tan)
+asin = _unop("asin", jnp.arcsin)
+acos = _unop("acos", jnp.arccos)
+atan = _unop("atan", jnp.arctan)
+sinh = _unop("sinh", jnp.sinh)
+cosh = _unop("cosh", jnp.cosh)
+tanh = _unop("tanh", jnp.tanh)
+asinh = _unop("asinh", jnp.arcsinh)
+acosh = _unop("acosh", jnp.arccosh)
+atanh = _unop("atanh", jnp.arctanh)
+deg2rad = _unop("deg2rad", jnp.deg2rad)
+rad2deg = _unop("rad2deg", jnp.rad2deg)
+reciprocal = _unop("reciprocal", jnp.reciprocal)
+square = _unop("square", jnp.square)
+erf = _unop("erf", jax.lax.erf)
+erfinv = _unop("erfinv", jax.lax.erf_inv)
+digamma = _unop("digamma", jax.lax.digamma)
+lgamma = _unop("lgamma", jax.lax.lgamma)
+i0 = _unop("i0", lambda v: jax.lax.bessel_i0e(v) * jnp.exp(jnp.abs(v)))
+i0e = _unop("i0e", jax.lax.bessel_i0e)
+i1 = _unop("i1", lambda v: jax.lax.bessel_i1e(v) * jnp.exp(jnp.abs(v)))
+i1e = _unop("i1e", jax.lax.bessel_i1e)
+isnan = _unop("isnan", jnp.isnan, nondiff=True)
+isinf = _unop("isinf", jnp.isinf, nondiff=True)
+isfinite = _unop("isfinite", jnp.isfinite, nondiff=True)
+angle = _unop("angle", jnp.angle)
+conj = _unop("conj", jnp.conj)
+real = _unop("real", jnp.real)
+imag = _unop("imag", jnp.imag)
+
+
+def multigammaln(x, p, name=None):
+    def impl(v):
+        i = jnp.arange(p, dtype=v.dtype)
+        return (p * (p - 1) / 4.0) * jnp.log(jnp.pi) + jnp.sum(
+            jax.lax.lgamma(v[..., None] - i / 2.0), axis=-1)
+    return op_call("multigammaln", impl, x)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return op_call("stanh", lambda v: scale_b * jnp.tanh(scale_a * v), x)
+
+
+def softplus_math(x, beta=1.0, threshold=20.0, name=None):
+    return op_call("softplus",
+                   lambda v: jnp.where(v * beta > threshold, v,
+                                       jnp.log1p(jnp.exp(beta * v)) / beta), x)
+
+
+def logit(x, eps=None, name=None):
+    def impl(v):
+        vv = v if eps is None else jnp.clip(v, eps, 1.0 - eps)
+        return jnp.log(vv / (1.0 - vv))
+    return op_call("logit", impl, x)
+
+
+def clip(x, min=None, max=None, name=None):
+    lo = min._value if isinstance(min, Tensor) else min
+    hi = max._value if isinstance(max, Tensor) else max
+    return op_call("clip", lambda v: jnp.clip(v, lo, hi), x)
+
+
+def lerp(x, y, weight, name=None):
+    if isinstance(weight, Tensor):
+        return op_call("lerp", lambda a, b, w: a + w * (b - a), x, y, weight)
+    return op_call("lerp", lambda a, b: a + weight * (b - a), x, y)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return op_call("nan_to_num",
+                   lambda v: jnp.nan_to_num(v, nan=nan, posinf=posinf, neginf=neginf), x)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    s = scale._value if isinstance(scale, Tensor) else scale
+    def impl(v):
+        out = v * s + bias if bias_after_scale else (v + bias) * s
+        return out
+    return op_call("scale", impl, x)
+
+
+def scale_(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    out = globals()["scale"](x, scale, bias, bias_after_scale)
+    return x._set_value(out._value)
+
+
+def increment(x, value=1.0, name=None):
+    return x._set_value(x._value + value)
+
+
+def add_(x, y, name=None):
+    return x._set_value(add(x, y)._value)
+
+
+def subtract_(x, y, name=None):
+    return x._set_value(subtract(x, y)._value)
+
+
+def multiply_(x, y, name=None):
+    return x._set_value(multiply(x, y)._value)
+
+
+# -- reductions -------------------------------------------------------------
+def _maybe_cast_reduce_dtype(v, dtype):
+    d = dtype_mod.convert_dtype(dtype)
+    return v.astype(d) if d is not None else v
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return op_call("sum", lambda v: jnp.sum(_maybe_cast_reduce_dtype(v, dtype),
+                                            axis=ax, keepdims=keepdim), x)
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return op_call("mean", lambda v: jnp.mean(v, axis=ax, keepdims=keepdim), x)
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return op_call("nansum", lambda v: jnp.nansum(_maybe_cast_reduce_dtype(v, dtype),
+                                                  axis=ax, keepdims=keepdim), x)
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return op_call("nanmean", lambda v: jnp.nanmean(v, axis=ax, keepdims=keepdim), x)
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return op_call("max", lambda v: jnp.max(v, axis=ax, keepdims=keepdim), x)
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return op_call("min", lambda v: jnp.min(v, axis=ax, keepdims=keepdim), x)
+
+
+amax = max
+amin = min
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    ax = _axis(axis)
+    return op_call("prod", lambda v: jnp.prod(_maybe_cast_reduce_dtype(v, dtype),
+                                              axis=ax, keepdims=keepdim), x)
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return op_call("logsumexp", lambda v: jax.scipy.special.logsumexp(v, axis=ax, keepdims=keepdim), x)
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return op_call("count_nonzero",
+                   lambda v: jnp.count_nonzero(v, axis=ax, keepdims=keepdim).astype(jnp.int64),
+                   x, nondiff=True)
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    def impl(v):
+        vv = _maybe_cast_reduce_dtype(v, dtype)
+        if axis is None:
+            return jnp.cumsum(vv.reshape(-1))
+        return jnp.cumsum(vv, axis=_axis(axis))
+    return op_call("cumsum", impl, x)
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    def impl(v):
+        vv = _maybe_cast_reduce_dtype(v, dtype)
+        if dim is None:
+            return jnp.cumprod(vv.reshape(-1))
+        return jnp.cumprod(vv, axis=_axis(dim))
+    return op_call("cumprod", impl, x)
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    ax = -1 if axis is None else _axis(axis)
+    v = x._value.reshape(-1) if axis is None else x._value
+    vals = op_call("cummax", lambda t: jax.lax.cummax(t, axis=ax if ax >= 0 else t.ndim + ax),
+                   Tensor(v, stop_gradient=x.stop_gradient) if axis is None else x)
+    # index of running max: positions where value equals running max, take last
+    n = v.shape[ax]
+    pos = jnp.arange(n).reshape([-1 if i == (ax % v.ndim) else 1 for i in range(v.ndim)])
+    eq = (v == vals._value)
+    ind = jax.lax.cummax(jnp.where(eq, pos, -1), axis=ax % v.ndim)
+    return vals, Tensor(ind.astype(dtype_mod.convert_dtype(dtype)))
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    ax = -1 if axis is None else _axis(axis)
+    v = x._value.reshape(-1) if axis is None else x._value
+    vals = op_call("cummin", lambda t: jax.lax.cummin(t, axis=ax if ax >= 0 else t.ndim + ax),
+                   Tensor(v, stop_gradient=x.stop_gradient) if axis is None else x)
+    n = v.shape[ax]
+    pos = jnp.arange(n).reshape([-1 if i == (ax % v.ndim) else 1 for i in range(v.ndim)])
+    eq = (v == vals._value)
+    ind = jax.lax.cummax(jnp.where(eq, pos, -1), axis=ax % v.ndim)
+    return vals, Tensor(ind.astype(dtype_mod.convert_dtype(dtype)))
+
+
+# -- linear-algebra-lite (kept here to mirror paddle.tensor.math) -----------
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return op_call("addmm", lambda i, a, b: beta * i + alpha * (a @ b), input, x, y)
+
+
+def outer(x, y, name=None):
+    return op_call("outer", lambda a, b: jnp.outer(a, b), x, y)
+
+
+def inner(x, y, name=None):
+    return op_call("inner", lambda a, b: jnp.inner(a, b), x, y)
+
+
+def dot(x, y, name=None):
+    def impl(a, b):
+        if a.ndim == 1:
+            return jnp.sum(a * b)
+        return jnp.sum(a * b, axis=-1)
+    return op_call("dot", impl, x, y)
+
+
+def cross(x, y, axis=None, name=None):
+    ax = 9 if axis is None else _axis(axis)  # paddle default: first dim of size 3
+    def impl(a, b):
+        axis_ = ax
+        if axis_ == 9:
+            for i, s in enumerate(a.shape):
+                if s == 3:
+                    axis_ = i
+                    break
+        return jnp.cross(a, b, axis=axis_)
+    return op_call("cross", impl, x, y)
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    if x is not None:
+        return op_call("trapezoid", lambda yy, xx: jax.scipy.integrate.trapezoid(yy, xx, axis=axis), y, x)
+    d = 1.0 if dx is None else dx
+    return op_call("trapezoid", lambda yy: jax.scipy.integrate.trapezoid(yy, dx=d, axis=axis), y)
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    pre = prepend._value if isinstance(prepend, Tensor) else prepend
+    app = append._value if isinstance(append, Tensor) else append
+    return op_call("diff", lambda v: jnp.diff(v, n=n, axis=axis, prepend=pre, append=app), x)
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    def impl(v):
+        dims = [i for i in range(v.ndim) if i != axis % v.ndim]
+        norms = jnp.sum(jnp.abs(v) ** p, axis=dims, keepdims=True) ** (1.0 / p)
+        factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+        return v * factor
+    return op_call("renorm", impl, x)
+
+
+def ldexp(x, y, name=None):
+    return op_call("ldexp", lambda a, b: a * (2.0 ** b.astype(jnp.float32)), x, y)
+
+
+def take(x, index, mode="raise", name=None):
+    def impl(v, idx):
+        flat = v.reshape(-1)
+        n = flat.shape[0]
+        ii = idx.astype(jnp.int32)
+        if mode == "wrap":
+            ii = jnp.mod(ii, n)
+        elif mode == "clip":
+            ii = jnp.clip(ii, -n, n - 1)
+        ii = jnp.where(ii < 0, ii + n, ii)
+        return flat[ii]
+    return op_call("take", impl, x, index)
+
+
+def vander(x, n=None, increasing=False, name=None):
+    return op_call("vander", lambda v: jnp.vander(v, N=n, increasing=increasing), x)
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    import itertools
+    v = x._value
+    n = v.shape[0]
+    it = itertools.combinations_with_replacement(range(n), r) if with_replacement \
+        else itertools.combinations(range(n), r)
+    idx = np.array(list(it), dtype=np.int32)
+    if idx.size == 0:
+        return Tensor(jnp.zeros((0, r), v.dtype))
+    return op_call("combinations", lambda vv: vv[jnp.asarray(idx)], x)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    side = "right" if right else "left"
+    d = jnp.int32 if out_int32 else jnp.int64
+    return op_call("bucketize",
+                   lambda v, s: jnp.searchsorted(s, v, side=side).astype(d),
+                   x, sorted_sequence, nondiff=True)

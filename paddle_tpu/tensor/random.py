@@ -1,0 +1,131 @@
+"""Random ops (reference: python/paddle/tensor/random.py).
+
+Counter-based jax.random keys drawn from the default Generator replace the
+reference's Philox seed/offset state (paddle/phi/core/generator.h:32).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..core import dtype as dtype_mod
+from ..core.random import split_key
+
+__all__ = [
+    "rand", "randn", "normal", "uniform", "randint", "randint_like", "randperm",
+    "bernoulli", "multinomial", "poisson", "standard_normal", "standard_gamma",
+    "exponential_", "uniform_", "normal_", "binomial", "log_normal",
+]
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(shape.numpy().tolist())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s._value) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def _dt(dtype):
+    d = dtype_mod.convert_dtype(dtype)
+    return d if d is not None else dtype_mod.default_float_dtype()
+
+
+def rand(shape, dtype=None, name=None):
+    return Tensor(jax.random.uniform(split_key(), _shape(shape), _dt(dtype)))
+
+
+def randn(shape, dtype=None, name=None):
+    return Tensor(jax.random.normal(split_key(), _shape(shape), _dt(dtype)))
+
+
+standard_normal = randn
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._value if isinstance(mean, Tensor) else mean
+        s = std._value if isinstance(std, Tensor) else std
+        shp = np.broadcast_shapes(np.shape(m), np.shape(s))
+        return Tensor(jax.random.normal(split_key(), shp, dtype_mod.default_float_dtype()) * s + m)
+    shp = _shape(shape if shape is not None else [1])
+    return Tensor(jax.random.normal(split_key(), shp, dtype_mod.default_float_dtype()) * std + mean)
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    return x._set_value(jax.random.normal(split_key(), tuple(x.shape), x._value.dtype) * std + mean)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.PRNGKey(seed) if seed else split_key()
+    return Tensor(jax.random.uniform(key, _shape(shape), _dt(dtype), min, max))
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.PRNGKey(seed) if seed else split_key()
+    return x._set_value(jax.random.uniform(key, tuple(x.shape), x._value.dtype, min, max))
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(split_key(), _shape(shape), low, high,
+                                     dtype=dtype_mod.convert_dtype(dtype)))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    d = dtype_mod.convert_dtype(dtype) or x._value.dtype
+    out = jax.random.randint(split_key(), tuple(x.shape), low, high, dtype=jnp.int32)
+    return Tensor(out.astype(d))
+
+
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(split_key(), n).astype(dtype_mod.convert_dtype(dtype)))
+
+
+def bernoulli(x, name=None):
+    p = x._value
+    return Tensor(jax.random.bernoulli(split_key(), p).astype(p.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    v = x._value
+    logits = jnp.log(jnp.clip(v, 1e-30, None))
+    if v.ndim == 1:
+        out = jax.random.choice(split_key(), v.shape[0], (num_samples,),
+                                replace=replacement, p=v / v.sum())
+        return Tensor(out.astype(jnp.int64))
+    keys = jax.random.split(split_key(), v.shape[0])
+    def one(k, row):
+        return jax.random.choice(k, v.shape[1], (num_samples,), replace=replacement,
+                                 p=row / row.sum())
+    return Tensor(jax.vmap(one)(keys, v).astype(jnp.int64))
+
+
+def poisson(x, name=None):
+    return Tensor(jax.random.poisson(split_key(), x._value).astype(x._value.dtype))
+
+
+def binomial(count, prob, name=None):
+    c = count._value if isinstance(count, Tensor) else jnp.asarray(count)
+    p = prob._value if isinstance(prob, Tensor) else jnp.asarray(prob)
+    return Tensor(jax.random.binomial(split_key(), c.astype(jnp.float32), p).astype(jnp.int64))
+
+
+def standard_gamma(alpha, name=None):
+    a = alpha._value if isinstance(alpha, Tensor) else jnp.asarray(alpha)
+    return Tensor(jax.random.gamma(split_key(), a))
+
+
+def exponential_(x, lam=1.0, name=None):
+    return x._set_value(jax.random.exponential(split_key(), tuple(x.shape),
+                                               x._value.dtype) / lam)
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, name=None):
+    shp = _shape(shape if shape is not None else [1])
+    return Tensor(jnp.exp(jax.random.normal(split_key(), shp) * std + mean))

@@ -1,0 +1,157 @@
+"""Search / sort ops (reference: python/paddle/tensor/search.py).
+
+Differentiable values are produced via argsort + take_along_axis so that
+integer-output ops stay out of the vjp path.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..core.dispatch import op_call
+
+__all__ = [
+    "argmax", "argmin", "argsort", "sort", "topk", "searchsorted", "nonzero",
+    "index_sample", "masked_select", "where", "kthvalue", "mode", "median",
+    "nanmedian", "quantile", "nanquantile", "bincount", "histogram_bin_edges",
+]
+
+from .manipulation import masked_select, where, nonzero  # re-export
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    from ..core.dtype import convert_dtype
+    d = convert_dtype(dtype)
+    def impl(v):
+        if axis is None:
+            out = jnp.argmax(v.reshape(-1))
+            return out.reshape((1,) * v.ndim).squeeze() if not keepdim else out.reshape((1,) * v.ndim)
+        return jnp.argmax(v, axis=axis, keepdims=keepdim).astype(d)
+    return op_call("argmax", impl, x, nondiff=True)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    from ..core.dtype import convert_dtype
+    d = convert_dtype(dtype)
+    def impl(v):
+        if axis is None:
+            return jnp.argmin(v.reshape(-1)).astype(d)
+        return jnp.argmin(v, axis=axis, keepdims=keepdim).astype(d)
+    return op_call("argmin", impl, x, nondiff=True)
+
+
+def argsort(x, axis=-1, descending=False, stable=True, name=None):
+    def impl(v):
+        idx = jnp.argsort(v, axis=axis, stable=stable, descending=descending)
+        return idx.astype(jnp.int64)
+    return op_call("argsort", impl, x, nondiff=True)
+
+
+def sort(x, axis=-1, descending=False, stable=True, name=None):
+    idx = argsort(x, axis=axis, descending=descending, stable=stable)
+    from .manipulation import take_along_axis
+    return take_along_axis(x, idx, axis=axis)
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    kk = int(k._value) if isinstance(k, Tensor) else int(k)
+    def impl_idx(v):
+        ax = axis % v.ndim
+        vv = jnp.moveaxis(v, ax, -1)
+        if largest:
+            _, idx = jax.lax.top_k(vv, kk)
+        else:
+            _, idx = jax.lax.top_k(-vv, kk)
+        return jnp.moveaxis(idx, -1, ax).astype(jnp.int64)
+    indices = op_call("topk_idx", impl_idx, x, nondiff=True)
+    from .manipulation import take_along_axis
+    values = take_along_axis(x, indices, axis=axis)
+    return values, indices
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    side = "right" if right else "left"
+    d = jnp.int32 if out_int32 else jnp.int64
+    def impl(s, v):
+        if s.ndim == 1:
+            return jnp.searchsorted(s, v, side=side).astype(d)
+        flat_s = s.reshape(-1, s.shape[-1])
+        flat_v = v.reshape(-1, v.shape[-1])
+        out = jax.vmap(lambda ss, vv: jnp.searchsorted(ss, vv, side=side))(flat_s, flat_v)
+        return out.reshape(v.shape).astype(d)
+    return op_call("searchsorted", impl, sorted_sequence, values, nondiff=True)
+
+
+def index_sample(x, index, name=None):
+    from .manipulation import take_along_axis
+    return take_along_axis(x, index, axis=1)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def impl_idx(v):
+        idx = jnp.argsort(v, axis=axis)
+        sel = jnp.take(idx, k - 1, axis=axis)
+        return jnp.expand_dims(sel, axis).astype(jnp.int64)
+    indices = op_call("kthvalue_idx", impl_idx, x, nondiff=True)
+    from .manipulation import take_along_axis, squeeze
+    values = take_along_axis(x, indices, axis=axis)
+    if not keepdim:
+        values = squeeze(values, axis)
+        indices = squeeze(indices, axis)
+    return values, indices
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    v = np.asarray(x._value)
+    from scipy import stats
+    m = stats.mode(v, axis=axis, keepdims=keepdim)
+    return Tensor(jnp.asarray(m.mode)), Tensor(jnp.asarray(m.count).astype(np.int64))
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    def impl(v):
+        if mode == "avg":
+            return jnp.median(v, axis=axis, keepdims=keepdim)
+        n = v.shape[axis] if axis is not None else v.size
+        srt = jnp.sort(v.reshape(-1) if axis is None else v, axis=0 if axis is None else axis)
+        mid = (n - 1) // 2
+        return jnp.take(srt, mid, axis=0 if axis is None else axis)
+    return op_call("median", impl, x)
+
+
+def nanmedian(x, axis=None, keepdim=False, mode="avg", name=None):
+    return op_call("nanmedian", lambda v: jnp.nanmedian(v, axis=axis, keepdims=keepdim), x)
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    qq = q._value if isinstance(q, Tensor) else jnp.asarray(q)
+    return op_call("quantile",
+                   lambda v: jnp.quantile(v, qq, axis=axis, keepdims=keepdim,
+                                          method=interpolation), x)
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    qq = q._value if isinstance(q, Tensor) else jnp.asarray(q)
+    return op_call("nanquantile",
+                   lambda v: jnp.nanquantile(v, qq, axis=axis, keepdims=keepdim,
+                                             method=interpolation), x)
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    if weights is not None:
+        return op_call("bincount",
+                       lambda v, w: jnp.bincount(v, weights=w, minlength=minlength,
+                                                 length=max(int(np.asarray(v).max(initial=0)) + 1, minlength, 1)),
+                       x, weights, nondiff=True)
+    v = np.asarray(x._value)
+    length = max(int(v.max(initial=0)) + 1, minlength, 1)
+    return op_call("bincount", lambda t: jnp.bincount(t, minlength=minlength, length=length),
+                   x, nondiff=True)
+
+
+def histogram_bin_edges(x, bins=100, min=0, max=0, name=None):
+    v = np.asarray(x._value)
+    rng = None if (min == 0 and max == 0) else (min, max)
+    return Tensor(jnp.asarray(np.histogram_bin_edges(v, bins=bins, range=rng)))

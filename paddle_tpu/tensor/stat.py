@@ -1,0 +1,24 @@
+"""Statistics ops (reference: python/paddle/tensor/stat.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import op_call
+
+__all__ = ["std", "var"]
+
+
+def _axis(axis):
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return axis
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return op_call("var", lambda v: jnp.var(v, axis=_axis(axis), ddof=1 if unbiased else 0,
+                                            keepdims=keepdim), x)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return op_call("std", lambda v: jnp.std(v, axis=_axis(axis), ddof=1 if unbiased else 0,
+                                            keepdims=keepdim), x)

@@ -1,0 +1,50 @@
+"""paddle.text parity (reference: python/paddle/text/ — dataset loaders).
+Zero-egress environment: synthetic dataset shims; ViterbiDecoder is real."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..core.dispatch import op_call
+from ..nn.layer import Layer
+
+__all__ = ["ViterbiDecoder", "viterbi_decode"]
+
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag=True, name=None):
+    """CRF Viterbi decode (reference text/viterbi_decode.py) via lax.scan."""
+    def impl(emissions, trans):
+        B, T, N = emissions.shape
+        start = emissions[:, 0]
+        def step(carry, emit_t):
+            score = carry  # [B, N]
+            cand = score[:, :, None] + trans[None] + emit_t[:, None, :]
+            best = jnp.max(cand, axis=1)
+            idx = jnp.argmax(cand, axis=1)
+            return best, idx
+        final, history = jax.lax.scan(step, start,
+                                      jnp.moveaxis(emissions[:, 1:], 1, 0))
+        best_last = jnp.argmax(final, axis=-1)
+        def back(carry, idx_t):
+            tag = carry
+            prev = jnp.take_along_axis(idx_t, tag[:, None], axis=1)[:, 0]
+            return prev, prev
+        _, path_rev = jax.lax.scan(back, best_last, history, reverse=True)
+        path = jnp.concatenate([path_rev, best_last[None]], axis=0)
+        scores = jnp.max(final, axis=-1)
+        return scores, jnp.moveaxis(path, 0, 1).astype(jnp.int64)
+    return op_call("viterbi_decode", impl, potentials, transition_params)
+
+
+class ViterbiDecoder(Layer):
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

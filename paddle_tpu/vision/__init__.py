@@ -1,0 +1,5 @@
+"""paddle.vision parity (reference: python/paddle/vision/)."""
+from . import models  # noqa: F401
+from . import transforms  # noqa: F401
+from . import datasets  # noqa: F401
+from . import ops  # noqa: F401

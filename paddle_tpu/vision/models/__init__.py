@@ -1,0 +1,4 @@
+from .resnet import (ResNet, BasicBlock, BottleneckBlock, resnet18, resnet34,  # noqa: F401
+                     resnet50, resnet101, resnet152, wide_resnet50_2,
+                     wide_resnet101_2, resnext50_32x4d, resnext101_32x4d)
+from .vit import VisionTransformer, vit_b_16, vit_l_16  # noqa: F401

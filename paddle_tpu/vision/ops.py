@@ -1,0 +1,51 @@
+"""Vision ops (reference: python/paddle/vision/ops.py) — detection helpers."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..core.dispatch import op_call
+
+__all__ = ["nms", "box_coder", "roi_align", "deform_conv2d"]
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Host-side NMS (dynamic output size — eager only, like the reference's
+    dygraph-only detection ops)."""
+    b = np.asarray(boxes._value)
+    s = np.asarray(scores._value) if scores is not None else np.arange(len(b))[::-1]
+    order = np.argsort(-s)
+    keep = []
+    while order.size > 0:
+        i = order[0]
+        keep.append(i)
+        if order.size == 1:
+            break
+        xx1 = np.maximum(b[i, 0], b[order[1:], 0])
+        yy1 = np.maximum(b[i, 1], b[order[1:], 1])
+        xx2 = np.minimum(b[i, 2], b[order[1:], 2])
+        yy2 = np.minimum(b[i, 3], b[order[1:], 3])
+        inter = np.clip(xx2 - xx1, 0, None) * np.clip(yy2 - yy1, 0, None)
+        a1 = (b[i, 2] - b[i, 0]) * (b[i, 3] - b[i, 1])
+        a2 = (b[order[1:], 2] - b[order[1:], 0]) * (b[order[1:], 3] - b[order[1:], 1])
+        iou = inter / (a1 + a2 - inter + 1e-10)
+        order = order[1:][iou <= iou_threshold]
+    keep = np.asarray(keep[:top_k] if top_k else keep, dtype=np.int64)
+    return Tensor(jnp.asarray(keep))
+
+
+def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_size",
+              box_normalized=True, axis=0):
+    raise NotImplementedError("box_coder lands with the detection model family")
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True):
+    raise NotImplementedError("roi_align lands with the detection model family")
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0, dilation=1,
+                  deformable_groups=1, groups=1, mask=None):
+    raise NotImplementedError("deform_conv2d lands with the detection model family")

@@ -1,0 +1,24 @@
+"""Test configuration: force an 8-virtual-device CPU platform BEFORE jax
+import (the gloo/fake-device analog — SURVEY.md §4 test strategy)."""
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+# the environment's TPU tunnel plugin force-appends itself to jax_platforms;
+# pin CPU explicitly so tests always run on the 8-device virtual mesh
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reseed():
+    import paddle_tpu
+    paddle_tpu.seed(2024)
+    yield

@@ -1,0 +1,117 @@
+"""OpTest harness (reference: test/legacy_test/op_test.py:418).
+
+Same contract as the reference: supply inputs + a numpy reference; the harness
+runs the op (1) eager, (2) under jax.jit (the static-graph mode analog), and
+(3) checks analytic grads from the tape against numeric finite differences.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+
+
+def _tree_np(out):
+    if isinstance(out, Tensor):
+        return np.asarray(out.numpy())
+    if isinstance(out, (list, tuple)):
+        return [_tree_np(o) for o in out]
+    return out
+
+
+def check_output(fn, np_ref, args=(), kwargs=None, rtol=2e-4, atol=1e-5,
+                 check_jit=True):
+    """fn: framework op over Tensors; np_ref: same op over numpy arrays."""
+    kwargs = kwargs or {}
+    t_args = [paddle.to_tensor(a) if isinstance(a, np.ndarray) else a for a in args]
+    out = fn(*t_args, **kwargs)
+    ref = np_ref(*[a for a in args], **kwargs)
+    _assert_tree_close(out, ref, rtol, atol, "eager")
+    if check_jit:
+        jitted = jax.jit(lambda *vals: _tree_vals(
+            fn(*[Tensor(v) if i in _tensor_idx(args) else args[i]
+                 for i, v in _zip_vals(args, vals)], **kwargs)))
+        vals = [a for a in args if isinstance(a, np.ndarray)]
+        jout = jitted(*vals)
+        _assert_vals_close(jout, ref, rtol, atol, "jit")
+    return out
+
+
+def _tensor_idx(args):
+    return [i for i, a in enumerate(args) if isinstance(a, np.ndarray)]
+
+
+def _zip_vals(args, vals):
+    vi = iter(range(len(vals)))
+    out = []
+    k = 0
+    for i, a in enumerate(args):
+        if isinstance(a, np.ndarray):
+            out.append((i, vals[k]))
+            k += 1
+        else:
+            out.append((i, None))
+    return out
+
+
+def _tree_vals(out):
+    if isinstance(out, Tensor):
+        return out._value
+    if isinstance(out, (list, tuple)):
+        return [_tree_vals(o) for o in out]
+    return out
+
+
+def _assert_tree_close(out, ref, rtol, atol, tag):
+    if isinstance(ref, (list, tuple)):
+        for o, r in zip(out, ref):
+            _assert_tree_close(o, r, rtol, atol, tag)
+        return
+    o = np.asarray(out.numpy() if isinstance(out, Tensor) else out, dtype=np.float64) \
+        if np.asarray(ref).dtype.kind in "fc" else np.asarray(
+            out.numpy() if isinstance(out, Tensor) else out)
+    np.testing.assert_allclose(o, ref, rtol=rtol, atol=atol,
+                               err_msg=f"[{tag}] mismatch")
+
+
+def _assert_vals_close(out, ref, rtol, atol, tag):
+    if isinstance(ref, (list, tuple)):
+        for o, r in zip(out, ref):
+            _assert_vals_close(o, r, rtol, atol, tag)
+        return
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=rtol, atol=atol,
+                               err_msg=f"[{tag}] mismatch")
+
+
+def check_grad(fn, args, arg_idx=0, kwargs=None, eps=1e-3, rtol=2e-2, atol=5e-3,
+               reduce_to_scalar=True):
+    """Numeric-vs-analytic grad check through the tape (op_test.py check_grad
+    analog). Uses float64-ish central differences on float32 inputs."""
+    kwargs = kwargs or {}
+    t_args = [paddle.to_tensor(a, stop_gradient=False)
+              if isinstance(a, np.ndarray) else a for a in args]
+    out = fn(*t_args, **kwargs)
+    loss = out.sum() if reduce_to_scalar else out
+    loss.backward()
+    analytic = np.asarray(t_args[arg_idx].grad.numpy(), dtype=np.float64)
+
+    base = np.asarray(args[arg_idx], dtype=np.float64)
+    numeric = np.zeros_like(base)
+    it = np.nditer(base, flags=["multi_index"])
+    while not it.finished:
+        idx = it.multi_index
+        for sgn in (+1, -1):
+            pert = base.copy()
+            pert[idx] += sgn * eps
+            new_args = list(args)
+            new_args[arg_idx] = pert.astype(np.asarray(args[arg_idx]).dtype)
+            tt = [paddle.to_tensor(a) if isinstance(a, np.ndarray) else a
+                  for a in new_args]
+            val = float(fn(*tt, **kwargs).sum().numpy())
+            numeric[idx] += sgn * val
+        numeric[idx] /= (2 * eps)
+        it.iternext()
+    np.testing.assert_allclose(analytic, numeric, rtol=rtol, atol=atol,
+                               err_msg=f"grad mismatch for arg {arg_idx}")

@@ -1,0 +1,210 @@
+"""Core Tensor + autograd tape tests (reference analog: eager unit tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor, Parameter
+
+
+def test_to_tensor_basic():
+    t = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert t.shape == [2, 2]
+    assert t.dtype == np.float32
+    assert t.stop_gradient
+    np.testing.assert_array_equal(t.numpy(), [[1, 2], [3, 4]])
+
+
+def test_dtypes_and_cast():
+    t = paddle.to_tensor([1, 2, 3])
+    assert t.dtype in (np.int32, np.int64)
+    f = t.astype("float32")
+    assert f.dtype == np.float32
+    b = f.astype(paddle.bfloat16)
+    assert str(b.dtype) == "bfloat16"
+
+
+def test_arith_dunders():
+    a = paddle.to_tensor([1.0, 2.0])
+    b = paddle.to_tensor([3.0, 4.0])
+    np.testing.assert_allclose((a + b).numpy(), [4, 6])
+    np.testing.assert_allclose((a - b).numpy(), [-2, -2])
+    np.testing.assert_allclose((a * b).numpy(), [3, 8])
+    np.testing.assert_allclose((b / a).numpy(), [3, 2])
+    np.testing.assert_allclose((2.0 - a).numpy(), [1, 0])
+    np.testing.assert_allclose((a ** 2).numpy(), [1, 4])
+    np.testing.assert_allclose((-a).numpy(), [-1, -2])
+    assert bool((a < b).numpy().all())
+
+
+def test_indexing():
+    t = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+    np.testing.assert_allclose(t[0].numpy(), [0, 1, 2, 3])
+    np.testing.assert_allclose(t[1, 2].numpy(), 6)
+    np.testing.assert_allclose(t[:, 1].numpy(), [1, 5, 9])
+    idx = paddle.to_tensor([0, 2])
+    np.testing.assert_allclose(t[idx].numpy(), [[0, 1, 2, 3], [8, 9, 10, 11]])
+
+
+def test_setitem():
+    t = paddle.to_tensor(np.zeros((3, 3), np.float32))
+    t[1] = 5.0
+    np.testing.assert_allclose(t.numpy()[1], [5, 5, 5])
+
+
+def test_backward_simple():
+    x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+    y = (x * x * 3.0).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [12.0, 18.0])
+
+
+def test_backward_chain_and_accumulate():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    a = x * 2.0
+    b = a + x  # x used twice
+    loss = (b * b).sum()
+    loss.backward()
+    # b = 3x, loss = 9x^2, dloss/dx = 18x
+    np.testing.assert_allclose(x.grad.numpy(), [18.0, 36.0])
+
+
+def test_backward_twice_raises_without_retain():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward(retain_graph=True)
+    y.backward()  # ok with retain on first call
+    x2 = paddle.to_tensor([1.0], stop_gradient=False)
+    y2 = (x2 * x2).sum()
+    y2.backward()
+    with pytest.raises(RuntimeError):
+        y2.backward()
+
+
+def test_grad_api_partial():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = paddle.to_tensor([3.0, 4.0], stop_gradient=False)
+    z = (x * y).sum()
+    gx = paddle.grad(z, x, retain_graph=True)
+    np.testing.assert_allclose(gx.numpy(), [3.0, 4.0])
+    # y.grad not polluted by paddle.grad
+    assert y.grad is None
+
+
+def test_no_grad():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+    assert y._grad_node is None
+
+
+def test_register_hook_scales_grad():
+    x = paddle.to_tensor([1.0, 1.0], stop_gradient=False)
+    h = x.register_hook(lambda g: g * 2)
+    (x * 3.0).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [6.0, 6.0])
+    h.remove()
+
+
+def test_detach_and_clone():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    d = x.detach()
+    assert d.stop_gradient
+    c = x.clone()
+    s = (c * 2).sum()
+    s.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+def test_parameter_defaults():
+    import jax.numpy as jnp
+    p = Parameter(jnp.ones((2, 2)))
+    assert not p.stop_gradient
+    assert p.trainable
+
+
+def test_pylayer():
+    from paddle_tpu.autograd import PyLayer
+
+    class Double(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * 2
+
+        @staticmethod
+        def backward(ctx, dy):
+            return dy * 10  # deliberately wrong scale to prove custom bwd runs
+
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = Double.apply(x)
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [10.0])
+
+
+def test_save_load(tmp_path):
+    x = paddle.to_tensor([[1.0, 2.0]])
+    obj = {"w": x, "meta": {"epoch": 3}, "lst": [x, 1.5]}
+    p = str(tmp_path / "ckpt.pdparams")
+    paddle.save(obj, p)
+    loaded = paddle.load(p)
+    np.testing.assert_allclose(loaded["w"].numpy(), x.numpy())
+    assert loaded["meta"]["epoch"] == 3
+    assert loaded["lst"][1] == 1.5
+
+
+def test_seed_determinism():
+    paddle.seed(7)
+    a = paddle.randn([4])
+    paddle.seed(7)
+    b = paddle.randn([4])
+    np.testing.assert_allclose(a.numpy(), b.numpy())
+
+
+def test_flags():
+    paddle.set_flags({"FLAGS_check_nan_inf": True})
+    assert paddle.get_flags(["FLAGS_check_nan_inf"])["FLAGS_check_nan_inf"]
+    import jax.numpy as jnp
+    x = paddle.to_tensor([1.0])
+    with pytest.raises(FloatingPointError):
+        _ = x / 0.0 * 0.0  # inf then nan
+    paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def test_hook_fires_once_on_accumulated_grad():
+    # x used twice: hook must see the FINAL grad (5), not per-edge partials
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    seen = []
+    x.register_hook(lambda g: seen.append(float(g.numpy())))
+    y = x * 2.0 + x * 3.0
+    y.sum().backward()
+    assert seen == [5.0], seen
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+
+
+def test_set_grad_enabled_context_restores():
+    from paddle_tpu import set_grad_enabled, is_grad_enabled
+    assert is_grad_enabled()
+    with set_grad_enabled(False):
+        assert not is_grad_enabled()
+    assert is_grad_enabled()
+    # immediate-effect (non-context) usage
+    g = set_grad_enabled(False)
+    assert not is_grad_enabled()
+    g.__exit__()
+    assert is_grad_enabled()
+
+
+def test_split_rejects_uneven():
+    t = paddle.to_tensor(np.arange(10.0, dtype=np.float32))
+    with pytest.raises(ValueError):
+        paddle.split(t, 3)
+    parts = paddle.tensor_split(t, 3)
+    assert [p.shape[0] for p in parts] == [4, 3, 3]
+
+
+def test_state_dict_with_prefix_and_buffer():
+    from paddle_tpu import nn
+    l = nn.BatchNorm1D(4)
+    sd = l.state_dict(structured_name_prefix="model.")
+    assert any(k.startswith("model.") and k.endswith("_mean") for k in sd)

@@ -1,0 +1,157 @@
+"""jit.to_static, jit.save/load, DataLoader tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.io import DataLoader, Dataset, TensorDataset, BatchSampler
+
+rng = np.random.default_rng(5)
+
+
+def _x(*shape):
+    return rng.standard_normal(shape).astype(np.float32)
+
+
+def test_to_static_function():
+    calls = []
+
+    @paddle.jit.to_static
+    def f(x, y):
+        calls.append(1)
+        return x * y + 2.0
+
+    a = paddle.to_tensor(_x(3, 3))
+    b = paddle.to_tensor(_x(3, 3))
+    out1 = f(a, b)
+    out2 = f(a, b)  # cached — python body runs once per signature
+    np.testing.assert_allclose(out1.numpy(), a.numpy() * b.numpy() + 2.0, rtol=1e-5)
+    np.testing.assert_allclose(out2.numpy(), out1.numpy())
+    assert len(calls) == 1
+
+
+def test_to_static_layer_params_not_constants():
+    l = nn.Linear(4, 2)
+    sf = paddle.jit.to_static(l)
+    x = paddle.to_tensor(_x(3, 4))
+    out1 = l(x)
+    ref1 = x.numpy() @ l.weight.numpy() + l.bias.numpy()
+    np.testing.assert_allclose(out1.numpy(), ref1, rtol=1e-4)
+    # mutate weights: compiled fn must see the new values (no retrace needed)
+    l.weight._set_value(l.weight._value * 2.0)
+    out2 = l(x)
+    ref2 = x.numpy() @ l.weight.numpy() + l.bias.numpy()
+    np.testing.assert_allclose(out2.numpy(), ref2, rtol=1e-4)
+
+
+def test_to_static_bn_buffer_update():
+    bn = nn.BatchNorm1D(4)
+    bn.train()
+    sf = paddle.jit.to_static(bn)
+    x = paddle.to_tensor(_x(8, 4, 5))
+    before = bn._mean.numpy().copy()
+    bn(x)
+    after = bn._mean.numpy()
+    assert not np.allclose(before, after)
+
+
+def test_jit_save_load(tmp_path):
+    from paddle_tpu.static import InputSpec
+    l = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    path = str(tmp_path / "model")
+    paddle.jit.save(l, path, input_spec=[InputSpec([1, 4], "float32")])
+    loaded = paddle.jit.load(path)
+    x = paddle.to_tensor(_x(1, 4))
+    np.testing.assert_allclose(loaded(x).numpy(), l(x).numpy(), rtol=1e-5)
+
+
+def test_dataset_dataloader():
+    class Sq(Dataset):
+        def __len__(self):
+            return 10
+
+        def __getitem__(self, i):
+            return np.float32([i]), np.int64(i % 2)
+
+    dl = DataLoader(Sq(), batch_size=4, shuffle=False)
+    batches = list(dl)
+    assert len(batches) == 3
+    x, y = batches[0]
+    assert x.shape == [4, 1]
+    np.testing.assert_allclose(x.numpy().reshape(-1), [0, 1, 2, 3])
+
+
+def test_dataloader_multiprocess():
+    class Sq(Dataset):
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            return np.float32([i * 2])
+
+    dl = DataLoader(Sq(), batch_size=2, num_workers=2)
+    got = sorted(float(b.numpy().sum()) for b in dl)
+    assert got == [2.0, 10.0, 18.0, 26.0]
+
+
+def test_tensor_dataset_and_sampler():
+    xs = paddle.to_tensor(_x(10, 3))
+    ys = paddle.to_tensor(np.arange(10, dtype=np.int64))
+    ds = TensorDataset([xs, ys])
+    dl = DataLoader(ds, batch_size=5)
+    b = next(iter(dl))
+    assert b[0].shape == [5, 3]
+    bs = BatchSampler(ds, batch_size=3, drop_last=True)
+    assert len(bs) == 3
+
+
+def test_distributed_batch_sampler():
+    from paddle_tpu.io import DistributedBatchSampler
+
+    class D(Dataset):
+        def __len__(self):
+            return 10
+
+        def __getitem__(self, i):
+            return np.float32([i])
+
+    s0 = DistributedBatchSampler(D(), batch_size=2, num_replicas=2, rank=0)
+    s1 = DistributedBatchSampler(D(), batch_size=2, num_replicas=2, rank=1)
+    i0 = [i for b in s0 for i in b]
+    i1 = [i for b in s1 for i in b]
+    assert len(i0) == len(i1) == 5
+    assert set(i0).isdisjoint(set(i1) - {0, 1, 2, 3})  # padded overlap allowed
+
+
+def test_static_control_flow():
+    from paddle_tpu.static import nn as snn
+    x = paddle.to_tensor(3.0)
+    out = snn.cond(x > 2.0, lambda: paddle.to_tensor(1.0), lambda: paddle.to_tensor(0.0))
+    assert float(out.numpy()) == 1.0
+    i = paddle.to_tensor(0)
+    ten = paddle.to_tensor(5)
+    res = snn.while_loop(lambda i: i < ten, lambda i: [i + 1], [i])
+    assert int(res[0].numpy()) == 5
+
+
+def test_recompute_matches_plain():
+    from paddle_tpu.distributed.fleet import recompute
+    l1 = nn.Linear(4, 4)
+    l2 = nn.Linear(4, 4)
+    x = paddle.to_tensor(_x(2, 4), stop_gradient=False)
+
+    def block(t):
+        return l2(paddle.tanh(l1(t)))
+
+    out = recompute(block, x)
+    out.sum().backward()
+    g_re = {id(p): p.grad.numpy().copy() for p in list(l1.parameters()) + list(l2.parameters())}
+    gx_re = x.grad.numpy().copy()
+
+    for p in list(l1.parameters()) + list(l2.parameters()):
+        p.clear_grad()
+    x2 = paddle.to_tensor(x.numpy(), stop_gradient=False)
+    block(x2).sum().backward()
+    np.testing.assert_allclose(gx_re, x2.grad.numpy(), rtol=1e-4)
+    for p in list(l1.parameters()) + list(l2.parameters()):
+        np.testing.assert_allclose(g_re[id(p)], p.grad.numpy(), rtol=1e-4)

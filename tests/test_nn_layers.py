@@ -1,0 +1,289 @@
+"""Layer tests vs numpy/torch-free references."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import paddle_tpu.nn.functional as F
+
+rng = np.random.default_rng(3)
+
+
+def _x(*shape):
+    return rng.standard_normal(shape).astype(np.float32)
+
+
+def test_linear_forward_shape_and_math():
+    l = nn.Linear(4, 3)
+    x = _x(2, 4)
+    out = l(paddle.to_tensor(x))
+    ref = x @ l.weight.numpy() + l.bias.numpy()
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+
+
+def test_layer_registry_and_state_dict():
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(4, 8)
+            self.fc2 = nn.Linear(8, 2)
+            self.act = nn.ReLU()
+
+        def forward(self, x):
+            return self.fc2(self.act(self.fc1(x)))
+
+    net = Net()
+    names = [n for n, _ in net.named_parameters()]
+    assert "fc1.weight" in names and "fc2.bias" in names
+    sd = net.state_dict()
+    assert len(sd) == 4
+    net2 = Net()
+    net2.set_state_dict(sd)
+    np.testing.assert_allclose(net2.fc1.weight.numpy(), net.fc1.weight.numpy())
+    # identity preserved on set_state_dict
+    p = net2.fc1.weight
+    net2.set_state_dict(sd)
+    assert net2.fc1.weight is p
+
+
+def test_layer_train_eval_dropout():
+    d = nn.Dropout(0.5)
+    x = paddle.to_tensor(np.ones((100, 100), np.float32))
+    d.train()
+    y = d(x)
+    frac = float((y.numpy() == 0).mean())
+    assert 0.3 < frac < 0.7
+    d.eval()
+    y = d(x)
+    np.testing.assert_allclose(y.numpy(), x.numpy())
+
+
+def test_forward_hooks():
+    l = nn.Linear(2, 2)
+    calls = []
+    h1 = l.register_forward_pre_hook(lambda layer, inp: calls.append("pre"))
+    h2 = l.register_forward_post_hook(lambda layer, inp, out: calls.append("post"))
+    l(paddle.to_tensor(_x(1, 2)))
+    assert calls == ["pre", "post"]
+    h1.remove()
+    h2.remove()
+
+
+def test_conv2d_matches_reference():
+    l = nn.Conv2D(3, 8, 3, stride=1, padding=1)
+    x = _x(2, 3, 8, 8)
+    out = l(paddle.to_tensor(x))
+    assert out.shape == [2, 8, 8, 8]
+    # compare against scipy-style direct computation for one output element
+    w = l.weight.numpy()
+    b = l.bias.numpy()
+    xp = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+    ref00 = (xp[0, :, 0:3, 0:3] * w[0]).sum() + b[0]
+    np.testing.assert_allclose(out.numpy()[0, 0, 0, 0], ref00, rtol=1e-4)
+
+
+def test_conv2d_groups_and_stride():
+    l = nn.Conv2D(4, 8, 3, stride=2, padding=1, groups=2)
+    out = l(paddle.to_tensor(_x(1, 4, 9, 9)))
+    assert out.shape == [1, 8, 5, 5]
+
+
+def test_conv2d_transpose_shape():
+    l = nn.Conv2DTranspose(4, 2, 3, stride=2, padding=1, output_padding=1)
+    out = l(paddle.to_tensor(_x(1, 4, 5, 5)))
+    assert out.shape == [1, 2, 10, 10]
+
+
+def test_batchnorm_train_eval():
+    bn = nn.BatchNorm2D(3)
+    x = _x(4, 3, 5, 5) * 3 + 1
+    bn.train()
+    out = bn(paddle.to_tensor(x))
+    m = out.numpy().mean(axis=(0, 2, 3))
+    np.testing.assert_allclose(m, 0, atol=1e-5)
+    assert not np.allclose(bn._mean.numpy(), 0)  # running stats updated
+    bn.eval()
+    out2 = bn(paddle.to_tensor(x))
+    assert out2.shape == [4, 3, 5, 5]
+
+
+def test_layernorm_rmsnorm():
+    ln = nn.LayerNorm(8)
+    x = _x(2, 4, 8)
+    out = ln(paddle.to_tensor(x))
+    np.testing.assert_allclose(out.numpy().mean(-1), 0, atol=1e-5)
+    np.testing.assert_allclose(out.numpy().std(-1), 1, atol=1e-2)
+    rn = nn.RMSNorm(8)
+    out = rn(paddle.to_tensor(x))
+    ref = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4)
+
+
+def test_groupnorm():
+    gn = nn.GroupNorm(2, 4)
+    out = gn(paddle.to_tensor(_x(2, 4, 3, 3)))
+    assert out.shape == [2, 4, 3, 3]
+
+
+def test_embedding_and_padding_grad():
+    emb = nn.Embedding(10, 4, padding_idx=0)
+    ids = paddle.to_tensor(np.array([[0, 1, 2]], np.int64))
+    out = emb(ids)
+    assert out.shape == [1, 3, 4]
+    out.sum().backward()
+    g = emb.weight.grad.numpy()
+    np.testing.assert_allclose(g[0], 0)  # padding row grad masked
+    np.testing.assert_allclose(g[1], 1)
+
+
+def test_pooling():
+    x = _x(1, 2, 6, 6)
+    mp = nn.MaxPool2D(2)
+    out = mp(paddle.to_tensor(x))
+    ref = x.reshape(1, 2, 3, 2, 3, 2).max((3, 5))
+    np.testing.assert_allclose(out.numpy(), ref)
+    ap = nn.AvgPool2D(2)
+    out = ap(paddle.to_tensor(x))
+    np.testing.assert_allclose(out.numpy(), x.reshape(1, 2, 3, 2, 3, 2).mean((3, 5)),
+                               rtol=1e-5)
+    aap = nn.AdaptiveAvgPool2D((1, 1))
+    np.testing.assert_allclose(aap(paddle.to_tensor(x)).numpy()[..., 0, 0],
+                               x.mean((2, 3)), rtol=1e-5)
+
+
+def test_activations_vs_numpy():
+    x = _x(3, 4)
+    t = paddle.to_tensor(x)
+    np.testing.assert_allclose(F.relu(t).numpy(), np.maximum(x, 0))
+    np.testing.assert_allclose(F.sigmoid(t).numpy(), 1 / (1 + np.exp(-x)), rtol=1e-5)
+    np.testing.assert_allclose(F.silu(t).numpy(), x / (1 + np.exp(-x)), rtol=1e-5)
+    sm = F.softmax(t, axis=-1).numpy()
+    np.testing.assert_allclose(sm.sum(-1), 1, rtol=1e-5)
+    np.testing.assert_allclose(F.leaky_relu(t, 0.1).numpy(),
+                               np.where(x > 0, x, 0.1 * x), rtol=1e-5)
+
+
+def test_cross_entropy_matches_manual():
+    logits = _x(4, 5)
+    labels = np.array([1, 0, 3, 2], np.int64)
+    loss = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(labels))
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    ref = -np.log(p[np.arange(4), labels]).mean()
+    np.testing.assert_allclose(loss.numpy(), ref, rtol=1e-5)
+
+
+def test_cross_entropy_ignore_index_and_soft():
+    logits = _x(4, 5)
+    labels = np.array([1, -100, 3, 2], np.int64)
+    loss = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                           ignore_index=-100)
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    valid = labels != -100
+    ref = -np.log(p[np.arange(4), np.where(valid, labels, 0)])[valid].mean()
+    np.testing.assert_allclose(loss.numpy(), ref, rtol=1e-5)
+    soft = np.abs(_x(4, 5))
+    soft = soft / soft.sum(-1, keepdims=True)
+    loss = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(soft),
+                           soft_label=True)
+    ref = -(soft * np.log(p)).sum(-1).mean()
+    np.testing.assert_allclose(loss.numpy(), ref, rtol=1e-4)
+
+
+def test_losses():
+    a, b = _x(3, 4), _x(3, 4)
+    np.testing.assert_allclose(F.mse_loss(paddle.to_tensor(a), paddle.to_tensor(b)).numpy(),
+                               ((a - b) ** 2).mean(), rtol=1e-5)
+    np.testing.assert_allclose(F.l1_loss(paddle.to_tensor(a), paddle.to_tensor(b)).numpy(),
+                               np.abs(a - b).mean(), rtol=1e-5)
+    p = 1 / (1 + np.exp(-a))
+    y = (b > 0).astype(np.float32)
+    bce = F.binary_cross_entropy(paddle.to_tensor(p), paddle.to_tensor(y))
+    ref = -(y * np.log(p) + (1 - y) * np.log(1 - p)).mean()
+    np.testing.assert_allclose(bce.numpy(), ref, rtol=1e-4)
+    bcel = F.binary_cross_entropy_with_logits(paddle.to_tensor(a), paddle.to_tensor(y))
+    np.testing.assert_allclose(bcel.numpy(), ref, rtol=1e-4)
+
+
+def test_sdpa_matches_reference():
+    q = _x(2, 5, 2, 4)
+    k = _x(2, 5, 2, 4)
+    v = _x(2, 5, 2, 4)
+    out = F.scaled_dot_product_attention(paddle.to_tensor(q), paddle.to_tensor(k),
+                                         paddle.to_tensor(v))
+    # manual reference
+    logits = np.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(4)
+    w = np.exp(logits - logits.max(-1, keepdims=True))
+    w = w / w.sum(-1, keepdims=True)
+    ref = np.einsum("bhqk,bkhd->bqhd", w, v)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_sdpa_causal():
+    q = _x(1, 4, 1, 8)
+    out = F.scaled_dot_product_attention(paddle.to_tensor(q), paddle.to_tensor(q),
+                                         paddle.to_tensor(q), is_causal=True)
+    assert out.shape == [1, 4, 1, 8]
+
+
+def test_multihead_attention_and_transformer():
+    mha = nn.MultiHeadAttention(16, 4)
+    x = paddle.to_tensor(_x(2, 5, 16))
+    out = mha(x)
+    assert out.shape == [2, 5, 16]
+    enc_layer = nn.TransformerEncoderLayer(16, 4, 32)
+    enc = nn.TransformerEncoder(enc_layer, 2)
+    out = enc(x)
+    assert out.shape == [2, 5, 16]
+
+
+def test_sequential_layerlist():
+    s = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    out = s(paddle.to_tensor(_x(3, 4)))
+    assert out.shape == [3, 2]
+    assert len(s) == 3
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    assert len(list(ll.parameters())) == 6
+
+
+def test_lstm_gru():
+    lstm = nn.LSTM(4, 8, num_layers=2)
+    x = paddle.to_tensor(_x(2, 5, 4))
+    out, (h, c) = lstm(x)
+    assert out.shape == [2, 5, 8]
+    assert h.shape == [2, 2, 8]
+    gru = nn.GRU(4, 8, direction="bidirect")
+    out, h = gru(x)
+    assert out.shape == [2, 5, 16]
+
+
+def test_grad_clip():
+    from paddle_tpu.nn import ClipGradByGlobalNorm
+    l = nn.Linear(4, 4)
+    x = paddle.to_tensor(_x(2, 4))
+    (l(x) * 100).sum().backward()
+    clip = ClipGradByGlobalNorm(1.0)
+    pg = clip([(p, p.grad) for p in l.parameters()])
+    total = np.sqrt(sum((g.numpy() ** 2).sum() for _, g in pg))
+    np.testing.assert_allclose(total, 1.0, rtol=1e-4)
+
+
+def test_interpolate():
+    x = _x(1, 2, 4, 4)
+    out = F.interpolate(paddle.to_tensor(x), scale_factor=2, mode="nearest")
+    assert out.shape == [1, 2, 8, 8]
+    np.testing.assert_allclose(out.numpy()[0, 0, ::2, ::2], x[0, 0])
+    out = F.interpolate(paddle.to_tensor(x), size=[8, 8], mode="bilinear")
+    assert out.shape == [1, 2, 8, 8]
+
+
+def test_weight_norm():
+    from paddle_tpu.nn.utils import weight_norm, remove_weight_norm
+    l = nn.Linear(3, 4)
+    w0 = l.weight.numpy().copy()
+    weight_norm(l, dim=1)
+    out = l(paddle.to_tensor(_x(2, 3)))
+    assert out.shape == [2, 4]
+    remove_weight_norm(l)
+    np.testing.assert_allclose(l.weight.numpy(), w0, rtol=1e-5)

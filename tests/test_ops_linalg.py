@@ -1,0 +1,80 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+rng = np.random.default_rng(2)
+
+
+def _x(*shape):
+    return rng.standard_normal(shape).astype(np.float32)
+
+
+def test_matmul_variants():
+    a, b = _x(3, 4), _x(4, 5)
+    np.testing.assert_allclose(paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b)).numpy(),
+                               a @ b, rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(
+        paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b.T), transpose_y=True).numpy(),
+        a @ b, rtol=1e-3, atol=1e-4)
+    bb = _x(2, 3, 4)
+    cc = _x(2, 4, 5)
+    np.testing.assert_allclose(paddle.bmm(paddle.to_tensor(bb), paddle.to_tensor(cc)).numpy(),
+                               bb @ cc, rtol=1e-3, atol=1e-4)
+
+
+def test_einsum():
+    a, b = _x(3, 4), _x(4, 5)
+    np.testing.assert_allclose(paddle.einsum("ij,jk->ik", paddle.to_tensor(a),
+                                             paddle.to_tensor(b)).numpy(),
+                               np.einsum("ij,jk->ik", a, b), rtol=1e-3, atol=1e-4)
+
+
+def test_norms():
+    x = _x(3, 4)
+    t = paddle.to_tensor(x)
+    np.testing.assert_allclose(paddle.norm(t).numpy(), np.linalg.norm(x), rtol=1e-5)
+    np.testing.assert_allclose(paddle.norm(t, p=1, axis=1).numpy(),
+                               np.abs(x).sum(1), rtol=1e-5)
+
+
+def test_decompositions():
+    a = _x(4, 4)
+    spd = a @ a.T + 4 * np.eye(4, dtype=np.float32)
+    L = paddle.cholesky(paddle.to_tensor(spd))
+    np.testing.assert_allclose(L.numpy() @ L.numpy().T, spd, rtol=1e-3, atol=1e-3)
+    q, r = paddle.qr(paddle.to_tensor(a))
+    np.testing.assert_allclose(q.numpy() @ r.numpy(), a, rtol=1e-3, atol=1e-3)
+    u, s, vt = paddle.svd(paddle.to_tensor(a))
+    np.testing.assert_allclose((u.numpy() * s.numpy()) @ vt.numpy(), a,
+                               rtol=1e-3, atol=1e-3)
+    inv = paddle.inv(paddle.to_tensor(spd))
+    np.testing.assert_allclose(inv.numpy() @ spd, np.eye(4), atol=1e-3)
+    np.testing.assert_allclose(paddle.det(paddle.to_tensor(spd)).numpy(),
+                               np.linalg.det(spd), rtol=1e-3)
+
+
+def test_solve_triangular():
+    a = _x(3, 3) + 3 * np.eye(3, dtype=np.float32)
+    b = _x(3, 2)
+    x = paddle.solve(paddle.to_tensor(a), paddle.to_tensor(b))
+    np.testing.assert_allclose(a @ x.numpy(), b, atol=1e-4)
+    lt = np.tril(a)
+    x = paddle.triangular_solve(paddle.to_tensor(lt), paddle.to_tensor(b), upper=False)
+    np.testing.assert_allclose(lt @ x.numpy(), b, atol=1e-4)
+
+
+def test_eigh():
+    a = _x(4, 4)
+    sym = (a + a.T) / 2
+    w, v = paddle.eigh(paddle.to_tensor(sym))
+    ref_w = np.linalg.eigvalsh(sym)
+    np.testing.assert_allclose(np.sort(w.numpy()), np.sort(ref_w), rtol=1e-3, atol=1e-4)
+
+
+def test_cov_corrcoef_histogram():
+    x = _x(3, 10)
+    np.testing.assert_allclose(paddle.cov(paddle.to_tensor(x)).numpy(),
+                               np.cov(x), rtol=1e-3, atol=1e-4)
+    h = paddle.histogram(paddle.to_tensor(x), bins=5)
+    assert int(h.numpy().sum()) == 30

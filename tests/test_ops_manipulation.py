@@ -1,0 +1,140 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+rng = np.random.default_rng(1)
+
+
+def _x(*shape):
+    return rng.standard_normal(shape).astype(np.float32)
+
+
+def test_reshape_flatten_squeeze():
+    x = _x(2, 3, 4)
+    t = paddle.to_tensor(x)
+    assert paddle.reshape(t, [6, 4]).shape == [6, 4]
+    assert paddle.reshape(t, [-1, 4]).shape == [6, 4]
+    assert paddle.reshape(t, [0, 3, 4]).shape == [2, 3, 4]
+    assert paddle.flatten(t, 1).shape == [2, 12]
+    assert paddle.unsqueeze(t, 0).shape == [1, 2, 3, 4]
+    assert paddle.squeeze(paddle.unsqueeze(t, 0), 0).shape == [2, 3, 4]
+
+
+def test_concat_stack_split():
+    a, b = _x(2, 3), _x(2, 3)
+    ta, tb = paddle.to_tensor(a), paddle.to_tensor(b)
+    np.testing.assert_allclose(paddle.concat([ta, tb], 0).numpy(),
+                               np.concatenate([a, b], 0))
+    np.testing.assert_allclose(paddle.stack([ta, tb], 1).numpy(),
+                               np.stack([a, b], 1))
+    parts = paddle.split(paddle.to_tensor(_x(6, 2)), 3, axis=0)
+    assert len(parts) == 3 and parts[0].shape == [2, 2]
+    parts = paddle.split(paddle.to_tensor(_x(7, 2)), [3, -1], axis=0)
+    assert parts[1].shape == [4, 2]
+
+
+def test_tile_expand_broadcast():
+    x = _x(1, 3)
+    t = paddle.to_tensor(x)
+    np.testing.assert_allclose(paddle.tile(t, [2, 2]).numpy(), np.tile(x, (2, 2)))
+    assert paddle.expand(t, [4, 3]).shape == [4, 3]
+    assert paddle.broadcast_to(t, [4, 3]).shape == [4, 3]
+
+
+def test_gather_scatter():
+    x = _x(5, 3)
+    idx = np.array([0, 2, 4])
+    t = paddle.to_tensor(x)
+    np.testing.assert_allclose(paddle.gather(t, paddle.to_tensor(idx), 0).numpy(),
+                               x[idx])
+    upd = np.ones((3, 3), np.float32)
+    out = paddle.scatter(t, paddle.to_tensor(idx), paddle.to_tensor(upd))
+    ref = x.copy()
+    ref[idx] = 1.0
+    np.testing.assert_allclose(out.numpy(), ref)
+
+
+def test_gather_nd_scatter_nd():
+    x = _x(3, 4)
+    idx = np.array([[0, 1], [2, 3]])
+    out = paddle.gather_nd(paddle.to_tensor(x), paddle.to_tensor(idx))
+    np.testing.assert_allclose(out.numpy(), x[[0, 2], [1, 3]])
+    updates = np.array([1.0, 2.0], np.float32)
+    out = paddle.scatter_nd(paddle.to_tensor(idx), paddle.to_tensor(updates), [3, 4])
+    ref = np.zeros((3, 4), np.float32)
+    ref[0, 1] = 1
+    ref[2, 3] = 2
+    np.testing.assert_allclose(out.numpy(), ref)
+
+
+def test_where_masked():
+    x, y = _x(3, 3), _x(3, 3)
+    cond = x > 0
+    out = paddle.where(paddle.to_tensor(cond), paddle.to_tensor(x), paddle.to_tensor(y))
+    np.testing.assert_allclose(out.numpy(), np.where(cond, x, y))
+    ms = paddle.masked_select(paddle.to_tensor(x), paddle.to_tensor(cond))
+    np.testing.assert_allclose(ms.numpy(), x[cond])
+
+
+def test_take_along_put_along():
+    x = _x(3, 4)
+    idx = np.argsort(x, axis=1)
+    out = paddle.take_along_axis(paddle.to_tensor(x), paddle.to_tensor(idx), 1)
+    np.testing.assert_allclose(out.numpy(), np.take_along_axis(x, idx, 1))
+
+
+def test_roll_flip_transpose():
+    x = _x(3, 4)
+    t = paddle.to_tensor(x)
+    np.testing.assert_allclose(paddle.roll(t, 1, 0).numpy(), np.roll(x, 1, 0))
+    np.testing.assert_allclose(paddle.flip(t, [1]).numpy(), x[:, ::-1])
+    np.testing.assert_allclose(paddle.transpose(t, [1, 0]).numpy(), x.T)
+    np.testing.assert_allclose(t.T.numpy(), x.T)
+
+
+def test_pad():
+    x = _x(2, 3)
+    out = paddle.nn.functional.pad(paddle.to_tensor(x), [1, 1], value=0.0)
+    assert out.shape == [2, 5]
+    x4 = _x(1, 2, 3, 3)
+    out = paddle.nn.functional.pad(paddle.to_tensor(x4), [1, 1, 2, 2])
+    assert out.shape == [1, 2, 7, 5]
+
+
+def test_topk_sort_argsort():
+    x = _x(3, 5)
+    t = paddle.to_tensor(x)
+    vals, idx = paddle.topk(t, 2, axis=1)
+    ref_idx = np.argsort(-x, axis=1)[:, :2]
+    np.testing.assert_allclose(vals.numpy(), np.take_along_axis(x, ref_idx, 1),
+                               rtol=1e-6)
+    s = paddle.sort(t, axis=1)
+    np.testing.assert_allclose(s.numpy(), np.sort(x, 1), rtol=1e-6)
+    a = paddle.argsort(t, axis=1)
+    np.testing.assert_array_equal(a.numpy(), np.argsort(x, 1))
+
+
+def test_unique_nonzero():
+    x = np.array([3, 1, 2, 1, 3], np.int64)
+    u = paddle.unique(paddle.to_tensor(x))
+    np.testing.assert_array_equal(u.numpy(), [1, 2, 3])
+    nz = paddle.nonzero(paddle.to_tensor(np.array([0, 1, 0, 2])))
+    np.testing.assert_array_equal(nz.numpy(), [[1], [3]])
+
+
+def test_one_hot_diag():
+    x = np.array([0, 2, 1])
+    oh = paddle.nn.functional.one_hot(paddle.to_tensor(x), 3)
+    np.testing.assert_allclose(oh.numpy(), np.eye(3, dtype=np.float32)[x])
+    d = paddle.diag(paddle.to_tensor(np.array([1.0, 2.0], np.float32)))
+    np.testing.assert_allclose(d.numpy(), np.diag([1.0, 2.0]))
+
+
+def test_grad_through_gather_concat():
+    from op_test import check_grad
+    x = _x(4, 3)
+    idx = np.array([0, 2])
+    check_grad(lambda t: paddle.gather(t, paddle.to_tensor(idx), 0), (x,))
+    a, b = _x(2, 2), _x(2, 2)
+    check_grad(lambda u, v: paddle.concat([u, v], 0), (a, b), arg_idx=0)

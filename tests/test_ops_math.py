@@ -1,0 +1,126 @@
+"""Functional op tests vs numpy references (OpTest-style)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_output, check_grad
+
+rng = np.random.default_rng(0)
+
+
+def _x(*shape):
+    return rng.standard_normal(shape).astype(np.float32)
+
+
+@pytest.mark.parametrize("name,np_fn", [
+    ("exp", np.exp), ("tanh", np.tanh), ("sin", np.sin),
+    ("cos", np.cos), ("abs", np.abs), ("floor", np.floor), ("ceil", np.ceil),
+    ("sign", np.sign), ("square", np.square), ("expm1", np.expm1),
+    ("sinh", np.sinh), ("cosh", np.cosh), ("atan", np.arctan),
+])
+def test_unary(name, np_fn):
+    x = _x(3, 4)
+    check_output(getattr(paddle, name), np_fn, (x,), rtol=5e-4)
+
+
+@pytest.mark.parametrize("name,np_fn", [
+    ("sqrt", np.sqrt), ("log", np.log), ("rsqrt", lambda v: 1 / np.sqrt(v)),
+    ("log2", np.log2), ("log10", np.log10), ("log1p", np.log1p),
+])
+def test_unary_positive(name, np_fn):
+    x = np.abs(_x(3, 4)) + 0.5
+    check_output(getattr(paddle, name), np_fn, (x,), rtol=5e-4)
+
+
+@pytest.mark.parametrize("name,np_fn", [
+    ("add", np.add), ("subtract", np.subtract), ("multiply", np.multiply),
+    ("divide", np.true_divide), ("maximum", np.maximum), ("minimum", np.minimum),
+    ("atan2", np.arctan2), ("logaddexp", np.logaddexp),
+])
+def test_binary(name, np_fn):
+    x, y = _x(3, 4), _x(3, 4) + 2.5
+    check_output(getattr(paddle, name), np_fn, (x, y), rtol=5e-4)
+
+
+def test_broadcasting():
+    x, y = _x(3, 1, 4), _x(2, 1)
+    check_output(paddle.add, np.add, (x, y))
+
+
+@pytest.mark.parametrize("axis,keepdim", [(None, False), (0, False), (1, True), ((0, 1), False)])
+def test_reductions(axis, keepdim):
+    x = np.random.default_rng(11).standard_normal((3, 4, 5)).astype(np.float32)
+    out = paddle.sum(paddle.to_tensor(x), axis=axis, keepdim=keepdim)
+    np.testing.assert_allclose(out.numpy(), np.sum(x, axis=axis, keepdims=keepdim),
+                               rtol=1e-4, atol=1e-5)
+    out = paddle.mean(paddle.to_tensor(x), axis=axis, keepdim=keepdim)
+    np.testing.assert_allclose(out.numpy(), np.mean(x, axis=axis, keepdims=keepdim),
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_max_min_prod_logsumexp():
+    x = _x(3, 4)
+    np.testing.assert_allclose(paddle.max(paddle.to_tensor(x), axis=1).numpy(),
+                               x.max(1), rtol=1e-5)
+    np.testing.assert_allclose(paddle.min(paddle.to_tensor(x)).numpy(), x.min(), rtol=1e-5)
+    np.testing.assert_allclose(paddle.prod(paddle.to_tensor(x), axis=0).numpy(),
+                               x.prod(0), rtol=1e-4)
+    from scipy.special import logsumexp as sp_lse
+    np.testing.assert_allclose(paddle.logsumexp(paddle.to_tensor(x), axis=1).numpy(),
+                               sp_lse(x, axis=1), rtol=1e-4)
+
+
+def test_cumsum_cumprod():
+    x = _x(3, 4)
+    np.testing.assert_allclose(paddle.cumsum(paddle.to_tensor(x), axis=1).numpy(),
+                               np.cumsum(x, 1), rtol=1e-5)
+    np.testing.assert_allclose(paddle.cumprod(paddle.to_tensor(x), dim=0).numpy(),
+                               np.cumprod(x, 0), rtol=1e-4)
+
+
+def test_clip_lerp():
+    x = _x(3, 4)
+    np.testing.assert_allclose(paddle.clip(paddle.to_tensor(x), -0.5, 0.5).numpy(),
+                               np.clip(x, -0.5, 0.5))
+    y = _x(3, 4)
+    np.testing.assert_allclose(paddle.lerp(paddle.to_tensor(x), paddle.to_tensor(y), 0.3).numpy(),
+                               x + 0.3 * (y - x), rtol=1e-5)
+
+
+def test_grads_elementwise():
+    x = _x(2, 3)
+    check_grad(paddle.tanh, (x,))
+    check_grad(paddle.exp, (x,))
+    y = _x(2, 3) + 2.5
+    check_grad(paddle.multiply, (x, y), arg_idx=0)
+    check_grad(paddle.multiply, (x, y), arg_idx=1)
+
+
+def test_grad_matmul():
+    a, b = _x(3, 4), _x(4, 5)
+    check_grad(paddle.matmul, (a, b), arg_idx=0)
+    check_grad(paddle.matmul, (a, b), arg_idx=1)
+
+
+def test_grad_reduction():
+    x = _x(3, 4)
+    check_grad(paddle.sum, (x,))
+    check_grad(lambda t: paddle.mean(t, axis=1), (x,))
+    check_grad(lambda t: paddle.max(t, axis=1), (x,))
+
+
+def test_bitwise_and_logical():
+    a = np.array([1, 0, 3], np.int32)
+    b = np.array([1, 2, 2], np.int32)
+    np.testing.assert_array_equal(
+        paddle.bitwise_and(paddle.to_tensor(a), paddle.to_tensor(b)).numpy(), a & b)
+    np.testing.assert_array_equal(
+        paddle.logical_or(paddle.to_tensor(a > 0), paddle.to_tensor(b > 1)).numpy(),
+        (a > 0) | (b > 1))
+
+
+def test_isnan_isinf():
+    x = np.array([1.0, np.nan, np.inf], np.float32)
+    t = paddle.to_tensor(x)
+    np.testing.assert_array_equal(paddle.isnan(t).numpy(), np.isnan(x))
+    np.testing.assert_array_equal(paddle.isinf(t).numpy(), np.isinf(x))
