@@ -47,6 +47,9 @@ from . import amp  # noqa: F401
 from . import io  # noqa: F401
 from . import jit  # noqa: F401
 from . import distributed  # noqa: F401
+from . import ops  # noqa: F401
+from .ops.pallas import register_all as _register_pallas_kernels
+_register_pallas_kernels()  # TPU-only; no-op on CPU
 from . import vision  # noqa: F401
 from . import incubate  # noqa: F401
 from . import metric  # noqa: F401

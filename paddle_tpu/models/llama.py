@@ -287,9 +287,8 @@ def build_functional_llama(config: LlamaConfig, key=None, dtype=None,
             v = jnp.repeat(v, rep, axis=2)
         from ..core.dispatch import get_kernel
         attn_impl = get_kernel("flash_attention_causal")
-        if attn_impl is not None:
-            o = attn_impl(q, k, v)
-        else:
+        o = attn_impl(q, k, v) if attn_impl is not None else None
+        if o is None:
             logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(head_dim)
             mask = jnp.tril(jnp.ones((S, S), bool))
             logits = jnp.where(mask, logits.astype(jnp.float32), -jnp.inf)

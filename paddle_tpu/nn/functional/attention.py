@@ -51,26 +51,37 @@ def _sdpa_ref(q, k, v, mask=None, dropout=0.0, causal=False, scale=None,
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False, training=True,
                                  name=None):
-    """reference flash_attention.py:756 — dispatches to flash when available."""
-    dk = split_key() if (dropout_p > 0.0 and training) else None
+    """reference flash_attention.py:756 — the no-mask/no-dropout fast path
+    dispatches through op names the Pallas flash kernel overrides
+    ('flash_attention' / 'flash_attention_causal'); masked or dropout
+    attention runs the fused-softmax XLA path."""
+    use_dropout = dropout_p > 0.0 and training
+    if attn_mask is None and not use_dropout:
+        def impl(q, k, v):
+            return _sdpa_ref(q, k, v, causal=is_causal)
+        name_ = "flash_attention_causal" if is_causal else "flash_attention"
+        return op_call(name_, impl, query, key, value)
+    dk = split_key() if use_dropout else None
     def impl(q, k, v, *rest):
         m = rest[0] if rest else None
         return _sdpa_ref(q, k, v, mask=m, dropout=dropout_p if training else 0.0,
                          causal=is_causal, dropout_key=dk)
     args = [query, key, value] if attn_mask is None else [query, key, value, attn_mask]
-    return op_call("flash_attention", impl, *args)
+    return op_call("sdpa_general", impl, *args)
 
 
 def flash_attention(query, key, value, dropout=0.0, causal=False,
                     return_softmax=False, fixed_seed_offset=None, rng_name="",
                     training=True, name=None):
     """reference flash_attention.py:358. Returns (out, softmax_lse-like None)."""
-    dk = split_key() if (dropout > 0.0 and training) else None
+    use_dropout = dropout > 0.0 and training
+    dk = split_key() if use_dropout else None
     def impl(q, k, v):
         return _sdpa_ref(q, k, v, dropout=dropout if training else 0.0,
                          causal=causal, dropout_key=dk)
-    out = op_call("flash_attention_causal" if causal else "flash_attention",
-                  impl, query, key, value)
+    name_ = ("flash_attention_causal" if causal else "flash_attention") \
+        if not use_dropout else "sdpa_general"
+    out = op_call(name_, impl, query, key, value)
     return out, None
 
 

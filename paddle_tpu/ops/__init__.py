@@ -1,0 +1,1 @@
+from . import pallas  # noqa: F401

@@ -1,0 +1,60 @@
+"""Pallas TPU kernel overrides.
+
+The PD_REGISTER_KERNEL(..., GPU, ...) analog: importing this module registers
+Pallas implementations for hot ops under the same op names the functional API
+dispatches through (kernel_registry.h:196 → core/dispatch.py registry).
+Registration is TPU-only; on CPU the jnp defaults run (tests exercise the
+kernels via interpret=True).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import register_kernel
+from . import flash_attention as fa_mod
+
+__all__ = ["register_all", "flash_attention"]
+
+flash_attention = fa_mod.flash_attention
+
+
+def _naive_sdpa(q, k, v, causal):
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / math.sqrt(d)
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        s = jnp.where(mask, s, fa_mod.NEG_INF)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def _fa_plain(q, k, v):
+    out = fa_mod.flash_attention(q, k, v, causal=False)
+    return out if out is not None else _naive_sdpa(q, k, v, False)
+
+
+def _fa_causal(q, k, v):
+    out = fa_mod.flash_attention(q, k, v, causal=True)
+    return out if out is not None else _naive_sdpa(q, k, v, True)
+
+
+_registered = [False]
+
+
+def register_all(force=False):
+    """Register Pallas overrides (TPU backend only unless force)."""
+    if _registered[0]:
+        return
+    try:
+        on_tpu = any(d.platform == "tpu" for d in jax.devices())
+    except Exception:
+        on_tpu = False
+    if not (on_tpu or force):
+        return
+    register_kernel("flash_attention", impl="pallas")(_fa_plain)
+    register_kernel("flash_attention_causal", impl="pallas")(_fa_causal)
+    _registered[0] = True

@@ -1,0 +1,351 @@
+"""Pallas TPU flash attention (forward + backward).
+
+The TPU-native replacement for the reference's CUDA FA2 kernel
+(paddle/phi/kernels/gpu/flash_attn_kernel.cu + third_party flashattn):
+online-softmax tiling so the S×S score matrix never hits HBM.
+
+Layout: [B, S, H, D] at the API (reference flash_attention.py convention);
+kernels run per (batch*head) over [BH, S, D] with q-block × k-block tiling.
+
+Forward: FlashAttention-2 style — one pass over K/V blocks per Q block with a
+running max/denominator in VMEM scratch; emits O and the per-row logsumexp L.
+Backward: two kernels (dKdV accumulating over Q blocks; dQ accumulating over
+K blocks) using the saved L and D = rowsum(dO ∘ O).
+
+Grid iteration puts the reduction dim last ("arbitrary" semantics) so output
+blocks are revisited with live scratch.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["flash_attention", "flash_attention_fwd_kernel_call"]
+
+NEG_INF = -1e30
+
+
+def _block_sizes(s_q, s_k, d):
+    bq = min(512, s_q) if s_q % 512 == 0 else (128 if s_q % 128 == 0 else s_q)
+    bk = min(512, s_k) if s_k % 512 == 0 else (128 if s_k % 128 == 0 else s_k)
+    return bq, bk
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
+                causal, sm_scale, block_q, block_k, num_k_blocks):
+    j = pl.program_id(2)  # k-block index (innermost, reduction)
+    i = pl.program_id(1)  # q-block index
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # causal: process only blocks with k_start <= q_end
+    run = True
+    if causal:
+        run = (j * block_k) <= (i * block_q + block_q - 1)
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0]                      # [block_q, d]
+        k = k_ref[0]                      # [block_k, d]
+        v = v_ref[0]                      # [block_k, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale  # [bq, bk]
+        if causal:
+            q_ids = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_ids = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_ids >= k_ids, s, NEG_INF)
+        m_prev = m_scr[:]                 # [bq, 1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)            # [bq, bk]
+        alpha = jnp.exp(m_prev - m_new)   # [bq, 1]
+        l_new = alpha * l_scr[:] + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = m_new
+        l_scr[:] = l_new
+
+    @pl.when(j == num_k_blocks - 1)
+    def _finalize():
+        l = l_scr[:]
+        inv = jnp.where(l > 0.0, 1.0 / jnp.where(l > 0.0, l, 1.0), 0.0)
+        o_ref[0] = (acc_scr[:] * inv).astype(o_ref.dtype)
+        lse_ref[0] = m_scr[:] + jnp.log(jnp.maximum(l, 1e-30))
+
+
+def flash_attention_fwd_kernel_call(q, k, v, causal, sm_scale, interpret=False):
+    """q,k,v: [BH, S, D] -> (o [BH, Sq, D], lse [BH, Sq])."""
+    bh, s_q, d = q.shape
+    s_k = k.shape[1]
+    block_q, block_k = _block_sizes(s_q, s_k, d)
+    grid = (bh, s_q // block_q, s_k // block_k)
+
+    kernel = functools.partial(
+        _fwd_kernel, causal=causal, sm_scale=sm_scale, block_q=block_q,
+        block_k=block_k, num_k_blocks=s_k // block_k)
+
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s_q, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, s_q, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# Backward
+# ---------------------------------------------------------------------------
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr, *,
+                    causal, sm_scale, block_q, block_k, num_q_blocks):
+    i = pl.program_id(2)  # q-block (reduction)
+    j = pl.program_id(1)  # k-block
+
+    @pl.when(i == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    run = True
+    if causal:
+        run = (j * block_k) <= (i * block_q + block_q - 1)
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]                                # [bq, 1]
+        delta = delta_ref[0]                            # [bq, 1]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            q_ids = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_ids = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_ids >= k_ids, s, NEG_INF)
+        p = jnp.exp(s - lse)                            # [bq, bk]
+        # dv += p^T do
+        dv_scr[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        # dp = do v^T ; ds = p * (dp - delta) * scale
+        dp = jax.lax.dot_general(do, v.astype(jnp.float32),
+                                 (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        # dk += ds^T q
+        dk_scr[:] += jax.lax.dot_general(
+            ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(i == num_q_blocks - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_scr, *,
+                   causal, sm_scale, block_q, block_k, num_k_blocks):
+    j = pl.program_id(2)  # k-block (reduction)
+    i = pl.program_id(1)  # q-block
+
+    @pl.when(j == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    run = True
+    if causal:
+        run = (j * block_k) <= (i * block_q + block_q - 1)
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            q_ids = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_ids = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_ids >= k_ids, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(do, v.astype(jnp.float32),
+                                 (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        dq_scr[:] += jax.lax.dot_general(
+            ds, k.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(j == num_k_blocks - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd_call(res, g, causal, sm_scale, interpret):
+    q, k, v, o, lse = res
+    do = g
+    bh, s_q, d = q.shape
+    s_k = k.shape[1]
+    block_q, block_k = _block_sizes(s_q, s_k, d)
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True)  # [bh, s_q, 1]
+
+    dkv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, causal=causal, sm_scale=sm_scale,
+                          block_q=block_q, block_k=block_k,
+                          num_q_blocks=s_q // block_q),
+        grid=(bh, s_k // block_k, s_q // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s_k, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, s_k, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    dk, dv = dkv
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, causal=causal, sm_scale=sm_scale,
+                          block_q=block_q, block_k=block_k,
+                          num_k_blocks=s_k // block_k),
+        grid=(bh, s_q // block_q, s_k // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, s_q, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# Public op: [B, S, H, D] layout with custom VJP
+# ---------------------------------------------------------------------------
+@functools.lru_cache(maxsize=16)
+def _make_op(causal: bool, interpret: bool):
+    @jax.custom_vjp
+    def op(q, k, v):
+        o, _ = _fwd(q, k, v)
+        return o
+
+    def _fwd(q, k, v):
+        b, s_q, h, d = q.shape
+        s_k = k.shape[1]
+        sm_scale = 1.0 / math.sqrt(d)
+        qr = q.transpose(0, 2, 1, 3).reshape(b * h, s_q, d)
+        kr = k.transpose(0, 2, 1, 3).reshape(b * h, s_k, d)
+        vr = v.transpose(0, 2, 1, 3).reshape(b * h, s_k, d)
+        o, lse = flash_attention_fwd_kernel_call(qr, kr, vr, causal, sm_scale,
+                                                 interpret)
+        o4 = o.reshape(b, h, s_q, d).transpose(0, 2, 1, 3)
+        return o4, (qr, kr, vr, o, lse, (b, h, s_q, s_k, d))
+
+    def fwd(q, k, v):
+        o4, res = _fwd(q, k, v)
+        return o4, res
+
+    def bwd(res, g):
+        qr, kr, vr, o, lse, (b, h, s_q, s_k, d) = res
+        sm_scale = 1.0 / math.sqrt(d)
+        do = g.transpose(0, 2, 1, 3).reshape(b * h, s_q, d)
+        dq, dk, dv = _bwd_call((qr, kr, vr, o, lse), do, causal, sm_scale,
+                               interpret)
+        dq4 = dq.reshape(b, h, s_q, d).transpose(0, 2, 1, 3)
+        dk4 = dk.reshape(b, h, s_k, d).transpose(0, 2, 1, 3)
+        dv4 = dv.reshape(b, h, s_k, d).transpose(0, 2, 1, 3)
+        return dq4, dk4, dv4
+
+    op.defvjp(fwd, bwd)
+    return op
+
+
+def _supported(q, k):
+    b, s_q, h, d = q.shape
+    s_k = k.shape[1]
+    if d > 256 or d % 8 != 0:
+        return False
+    for s in (s_q, s_k):
+        if s % 128 != 0 and s < 128:
+            return False
+        if s % 128 != 0:
+            return False
+    return True
+
+
+def flash_attention(q, k, v, causal=False, interpret=False):
+    """[B, S, H, D] flash attention; falls back unsupported shapes to the
+    caller (returns None so the dispatch default runs)."""
+    if not _supported(q, k):
+        return None
+    return _make_op(bool(causal), bool(interpret))(q, k, v)

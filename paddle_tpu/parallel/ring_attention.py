@@ -1,0 +1,124 @@
+"""Ring attention + Ulysses attention over a mesh axis.
+
+SURVEY.md §5: the reference's `sep` segment parallelism has NO ring/blockwise
+attention — it re-gathers the full sequence for attention. These two
+implementations are the upgrade the TPU build ships:
+
+* **ring_attention** — blockwise attention with online softmax; KV shards
+  rotate around the ICI ring via `lax.ppermute`, one hop per step, overlapping
+  the next hop's transfer with the current block's compute (XLA schedules the
+  ppermute DMA async). Memory per chip: O(S_local²) scores, O(S/N) KV.
+* **ulysses_attention** — all-to-all head↔sequence swap: each chip trades its
+  sequence shard of all heads for all sequence of its head shard, runs dense
+  (flash) attention locally, and swaps back. Two all-to-alls instead of N-1
+  ring hops; best when heads ≥ axis size.
+
+Both are called inside shard_map with the sequence axis sharded over `axis`.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["ring_attention", "ulysses_attention"]
+
+
+def _block_attn_lse(q, k, v, scale, mask=None):
+    """Dense block attention returning (out_unnorm [B,Sq,H,D], m [B,H,Sq,1],
+    l [B,H,Sq,1]) for online combination."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if mask is not None:
+        s = jnp.where(mask, s, -1e30)
+    m = jnp.max(s, axis=-1, keepdims=True)                    # [B,H,Sq,1]
+    m = jnp.maximum(m, -1e30)
+    p = jnp.exp(s - m)
+    if mask is not None:
+        p = jnp.where(mask, p, 0.0)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+    return o.astype(jnp.float32), m, l
+
+
+def ring_attention(q, k, v, axis: str = "sep", causal: bool = False):
+    """q,k,v: local shards [B, S_local, H, D], sequence sharded over `axis`.
+    Returns local output shard [B, S_local, H, D]."""
+    n = jax.lax.psum(1, axis)
+    r = jax.lax.axis_index(axis)
+    b, s_local, h, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, t):
+        k_cur, v_cur, o_acc, m_acc, l_acc = carry
+        src = (r - t) % n  # whose KV shard we hold this step
+        if causal:
+            # global positions: q rows r*s_local + i, kv cols src*s_local + j
+            qi = r * s_local + jax.lax.broadcasted_iota(
+                jnp.int32, (s_local, s_local), 0)
+            kj = src * s_local + jax.lax.broadcasted_iota(
+                jnp.int32, (s_local, s_local), 1)
+            mask = (qi >= kj)[None, None]
+        else:
+            mask = None
+        o_t, m_t, l_t = _block_attn_lse(q, k_cur, v_cur, scale, mask)
+        m_new = jnp.maximum(m_acc, m_t)
+        a_old = jnp.exp(m_acc - m_new)
+        a_new = jnp.exp(m_t - m_new)
+        # o_acc layout [B,Sq,H,D]; alphas are [B,H,Sq,1] -> move to [B,Sq,H,1]
+        a_old_o = jnp.transpose(a_old, (0, 2, 1, 3))
+        a_new_o = jnp.transpose(a_new, (0, 2, 1, 3))
+        o_new = o_acc * a_old_o + o_t * a_new_o
+        l_new = l_acc * a_old + l_t * a_new
+        # rotate KV to the next neighbor (overlaps with next step's compute)
+        k_nxt = jax.lax.ppermute(k_cur, axis, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis, perm)
+        return (k_nxt, v_nxt, o_new, m_new, l_new), None
+
+    o0 = jnp.zeros((b, s_local, h, d), jnp.float32)
+    m0 = jnp.full((b, h, s_local, 1), -1e30, jnp.float32)
+    l0 = jnp.zeros((b, h, s_local, 1), jnp.float32)
+    # scan carries must be typed axis-varying like the per-shard k/v
+    o0 = jax.lax.pcast(o0, (axis,), to="varying")
+    m0 = jax.lax.pcast(m0, (axis,), to="varying")
+    l0 = jax.lax.pcast(l0, (axis,), to="varying")
+    (k_f, v_f, o, m, l), _ = jax.lax.scan(
+        step, (k, v, o0, m0, l0), jnp.arange(n))
+    l_o = jnp.transpose(l, (0, 2, 1, 3))              # [B,Sq,H,1]
+    out = o / jnp.maximum(l_o, 1e-30)
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis: str = "sep", causal: bool = False):
+    """All-to-all attention (DeepSpeed-Ulysses style): swap seq-sharding for
+    head-sharding, attend over the full sequence locally, swap back.
+    Requires num_heads % axis_size == 0."""
+    n = jax.lax.psum(1, axis)
+    b, s_local, h, d = q.shape
+
+    def seq2head(x):
+        # [B, S/n, H, D] -> [B, S, H/n, D]
+        xs = x.reshape(b, s_local, n, h // n, d)
+        y = jax.lax.all_to_all(xs, axis, split_axis=2, concat_axis=1, tiled=False)
+        # all_to_all over axis 2 (size n): gather seq, scatter heads
+        return y.reshape(b, s_local * n, h // n, d)
+
+    def head2seq(x):
+        xs = x.reshape(b, n, s_local, h // n, d)
+        y = jax.lax.all_to_all(xs, axis, split_axis=1, concat_axis=2, tiled=False)
+        return y.reshape(b, s_local, h, d)
+
+    qh = seq2head(q)
+    kh = seq2head(k)
+    vh = seq2head(v)
+    scale = 1.0 / math.sqrt(d)
+    s = jnp.einsum("bqhd,bkhd->bhqk", qh, kh).astype(jnp.float32) * scale
+    if causal:
+        S = s.shape[-1]
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    oh = jnp.einsum("bhqk,bkhd->bqhd", p, vh)
+    return head2seq(oh)
