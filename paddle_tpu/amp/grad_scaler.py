@@ -27,6 +27,13 @@ class GradScaler:
         self._good_steps = 0
         self._bad_steps = 0
         self._found_inf = False
+        # per-optimizer state machine (INIT -> UNSCALED -> STEPPED) so the
+        # canonical `scaler.unscale_(opt); clip; scaler.step(opt)` pattern
+        # does not divide gradients by the scale twice, including with
+        # multiple optimizers per iteration (reference OptimizerState
+        # tracking in python/paddle/amp/grad_scaler.py)
+        self._opt_states: dict[int, str] = {}
+        self._opt_found_inf: dict[int, bool] = {}
 
     def is_enable(self):
         return self._enable
@@ -45,6 +52,12 @@ class GradScaler:
     def unscale_(self, optimizer):
         if not self._enable:
             return
+        oid = id(optimizer)
+        if self._opt_states.get(oid) == "UNSCALED":
+            raise RuntimeError(
+                "unscale_() has already been called on this optimizer since "
+                "the last step()/update(); calling it twice would divide "
+                "gradients by the loss scale twice.")
         inv = 1.0 / self._scale
         found = False
         for p in optimizer._parameter_list:
@@ -54,23 +67,34 @@ class GradScaler:
             if not bool(jnp.all(jnp.isfinite(g.astype(jnp.float32)))):
                 found = True
             p._grad._set_value(g)
-        self._found_inf = found
+        self._opt_found_inf[oid] = found
+        self._found_inf = self._found_inf or found
+        self._opt_states[oid] = "UNSCALED"
 
     def step(self, optimizer):
+        """Unscale (if not already done) and apply the optimizer step.
+        Like the reference, step() does NOT update the loss scale — call
+        update() once per iteration after all optimizers have stepped."""
         if not self._enable:
             optimizer.step()
             return
-        self.unscale_(optimizer)
-        if not self._found_inf:
+        oid = id(optimizer)
+        if self._opt_states.get(oid) != "UNSCALED":
+            self.unscale_(optimizer)
+        if not self._opt_found_inf.get(oid, False):
             optimizer.step()
-        self.update()
+        self._opt_states[oid] = "STEPPED"
 
     def minimize(self, optimizer, scaled_loss):
         scaled_loss.backward()
         self.step(optimizer)
+        self.update()
 
     def update(self):
+        self._opt_states.clear()
+        self._opt_found_inf.clear()
         if not (self._enable and self._dynamic):
+            self._found_inf = False
             return
         if self._found_inf:
             self._bad_steps += 1

@@ -3,10 +3,12 @@ python/paddle/distributed/checkpoint/load_state_dict.py:526): reassembles
 global tensors from shard files, then re-places them under the current
 mesh/sharding of the destination state_dict — resumable across changed
 parallelism degrees.
+
+Shard payloads are keyed by (name, global extent) so files from different
+ranks never collide (multi-host safe; see save_state_dict.py).
 """
 from __future__ import annotations
 
-import glob
 import json
 import os
 import pickle
@@ -35,23 +37,46 @@ def load_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
                     unique_id=None, offload=False):
     with open(os.path.join(path, "metadata.json")) as f:
         meta = json.load(f)
+    # read only the shard files metadata references (never stray rank files
+    # left behind by an older save into the same directory)
+    referenced = set()
+    for entry in meta["tensors"].values():
+        for s in entry.get("shards", []) if not entry.get("py") else []:
+            referenced.add(s["file"])
     data = {}
-    for fn in glob.glob(os.path.join(path, "rank*.data")):
+    for base in sorted(referenced):
+        fn = os.path.join(path, base)
         with open(fn, "rb") as f:
-            data.update(pickle.load(f))
+            payload = pickle.load(f)
+        for key, arr in payload.items():
+            data.setdefault(key, arr)  # replicated extents: first copy wins
     targets = _flat_targets(state_dict)
     for name, t in targets.items():
         entry = meta["tensors"].get(name)
         if entry is None or entry.get("py"):
             continue
-        full = np.zeros(entry["shape"], dtype=entry["dtype"] if entry["dtype"] != "bfloat16"
-                        else np.float32)
+        np_dtype = entry["dtype"]
+        if np_dtype == "bfloat16":
+            np_dtype = "float32"  # assemble in fp32, cast on device_put
+        full = np.zeros(entry["shape"], dtype=np_dtype)
+        filled = np.zeros(entry["shape"], dtype=bool) if entry["shape"] else None
         for sid, shard in enumerate(entry["shards"]):
-            arr = data.get((name, sid))
+            ext = tuple(tuple(p) for p in shard["index"])
+            arr = data.get((name, ext))
             if arr is None:
-                continue
+                # version-1 files keyed the payload by rank-local sid
+                arr = data.get((name, sid))
+            if arr is None:
+                continue  # detected below by the completeness check
             idx = tuple(slice(a, b) for a, b in shard["index"])
-            full[idx] = np.asarray(arr, dtype=full.dtype)
+            full[idx] = np.asarray(jax.device_get(arr), dtype=full.dtype)
+            if filled is not None:
+                filled[idx] = True
+        if filled is not None and not filled.all():
+            raise RuntimeError(
+                f"checkpoint shard(s) missing for '{name}': only "
+                f"{int(filled.sum())}/{filled.size} elements present in "
+                f"{path} — incomplete save or mismatched rank files")
         if isinstance(t, Tensor):
             v = jnp.asarray(full, dtype=t._value.dtype)
             try:

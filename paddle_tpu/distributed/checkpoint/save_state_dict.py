@@ -3,21 +3,28 @@ checkpoint/save_state_dict.py:135): per-rank shard files + a metadata file
 recording global shapes/shardings, enabling reshard-on-load.
 
 TPU-native: each process saves only its addressable shards of each jax.Array
-(single-controller saves all shards); metadata stores the PartitionSpec-like
-layout so load_state_dict can reassemble and re-place under any target mesh.
+(single-controller saves all shards).  Multi-host safety: shard payloads are
+keyed by (name, global extent) — never by a rank-local counter — and every
+rank writes a sidecar ``rank{r}.meta.json`` describing its shard extents;
+after a global barrier the coordinator merges all sidecars into the single
+``metadata.json`` (the analog of the reference's cross-rank metadata gather
+in save_state_dict).
 """
 from __future__ import annotations
 
 import json
 import os
 import pickle
+import threading
 
 import numpy as np
 import jax
 
 from ...core.tensor import Tensor
 
-__all__ = ["save_state_dict"]
+__all__ = ["save_state_dict", "wait_async_save"]
+
+_async_threads: list[threading.Thread] = []
 
 
 def _flat(state_dict, prefix=""):
@@ -31,12 +38,37 @@ def _flat(state_dict, prefix=""):
     return out
 
 
+def _extent_key(index, shape):
+    """Normalize a shard index (tuple of slices) to ((start, stop), ...)."""
+    out = []
+    for s, dim in zip(index, shape):
+        start = 0 if s.start is None else int(s.start)
+        stop = int(dim) if s.stop is None else int(s.stop)
+        out.append((start, stop))
+    return tuple(out)
+
+
+def _barrier():
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("paddle_tpu_ckpt_save")
+
+
+def wait_async_save():
+    """Block until all pending async checkpoint writes are on disk."""
+    global _async_threads
+    for t in _async_threads:
+        t.join()
+    _async_threads = []
+
+
 def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
                     unique_id=None, async_save=False):
     os.makedirs(path, exist_ok=True)
     flat = _flat(state_dict)
     rank = jax.process_index()
-    meta = {"version": 1, "tensors": {}}
+    # per-rank view of the metadata; merged by the coordinator at the end
+    local_meta = {"version": 2, "tensors": {}}
     shards = {}
     for name, t in flat.items():
         if isinstance(t, Tensor):
@@ -44,32 +76,99 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
         elif isinstance(t, (np.ndarray, jax.Array)):
             v = t
         else:
-            meta["tensors"][name] = {"py": True, "value": t} \
+            local_meta["tensors"][name] = {"py": True, "value": t} \
                 if isinstance(t, (int, float, str, bool, list)) else {"py": True, "value": None}
             continue
+        shape = tuple(np.shape(v))
         try:
-            local_shards = [(s.index, np.asarray(s.data)) for s in
+            local_shards = [(s.index, s.data) for s in
                             getattr(v, "addressable_shards", [])]
         except Exception:
             local_shards = []
         if not local_shards:
-            local_shards = [(tuple(slice(None) for _ in np.shape(v)),
-                             np.asarray(jax.device_get(v)))]
-        entry = {"shape": list(np.shape(v)), "dtype": str(np.asarray(local_shards[0][1]).dtype),
-                 "shards": []}
+            local_shards = [(tuple(slice(None) for _ in shape), v)]
+        entry = {"shape": list(shape), "dtype": str(np.asarray(
+            jax.device_get(local_shards[0][1])).dtype), "shards": []}
         seen = set()
         for idx, data in local_shards:
-            key = tuple((s.start, s.stop) for s in idx)
-            if key in seen:
-                continue  # replicated copies: save once
-            seen.add(key)
-            sid = len(entry["shards"])
-            entry["shards"].append({"index": [[s.start, s.stop] for s in idx],
+            ext = _extent_key(idx, shape)
+            if ext in seen:
+                continue  # replicated copies on this rank: save once
+            seen.add(ext)
+            entry["shards"].append({"index": [[a, b] for a, b in ext],
                                     "file": f"rank{rank}.data"})
-            shards[(name, sid)] = data
-        meta["tensors"][name] = entry
-    with open(os.path.join(path, f"rank{rank}.data"), "wb") as f:
-        pickle.dump({(n, i): d for (n, i), d in shards.items()}, f, protocol=4)
+            shards[(name, ext)] = np.asarray(jax.device_get(data))
+        local_meta["tensors"][name] = entry
+
+    def _write():
+        with open(os.path.join(path, f"rank{rank}.data"), "wb") as f:
+            pickle.dump(shards, f, protocol=4)
+        with open(os.path.join(path, f"rank{rank}.meta.json"), "w") as f:
+            json.dump(local_meta, f, default=str)
+
+    if async_save:
+        # device_get already happened above; only the host-side serialization
+        # and file IO run in the background thread.
+        th = threading.Thread(target=_write, daemon=False)
+        th.start()
+        _async_threads.append(th)
+        if jax.process_count() == 1:
+            # single-controller: merge metadata after the write completes
+            def _finish():
+                th.join()
+                _merge_metadata(path)
+            fin = threading.Thread(target=_finish, daemon=False)
+            fin.start()
+            _async_threads.append(fin)
+            return
+        # multi-host async: caller must invoke wait_async_save() before the
+        # barrier; fall through to synchronous merge for safety
+        th.join()
+    else:
+        _write()
+
+    _barrier()  # all ranks' sidecars must be on disk before the merge
     if rank == coordinator_rank:
-        with open(os.path.join(path, "metadata.json"), "w") as f:
-            json.dump(meta, f, default=str)
+        _merge_metadata(path)
+    _barrier()  # nobody returns until metadata.json exists
+
+
+def _merge_metadata(path):
+    """Merge the current world's rank sidecars into the global metadata.json,
+    deduplicating replicated extents across ranks (keep the lowest-rank copy).
+    Only ranks [0, process_count) are merged, and stale rank files from a
+    previous larger-world save into the same directory are removed so a
+    subsequent load cannot mix checkpoints."""
+    import glob as _glob
+    world = jax.process_count()
+    merged = {"version": 2, "tensors": {}}
+    files = []
+    for fn in _glob.glob(os.path.join(path, "rank*.meta.json")):
+        r = int(os.path.basename(fn)[4:].split(".")[0])
+        if r < world:
+            files.append((r, fn))
+        else:  # stale sidecar from an older, larger-world save
+            for stale in (fn, os.path.join(path, f"rank{r}.data")):
+                try:
+                    os.remove(stale)
+                except OSError:
+                    pass
+    files = [fn for _, fn in sorted(files)]
+    for fn in files:
+        with open(fn) as f:
+            m = json.load(f)
+        for name, entry in m["tensors"].items():
+            if entry.get("py"):
+                merged["tensors"].setdefault(name, entry)
+                continue
+            tgt = merged["tensors"].setdefault(
+                name, {"shape": entry["shape"], "dtype": entry["dtype"],
+                       "shards": []})
+            have = {tuple(tuple(p) for p in s["index"]) for s in tgt["shards"]}
+            for s in entry["shards"]:
+                ext = tuple(tuple(p) for p in s["index"])
+                if ext not in have:
+                    have.add(ext)
+                    tgt["shards"].append(s)
+    with open(os.path.join(path, "metadata.json"), "w") as f:
+        json.dump(merged, f, default=str)

@@ -62,6 +62,7 @@ class PipelineParallel(Layer):
                 else total_loss + scaled.detach()
         if scaler is not None:
             scaler.step(optimizer)
+            scaler.update()
         else:
             optimizer.step()
         optimizer.clear_grad()

@@ -22,16 +22,31 @@ from .. import framework
 __all__ = ["save", "load", "TranslatedLayer"]
 
 
-def _spec_to_sds(spec):
+def _specs_to_sds(input_spec):
+    """Map InputSpecs/Tensors to ShapeDtypeStructs.  Dynamic dims (None / -1)
+    become jax.export symbolic dimensions — all created in ONE scope so the
+    exported artifact is shape-polymorphic across multiple dynamic dims
+    (the reference's saved inference models keep the batch dim dynamic)."""
     from ..static.input_spec import InputSpec
-    if isinstance(spec, InputSpec):
-        shape = tuple(1 if (s is None or s == -1) else int(s) for s in spec.shape)
-        return jax.ShapeDtypeStruct(shape, spec.dtype or jnp.float32)
-    if isinstance(spec, Tensor):
-        return jax.ShapeDtypeStruct(tuple(spec.shape), spec._value.dtype)
-    if hasattr(spec, "shape"):
-        return jax.ShapeDtypeStruct(tuple(spec.shape), getattr(spec, "dtype", jnp.float32))
-    raise TypeError(f"cannot build input spec from {spec!r}")
+    n_dyn = sum(1 for s in input_spec if isinstance(s, InputSpec)
+                for d in s.shape if d is None or d == -1)
+    syms = iter(jax.export.symbolic_shape(
+        ", ".join(f"d{i}" for i in range(n_dyn))) if n_dyn else ())
+    out = []
+    for spec in input_spec:
+        if isinstance(spec, InputSpec):
+            dims = tuple(next(syms) if (s is None or s == -1) else int(s)
+                         for s in spec.shape)
+            out.append(jax.ShapeDtypeStruct(dims, spec.dtype or jnp.float32))
+        elif isinstance(spec, Tensor):
+            out.append(jax.ShapeDtypeStruct(tuple(spec.shape),
+                                            spec._value.dtype))
+        elif hasattr(spec, "shape"):
+            out.append(jax.ShapeDtypeStruct(
+                tuple(spec.shape), getattr(spec, "dtype", jnp.float32)))
+        else:
+            raise TypeError(f"cannot build input spec from {spec!r}")
+    return out
 
 
 def save(layer, path, input_spec=None, **configs):
@@ -52,7 +67,7 @@ def save(layer, path, input_spec=None, **configs):
     if input_spec is None:
         raise ValueError("jit.save requires input_spec on the TPU backend "
                          "(static shapes are part of the exported artifact)")
-    sds = [_spec_to_sds(s) for s in input_spec]
+    sds = _specs_to_sds(input_spec)
     state_sds = jax.tree_util.tree_map(lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype), state)
     exported = jax.export.export(jax.jit(pure_fn))(state_sds, *sds)
     blob = exported.serialize()

@@ -40,7 +40,7 @@ def _block_sizes(s_q, s_k, d):
 # Forward
 # ---------------------------------------------------------------------------
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
-                causal, sm_scale, block_q, block_k, num_k_blocks):
+                causal, sm_scale, block_q, block_k, num_k_blocks, offset):
     j = pl.program_id(2)  # k-block index (innermost, reduction)
     i = pl.program_id(1)  # q-block index
 
@@ -53,7 +53,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
     # causal: process only blocks with k_start <= q_end
     run = True
     if causal:
-        run = (j * block_k) <= (i * block_q + block_q - 1)
+        run = (j * block_k) <= (i * block_q + block_q - 1 + offset)
 
     @pl.when(run)
     def _body():
@@ -68,7 +68,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
                 jnp.int32, (block_q, block_k), 0)
             k_ids = j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_ids >= k_ids, s, NEG_INF)
+            s = jnp.where(q_ids + offset >= k_ids, s, NEG_INF)
         m_prev = m_scr[:]                 # [bq, 1]
         m_cur = jnp.max(s, axis=1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
@@ -98,7 +98,7 @@ def flash_attention_fwd_kernel_call(q, k, v, causal, sm_scale, interpret=False):
 
     kernel = functools.partial(
         _fwd_kernel, causal=causal, sm_scale=sm_scale, block_q=block_q,
-        block_k=block_k, num_k_blocks=s_k // block_k)
+        block_k=block_k, num_k_blocks=s_k // block_k, offset=s_k - s_q)
 
     return pl.pallas_call(
         kernel,
@@ -132,7 +132,7 @@ def flash_attention_fwd_kernel_call(q, k, v, causal, sm_scale, interpret=False):
 # ---------------------------------------------------------------------------
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_scr, dv_scr, *,
-                    causal, sm_scale, block_q, block_k, num_q_blocks):
+                    causal, sm_scale, block_q, block_k, num_q_blocks, offset):
     i = pl.program_id(2)  # q-block (reduction)
     j = pl.program_id(1)  # k-block
 
@@ -143,7 +143,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     run = True
     if causal:
-        run = (j * block_k) <= (i * block_q + block_q - 1)
+        run = (j * block_k) <= (i * block_q + block_q - 1 + offset)
 
     @pl.when(run)
     def _body():
@@ -160,7 +160,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 jnp.int32, (block_q, block_k), 0)
             k_ids = j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_ids >= k_ids, s, NEG_INF)
+            s = jnp.where(q_ids + offset >= k_ids, s, NEG_INF)
         p = jnp.exp(s - lse)                            # [bq, bk]
         # dv += p^T do
         dv_scr[:] += jax.lax.dot_general(
@@ -184,7 +184,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                    dq_ref, dq_scr, *,
-                   causal, sm_scale, block_q, block_k, num_k_blocks):
+                   causal, sm_scale, block_q, block_k, num_k_blocks, offset):
     j = pl.program_id(2)  # k-block (reduction)
     i = pl.program_id(1)  # q-block
 
@@ -194,7 +194,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     run = True
     if causal:
-        run = (j * block_k) <= (i * block_q + block_q - 1)
+        run = (j * block_k) <= (i * block_q + block_q - 1 + offset)
 
     @pl.when(run)
     def _body():
@@ -211,7 +211,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 jnp.int32, (block_q, block_k), 0)
             k_ids = j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_ids >= k_ids, s, NEG_INF)
+            s = jnp.where(q_ids + offset >= k_ids, s, NEG_INF)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v.astype(jnp.float32),
                                  (((1,), (1,)), ((), ())),
@@ -238,7 +238,7 @@ def _bwd_call(res, g, causal, sm_scale, interpret):
     dkv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, causal=causal, sm_scale=sm_scale,
                           block_q=block_q, block_k=block_k,
-                          num_q_blocks=s_q // block_q),
+                          num_q_blocks=s_q // block_q, offset=s_k - s_q),
         grid=(bh, s_k // block_k, s_q // block_q),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
@@ -269,7 +269,7 @@ def _bwd_call(res, g, causal, sm_scale, interpret):
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, causal=causal, sm_scale=sm_scale,
                           block_q=block_q, block_k=block_k,
-                          num_k_blocks=s_k // block_k),
+                          num_k_blocks=s_k // block_k, offset=s_k - s_q),
         grid=(bh, s_q // block_q, s_k // block_k),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -330,10 +330,16 @@ def _make_op(causal: bool, interpret: bool):
     return op
 
 
-def _supported(q, k):
+def _supported(q, k, causal=False):
     b, s_q, h, d = q.shape
     s_k = k.shape[1]
     if d > 256 or d % 8 != 0:
+        return False
+    if causal and s_q > s_k:
+        # bottom-right-aligned causal leaves rows [0, s_q - s_k) with zero
+        # valid keys; their softmax is ill-defined and the XLA fallback's
+        # uniform-weight convention differs from FA's zero-output — defer to
+        # the fallback for this shape.
         return False
     for s in (s_q, s_k):
         if s % 128 != 0 and s < 128:
@@ -346,6 +352,6 @@ def _supported(q, k):
 def flash_attention(q, k, v, causal=False, interpret=False):
     """[B, S, H, D] flash attention; falls back unsupported shapes to the
     caller (returns None so the dispatch default runs)."""
-    if not _supported(q, k):
+    if not _supported(q, k, causal):
         return None
     return _make_op(bool(causal), bool(interpret))(q, k, v)

@@ -35,6 +35,9 @@ class TrainStep:
         self.loss_fn = loss_fn
         self.with_amp = with_amp
         self.amp_dtype = amp_dtype
+        if grad_accum < 1:
+            raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
+        self.grad_accum = int(grad_accum)
         self.params = {n: p._value for n, p in model.named_parameters()
                        if not p.stop_gradient}
         self._lr_scales = {
@@ -53,30 +56,67 @@ class TrainStep:
 
     # pure: (params, opt_state, buffers, rng, lr, *batch) -> (loss, ...)
     def _step_impl(self, params, opt_state, buffers, rng, lr, *batch):
-        def loss_of(p):
-            state = {}
-            state.update(p)
-            state.update(self.frozen)
-            state.update(buffers)
-            with random_mod.trace_rng(rng):
-                if self.with_amp:
-                    from ..amp import auto_cast
-                    ctx = auto_cast(dtype=self.amp_dtype)
-                else:
-                    import contextlib
-                    ctx = contextlib.nullcontext()
-                with ctx, functional_state(self.model, state) as fs:
-                    batch_t = [Tensor(b) for b in batch]
-                    loss = self.loss_fn(self.model, *batch_t)
-                    new_state = fs.collect()
-            new_buffers = {k: new_state[k] for k in buffers}
-            lv = loss._value if isinstance(loss, Tensor) else loss
-            return lv, new_buffers
+        if self.grad_accum == 1:
+            (loss_v, new_buffers), grads = jax.value_and_grad(
+                lambda p: self._loss_with(p, buffers, rng, batch),
+                has_aux=True)(params)
+        else:
+            # gradient merge (reference gradient_merge pass analog): split the
+            # global batch into grad_accum microbatches on the leading axis and
+            # lax.scan the fwd+bwd, averaging loss and grads; one optimizer
+            # update per call.
+            a = self.grad_accum
+            micro = []
+            for b in batch:
+                if b.shape[0] % a != 0:
+                    raise ValueError(
+                        f"batch dim {b.shape[0]} not divisible by "
+                        f"grad_accum={a}")
+                micro.append(b.reshape((a, b.shape[0] // a) + b.shape[1:]))
+            rngs = jax.random.split(rng, a)
 
-        (loss_v, new_buffers), grads = jax.value_and_grad(loss_of, has_aux=True)(params)
+            def one(carry, xs):
+                mb_rng, mb = xs[0], xs[1:]
+                acc_loss, acc_grads, bufs = carry
+                # buffers (e.g. BatchNorm running stats) chain microbatch to
+                # microbatch, exactly as grad_accum sequential steps would
+                (lv, new_bufs), g = jax.value_and_grad(
+                    lambda p: self._loss_with(p, bufs, mb_rng, mb),
+                    has_aux=True)(params)
+                acc_grads = jax.tree_util.tree_map(jnp.add, acc_grads, g)
+                return (acc_loss + lv, acc_grads, new_bufs), None
+
+            zero_g = jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params)
+            (loss_sum, gsum, new_buffers), _ = jax.lax.scan(
+                one, (jnp.zeros((), jnp.float32), zero_g, buffers),
+                (rngs, *micro))
+            loss_v = loss_sum / a
+            grads = jax.tree_util.tree_map(lambda g: g / a, gsum)
         new_params, new_opt = self.opt.apply_gradients_functional(
             params, grads, opt_state, lr=lr, lr_scales=self._lr_scales or None)
         return new_params, new_opt, new_buffers, loss_v
+
+    def _loss_with(self, params, buffers, rng, batch):
+        """Single-microbatch loss; shared by the plain and grad-accum paths."""
+        state = {}
+        state.update(params)
+        state.update(self.frozen)
+        state.update(buffers)
+        with random_mod.trace_rng(rng):
+            if self.with_amp:
+                from ..amp import auto_cast
+                ctx = auto_cast(dtype=self.amp_dtype)
+            else:
+                import contextlib
+                ctx = contextlib.nullcontext()
+            with ctx, functional_state(self.model, state) as fs:
+                batch_t = [Tensor(b) for b in batch]
+                loss = self.loss_fn(self.model, *batch_t)
+                new_state = fs.collect()
+        new_buffers = {k: new_state[k] for k in buffers}
+        lv = loss._value if isinstance(loss, Tensor) else loss
+        return lv, new_buffers
 
     def __call__(self, *batch):
         vals = [b._value if isinstance(b, Tensor) else jnp.asarray(b) for b in batch]
