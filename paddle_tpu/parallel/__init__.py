@@ -14,5 +14,7 @@ from __future__ import annotations
 
 from .train_step import TrainStep, compile_train_step
 from .pipeline import PipelineTrainStep
+from .sharded import ShardedTrainStep
 
-__all__ = ["TrainStep", "compile_train_step", "PipelineTrainStep"]
+__all__ = ["TrainStep", "compile_train_step", "PipelineTrainStep",
+           "ShardedTrainStep"]

@@ -1,0 +1,261 @@
+"""Compiled ZeRO (group-sharded) train step — stages 1/2/3.
+
+The TPU-native equivalent of the reference's group-sharded machinery
+(python/paddle/distributed/fleet/meta_parallel/sharding/
+group_sharded_stage2.py, group_sharded_stage3.py:174 `_param2buffer`,
+:335 `_update_params_slice`, :560 forward gather/release hooks):
+
+* every parameter is flattened and zero-padded to ``N * chunk`` so each of
+  the N ranks on the ZeRO axis owns one contiguous ``chunk`` slice — the
+  per-rank slice buffer analog of ``_param2buffer``;
+* **stage 1** (os):    grads all-reduced (psum), each rank updates only its
+  slice with its shard of the optimizer state, updated params all-gathered;
+* **stage 2** (os_g):  grads reduce-scattered (``lax.psum_scatter`` — the
+  collective the stage2 grad hooks issue), then as stage 1;
+* **stage 3** (p_g_os): parameters live sharded between steps; the step
+  all-gathers them just-in-time for the forward (the forward-prehook gather
+  analog), re-gathers under remat for backward, reduce-scatters grads and
+  writes back only the local slice (the posthook release analog is XLA
+  buffer donation — the gathered full copy is transient).
+
+Everything runs inside one ``shard_map`` + ``jax.jit`` so XLA schedules the
+collectives (reduce-scatter/all-gather ride ICI) and fuses the optimizer
+update over the slice.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from ..core.tensor import Tensor
+
+__all__ = ["ShardedTrainStep", "zero_stage_name"]
+
+
+def zero_stage_name(stage) -> int:
+    """Normalize Paddle level strings ('os', 'os_g', 'p_g_os') to 1/2/3."""
+    if stage in (1, 2, 3):
+        return int(stage)
+    return {"os": 1, "os_g": 2, "p_g_os": 3,
+            "stage1": 1, "stage2": 2, "stage3": 3}[str(stage)]
+
+
+class ShardedTrainStep:
+    """One-jit ZeRO train step over an arbitrary params pytree.
+
+    loss_fn(params_pytree, batch) -> scalar loss.  The batch's leading dim is
+    split across the ZeRO axis (data parallel); loss is the global mean.
+    """
+
+    def __init__(self, mesh: Mesh, loss_fn: Callable, params: Any, opt,
+                 stage=2, axis: str = "dp", remat: bool = False,
+                 clip_norm: Optional[float] = None, donate: bool = True):
+        self.mesh = mesh
+        self.axis = axis
+        self.stage = zero_stage_name(stage)
+        self.opt = opt
+        self.remat = remat
+        self.clip_norm = clip_norm
+        n = mesh.shape[axis]
+        self.n_shards = n
+
+        leaves, self.treedef = jax.tree_util.tree_flatten(params)
+        self.shapes = [l.shape for l in leaves]
+        self.dtypes = [l.dtype for l in leaves]
+        self.sizes = [int(np.prod(s)) if s else 1 for s in self.shapes]
+        self.padded = [((sz + n - 1) // n) * n for sz in self.sizes]
+
+        self._loss_fn = loss_fn
+
+        # flattened padded global arrays, sharded over the ZeRO axis
+        flat_sh = NamedSharding(mesh, P(axis))
+        repl_sh = NamedSharding(mesh, P())
+
+        def to_flat(leaf, pad):
+            f = jnp.ravel(leaf)
+            if pad != f.size:
+                f = jnp.concatenate([f, jnp.zeros(pad - f.size, f.dtype)])
+            return f
+
+        flats = [to_flat(l, p) for l, p in zip(leaves, self.padded)]
+        names = [f"p{i}" for i in range(len(flats))]
+        self._names = names
+
+        if self.stage >= 3:
+            self.flat_params = {k: jax.device_put(v, flat_sh)
+                                for k, v in zip(names, flats)}
+        else:
+            self.flat_params = {k: jax.device_put(v, repl_sh)
+                                for k, v in zip(names, flats)}
+        # optimizer state always lives sharded (that's stage 1's whole point);
+        # scalar entries (beta pow counters) stay replicated
+        def place_state(v):
+            sh = flat_sh if self._shardable(v) else repl_sh
+            return jax.device_put(v, sh)
+        self.opt_state = jax.tree_util.tree_map(
+            place_state, opt.init_opt_state(self.flat_params))
+
+        self._step = self._build(donate)
+
+    def _shardable(self, v):
+        return (hasattr(v, "ndim") and v.ndim >= 1 and v.shape[0] > 0
+                and v.shape[0] % self.n_shards == 0)
+
+    # -- pytree <-> flat slice plumbing ------------------------------------
+    def _assemble(self, full_flats):
+        """[padded] flat arrays -> original params pytree (local, in-step)."""
+        leaves = []
+        for k, shape, size, dtype in zip(self._names, self.shapes, self.sizes,
+                                         self.dtypes):
+            f = full_flats[k]
+            leaves.append(f[:size].reshape(shape).astype(dtype))
+        return jax.tree_util.tree_unflatten(self.treedef, leaves)
+
+    @staticmethod
+    def _coerce_batch(batch):
+        return tuple(b._value if isinstance(b, Tensor) else jnp.asarray(b)
+                     for b in (batch if isinstance(batch, (tuple, list))
+                               else (batch,)))
+
+    # -- the compiled step --------------------------------------------------
+    def _build(self, donate):
+        ax, n, stage = self.axis, self.n_shards, self.stage
+        mesh = self.mesh
+        opt = self.opt
+
+        remat = self.remat
+
+        def local_step(flat_params, opt_state, lr, *batch):
+            # flat_params local views: [padded/n] (stage 3) or [padded] (1/2)
+            if stage >= 3:
+                # Differentiate w.r.t. the LOCAL slices with the all_gather
+                # INSIDE the (optionally rematted) loss: autodiff transposes
+                # all_gather into psum_scatter, so grads arrive already
+                # reduce-scattered, and under remat the backward re-gathers
+                # params per use instead of keeping the full copy live —
+                # the real ZeRO-3 memory behavior (stage3 gather/release
+                # hooks, group_sharded_stage3.py:560).
+                def loss_of(slices):
+                    full = {k: jax.lax.all_gather(v, ax, tiled=True)
+                            for k, v in slices.items()}
+                    return self._loss_fn(self._assemble(full), batch)
+
+                fn = jax.checkpoint(loss_of) if remat else loss_of
+                loss, graw = jax.value_and_grad(fn)(flat_params)
+                # psum_scatter summed over ranks -> mean
+                gslice = {k: g.astype(jnp.float32) / n
+                          for k, g in graw.items()}
+                pslice = flat_params
+            else:
+                def loss_of(full_flats):
+                    return self._loss_fn(self._assemble(full_flats), batch)
+
+                fn = jax.checkpoint(loss_of) if remat else loss_of
+                loss, gfull = jax.value_and_grad(fn)(flat_params)
+                gflat = {k: jnp.ravel(g).astype(jnp.float32)
+                         for k, g in gfull.items()}
+                r = jax.lax.axis_index(ax)
+                if stage == 1:
+                    # all-reduce full grads, every rank slices its own chunk
+                    gslice = {}
+                    for k, g in gflat.items():
+                        g = jax.lax.pmean(g, ax)
+                        chunk = g.shape[0] // n
+                        gslice[k] = jax.lax.dynamic_slice_in_dim(
+                            g, r * chunk, chunk)
+                else:
+                    # reduce-scatter: each rank receives the mean of its slice
+                    gslice = {k: jax.lax.psum_scatter(
+                        g, ax, scatter_dimension=0, tiled=True) / n
+                        for k, g in gflat.items()}
+                pslice = {}
+                for k, v in flat_params.items():
+                    chunk = v.shape[0] // n
+                    pslice[k] = jax.lax.dynamic_slice_in_dim(
+                        v, r * chunk, chunk)
+
+            loss = jax.lax.pmean(loss, ax)
+
+            if self.clip_norm is not None:
+                # global grad-norm over ALL shards (ClipGradByGlobalNorm
+                # across the sharding group, hybrid_parallel_optimizer
+                # analog); slices are disjoint chunks of the full grad
+                sq = sum(jnp.sum(jnp.square(g)) for g in gslice.values())
+                gnorm = jnp.sqrt(jax.lax.psum(sq, ax))
+                scale = jnp.minimum(1.0, self.clip_norm / (gnorm + 1e-6))
+                gslice = {k: g * scale for k, g in gslice.items()}
+
+            # update only the local slice with the local optimizer shard
+            new_slice, new_opt = opt.apply_gradients_functional(
+                pslice, gslice, opt_state, lr=lr)
+
+            if stage >= 3:
+                new_params = new_slice        # stays sharded
+            else:
+                new_params = {k: jax.lax.all_gather(v, ax, tiled=True)
+                              for k, v in new_slice.items()}
+            return new_params, new_opt, loss
+
+        flat_spec = {k: P(ax) for k in self._names}
+        repl_spec = {k: P() for k in self._names}
+        param_spec = flat_spec if stage >= 3 else repl_spec
+        opt_spec = jax.tree_util.tree_map(
+            lambda v: P(ax) if self._shardable(v) else P(), self.opt_state)
+        batch_spec = P(ax)
+
+        def stepper(flat_params, opt_state, lr, batch):
+            sm = shard_map(
+                local_step, mesh=mesh,
+                in_specs=(param_spec, opt_spec, P(),
+                          *([batch_spec] * len(batch))),
+                out_specs=(param_spec, opt_spec, P()),
+                check_rep=False)
+            return sm(flat_params, opt_state, lr, *batch)
+
+        return jax.jit(stepper, donate_argnums=(0, 1) if donate else ())
+
+    def __call__(self, batch):
+        batch = self._coerce_batch(batch)
+        lr = jnp.asarray(self.opt.get_lr(), jnp.float32)
+        self.flat_params, self.opt_state, loss = self._step(
+            self.flat_params, self.opt_state, lr, batch)
+        self.opt._global_step += 1
+        from ..optimizer.lr import LRScheduler
+        if isinstance(self.opt._learning_rate, LRScheduler):
+            self.opt._learning_rate.step()
+        return loss
+
+    # -- introspection ------------------------------------------------------
+    def materialized_params(self):
+        """Gather the full (unsharded) params pytree — checkpoints, eval."""
+        full = {}
+        for k, v in self.flat_params.items():
+            arr = jax.device_get(v)
+            full[k] = jnp.asarray(arr)
+        return self._assemble(full)
+
+    def lowered_hlo(self, batch) -> str:
+        """Compiler IR of the step (tests assert collective choice here)."""
+        batch = self._coerce_batch(batch)
+        lr = jnp.asarray(self.opt.get_lr(), jnp.float32)
+        return self._step.lower(
+            self.flat_params, self.opt_state, lr, batch).as_text()
+
+    def bytes_per_device(self):
+        """(param_bytes, opt_bytes) actually resident per device."""
+        def local_bytes(tree):
+            total = 0
+            for v in jax.tree_util.tree_leaves(tree):
+                if hasattr(v, "addressable_shards"):
+                    shard = v.addressable_shards[0]
+                    total += int(np.prod(shard.data.shape)) * v.dtype.itemsize
+                else:
+                    total += v.size * v.dtype.itemsize
+            return total
+        return local_bytes(self.flat_params), local_bytes(self.opt_state)

@@ -1,0 +1,150 @@
+"""ZeRO stages 1/2/3 compiled sharded train step (VERDICT round-1 item #2).
+
+Asserts the three deliverables: (a) loss equivalence vs single-device,
+(b) per-device param/opt-state bytes shrink ~Nx, (c) the compiled HLO
+contains reduce-scatter (stages 2/3) — matching the semantics of reference
+group_sharded_stage3.py:174 (slice buffers), :335 (slice update), :560
+(gather/release hooks).
+"""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.distributed.topology import build_mesh
+from paddle_tpu.parallel.sharded import ShardedTrainStep, zero_stage_name
+from paddle_tpu import optimizer
+
+
+def _init_params(key):
+    k1, k2 = jax.random.split(key)
+    return {"w1": jax.random.normal(k1, (16, 32)) * 0.1,
+            "b1": jnp.zeros((32,)),
+            "w2": jax.random.normal(k2, (32, 1)) * 0.1,
+            "b2": jnp.zeros((1,))}
+
+
+def _loss_fn(p, batch):
+    x, y = batch
+    h = jnp.maximum(x @ p["w1"] + p["b1"], 0)
+    return jnp.mean((h @ p["w2"] + p["b2"] - y) ** 2)
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(32, 16)).astype("float32"))
+    y = jnp.asarray(rng.normal(size=(32, 1)).astype("float32"))
+    return x, y
+
+
+@pytest.fixture(scope="module")
+def ref_losses(data):
+    x, y = data
+    flat = _init_params(jax.random.PRNGKey(0))
+    opt_ref = optimizer.AdamW(learning_rate=1e-2, parameters=[])
+    st = opt_ref.init_opt_state(flat)
+
+    @jax.jit
+    def ref_step(flat, st):
+        loss, g = jax.value_and_grad(lambda f: _loss_fn(f, (x, y)))(flat)
+        nf, ns = opt_ref.apply_gradients_functional(flat, g, st, lr=1e-2)
+        return nf, ns, loss
+
+    losses = []
+    for _ in range(5):
+        flat, st, l = ref_step(flat, st)
+        losses.append(float(l))
+    return losses
+
+
+@pytest.mark.parametrize("stage", [1, 2, 3])
+def test_loss_equivalence(stage, data, ref_losses):
+    mesh = build_mesh({"dp": 8})
+    p = _init_params(jax.random.PRNGKey(0))
+    opt = optimizer.AdamW(learning_rate=1e-2, parameters=[])
+    step = ShardedTrainStep(mesh, _loss_fn, p, opt, stage=stage, axis="dp")
+    losses = [float(step(data)) for _ in range(5)]
+    np.testing.assert_allclose(losses, ref_losses, rtol=1e-4, atol=1e-5)
+
+
+def test_stage3_param_bytes_shrink(data):
+    mesh = build_mesh({"dp": 8})
+    p = _init_params(jax.random.PRNGKey(0))
+    opt = optimizer.AdamW(learning_rate=1e-2, parameters=[])
+    s2 = ShardedTrainStep(mesh, _loss_fn, p, opt, stage=2, axis="dp")
+    s3 = ShardedTrainStep(mesh, _loss_fn, _init_params(jax.random.PRNGKey(0)),
+                          optimizer.AdamW(learning_rate=1e-2, parameters=[]),
+                          stage=3, axis="dp")
+    p2, o2 = s2.bytes_per_device()
+    p3, o3 = s3.bytes_per_device()
+    # stage 3 params are ~1/8 of the replicated stage-2 copy
+    assert p3 * 6 < p2, (p3, p2)
+    # opt state is sharded in both
+    assert o2 == o3
+    # and the actual arrays really are sharded across devices
+    w = s3.flat_params["p0"]
+    assert len({s.device for s in w.addressable_shards}) == 8
+    local = w.addressable_shards[0].data.shape[0]
+    assert local * 8 == w.shape[0]
+
+
+def test_reduce_scatter_in_hlo(data):
+    mesh = build_mesh({"dp": 8})
+    for stage, want_rs in ((1, False), (2, True), (3, True)):
+        p = _init_params(jax.random.PRNGKey(0))
+        opt = optimizer.AdamW(learning_rate=1e-2, parameters=[])
+        step = ShardedTrainStep(mesh, _loss_fn, p, opt, stage=stage, axis="dp")
+        hlo = step.lowered_hlo(data)
+        has_rs = "reduce_scatter" in hlo or "reduce-scatter" in hlo
+        assert has_rs == want_rs, f"stage {stage}: reduce_scatter={has_rs}"
+        assert "all-gather" in hlo or "all_gather" in hlo
+
+
+def test_materialized_params_roundtrip(data):
+    mesh = build_mesh({"dp": 8})
+    p = _init_params(jax.random.PRNGKey(0))
+    opt = optimizer.AdamW(learning_rate=1e-2, parameters=[])
+    step = ShardedTrainStep(mesh, _loss_fn, p, opt, stage=3, axis="dp")
+    got = step.materialized_params()
+    for k in p:
+        np.testing.assert_allclose(np.asarray(got[k]), np.asarray(p[k]),
+                                   rtol=1e-6)
+
+
+def test_clip_norm_matches_unsharded(data):
+    x, y = data
+    mesh = build_mesh({"dp": 8})
+    clip = 0.05
+    # unsharded reference with global-norm clipping
+    flat = _init_params(jax.random.PRNGKey(0))
+    opt_ref = optimizer.AdamW(learning_rate=1e-2, parameters=[])
+    st = opt_ref.init_opt_state(flat)
+
+    @jax.jit
+    def ref_step(flat, st):
+        loss, g = jax.value_and_grad(lambda f: _loss_fn(f, (x, y)))(flat)
+        gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(v)) for v in g.values()))
+        scale = jnp.minimum(1.0, clip / (gnorm + 1e-6))
+        g = {k: v * scale for k, v in g.items()}
+        nf, ns = opt_ref.apply_gradients_functional(flat, g, st, lr=1e-2)
+        return nf, ns, loss
+
+    ref = []
+    for _ in range(4):
+        flat, st, l = ref_step(flat, st)
+        ref.append(float(l))
+
+    p = _init_params(jax.random.PRNGKey(0))
+    opt = optimizer.AdamW(learning_rate=1e-2, parameters=[])
+    step = ShardedTrainStep(mesh, _loss_fn, p, opt, stage=2, axis="dp",
+                            clip_norm=clip)
+    losses = [float(step((x, y))) for _ in range(4)]
+    np.testing.assert_allclose(losses, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_level_name_mapping():
+    assert zero_stage_name("os") == 1
+    assert zero_stage_name("os_g") == 2
+    assert zero_stage_name("p_g_os") == 3
+    assert zero_stage_name(2) == 2
