@@ -123,6 +123,13 @@ class Optimizer:
 
     clear_gradients = clear_grad
 
+    def finish_step(self):
+        """Post-step bookkeeping shared by compiled train steps: advance the
+        LR scheduler (if any) and the global step counter."""
+        if isinstance(self._learning_rate, LRScheduler):
+            self._learning_rate.step()
+        self._global_step += 1
+
     # -- functional application (jit path) ---------------------------------
     def apply_gradients_functional(self, params: dict, grads: dict, opt_state: dict,
                                    lr=None, lr_scales: Optional[dict] = None):

@@ -14,7 +14,10 @@ from __future__ import annotations
 
 from .train_step import TrainStep, compile_train_step
 from .pipeline import PipelineTrainStep
+from .pipeline_schedules import (Pipeline1F1BTrainStep,
+                                 GenericPipeline1F1BTrainStep)
 from .sharded import ShardedTrainStep
 
 __all__ = ["TrainStep", "compile_train_step", "PipelineTrainStep",
+           "Pipeline1F1BTrainStep", "GenericPipeline1F1BTrainStep",
            "ShardedTrainStep"]

@@ -208,6 +208,7 @@ class PipelineTrainStep:
          loss) = self._step(self.embed_params, self.block_params, self.head_params,
                             self.opt_state["embed"], self.opt_state["block"],
                             self.opt_state["head"], lr, v)
+        self.opt.finish_step()
         return Tensor(loss)
 
 

@@ -1,0 +1,455 @@
+"""1F1B and interleaved (VPP) pipeline schedules, compiled SPMD.
+
+Reference semantics: fleet/meta_parallel/pipeline_parallel.py:242
+(`PipelineParallel` 1F1B), :1308 (interleaved VPP),
+passes/pipeline_scheduler_pass/pipeline_zero_bubble.py:62.
+
+Unlike the GPipe scan in pipeline.py (jax.grad through the whole schedule —
+every tick's activations stay live until the backward scan), these schedules
+do the backward *inside* the tick loop with an explicit jax.vjp:
+
+* each rank keeps a ring buffer of only the **stage inputs** for in-flight
+  microbatches — depth min(n_micro, 2*(n_virtual_stages-1)+1), independent of
+  n_micro in the long-batch regime (the 1F1B memory bound; remat-inside-stage
+  because vjp recomputes the stage forward at backward time);
+* forward of microbatch f runs on virtual stage s at tick f + s; backward of
+  microbatch b runs at tick 2*(S-1) - s + b (S = total virtual stages) — the
+  synchronous 1F1B order: the last stage's backward of mb 0 starts the tick
+  of its forward, n_micro-independent activation footprint;
+* activations hop stage->stage+1 with `lax.ppermute` (ICI neighbor), grad
+  cotangents hop the reverse ring; with v>1 chunks per rank (VPP) the ring
+  carries a [v, ...] stack and rank 0 / rank n-1 rotate the chunk axis on
+  wrap, exactly the interleaved virtual-stage order.
+
+All of it sits inside one shard_map/jit: XLA overlaps the ppermutes with the
+stage compute.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor
+from .pipeline import _flatten, _unflatten, _opt_specs, _axes_in_scope
+
+__all__ = ["spmd_pipeline_1f1b", "Pipeline1F1BTrainStep",
+           "GenericPipeline1F1BTrainStep"]
+
+
+def _vary(x, axes):
+    """Cast x to be manual-varying over every axis in `axes` it isn't yet
+    (aligns lax.cond branch output types under shard_map's vma typing)."""
+    have = getattr(getattr(x, "aval", None), "vma", frozenset())
+    missing = tuple(a for a in axes if a not in have)
+    return jax.lax.pcast(x, missing, to="varying") if missing else x
+
+
+def spmd_pipeline_1f1b(fwd_mb: Callable, params, n_micro: int,
+                       act_sd, axis: str = "pp", n_chunks: int = 1,
+                       varying_axes=("dp", "pp", "mp")):
+    """Run the 1F1B (v=1) / interleaved (v>1) schedule inside shard_map.
+
+    fwd_mb(params, chunk_idx, act_in, mb_idx) -> (act_out, loss_mb)
+        chunk_idx: which of this rank's v parameter chunks to apply;
+        the caller gates embed (global stage 0) / head-loss (global last
+        stage) inside fwd_mb with lax.cond on (rank, chunk).
+    params: this rank's full parameter pytree (stage chunks + embed + head).
+    act_sd: jax.ShapeDtypeStruct of one microbatch activation.
+    Returns (loss_sum_on_last_stage, grads_like_params).
+    """
+    n = jax.lax.psum(1, axis)
+    r = jax.lax.axis_index(axis)
+    v = n_chunks
+    S = v * n                                   # virtual stages
+    total = n_micro + 2 * (S - 1)
+    depth = int(min(n_micro, 2 * (S - 1) + 1))
+    depth = max(depth, 1)
+    perm_f = [(i, (i + 1) % n) for i in range(n)]
+    perm_b = [(i, (i - 1) % n) for i in range(n)]
+
+    mb_shape, mb_dtype = act_sd.shape, act_sd.dtype
+    va = _axes_in_scope(varying_axes)
+    # Cast params to axis-varying BEFORE the per-tick vjp: jax.vjp inserts an
+    # implicit psum over the mesh axes for cotangents of invariant inputs
+    # used in varying computation, which would (a) pre-sum embed/head grads
+    # across ranks per tick, corrupting the masked accumulation, and (b) put
+    # collectives inside masked code paths.  With varying params the vjp is
+    # purely rank-local; the caller combines grads explicitly afterwards.
+    params = jax.tree_util.tree_map(lambda p: _vary(p, va), params)
+
+    def tick(carry, t):
+        fwd_in, bwd_in, buf, gacc, loss_acc = carry
+        fwd_out = jnp.zeros_like(fwd_in)
+        bwd_out = jnp.zeros_like(bwd_in)
+        for c in range(v):
+            s = c * n + r                        # this chunk's virtual stage
+            # ---- forward slot: microbatch f = t - s -----------------------
+            f = t - s
+            do_f = (f >= 0) & (f < n_micro)
+            fc = jnp.clip(f, 0, n_micro - 1)
+            a_in = fwd_in[c]
+            a_out, l_mb = fwd_mb(params, c, a_in, fc)
+            buf = jnp.where(do_f, buf.at[c, jnp.mod(fc, depth)].set(a_in), buf)
+            loss_acc = loss_acc + jnp.where(
+                do_f, l_mb.astype(jnp.float32), 0.0)
+            fwd_out = fwd_out.at[c].set(a_out)
+            # ---- backward slot: microbatch b ------------------------------
+            b = t - (2 * (S - 1) - s)
+            do_b = (b >= 0) & (b < n_micro)
+            bc = jnp.clip(b, 0, n_micro - 1)
+            a_saved = buf[c, jnp.mod(bc, depth)]
+            _, vjp_fn = jax.vjp(
+                lambda p, a: fwd_mb(p, c, a, bc), params, a_saved)
+            is_last = s == S - 1
+            g_act = jnp.where(is_last, jnp.zeros_like(bwd_in[c]), bwd_in[c])
+            gp, ga = vjp_fn((g_act, _vary(jnp.ones((), jnp.float32), va)))
+            gacc = jax.tree_util.tree_map(
+                lambda acc, g: acc + jnp.where(do_b, g, 0).astype(acc.dtype),
+                gacc, gp)
+            bwd_out = bwd_out.at[c].set(jnp.where(do_b, ga, 0))
+        # ---- communicate ----------------------------------------------
+        recv_f = jax.lax.ppermute(fwd_out, axis, perm_f)
+        # chunk rotation on the wrap rank: rank n-1's chunk c output feeds
+        # rank 0's chunk c+1 (interleaved virtual-stage order)
+        fwd_in = jnp.where(r == 0, jnp.roll(recv_f, 1, axis=0), recv_f)
+        recv_b = jax.lax.ppermute(bwd_out, axis, perm_b)
+        bwd_in = jnp.where(r == n - 1, jnp.roll(recv_b, -1, axis=0), recv_b)
+        return (fwd_in, bwd_in, buf, gacc, loss_acc), None
+
+    carry = (jnp.zeros((v,) + mb_shape, mb_dtype),          # fwd ring
+             jnp.zeros((v,) + mb_shape, mb_dtype),          # bwd ring
+             jnp.zeros((v, depth) + mb_shape, mb_dtype),    # saved inputs
+             jax.tree_util.tree_map(
+                 lambda p: jnp.zeros(p.shape, p.dtype), params),  # grad acc
+             jnp.zeros((), jnp.float32))                    # loss acc
+    if va:
+        carry = jax.tree_util.tree_map(lambda x: _vary(x, va), carry)
+    (fwd_in, bwd_in, buf, gacc, loss_acc), _ = jax.lax.scan(
+        tick, carry, jnp.arange(total))
+    return loss_acc, gacc
+
+
+class Pipeline1F1BTrainStep:
+    """Hybrid dp×pp(×mp) compiled train step on the 1F1B / interleaved
+    schedule for LM-shaped models (embed / L stacked blocks / head).
+
+    Same model contract as PipelineTrainStep, but:
+      * per-microbatch embed + head run inside the pipelined tick (memory
+        does not scale with n_micro);
+      * schedule="1f1b" (default) or n_chunks>1 for interleaved VPP.
+
+    block_params leaves: leading dim L = n_pp * n_chunks * layers_per_chunk.
+    """
+
+    def __init__(self, mesh: Mesh, embed_apply_mb, block_apply, head_loss_mb,
+                 embed_params, block_params, head_params, optimizer,
+                 n_micro: int, n_chunks: int = 1, batch_spec=None,
+                 donate=True, remat_stage: bool = False):
+        if batch_spec is None:
+            batch_spec = P("dp") if "dp" in mesh.axis_names else P()
+        self.mesh = mesh
+        self.n_micro = n_micro
+        self.n_chunks = n_chunks
+        self.opt = optimizer
+        n_pp = mesh.shape.get("pp", 1)
+        self.n_pp = n_pp
+
+        L = jax.tree_util.tree_leaves(block_params)[0].shape[0]
+        if L % (n_pp * n_chunks) != 0:
+            raise ValueError(
+                f"layers {L} not divisible by n_pp*n_chunks = "
+                f"{n_pp}*{n_chunks}")
+
+        def place(tree, spec_fn):
+            return jax.tree_util.tree_map(
+                lambda va: jax.device_put(
+                    va, NamedSharding(mesh, spec_fn(va))), tree)
+
+        rep = lambda va: P(*([None] * va.ndim))
+        # reorder layers so that chunk c of rank r holds virtual stage c*n+r:
+        # layer order along dim0 becomes [chunk0: ranks 0..n-1][chunk1: ...]
+        stacked = lambda va: P(*(["pp"] + [None] * (va.ndim - 1)))
+        lpc = L // (n_pp * n_chunks)            # layers per chunk
+
+        def vpp_order(x):
+            # [L, ...] -> [n_chunks*n_pp, lpc, ...] grouped so that
+            # shard_map's pp split gives rank r chunks [c, lpc, ...]
+            xs = x.reshape((n_chunks, n_pp, lpc) + x.shape[1:])
+            xs = jnp.swapaxes(xs, 0, 1)          # [n_pp, n_chunks, lpc, ...]
+            return xs.reshape((n_pp, n_chunks * lpc) + x.shape[1:]) \
+                     .reshape((n_pp * n_chunks * lpc,) + x.shape[1:])
+
+        self._vpp = n_chunks > 1
+        bp = jax.tree_util.tree_map(vpp_order, block_params) if self._vpp \
+            else block_params
+        self.embed_params = place(embed_params, rep)
+        self.block_params = place(bp, stacked)
+        self.head_params = place(head_params, rep)
+        self.opt_state = {
+            "embed": self.opt.init_opt_state(_flatten(self.embed_params)),
+            "block": self.opt.init_opt_state(_flatten(self.block_params)),
+            "head": self.opt.init_opt_state(_flatten(self.head_params)),
+        }
+
+        from jax import shard_map
+
+        blk_spec = jax.tree_util.tree_map(
+            lambda va: P(*(["pp"] + [None] * (va.ndim - 1))),
+            self.block_params)
+        rep_spec_e = jax.tree_util.tree_map(
+            lambda va: P(*([None] * va.ndim)), self.embed_params)
+        rep_spec_h = jax.tree_util.tree_map(
+            lambda va: P(*([None] * va.ndim)), self.head_params)
+
+        n_ck = n_chunks
+        self._embed_apply_mb = embed_apply_mb
+        self._block_apply = jax.checkpoint(block_apply) if remat_stage \
+            else block_apply
+        self._head_loss_mb = head_loss_mb
+
+        def grad_step(embed_p, block_p, head_p, eo, bo, ho, lr, batch):
+            # inside shard_map: block_p leading dim = n_chunks * lpc
+            n = jax.lax.psum(1, "pp")
+            r = jax.lax.axis_index("pp")
+            S = n_ck * n
+            ids = batch[0]
+            B = ids.shape[0]
+            mbs = B // self.n_micro
+            va = _axes_in_scope(mesh.axis_names)
+            # pre-vary the batch over every mesh axis: ints carry no grads,
+            # so the pcast transpose (a psum) is harmless — and everything
+            # computed from it is then fully varying, keeping implicit
+            # collectives out of the masked embed/head paths
+            mb_batch = jax.tree_util.tree_map(
+                lambda x: _vary(
+                    x.reshape((self.n_micro, mbs) + x.shape[1:]), va), batch)
+
+            params = {"embed": embed_p, "block": block_p, "head": head_p}
+            # activation ShapeDtypeStruct: embed of one microbatch
+            act_sd = jax.eval_shape(
+                lambda p, mb: self._embed_apply_mb(p, mb), embed_p,
+                jax.tree_util.tree_map(lambda x: x[0], mb_batch))
+
+            def fwd_mb(ps, c, a_in, f):
+                mb = jax.tree_util.tree_map(lambda x: x[f], mb_batch)
+                s = c * n + r
+                # embed/head run (masked) on every rank: where-select keeps
+                # collectives out of conditionals, and grads route only to
+                # the owning stage through the select
+                emb = self._embed_apply_mb(ps["embed"], mb).astype(a_in.dtype)
+                a0 = jnp.where(s == 0, emb, a_in)
+                lpc = jax.tree_util.tree_leaves(
+                    ps["block"])[0].shape[0] // n_ck
+                chunk = jax.tree_util.tree_map(
+                    lambda x: x[c * lpc:(c + 1) * lpc], ps["block"])
+
+                def one(a, lp):
+                    return self._block_apply(lp, a), None
+                out, _ = jax.lax.scan(one, a0, chunk)
+                l_mb = self._head_loss_mb(ps["head"], out, mb).astype(
+                    jnp.float32)
+                loss = l_mb * jnp.where(s == S - 1, 1.0, 0.0)
+                return out, loss
+
+            loss_sum, g = spmd_pipeline_1f1b(
+                fwd_mb, params, self.n_micro, act_sd, axis="pp",
+                n_chunks=n_ck)
+            # per-mb head losses were means; global loss = mean over mbs
+            loss = loss_sum / self.n_micro
+            loss = jax.lax.psum(loss, "pp")      # nonzero on last stage only
+            for axn in mesh.axis_names:
+                if axn != "pp":
+                    loss = jax.lax.pmean(loss, axn)
+
+            ge, gb, gh = g["embed"], g["block"], g["head"]
+            scale = 1.0 / self.n_micro
+            ge, gb, gh = jax.tree_util.tree_map(
+                lambda x: x * scale, (ge, gb, gh))
+            # embed/head grads live on their owning stage only -> share
+            ge, gh = jax.tree_util.tree_map(
+                lambda va: jax.lax.psum(va, "pp"), (ge, gh))
+            if "dp" in mesh.axis_names and mesh.shape["dp"] > 1:
+                ge, gb, gh = jax.tree_util.tree_map(
+                    lambda va: jax.lax.pmean(va, "dp"), (ge, gb, gh))
+            if "mp" in mesh.axis_names and mesh.shape["mp"] > 1:
+                ge, gb, gh = jax.tree_util.tree_map(
+                    lambda va: jax.lax.pmean(va, "mp"), (ge, gb, gh))
+            ne, neo = self.opt.apply_gradients_functional(
+                _flatten(embed_p), _flatten(ge), eo, lr=lr)
+            nb, nbo = self.opt.apply_gradients_functional(
+                _flatten(block_p), _flatten(gb), bo, lr=lr)
+            nh, nho = self.opt.apply_gradients_functional(
+                _flatten(head_p), _flatten(gh), ho, lr=lr)
+            return (_unflatten(ne, embed_p), _unflatten(nb, block_p),
+                    _unflatten(nh, head_p), neo, nbo, nho, loss)
+
+        sm = shard_map(
+            grad_step, mesh=mesh,
+            in_specs=(rep_spec_e, blk_spec, rep_spec_h,
+                      _opt_specs(self.opt_state["embed"], None),
+                      _opt_specs(self.opt_state["block"], "pp"),
+                      _opt_specs(self.opt_state["head"], None),
+                      P(), batch_spec),
+            out_specs=(rep_spec_e, blk_spec, rep_spec_h,
+                       _opt_specs(self.opt_state["embed"], None),
+                       _opt_specs(self.opt_state["block"], "pp"),
+                       _opt_specs(self.opt_state["head"], None),
+                       P()))
+        donate_args = tuple(range(6)) if donate else ()
+        self._step = jax.jit(sm, donate_argnums=donate_args)
+
+    def __call__(self, batch):
+        val = jax.tree_util.tree_map(
+            lambda b: b._value if isinstance(b, Tensor) else jnp.asarray(b),
+            batch, is_leaf=lambda x: isinstance(x, Tensor))
+        lr = jnp.asarray(self.opt.get_lr(), jnp.float32)
+        (self.embed_params, self.block_params, self.head_params,
+         self.opt_state["embed"], self.opt_state["block"],
+         self.opt_state["head"], loss) = self._step(
+            self.embed_params, self.block_params, self.head_params,
+            self.opt_state["embed"], self.opt_state["block"],
+            self.opt_state["head"], lr, val)
+        self.opt.finish_step()
+        return Tensor(loss)
+
+
+class GenericPipeline1F1BTrainStep:
+    """Compiled 1F1B schedule for an arbitrary PipelineLayer (the LayerDesc /
+    SegmentLayers segmentation wired into the compiled path — reference
+    pp_layers.py:258 + pipeline_parallel.py:242).
+
+    Stages come from pipeline_layer.segment_parts; heterogeneous stages are
+    dispatched with lax.switch on the rank index (parameters replicated over
+    'pp' — simple and correct; the homogeneous-block Pipeline1F1BTrainStep is
+    the scalable path for big LMs).  Requires: every stage boundary carries
+    one activation array of the same shape/dtype, and pipeline_layer.loss_fn
+    is set.
+    """
+
+    def __init__(self, mesh: Mesh, pipeline_layer, optimizer, n_micro: int,
+                 example_input, batch_spec=None, donate=True):
+        from ..nn.layer import functional_state
+        if batch_spec is None:
+            batch_spec = P("dp") if "dp" in mesh.axis_names else P()
+        self.mesh = mesh
+        self.pl = pipeline_layer
+        self.opt = optimizer
+        self.n_micro = n_micro
+        n_pp = mesh.shape.get("pp", 1)
+        self.n_pp = n_pp
+        if pipeline_layer.loss_fn is None:
+            raise ValueError("PipelineLayer needs loss_fn for training")
+        segs = pipeline_layer.segment_parts
+        if len(segs) - 1 != n_pp:
+            raise ValueError(
+                f"PipelineLayer has {len(segs) - 1} stages, mesh pp={n_pp}")
+
+        self.params = {name: p._value
+                       for name, p in pipeline_layer.named_parameters()}
+        self.opt_state = self.opt.init_opt_state(self.params)
+
+        # stage apply functions over the substituted functional state
+        def make_stage(si):
+            lo, hi = segs[si], segs[si + 1]
+
+            def apply(ps, x):
+                with functional_state(pipeline_layer, ps):
+                    t = Tensor(x)
+                    for i in range(lo, hi):
+                        layer = pipeline_layer.run_function[i]
+                        t = layer(*t) if isinstance(t, tuple) else layer(t)
+                return t._value if isinstance(t, Tensor) else t
+            return apply
+
+        self._stage_fns = [make_stage(s) for s in range(n_pp)]
+
+        # activation contract: every stage boundary same shape/dtype
+        mb_in = jax.tree_util.tree_map(
+            lambda x: jax.eval_shape(lambda v: v[:max(1, x.shape[0] // n_micro)],
+                                     x), example_input)
+        act_sd = jax.eval_shape(self._stage_fns[0], self.params,
+                                mb_in if not isinstance(mb_in, (tuple, list))
+                                else mb_in[0])
+        for s in range(1, n_pp):
+            nxt = jax.eval_shape(self._stage_fns[s], self.params, act_sd)
+            if s < n_pp - 1 and (nxt.shape != act_sd.shape
+                                 or nxt.dtype != act_sd.dtype):
+                raise ValueError(
+                    f"stage {s} output {nxt.shape}/{nxt.dtype} != activation "
+                    f"contract {act_sd.shape}/{act_sd.dtype}")
+        self._act_sd = act_sd
+
+        from jax import shard_map
+        rep_spec = jax.tree_util.tree_map(
+            lambda v: P(*([None] * v.ndim)), self.params)
+        loss_fn = pipeline_layer.loss_fn
+
+        def grad_step(params, opt_state, lr, batch):
+            n = jax.lax.psum(1, "pp")
+            r = jax.lax.axis_index("pp")
+            va = _axes_in_scope(mesh.axis_names)
+            x_in, y_in = batch
+            B = x_in.shape[0]
+            mbs = B // self.n_micro
+            mb_batch = jax.tree_util.tree_map(
+                lambda x: _vary(
+                    x.reshape((self.n_micro, mbs) + x.shape[1:]), va), batch)
+
+            def fwd_mb(ps, c, a_in, f):
+                mb_x, mb_y = jax.tree_util.tree_map(
+                    lambda x: x[f], mb_batch)
+                s = r
+                # index-aware branches: stage 0 eats the microbatch
+                def branch(si):
+                    fn = self._stage_fns[si]
+                    if si == 0:
+                        return lambda ops, ox, oa: fn(ops, ox)
+                    return lambda ops, ox, oa: fn(ops, oa)
+                out = jax.lax.switch(s, [branch(si) for si in range(n_pp)],
+                                     ps, mb_x, a_in)
+                lt = loss_fn(Tensor(out), Tensor(mb_y))
+                lv = (lt._value if isinstance(lt, Tensor) else lt).astype(
+                    jnp.float32)
+                return out, lv * jnp.where(s == n - 1, 1.0, 0.0)
+
+            loss_sum, g = spmd_pipeline_1f1b(
+                fwd_mb, params, self.n_micro, self._act_sd, axis="pp",
+                n_chunks=1)
+            loss = jax.lax.psum(loss_sum / self.n_micro, "pp")
+            g = jax.tree_util.tree_map(
+                lambda v: jax.lax.psum(v / self.n_micro, "pp"), g)
+            for axn in mesh.axis_names:
+                if axn != "pp" and mesh.shape[axn] > 1:
+                    loss = jax.lax.pmean(loss, axn)
+                    g = jax.tree_util.tree_map(
+                        lambda v: jax.lax.pmean(v, axn), g)
+            new_p, new_o = self.opt.apply_gradients_functional(
+                params, g, opt_state, lr=lr)
+            return new_p, new_o, loss
+
+        opt_spec = jax.tree_util.tree_map(
+            lambda v: P(*([None] * getattr(v, "ndim", 0))), self.opt_state)
+        sm = shard_map(grad_step, mesh=mesh,
+                       in_specs=(rep_spec, opt_spec, P(), batch_spec),
+                       out_specs=(rep_spec, opt_spec, P()))
+        self._step = jax.jit(sm, donate_argnums=(0, 1) if donate else ())
+
+    def __call__(self, batch):
+        val = jax.tree_util.tree_map(
+            lambda b: b._value if isinstance(b, Tensor) else jnp.asarray(b),
+            batch, is_leaf=lambda x: isinstance(x, Tensor))
+        lr = jnp.asarray(self.opt.get_lr(), jnp.float32)
+        self.params, self.opt_state, loss = self._step(
+            self.params, self.opt_state, lr, val)
+        self.opt.finish_step()
+        return Tensor(loss)
+
+    def sync_to_model(self):
+        targets = dict(self.pl.named_parameters())
+        for nme, v in self.params.items():
+            if nme in targets:
+                targets[nme]._set_value(v)

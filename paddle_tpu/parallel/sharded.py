@@ -31,7 +31,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 from ..core.tensor import Tensor
 
@@ -42,8 +42,15 @@ def zero_stage_name(stage) -> int:
     """Normalize Paddle level strings ('os', 'os_g', 'p_g_os') to 1/2/3."""
     if stage in (1, 2, 3):
         return int(stage)
-    return {"os": 1, "os_g": 2, "p_g_os": 3,
-            "stage1": 1, "stage2": 2, "stage3": 3}[str(stage)]
+    table = {"os": 1, "os_g": 2, "p_g_os": 3,
+             "stage1": 1, "stage2": 2, "stage3": 3,
+             "1": 1, "2": 2, "3": 3}
+    key = str(stage)
+    if key not in table:
+        raise ValueError(
+            f"unknown ZeRO stage {stage!r}; expected one of 1/2/3 or "
+            f"{sorted(table)}")
+    return table[key]
 
 
 class ShardedTrainStep:
@@ -215,7 +222,7 @@ class ShardedTrainStep:
                 in_specs=(param_spec, opt_spec, P(),
                           *([batch_spec] * len(batch))),
                 out_specs=(param_spec, opt_spec, P()),
-                check_rep=False)
+                check_vma=False)
             return sm(flat_params, opt_state, lr, *batch)
 
         return jax.jit(stepper, donate_argnums=(0, 1) if donate else ())
@@ -225,20 +232,23 @@ class ShardedTrainStep:
         lr = jnp.asarray(self.opt.get_lr(), jnp.float32)
         self.flat_params, self.opt_state, loss = self._step(
             self.flat_params, self.opt_state, lr, batch)
-        self.opt._global_step += 1
-        from ..optimizer.lr import LRScheduler
-        if isinstance(self.opt._learning_rate, LRScheduler):
-            self.opt._learning_rate.step()
+        self.opt.finish_step()
         return loss
 
     # -- introspection ------------------------------------------------------
     def materialized_params(self):
-        """Gather the full (unsharded) params pytree — checkpoints, eval."""
-        full = {}
-        for k, v in self.flat_params.items():
-            arr = jax.device_get(v)
-            full[k] = jnp.asarray(arr)
-        return self._assemble(full)
+        """Gather the full (unsharded) params pytree — checkpoints, eval.
+        Multi-host safe: reshards to replicated first (device_get on an array
+        sharded across non-addressable devices would fail), then assembles on
+        host with numpy — no round-trip back through the device."""
+        out_leaves = []
+        repl = NamedSharding(self.mesh, P())
+        for k, shape, size, dtype in zip(self._names, self.shapes, self.sizes,
+                                         self.dtypes):
+            v = jax.device_put(self.flat_params[k], repl)
+            arr = np.asarray(jax.device_get(v))
+            out_leaves.append(arr[:size].reshape(shape).astype(dtype))
+        return jax.tree_util.tree_unflatten(self.treedef, out_leaves)
 
     def lowered_hlo(self, batch) -> str:
         """Compiler IR of the step (tests assert collective choice here)."""

@@ -124,9 +124,7 @@ class TrainStep:
         lr = jnp.asarray(self.opt.get_lr(), jnp.float32)
         self.params, self.opt_state, self.buffers, loss = self._step(
             self.params, self.opt_state, self.buffers, sub, lr, *vals)
-        if isinstance(self.opt._learning_rate, LRScheduler):
-            self.opt._learning_rate.step()
-        self.opt._global_step += 1
+        self.opt.finish_step()
         return Tensor(loss)
 
     def sync_to_model(self):

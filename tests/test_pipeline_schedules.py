@@ -1,0 +1,151 @@
+"""1F1B + interleaved VPP pipeline schedules (VERDICT round-1 item #3).
+
+Deliverables verified: (a) loss equivalence vs the GPipe scan and vs
+single-device training, (b) activation memory (compiled temp bytes) 1F1B <
+GPipe at the same config, (c) PipelineLayer/LayerDesc segmentation drives a
+compiled pipeline for an arbitrary (non-LM) model.  Reference semantics:
+fleet/meta_parallel/pipeline_parallel.py:242 (1F1B), :1308 (VPP).
+"""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import optimizer
+from paddle_tpu.models.llama import llama_config_tiny, build_functional_llama
+from paddle_tpu.parallel.pipeline import PipelineTrainStep
+from paddle_tpu.parallel.pipeline_schedules import (
+    Pipeline1F1BTrainStep, GenericPipeline1F1BTrainStep)
+from paddle_tpu.distributed.topology import build_mesh, set_default_mesh
+
+
+def _lm_fns(cfg):
+    """Per-microbatch embed/head adapters (closures only capture config)."""
+    _, _, _, ea1, ba1, hl1 = build_functional_llama(cfg, n_micro=1)
+    embed_mb = lambda p, mb: ea1(p, mb)[0]
+    head_mb = lambda p, y, mb: hl1(p, y[None], mb)
+    return embed_mb, ba1, head_mb
+
+
+@pytest.fixture(scope="module")
+def lm_setup():
+    mesh = build_mesh({"dp": 2, "pp": 2, "mp": 2})
+    set_default_mesh(mesh)
+    cfg = llama_config_tiny(vocab=64, hidden=32, layers=4, heads=4, seq=16)
+    n_micro = 4
+    ep, bp, hp, ea, ba, hl = build_functional_llama(cfg, n_micro=n_micro)
+    embed_mb, _, head_mb = _lm_fns(cfg)
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, 64, (8, 16)).astype(np.int32))
+    batch = (ids, ids)
+
+    opt1 = optimizer.AdamW(learning_rate=1e-2, parameters=[])
+    s1 = PipelineTrainStep(mesh, ea, ba, hl, ep, bp, hp, opt1,
+                           n_micro=n_micro, donate=False)
+    gpipe = [float(s1(batch).numpy()) for _ in range(5)]
+    return dict(mesh=mesh, cfg=cfg, n_micro=n_micro, params=(ep, bp, hp),
+                fns=(embed_mb, ba, head_mb), batch=batch, gpipe=gpipe)
+
+
+def test_1f1b_matches_gpipe(lm_setup):
+    ep, bp, hp = lm_setup["params"]
+    embed_mb, ba, head_mb = lm_setup["fns"]
+    opt = optimizer.AdamW(learning_rate=1e-2, parameters=[])
+    step = Pipeline1F1BTrainStep(lm_setup["mesh"], embed_mb, ba, head_mb,
+                                 ep, bp, hp, opt,
+                                 n_micro=lm_setup["n_micro"], donate=False)
+    got = [float(step(lm_setup["batch"]).numpy()) for _ in range(5)]
+    np.testing.assert_allclose(got, lm_setup["gpipe"], rtol=2e-4, atol=1e-5)
+
+
+def test_interleaved_vpp_matches_gpipe(lm_setup):
+    ep, bp, hp = lm_setup["params"]
+    embed_mb, ba, head_mb = lm_setup["fns"]
+    opt = optimizer.AdamW(learning_rate=1e-2, parameters=[])
+    step = Pipeline1F1BTrainStep(lm_setup["mesh"], embed_mb, ba, head_mb,
+                                 ep, bp, hp, opt, n_chunks=2,
+                                 n_micro=lm_setup["n_micro"], donate=False)
+    got = [float(step(lm_setup["batch"]).numpy()) for _ in range(5)]
+    np.testing.assert_allclose(got, lm_setup["gpipe"], rtol=2e-4, atol=1e-5)
+
+
+def test_1f1b_uses_less_activation_memory_than_gpipe():
+    """The 1F1B bound: compiled temp bytes shrink vs GPipe at large
+    n_micro (saved activations ~ schedule depth, not n_micro)."""
+    mesh = build_mesh({"pp": 2}, devices=jax.devices()[:2])
+    cfg = llama_config_tiny(vocab=64, hidden=64, layers=4, heads=4, seq=64)
+    n_micro = 16
+    ep, bp, hp, ea, ba, hl = build_functional_llama(cfg, n_micro=n_micro)
+    embed_mb, _, head_mb = _lm_fns(cfg)
+    ids = jnp.zeros((32, 64), jnp.int32)
+
+    def temp_bytes(step):
+        c = step._step.lower(
+            step.embed_params, step.block_params, step.head_params,
+            step.opt_state["embed"], step.opt_state["block"],
+            step.opt_state["head"], jnp.asarray(1e-2, jnp.float32),
+            (ids, ids)).compile()
+        ma = c.memory_analysis()
+        return ma.temp_size_in_bytes if ma else None
+
+    o1 = optimizer.SGD(learning_rate=1e-2, parameters=[])
+    gpipe = PipelineTrainStep(mesh, ea, ba, hl, ep, bp, hp, o1,
+                              n_micro=n_micro, donate=False, batch_spec=P())
+    o2 = optimizer.SGD(learning_rate=1e-2, parameters=[])
+    f1b = Pipeline1F1BTrainStep(mesh, embed_mb, ba, head_mb, ep, bp, hp, o2,
+                                n_micro=n_micro, donate=False,
+                                batch_spec=P())
+    m_gpipe, m_1f1b = temp_bytes(gpipe), temp_bytes(f1b)
+    if m_gpipe is None or m_1f1b is None:
+        pytest.skip("memory_analysis unavailable on this backend")
+    assert m_1f1b * 2 < m_gpipe, (m_1f1b, m_gpipe)
+
+
+def test_generic_pipelinelayer_1f1b():
+    """LayerDesc segmentation drives a compiled pipeline for a non-LM model;
+    matches single-device SGD exactly."""
+    from paddle_tpu.distributed.fleet.meta_parallel.pp_layers import (
+        PipelineLayer, LayerDesc)
+    mesh = build_mesh({"pp": 2}, devices=jax.devices()[:2])
+    paddle.seed(3)
+    pl = PipelineLayer(
+        layers=[LayerDesc(nn.Linear, 16, 16), LayerDesc(nn.ReLU),
+                LayerDesc(nn.Linear, 16, 16), LayerDesc(nn.Tanh)],
+        num_stages=2,
+        loss_fn=lambda out, y: ((out - y) ** 2).mean())
+    opt = optimizer.SGD(learning_rate=0.05, parameters=[])
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(8, 16)).astype("float32")
+    y = rng.normal(size=(8, 16)).astype("float32")
+    step = GenericPipeline1F1BTrainStep(mesh, pl, opt, n_micro=4,
+                                        example_input=jnp.asarray(x),
+                                        donate=False)
+    losses = [float(step((x, y)).numpy()) for _ in range(6)]
+
+    paddle.seed(3)
+    net = nn.Sequential(nn.Linear(16, 16), nn.ReLU(), nn.Linear(16, 16),
+                        nn.Tanh())
+    opt2 = optimizer.SGD(learning_rate=0.05, parameters=net.parameters())
+    ref = []
+    for _ in range(6):
+        loss = ((net(paddle.to_tensor(x)) - paddle.to_tensor(y)) ** 2).mean()
+        loss.backward()
+        opt2.step()
+        opt2.clear_grad()
+        ref.append(float(loss.numpy()))
+    np.testing.assert_allclose(losses, ref, rtol=2e-4, atol=1e-5)
+
+
+def test_generic_stage_count_mismatch_raises():
+    from paddle_tpu.distributed.fleet.meta_parallel.pp_layers import (
+        PipelineLayer, LayerDesc)
+    mesh = build_mesh({"pp": 2}, devices=jax.devices()[:2])
+    pl = PipelineLayer(layers=[LayerDesc(nn.Linear, 4, 4)], num_stages=1,
+                       loss_fn=lambda o, y: o.sum())
+    opt = optimizer.SGD(learning_rate=0.1, parameters=[])
+    with pytest.raises(ValueError, match="stages"):
+        GenericPipeline1F1BTrainStep(mesh, pl, opt, n_micro=2,
+                                     example_input=jnp.zeros((2, 4)))
