@@ -25,7 +25,8 @@ from ..tensor import manipulation as manip
 from ..incubate.nn.functional import fused_rotary_position_embedding
 
 __all__ = ["LlamaConfig", "LlamaForCausalLM", "LlamaModel", "LlamaDecoderLayer",
-           "build_functional_llama", "llama_config_7b", "llama_config_tiny"]
+           "build_functional_llama", "llama_block_specs", "llama_config_7b",
+           "llama_config_tiny"]
 
 
 @dataclass
@@ -212,6 +213,24 @@ class LlamaForCausalLM(Layer):
 # ---------------------------------------------------------------------------
 # Functional form (pipeline/bench path)
 # ---------------------------------------------------------------------------
+def llama_block_specs(mp_axis: str = "mp"):
+    """Per-leaf PartitionSpec suffixes (excluding the leading layer dim) for
+    Megatron-style tensor parallelism over `mp_axis`:
+
+      wq/wk/wv, wgate/wup: column-parallel (output dim sharded over mp)
+      wo, wdown:           row-parallel (input dim sharded, psum after)
+      ln1/ln2:             replicated
+
+    Reference: fleet/layers/mpu/mp_layers.py:336 (ColumnParallelLinear),
+    :543 (RowParallelLinear) — here the sharded matmuls live inside the
+    pipeline stage function (block_apply) as rank-local dots + lax.psum.
+    """
+    col = (None, mp_axis)
+    row = (mp_axis, None)
+    return {"ln1": (None,), "wq": col, "wk": col, "wv": col, "wo": row,
+            "ln2": (None,), "wgate": col, "wup": col, "wdown": row}
+
+
 def build_functional_llama(config: LlamaConfig, key=None, dtype=None,
                            n_micro: int = 1, mp_axis: str = None):
     """Returns (embed_params, block_params_stacked, head_params,
@@ -219,8 +238,15 @@ def build_functional_llama(config: LlamaConfig, key=None, dtype=None,
 
     block_params leaves have leading dim num_hidden_layers (stackable over
     'pp'). batch = (input_ids[B,S], labels[B,S]); embed_apply splits B into
-    n_micro microbatches. When mp_axis is set, matmul outputs get sharding
-    constraints over that axis (GSPMD tensor parallelism).
+    n_micro microbatches.
+
+    When mp_axis is set, block_apply is tensor-parallel over that mesh axis:
+    it must then run inside shard_map with `mp_axis` in scope and with block
+    weights sharded per `llama_block_specs(mp_axis)` (column-parallel QKV and
+    gate/up, row-parallel wo/wdown followed by lax.psum over mp_axis).  The
+    per-rank head counts are derived from the *local* weight shard shapes, so
+    the same block_apply works sharded and unsharded.  Requires
+    num_attention_heads % mp == 0 and num_key_value_heads % mp == 0.
     """
     c = config
     d = jnp.dtype(dtype) if dtype is not None else jnp.float32
@@ -270,19 +296,30 @@ def build_functional_llama(config: LlamaConfig, key=None, dtype=None,
         mbs = B // n_micro
         return x.reshape((n_micro, mbs) + x.shape[1:])
 
+    def _mp_reduce(y):
+        # row-parallel epilogue: sum partials across mp ranks, then restore
+        # the manual-varying type (psum strips mp from the vma set, but the
+        # residual stream it is added to is varying over mp)
+        if mp_axis is None:
+            return y
+        y = jax.lax.psum(y, mp_axis)
+        return jax.lax.pcast(y, (mp_axis,), to="varying")
+
     def block_apply(lp, x):
-        # x: [mbs, S, H] (one microbatch)
+        # x: [mbs, S, H] (one microbatch); weight leaves may be mp-local
+        # shards (llama_block_specs) — head counts derive from local shapes
         B, S, H = x.shape
-        nh, nkv = c.num_attention_heads, c.num_key_value_heads
+        nh_l = lp["wq"].shape[-1] // head_dim
+        nkv_l = lp["wk"].shape[-1] // head_dim
         h = rms(x, lp["ln1"])
-        q = (h @ lp["wq"]).reshape(B, S, nh, head_dim)
-        k = (h @ lp["wk"]).reshape(B, S, nkv, head_dim)
-        v = (h @ lp["wv"]).reshape(B, S, nkv, head_dim)
+        q = (h @ lp["wq"]).reshape(B, S, nh_l, head_dim)
+        k = (h @ lp["wk"]).reshape(B, S, nkv_l, head_dim)
+        v = (h @ lp["wv"]).reshape(B, S, nkv_l, head_dim)
         sin, cos = sin_t[:S], cos_t[:S]
         q = _apply_rope(q, sin, cos)
         k = _apply_rope(k, sin, cos)
-        if nh != nkv:
-            rep = nh // nkv
+        if nh_l != nkv_l:
+            rep = nh_l // nkv_l
             k = jnp.repeat(k, rep, axis=2)
             v = jnp.repeat(v, rep, axis=2)
         from ..core.dispatch import get_kernel
@@ -294,11 +331,11 @@ def build_functional_llama(config: LlamaConfig, key=None, dtype=None,
             logits = jnp.where(mask, logits.astype(jnp.float32), -jnp.inf)
             w = jax.nn.softmax(logits, -1).astype(x.dtype)
             o = jnp.einsum("bhqk,bkhd->bqhd", w, v)
-        o = o.reshape(B, S, H) @ lp["wo"]
+        o = _mp_reduce(o.reshape(B, S, nh_l * head_dim) @ lp["wo"])
         x = x + o
         h = rms(x, lp["ln2"])
         ff = jax.nn.silu(h @ lp["wgate"]) * (h @ lp["wup"])
-        return x + ff @ lp["wdown"]
+        return x + _mp_reduce(ff @ lp["wdown"])
 
     def head_loss_apply(p, y, batch):
         # y: [n_micro, mbs, S, H]

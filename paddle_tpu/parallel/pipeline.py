@@ -249,3 +249,21 @@ def _opt_specs(opt_state, stack_axis):
             return P(*([stack_axis] + [None] * (nd - 1)))
         return P(*([None] * nd))
     return jax.tree_util.tree_map(spec, opt_state)
+
+
+def _opt_specs_named(opt_state, param_suffixes, stack_axis):
+    """Opt-state specs that co-shard moment buffers with tensor-parallel
+    params: opt_state is {pname: {state_key: leaf}}; param_suffixes maps
+    pname -> partition suffix (excluding the stacked-layer dim).  Moment
+    leaves (same ndim as the param) inherit the param's spec; scalars and
+    everything else fall back to stack-dim-only / replicated."""
+    def spec_for(pname, v):
+        nd = getattr(v, "ndim", 0)
+        suffix = param_suffixes.get(pname)
+        if suffix is not None and nd == len(suffix) + 1:
+            return P(stack_axis, *suffix)
+        if stack_axis and nd >= 1:
+            return P(*([stack_axis] + [None] * (nd - 1)))
+        return P(*([None] * nd))
+    return {pname: jax.tree_util.tree_map(lambda v: spec_for(pname, v), st)
+            for pname, st in opt_state.items()}

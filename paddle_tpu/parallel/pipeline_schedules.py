@@ -148,7 +148,12 @@ class Pipeline1F1BTrainStep:
     def __init__(self, mesh: Mesh, embed_apply_mb, block_apply, head_loss_mb,
                  embed_params, block_params, head_params, optimizer,
                  n_micro: int, n_chunks: int = 1, batch_spec=None,
-                 donate=True, remat_stage: bool = False):
+                 donate=True, remat_stage: bool = False, block_specs=None):
+        """block_specs: optional {leaf_name: partition-suffix tuple} for the
+        block params (excluding the leading stacked-layer dim), e.g.
+        llama_block_specs("mp") — wires real tensor parallelism: those leaves
+        are placed P("pp", *suffix) and their grads are NOT averaged over the
+        axes the suffix names (each rank owns a distinct shard)."""
         if batch_spec is None:
             batch_spec = P("dp") if "dp" in mesh.axis_names else P()
         self.mesh = mesh
@@ -157,6 +162,16 @@ class Pipeline1F1BTrainStep:
         self.opt = optimizer
         n_pp = mesh.shape.get("pp", 1)
         self.n_pp = n_pp
+        if block_specs is not None and not isinstance(block_params, dict):
+            raise ValueError("block_specs requires dict block_params")
+        self._block_specs = block_specs or {}
+        # the grad-combine below (and spmd_pipeline_1f1b's varying_axes)
+        # assumes the tensor-parallel axis is literally named "mp"
+        bad = {a for sfx in self._block_specs.values()
+               for a in sfx if a not in (None, "mp", "pp")}
+        if bad:
+            raise ValueError(
+                f"block_specs may only shard over the 'mp' axis, got {bad}")
 
         L = jax.tree_util.tree_leaves(block_params)[0].shape[0]
         if L % (n_pp * n_chunks) != 0:
@@ -175,6 +190,12 @@ class Pipeline1F1BTrainStep:
         stacked = lambda va: P(*(["pp"] + [None] * (va.ndim - 1)))
         lpc = L // (n_pp * n_chunks)            # layers per chunk
 
+        def blk_leaf_spec(name, va):
+            suffix = self._block_specs.get(name)
+            if suffix is not None:
+                return P("pp", *suffix)
+            return P(*(["pp"] + [None] * (va.ndim - 1)))
+
         def vpp_order(x):
             # [L, ...] -> [n_chunks*n_pp, lpc, ...] grouped so that
             # shard_map's pp split gives rank r chunks [c, lpc, ...]
@@ -187,7 +208,13 @@ class Pipeline1F1BTrainStep:
         bp = jax.tree_util.tree_map(vpp_order, block_params) if self._vpp \
             else block_params
         self.embed_params = place(embed_params, rep)
-        self.block_params = place(bp, stacked)
+        if isinstance(bp, dict):
+            self.block_params = {
+                name: jax.device_put(
+                    v, NamedSharding(mesh, blk_leaf_spec(name, v)))
+                for name, v in bp.items()}
+        else:
+            self.block_params = place(bp, stacked)
         self.head_params = place(head_params, rep)
         self.opt_state = {
             "embed": self.opt.init_opt_state(_flatten(self.embed_params)),
@@ -197,9 +224,13 @@ class Pipeline1F1BTrainStep:
 
         from jax import shard_map
 
-        blk_spec = jax.tree_util.tree_map(
-            lambda va: P(*(["pp"] + [None] * (va.ndim - 1))),
-            self.block_params)
+        if isinstance(self.block_params, dict):
+            blk_spec = {name: blk_leaf_spec(name, va)
+                        for name, va in self.block_params.items()}
+        else:
+            blk_spec = jax.tree_util.tree_map(
+                lambda va: P(*(["pp"] + [None] * (va.ndim - 1))),
+                self.block_params)
         rep_spec_e = jax.tree_util.tree_map(
             lambda va: P(*([None] * va.ndim)), self.embed_params)
         rep_spec_h = jax.tree_util.tree_map(
@@ -272,12 +303,32 @@ class Pipeline1F1BTrainStep:
             # embed/head grads live on their owning stage only -> share
             ge, gh = jax.tree_util.tree_map(
                 lambda va: jax.lax.psum(va, "pp"), (ge, gh))
-            if "dp" in mesh.axis_names and mesh.shape["dp"] > 1:
+            if "dp" in mesh.axis_names:
                 ge, gb, gh = jax.tree_util.tree_map(
                     lambda va: jax.lax.pmean(va, "dp"), (ge, gb, gh))
-            if "mp" in mesh.axis_names and mesh.shape["mp"] > 1:
-                ge, gb, gh = jax.tree_util.tree_map(
-                    lambda va: jax.lax.pmean(va, "mp"), (ge, gb, gh))
+            if "mp" in mesh.axis_names:
+                ge, gh = jax.tree_util.tree_map(
+                    lambda va: jax.lax.pmean(va, "mp"), (ge, gh))
+                # replicated block leaves: copies hold rank-partial grads
+                # under TP (and full grads when mp is replicated-compute) —
+                # pmean is right for both: per-tick vjp seeds the loss on
+                # every mp rank, so partial sums arrive psum'd * mp.
+                # mp-sharded leaves: each rank owns a distinct shard whose
+                # accumulated grad is mp x the true shard grad (the
+                # row-parallel psum/pvary transpose broadcasts the summed
+                # cotangent to all ranks) -> scale by 1/mp, no collective.
+                inv_mp = 1.0 / mesh.shape["mp"]
+
+                def _combine_mp(name, g):
+                    if "mp" in self._block_specs.get(name, ()):
+                        return g * inv_mp
+                    return jax.lax.pmean(g, "mp")
+                if isinstance(gb, dict) and self._block_specs:
+                    gb = {name: _combine_mp(name, g)
+                          for name, g in gb.items()}
+                else:
+                    gb = jax.tree_util.tree_map(
+                        lambda va: jax.lax.pmean(va, "mp"), gb)
             ne, neo = self.opt.apply_gradients_functional(
                 _flatten(embed_p), _flatten(ge), eo, lr=lr)
             nb, nbo = self.opt.apply_gradients_functional(
@@ -287,16 +338,21 @@ class Pipeline1F1BTrainStep:
             return (_unflatten(ne, embed_p), _unflatten(nb, block_p),
                     _unflatten(nh, head_p), neo, nbo, nho, loss)
 
+        from .pipeline import _opt_specs_named
+        blk_opt_spec = (_opt_specs_named(self.opt_state["block"],
+                                         self._block_specs, "pp")
+                        if self._block_specs
+                        else _opt_specs(self.opt_state["block"], "pp"))
         sm = shard_map(
             grad_step, mesh=mesh,
             in_specs=(rep_spec_e, blk_spec, rep_spec_h,
                       _opt_specs(self.opt_state["embed"], None),
-                      _opt_specs(self.opt_state["block"], "pp"),
+                      blk_opt_spec,
                       _opt_specs(self.opt_state["head"], None),
                       P(), batch_spec),
             out_specs=(rep_spec_e, blk_spec, rep_spec_h,
                        _opt_specs(self.opt_state["embed"], None),
-                       _opt_specs(self.opt_state["block"], "pp"),
+                       blk_opt_spec,
                        _opt_specs(self.opt_state["head"], None),
                        P()))
         donate_args = tuple(range(6)) if donate else ()

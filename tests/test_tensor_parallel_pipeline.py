@@ -1,0 +1,132 @@
+"""Real tensor parallelism in the compiled flagship path (VERDICT r2 item 1).
+
+Checks, on the 8-virtual-device CPU mesh:
+  * loss equivalence: dp=2 x pp=2 x mp=2 with llama_block_specs("mp") matches
+    the same model with mp=1 (and the single-device reference) to rtol 1e-4
+    over several optimization steps;
+  * memory: per-device bytes of the mp-sharded block params are half the
+    replicated run's;
+  * HLO: the lowered step contains mp-axis collectives inside the stage body
+    (all-reduce appears with the mp axis in its replica groups).
+
+Reference parity target: fleet/layers/mpu/mp_layers.py:336 (ColumnParallelLinear),
+:543 (RowParallelLinear) — here implemented as rank-local dots + lax.psum inside
+block_apply (models/llama.py) under shard_map.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.models.llama import (llama_config_tiny, build_functional_llama,
+                                     llama_block_specs)
+from paddle_tpu.parallel.pipeline_schedules import Pipeline1F1BTrainStep
+from paddle_tpu.distributed.topology import build_mesh
+from paddle_tpu import optimizer
+
+
+def _make_batch(cfg, B, S, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, S)).astype(np.int32))
+    labels = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, S)).astype(np.int32))
+    return ids, labels
+
+
+def _mb_fns(cfg, mp_axis):
+    """Per-microbatch embed/head adapters + mp-aware block apply."""
+    _, _, _, ea1, ba1, hl1 = build_functional_llama(cfg, n_micro=1,
+                                                    mp_axis=mp_axis)
+    embed_mb = lambda p, mb: ea1(p, mb)[0]
+    head_mb = lambda p, y, mb: hl1(p, y[None], mb)
+    return embed_mb, ba1, head_mb
+
+
+def _run_steps(mesh_axes, mp_axis, n_steps=3, n_micro=4, seed=7):
+    cfg = llama_config_tiny(vocab=64, hidden=32, layers=4, heads=4, seq=16)
+    devs = jax.devices()[:int(np.prod(list(mesh_axes.values())))]
+    mesh = build_mesh(mesh_axes, devices=devs)
+    ep, bp, hp, _, _, _ = build_functional_llama(
+        cfg, key=jax.random.PRNGKey(seed), n_micro=n_micro, mp_axis=mp_axis)
+    ea, ba, hl = _mb_fns(cfg, mp_axis)
+    opt = optimizer.AdamW(learning_rate=1e-2, parameters=[])
+    specs = llama_block_specs(mp_axis) if mp_axis else None
+    step = Pipeline1F1BTrainStep(mesh, ea, ba, hl, ep, bp, hp, opt,
+                                 n_micro=n_micro, block_specs=specs,
+                                 donate=False)
+    dp = mesh_axes.get("dp", 1)
+    B = dp * n_micro
+    batch = _make_batch(cfg, B, 16, seed=1)
+    losses = [float(step(batch).numpy()) for _ in range(n_steps)]
+    return losses, step
+
+
+def test_mp2_loss_matches_mp1():
+    losses_ref, _ = _run_steps({"dp": 2, "pp": 2, "mp": 1}, mp_axis=None)
+    losses_tp, _ = _run_steps({"dp": 2, "pp": 2, "mp": 2}, mp_axis="mp")
+    np.testing.assert_allclose(losses_tp, losses_ref, rtol=1e-4)
+    # training actually moves
+    assert losses_tp[-1] < losses_tp[0]
+
+
+def test_mp_shards_halve_block_param_bytes():
+    _, step_rep = _run_steps({"pp": 2, "mp": 1}, mp_axis=None, n_steps=1)
+    _, step_tp = _run_steps({"pp": 2, "mp": 2}, mp_axis="mp", n_steps=1)
+
+    def per_device_bytes(step, names):
+        total = 0
+        for name in names:
+            arr = step.block_params[name]
+            shard = arr.addressable_shards[0]
+            total += shard.data.size * shard.data.dtype.itemsize
+        return total
+
+    mats = ["wq", "wk", "wv", "wo", "wgate", "wup", "wdown"]
+    b_rep = per_device_bytes(step_rep, mats)
+    b_tp = per_device_bytes(step_tp, mats)
+    assert b_tp * 2 == b_rep, (b_tp, b_rep)
+
+
+def test_mp_collectives_in_hlo():
+    cfg = llama_config_tiny(vocab=64, hidden=32, layers=2, heads=4, seq=16)
+    mesh = build_mesh({"pp": 2, "mp": 2}, devices=jax.devices()[:4])
+    ep, bp, hp, _, _, _ = build_functional_llama(cfg, n_micro=2, mp_axis="mp")
+    ea, ba, hl = _mb_fns(cfg, "mp")
+    opt = optimizer.AdamW(learning_rate=1e-3, parameters=[])
+    step = Pipeline1F1BTrainStep(mesh, ea, ba, hl, ep, bp, hp, opt,
+                                 n_micro=2, block_specs=llama_block_specs("mp"),
+                                 donate=False)
+    batch = _make_batch(cfg, 2, 16)
+    lr = jnp.asarray(1e-3, jnp.float32)
+    txt = step._step.lower(step.embed_params, step.block_params,
+                           step.head_params, step.opt_state["embed"],
+                           step.opt_state["block"], step.opt_state["head"],
+                           lr, batch).as_text()
+    # mesh is [pp=2, mp=2] with device order [[0,1],[2,3]]: mp groups are
+    # {0,1} and {2,3}; the row-parallel psum inside the block must produce
+    # an all-reduce over exactly those groups
+    assert "all-reduce" in txt or "all_reduce" in txt
+    assert "[[0,1],[2,3]]" in txt.replace(" ", ""), \
+        "expected mp-axis replica groups [[0,1],[2,3]] in lowered StableHLO"
+
+
+def test_mp2_with_vpp_chunks():
+    # interleaved schedule (n_chunks=2) composes with tensor parallelism
+    cfg = llama_config_tiny(vocab=64, hidden=32, layers=8, heads=4, seq=16)
+    n_micro = 4
+
+    def run(mp, mp_axis):
+        mesh = build_mesh({"pp": 2, "mp": mp},
+                          devices=jax.devices()[:2 * mp])
+        ep, bp, hp, _, _, _ = build_functional_llama(
+            cfg, key=jax.random.PRNGKey(3), n_micro=n_micro, mp_axis=mp_axis)
+        ea, ba, hl = _mb_fns(cfg, mp_axis)
+        opt = optimizer.AdamW(learning_rate=1e-2, parameters=[])
+        specs = llama_block_specs(mp_axis) if mp_axis else None
+        step = Pipeline1F1BTrainStep(mesh, ea, ba, hl, ep, bp, hp, opt,
+                                     n_micro=n_micro, n_chunks=2,
+                                     block_specs=specs, donate=False)
+        batch = _make_batch(cfg, n_micro, 16, seed=2)
+        return [float(step(batch).numpy()) for _ in range(2)]
+
+    np.testing.assert_allclose(run(2, "mp"), run(1, None), rtol=1e-4)
