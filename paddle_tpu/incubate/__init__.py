@@ -3,5 +3,6 @@ MoE models, asp). The fused functional surface maps to framework ops whose
 Pallas overrides provide the fusion on TPU."""
 from . import nn
 from . import autograd
+from . import distributed
 
-__all__ = ["nn", "autograd"]
+__all__ = ["nn", "autograd", "distributed"]

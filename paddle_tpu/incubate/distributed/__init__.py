@@ -1,0 +1,4 @@
+"""paddle.incubate.distributed parity (reference: python/paddle/incubate/distributed/)."""
+from . import models
+
+__all__ = ["models"]

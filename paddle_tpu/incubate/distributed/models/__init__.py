@@ -1,0 +1,3 @@
+from . import moe
+
+__all__ = ["moe"]

@@ -1,0 +1,11 @@
+"""Expert-parallel MoE (reference: python/paddle/incubate/distributed/models/moe/)."""
+from .gate import BaseGate, NaiveGate, GShardGate, SwitchGate, top_k_gating, compute_capacity
+from .moe_layer import (MoELayer, moe_dispatch, moe_combine, moe_ffn,
+                        ep_all_to_all, ep_all_to_all_back)
+from .grad_clip import ClipGradForMOEByGlobalNorm
+from . import utils
+
+__all__ = ["BaseGate", "NaiveGate", "GShardGate", "SwitchGate", "top_k_gating",
+           "compute_capacity", "MoELayer", "moe_dispatch", "moe_combine",
+           "moe_ffn", "ep_all_to_all", "ep_all_to_all_back",
+           "ClipGradForMOEByGlobalNorm", "utils"]

@@ -1,0 +1,158 @@
+"""MoE gates (reference: python/paddle/incubate/distributed/models/moe/gate/
+— naive_gate.py NaiveGate, gshard_gate.py GShardGate, switch_gate.py
+SwitchGate over base_gate.py BaseGate).
+
+TPU-native design: a gate is a small Layer producing, from token features
+[T, d], the *static-shape* routing tensors the dispatcher consumes:
+
+    combine_weights f32[T, E, C]   (token t's weight in expert e's slot c)
+    dispatch_mask  bool[T, E, C]   (combine_weights != 0)
+    aux_loss       f32[]           (load-balance loss, 0 for NaiveGate)
+
+Capacity overflow is masking (tokens beyond an expert's C slots get zero
+weight — "dropped" exactly like the reference's prune_gate_by_capacity),
+so every shape is known to XLA and the dispatch/combine are einsums that
+tile onto the MXU.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .....nn.layer import Layer
+from .....core.tensor import Tensor
+from .....core.dispatch import op_call
+
+__all__ = ["BaseGate", "NaiveGate", "GShardGate", "SwitchGate",
+           "top_k_gating", "compute_capacity"]
+
+
+def compute_capacity(num_tokens, num_experts, top_k, capacity_factor):
+    """C = ceil(k*T/E * factor), min 1 (reference gshard_gate.py capacity=(1.2, 2.4))."""
+    return max(1, int(math.ceil(top_k * num_tokens / num_experts * capacity_factor)))
+
+
+def top_k_gating(logits, top_k, capacity, *, normalize=True,
+                 balance_loss_weight=1.0, prng=None, random_routing_prob=False):
+    """Core static-shape top-k capacity gating (GShard algorithm).
+
+    logits: f32[T, E]. Returns (combine_weights[T,E,C], dispatch_mask[T,E,C],
+    aux_loss[], info dict). Slot assignment is k-major (all 1st choices
+    queue before any 2nd choice, reference gshard order via fmoe-style
+    per-k cumsum).
+    """
+    T, E = logits.shape
+    C = capacity
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)       # [T, E]
+    topv, topi = jax.lax.top_k(probs, top_k)                          # [T, k]
+
+    if random_routing_prob and top_k == 2 and prng is not None:
+        from .utils import random_routing
+        r = jax.random.uniform(prng, (T,))
+        topi = random_routing(topi, topv, r, topk=2)
+
+    # masks per k-slot: [k, T, E]; dropped (-1) slots one_hot to all-zero
+    kmask = jax.nn.one_hot(topi.T, E, dtype=jnp.float32)
+    # queue position: 1st-choice tokens claim slots before 2nd-choice ones
+    flat = kmask.reshape(top_k * T, E)                                 # k-major
+    pos = jnp.cumsum(flat, axis=0) - flat                              # [k*T, E]
+    pos = pos.reshape(top_k, T, E)
+    within = (pos < C) & (kmask > 0)                                   # [k, T, E]
+
+    # load-balance aux loss (switch/gshard): E * sum_e mean_frac_e * mean_prob_e
+    me = jnp.mean(probs, axis=0)                                       # [E]
+    ce = jnp.mean(kmask[0], axis=0)                                    # 1st-choice frac
+    aux = jnp.sum(me * ce) * E * balance_loss_weight
+
+    gate_w = topv.T[..., None] * within                                # [k, T, E]
+    if normalize:
+        denom = jnp.sum(gate_w, axis=(0, 2), keepdims=True)            # per token
+        gate_w = gate_w / jnp.maximum(denom, 1e-9)
+
+    slot = jnp.minimum(pos, C - 1).astype(jnp.int32)                   # [k, T, E]
+    slot_oh = jax.nn.one_hot(slot, C, dtype=jnp.float32) * within[..., None]
+    combine = jnp.sum(gate_w[..., None] * slot_oh, axis=0)             # [T, E, C]
+    dispatch = combine > 0
+    info = {"probs": probs, "top_idx": topi, "within_capacity": within}
+    return combine, dispatch, aux, info
+
+
+class BaseGate(Layer):
+    """reference gate/base_gate.py: holds expert counts + loss slot."""
+
+    def __init__(self, num_expert, n_worker=1):
+        super().__init__()
+        self.num_expert = num_expert
+        self.n_worker = n_worker
+        self.tot_expert = num_expert * n_worker
+        self.loss = None
+
+    def get_loss(self, clear=True):
+        loss = self.loss
+        if clear:
+            self.loss = None
+        return loss
+
+
+class NaiveGate(BaseGate):
+    """Top-k softmax gate without capacity (reference naive_gate.py)."""
+
+    random_routing = False
+
+    def __init__(self, d_model, num_expert, n_worker=1, topk=2,
+                 capacity_factor=None, eval_capacity_factor=None,
+                 balance_loss_weight=1.0):
+        super().__init__(num_expert, n_worker)
+        self.top_k = topk
+        self.capacity_factor = capacity_factor
+        self.eval_capacity_factor = eval_capacity_factor
+        self.balance_loss_weight = balance_loss_weight
+        self.gate_weight = self.create_parameter((d_model, self.tot_expert))
+
+    def capacity_for(self, num_tokens, training=True):
+        f = self.capacity_factor if training else \
+            (self.eval_capacity_factor or self.capacity_factor)
+        if f is None:
+            # no drops: every token can land in any expert
+            return num_tokens
+        return compute_capacity(num_tokens, self.tot_expert, self.top_k, f)
+
+    def forward(self, x):
+        def impl(xv, w):
+            return xv @ w.astype(xv.dtype)
+        return op_call("moe_gate", impl, x, self.gate_weight)
+
+
+def _split_capacity(capacity):
+    if isinstance(capacity, (tuple, list)):
+        train = capacity[0]
+        ev = capacity[1] if len(capacity) > 1 else capacity[0]
+        return train, ev
+    return capacity, capacity
+
+
+class GShardGate(NaiveGate):
+    """Top-2 gate with capacity + balance loss + random routing
+    (reference gshard_gate.py; capacity=(1.2, 2.4) = train/eval factors)."""
+
+    def __init__(self, d_model, num_expert, n_worker=1, topk=2,
+                 capacity=(1.2, 2.4), random_routing=True,
+                 balance_loss_weight=1.0, group=None, gate_weight=None):
+        cf, ef = _split_capacity(capacity)
+        super().__init__(d_model, num_expert, n_worker, topk=topk,
+                         capacity_factor=cf, eval_capacity_factor=ef,
+                         balance_loss_weight=balance_loss_weight)
+        self.random_routing = random_routing
+
+
+class SwitchGate(NaiveGate):
+    """Top-1 switch gate with capacity (reference switch_gate.py)."""
+
+    def __init__(self, d_model, num_expert, n_worker=1, topk=1, capacity=(1.2, 2.4),
+                 balance_loss_weight=1.0, group=None, gate_weight=None):
+        cf, ef = _split_capacity(capacity)
+        super().__init__(d_model, num_expert, n_worker, topk=1,
+                         capacity_factor=cf, eval_capacity_factor=ef,
+                         balance_loss_weight=balance_loss_weight)

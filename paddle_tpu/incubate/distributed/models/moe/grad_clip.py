@@ -1,0 +1,48 @@
+"""MoE-aware global-norm gradient clipping (reference: python/paddle/
+incubate/distributed/models/moe/grad_clip.py:63 ClipGradForMOEByGlobalNorm).
+
+Expert parameters are sharded over the expert-parallel group, so the global
+norm must sum the *local* expert-grad norms across that group once, while
+shared (non-expert) parameter norms are already replicated and must not be
+re-summed. The reference psums the expert partial norm over the moe group;
+here the same psum runs over the `ep` mesh axis when one is in scope, and
+is a no-op otherwise (single-process semantics match).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .....core.tensor import Tensor
+from .....nn.clip import ClipGradByGlobalNorm
+
+__all__ = ["ClipGradForMOEByGlobalNorm"]
+
+
+def _is_expert_param(p):
+    return getattr(p, "is_expert", False) or getattr(p, "no_sync", False)
+
+
+class ClipGradForMOEByGlobalNorm(ClipGradByGlobalNorm):
+    def __init__(self, clip_norm, is_expert_param_func=None, moe_group=None,
+                 group_name="default_moe_group", ep_axis="ep"):
+        super().__init__(clip_norm, group_name)
+        self.is_expert = is_expert_param_func or _is_expert_param
+        self.ep_axis = ep_axis
+
+    def _global_norm_sq(self, params_grads):
+        normal_sq = jnp.zeros((), jnp.float32)
+        expert_sq = jnp.zeros((), jnp.float32)
+        for p, g in params_grads:
+            if g is None or (hasattr(p, "need_clip") and not p.need_clip):
+                continue
+            v = g._value if hasattr(g, "_value") else g
+            sq = jnp.sum(jnp.square(v.astype(jnp.float32)))
+            if self.is_expert(p):
+                expert_sq = expert_sq + sq
+            else:
+                normal_sq = normal_sq + sq
+        from .....distributed.fleet.meta_parallel.mp_layers import mp_axis_in_scope
+        if mp_axis_in_scope(self.ep_axis):
+            expert_sq = jax.lax.psum(expert_sq, self.ep_axis)
+        return normal_sq + expert_sq

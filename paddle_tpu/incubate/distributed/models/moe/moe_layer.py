@@ -1,0 +1,201 @@
+"""MoE layer with expert parallelism (reference: python/paddle/incubate/
+distributed/models/moe/moe_layer.py — MoEScatter :97, MoEGather :147, and
+the global_scatter/global_gather NCCL all-to-all underneath).
+
+TPU-native design
+-----------------
+The reference scatters tokens into dynamically-sized per-expert buffers and
+moves them with `global_scatter` (NCCL alltoallv). XLA needs static shapes,
+so the dispatch is the GShard formulation instead:
+
+  gate → combine_weights[T,E,C] → dispatch einsum → [E, C, d]
+       → `lax.all_to_all` over the `ep` mesh axis → [E_local, W*C, d]
+       → local experts → reverse all_to_all → combine einsum → [T, d]
+
+Both data movements are single XLA collectives riding ICI; the einsums tile
+onto the MXU. Capacity overflow is masking (zero combine weight), which is
+exactly the reference's prune_gate_by_capacity semantics without dynamic
+shapes.
+
+Two entry points:
+  * `moe_dispatch` / `moe_combine` / `moe_ffn` — the functional core, usable
+    directly inside `shard_map` (pass `ep_axis="ep"`) or under GSPMD.
+  * `MoELayer` — reference-parity Layer wrapping gate + expert Layers.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .....core.tensor import Tensor
+from .....core.dispatch import op_call
+from .....nn.layer import Layer
+from .....nn.container import LayerList
+from .gate import BaseGate, NaiveGate, GShardGate, SwitchGate, top_k_gating
+
+__all__ = ["MoELayer", "moe_dispatch", "moe_combine", "moe_ffn",
+           "ep_all_to_all", "ep_all_to_all_back"]
+
+
+def ep_all_to_all(disp, ep_axis):
+    """[E, C, d] per-rank dispatch buffer → [E_local, W*C, d] expert inbox.
+
+    W = size of `ep_axis`; requires E % W == 0. The leading W chunk of the
+    second dim indexes the source rank (reference MoEScatter/global_scatter).
+    """
+    W = jax.lax.psum(1, ep_axis)
+    E, C, d = disp.shape
+    x = disp.reshape(W, E // W, C, d)
+    x = jax.lax.all_to_all(x, ep_axis, split_axis=0, concat_axis=0, tiled=False)
+    # x: [W(source rank), E_local, C, d]
+    x = jnp.moveaxis(x, 0, 1)                       # [E_local, W, C, d]
+    return x.reshape(E // W, W * C, d)
+
+
+def ep_all_to_all_back(y, ep_axis):
+    """Inverse of `ep_all_to_all`: [E_local, W*C, d] → [E, C, d]
+    (reference MoEGather/global_gather)."""
+    W = jax.lax.psum(1, ep_axis)
+    El, WC, d = y.shape
+    C = WC // W
+    x = y.reshape(El, W, C, d)
+    x = jnp.moveaxis(x, 1, 0)                        # [W, E_local, C, d]
+    x = jax.lax.all_to_all(x, ep_axis, split_axis=0, concat_axis=0, tiled=False)
+    return x.reshape(W * El, C, d)
+
+
+def moe_dispatch(x, dispatch_mask, dtype=None):
+    """x[T, d] × dispatch[T, E, C] → [E, C, d] (slot-addressed token copy)."""
+    m = dispatch_mask.astype(dtype or x.dtype)
+    return jnp.einsum("td,tec->ecd", x, m)
+
+
+def moe_combine(y, combine_weights):
+    """y[E, C, d] × combine[T, E, C] → [T, d] (weighted sum of expert outs)."""
+    return jnp.einsum("ecd,tec->td", y, combine_weights.astype(y.dtype))
+
+
+def moe_ffn(x, gate_weight, w1, b1, w2, b2, *, top_k=2, capacity_factor=1.25,
+            ep_axis=None, activation="gelu", normalize=True,
+            balance_loss_weight=1.0, capacity=None):
+    """Functional MoE-FFN block: gate + dispatch + expert FFN + combine.
+
+    x: [T, d]. gate_weight: [d, E_total]. w1/b1/w2/b2 carry a leading expert
+    dim — E_total outside shard_map, E_local = E_total/W inside shard_map
+    over `ep_axis`. Returns (out[T, d], aux_loss).
+    """
+    T, dm = x.shape
+    E = gate_weight.shape[-1]
+    logits = (x @ gate_weight.astype(x.dtype)).astype(jnp.float32)
+    if capacity is None:
+        from .gate import compute_capacity
+        capacity = compute_capacity(T, E, top_k, capacity_factor)
+    combine, dispatch, aux, _ = top_k_gating(
+        logits, top_k, capacity, normalize=normalize,
+        balance_loss_weight=balance_loss_weight)
+
+    disp = moe_dispatch(x, dispatch)                        # [E, C, d]
+    if ep_axis is not None:
+        disp = ep_all_to_all(disp, ep_axis)                 # [E_l, W*C, d]
+
+    act = getattr(jax.nn, activation)
+    h = jnp.einsum("ebd,edh->ebh", disp, w1.astype(disp.dtype))
+    if b1 is not None:
+        h = h + b1[:, None, :].astype(h.dtype)
+    h = act(h)
+    y = jnp.einsum("ebh,ehd->ebd", h, w2.astype(h.dtype))
+    if b2 is not None:
+        y = y + b2[:, None, :].astype(y.dtype)
+
+    if ep_axis is not None:
+        y = ep_all_to_all_back(y, ep_axis)                  # [E, C, d]
+    out = moe_combine(y, combine)
+    return out, aux
+
+
+def _make_gate(gate, d_model, num_expert, n_worker, top_k):
+    if isinstance(gate, BaseGate):
+        return gate
+    cfg = dict(gate) if isinstance(gate, dict) else {"type": gate or "gshard"}
+    typ = cfg.get("type", "gshard")
+    k = cfg.get("top_k", top_k)
+    if typ == "naive":
+        return NaiveGate(d_model, num_expert, n_worker, topk=k)
+    if typ == "switch":
+        return SwitchGate(d_model, num_expert, n_worker)
+    return GShardGate(d_model, num_expert, n_worker, topk=k)
+
+
+class MoELayer(Layer):
+    """Reference-parity MoE layer (moe_layer.py:MoELayer).
+
+    experts: LayerList of expert Layers (this rank's experts when running
+    under expert parallelism; all experts otherwise). gate: BaseGate | dict
+    config {"type": "gshard"|"switch"|"naive", "top_k": k}. The balance loss
+    is exposed via `gate.get_loss()` after forward, as in the reference.
+    """
+
+    def __init__(self, d_model, experts, gate=None, moe_group=None,
+                 mp_group=None, recompute_interval=0, top_k=2,
+                 capacity_factor=1.25, ep_axis=None, ep_world_size=1, **kw):
+        super().__init__()
+        self.d_model = d_model
+        self.experts = experts if isinstance(experts, LayerList) else LayerList(experts)
+        self.ep_axis = ep_axis
+        # n_worker scales the gate to the GLOBAL expert count: under expert
+        # parallelism this Layer holds only the local experts, but routing
+        # must cover all ep_world_size * len(experts) of them
+        if ep_axis is not None:
+            n_worker = int(ep_world_size)
+            if n_worker < 1:
+                raise ValueError("ep_world_size must be >= 1 when ep_axis is set")
+        else:
+            n_worker = getattr(moe_group, "nranks", 1) or 1 if moe_group is not None else 1
+        self.world_size = n_worker
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        self.gate = _make_gate(gate, d_model, len(self.experts), n_worker, top_k)
+        if getattr(self.gate, "capacity_factor", None) is None:
+            self.gate.capacity_factor = capacity_factor
+
+    def forward(self, x):
+        shape = x.shape
+        d = shape[-1]
+        from .....tensor import manipulation as manip
+        xf = manip.reshape(x, [-1, d])
+        T = xf.shape[0]
+        logits = self.gate(xf)
+        capacity = self.gate.capacity_for(T, training=self.training)
+        top_k = self.gate.top_k
+        prng = None
+        if self.training and getattr(self.gate, "random_routing", False):
+            from .....core import random as _rnd
+            prng = _rnd.default_generator.next_key()
+
+        def route(lg):
+            combine, dispatch, aux, _ = top_k_gating(
+                lg.astype(jnp.float32), top_k, capacity,
+                balance_loss_weight=self.gate.balance_loss_weight,
+                prng=prng, random_routing_prob=prng is not None)
+            return combine, dispatch.astype(jnp.float32), aux
+
+        combine, dispatch, aux = op_call("moe_gating", route, logits)
+        self.gate.loss = aux
+
+        def disp_impl(xv, dsp):
+            out = moe_dispatch(xv, dsp)                       # [E, C, d]
+            if self.ep_axis is not None:
+                out = ep_all_to_all(out, self.ep_axis)        # [E_l, W*C, d]
+            return out
+
+        disp = op_call("moe_dispatch", disp_impl, xf, dispatch)
+        outs = [self.experts[i](disp[i]) for i in range(len(self.experts))]
+        y = manip.stack(outs)
+
+        def comb_impl(yv, cmb, xv):
+            if self.ep_axis is not None:
+                yv = ep_all_to_all_back(yv, self.ep_axis)     # [E, C, d]
+            return moe_combine(yv, cmb).astype(xv.dtype)
+
+        out = op_call("moe_combine", comb_impl, y, combine, xf)
+        return manip.reshape(out, list(shape[:-1]) + [d])

@@ -1,0 +1,65 @@
+"""Static-shape TPU analogs of the reference's MoE capacity kernels
+(paddle/phi/kernels/number_count_kernel.h, assign_pos_kernel.h,
+limit_by_capacity_kernel.h, prune_gate_by_capacity_kernel.h,
+random_routing_kernel.h).
+
+The CUDA kernels scatter tokens with atomics into dynamically-sized
+buffers; on TPU every shape must be static, so the same facts are
+computed with one-hot + cumsum (an O(T*E) formulation XLA tiles onto
+the VPU) and capacity overflow is expressed as masking, not pruning.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["number_count", "assign_pos", "limit_by_capacity",
+           "prune_gate_by_capacity", "random_routing", "count_by_gate"]
+
+
+def number_count(gate_idx, upper_range):
+    """Tokens routed to each expert. gate_idx: int[...] in [0, upper_range).
+    Returns int32[upper_range] (reference number_count_kernel.h)."""
+    oh = jax.nn.one_hot(gate_idx.reshape(-1), upper_range, dtype=jnp.int32)
+    return jnp.sum(oh, axis=0)
+
+
+def assign_pos(gate_idx, num_expert):
+    """Position of each token within its expert's queue, in flat order.
+    Returns int32 with gate_idx's shape (reference assign_pos_kernel.h,
+    minus the CUDA atomics: cumsum over one-hot gives the same order)."""
+    flat = gate_idx.reshape(-1)
+    oh = jax.nn.one_hot(flat, num_expert, dtype=jnp.int32)
+    pos = jnp.cumsum(oh, axis=0) - 1          # [T, E]
+    return jnp.take_along_axis(pos, flat[:, None], axis=1)[:, 0].reshape(gate_idx.shape)
+
+
+def limit_by_capacity(expert_count, capacity, n_worker=1):
+    """Clamp per-expert counts to capacity*n_worker (reference
+    limit_by_capacity_kernel.h)."""
+    cap = jnp.asarray(capacity)
+    return jnp.minimum(expert_count, cap * n_worker)
+
+
+def prune_gate_by_capacity(gate_idx, expert_count, n_expert, n_worker=1):
+    """Set gate_idx of overflowing tokens to -1 (reference
+    prune_gate_by_capacity_kernel.h). Static-shape: recompute each
+    token's queue position and compare with its expert's capacity."""
+    pos = assign_pos(gate_idx, n_expert)
+    cap = expert_count[gate_idx.reshape(-1)].reshape(gate_idx.shape)
+    return jnp.where(pos < cap, gate_idx, -1)
+
+
+def random_routing(topk_idx, topk_value, prob, topk=2):
+    """Reference random_routing_kernel.h: for k=2, drop the 2nd expert
+    with probability prob < value*2 (keeps high-confidence 2nd choices)."""
+    if topk != 2:
+        return topk_idx
+    keep = prob < topk_value[..., 1] * 2.0
+    second = jnp.where(keep, topk_idx[..., 1], -1)
+    return jnp.stack([topk_idx[..., 0], second], axis=-1)
+
+
+def count_by_gate(gate_idx, num_expert, n_worker=1):
+    """(expert_count, per-token position) pair used by the dispatcher."""
+    return number_count(gate_idx, num_expert), assign_pos(gate_idx, num_expert)

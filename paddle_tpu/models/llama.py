@@ -25,8 +25,8 @@ from ..tensor import manipulation as manip
 from ..incubate.nn.functional import fused_rotary_position_embedding
 
 __all__ = ["LlamaConfig", "LlamaForCausalLM", "LlamaModel", "LlamaDecoderLayer",
-           "build_functional_llama", "llama_block_specs", "llama_config_7b",
-           "llama_config_tiny"]
+           "build_functional_llama", "llama_microbatch_fns", "llama_block_specs",
+           "llama_config_7b", "llama_config_tiny"]
 
 
 @dataclass
@@ -231,8 +231,21 @@ def llama_block_specs(mp_axis: str = "mp"):
             "ln2": (None,), "wgate": col, "wup": col, "wdown": row}
 
 
+def llama_microbatch_fns(config: LlamaConfig, mp_axis: str = None, dtype=None):
+    """Per-microbatch (embed, block, head) adapters for the pipeline schedule
+    step fns (Pipeline1F1BTrainStep et al.), without initializing a second
+    parameter set: embed returns one [mbs, S, H] microbatch, head consumes a
+    single microbatch activation."""
+    _, _, _, ea1, ba1, hl1 = build_functional_llama(
+        config, n_micro=1, mp_axis=mp_axis, dtype=dtype, init_params=False)
+    embed_mb = lambda p, mb: ea1(p, mb)[0]
+    head_mb = lambda p, y, mb: hl1(p, y[None], mb)
+    return embed_mb, ba1, head_mb
+
+
 def build_functional_llama(config: LlamaConfig, key=None, dtype=None,
-                           n_micro: int = 1, mp_axis: str = None):
+                           n_micro: int = 1, mp_axis: str = None,
+                           init_params: bool = True):
     """Returns (embed_params, block_params_stacked, head_params,
     embed_apply, block_apply, head_loss_apply).
 
@@ -260,27 +273,30 @@ def build_functional_llama(config: LlamaConfig, key=None, dtype=None,
 
     L = c.num_hidden_layers
     kv_dim = c.num_key_value_heads * head_dim
-    embed_params = {"tok": init(ks[0], (c.vocab_size, c.hidden_size), 0.02)}
-    block_params = {
-        "ln1": jnp.ones((L, c.hidden_size), d),
-        "wq": jnp.stack([init(jax.random.fold_in(ks[1], i),
-                              (c.hidden_size, c.hidden_size)) for i in range(L)]),
-        "wk": jnp.stack([init(jax.random.fold_in(ks[2], i),
-                              (c.hidden_size, kv_dim)) for i in range(L)]),
-        "wv": jnp.stack([init(jax.random.fold_in(ks[3], i),
-                              (c.hidden_size, kv_dim)) for i in range(L)]),
-        "wo": jnp.stack([init(jax.random.fold_in(ks[4], i),
-                              (c.hidden_size, c.hidden_size)) for i in range(L)]),
-        "ln2": jnp.ones((L, c.hidden_size), d),
-        "wgate": jnp.stack([init(jax.random.fold_in(ks[5], i),
-                                 (c.hidden_size, c.intermediate_size)) for i in range(L)]),
-        "wup": jnp.stack([init(jax.random.fold_in(ks[6], i),
-                               (c.hidden_size, c.intermediate_size)) for i in range(L)]),
-        "wdown": jnp.stack([init(jax.random.fold_in(ks[7], i),
-                                 (c.intermediate_size, c.hidden_size)) for i in range(L)]),
-    }
-    head_params = {"ln_f": jnp.ones((c.hidden_size,), d),
-                   "lm": init(ks[8], (c.hidden_size, c.vocab_size), 0.02)}
+    if not init_params:
+        embed_params = block_params = head_params = None
+    else:
+        embed_params = {"tok": init(ks[0], (c.vocab_size, c.hidden_size), 0.02)}
+        block_params = {
+            "ln1": jnp.ones((L, c.hidden_size), d),
+            "wq": jnp.stack([init(jax.random.fold_in(ks[1], i),
+                                  (c.hidden_size, c.hidden_size)) for i in range(L)]),
+            "wk": jnp.stack([init(jax.random.fold_in(ks[2], i),
+                                  (c.hidden_size, kv_dim)) for i in range(L)]),
+            "wv": jnp.stack([init(jax.random.fold_in(ks[3], i),
+                                  (c.hidden_size, kv_dim)) for i in range(L)]),
+            "wo": jnp.stack([init(jax.random.fold_in(ks[4], i),
+                                  (c.hidden_size, c.hidden_size)) for i in range(L)]),
+            "ln2": jnp.ones((L, c.hidden_size), d),
+            "wgate": jnp.stack([init(jax.random.fold_in(ks[5], i),
+                                     (c.hidden_size, c.intermediate_size)) for i in range(L)]),
+            "wup": jnp.stack([init(jax.random.fold_in(ks[6], i),
+                                   (c.hidden_size, c.intermediate_size)) for i in range(L)]),
+            "wdown": jnp.stack([init(jax.random.fold_in(ks[7], i),
+                                     (c.intermediate_size, c.hidden_size)) for i in range(L)]),
+        }
+        head_params = {"ln_f": jnp.ones((c.hidden_size,), d),
+                       "lm": init(ks[8], (c.hidden_size, c.vocab_size), 0.02)}
 
     sin_t, cos_t = _rope_tables(c.max_position_embeddings, head_dim, c.rope_theta, d)
 

@@ -167,8 +167,10 @@ class Pipeline1F1BTrainStep:
         self._block_specs = block_specs or {}
         # the grad-combine below (and spmd_pipeline_1f1b's varying_axes)
         # assumes the tensor-parallel axis is literally named "mp"
+        # 'pp' is NOT allowed in suffixes: the leading stacked-layer dim is
+        # already placed on 'pp', a suffix repeat would be a duplicate axis
         bad = {a for sfx in self._block_specs.values()
-               for a in sfx if a not in (None, "mp", "pp")}
+               for a in sfx if a not in (None, "mp")}
         if bad:
             raise ValueError(
                 f"block_specs may only shard over the 'mp' axis, got {bad}")

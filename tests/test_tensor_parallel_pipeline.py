@@ -35,11 +35,8 @@ def _make_batch(cfg, B, S, seed=0):
 
 def _mb_fns(cfg, mp_axis):
     """Per-microbatch embed/head adapters + mp-aware block apply."""
-    _, _, _, ea1, ba1, hl1 = build_functional_llama(cfg, n_micro=1,
-                                                    mp_axis=mp_axis)
-    embed_mb = lambda p, mb: ea1(p, mb)[0]
-    head_mb = lambda p, y, mb: hl1(p, y[None], mb)
-    return embed_mb, ba1, head_mb
+    from paddle_tpu.models.llama import llama_microbatch_fns
+    return llama_microbatch_fns(cfg, mp_axis=mp_axis)
 
 
 def _run_steps(mesh_axes, mp_axis, n_steps=3, n_micro=4, seed=7):
