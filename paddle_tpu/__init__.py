@@ -49,7 +49,11 @@ from . import jit  # noqa: F401
 from . import distributed  # noqa: F401
 from . import ops  # noqa: F401
 from .ops.pallas import register_all as _register_pallas_kernels
-_register_pallas_kernels()  # TPU-only; no-op on CPU
+# TPU-only; deferred to first kernel lookup because probing jax.devices()
+# here would initialise the XLA backend before a multi-process launch can
+# call jax.distributed.initialize (distributed/env.py)
+from .core import dispatch as _dispatch_mod
+_dispatch_mod.add_lazy_initializer(_register_pallas_kernels)
 from . import vision  # noqa: F401
 from . import incubate  # noqa: F401
 from . import metric  # noqa: F401

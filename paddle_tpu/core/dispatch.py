@@ -22,6 +22,7 @@ instead of executing immediately — the executor role collapses into XLA.
 from __future__ import annotations
 
 import functools
+import threading
 from typing import Any, Callable, Dict, Optional
 
 import jax
@@ -39,6 +40,25 @@ __all__ = ["op_call", "register_kernel", "get_kernel", "no_grad",
 # --------------------------------------------------------------------------
 _KERNELS: Dict[str, Dict[str, Callable]] = {}
 
+# Deferred registration hooks (e.g. Pallas overrides, which must probe the
+# device platform — an XLA-backend-initialising call that cannot happen at
+# import time in multi-process launches). Run once, on first kernel lookup.
+_lazy_initializers = []
+_lazy_lock = threading.Lock()
+
+
+def add_lazy_initializer(fn: Callable):
+    _lazy_initializers.append(fn)
+
+
+def _run_lazy_initializers():
+    if not _lazy_initializers:
+        return
+    with _lazy_lock:
+        while _lazy_initializers:
+            fn = _lazy_initializers.pop(0)
+            fn()
+
 
 def register_kernel(name: str, impl: str = "default"):
     """PD_REGISTER_KERNEL analog (kernel_registry.h:196)."""
@@ -49,6 +69,7 @@ def register_kernel(name: str, impl: str = "default"):
 
 
 def get_kernel(name: str, default: Optional[Callable] = None) -> Optional[Callable]:
+    _run_lazy_initializers()
     impls = _KERNELS.get(name)
     if not impls:
         return default

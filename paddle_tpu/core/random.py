@@ -30,19 +30,29 @@ class Generator:
 
     def manual_seed(self, s: int):
         self._seed = int(s)
-        self._key = jax.random.PRNGKey(int(s))
+        # lazy: PRNGKey initialises the XLA backend, which must not happen
+        # at import time (jax.distributed.initialize must run first in
+        # multi-process launch — see distributed/env.py)
+        self._key = None
         return self
 
     def initial_seed(self) -> int:
         return self._seed
 
+    def _ensure_key(self):
+        if self._key is None:
+            self._key = jax.random.PRNGKey(self._seed)
+
     def next_key(self):
         with self._lock:
+            self._ensure_key()
             self._key, sub = jax.random.split(self._key)
             return sub
 
     def get_state(self):
-        return self._key
+        with self._lock:
+            self._ensure_key()
+            return self._key
 
     def set_state(self, state):
         self._key = jnp.asarray(state, dtype=jnp.uint32)

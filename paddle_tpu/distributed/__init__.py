@@ -21,6 +21,7 @@ from .checkpoint.save_state_dict import save_state_dict
 from .checkpoint.load_state_dict import load_state_dict
 from . import sharding
 from . import utils
+from . import launch
 
 __all__ = [
     "init_parallel_env", "get_rank", "get_world_size", "is_initialized",

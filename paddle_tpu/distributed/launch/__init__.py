@@ -1,0 +1,4 @@
+"""Launch CLI package (reference: python/paddle/distributed/launch/)."""
+from .main import main, launch_gang
+
+__all__ = ["main", "launch_gang"]

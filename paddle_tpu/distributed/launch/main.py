@@ -1,0 +1,202 @@
+"""Distributed launch CLI (reference: python/paddle/distributed/launch/main.py:23,
+controllers/collective.py:22 CollectiveController, controllers/watcher.py).
+
+    python -m paddle_tpu.distributed.launch --nproc_per_node=8 train.py [args...]
+
+Spawns one process per rank with the PADDLE_* env contract the reference's
+controller exports (collective.py:76,139):
+
+    PADDLE_MASTER            coordinator host:port (jax.distributed rendezvous
+                             — the TCPStore analog, store/tcp_store.h:121)
+    PADDLE_TRAINER_ID        global rank
+    PADDLE_TRAINERS_NUM      world size
+    PADDLE_TRAINER_ENDPOINTS comma list of all rank endpoints
+    PADDLE_LOCAL_RANK        rank on this node
+    PADDLE_NNODES / PADDLE_NODE_RANK
+
+`paddle_tpu.distributed.env.init_parallel_env` consumes these and calls
+`jax.distributed.initialize`. On TPU pods each process drives its local
+chips; on CPU (tests) each process is pinned to one virtual device.
+
+The watcher polls children: if any rank exits non-zero the rest are
+terminated (reference controller.py:35 watch loop). `--max_restarts N`
+relaunches the whole gang on failure (the elastic-controller restart
+semantic, collective.py:267 — peer discovery via etcd is out of scope;
+membership is the static endpoint list).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+__all__ = ["main", "launch_gang"]
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _parse_args(argv):
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.distributed.launch",
+        description="paddle_tpu distributed launcher")
+    p.add_argument("--nproc_per_node", type=int,
+                   default=int(os.environ.get("PADDLE_NPROC_PER_NODE", 1)))
+    p.add_argument("--nnodes", type=int, default=1)
+    p.add_argument("--node_rank", type=int, default=0)
+    p.add_argument("--master", type=str, default=None,
+                   help="host:port of the coordination service (default: "
+                        "a free local port; required multi-node)")
+    p.add_argument("--job_id", type=str, default="default")
+    p.add_argument("--log_dir", type=str, default=None)
+    p.add_argument("--max_restarts", type=int, default=0,
+                   help="relaunch the gang up to N times on failure")
+    p.add_argument("--devices", type=str, default=None,
+                   help="comma list of device ids to pin per local rank")
+    p.add_argument("script", type=str)
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def _rank_env(base_env, *, rank, local_rank, world, master, endpoints,
+              nnodes, node_rank, devices=None):
+    env = dict(base_env)
+    env.update({
+        "PADDLE_MASTER": master,
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TRAINERS_NUM": str(world),
+        "PADDLE_TRAINER_ENDPOINTS": endpoints,
+        "PADDLE_LOCAL_RANK": str(local_rank),
+        "PADDLE_NNODES": str(nnodes),
+        "PADDLE_NODE_RANK": str(node_rank),
+        "PADDLE_RANK_IN_NODE": str(local_rank),
+        # torch-style aliases many scripts read
+        "RANK": str(rank),
+        "WORLD_SIZE": str(world),
+        "LOCAL_RANK": str(local_rank),
+        "MASTER_ADDR": master.split(":")[0],
+        "MASTER_PORT": master.split(":")[1],
+    })
+    if devices is not None:
+        dev = devices[local_rank % len(devices)]
+        env["PADDLE_SELECTED_DEVICES"] = dev
+        # actually pin the rank to its accelerator (reference launch exports
+        # CUDA_VISIBLE_DEVICES; TPU runtimes read TPU_VISIBLE_CHIPS)
+        env["CUDA_VISIBLE_DEVICES"] = dev
+        env["TPU_VISIBLE_CHIPS"] = dev
+    return env
+
+
+def launch_gang(cmd, *, nproc, master=None, nnodes=1, node_rank=0,
+                env=None, log_dir=None, max_restarts=0, devices=None,
+                poll_interval=0.5):
+    """Spawn and watch a gang of `nproc` rank processes running `cmd`
+    (a list, the per-rank argv). Returns the max child return code."""
+    base_env = dict(os.environ if env is None else env)
+    if master is None:
+        master = f"127.0.0.1:{_free_port()}"
+    world = nproc * nnodes
+    rank0 = node_rank * nproc
+    host = master.split(":")[0]
+    endpoints = ",".join(
+        f"{host}:{_free_port()}" for _ in range(world))
+    if log_dir:
+        os.makedirs(log_dir, exist_ok=True)
+
+    attempts = 0
+    while True:
+        procs = []
+        logs = []
+        for lr in range(nproc):
+            rank = rank0 + lr
+            renv = _rank_env(base_env, rank=rank, local_rank=lr, world=world,
+                             master=master, endpoints=endpoints,
+                             nnodes=nnodes, node_rank=node_rank,
+                             devices=devices)
+            if log_dir:
+                lf = open(os.path.join(log_dir, f"workerlog.{rank}"), "w")
+                logs.append(lf)
+                out = lf
+            else:
+                out = None  # inherit
+            procs.append(subprocess.Popen(cmd, env=renv, stdout=out,
+                                          stderr=subprocess.STDOUT if out else None))
+
+        def _terminate_all(sig=signal.SIGTERM):
+            for pr in procs:
+                if pr.poll() is None:
+                    try:
+                        pr.send_signal(sig)
+                    except OSError:
+                        pass
+
+        prev_handlers = {}
+        for s in (signal.SIGINT, signal.SIGTERM):
+            try:
+                prev_handlers[s] = signal.signal(
+                    s, lambda *_: (_terminate_all(), sys.exit(1)))
+            except ValueError:
+                pass  # not main thread
+
+        rc = 0
+        try:
+            # watcher loop (reference controller.py:35): any failure kills the gang
+            while True:
+                codes = [pr.poll() for pr in procs]
+                failed = [c for c in codes if c not in (None, 0)]
+                if failed:
+                    _terminate_all()
+                    deadline = time.time() + 10
+                    for pr in procs:
+                        t = max(0.1, deadline - time.time())
+                        try:
+                            pr.wait(timeout=t)
+                        except subprocess.TimeoutExpired:
+                            pr.kill()
+                    rc = max(failed)
+                    break
+                if all(c == 0 for c in codes):
+                    rc = 0
+                    break
+                time.sleep(poll_interval)
+        finally:
+            for s, h in prev_handlers.items():
+                signal.signal(s, h)
+            for lf in logs:
+                lf.close()
+
+        if rc == 0 or attempts >= max_restarts:
+            return rc
+        attempts += 1
+        # elastic-style gang restart on a fresh rendezvous port
+        master = f"127.0.0.1:{_free_port()}"
+        print(f"[launch] gang failed rc={rc}; restart {attempts}/{max_restarts}",
+              file=sys.stderr)
+
+
+def main(argv=None):
+    args = _parse_args(sys.argv[1:] if argv is None else argv)
+    # drop only a single leading "--" separator; later "--" belong to the script
+    script_args = list(args.script_args)
+    if script_args and script_args[0] == "--":
+        script_args = script_args[1:]
+    cmd = [sys.executable, "-u", args.script] + script_args
+    devices = args.devices.split(",") if args.devices else None
+    rc = launch_gang(cmd, nproc=args.nproc_per_node, master=args.master,
+                     nnodes=args.nnodes, node_rank=args.node_rank,
+                     log_dir=args.log_dir, max_restarts=args.max_restarts,
+                     devices=devices)
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()

@@ -22,7 +22,6 @@ import jax
 import jax.numpy as jnp
 
 from .....nn.layer import Layer
-from .....core.tensor import Tensor
 from .....core.dispatch import op_call
 
 __all__ = ["BaseGate", "NaiveGate", "GShardGate", "SwitchGate",

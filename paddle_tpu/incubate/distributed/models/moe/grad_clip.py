@@ -13,7 +13,6 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from .....core.tensor import Tensor
 from .....nn.clip import ClipGradByGlobalNorm
 
 __all__ = ["ClipGradForMOEByGlobalNorm"]

@@ -27,7 +27,6 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from .....core.tensor import Tensor
 from .....core.dispatch import op_call
 from .....nn.layer import Layer
 from .....nn.container import LayerList
@@ -113,17 +112,23 @@ def moe_ffn(x, gate_weight, w1, b1, w2, b2, *, top_k=2, capacity_factor=1.25,
     return out, aux
 
 
-def _make_gate(gate, d_model, num_expert, n_worker, top_k):
+def _make_gate(gate, d_model, num_expert, n_worker, top_k, capacity_factor):
     if isinstance(gate, BaseGate):
         return gate
     cfg = dict(gate) if isinstance(gate, dict) else {"type": gate or "gshard"}
-    typ = cfg.get("type", "gshard")
-    k = cfg.get("top_k", top_k)
+    typ = cfg.pop("type", "gshard")
+    k = cfg.pop("top_k", top_k)
+    # MoELayer's capacity_factor wins unless the gate config names its own
+    cfg.setdefault("capacity", cfg.pop("capacity_factor", capacity_factor))
     if typ == "naive":
-        return NaiveGate(d_model, num_expert, n_worker, topk=k)
+        cap = cfg.pop("capacity", None)
+        if isinstance(cap, (tuple, list)):
+            cap = cap[0]
+        return NaiveGate(d_model, num_expert, n_worker, topk=k,
+                         capacity_factor=cap, **cfg)
     if typ == "switch":
-        return SwitchGate(d_model, num_expert, n_worker)
-    return GShardGate(d_model, num_expert, n_worker, topk=k)
+        return SwitchGate(d_model, num_expert, n_worker, **cfg)
+    return GShardGate(d_model, num_expert, n_worker, topk=k, **cfg)
 
 
 class MoELayer(Layer):
@@ -154,7 +159,8 @@ class MoELayer(Layer):
         self.world_size = n_worker
         self.top_k = top_k
         self.capacity_factor = capacity_factor
-        self.gate = _make_gate(gate, d_model, len(self.experts), n_worker, top_k)
+        self.gate = _make_gate(gate, d_model, len(self.experts), n_worker,
+                               top_k, capacity_factor)
         if getattr(self.gate, "capacity_factor", None) is None:
             self.gate.capacity_factor = capacity_factor
 

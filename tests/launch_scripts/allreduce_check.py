@@ -1,0 +1,43 @@
+"""Rank script: multi-process collective smoke check.
+
+Launched by test_launch_multiprocess.py via the launch CLI with
+JAX_PLATFORMS=cpu and 1 virtual device per process. Asserts the
+jax.distributed rendezvous worked and a cross-process psum returns the
+true global sum.
+"""
+import os
+import sys
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+
+
+def main():
+    dist.init_parallel_env()
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    rank = dist.get_rank()
+    world = dist.get_world_size()
+    assert jax.process_count() == world, (jax.process_count(), world)
+    assert len(jax.devices()) == world, "expected 1 device contributed per process"
+
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    sharding = NamedSharding(mesh, P("dp"))
+    local = jnp.asarray([float(rank + 1)])
+    garr = jax.make_array_from_single_device_arrays(
+        (world,), sharding,
+        [jax.device_put(local, jax.local_devices()[0])])
+
+    total = jax.jit(lambda a: jnp.sum(a), out_shardings=NamedSharding(mesh, P()))(garr)
+    expect = world * (world + 1) / 2.0
+    got = float(np.asarray(total))
+    assert got == expect, (got, expect)
+    print(f"RANK{rank} ALLREDUCE_OK {got}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

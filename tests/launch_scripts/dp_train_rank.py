@@ -1,0 +1,65 @@
+"""Rank script: 2-process data-parallel training, loss curve written by rank 0.
+
+The test compares this curve to a single-process run of the identical model
+on the full batch (the reference's TestDistBase loss-curve equivalence,
+test/legacy_test/test_dist_base.py:957).
+"""
+import json
+import os
+import sys
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+
+
+def main(out_path):
+    dist.init_parallel_env()
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    rank = dist.get_rank()
+    world = dist.get_world_size()
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    repl = NamedSharding(mesh, P())
+    batch_sh = NamedSharding(mesh, P("dp"))
+
+    # deterministic data, identical to the single-process reference
+    rng = np.random.default_rng(42)
+    B, D = 8, 4
+    X = rng.normal(0, 1, (B, D)).astype(np.float32)
+    Y = (X @ np.arange(1, D + 1).astype(np.float32)[:, None] * 0.1)
+    W0 = rng.normal(0, 0.1, (D, 1)).astype(np.float32)
+
+    shard = B // world
+    xl = jnp.asarray(X[rank * shard:(rank + 1) * shard])
+    yl = jnp.asarray(Y[rank * shard:(rank + 1) * shard])
+    xg = jax.make_array_from_single_device_arrays(
+        (B, D), batch_sh, [jax.device_put(xl, jax.local_devices()[0])])
+    yg = jax.make_array_from_single_device_arrays(
+        (B, 1), batch_sh, [jax.device_put(yl, jax.local_devices()[0])])
+    w = jax.device_put(jnp.asarray(W0), repl)
+
+    def loss_fn(w, x, y):
+        return jnp.mean(jnp.square(x @ w - y))
+
+    @jax.jit
+    def step(w, x, y):
+        l, g = jax.value_and_grad(loss_fn)(w, x, y)
+        return w - 0.1 * g, l
+
+    losses = []
+    for _ in range(10):
+        w, l = step(w, xg, yg)
+        losses.append(float(np.asarray(l)))
+
+    if rank == 0:
+        with open(out_path, "w") as f:
+            json.dump(losses, f)
+    print(f"RANK{rank} TRAIN_OK {losses[-1]:.6f}", flush=True)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])
