@@ -1,11 +1,23 @@
 """Benchmark: LLaMA-architecture causal-LM training throughput on one chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
 
-The model is a LLaMA-2-architecture network sized to the available HBM
-(BASELINE.json config #4 family; the reference publishes no numbers —
-vs_baseline is reported against a locally-measured naive-eager run of the
-same model, so the number tracks how much the compiled path delivers).
+Headline: the 271M-param LLaMA config (BASELINE.json config #4 family) on the
+compiled donate-buffers train step with full-block rematerialization and the
+Pallas flash-attention kernel asserted engaged. `vs_baseline` is the ratio to
+round 2's measured 36,285.8 tok/s/chip for the SAME config on the same chip
+class (the reference publishes no numbers — BASELINE.md).
+
+MFU is reported against the chip's bf16 peak using model FLOPs
+(6·N_params + causal-attention 6·L·S·H per token — the PaLM convention, no
+credit for remat recompute). Variant sweep r3 (this file's history): donate,
+bigger batch (16/24), dots-saveable remat, and FA-residual-saving remat all
+measured at or below full-remat B=8 on v5e — the config is MXU/HBM balanced,
+so the headline keeps that shape; the honest headroom argument is the mfu
+field, not a bigger batch.
+
+Extras: ViT-L/16 (compiled functional train step) and ResNet-50 (dygraph
+eager, per BASELINE.md's "single-device dygraph" row) images/sec.
 """
 from __future__ import annotations
 
@@ -14,21 +26,42 @@ import time
 
 import numpy as np
 
+R2_BASELINE_TPS = 36285.8   # BENCH_r02.json, same config/chip class
 
-def main():
+_PEAK_BF16 = (
+    ("v5 lite", 197e12), ("v5litepod", 197e12), ("v5e", 197e12),
+    ("v6 lite", 918e12), ("v6e", 918e12),
+    ("v5p", 459e12), ("v5", 459e12),
+    ("v4", 275e12), ("v3", 123e12),
+)
+
+
+def _chip_peak_flops(device):
+    kind = device.device_kind.lower()
+    for key, peak in _PEAK_BF16:
+        if key in kind:
+            return peak
+    return 197e12  # conservative default (v5e-class)
+
+
+def bench_llama():
     import jax
     import jax.numpy as jnp
     from paddle_tpu.models.llama import LlamaConfig, build_functional_llama
     from paddle_tpu.parallel.pipeline import _flatten, _unflatten
     from paddle_tpu import optimizer
+    from paddle_tpu.core.dispatch import get_kernel
 
     on_tpu = any(d.platform == "tpu" for d in jax.devices())
-    # ~350M-param LLaMA-style config that fits v5e HBM with bf16 + adamw fp32 state
     if on_tpu:
         cfg = LlamaConfig(vocab_size=32000, hidden_size=1024, intermediate_size=2816,
                           num_hidden_layers=16, num_attention_heads=16,
                           num_key_value_heads=16, max_position_embeddings=2048)
         B, S, steps, warmup = 8, 2048, 20, 3
+        # the perf contract: Pallas flash attention must be engaged
+        k = get_kernel("flash_attention_causal")
+        assert k is not None and "pallas" in (k.__module__ or ""), \
+            f"Pallas flash attention not engaged: {k}"
     else:  # CPU smoke
         cfg = LlamaConfig(vocab_size=512, hidden_size=128, intermediate_size=384,
                           num_hidden_layers=2, num_attention_heads=4,
@@ -39,7 +72,7 @@ def main():
     ep, bp, hp, ea, ba, hl = build_functional_llama(cfg, dtype=dtype, n_micro=1)
     opt = optimizer.AdamW(learning_rate=1e-4, parameters=[])
 
-    # remat each block: trade FLOPs for HBM (reference recompute pass analog)
+    # full-block remat: measured fastest on v5e (see module docstring)
     ba_ckpt = jax.checkpoint(ba)
 
     def loss_fn(ep, bp, hp, batch):
@@ -54,7 +87,6 @@ def main():
     ho = opt.init_opt_state(_flatten(hp))
     lr = jnp.asarray(1e-4, jnp.float32)
 
-    @jax.jit
     def step(ep, bp, hp, eo, bo, ho, batch):
         loss, (ge, gb, gh) = jax.value_and_grad(loss_fn, argnums=(0, 1, 2))(
             ep, bp, hp, batch)
@@ -63,6 +95,8 @@ def main():
         nh, nho = opt.apply_gradients_functional(_flatten(hp), _flatten(gh), ho, lr=lr)
         return (_unflatten(ne, ep), _unflatten(nb, bp), _unflatten(nh, hp),
                 neo, nbo, nho, loss)
+
+    step = jax.jit(step, donate_argnums=tuple(range(6)))
 
     rng = np.random.default_rng(0)
     ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, S)).astype(np.int32))
@@ -78,58 +112,134 @@ def main():
     jax.block_until_ready(loss)
     dt = time.perf_counter() - t0
 
-    tokens_per_sec = B * S * steps / dt
-
-    # eager-mode reference of the same model (the dispatch-per-op baseline)
-    eager_tps = _eager_baseline(cfg, dtype, B if not on_tpu else 2,
-                                S if not on_tpu else 512)
-    vs = tokens_per_sec / eager_tps if eager_tps > 0 else None
-
+    tps = B * S * steps / dt
     n_params = sum(int(np.prod(v.shape)) for v in
                    list(_flatten(ep).values()) + list(_flatten(bp).values()) +
                    list(_flatten(hp).values()))
-    print(json.dumps({
-        "metric": f"llama_{n_params // 1_000_000}M_train_tokens_per_sec_per_chip",
-        "value": round(tokens_per_sec, 1),
-        "unit": "tokens/s/chip",
-        "vs_baseline": round(vs, 2) if vs else None,
-    }))
+    # model FLOPs/token: 6N + causal attn 6·L·S·H (PaLM MFU convention)
+    flops_tok = 6.0 * n_params + 6.0 * cfg.num_hidden_layers * S * cfg.hidden_size
+    peak = _chip_peak_flops(jax.devices()[0]) if on_tpu else None
+    return {
+        "tokens_per_sec": round(tps, 1),
+        "n_params": n_params,
+        "on_tpu": on_tpu,
+        # off-TPU these are meaningless — emit null, not bogus ratios
+        "mfu": round(flops_tok * tps / peak, 4) if on_tpu else None,
+        "model_flops_per_token": round(flops_tok / 1e9, 3),
+        "chip_peak_tflops_bf16": peak / 1e12 if on_tpu else None,
+        "device_kind": jax.devices()[0].device_kind,
+        "loss": round(float(loss), 4),
+    }
 
 
-def _eager_baseline(cfg, dtype, B, S):
-    """Dygraph eager per-op dispatch on the same architecture (small shapes)."""
+def bench_vit_l16():
+    """ViT-L/16 compiled functional train step, images/sec (BASELINE.md #2)."""
+    import jax
+    import jax.numpy as jnp
     import paddle_tpu as paddle
-    from paddle_tpu.models.llama import LlamaForCausalLM, LlamaConfig
-    from paddle_tpu import optimizer as popt
-    small = LlamaConfig(vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
-                        intermediate_size=cfg.intermediate_size,
-                        num_hidden_layers=min(cfg.num_hidden_layers, 4),
-                        num_attention_heads=cfg.num_attention_heads,
-                        num_key_value_heads=cfg.num_key_value_heads,
-                        max_position_embeddings=S)
-    model = LlamaForCausalLM(small)
-    opt = popt.AdamW(learning_rate=1e-4, parameters=model.parameters())
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.nn.layer import functional_state
+    from paddle_tpu.vision.models import vit_l_16
+
+    on_tpu = any(d.platform == "tpu" for d in jax.devices())
+    B, steps, warmup = (32, 10, 2) if on_tpu else (2, 2, 1)
+    paddle.seed(0)
+    model = vit_l_16(num_classes=1000)
+    # f32 throughout: mixing per-leaf dtypes breaks conv dtype checks
+    params = {n: p._value for n, p in model.named_parameters()}
+
+    def loss_fn(params, x, y):
+        with functional_state(model, params):
+            logits = model(Tensor(x))
+        lv = logits._value.astype(jnp.float32)
+        logp = jax.nn.log_softmax(lv, -1)
+        return -jnp.mean(jnp.take_along_axis(logp, y[:, None], -1))
+
+    @jax.jit
+    def step(params, x, y):
+        loss, g = jax.value_and_grad(loss_fn)(params, x, y)
+        new = jax.tree_util.tree_map(lambda p, gg: p - 1e-4 * gg.astype(p.dtype),
+                                     params, g)
+        return new, loss
+
     rng = np.random.default_rng(0)
-    ids = paddle.to_tensor(rng.integers(0, small.vocab_size, (B, S)).astype(np.int32))
-    import time as _t
+    x = jnp.asarray(rng.normal(0, 1, (B, 3, 224, 224)).astype(np.float32))
+    y = jnp.asarray(rng.integers(0, 1000, (B,)).astype(np.int32))
+    for _ in range(warmup):
+        params, loss = step(params, x, y)
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, loss = step(params, x, y)
+    jax.block_until_ready(loss)
+    return round(B * steps / (time.perf_counter() - t0), 1)
+
+
+def bench_resnet50_dygraph():
+    """ResNet-50 eager dygraph step, images/sec (BASELINE.md #1 calls for
+    single-device dygraph — measures the per-op dispatch path)."""
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.vision.models import resnet50
+    from paddle_tpu import optimizer as popt
+    from paddle_tpu.nn import functional as F
+
+    on_tpu = any(d.platform == "tpu" for d in jax.devices())
+    B, steps = (16, 4) if on_tpu else (2, 1)
+    paddle.seed(0)
+    model = resnet50(num_classes=1000)
+    opt = popt.Momentum(learning_rate=0.1, momentum=0.9,
+                        parameters=model.parameters())
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.normal(0, 1, (B, 3, 224, 224)).astype(np.float32))
+    y = paddle.to_tensor(rng.integers(0, 1000, (B,)).astype(np.int64))
     # warmup
-    loss, _ = model(ids, labels=ids)
+    loss = F.cross_entropy(model(x), y)
     loss.backward()
     opt.step()
     opt.clear_grad()
-    t0 = _t.perf_counter()
-    n = 3
-    for _ in range(n):
-        loss, _ = model(ids, labels=ids)
+    jax.block_until_ready(loss._value)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = F.cross_entropy(model(x), y)
         loss.backward()
         opt.step()
         opt.clear_grad()
-    import jax
     jax.block_until_ready(loss._value)
-    dt = _t.perf_counter() - t0
-    # scale for layer-count difference
-    frac = small.num_hidden_layers / cfg.num_hidden_layers
-    return B * S * n / dt * frac
+    return round(B * steps / (time.perf_counter() - t0), 1)
+
+
+def main():
+    import jax
+    res = bench_llama()
+    extras = {}
+    on_tpu = any(d.platform == "tpu" for d in jax.devices())
+    secondary = (("vit_l16_images_per_sec", bench_vit_l16),
+                 ("resnet50_dygraph_images_per_sec", bench_resnet50_dygraph)) \
+        if on_tpu else ()
+    for name, fn in secondary:
+        try:
+            jax.clear_caches()  # release the previous bench's HBM footprint
+            extras[name] = fn()
+        except Exception as e:  # noqa: BLE001 — secondary configs must not
+            extras[name] = f"error: {type(e).__name__}: {e}"[:200]
+
+    out = {
+        "metric": f"llama_{res['n_params'] // 1_000_000}M_train_tokens_per_sec_per_chip",
+        "value": res["tokens_per_sec"],
+        "unit": "tokens/s/chip",
+        "vs_baseline": (round(res["tokens_per_sec"] / R2_BASELINE_TPS, 4)
+                        if res["on_tpu"] else None),
+        "baseline_note": "ratio vs round-2 measured 36285.8 tok/s same config "
+                         "(reference publishes no numbers, BASELINE.md)",
+        "mfu": res["mfu"],
+        "model_flops_per_token_gflops": res["model_flops_per_token"],
+        "chip_peak_tflops_bf16": res["chip_peak_tflops_bf16"],
+        "device_kind": res["device_kind"],
+        "loss": res["loss"],
+    }
+    out.update(extras)
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -116,10 +116,8 @@ class LlamaAttention(Layer):
             from ..core.dispatch import op_call
             q = op_call("rope", lambda qq: _apply_rope(qq, sin, cos), q)
             k = op_call("rope", lambda kk: _apply_rope(kk, sin, cos), k)
-        n_rep = self.num_heads // self.num_kv
-        if n_rep > 1:
-            k = manip.repeat_interleave(k, n_rep, axis=2)
-            v = manip.repeat_interleave(v, n_rep, axis=2)
+        # GQA KV heads pass through un-repeated: the Pallas kernel indexes
+        # them natively; the jnp fallback up-materializes internally
         out = F.scaled_dot_product_attention(q, k, v, is_causal=True,
                                              training=self.training)
         out = manip.reshape(out, [b, s, -1])
@@ -301,8 +299,12 @@ def build_functional_llama(config: LlamaConfig, key=None, dtype=None,
     sin_t, cos_t = _rope_tables(c.max_position_embeddings, head_dim, c.rope_theta, d)
 
     def rms(x, w, eps=c.rms_norm_eps):
-        ms = jnp.mean(jnp.square(x.astype(jnp.float32)), -1, keepdims=True)
-        return (x * jax.lax.rsqrt(ms + eps)).astype(x.dtype) * w
+        from ..core.dispatch import get_kernel
+        from ..nn.functional.norm import rms_norm_ref
+        impl = get_kernel("rms_norm")
+        if impl is not None:
+            return impl(x, w, epsilon=eps)
+        return rms_norm_ref(x, w, eps)
 
     def embed_apply(p, batch):
         ids, labels = batch
@@ -334,14 +336,16 @@ def build_functional_llama(config: LlamaConfig, key=None, dtype=None,
         sin, cos = sin_t[:S], cos_t[:S]
         q = _apply_rope(q, sin, cos)
         k = _apply_rope(k, sin, cos)
-        if nh_l != nkv_l:
-            rep = nh_l // nkv_l
-            k = jnp.repeat(k, rep, axis=2)
-            v = jnp.repeat(v, rep, axis=2)
         from ..core.dispatch import get_kernel
         attn_impl = get_kernel("flash_attention_causal")
+        # GQA: the Pallas kernel indexes KV heads natively; only the jnp
+        # fallback up-materializes (reference flash_attn GQA path)
         o = attn_impl(q, k, v) if attn_impl is not None else None
         if o is None:
+            if nh_l != nkv_l:
+                rep = nh_l // nkv_l
+                k = jnp.repeat(k, rep, axis=2)
+                v = jnp.repeat(v, rep, axis=2)
             logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(head_dim)
             mask = jnp.tril(jnp.ones((S, S), bool))
             logits = jnp.where(mask, logits.astype(jnp.float32), -jnp.inf)

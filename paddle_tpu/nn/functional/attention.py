@@ -28,6 +28,10 @@ def _sdpa_ref(q, k, v, mask=None, dropout=0.0, causal=False, scale=None,
               dropout_key=None):
     """Reference math: q,k,v [B, S, H, D] -> [B, S, H, D]."""
     d = q.shape[-1]
+    if k.shape[2] != q.shape[2]:  # GQA fallback: up-materialize KV heads
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     s = scale if scale is not None else 1.0 / math.sqrt(d)
     # [B, H, Sq, Sk]
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * s

@@ -97,17 +97,25 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=N
     return op_call("layer_norm", impl, *args)
 
 
+def rms_norm_ref(v, w=None, epsilon=1e-6):
+    """The single jnp-level RMSNorm fallback (fp32 stats; weight applied in
+    fp32 then cast, matching the Pallas kernel's convention). Shared by the
+    functional dispatch default, the Pallas untileable fallback, and the
+    functional LLaMA block."""
+    ms = jnp.mean(jnp.square(v.astype(jnp.float32)), axis=-1, keepdims=True)
+    out = v.astype(jnp.float32) * jax.lax.rsqrt(ms + epsilon)
+    if w is not None:
+        out = out * w.astype(jnp.float32)
+    return out.astype(v.dtype)
+
+
 def rms_norm(x, weight=None, epsilon=1e-6, name=None):
     """RMSNorm (reference incubate fused_rms_norm) — LLaMA's norm; Pallas
     override registers under op name 'rms_norm'."""
-    def impl(v, *rest):
-        ms = jnp.mean(jnp.square(v.astype(jnp.float32)), axis=-1, keepdims=True)
-        out = (v * jax.lax.rsqrt(ms + epsilon)).astype(v.dtype)
-        if rest:
-            out = out * rest[0]
-        return out
+    def impl(v, *rest, epsilon=epsilon):
+        return rms_norm_ref(v, rest[0] if rest else None, epsilon)
     args = [x] if weight is None else [x, weight]
-    return op_call("rms_norm", impl, *args)
+    return op_call("rms_norm", impl, *args, epsilon=epsilon)
 
 
 def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,

@@ -23,6 +23,10 @@ flash_attention = fa_mod.flash_attention
 
 def _naive_sdpa(q, k, v, causal):
     d = q.shape[-1]
+    if k.shape[2] != q.shape[2]:  # GQA: up-materialize only in the fallback
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / math.sqrt(d)
     if causal:
         sq, sk = s.shape[-2], s.shape[-1]
@@ -30,6 +34,17 @@ def _naive_sdpa(q, k, v, causal):
         s = jnp.where(mask, s, fa_mod.NEG_INF)
     p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def _rms_norm_pallas(x, *rest, epsilon=1e-6):
+    from . import fused
+    if rest:
+        out = fused.rms_norm(x, rest[0], eps=epsilon)
+        if out is not None:
+            return out
+    # unweighted or untileable: the shared jnp fallback (XLA fuses it anyway)
+    from ...nn.functional.norm import rms_norm_ref
+    return rms_norm_ref(x, rest[0] if rest else None, epsilon)
 
 
 def _fa_plain(q, k, v):
@@ -57,4 +72,7 @@ def register_all(force=False):
         return
     register_kernel("flash_attention", impl="pallas")(_fa_plain)
     register_kernel("flash_attention_causal", impl="pallas")(_fa_causal)
+    register_kernel("rms_norm", impl="pallas")(_rms_norm_pallas)
+    from .fused import adamw_update
+    register_kernel("adamw_fused", impl="pallas")(adamw_update)
     _registered[0] = True

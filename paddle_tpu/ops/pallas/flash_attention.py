@@ -89,12 +89,24 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
         lse_ref[0] = m_scr[:] + jnp.log(jnp.maximum(l, 1e-30))
 
 
-def flash_attention_fwd_kernel_call(q, k, v, causal, sm_scale, interpret=False):
-    """q,k,v: [BH, S, D] -> (o [BH, Sq, D], lse [BH, Sq])."""
+def flash_attention_fwd_kernel_call(q, k, v, causal, sm_scale, interpret=False,
+                                    n_q_heads=None, n_kv_heads=None):
+    """q: [B*Hq, S, D], k/v: [B*Hkv, S, D] -> (o [B*Hq, Sq, D], lse).
+
+    GQA (n_kv_heads < n_q_heads) is handled in the BlockSpec index maps: the
+    kernel reads KV blocks of head h // rep directly from HBM — no
+    materialized jnp.repeat of K/V (reference flash_attn_kernel.cu GQA path).
+    """
     bh, s_q, d = q.shape
     s_k = k.shape[1]
+    hq = n_q_heads or 1
+    hkv = n_kv_heads or hq
+    rep = hq // hkv
     block_q, block_k = _block_sizes(s_q, s_k, d)
     grid = (bh, s_q // block_q, s_k // block_k)
+
+    def kv_idx(b, i, j):
+        return ((b // hq) * hkv + (b % hq) // rep, j, 0)
 
     kernel = functools.partial(
         _fwd_kernel, causal=causal, sm_scale=sm_scale, block_q=block_q,
@@ -105,8 +117,8 @@ def flash_attention_fwd_kernel_call(q, k, v, causal, sm_scale, interpret=False):
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), kv_idx),
+            pl.BlockSpec((1, block_k, d), kv_idx),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -132,11 +144,15 @@ def flash_attention_fwd_kernel_call(q, k, v, causal, sm_scale, interpret=False):
 # ---------------------------------------------------------------------------
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_scr, dv_scr, *,
-                    causal, sm_scale, block_q, block_k, num_q_blocks, offset):
-    i = pl.program_id(2)  # q-block (reduction)
+                    causal, sm_scale, block_q, block_k, num_q_blocks,
+                    rep_heads, offset):
+    # grid (bh_kv, j, rr, i): rr walks the rep q-heads sharing this kv head
+    # (GQA — dk/dv accumulate over them), i walks q blocks
     j = pl.program_id(1)  # k-block
+    rr = pl.program_id(2)  # q-head within the kv group (reduction)
+    i = pl.program_id(3)  # q-block (reduction)
 
-    @pl.when(i == 0)
+    @pl.when((i == 0) & (rr == 0))
     def _init():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
@@ -176,7 +192,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    @pl.when(i == num_q_blocks - 1)
+    @pl.when((i == num_q_blocks - 1) & (rr == rep_heads - 1))
     def _finalize():
         dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
@@ -226,45 +242,61 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _bwd_call(res, g, causal, sm_scale, interpret):
+def _bwd_call(res, g, causal, sm_scale, interpret, n_q_heads=None,
+              n_kv_heads=None):
     q, k, v, o, lse = res
     do = g
     bh, s_q, d = q.shape
-    s_k = k.shape[1]
+    bh_kv, s_k, _ = k.shape
+    hq = n_q_heads or 1
+    hkv = n_kv_heads or hq
+    rep = hq // hkv
     block_q, block_k = _block_sizes(s_q, s_k, d)
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1, keepdims=True)  # [bh, s_q, 1]
 
+    def q_idx_dkv(b, j, rr, i):
+        # b indexes B*Hkv; the q head is the rr-th member of its kv group
+        return ((b // hkv) * hq + (b % hkv) * rep + rr, i, 0)
+
+    def kv_idx_dkv(b, j, rr, i):
+        return (b, j, 0)
+
     dkv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, causal=causal, sm_scale=sm_scale,
                           block_q=block_q, block_k=block_k,
-                          num_q_blocks=s_q // block_q, offset=s_k - s_q),
-        grid=(bh, s_k // block_k, s_q // block_q),
+                          num_q_blocks=s_q // block_q, rep_heads=rep,
+                          offset=s_k - s_q),
+        grid=(bh_kv, s_k // block_k, rep, s_q // block_q),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, d), q_idx_dkv),
+            pl.BlockSpec((1, block_k, d), kv_idx_dkv),
+            pl.BlockSpec((1, block_k, d), kv_idx_dkv),
+            pl.BlockSpec((1, block_q, d), q_idx_dkv),
+            pl.BlockSpec((1, block_q, 1), q_idx_dkv),
+            pl.BlockSpec((1, block_q, 1), q_idx_dkv),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), kv_idx_dkv),
+            pl.BlockSpec((1, block_k, d), kv_idx_dkv),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, s_k, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, s_k, d), v.dtype),
+            jax.ShapeDtypeStruct((bh_kv, s_k, d), k.dtype),
+            jax.ShapeDtypeStruct((bh_kv, s_k, d), v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
+            dimension_semantics=("parallel", "parallel", "arbitrary",
+                                 "arbitrary")),
         interpret=interpret,
     )(q, k, v, do, lse, delta)
     dk, dv = dkv
+
+    def kv_idx_dq(b, i, j):
+        return ((b // hq) * hkv + (b % hq) // rep, j, 0)
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, causal=causal, sm_scale=sm_scale,
@@ -273,8 +305,8 @@ def _bwd_call(res, g, causal, sm_scale, interpret):
         grid=(bh, s_q // block_q, s_k // block_k),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), kv_idx_dq),
+            pl.BlockSpec((1, block_k, d), kv_idx_dq),
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
@@ -302,28 +334,34 @@ def _make_op(causal: bool, interpret: bool):
     def _fwd(q, k, v):
         b, s_q, h, d = q.shape
         s_k = k.shape[1]
+        hkv = k.shape[2]
         sm_scale = 1.0 / math.sqrt(d)
         qr = q.transpose(0, 2, 1, 3).reshape(b * h, s_q, d)
-        kr = k.transpose(0, 2, 1, 3).reshape(b * h, s_k, d)
-        vr = v.transpose(0, 2, 1, 3).reshape(b * h, s_k, d)
+        kr = k.transpose(0, 2, 1, 3).reshape(b * hkv, s_k, d)
+        vr = v.transpose(0, 2, 1, 3).reshape(b * hkv, s_k, d)
         o, lse = flash_attention_fwd_kernel_call(qr, kr, vr, causal, sm_scale,
-                                                 interpret)
+                                                 interpret, n_q_heads=h,
+                                                 n_kv_heads=hkv)
         o4 = o.reshape(b, h, s_q, d).transpose(0, 2, 1, 3)
-        return o4, (qr, kr, vr, o, lse, (b, h, s_q, s_k, d))
+        # name the bwd residuals so a save_only_these_names("fa_res") remat
+        # policy keeps them and the backward skips re-running the fwd kernel
+        from jax.ad_checkpoint import checkpoint_name
+        res = tuple(checkpoint_name(x, "fa_res") for x in (qr, kr, vr, o, lse))
+        return o4, res + ((b, h, hkv, s_q, s_k, d),)
 
     def fwd(q, k, v):
         o4, res = _fwd(q, k, v)
         return o4, res
 
     def bwd(res, g):
-        qr, kr, vr, o, lse, (b, h, s_q, s_k, d) = res
+        qr, kr, vr, o, lse, (b, h, hkv, s_q, s_k, d) = res
         sm_scale = 1.0 / math.sqrt(d)
         do = g.transpose(0, 2, 1, 3).reshape(b * h, s_q, d)
         dq, dk, dv = _bwd_call((qr, kr, vr, o, lse), do, causal, sm_scale,
-                               interpret)
+                               interpret, n_q_heads=h, n_kv_heads=hkv)
         dq4 = dq.reshape(b, h, s_q, d).transpose(0, 2, 1, 3)
-        dk4 = dk.reshape(b, h, s_k, d).transpose(0, 2, 1, 3)
-        dv4 = dv.reshape(b, h, s_k, d).transpose(0, 2, 1, 3)
+        dk4 = dk.reshape(b, hkv, s_k, d).transpose(0, 2, 1, 3)
+        dv4 = dv.reshape(b, hkv, s_k, d).transpose(0, 2, 1, 3)
         return dq4, dk4, dv4
 
     op.defvjp(fwd, bwd)
@@ -333,6 +371,9 @@ def _make_op(causal: bool, interpret: bool):
 def _supported(q, k, causal=False):
     b, s_q, h, d = q.shape
     s_k = k.shape[1]
+    hkv = k.shape[2]
+    if h % hkv != 0:
+        return False
     if d > 256 or d % 8 != 0:
         return False
     if causal and s_q > s_k:

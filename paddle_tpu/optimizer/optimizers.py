@@ -69,6 +69,20 @@ class Adam(Optimizer):
         b1, b2 = self._beta1, self._beta2
         b1p = state["beta1_pow"] * b1
         b2p = state["beta2_pow"] * b2
+        if not getattr(self, "_amsgrad", False):
+            # fused Pallas update when registered (TPU): one kernel for the
+            # whole (p, m, v) stream instead of an XLA elementwise chain
+            from ..core.dispatch import get_kernel
+            fused_fn = get_kernel("adamw_fused")
+            if fused_fn is not None:
+                res = fused_fn(p, g, state["moment1"], state["moment2"],
+                               lr=lr, beta1=b1, beta2=b2, eps=self._eps,
+                               weight_decay=decoupled_wd,
+                               bias1=1.0 - b1p, bias2=1.0 - b2p)
+                if res is not None:
+                    np_, nm, nv = res
+                    return np_, {"moment1": nm, "moment2": nv,
+                                 "beta1_pow": b1p, "beta2_pow": b2p}
         m1 = b1 * state["moment1"] + (1 - b1) * g32
         m2 = b2 * state["moment2"] + (1 - b2) * g32 * g32
         new = {"moment1": m1, "moment2": m2, "beta1_pow": b1p, "beta2_pow": b2p}

@@ -148,7 +148,8 @@ class Pipeline1F1BTrainStep:
     def __init__(self, mesh: Mesh, embed_apply_mb, block_apply, head_loss_mb,
                  embed_params, block_params, head_params, optimizer,
                  n_micro: int, n_chunks: int = 1, batch_spec=None,
-                 donate=True, remat_stage: bool = False, block_specs=None):
+                 donate=True, remat_stage: bool = False, block_specs=None,
+                 schedule: str = "1f1b"):
         """block_specs: optional {leaf_name: partition-suffix tuple} for the
         block params (excluding the leading stacked-layer dim), e.g.
         llama_block_specs("mp") — wires real tensor parallelism: those leaves
@@ -156,6 +157,11 @@ class Pipeline1F1BTrainStep:
         axes the suffix names (each rank owns a distinct shard)."""
         if batch_spec is None:
             batch_spec = P("dp") if "dp" in mesh.axis_names else P()
+        if schedule not in ("1f1b", "zero_bubble"):
+            raise ValueError(f"unknown schedule {schedule!r}")
+        if schedule == "zero_bubble" and n_chunks != 1:
+            raise ValueError("zero_bubble schedule has no VPP chunks")
+        self.schedule = schedule
         self.mesh = mesh
         self.n_micro = n_micro
         self.n_chunks = n_chunks
@@ -288,9 +294,14 @@ class Pipeline1F1BTrainStep:
                 loss = l_mb * jnp.where(s == S - 1, 1.0, 0.0)
                 return out, loss
 
-            loss_sum, g = spmd_pipeline_1f1b(
-                fwd_mb, params, self.n_micro, act_sd, axis="pp",
-                n_chunks=n_ck)
+            if self.schedule == "zero_bubble":
+                from .zero_bubble import spmd_pipeline_zero_bubble
+                loss_sum, g = spmd_pipeline_zero_bubble(
+                    fwd_mb, params, self.n_micro, act_sd, axis="pp")
+            else:
+                loss_sum, g = spmd_pipeline_1f1b(
+                    fwd_mb, params, self.n_micro, act_sd, axis="pp",
+                    n_chunks=n_ck)
             # per-mb head losses were means; global loss = mean over mbs
             loss = loss_sum / self.n_micro
             loss = jax.lax.psum(loss, "pp")      # nonzero on last stage only

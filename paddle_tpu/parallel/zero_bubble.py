@@ -1,0 +1,270 @@
+"""Zero-bubble pipeline schedule (B/W-split backward), compiled SPMD.
+
+Reference: passes/pipeline_scheduler_pass/pipeline_zero_bubble.py:62 — the
+ZB-H1 family splits each microbatch's backward into
+
+    B: input-grad only   (dL/dx — the inter-stage critical path)
+    W: weight-grad only  (dL/dW — no successor, schedulable into bubbles)
+
+TPU-native design: unlike the fused-tick 1F1B engine
+(pipeline_schedules.spmd_pipeline_1f1b), this engine is *slot-granular*.  A
+schedule TABLE (built in Python by a greedy list scheduler, one row per pp
+rank, one column per tick) assigns each rank one slot per tick:
+IDLE / F(mb) / B(mb) / W(mb).  Inside shard_map every tick executes
+`lax.switch` on this rank's table entry — real per-device control flow, so a
+tick costs one slot's work — then ppermutes the fwd/bwd rings.  With B on
+the critical path and W deferred into bubbles, the zero-bubble table's
+makespan is strictly shorter than the fine-grained 1F1B table's at the same
+(n_stages, n_micro); `build_schedule` exposes both policies so the bubble
+reduction is measurable (tests assert it).
+
+Cost note: B and W each rematerialize the stage forward (jax.vjp over the
+input-only / params-only closure), so ZB trades one extra stage-forward per
+microbatch for bubble elimination — profitable when the bubble fraction
+2(S-1)/(n_micro+2(S-1)) exceeds the ~20% recompute overhead, i.e. small
+n_micro/S ratios, exactly the regime ZB targets.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .pipeline import _flatten, _unflatten, _opt_specs, _axes_in_scope
+from .pipeline_schedules import _vary
+
+__all__ = ["build_schedule", "schedule_stats", "spmd_pipeline_zero_bubble",
+           "PipelineZeroBubbleTrainStep", "IDLE", "F", "B", "W"]
+
+IDLE, F, B, W = 0, 1, 2, 3
+
+
+def build_schedule(n_stages: int, n_micro: int, policy: str = "zb1"
+                   ) -> List[List[Tuple[int, int]]]:
+    """Greedy list scheduler. Returns per-rank slot lists [(kind, mb), ...]
+    (all rows same length = makespan).
+
+    policy "1f1b": W is chained right after its B (the classic fused
+    backward, split into two unit slots — the fair fine-grained baseline).
+    policy "zb1": W defers; B and F take priority, W fills bubbles (ZB-H1).
+    In-flight activations per rank are capped at n_stages (H1's memory
+    bound ~ 1F1B's).
+    """
+    S, M = n_stages, n_micro
+    f_done = [[-1] * M for _ in range(S)]   # tick F(s,m) executed
+    b_done = [[-1] * M for _ in range(S)]
+    w_done = [[-1] * M for _ in range(S)]
+    rows: List[List[Tuple[int, int]]] = [[] for _ in range(S)]
+    forced: List[Tuple[int, int]] = [None] * S  # 1f1b: W forced next tick
+    t = 0
+    limit = 4 * (M + 2 * S) + 8
+    while (any(w_done[s][m] < 0 for s in range(S) for m in range(M))
+           and t < limit):
+        for s in range(S):
+            rows[s].append((IDLE, 0))
+
+        def ready_F(s, m):
+            if f_done[s][m] >= 0:
+                return False
+            if s > 0 and not (0 <= f_done[s - 1][m] < t):
+                return False
+            # memory cap: in-flight (F done or now, W not done) < S + 1
+            inflight = sum(1 for mm in range(M)
+                           if f_done[s][mm] >= 0 and w_done[s][mm] < 0)
+            return inflight <= S
+
+        def ready_B(s, m):
+            if b_done[s][m] >= 0 or f_done[s][m] < 0:
+                return False
+            if s == S - 1:
+                return f_done[s][m] < t
+            return 0 <= b_done[s + 1][m] < t
+
+        def ready_W(s, m):
+            return w_done[s][m] < 0 <= b_done[s][m] and b_done[s][m] < t
+
+        for s in range(S):
+            if forced[s] is not None:
+                m = forced[s][1]
+                rows[s][t] = (W, m)
+                w_done[s][m] = t
+                forced[s] = None
+                continue
+            slot = None
+            # priority: B first (critical path), then F, then W
+            for m in range(M):
+                if ready_B(s, m):
+                    slot = (B, m)
+                    break
+            if slot is None:
+                for m in range(M):
+                    if ready_F(s, m):
+                        slot = (F, m)
+                        break
+            if slot is None and policy == "zb1":
+                for m in range(M):
+                    if ready_W(s, m):
+                        slot = (W, m)
+                        break
+            if slot is None:
+                continue
+            kind, m = slot
+            rows[s][t] = slot
+            if kind == F:
+                f_done[s][m] = t
+            elif kind == B:
+                b_done[s][m] = t
+                if policy == "1f1b":
+                    forced[s] = (W, m)
+            elif kind == W:
+                w_done[s][m] = t
+        t += 1
+    if t >= limit:
+        raise RuntimeError("schedule did not converge")
+    return rows
+
+
+def schedule_stats(rows):
+    """(makespan, idle_slots, bubble_fraction)."""
+    T = len(rows[0])
+    idle = sum(1 for r in rows for k, _ in r if k == IDLE)
+    return T, idle, idle / (T * len(rows))
+
+
+def _depths(rows, n_micro):
+    """Ring-buffer depths: max lifetime span (in distinct mbs) of saved
+    activations (F..W) and cotangents (B-arrival..W)."""
+    S = len(rows)
+    act_d, cot_d = 1, 1
+    for s in range(S):
+        f_t = {}
+        w_t = {}
+        b_t = {}
+        for t, (k, m) in enumerate(rows[s]):
+            if k == F:
+                f_t[m] = t
+            elif k == B:
+                b_t[m] = t
+            elif k == W:
+                w_t[m] = t
+        for t in range(len(rows[s])):
+            live_a = [m for m in range(n_micro)
+                      if f_t.get(m, 10**9) <= t and w_t.get(m, 10**9) >= t]
+            live_c = [m for m in range(n_micro)
+                      if b_t.get(m, 10**9) - 1 <= t and w_t.get(m, 10**9) >= t]
+            if live_a:
+                act_d = max(act_d, max(live_a) - min(live_a) + 1)
+            if live_c:
+                cot_d = max(cot_d, max(live_c) - min(live_c) + 1)
+    return min(act_d, n_micro), min(cot_d, n_micro)
+
+
+def spmd_pipeline_zero_bubble(fwd_mb: Callable, params, n_micro: int,
+                              act_sd, axis: str = "pp", policy: str = "zb1",
+                              varying_axes=("dp", "pp", "mp")):
+    """Run the slot-table schedule inside shard_map over `axis`.
+
+    fwd_mb(params, c, act_in, mb_idx) -> (act_out, loss_mb) — same contract
+    as spmd_pipeline_1f1b (c is always 0; no VPP chunks here).
+    Returns (loss_sum_on_last_stage, grads_like_params).
+    """
+    n = jax.lax.psum(1, axis)
+    r = jax.lax.axis_index(axis)
+    S = n
+    rows = build_schedule(S, n_micro, policy)
+    T = len(rows[0])
+    act_depth, cot_depth = _depths(rows, n_micro)
+    kind_arr = jnp.asarray([[k for k, _ in row] for row in rows], jnp.int32)
+    mb_arr = jnp.asarray([[m for _, m in row] for row in rows], jnp.int32)
+    perm_f = [(i, (i + 1) % n) for i in range(n)]
+    perm_b = [(i, (i - 1) % n) for i in range(n)]
+
+    va = _axes_in_scope(varying_axes)
+    params = jax.tree_util.tree_map(lambda p: _vary(p, va), params)
+    mb_shape, mb_dtype = act_sd.shape, act_sd.dtype
+
+    def tick(carry, t):
+        act_buf, cot_buf, gacc, loss_acc, send_f, send_b = carry
+        # ---- ingest last tick's arrivals (table-addressed) ---------------
+        prev_r = jnp.mod(r - 1, n)
+        next_r = jnp.mod(r + 1, n)
+        pk = kind_arr[prev_r, jnp.maximum(t - 1, 0)]
+        pm = mb_arr[prev_r, jnp.maximum(t - 1, 0)]
+        recv_f = jax.lax.ppermute(send_f, axis, perm_f)
+        recv_b = jax.lax.ppermute(send_b, axis, perm_b)
+        take_f = (t > 0) & (pk == F) & (r > 0)
+        act_buf = jnp.where(take_f,
+                            act_buf.at[jnp.mod(pm, act_depth)].set(recv_f),
+                            act_buf)
+        nk = kind_arr[next_r, jnp.maximum(t - 1, 0)]
+        nm = mb_arr[next_r, jnp.maximum(t - 1, 0)]
+        take_b = (t > 0) & (nk == B) & (r < n - 1)
+        cot_buf = jnp.where(take_b,
+                            cot_buf.at[jnp.mod(nm, cot_depth)].set(recv_b),
+                            cot_buf)
+
+        my_k = kind_arr[r, t]
+        my_m = mb_arr[r, t]
+        a_in = act_buf[jnp.mod(my_m, act_depth)]
+
+        def norm_out(a, g, gp, l):
+            # align vma types across lax.switch branches
+            return (_vary(a, va), _vary(g, va),
+                    jax.tree_util.tree_map(lambda x: _vary(x, va), gp),
+                    _vary(l, va))
+
+        def do_idle(a_in, g_in):
+            return norm_out(jnp.zeros(mb_shape, mb_dtype),
+                            jnp.zeros(mb_shape, mb_dtype),
+                            jax.tree_util.tree_map(jnp.zeros_like, params),
+                            jnp.zeros((), jnp.float32))
+
+        def do_F(a_in, g_in):
+            a_out, l_mb = fwd_mb(params, 0, a_in, my_m)
+            return norm_out(a_out, jnp.zeros(mb_shape, mb_dtype),
+                            jax.tree_util.tree_map(jnp.zeros_like, params),
+                            l_mb.astype(jnp.float32))
+
+        def do_B(a_in, g_in):
+            # input-grad only: params closed over as constants
+            _, vjp_a = jax.vjp(lambda a: fwd_mb(params, 0, a, my_m), a_in)
+            is_last = r == n - 1
+            g_act = jnp.where(is_last, jnp.zeros(mb_shape, mb_dtype), g_in)
+            (ga,) = vjp_a((g_act, _vary(jnp.ones((), jnp.float32), va)))
+            return norm_out(jnp.zeros(mb_shape, mb_dtype), ga,
+                            jax.tree_util.tree_map(jnp.zeros_like, params),
+                            jnp.zeros((), jnp.float32))
+
+        def do_W(a_in, g_in):
+            # weight-grad only: activation closed over as constant
+            _, vjp_p = jax.vjp(lambda p: fwd_mb(p, 0, a_in, my_m), params)
+            is_last = r == n - 1
+            g_act = jnp.where(is_last, jnp.zeros(mb_shape, mb_dtype), g_in)
+            (gp,) = vjp_p((g_act, _vary(jnp.ones((), jnp.float32), va)))
+            return norm_out(jnp.zeros(mb_shape, mb_dtype),
+                            jnp.zeros(mb_shape, mb_dtype), gp,
+                            jnp.zeros((), jnp.float32))
+
+        g_in = cot_buf[jnp.mod(my_m, cot_depth)]
+        branches = [do_idle, do_F, do_B, do_W]
+        a_out, g_out, gp, l_mb = jax.lax.switch(my_k, branches, a_in, g_in)
+        # last stage's loss counts only on F slots (head runs there)
+        loss_acc = loss_acc + jnp.where(my_k == F, l_mb, 0.0)
+        gacc = jax.tree_util.tree_map(lambda acc, g: acc + g.astype(acc.dtype),
+                                      gacc, gp)
+        return (act_buf, cot_buf, gacc, loss_acc, a_out, g_out), None
+
+    carry = (jnp.zeros((act_depth,) + mb_shape, mb_dtype),
+             jnp.zeros((cot_depth,) + mb_shape, mb_dtype),
+             jax.tree_util.tree_map(
+                 lambda p: jnp.zeros(p.shape, p.dtype), params),
+             jnp.zeros((), jnp.float32),
+             jnp.zeros(mb_shape, mb_dtype),
+             jnp.zeros(mb_shape, mb_dtype))
+    if va:
+        carry = jax.tree_util.tree_map(lambda x: _vary(x, va), carry)
+    (_, _, gacc, loss_acc, _, _), _ = jax.lax.scan(
+        tick, carry, jnp.arange(T))
+    return loss_acc, gacc

@@ -1,0 +1,64 @@
+"""Auto-generated OpTest cases from the single-source op table
+(VERDICT r2 item #7; reference ops.yaml → generated op tests). Every
+OpSpec with a test block gets: eager-vs-numpy output check, jit check, and
+a numeric-vs-analytic grad check through the tape — from the table entry
+alone."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.ops import registry, table
+from op_test import check_output, check_grad
+
+_TESTABLE = [s for s in registry.all_specs()
+             if s.test is not None and s.np_ref is not None]
+
+
+@pytest.mark.parametrize("spec", _TESTABLE, ids=lambda s: s.name)
+def test_op_output(spec):
+    rng = np.random.default_rng(hash(spec.name) % 2**31)
+    t = spec.test
+    args = [rng.uniform(t.low, t.high, sh).astype(t.dtype) for sh in t.shapes]
+    fn = table.TABLE_OPS[spec.name]
+    check_output(fn, spec.np_ref, args=args, kwargs=t.kwargs,
+                 rtol=t.rtol, atol=t.atol)
+
+
+@pytest.mark.parametrize(
+    "spec", [s for s in _TESTABLE if s.test.grad], ids=lambda s: s.name)
+def test_op_grad(spec):
+    rng = np.random.default_rng(hash(spec.name) % 2**31)
+    t = spec.test
+    args = [rng.uniform(t.low, t.high, sh).astype(t.dtype) for sh in t.shapes]
+    fn = table.TABLE_OPS[spec.name]
+    for i in range(len(args)):
+        check_grad(fn, args, arg_idx=i, kwargs=t.kwargs, eps=t.grad_eps)
+
+
+def test_custom_vjp_through_table():
+    """The t_grad_x2 table entry declares a custom VJP (grad = 2·upstream)."""
+    x = paddle.to_tensor(np.ones((3, 3), np.float32))
+    x.stop_gradient = False
+    out = table.TABLE_OPS["t_grad_x2"](x)
+    out.sum().backward()
+    np.testing.assert_allclose(np.asarray(x.grad.numpy()), 2.0)
+
+
+def test_amp_list_membership_from_table():
+    from paddle_tpu.amp.auto_cast import WHITE_LIST, BLACK_LIST
+    assert "t_matmul" in WHITE_LIST           # amp="allow"
+    assert "t_exp" in BLACK_LIST              # amp="deny"
+    assert "t_sin" not in WHITE_LIST and "t_sin" not in BLACK_LIST
+
+
+def test_new_op_by_entry_alone():
+    """Registering a spec at runtime yields a working wrapper + testable
+    metadata with no other code."""
+    from paddle_tpu.ops.registry import OpSpec, OpTest, register_op
+    import jax.numpy as jnp
+    fn = register_op(OpSpec(name="t_cube_demo", impl=lambda x: x ** 3,
+                            np_ref=lambda x: x ** 3,
+                            test=OpTest(shapes=((2, 4),), grad=True)))
+    x = np.full((2, 4), 2.0, np.float32)
+    check_output(fn, lambda x: x ** 3, args=[x])
+    check_grad(fn, [x])

@@ -76,3 +76,120 @@ def test_sdpa_dispatch_uses_registry():
                                          paddle.to_tensor(q), is_causal=True)
     ref = _ref_sdpa(jnp.asarray(q), jnp.asarray(q), jnp.asarray(q), True)
     np.testing.assert_allclose(out.numpy(), np.asarray(ref), rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# GQA (native KV-head indexing, VERDICT r2 item #5)
+# ---------------------------------------------------------------------------
+def _ref_sdpa_gqa(q, k, v, causal):
+    rep = q.shape[2] // k.shape[2]
+    if rep > 1:
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    return _ref_sdpa(q, k, v, causal)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("hq,hkv", [(4, 2), (4, 1)])
+def test_flash_attention_gqa_forward(causal, hq, hkv):
+    B, S, D = 2, 128, 64
+    q = jnp.asarray(rng.standard_normal((B, S, hq, D)).astype(np.float32))
+    k = jnp.asarray(rng.standard_normal((B, S, hkv, D)).astype(np.float32))
+    v = jnp.asarray(rng.standard_normal((B, S, hkv, D)).astype(np.float32))
+    out = flash_attention(q, k, v, causal=causal, interpret=True)
+    assert out is not None, "GQA shape must be kernel-supported"
+    ref = _ref_sdpa_gqa(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_gqa_grads(causal):
+    B, S, hq, hkv, D = 1, 128, 4, 2, 64
+    q = jnp.asarray(rng.standard_normal((B, S, hq, D)).astype(np.float32))
+    k = jnp.asarray(rng.standard_normal((B, S, hkv, D)).astype(np.float32))
+    v = jnp.asarray(rng.standard_normal((B, S, hkv, D)).astype(np.float32))
+
+    def loss_fa(q, k, v):
+        return (flash_attention(q, k, v, causal=causal, interpret=True) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        return (_ref_sdpa_gqa(q, k, v, causal) ** 2).sum()
+
+    g_fa = jax.grad(loss_fa, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_fa, g_ref):
+        assert a.shape == b.shape  # dk/dv stay at the KV head count
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+
+
+# ---------------------------------------------------------------------------
+# Fused rms_norm kernel
+# ---------------------------------------------------------------------------
+def test_fused_rms_norm_forward_and_grads():
+    from paddle_tpu.ops.pallas.fused import rms_norm
+    N, H = 32, 256
+    eps = 1e-5
+    x = jnp.asarray(rng.standard_normal((N, H)).astype(np.float32))
+    w = jnp.asarray(rng.standard_normal((H,)).astype(np.float32))
+
+    def ref(x, w):
+        ms = jnp.mean(jnp.square(x.astype(jnp.float32)), -1, keepdims=True)
+        return (x * jax.lax.rsqrt(ms + eps)) * w
+
+    out = rms_norm(x, w, eps=eps, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref(x, w)),
+                               rtol=2e-5, atol=2e-5)
+
+    g_k = jax.grad(lambda x, w: (rms_norm(x, w, eps=eps, interpret=True) ** 2).sum(),
+                   argnums=(0, 1))(x, w)
+    g_r = jax.grad(lambda x, w: (ref(x, w) ** 2).sum(), argnums=(0, 1))(x, w)
+    for a, b in zip(g_k, g_r):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_fused_rms_norm_untileable_returns_none():
+    from paddle_tpu.ops.pallas.fused import rms_norm
+    assert rms_norm(jnp.zeros((4, 100)), jnp.zeros((100,)), interpret=True) is None
+
+
+# ---------------------------------------------------------------------------
+# Fused AdamW kernel
+# ---------------------------------------------------------------------------
+def test_fused_adamw_matches_reference():
+    from paddle_tpu.ops.pallas.fused import adamw_update
+    n = 4 * 4096
+    p = jnp.asarray(rng.standard_normal(n).astype(np.float32)).reshape(16, 1024)
+    g = jnp.asarray(rng.standard_normal(n).astype(np.float32)).reshape(16, 1024)
+    m = jnp.zeros((16, 1024), jnp.float32)
+    v = jnp.zeros((16, 1024), jnp.float32)
+    lr, b1, b2, eps, wd, t = 1e-3, 0.9, 0.999, 1e-8, 0.01, 1
+
+    res = adamw_update(p, g, m, v, lr=lr, beta1=b1, beta2=b2, eps=eps,
+                       weight_decay=wd, step=t, interpret=True)
+    assert res is not None
+    np_, nm, nv = res
+
+    m_ref = b1 * m + (1 - b1) * g
+    v_ref = b2 * v + (1 - b2) * g * g
+    mh = m_ref / (1 - b1 ** t)
+    vh = v_ref / (1 - b2 ** t)
+    p_ref = p - lr * (mh / (jnp.sqrt(vh) + eps) + wd * p)
+    np.testing.assert_allclose(np.asarray(np_), np.asarray(p_ref), rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(nm), np.asarray(m_ref), rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(nv), np.asarray(v_ref), rtol=1e-6, atol=1e-6)
+
+
+def test_fused_adamw_bf16_param_fp32_state():
+    from paddle_tpu.ops.pallas.fused import adamw_update
+    p = jnp.asarray(rng.standard_normal(8192).astype(np.float32)).astype(jnp.bfloat16)
+    g = jnp.asarray(rng.standard_normal(8192).astype(np.float32)).astype(jnp.bfloat16)
+    m = jnp.zeros((8192,), jnp.float32)
+    v = jnp.zeros((8192,), jnp.float32)
+    res = adamw_update(p, g, m, v, lr=1e-3, step=3, interpret=True)
+    assert res is not None
+    np_, nm, nv = res
+    assert np_.dtype == jnp.bfloat16 and nm.dtype == jnp.float32
+    assert bool(jnp.all(jnp.isfinite(nm)))
