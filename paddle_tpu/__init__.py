@@ -59,6 +59,7 @@ from . import incubate  # noqa: F401
 from . import metric  # noqa: F401
 from . import profiler  # noqa: F401
 from . import hapi  # noqa: F401
+from . import inference  # noqa: F401
 from .hapi import Model, summary  # noqa: F401
 from . import static  # noqa: F401
 from . import sparse  # noqa: F401

@@ -157,6 +157,22 @@ def _set_amp_hook(fn):
     _amp_cast_hook[0] = fn
 
 
+# Debug-mode op recorder (subgraph accuracy checker, reference
+# sub_graph_checker.cc): when set, every eager op appends
+# (name, input_values, output_values) — concrete values only.
+_op_recorder = [None]
+
+
+def _record_op(name, vals, outs, impl=None, static_kwargs=None):
+    rec = _op_recorder[0]
+    if rec is None:
+        return
+    if any(isinstance(v, jax.core.Tracer) for v in vals) or \
+       any(isinstance(o, jax.core.Tracer) for o in outs):
+        return  # tracing (inside jit): not an eager execution
+    rec.append((name, tuple(vals), tuple(outs), impl, dict(static_kwargs or {})))
+
+
 def _check_numerics(name, vals):
     import numpy as np
     for v in vals:
@@ -213,6 +229,7 @@ def op_call(name: str, fn: Callable, *args, nondiff: bool = False, **static_kwar
         outs = tuple(out) if multi else (out,)
         if flags.get_flag("check_nan_inf"):
             _check_numerics(name, outs)
+        _record_op(name, vals, outs, impl, static_kwargs)
         wrapped = tuple(Tensor(o, stop_gradient=True) if not isinstance(o, Tensor) else o
                         for o in outs)
         return wrapped if multi else wrapped[0]
@@ -229,6 +246,7 @@ def op_call(name: str, fn: Callable, *args, nondiff: bool = False, **static_kwar
     outs = tuple(out) if multi else (out,)
     if flags.get_flag("check_nan_inf"):
         _check_numerics(name, outs)
+    _record_op(name, vals, outs, impl, static_kwargs)
 
     from .autograd import GradNode
     in_tensors = [args[i] for i in diff_idx]

@@ -3,3 +3,4 @@ from .placement_type import Shard, Replicate, Partial, Placement  # noqa: F401
 from .api import (shard_tensor, reshard, shard_layer, shard_optimizer,  # noqa: F401
                   dtensor_from_fn, unshard_dtensor, is_dist_tensor,
                   shard_dataloader, Strategy, to_static)
+from .static_engine import DistModel, Engine

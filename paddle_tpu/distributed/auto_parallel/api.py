@@ -185,8 +185,9 @@ class _SubCfg:
 
 def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None,
               input_spec=None):
-    """reference api.py:2952 — returns a DistModel-like compiled trainer.
-    Initial implementation delegates to jit.to_static for the forward; the
-    full static Engine lands with the pipeline/schedule pass work."""
-    from ...jit.api import to_static as jit_to_static
-    return jit_to_static(layer)
+    """reference api.py:2952 — returns the compiled DistModel
+    (static_engine.DistModel: one jitted SPMD train step with GSPMD doing
+    the completion/partition/reshard passes)."""
+    from .static_engine import to_static as _ts
+    return _ts(layer, loader=loader, loss=loss, optimizer=optimizer,
+               strategy=strategy, input_spec=input_spec)

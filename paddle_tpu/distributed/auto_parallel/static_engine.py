@@ -1,0 +1,226 @@
+"""Static auto-parallel Engine + DistModel (reference:
+python/paddle/distributed/auto_parallel/static/engine.py:99 Engine,
+auto_parallel/api.py:2254 DistModel / :2952 to_static).
+
+TPU-native collapse: the reference's static pipeline (completion →
+partition → reshard passes over a static Program, then executor runs) is
+GSPMD's job. `DistModel` captures the Layer + loss + optimizer as ONE jitted
+SPMD train step: parameters keep whatever NamedSharding `shard_tensor` /
+`shard_layer` gave them (replicated otherwise), jit's in_shardings pick them
+up, XLA propagates and inserts the collectives, and buffer donation updates
+in place. `Engine` is the fit/evaluate/predict driver over it.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...nn.layer import Layer, functional_state
+
+__all__ = ["DistModel", "Engine", "to_static"]
+
+
+def _coerce(v):
+    if isinstance(v, Tensor):
+        return v._value
+    return jnp.asarray(v)
+
+
+class DistModel:
+    """Compiled SPMD train/eval wrapper (reference DistModel api.py:2254).
+
+    Modes mirror the reference: `train()` → __call__(x, y) runs a train
+    step and returns the loss; `eval()` → returns the loss without update;
+    `predict()` → returns outputs.
+    """
+
+    def __init__(self, layer: Layer, loss=None, optimizer=None,
+                 strategy=None):
+        if optimizer is not None and loss is None:
+            raise ValueError(
+                "DistModel: an optimizer was given without a loss — "
+                "training needs loss(outputs, labels)")
+        self.network = layer
+        self._loss = loss
+        self._opt = optimizer
+        self._mode = "train" if optimizer is not None else "predict"
+        self.params = {n: p._value for n, p in layer.named_parameters()
+                       if not p.stop_gradient}
+        self._frozen = {n: p._value for n, p in layer.named_parameters()
+                        if p.stop_gradient}
+        self.opt_state = optimizer.init_opt_state(self.params) \
+            if optimizer is not None else None
+        self._train_step = None
+        self._eval_step = None
+        self._pred_step = None
+
+    # -- modes --------------------------------------------------------------
+    def train(self):
+        self._mode = "train"
+        self.network.train()
+        return self
+
+    def eval(self):
+        self._mode = "eval"
+        self.network.eval()
+        return self
+
+    def predict(self):
+        self._mode = "predict"
+        self.network.eval()
+        return self
+
+    def dist_main_program(self, mode=None):  # reference API parity
+        return None
+
+    # -- compiled steps -----------------------------------------------------
+    def _loss_of(self, params, x, y):
+        full = dict(params)
+        full.update(self._frozen)
+        with functional_state(self.network, full):
+            out = self.network(Tensor(x))
+        lt = self._loss(out, Tensor(y))
+        return (lt._value if isinstance(lt, Tensor) else lt).astype(jnp.float32)
+
+    def _build_train(self):
+        opt = self._opt
+
+        def step(params, opt_state, lr, x, y):
+            loss, g = jax.value_and_grad(self._loss_of)(params, x, y)
+            new_p, new_o = opt.apply_gradients_functional(
+                params, g, opt_state, lr=lr)
+            return new_p, new_o, loss
+
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    def _build_eval(self):
+        return jax.jit(self._loss_of)
+
+    def _build_pred(self):
+        def fwd(params, x):
+            full = dict(params)
+            full.update(self._frozen)
+            with functional_state(self.network, full):
+                out = self.network(Tensor(x))
+            return jax.tree_util.tree_map(
+                lambda t: t._value if isinstance(t, Tensor) else t, out,
+                is_leaf=lambda t: isinstance(t, Tensor))
+        return jax.jit(fwd)
+
+    def __call__(self, *args):
+        if self._mode == "train":
+            x, y = (_coerce(a) for a in args)
+            if self._train_step is None:
+                self._train_step = self._build_train()
+            lr = jnp.asarray(self._opt.get_lr(), jnp.float32)
+            self.params, self.opt_state, loss = self._train_step(
+                self.params, self.opt_state, lr, x, y)
+            self._opt.finish_step()
+            return Tensor(loss)
+        if self._mode == "eval":
+            x, y = (_coerce(a) for a in args)
+            if self._eval_step is None:
+                self._eval_step = self._build_eval()
+            return Tensor(self._eval_step(self.params, x, y))
+        x = _coerce(args[0])
+        if self._pred_step is None:
+            self._pred_step = self._build_pred()
+        out = self._pred_step(self.params, x)
+        return jax.tree_util.tree_map(Tensor, out)
+
+    # -- state --------------------------------------------------------------
+    def state_dict(self, mode="all"):
+        return dict(self.params)
+
+    def sync_to_network(self):
+        targets = dict(self.network.named_parameters())
+        for n, v in self.params.items():
+            if n in targets:
+                targets[n]._set_value(v)
+        for n, v in self._frozen.items():
+            if n in targets:
+                targets[n]._set_value(v)
+
+
+class Engine:
+    """reference static/engine.py:99 — prepare/fit/evaluate/predict driver."""
+
+    def __init__(self, model, loss=None, optimizer=None, metrics=None,
+                 strategy=None):
+        self.model = model
+        self.loss = loss
+        self.optimizer = optimizer
+        self.metrics = metrics or []
+        self.strategy = strategy
+        self._dist = DistModel(model, loss=loss, optimizer=optimizer,
+                               strategy=strategy)
+        self.history = {"loss": []}
+
+    def prepare(self, inputs_spec=None, labels_spec=None, mode="train"):
+        getattr(self._dist, mode)()
+        return self
+
+    def fit(self, train_data, epochs=1, batch_size=None, steps_per_epoch=None,
+            log_freq=10, verbose=0):
+        self._dist.train()
+        for _ in range(epochs):
+            for step_i, batch in enumerate(train_data):
+                if steps_per_epoch is not None and step_i >= steps_per_epoch:
+                    break
+                x, y = batch
+                loss = self._dist(x, y)
+                self.history["loss"].append(float(loss.numpy()))
+        self._dist.sync_to_network()
+        return self.history
+
+    def evaluate(self, valid_data, steps=None):
+        self._dist.eval()
+        losses = []
+        for i, (x, y) in enumerate(valid_data):
+            if steps is not None and i >= steps:
+                break
+            losses.append(float(self._dist(x, y).numpy()))
+        return {"loss": float(np.mean(losses)) if losses else None}
+
+    def predict(self, test_data, steps=None):
+        self._dist.predict()
+        outs = []
+        for i, batch in enumerate(test_data):
+            if steps is not None and i >= steps:
+                break
+            x = batch[0] if isinstance(batch, (tuple, list)) else batch
+            outs.append(self._dist(x))
+        return outs
+
+    def save(self, path, training=True):
+        from ... import framework
+        self._dist.sync_to_network()
+        state = {n: np.asarray(v) for n, v in self._dist.params.items()}
+        # frozen params/buffers (BN running stats etc.) must round-trip too
+        state.update({n: np.asarray(v)
+                      for n, v in self._dist._frozen.items()})
+        framework.save(state, path + ".pdparams")
+
+    def load(self, path):
+        from ... import framework
+        state = framework.load(path + ".pdparams", return_numpy=True)
+        for n in list(self._dist.params):
+            if n in state:
+                self._dist.params[n] = jnp.asarray(state[n])
+        for n in list(self._dist._frozen):
+            if n in state:
+                self._dist._frozen[n] = jnp.asarray(state[n])
+        self._dist.sync_to_network()
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None,
+              input_spec=None):
+    """reference api.py:2952 — build the compiled DistModel."""
+    dm = DistModel(layer, loss=loss, optimizer=optimizer, strategy=strategy)
+    if optimizer is None:
+        dm.predict()
+    return dm

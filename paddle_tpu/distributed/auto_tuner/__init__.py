@@ -1,0 +1,10 @@
+"""Parallel-config auto-tuner (reference: python/paddle/distributed/
+auto_tuner/tuner.py:21 AutoTuner, search.py:48 GridSearch, prune.py,
+memory_cost_model.py, recorder.py HistoryRecorder)."""
+from .tuner import AutoTuner, TrialResult
+from .search import GridSearch, candidate_configs
+from .prune import prune_by_memory, estimate_bytes_per_device
+from .recorder import HistoryRecorder
+
+__all__ = ["AutoTuner", "TrialResult", "GridSearch", "candidate_configs",
+           "prune_by_memory", "estimate_bytes_per_device", "HistoryRecorder"]

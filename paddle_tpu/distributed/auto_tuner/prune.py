@@ -1,0 +1,45 @@
+"""Memory-model pruning (reference auto_tuner/prune.py +
+memory_cost_model.py): estimate per-device bytes for a candidate config and
+drop candidates that cannot fit before paying for a trial."""
+from __future__ import annotations
+
+__all__ = ["estimate_bytes_per_device", "prune_by_memory"]
+
+
+def estimate_bytes_per_device(cfg, *, n_params, hidden, n_layers, seq_len,
+                              micro_batch_size, param_bytes=4,
+                              grad_bytes=4, opt_bytes=8,
+                              act_bytes_per_token_layer=None):
+    """Coarse analytical model (memory_cost_model.py analog).
+
+    params/grads shard over mp (block weights) and zero-stage>=1 shards
+    optimizer state over dp; stage 2 also grads; stage 3 also params.
+    Activations: micro_batch tokens × layers-resident. remat bounds the
+    resident layer count to 1 block (+ schedule depth under pp).
+    """
+    dp, mp, pp = cfg["dp"], cfg["mp"], cfg["pp"]
+    zs, remat = cfg["zero_stage"], cfg["remat"]
+    shard_model = mp * pp
+    p_local = n_params / shard_model
+    param_b = p_local * param_bytes / (dp if zs >= 3 else 1)
+    grad_b = p_local * grad_bytes / (dp if zs >= 2 else 1)
+    opt_b = p_local * opt_bytes / (dp if zs >= 1 else 1)
+    if act_bytes_per_token_layer is None:
+        # ~20 live fp32 values per token per layer in a transformer block
+        act_bytes_per_token_layer = 20 * hidden * 4
+    layers_resident = (1 if remat else n_layers / pp)
+    depth = min(cfg["n_micro"], 2 * (pp - 1) + 1) if pp > 1 else 1
+    act_b = (micro_batch_size * seq_len * act_bytes_per_token_layer
+             * layers_resident * depth)
+    return param_b + grad_b + opt_b + act_b
+
+
+def prune_by_memory(candidates, hbm_bytes, **model_kw):
+    """Keep candidates whose estimate fits in hbm_bytes (with 10% headroom).
+    Returns (kept, pruned_with_estimates)."""
+    kept, pruned = [], []
+    budget = hbm_bytes * 0.9
+    for c in candidates:
+        est = estimate_bytes_per_device(c, **model_kw)
+        (kept if est <= budget else pruned).append((c, est))
+    return [c for c, _ in kept], pruned

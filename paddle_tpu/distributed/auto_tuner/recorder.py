@@ -1,0 +1,38 @@
+"""Trial history CSV (reference auto_tuner/recorder.py HistoryRecorder)."""
+from __future__ import annotations
+
+import csv
+import os
+
+__all__ = ["HistoryRecorder"]
+
+
+class HistoryRecorder:
+    FIELDS = ["dp", "mp", "pp", "n_micro", "zero_stage", "remat",
+              "status", "time_per_step", "tokens_per_sec", "error"]
+
+    def __init__(self, path=None):
+        self.path = path
+        self.history = []
+
+    def add(self, cfg, status, time_per_step=None, tokens_per_sec=None,
+            error=None):
+        row = dict(cfg)
+        row.update({"status": status, "time_per_step": time_per_step,
+                    "tokens_per_sec": tokens_per_sec, "error": error})
+        self.history.append(row)
+        if self.path:
+            exists = os.path.exists(self.path)
+            d = os.path.dirname(self.path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            with open(self.path, "a", newline="") as f:
+                w = csv.DictWriter(f, fieldnames=self.FIELDS)
+                if not exists:
+                    w.writeheader()
+                w.writerow({k: row.get(k) for k in self.FIELDS})
+
+    def best(self):
+        ok = [r for r in self.history if r["status"] == "ok"
+              and r["tokens_per_sec"]]
+        return max(ok, key=lambda r: r["tokens_per_sec"]) if ok else None

@@ -1,0 +1,59 @@
+"""Search algorithms (reference auto_tuner/search.py:48 GridSearch)."""
+from __future__ import annotations
+
+import itertools
+
+__all__ = ["candidate_configs", "GridSearch"]
+
+
+def _divisors(n):
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def candidate_configs(n_devices, *, n_layers=None, n_heads=None,
+                      global_batch=None, micro_candidates=(1, 2, 4, 8),
+                      zero_stages=(0, 1, 2, 3), remat=(False, True)):
+    """Enumerate {dp, mp, pp, n_micro, zero_stage, remat} with
+    dp*mp*pp == n_devices and basic divisibility constraints
+    (reference search.py's dims construction)."""
+    out = []
+    for dp in _divisors(n_devices):
+        for mp in _divisors(n_devices // dp):
+            pp = n_devices // dp // mp
+            if n_layers is not None and pp > 1 and n_layers % pp != 0:
+                continue
+            if n_heads is not None and mp > 1 and n_heads % mp != 0:
+                continue
+            for n_micro in micro_candidates:
+                if pp > 1 and n_micro < pp:
+                    continue           # pipeline needs >= pp microbatches
+                if global_batch is not None and global_batch % (dp * n_micro):
+                    continue
+                for zs in zero_stages:
+                    if zs > 0 and dp == 1:
+                        continue       # ZeRO shards over dp
+                    if zs > 0 and pp > 1:
+                        continue       # one sharding engine at a time here
+                    for rm in remat:
+                        out.append({"dp": dp, "mp": mp, "pp": pp,
+                                    "n_micro": n_micro, "zero_stage": zs,
+                                    "remat": rm})
+    return out
+
+
+class GridSearch:
+    """Exhaustive walk over the (pruned) candidate list."""
+
+    def __init__(self, candidates):
+        self.candidates = list(candidates)
+        self._i = 0
+
+    def __iter__(self):
+        return iter(self.candidates)
+
+    def next_config(self):
+        if self._i >= len(self.candidates):
+            return None
+        c = self.candidates[self._i]
+        self._i += 1
+        return c

@@ -1,0 +1,148 @@
+"""AutoTuner (reference auto_tuner/tuner.py:21): search the parallel-config
+space with memory pruning + short timed trials, record history, return the
+best config.
+
+TPU-native: a trial builds the candidate's compiled train step on the
+available device mesh (virtual CPU mesh in tests — the reference launches
+subprocess trial jobs; one-process mesh trials are the XLA analog) and
+times a few steps.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+from .search import GridSearch, candidate_configs
+from .prune import prune_by_memory
+from .recorder import HistoryRecorder
+
+__all__ = ["AutoTuner", "TrialResult"]
+
+
+@dataclasses.dataclass
+class TrialResult:
+    config: dict
+    time_per_step: float
+    tokens_per_sec: float
+
+
+class AutoTuner:
+    def __init__(self, model_config=None, *, n_devices=None, global_batch=8,
+                 seq_len=16, history_csv: Optional[str] = None,
+                 hbm_bytes: Optional[int] = None,
+                 trial_fn: Optional[Callable] = None):
+        """model_config: LlamaConfig for the built-in llama trial runner, or
+        pass trial_fn(cfg, global_batch, seq_len, steps=, warmup=) ->
+        seconds_per_step (keyword args steps/warmup are always passed)."""
+        import jax
+        self.model_config = model_config
+        self.n_devices = n_devices or jax.device_count()
+        self.global_batch = global_batch
+        self.seq_len = seq_len
+        self.recorder = HistoryRecorder(history_csv)
+        self.hbm_bytes = hbm_bytes
+        self.trial_fn = trial_fn or self._llama_trial
+
+    # -- candidate generation + pruning ------------------------------------
+    def candidates(self, **kw):
+        c = self.model_config
+        cands = candidate_configs(
+            self.n_devices,
+            n_layers=getattr(c, "num_hidden_layers", None),
+            n_heads=getattr(c, "num_attention_heads", None),
+            global_batch=self.global_batch, **kw)
+        if self.hbm_bytes and c is not None:
+            n_params = (c.vocab_size * c.hidden_size * 2
+                        + c.num_hidden_layers
+                        * (4 * c.hidden_size ** 2
+                           + 3 * c.hidden_size * c.intermediate_size))
+            cands, _ = prune_by_memory(
+                cands, self.hbm_bytes, n_params=n_params,
+                hidden=c.hidden_size, n_layers=c.num_hidden_layers,
+                seq_len=self.seq_len,
+                micro_batch_size=max(1, self.global_batch
+                                     // max(1, self.n_devices)))
+        return cands
+
+    # -- the built-in llama trial ------------------------------------------
+    def _llama_trial(self, cfg, global_batch, seq_len, steps=3, warmup=1):
+        import jax
+        import jax.numpy as jnp
+        from ...models.llama import build_functional_llama, llama_microbatch_fns, \
+            llama_block_specs
+        from ...parallel.pipeline_schedules import Pipeline1F1BTrainStep
+        from ...parallel.sharded import ShardedTrainStep
+        from ..topology import build_mesh
+        from ... import optimizer
+
+        c = self.model_config
+        devs = jax.devices()[: self.n_devices]
+        rng = np.random.default_rng(0)
+        ids = jnp.asarray(rng.integers(0, c.vocab_size,
+                                       (global_batch, seq_len)).astype(np.int32))
+        batch = (ids, ids)
+        opt = optimizer.AdamW(learning_rate=1e-3, parameters=[])
+
+        if cfg["pp"] > 1 or cfg["mp"] > 1:
+            axes = {k: v for k, v in (("dp", cfg["dp"]), ("pp", cfg["pp"]),
+                                      ("mp", cfg["mp"])) if v > 1 or k == "pp"}
+            axes.setdefault("dp", cfg["dp"])
+            mesh = build_mesh(axes, devices=devs)
+            mp_axis = "mp" if cfg["mp"] > 1 else None
+            ep, bp, hp, *_ = build_functional_llama(c, n_micro=cfg["n_micro"],
+                                                    mp_axis=mp_axis)
+            ea, ba, hl = llama_microbatch_fns(c, mp_axis=mp_axis)
+            specs = llama_block_specs("mp") if mp_axis else None
+            step = Pipeline1F1BTrainStep(
+                mesh, ea, ba, hl, ep, bp, hp, opt, n_micro=cfg["n_micro"],
+                block_specs=specs, remat_stage=cfg["remat"])
+        else:
+            mesh = build_mesh({"dp": cfg["dp"]}, devices=devs[: cfg["dp"]])
+            ep, bp, hp, ea, ba, hl = build_functional_llama(c, n_micro=1)
+
+            def loss_fn(params, batch):
+                ep_, bp_, hp_ = params
+                x = ea(ep_, batch)[0]
+                bfn = jax.checkpoint(ba) if cfg["remat"] else ba
+                def body(a, lp):
+                    return bfn(lp, a), None
+                x, _ = jax.lax.scan(body, x, bp_)
+                return hl(hp_, x[None], batch)
+
+            step = ShardedTrainStep(mesh, loss_fn, (ep, bp, hp), opt,
+                                    stage=max(cfg["zero_stage"], 0), axis="dp")
+
+        for _ in range(warmup):
+            loss = step(batch)
+        jax.block_until_ready(loss._value if hasattr(loss, "_value") else loss)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = step(batch)
+        jax.block_until_ready(loss._value if hasattr(loss, "_value") else loss)
+        return (time.perf_counter() - t0) / steps
+
+    # -- main loop ----------------------------------------------------------
+    def tune(self, max_trials=None, steps=3, warmup=1, **cand_kw):
+        """Run trials over the (pruned) grid; returns the best TrialResult."""
+        cands = self.candidates(**cand_kw)
+        if max_trials:
+            cands = cands[:max_trials]
+        search = GridSearch(cands)
+        best = None
+        for cfg in search:
+            try:
+                tps_step = self.trial_fn(cfg, self.global_batch, self.seq_len,
+                                         steps=steps, warmup=warmup)
+                tokens = self.global_batch * self.seq_len / tps_step
+                self.recorder.add(cfg, "ok", time_per_step=tps_step,
+                                  tokens_per_sec=tokens)
+                if best is None or tokens > best.tokens_per_sec:
+                    best = TrialResult(cfg, tps_step, tokens)
+            except Exception as e:  # noqa: BLE001 — a failing candidate is
+                # data (OOM/invalid), not a tuner crash (reference prune-on-
+                # fail semantics)
+                self.recorder.add(cfg, "fail", error=f"{type(e).__name__}: {e}")
+        return best

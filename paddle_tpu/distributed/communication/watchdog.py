@@ -1,0 +1,101 @@
+"""Collective watchdog + comm sanitizers (reference: paddle/phi/core/
+distributed/comm_task_manager.h:37 CommTaskManager, IsTimeout :57, and the
+comm NaN check in distributed/check/).
+
+TPU-native: XLA collectives cannot hang mid-kernel the way a NCCL ring can,
+but a *peer failure* (dead host in the multi-controller gang, stuck DCN
+link) surfaces as an eager collective's result never becoming ready. The
+watchdog waits for readiness on a worker thread with a deadline and raises
+`CommTimeoutError` instead of blocking forever — the heartbeat-on-
+coordination-service analog. `check_comm_result` is the comm NaN/Inf
+sanitizer, gated by FLAGS_check_comm_nan.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import numpy as np
+
+from ... import flags
+
+__all__ = ["CommTimeoutError", "CommTaskManager", "wait_with_timeout",
+           "check_comm_result", "get_comm_task_manager"]
+
+
+class CommTimeoutError(RuntimeError):
+    pass
+
+
+def wait_with_timeout(value, timeout: float, op_name: str = "collective"):
+    """Block until `value` is ready, at most `timeout` seconds."""
+    done = threading.Event()
+    err = []
+
+    def waiter():
+        try:
+            jax.block_until_ready(value)
+        except Exception as e:  # noqa: BLE001 — surfaced to the caller below
+            err.append(e)
+        finally:
+            done.set()
+
+    t = threading.Thread(target=waiter, daemon=True)
+    t.start()
+    if not done.wait(timeout):
+        raise CommTimeoutError(
+            f"{op_name} not ready after {timeout:.1f}s — peer failure or "
+            f"hung link (reference comm_task_manager.h IsTimeout)")
+    if err:
+        raise err[0]
+    return value
+
+
+def check_comm_result(value, op_name: str = "collective"):
+    """NaN/Inf scan on a finished collective result (reference
+    distributed/check/). Active when FLAGS_check_comm_nan is set."""
+    if not flags.get_flag("check_comm_nan"):
+        return value
+    arr = np.asarray(value)
+    if np.issubdtype(arr.dtype, np.inexact) and not np.all(np.isfinite(arr)):
+        raise FloatingPointError(f"NaN/Inf in result of {op_name}")
+    return value
+
+
+class CommTaskManager:
+    """Tracks in-flight eager collectives (reference comm_task_manager.h:37).
+
+    `track(op_name, value)` registers a result; `wait_all(timeout)` asserts
+    every tracked result lands within the deadline, clearing the set."""
+
+    def __init__(self, default_timeout: float = None):
+        self.default_timeout = default_timeout or float(
+            flags.get_flag("comm_timeout_seconds") or 1800.0)
+        self._lock = threading.Lock()
+        self._tasks = []
+
+    def track(self, op_name, value):
+        with self._lock:
+            self._tasks.append((op_name, value))
+        return value
+
+    def pending(self):
+        with self._lock:
+            return len(self._tasks)
+
+    def wait_all(self, timeout: float = None):
+        timeout = timeout or self.default_timeout
+        with self._lock:
+            tasks, self._tasks = self._tasks, []
+        for name, v in tasks:
+            wait_with_timeout(v, timeout, name)
+            check_comm_result(v, name)
+
+
+_manager = [None]
+
+
+def get_comm_task_manager() -> CommTaskManager:
+    if _manager[0] is None:
+        _manager[0] = CommTaskManager()
+    return _manager[0]

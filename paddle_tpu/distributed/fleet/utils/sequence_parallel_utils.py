@@ -22,6 +22,7 @@ from ..meta_parallel.mp_layers import mp_axis_in_scope, constrain, shard_param
 __all__ = ["ScatterOp", "GatherOp", "AllGatherOp", "ReduceScatterOp",
            "mark_as_sequence_parallel_parameter",
            "ColumnSequenceParallelLinear", "RowSequenceParallelLinear",
+           "SPInnerOverlapLinear",
            "create_fused_allreduce_gradient_hooks"]
 
 
@@ -105,6 +106,71 @@ class RowSequenceParallelLinear(Layer):
         if self.bias is not None:
             out = out + self.bias
         return out
+
+
+def _ring_allgather_matmul(x_local, w, axis="mp"):
+    """Overlapped sequence all-gather × column matmul: each ring step
+    matmuls the sequence chunk it holds while ppermuting the next chunk in —
+    the TPU analog of SPInnerOverlapLinear's chunked comm/compute pipeline
+    (reference :257); XLA's latency-hiding scheduler overlaps the ppermute
+    with the dot.
+
+    x_local: [S_local, ...rest, H_in]; w: [H_in, out_local].
+    Returns [S_global, ...rest, out_local] (sequence-major).
+    """
+    n = jax.lax.psum(1, axis)
+    r = jax.lax.axis_index(axis)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    S_l = x_local.shape[0]
+
+    def body(i, carry):
+        chunk, out = carry
+        part = jnp.tensordot(chunk, w, axes=([-1], [0]))
+        # chunk i arrived from rank (r - i) mod n → its global offset
+        src = jnp.mod(r - i, n)
+        out = jax.lax.dynamic_update_slice_in_dim(out, part, src * S_l, 0)
+        chunk = jax.lax.ppermute(chunk, axis, perm)
+        return chunk, out
+
+    out0 = jnp.zeros((S_l * n,) + x_local.shape[1:-1] + (w.shape[-1],),
+                     x_local.dtype)
+    # align vma types: the zeros carry must be mp-varying like the chunks
+    from ....parallel.pipeline_schedules import _vary
+    out0 = _vary(out0, ("mp",))
+    x_local = _vary(x_local, ("mp",))
+    _, out = jax.lax.fori_loop(0, n, body, (x_local, out0))
+    return out
+
+
+class SPInnerOverlapLinear(Layer):
+    """ColumnSequenceParallelLinear with comm/compute overlap (reference
+    SPInnerOverlapLinear :257): the sequence all-gather is decomposed into a
+    ppermute ring whose chunks matmul as they arrive."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=None, fuse_matmul_bias=False, mp_group=None,
+                 name=None):
+        super().__init__()
+        self.weight = self.create_parameter((in_features, out_features),
+                                            attr=weight_attr)
+        self.bias = self.create_parameter((out_features,), is_bias=True) \
+            if has_bias in (True, None) else None
+        shard_param(self.weight, (None, "mp"))
+
+    def forward(self, x):
+        if mp_axis_in_scope("mp"):
+            def impl(v, w, *b):
+                out = _ring_allgather_matmul(v, w, "mp")
+                if b:
+                    out = out + b[0]
+                return out
+            args = [x, self.weight] + ([self.bias] if self.bias is not None
+                                       else [])
+            return op_call("sp_overlap_linear", impl, *args)
+        # GSPMD mode: constraints; XLA fuses + overlaps the all-gather itself
+        full = AllGatherOp.apply(x, axis=0)
+        out = F_nn.linear(full, self.weight, self.bias)
+        return constrain(out, *([None] * (out.ndim - 1)), "mp")
 
 
 def create_fused_allreduce_gradient_hooks(model, accumulation_steps=1):

@@ -111,4 +111,8 @@ define_flag("amp_dtype", "bfloat16", "Default AMP low-precision dtype on TPU.", 
 define_flag("allocator_strategy", "xla", "Informational: HBM is managed by XLA.", type=str,
             )
 define_flag("embedding_deterministic", False, "Deterministic embedding grad scatter.", type=bool)
+define_flag("check_comm_nan", False, "NaN/Inf-scan finished collective results "
+            "(reference phi/core/distributed/check/).", type=bool)
+define_flag("comm_timeout_seconds", 1800.0, "Watchdog deadline for eager collective "
+            "readiness (reference comm_task_manager.h:57 IsTimeout).", type=float)
 define_flag("cudnn_deterministic", False, "Accepted for reference compat; no-op on TPU.", type=bool)

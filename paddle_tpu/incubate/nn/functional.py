@@ -94,9 +94,74 @@ def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train", name=
     return dropout(x, p, training=training, mode=mode) + y
 
 
-def masked_multihead_attention(x, cache_kv=None, src_mask=None, **kw):
-    raise NotImplementedError("masked_multihead_attention lands with the "
-                              "serving-decode path (KV-cache attention kernel)")
+def masked_multihead_attention(x, cache_kv=None, src_mask=None, bias=None,
+                               sequence_lengths=None, rotary_tensor=None,
+                               beam_cache_offset=None, seq_len=1,
+                               rotary_emb_dims=0, use_neox_rotary_style=False,
+                               compute_dtype="default", out_scale=-1,
+                               quant_round_type=1, quant_max_bound=127.0,
+                               quant_min_bound=-127.0):
+    """Decode-phase attention with KV cache (reference
+    incubate/nn/functional/masked_multihead_attention.py backed by
+    masked_multihead_attention_kernel.cu).
+
+    x: [B, 3*H*D] fused qkv for ONE new token per sequence.
+    cache_kv: [2, B, H, max_seq, D]; sequence_lengths: int32 [B, 1] — the
+    number of cached tokens per sequence (the new token is written there).
+    bias: optional fused qkv bias [3*H*D]. Returns (out [B, H*D], updated
+    cache_kv) like the reference.
+    """
+    import math as _math
+
+    if rotary_tensor is not None or rotary_emb_dims:
+        raise NotImplementedError(
+            "masked_multihead_attention: in-kernel rotary embedding is not "
+            "implemented — apply RoPE to q/k before the call (see "
+            "models/llama.py build_llama_decode) or use "
+            "fused_rotary_position_embedding")
+    if beam_cache_offset is not None:
+        raise NotImplementedError(
+            "masked_multihead_attention: beam search cache offsets are not "
+            "implemented")
+    if bias is not None:
+        x = x + bias
+
+    def impl(xv, cache, *rest):
+        seq_lens = None
+        mask = None
+        ri = 0
+        if sequence_lengths is not None:
+            seq_lens = rest[ri]; ri += 1
+        if src_mask is not None:
+            mask = rest[ri]; ri += 1
+        two, B, H, S_max, D = cache.shape
+        qkv = xv.reshape(B, 3, H, D)
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]       # [B, H, D]
+        if seq_lens is None:
+            pos = jnp.zeros((B,), jnp.int32)
+        else:
+            pos = seq_lens.reshape(B).astype(jnp.int32)
+        # write k/v at each sequence's position
+        bidx = jnp.arange(B)
+        cache = cache.at[0, bidx, :, pos, :].set(k)
+        cache = cache.at[1, bidx, :, pos, :].set(v)
+        kc, vc = cache[0], cache[1]                      # [B, H, S, D]
+        s = jnp.einsum("bhd,bhsd->bhs", q.astype(jnp.float32),
+                       kc.astype(jnp.float32)) / _math.sqrt(D)
+        valid = jnp.arange(S_max)[None, :] <= pos[:, None]   # [B, S]
+        s = jnp.where(valid[:, None, :], s, -jnp.inf)
+        if mask is not None:
+            s = s + mask.reshape(B, 1, -1)[..., :S_max].astype(jnp.float32)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhs,bhsd->bhd", p.astype(vc.dtype), vc)
+        return o.reshape(B, H * D), cache
+
+    args = [x, cache_kv]
+    if sequence_lengths is not None:
+        args.append(sequence_lengths)
+    if src_mask is not None:
+        args.append(src_mask)
+    return op_call("masked_multihead_attention", impl, *args)
 
 
 def variable_length_memory_efficient_attention(query, key, value, seq_lens=None,

@@ -1,0 +1,138 @@
+"""Inference deployment API (reference: paddle/fluid/inference/api/
+analysis_predictor.h:101 AnalysisPredictor, paddle_inference_api.h Config).
+
+TPU-native design: the "inference program + optimization passes + executor"
+stack collapses into the StableHLO artifact `paddle_tpu.jit.save` exports
+(XLA is the optimizer + executor). `Predictor` is the serving-facing
+wrapper: named input/output handles, copy-in/run/copy-out semantics, and an
+AOT-compiled callable cached per input signature.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["Config", "Predictor", "create_predictor", "PredictorHandle"]
+
+
+class Config:
+    """reference paddle.inference.Config — model path + runtime knobs.
+    Device/memory knobs are accepted for API parity; XLA owns both."""
+
+    def __init__(self, prog_file=None, params_file=None):
+        # paddle convention: Config("model_dir/inference") prefix, or
+        # (prog_file, params_file) pair whose common prefix is the artifact
+        self._prefix = None
+        if prog_file is not None:
+            p = str(prog_file)
+            for suf in (".pdmodel.stablehlo", ".pdmodel", ".pdiparams"):
+                if p.endswith(suf):
+                    p = p[: -len(suf)]
+                    break
+            self._prefix = p
+        self._enable_memory_optim = True
+        self._device = "tpu"
+
+    def set_prog_file(self, path):
+        p = str(path)
+        for suf in (".pdmodel.stablehlo", ".pdmodel", ".pdiparams"):
+            if p.endswith(suf):
+                p = p[: -len(suf)]
+                break
+        self._prefix = p
+
+    def prog_file(self):
+        return self._prefix + ".pdmodel"
+
+    def enable_use_gpu(self, *a, **k):  # accepted for parity; device is TPU
+        self._device = "gpu_requested(tpu)"
+
+    def enable_memory_optim(self, flag=True):
+        self._enable_memory_optim = flag
+
+    def disable_glog_info(self):
+        pass
+
+    def switch_ir_optim(self, flag=True):
+        pass  # XLA pass pipeline always on
+
+
+class PredictorHandle:
+    """Input/output tensor handle (reference ZeroCopyTensor)."""
+
+    def __init__(self, name):
+        self.name = name
+        self._value = None
+
+    def copy_from_cpu(self, arr):
+        self._value = np.asarray(arr)
+
+    def reshape(self, shape):
+        if self._value is not None:
+            self._value = self._value.reshape(shape)
+
+    def copy_to_cpu(self):
+        return np.asarray(self._value)
+
+    def shape(self):
+        return list(self._value.shape) if self._value is not None else None
+
+
+class Predictor:
+    """reference AnalysisPredictor: named handles + Run().
+
+    Wraps a `jit.load`-ed TranslatedLayer (StableHLO artifact). Input names
+    come from the export metadata when present, else positional `x0, x1...`.
+    """
+
+    def __init__(self, config: Config):
+        from ..jit.save_load import load as jit_load
+        if config._prefix is None:
+            raise ValueError("Config has no model path")
+        self._layer = jit_load(config._prefix)
+        meta = getattr(self._layer, "_meta", {}) or {}
+        names = meta.get("input_names")
+        if not names:
+            n_in = meta.get("n_inputs", 1)
+            names = [f"x{i}" for i in range(n_in)]
+        self._input_names = list(names)
+        self._inputs = {n: PredictorHandle(n) for n in self._input_names}
+        self._outputs = []
+
+    def get_input_names(self):
+        return list(self._input_names)
+
+    def get_input_handle(self, name):
+        return self._inputs[name]
+
+    def run(self, inputs=None):
+        """Run; `inputs` may be a list of numpy arrays (positional) for the
+        one-shot convenience form, else use the copy_from_cpu handles."""
+        if inputs is not None:
+            for n, a in zip(self._input_names, inputs):
+                self._inputs[n].copy_from_cpu(a)
+        args = [self._inputs[n]._value for n in self._input_names]
+        if any(a is None for a in args):
+            missing = [n for n in self._input_names
+                       if self._inputs[n]._value is None]
+            raise ValueError(f"inputs not set: {missing}")
+        out = self._layer(*args)
+        outs = out if isinstance(out, (tuple, list)) else [out]
+        self._outputs = []
+        for i, o in enumerate(outs):
+            h = PredictorHandle(f"out{i}")
+            h._value = np.asarray(o.numpy() if hasattr(o, "numpy") else o)
+            self._outputs.append(h)
+        return [h._value for h in self._outputs]
+
+    def get_output_names(self):
+        return [h.name for h in self._outputs] or ["out0"]
+
+    def get_output_handle(self, name):
+        for h in self._outputs:
+            if h.name == name:
+                return h
+        raise KeyError(name)
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

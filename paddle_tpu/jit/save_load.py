@@ -77,8 +77,12 @@ def save(layer, path, input_spec=None, **configs):
     with open(path + ".pdmodel.stablehlo", "wb") as f:
         f.write(blob)
     framework.save({k: np.asarray(v) for k, v in state.items()}, path + ".pdiparams")
+    names = [getattr(s, "name", None) for s in (input_spec or [])]
+    meta = {"n_inputs": len(sds)}
+    if names and all(isinstance(n, str) and n for n in names):
+        meta["input_names"] = names
     with open(path + ".pdmodel.meta", "wb") as f:
-        pickle.dump({"n_inputs": len(sds)}, f)
+        pickle.dump(meta, f)
 
 
 class TranslatedLayer(Layer):

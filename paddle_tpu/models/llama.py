@@ -26,7 +26,8 @@ from ..incubate.nn.functional import fused_rotary_position_embedding
 
 __all__ = ["LlamaConfig", "LlamaForCausalLM", "LlamaModel", "LlamaDecoderLayer",
            "build_functional_llama", "llama_microbatch_fns", "llama_block_specs",
-           "llama_config_7b", "llama_config_tiny"]
+           "llama_config_7b", "llama_config_tiny", "build_llama_decode",
+           "functional_params_from_layer"]
 
 
 @dataclass
@@ -370,3 +371,137 @@ def build_functional_llama(config: LlamaConfig, key=None, dtype=None,
         return jnp.mean(nll)
 
     return embed_params, block_params, head_params, embed_apply, block_apply, head_loss_apply
+
+
+# ---------------------------------------------------------------------------
+# Serving decode path (KV cache)
+# ---------------------------------------------------------------------------
+def build_llama_decode(config: LlamaConfig, max_seq: int = None, dtype=None):
+    """Compiled autoregressive serving path (reference: the fused decode
+    attention masked_multihead_attention_kernel.cu + Predictor decode loop).
+
+    Returns (init_cache, prefill, decode_step) over the same
+    (embed_params, block_params, head_params) pytrees build_functional_llama
+    produces:
+
+      cache = init_cache(B)                      # {"k","v" [L,B,S,KV,D], "pos"}
+      logits, cache = prefill(params, ids)       # prompt pass, fills cache
+      logits, cache = decode_step(params, tok, cache)   # one token, O(S) attn
+
+    All shapes static (max_seq bounds the cache); jit decode_step once and
+    every generated token reuses the executable.
+    """
+    c = config
+    d = jnp.dtype(dtype) if dtype is not None else jnp.float32
+    S_max = max_seq or c.max_position_embeddings
+    head_dim = c.hidden_size // c.num_attention_heads
+    L = c.num_hidden_layers
+    nkv = c.num_key_value_heads
+    sin_t, cos_t = _rope_tables(S_max, head_dim, c.rope_theta, d)
+
+    from ..nn.functional.norm import rms_norm_ref
+
+    def init_cache(batch):
+        return {
+            "k": jnp.zeros((L, batch, S_max, nkv, head_dim), d),
+            "v": jnp.zeros((L, batch, S_max, nkv, head_dim), d),
+            "pos": jnp.zeros((), jnp.int32),
+        }
+
+    def _block_step(lp, x, k_cache, v_cache, pos, n_valid):
+        """One decoder block on x [B, T, H] with cache write at pos and
+        attention over cache[:, :n_valid]. Returns (x_out, k_cache, v_cache)."""
+        B, T, H = x.shape
+        nh = c.num_attention_heads
+        h = rms_norm_ref(x, lp["ln1"], c.rms_norm_eps)
+        q = (h @ lp["wq"]).reshape(B, T, nh, head_dim)
+        k = (h @ lp["wk"]).reshape(B, T, nkv, head_dim)
+        v = (h @ lp["wv"]).reshape(B, T, nkv, head_dim)
+        sin = jax.lax.dynamic_slice_in_dim(sin_t, pos, T, 0) \
+            if isinstance(pos, jnp.ndarray) or pos != 0 else sin_t[:T]
+        cos = jax.lax.dynamic_slice_in_dim(cos_t, pos, T, 0) \
+            if isinstance(pos, jnp.ndarray) or pos != 0 else cos_t[:T]
+        q = _apply_rope(q, sin, cos)
+        k = _apply_rope(k, sin, cos)
+        k_cache = jax.lax.dynamic_update_slice_in_dim(k_cache, k, pos, 1)
+        v_cache = jax.lax.dynamic_update_slice_in_dim(v_cache, v, pos, 1)
+        rep = nh // nkv
+        kf = jnp.repeat(k_cache, rep, axis=2) if rep > 1 else k_cache
+        vf = jnp.repeat(v_cache, rep, axis=2) if rep > 1 else v_cache
+        s = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32),
+                       kf.astype(jnp.float32)) / math.sqrt(head_dim)
+        q_pos = pos + jnp.arange(T)[None, :, None]          # [1, T, 1]
+        k_pos = jnp.arange(S_max)[None, None, :]            # [1, 1, S]
+        mask = (k_pos <= q_pos) & (k_pos < n_valid)
+        s = jnp.where(mask[:, None, :, :], s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1).astype(x.dtype)
+        o = jnp.einsum("bhts,bshd->bthd", p, vf).reshape(B, T, nh * head_dim)
+        x = x + o @ lp["wo"]
+        h = rms_norm_ref(x, lp["ln2"], c.rms_norm_eps)
+        ff = jax.nn.silu(h @ lp["wgate"]) * (h @ lp["wup"])
+        return x + ff @ lp["wdown"], k_cache, v_cache
+
+    def _head(hp, x_last):
+        h = rms_norm_ref(x_last, hp["ln_f"], c.rms_norm_eps)
+        return (h @ hp["lm"]).astype(jnp.float32)
+
+    def prefill(params, ids):
+        """ids [B, T_prompt] -> (logits [B, vocab] for the last token, cache)."""
+        ep, bp, hp = params
+        B, T = ids.shape
+        cache = init_cache(B)
+        x = ep["tok"][ids].astype(d)
+
+        def body(carry, layer_in):
+            xc, = carry
+            lp, kc, vc = layer_in
+            x_out, kc, vc = _block_step(lp, xc, kc, vc, 0, T)
+            return (x_out,), (kc, vc)
+
+        (x,), (ks, vs) = jax.lax.scan(
+            body, (x,), (bp, cache["k"], cache["v"]))
+        cache = {"k": ks, "v": vs, "pos": jnp.asarray(T, jnp.int32)}
+        return _head(hp, x[:, -1]), cache
+
+    def decode_step(params, tok, cache):
+        """tok [B] int32 -> (logits [B, vocab], cache advanced by one)."""
+        ep, bp, hp = params
+        B = tok.shape[0]
+        pos = cache["pos"]
+        x = ep["tok"][tok][:, None, :].astype(d)       # [B, 1, H]
+
+        def body(carry, layer_in):
+            xc, = carry
+            lp, kc, vc = layer_in
+            x_out, kc, vc = _block_step(lp, xc, kc, vc, pos, pos + 1)
+            return (x_out,), (kc, vc)
+
+        (x,), (ks, vs) = jax.lax.scan(
+            body, (x,), (bp, cache["k"], cache["v"]))
+        cache = {"k": ks, "v": vs, "pos": pos + 1}
+        return _head(hp, x[:, -1]), cache
+
+    return init_cache, prefill, decode_step
+
+
+def functional_params_from_layer(model: "LlamaForCausalLM"):
+    """Stack an eager LlamaForCausalLM's per-layer weights into the
+    (embed, block, head) pytrees the functional/decode paths consume.
+    Requires tensor_parallel_degree == 1 (full weights on this host)."""
+    m = model.model
+    def val(p):
+        return p._value
+    bp = {
+        "ln1": jnp.stack([val(l.input_layernorm.weight) for l in m.layers]),
+        "wq": jnp.stack([val(l.self_attn.q_proj.weight) for l in m.layers]),
+        "wk": jnp.stack([val(l.self_attn.k_proj.weight) for l in m.layers]),
+        "wv": jnp.stack([val(l.self_attn.v_proj.weight) for l in m.layers]),
+        "wo": jnp.stack([val(l.self_attn.o_proj.weight) for l in m.layers]),
+        "ln2": jnp.stack([val(l.post_attention_layernorm.weight) for l in m.layers]),
+        "wgate": jnp.stack([val(l.mlp.gate_proj.weight) for l in m.layers]),
+        "wup": jnp.stack([val(l.mlp.up_proj.weight) for l in m.layers]),
+        "wdown": jnp.stack([val(l.mlp.down_proj.weight) for l in m.layers]),
+    }
+    ep = {"tok": val(m.embed_tokens.weight)}
+    hp = {"ln_f": val(m.norm.weight), "lm": val(model.lm_head.weight)}
+    return ep, bp, hp

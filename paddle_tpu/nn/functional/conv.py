@@ -62,11 +62,13 @@ def _conv(x, weight, bias, stride, padding, dilation, groups, n, data_format):
             # lax wants e.g. HWIO for NHWC
             perm = tuple(range(2, 2 + n)) + (1, 0)
             w = jnp.transpose(w, perm)
+        # native dtype: the MXU accumulates bf16 convs in fp32 already, and
+        # preferred_element_type=f32 breaks the conv transpose rule (mixed
+        # f32 cotangent × bf16 operand) under autodiff
         out = jax.lax.conv_general_dilated(
             v, w, window_strides=strides, padding=pad,
             rhs_dilation=dil, dimension_numbers=dn,
-            feature_group_count=groups,
-            preferred_element_type=jnp.float32 if v.dtype == jnp.bfloat16 else None)
+            feature_group_count=groups)
         out = out.astype(v.dtype)
         if rest:
             b = rest[0]

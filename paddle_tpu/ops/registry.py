@@ -58,6 +58,7 @@ class OpSpec:
 
 
 _REGISTRY: Dict[str, OpSpec] = {}
+_WRAPPERS: Dict[str, Callable] = {}
 
 
 def register_op(spec: OpSpec) -> Callable:
@@ -79,6 +80,7 @@ def register_op(spec: OpSpec) -> Callable:
     wrapper.__qualname__ = spec.name
     wrapper.__doc__ = spec.doc or f"{spec.name} (registry-generated wrapper)"
     wrapper.__op_spec__ = spec
+    _WRAPPERS[spec.name] = wrapper
 
     if spec.amp in ("allow", "deny"):
         from ..amp.auto_cast import WHITE_LIST, BLACK_LIST
@@ -95,12 +97,5 @@ def all_specs() -> List[OpSpec]:
 
 
 def api(name: str) -> Callable:
-    """Fetch the generated wrapper for a registered op."""
-    spec = _REGISTRY[name]
-
-    def wrapper(*args, name=None, **kwargs):
-        return op_call(spec.name, spec.impl, *args, nondiff=spec.nondiff,
-                       **kwargs)
-    wrapper.__name__ = spec.name
-    wrapper.__op_spec__ = spec
-    return wrapper
+    """Fetch the canonical wrapper register_op generated."""
+    return _WRAPPERS[name]

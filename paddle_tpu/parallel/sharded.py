@@ -39,16 +39,17 @@ __all__ = ["ShardedTrainStep", "zero_stage_name"]
 
 
 def zero_stage_name(stage) -> int:
-    """Normalize Paddle level strings ('os', 'os_g', 'p_g_os') to 1/2/3."""
-    if stage in (1, 2, 3):
+    """Normalize Paddle level strings ('os', 'os_g', 'p_g_os') to 0/1/2/3
+    (0 = plain data parallel, nothing sharded)."""
+    if stage in (0, 1, 2, 3):
         return int(stage)
     table = {"os": 1, "os_g": 2, "p_g_os": 3,
-             "stage1": 1, "stage2": 2, "stage3": 3,
-             "1": 1, "2": 2, "3": 3}
+             "stage1": 1, "stage2": 2, "stage3": 3, "none": 0,
+             "0": 0, "1": 1, "2": 2, "3": 3}
     key = str(stage)
     if key not in table:
         raise ValueError(
-            f"unknown ZeRO stage {stage!r}; expected one of 1/2/3 or "
+            f"unknown ZeRO stage {stage!r}; expected one of 0/1/2/3 or "
             f"{sorted(table)}")
     return table[key]
 
@@ -62,13 +63,20 @@ class ShardedTrainStep:
 
     def __init__(self, mesh: Mesh, loss_fn: Callable, params: Any, opt,
                  stage=2, axis: str = "dp", remat: bool = False,
-                 clip_norm: Optional[float] = None, donate: bool = True):
+                 clip_norm: Optional[float] = None, donate: bool = True,
+                 bucket: bool = False):
+        """bucket=True fuses all same-dtype leaves into ONE contiguous flat
+        buffer (the group_sharded_storage.py fused-storage analog): the
+        grad reduce-scatter and param all-gather become one collective per
+        dtype group instead of one per leaf — the collective-launch-overhead
+        fix for models with hundreds of leaves."""
         self.mesh = mesh
         self.axis = axis
         self.stage = zero_stage_name(stage)
         self.opt = opt
         self.remat = remat
         self.clip_norm = clip_norm
+        self.bucket = bucket
         n = mesh.shape[axis]
         self.n_shards = n
 
@@ -90,8 +98,29 @@ class ShardedTrainStep:
                 f = jnp.concatenate([f, jnp.zeros(pad - f.size, f.dtype)])
             return f
 
-        flats = [to_flat(l, p) for l, p in zip(leaves, self.padded)]
-        names = [f"p{i}" for i in range(len(flats))]
+        if bucket:
+            # fused layout: one buffer per dtype group; per-leaf (name, offset)
+            groups = {}
+            self._layout = []
+            for i, l in enumerate(leaves):
+                key = f"b_{jnp.dtype(self.dtypes[i]).name}"
+                off = groups.setdefault(key, [0, []])
+                self._layout.append((key, off[0]))
+                off[0] += self.sizes[i]
+                off[1].append(jnp.ravel(l))
+            names, flats = [], []
+            for key, (total, parts) in groups.items():
+                pad = ((total + n - 1) // n) * n
+                buf = jnp.concatenate(parts)
+                if pad != buf.size:
+                    buf = jnp.concatenate(
+                        [buf, jnp.zeros(pad - buf.size, buf.dtype)])
+                names.append(key)
+                flats.append(buf)
+        else:
+            flats = [to_flat(l, p) for l, p in zip(leaves, self.padded)]
+            names = [f"p{i}" for i in range(len(flats))]
+            self._layout = [(nm, 0) for nm in names]
         self._names = names
 
         if self.stage >= 3:
@@ -100,10 +129,11 @@ class ShardedTrainStep:
         else:
             self.flat_params = {k: jax.device_put(v, repl_sh)
                                 for k, v in zip(names, flats)}
-        # optimizer state always lives sharded (that's stage 1's whole point);
-        # scalar entries (beta pow counters) stay replicated
+        # optimizer state lives sharded from stage 1 up (stage 1's whole
+        # point); scalars (beta pow counters) and stage 0 stay replicated
         def place_state(v):
-            sh = flat_sh if self._shardable(v) else repl_sh
+            sh = flat_sh if (self.stage >= 1 and self._shardable(v)) \
+                else repl_sh
             return jax.device_put(v, sh)
         self.opt_state = jax.tree_util.tree_map(
             place_state, opt.init_opt_state(self.flat_params))
@@ -118,10 +148,10 @@ class ShardedTrainStep:
     def _assemble(self, full_flats):
         """[padded] flat arrays -> original params pytree (local, in-step)."""
         leaves = []
-        for k, shape, size, dtype in zip(self._names, self.shapes, self.sizes,
-                                         self.dtypes):
+        for (k, off), shape, size, dtype in zip(self._layout, self.shapes,
+                                                self.sizes, self.dtypes):
             f = full_flats[k]
-            leaves.append(f[:size].reshape(shape).astype(dtype))
+            leaves.append(f[off:off + size].reshape(shape).astype(dtype))
         return jax.tree_util.tree_unflatten(self.treedef, leaves)
 
     @staticmethod
@@ -168,7 +198,11 @@ class ShardedTrainStep:
                 gflat = {k: jnp.ravel(g).astype(jnp.float32)
                          for k, g in gfull.items()}
                 r = jax.lax.axis_index(ax)
-                if stage == 1:
+                if stage == 0:
+                    # plain DP: all-reduce grads, update replicated params
+                    gslice = {k: jax.lax.pmean(g, ax) for k, g in gflat.items()}
+                    pslice = flat_params
+                elif stage == 1:
                     # all-reduce full grads, every rank slices its own chunk
                     gslice = {}
                     for k, g in gflat.items():
@@ -181,11 +215,12 @@ class ShardedTrainStep:
                     gslice = {k: jax.lax.psum_scatter(
                         g, ax, scatter_dimension=0, tiled=True) / n
                         for k, g in gflat.items()}
-                pslice = {}
-                for k, v in flat_params.items():
-                    chunk = v.shape[0] // n
-                    pslice[k] = jax.lax.dynamic_slice_in_dim(
-                        v, r * chunk, chunk)
+                if stage >= 1:
+                    pslice = {}
+                    for k, v in flat_params.items():
+                        chunk = v.shape[0] // n
+                        pslice[k] = jax.lax.dynamic_slice_in_dim(
+                            v, r * chunk, chunk)
 
             loss = jax.lax.pmean(loss, ax)
 
@@ -202,8 +237,8 @@ class ShardedTrainStep:
             new_slice, new_opt = opt.apply_gradients_functional(
                 pslice, gslice, opt_state, lr=lr)
 
-            if stage >= 3:
-                new_params = new_slice        # stays sharded
+            if stage >= 3 or stage == 0:
+                new_params = new_slice        # sharded (3) / replicated (0)
             else:
                 new_params = {k: jax.lax.all_gather(v, ax, tiled=True)
                               for k, v in new_slice.items()}
@@ -213,7 +248,8 @@ class ShardedTrainStep:
         repl_spec = {k: P() for k in self._names}
         param_spec = flat_spec if stage >= 3 else repl_spec
         opt_spec = jax.tree_util.tree_map(
-            lambda v: P(ax) if self._shardable(v) else P(), self.opt_state)
+            lambda v: P(ax) if (stage >= 1 and self._shardable(v)) else P(),
+            self.opt_state)
         batch_spec = P(ax)
 
         def stepper(flat_params, opt_state, lr, batch):
@@ -243,11 +279,14 @@ class ShardedTrainStep:
         host with numpy — no round-trip back through the device."""
         out_leaves = []
         repl = NamedSharding(self.mesh, P())
-        for k, shape, size, dtype in zip(self._names, self.shapes, self.sizes,
-                                         self.dtypes):
+        full = {}
+        for k in self._names:
             v = jax.device_put(self.flat_params[k], repl)
-            arr = np.asarray(jax.device_get(v))
-            out_leaves.append(arr[:size].reshape(shape).astype(dtype))
+            full[k] = np.asarray(jax.device_get(v))
+        for (k, off), shape, size, dtype in zip(self._layout, self.shapes,
+                                                self.sizes, self.dtypes):
+            out_leaves.append(
+                full[k][off:off + size].reshape(shape).astype(dtype))
         return jax.tree_util.tree_unflatten(self.treedef, out_leaves)
 
     def lowered_hlo(self, batch) -> str:

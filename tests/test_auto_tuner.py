@@ -1,0 +1,102 @@
+"""Auto-tuner tests (VERDICT r2 item #8; reference auto_tuner/tuner.py:21,
+search.py:48, prune.py): candidate enumeration with constraints, memory
+pruning, CSV history, and a toy sweep that must pick the known-best config."""
+import os
+
+import numpy as np
+import pytest
+import jax
+
+from paddle_tpu.distributed.auto_tuner import (
+    AutoTuner, GridSearch, candidate_configs, prune_by_memory,
+    estimate_bytes_per_device, HistoryRecorder)
+
+requires_8 = pytest.mark.skipif(jax.device_count() < 8, reason="needs 8 devices")
+
+
+def test_candidate_constraints():
+    cands = candidate_configs(8, n_layers=4, n_heads=4, global_batch=8)
+    assert cands
+    for c in cands:
+        assert c["dp"] * c["mp"] * c["pp"] == 8
+        if c["pp"] > 1:
+            assert 4 % c["pp"] == 0          # layer divisibility
+            assert c["n_micro"] >= c["pp"]
+            assert c["zero_stage"] == 0       # no zero+pp combo here
+        if c["mp"] > 1:
+            assert 4 % c["mp"] == 0
+        if c["zero_stage"] > 0:
+            assert c["dp"] > 1
+        assert 8 % (c["dp"] * c["n_micro"]) == 0
+
+
+def test_memory_model_monotonic():
+    base = {"dp": 1, "mp": 1, "pp": 1, "n_micro": 1, "zero_stage": 0,
+            "remat": False}
+    kw = dict(n_params=1e8, hidden=1024, n_layers=16, seq_len=2048,
+              micro_batch_size=8)
+    e0 = estimate_bytes_per_device(base, **kw)
+    e_mp = estimate_bytes_per_device({**base, "mp": 4}, **kw)
+    e_z3 = estimate_bytes_per_device({**base, "dp": 4, "zero_stage": 3}, **kw)
+    e_rm = estimate_bytes_per_device({**base, "remat": True}, **kw)
+    assert e_mp < e0 and e_z3 < e0 and e_rm < e0
+
+
+def test_prune_by_memory_drops_oversized():
+    cands = candidate_configs(8, n_layers=4, n_heads=4, global_batch=8)
+    kept, pruned = prune_by_memory(
+        cands, hbm_bytes=2 * 1024**2,   # absurdly small: everything drops
+        n_params=1e8, hidden=1024, n_layers=16, seq_len=2048,
+        micro_batch_size=8)
+    assert not kept and pruned
+
+
+def test_recorder_csv(tmp_path):
+    path = str(tmp_path / "hist.csv")
+    rec = HistoryRecorder(path)
+    rec.add({"dp": 2, "mp": 1, "pp": 1, "n_micro": 1, "zero_stage": 0,
+             "remat": False}, "ok", time_per_step=0.5, tokens_per_sec=100.0)
+    rec.add({"dp": 1, "mp": 1, "pp": 1, "n_micro": 1, "zero_stage": 0,
+             "remat": False}, "fail", error="OOM")
+    assert rec.best()["tokens_per_sec"] == 100.0
+    lines = open(path).read().strip().splitlines()
+    assert len(lines) == 3 and lines[0].startswith("dp,")
+
+
+@requires_8
+def test_toy_sweep_picks_best(tmp_path):
+    """On the virtual CPU mesh, hand the tuner a synthetic trial_fn with a
+    known optimum; the tuner must find it (search/prune/record plumbing)."""
+    def fake_trial(cfg, global_batch, seq_len, steps=3, warmup=1):
+        # best config by construction: dp=8 pure data parallel, no remat
+        t = 1.0
+        t /= cfg["dp"]                        # dp scales perfectly
+        t *= 1.0 + 0.5 * (cfg["pp"] - 1)      # pipeline bubble penalty
+        t *= 1.0 + 0.3 * (cfg["mp"] - 1)      # mp comm penalty
+        t *= 1.3 if cfg["remat"] else 1.0
+        return t
+
+    tuner = AutoTuner(None, n_devices=8, global_batch=8, seq_len=16,
+                      history_csv=str(tmp_path / "h.csv"), trial_fn=fake_trial)
+    tuner.candidates = lambda **kw: candidate_configs(8, global_batch=8)
+    best = tuner.tune()
+    assert best.config["dp"] == 8 and best.config["pp"] == 1
+    assert best.config["mp"] == 1 and not best.config["remat"]
+
+
+@requires_8
+def test_real_trials_on_virtual_mesh(tmp_path):
+    """Two real candidates actually build + time their train steps."""
+    from paddle_tpu.models.llama import llama_config_tiny
+    cfg = llama_config_tiny(vocab=64, hidden=32, layers=4, heads=4, seq=16)
+    tuner = AutoTuner(cfg, n_devices=8, global_batch=8, seq_len=16,
+                      history_csv=str(tmp_path / "h.csv"))
+    cands = [
+        {"dp": 8, "mp": 1, "pp": 1, "n_micro": 1, "zero_stage": 1, "remat": False},
+        {"dp": 2, "mp": 2, "pp": 2, "n_micro": 2, "zero_stage": 0, "remat": False},
+    ]
+    tuner.candidates = lambda **kw: cands
+    best = tuner.tune(steps=2, warmup=1)
+    assert best is not None
+    ok = [r for r in tuner.recorder.history if r["status"] == "ok"]
+    assert len(ok) == 2, tuner.recorder.history

@@ -1,0 +1,133 @@
+"""Serving/decode path tests (VERDICT r2 missing item #8): KV-cache decode,
+masked_multihead_attention, and the Predictor wrapper over the StableHLO
+artifact (reference analysis_predictor.h:101)."""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.models.llama import (llama_config_tiny, build_functional_llama,
+                                     build_llama_decode,
+                                     functional_params_from_layer,
+                                     LlamaForCausalLM)
+
+
+def _tiny():
+    return llama_config_tiny(vocab=64, hidden=32, layers=2, heads=4, seq=32)
+
+
+def _params(cfg, seed=0):
+    ep, bp, hp, *_ = build_functional_llama(cfg, key=jax.random.PRNGKey(seed))
+    return ep, bp, hp
+
+
+def test_prefill_decode_consistency():
+    """prefill(full prompt) == prefill(prompt[:-1]) + decode_step(last)."""
+    cfg = _tiny()
+    params = _params(cfg)
+    init_cache, prefill, decode_step = build_llama_decode(cfg, max_seq=32)
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, 64, (2, 8)).astype(np.int32))
+
+    logits_full, _ = prefill(params, ids)
+    _, cache = prefill(params, ids[:, :-1])
+    logits_inc, cache = decode_step(params, ids[:, -1], cache)
+    np.testing.assert_allclose(np.asarray(logits_inc), np.asarray(logits_full),
+                               rtol=2e-4, atol=2e-4)
+    assert int(cache["pos"]) == 8
+
+
+def test_greedy_generation_matches_teacher_forcing():
+    cfg = _tiny()
+    params = _params(cfg, seed=1)
+    init_cache, prefill, decode_step = build_llama_decode(cfg, max_seq=32)
+    decode_jit = jax.jit(decode_step)
+    rng = np.random.default_rng(1)
+    prompt = jnp.asarray(rng.integers(0, 64, (1, 4)).astype(np.int32))
+
+    logits, cache = prefill(params, prompt)
+    toks = [int(jnp.argmax(logits[0]))]
+    for _ in range(4):
+        logits, cache = decode_jit(params, jnp.asarray([toks[-1]], jnp.int32),
+                                   cache)
+        toks.append(int(jnp.argmax(logits[0])))
+
+    # teacher forcing: full prefill over prompt+generated must predict the
+    # same next token at every step
+    seq = jnp.concatenate([prompt, jnp.asarray([toks[:-1]], jnp.int32)], axis=1)
+    for i in range(len(toks) - 1):
+        lg, _ = prefill(params, seq[:, : 4 + i])
+        assert int(jnp.argmax(lg[0])) == toks[i]
+
+
+def test_functional_params_from_eager_layer_match():
+    cfg = _tiny()
+    paddle.seed(3)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    params = functional_params_from_layer(model)
+    init_cache, prefill, _ = build_llama_decode(cfg, max_seq=32)
+    rng = np.random.default_rng(3)
+    ids = rng.integers(0, 64, (2, 6)).astype(np.int32)
+    logits_f, _ = prefill(params, jnp.asarray(ids))
+    with paddle.no_grad():
+        logits_e = model(paddle.to_tensor(ids))
+    np.testing.assert_allclose(np.asarray(logits_f),
+                               np.asarray(logits_e.numpy()[:, -1]),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_masked_multihead_attention_matches_naive():
+    from paddle_tpu.incubate.nn.functional import masked_multihead_attention
+    B, H, S, D = 2, 4, 8, 16
+    rng = np.random.default_rng(4)
+    x = rng.normal(0, 1, (B, 3 * H * D)).astype(np.float32)
+    cache = np.zeros((2, B, H, S, D), np.float32)
+    cache[:, :, :, :3] = rng.normal(0, 1, (2, B, H, 3, D)).astype(np.float32)
+    seq_lens = np.full((B, 1), 3, np.int32)
+
+    out, new_cache = masked_multihead_attention(
+        paddle.to_tensor(x), paddle.to_tensor(cache),
+        sequence_lengths=paddle.to_tensor(seq_lens))
+    out = np.asarray(out.numpy())
+    new_cache = np.asarray(new_cache.numpy())
+
+    qkv = x.reshape(B, 3, H, D)
+    q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+    ref_cache = cache.copy()
+    ref_cache[0, :, :, 3] = k
+    ref_cache[1, :, :, 3] = v
+    np.testing.assert_allclose(new_cache, ref_cache, rtol=1e-6)
+    for b in range(B):
+        for h in range(H):
+            kk = ref_cache[0, b, h, :4]               # 4 valid positions
+            vv = ref_cache[1, b, h, :4]
+            s = kk @ q[b, h] / np.sqrt(D)
+            p = np.exp(s - s.max())
+            p /= p.sum()
+            np.testing.assert_allclose(out[b, h * D:(h + 1) * D], p @ vv,
+                                       rtol=2e-4, atol=2e-5)
+
+
+def test_predictor_over_stablehlo_artifact(tmp_path):
+    from paddle_tpu import nn
+    from paddle_tpu.static import InputSpec
+    from paddle_tpu import jit as pjit
+    from paddle_tpu.inference import Config, create_predictor
+
+    paddle.seed(5)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    path = str(tmp_path / "model" / "net")
+    pjit.save(net, path, input_spec=[InputSpec([2, 8], "float32", name="x")])
+
+    cfg = Config(path)
+    pred = create_predictor(cfg)
+    assert pred.get_input_names() == ["x"]
+    x = np.random.default_rng(5).normal(0, 1, (2, 8)).astype(np.float32)
+    h = pred.get_input_handle("x")
+    h.copy_from_cpu(x)
+    outs = pred.run()
+    with paddle.no_grad():
+        ref = net(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(outs[0], np.asarray(ref), rtol=1e-5, atol=1e-6)

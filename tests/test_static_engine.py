@@ -1,0 +1,108 @@
+"""Static auto-parallel Engine / DistModel tests (VERDICT r2 missing #9;
+reference auto_parallel/static/engine.py:99, api.py:2254/2952)."""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.distributed.auto_parallel import DistModel, Engine
+import paddle_tpu.distributed as dist
+
+requires_8 = pytest.mark.skipif(jax.device_count() < 8, reason="needs 8 devices")
+
+
+def _net(seed=0):
+    paddle.seed(seed)
+    return nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 1))
+
+
+def _data(n=32, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(0, 1, (n, 8)).astype(np.float32)
+    y = (x @ np.arange(1, 9).astype(np.float32)[:, None] * 0.1).astype(np.float32)
+    return x, y
+
+
+def _mse(out, y):
+    return ((out - y) ** 2).mean()
+
+
+def test_dist_model_train_eval_predict():
+    net = _net()
+    opt = optimizer.AdamW(learning_rate=1e-2, parameters=net.parameters())
+    dm = DistModel(net, loss=_mse, optimizer=opt)
+    x, y = _data()
+    dm.train()
+    losses = [float(dm(x, y).numpy()) for _ in range(10)]
+    assert losses[-1] < losses[0] * 0.7, losses
+
+    dm.eval()
+    ev = float(dm(x, y).numpy())
+    np.testing.assert_allclose(ev, losses[-1], rtol=0.5)
+
+    dm.predict()
+    out = dm(x)
+    assert tuple(out.shape) == (32, 1)
+
+    # updated params flow back into the eager Layer
+    dm.sync_to_network()
+    with paddle.no_grad():
+        eager_loss = float(_mse(net(paddle.to_tensor(x)),
+                                paddle.to_tensor(y)).numpy())
+    np.testing.assert_allclose(eager_loss, ev, rtol=1e-4)
+
+
+def test_engine_fit_evaluate_predict(tmp_path):
+    net = _net(1)
+    opt = optimizer.AdamW(learning_rate=1e-2, parameters=net.parameters())
+    eng = Engine(net, loss=_mse, optimizer=opt)
+    x, y = _data(64, seed=1)
+    batches = [(x[i:i + 16], y[i:i + 16]) for i in range(0, 64, 16)]
+    hist = eng.fit(batches, epochs=5)
+    assert hist["loss"][-1] < hist["loss"][0] * 0.7
+    ev = eng.evaluate(batches)
+    assert ev["loss"] is not None and np.isfinite(ev["loss"])
+    preds = eng.predict([b[0] for b in batches], steps=2)
+    assert len(preds) == 2
+
+    eng.save(str(tmp_path / "m"))
+    eng2 = Engine(_net(2), loss=_mse,
+                  optimizer=optimizer.AdamW(learning_rate=1e-2,
+                                            parameters=[]))
+    eng2.load(str(tmp_path / "m"))
+    ev2 = eng2.evaluate(batches)
+    np.testing.assert_allclose(ev2["loss"], ev["loss"], rtol=1e-4)
+
+
+def test_dist_to_static_api():
+    net = _net(3)
+    opt = optimizer.AdamW(learning_rate=1e-2, parameters=net.parameters())
+    dm = dist.auto_parallel.api.to_static(net, loss=_mse, optimizer=opt)
+    x, y = _data(16, seed=3)
+    l0 = float(dm(x, y).numpy())
+    for _ in range(5):
+        l1 = float(dm(x, y).numpy())
+    assert l1 < l0
+
+
+@requires_8
+def test_dist_model_sharded_params_keep_sharding():
+    """shard_tensor'd weights keep their placement through the compiled
+    step (GSPMD partitioned training)."""
+    from paddle_tpu.distributed.topology import build_mesh, set_default_mesh
+    from paddle_tpu.distributed.auto_parallel.process_mesh import ProcessMesh
+    mesh = build_mesh({"x": 8})
+    set_default_mesh(mesh)
+    net = _net(4)
+    pm = ProcessMesh(list(range(8)), dim_names=["x"])
+    net[0].weight = dist.shard_tensor(net[0].weight, pm, [dist.Shard(1)])
+    opt = optimizer.AdamW(learning_rate=1e-2, parameters=net.parameters())
+    dm = DistModel(net, loss=_mse, optimizer=opt)
+    x, y = _data(32, seed=4)
+    l0 = float(dm(x, y).numpy())
+    l1 = float(dm(x, y).numpy())
+    assert l1 < l0
+    w = dm.params["0.weight"]
+    assert not w.sharding.is_fully_replicated, w.sharding

@@ -1,0 +1,192 @@
+"""Round-3 weak-item fixes (VERDICT r2 "what's weak"): SP in-mesh tests with
+a real sequence split + the comm/compute-overlap variant, the comm watchdog,
+the subgraph accuracy checker, and eager PipelineParallel delegating to the
+compiled 1F1B schedule."""
+import time
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as paddle
+
+requires_8 = pytest.mark.skipif(jax.device_count() < 8, reason="needs 8 devices")
+
+
+# ---------------------------------------------------------------------------
+# Sequence parallel in-mesh (weak #6)
+# ---------------------------------------------------------------------------
+@requires_8
+def test_sequence_parallel_layers_real_split():
+    """Column/Row sequence-parallel pair under shard_map with the sequence
+    ACTUALLY split over mp == dense reference."""
+    from paddle_tpu.distributed.topology import build_mesh, set_default_mesh
+    from paddle_tpu.distributed.fleet.utils.sequence_parallel_utils import (
+        AllGatherOp, ReduceScatterOp)
+    mesh = build_mesh({"mp": 4}, devices=jax.devices()[:4])
+    set_default_mesh(mesh)
+    rng = np.random.default_rng(0)
+    S, B, H, O = 8, 2, 16, 32
+    x = rng.standard_normal((S, B, H)).astype(np.float32)
+    w1 = rng.standard_normal((H, O)).astype(np.float32)
+    w2 = rng.standard_normal((O, H)).astype(np.float32)
+
+    def body(xs, w1s, w2s):
+        # xs: [S/4, B, H] — column SP: gather sequence, matmul col shard
+        full = AllGatherOp.apply(paddle.Tensor(xs), axis=0)
+        h = jnp.maximum(full._value @ w1s, 0)
+        part = h @ w2s                       # row shard partial
+        out = ReduceScatterOp.apply(paddle.Tensor(part), axis=0)
+        return out._value                    # [S/4, B, H]
+
+    f = shard_map(body, mesh=mesh,
+                  in_specs=(P("mp"), P(None, "mp"), P("mp", None)),
+                  out_specs=P("mp"))
+    out = f(x, w1, w2)
+    ref = np.maximum(x @ w1, 0) @ w2
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-4)
+
+
+@requires_8
+def test_sp_overlap_linear_matches_allgather():
+    """SPInnerOverlapLinear's ring all-gather×matmul == plain gather+matmul."""
+    from paddle_tpu.distributed.topology import build_mesh
+    from paddle_tpu.distributed.fleet.utils.sequence_parallel_utils import (
+        _ring_allgather_matmul)
+    mesh = build_mesh({"mp": 4}, devices=jax.devices()[:4])
+    rng = np.random.default_rng(1)
+    S, H, O = 8, 16, 24
+    x = rng.standard_normal((S, H)).astype(np.float32)
+    w = rng.standard_normal((H, O)).astype(np.float32)
+
+    def body(xs, ws):
+        return _ring_allgather_matmul(xs, ws, "mp")
+
+    f = shard_map(body, mesh=mesh, in_specs=(P("mp"), P(None, "mp")),
+                  out_specs=P(None, "mp"))
+    out = f(x, w)
+    np.testing.assert_allclose(np.asarray(out), x @ w, rtol=1e-4, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# Comm watchdog (aux subsystem gap)
+# ---------------------------------------------------------------------------
+def test_watchdog_passes_fast_op_and_catches_nan():
+    from paddle_tpu.distributed.communication.watchdog import (
+        wait_with_timeout, check_comm_result, CommTaskManager)
+    v = jnp.ones((4,))
+    assert wait_with_timeout(v, 5.0, "t") is v
+    paddle.set_flags({"FLAGS_check_comm_nan": True})
+    try:
+        check_comm_result(jnp.ones((4,)), "ok_op")
+        with pytest.raises(FloatingPointError):
+            check_comm_result(jnp.asarray([1.0, np.nan]), "bad_op")
+    finally:
+        paddle.set_flags({"FLAGS_check_comm_nan": False})
+    m = CommTaskManager(default_timeout=5.0)
+    m.track("a", jnp.zeros(()))
+    assert m.pending() == 1
+    m.wait_all()
+    assert m.pending() == 0
+
+
+def test_watchdog_times_out_on_stuck_wait(monkeypatch):
+    from paddle_tpu.distributed.communication import watchdog
+
+    class Stuck:
+        pass
+
+    def never_ready(v):
+        time.sleep(60)
+
+    monkeypatch.setattr(jax, "block_until_ready", never_ready)
+    with pytest.raises(watchdog.CommTimeoutError):
+        watchdog.wait_with_timeout(Stuck(), 0.3, "hung_allreduce")
+
+
+# ---------------------------------------------------------------------------
+# Subgraph accuracy checker (native gap: sub_graph_checker.cc)
+# ---------------------------------------------------------------------------
+def test_subgraph_checker_clean_graph():
+    from paddle_tpu.jit.sub_graph_checker import check_accuracy
+    from paddle_tpu import nn
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.GELU(), nn.Linear(16, 4))
+    net.eval()
+    x = np.random.default_rng(0).standard_normal((4, 8)).astype(np.float32)
+    with paddle.no_grad():
+        res = check_accuracy(net, x, rtol=1e-4, atol=1e-5)
+    assert res.graph_ok, res.graph_max_abs_err
+    assert res.op_reports, "op-by-op mode recorded nothing"
+    assert all(r.ok for r in res.op_reports), res.worst()
+
+
+def test_subgraph_checker_localizes_bad_op():
+    """A kernel whose compiled run differs from eager must be flagged."""
+    from paddle_tpu.core.dispatch import register_kernel, _KERNELS, op_call
+    from paddle_tpu.jit.sub_graph_checker import check_accuracy
+
+    calls = {"n": 0}
+
+    def flaky(v):
+        # eager executes concrete values; under jit it traces → different
+        # result by design (simulates a miscompiling kernel)
+        if isinstance(v, jax.core.Tracer):
+            return v * 1.5
+        return v * 1.0
+
+    register_kernel("flaky_scale_demo")(flaky)
+    try:
+        def fn(t):
+            return op_call("flaky_scale_demo", flaky, t)
+
+        x = np.ones((4, 4), np.float32)
+        with paddle.no_grad():
+            res = check_accuracy(fn, x, rtol=1e-5, atol=1e-6)
+        assert not res.graph_ok
+        bad = [r for r in res.op_reports if r.name == "flaky_scale_demo"]
+        assert bad and not bad[0].ok
+    finally:
+        _KERNELS.pop("flaky_scale_demo", None)
+
+
+# ---------------------------------------------------------------------------
+# Eager PipelineParallel delegates to the compiled schedule (weak #4)
+# ---------------------------------------------------------------------------
+@requires_8
+def test_pipeline_parallel_delegates_to_compiled():
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.distributed.topology import build_mesh, set_default_mesh
+    from paddle_tpu.distributed.fleet.meta_parallel import PipelineParallel
+    from paddle_tpu.distributed.fleet.meta_parallel.pp_layers import (
+        PipelineLayer, LayerDesc)
+
+    mesh = build_mesh({"pp": 2}, devices=jax.devices()[:2])
+    set_default_mesh(mesh)
+    paddle.seed(0)
+    H = 16
+    descs = [LayerDesc(nn.Linear, H, H) for _ in range(4)]
+
+    def loss_fn(out, y):
+        return ((out - y) ** 2).mean()
+
+    pl = PipelineLayer(layers=descs, num_stages=2, loss_fn=loss_fn)
+    opt = optimizer.AdamW(learning_rate=1e-2, parameters=pl.parameters())
+
+    class HCG:
+        def get_pipe_parallel_world_size(self):
+            return 2
+
+    class Strat:
+        hybrid_configs = {}
+
+    pp = PipelineParallel(pl, HCG(), Strat())
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal((4, H)).astype(np.float32))
+    y = paddle.to_tensor(rng.standard_normal((4, H)).astype(np.float32))
+    losses = [float(pp.train_batch((x, y), opt).numpy()) for _ in range(4)]
+    assert pp._compiled_step is not None, "did not delegate to compiled 1F1B"
+    assert losses[-1] < losses[0], losses
