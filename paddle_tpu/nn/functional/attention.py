@@ -104,6 +104,24 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
         tk = k.shape[0]
         seg_q = jnp.cumsum(jnp.zeros(tq, jnp.int32).at[cu_q[1:-1]].add(1))
         seg_k = jnp.cumsum(jnp.zeros(tk, jnp.int32).at[cu_k[1:-1]].add(1))
+        use_dropout = dropout > 0.0 and training
+        same_boundaries = cu_q is cu_k
+        if not same_boundaries:
+            try:  # concrete boundary arrays: compare values
+                import numpy as _np
+                same_boundaries = (cu_q.shape == cu_k.shape
+                                   and bool(_np.array_equal(_np.asarray(cu_q),
+                                                            _np.asarray(cu_k))))
+            except Exception:
+                same_boundaries = False  # traced: can't prove equality
+        if tq == tk and same_boundaries and not use_dropout and scale is None:
+            # Pallas varlen kernel: block-diagonal via in-kernel segment ids
+            varlen_k = get_kernel("flash_attention_varlen")
+            if varlen_k is not None:
+                out = varlen_k(q[None], k[None], v[None], seg_q[None],
+                               causal=causal)
+                if out is not None:
+                    return out[0]
         mask = seg_q[:, None] == seg_k[None, :]
         if causal:
             pos_q = jnp.arange(tq) - cu_q[seg_q]

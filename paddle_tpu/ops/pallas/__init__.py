@@ -47,6 +47,12 @@ def _rms_norm_pallas(x, *rest, epsilon=1e-6):
     return rms_norm_ref(x, rest[0] if rest else None, epsilon)
 
 
+def _fa_varlen(q, k, v, seg, causal=False):
+    """Segment-masked (varlen) flash attention; None on unsupported shapes
+    so the caller's block-diagonal XLA fallback runs."""
+    return fa_mod.flash_attention(q, k, v, causal=causal, segment_ids=seg)
+
+
 def _fa_plain(q, k, v):
     out = fa_mod.flash_attention(q, k, v, causal=False)
     return out if out is not None else _naive_sdpa(q, k, v, False)
@@ -73,6 +79,7 @@ def register_all(force=False):
     register_kernel("flash_attention", impl="pallas")(_fa_plain)
     register_kernel("flash_attention_causal", impl="pallas")(_fa_causal)
     register_kernel("rms_norm", impl="pallas")(_rms_norm_pallas)
+    register_kernel("flash_attention_varlen", impl="pallas")(_fa_varlen)
     from .fused import adamw_update
     register_kernel("adamw_fused", impl="pallas")(adamw_update)
     _registered[0] = True

@@ -39,8 +39,12 @@ def _block_sizes(s_q, s_k, d):
 # ---------------------------------------------------------------------------
 # Forward
 # ---------------------------------------------------------------------------
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
-                causal, sm_scale, block_q, block_k, num_k_blocks, offset):
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest, causal, sm_scale, block_q,
+                block_k, num_k_blocks, offset, has_segments=False):
+    if has_segments:
+        qseg_ref, kseg_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
     j = pl.program_id(2)  # k-block index (innermost, reduction)
     i = pl.program_id(1)  # q-block index
 
@@ -69,6 +73,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
             k_ids = j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_ids + offset >= k_ids, s, NEG_INF)
+        if has_segments:
+            qs = qseg_ref[0, :, 0]        # [block_q] (f32 segment ids)
+            ks = kseg_ref[0, :, 0]        # [block_k]
+            s = jnp.where(qs[:, None] == ks[None, :], s, NEG_INF)
         m_prev = m_scr[:]                 # [bq, 1]
         m_cur = jnp.max(s, axis=1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
@@ -90,7 +98,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
 
 
 def flash_attention_fwd_kernel_call(q, k, v, causal, sm_scale, interpret=False,
-                                    n_q_heads=None, n_kv_heads=None):
+                                    n_q_heads=None, n_kv_heads=None,
+                                    segment_ids=None):
     """q: [B*Hq, S, D], k/v: [B*Hkv, S, D] -> (o [B*Hq, Sq, D], lse).
 
     GQA (n_kv_heads < n_q_heads) is handled in the BlockSpec index maps: the
@@ -108,18 +117,30 @@ def flash_attention_fwd_kernel_call(q, k, v, causal, sm_scale, interpret=False,
     def kv_idx(b, i, j):
         return ((b // hq) * hkv + (b % hq) // rep, j, 0)
 
+    has_seg = segment_ids is not None
     kernel = functools.partial(
         _fwd_kernel, causal=causal, sm_scale=sm_scale, block_q=block_q,
-        block_k=block_k, num_k_blocks=s_k // block_k, offset=s_k - s_q)
+        block_k=block_k, num_k_blocks=s_k // block_k, offset=s_k - s_q,
+        has_segments=has_seg)
 
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), kv_idx),
+        pl.BlockSpec((1, block_k, d), kv_idx),
+    ]
+    args = [q, k, v]
+    if has_seg:
+        # segment ids per batch row [B, S] (f32), broadcast over heads
+        seg3 = segment_ids[:, :, None]   # [B, S, 1]: TPU tiling wants
+        in_specs += [                     # (8·k, full-last-dim) blocks
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b // hq, i, 0)),
+            pl.BlockSpec((1, block_k, 1), lambda b, i, j: (b // hq, j, 0)),
+        ]
+        args += [seg3, seg3]
     return pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), kv_idx),
-            pl.BlockSpec((1, block_k, d), kv_idx),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
@@ -136,16 +157,19 @@ def flash_attention_fwd_kernel_call(q, k, v, causal, sm_scale, interpret=False,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(q, k, v)
+    )(*args)
 
 
 # ---------------------------------------------------------------------------
 # Backward
 # ---------------------------------------------------------------------------
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_scr, dv_scr, *,
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
                     causal, sm_scale, block_q, block_k, num_q_blocks,
-                    rep_heads, offset):
+                    rep_heads, offset, has_segments=False):
+    if has_segments:
+        qseg_ref, kseg_ref, dk_ref, dv_ref, dk_scr, dv_scr = rest
+    else:
+        dk_ref, dv_ref, dk_scr, dv_scr = rest
     # grid (bh_kv, j, rr, i): rr walks the rep q-heads sharing this kv head
     # (GQA — dk/dv accumulate over them), i walks q blocks
     j = pl.program_id(1)  # k-block
@@ -177,6 +201,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             k_ids = j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_ids + offset >= k_ids, s, NEG_INF)
+        if has_segments:
+            s = jnp.where(qseg_ref[0, :, 0][:, None]
+                          == kseg_ref[0, :, 0][None, :], s, NEG_INF)
         p = jnp.exp(s - lse)                            # [bq, bk]
         # dv += p^T do
         dv_scr[:] += jax.lax.dot_general(
@@ -198,9 +225,13 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, dq_scr, *,
-                   causal, sm_scale, block_q, block_k, num_k_blocks, offset):
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+                   causal, sm_scale, block_q, block_k, num_k_blocks, offset,
+                   has_segments=False):
+    if has_segments:
+        qseg_ref, kseg_ref, dq_ref, dq_scr = rest
+    else:
+        dq_ref, dq_scr = rest
     j = pl.program_id(2)  # k-block (reduction)
     i = pl.program_id(1)  # q-block
 
@@ -228,6 +259,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             k_ids = j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_ids + offset >= k_ids, s, NEG_INF)
+        if has_segments:
+            s = jnp.where(qseg_ref[0, :, 0][:, None]
+                          == kseg_ref[0, :, 0][None, :], s, NEG_INF)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v.astype(jnp.float32),
                                  (((1,), (1,)), ((), ())),
@@ -243,7 +277,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd_call(res, g, causal, sm_scale, interpret, n_q_heads=None,
-              n_kv_heads=None):
+              n_kv_heads=None, segment_ids=None):
     q, k, v, o, lse = res
     do = g
     bh, s_q, d = q.shape
@@ -254,6 +288,7 @@ def _bwd_call(res, g, causal, sm_scale, interpret, n_q_heads=None,
     block_q, block_k = _block_sizes(s_q, s_k, d)
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1, keepdims=True)  # [bh, s_q, 1]
+    has_seg = segment_ids is not None
 
     def q_idx_dkv(b, j, rr, i):
         # b indexes B*Hkv; the q head is the rr-th member of its kv group
@@ -262,20 +297,32 @@ def _bwd_call(res, g, causal, sm_scale, interpret, n_q_heads=None,
     def kv_idx_dkv(b, j, rr, i):
         return (b, j, 0)
 
+    dkv_in_specs = [
+        pl.BlockSpec((1, block_q, d), q_idx_dkv),
+        pl.BlockSpec((1, block_k, d), kv_idx_dkv),
+        pl.BlockSpec((1, block_k, d), kv_idx_dkv),
+        pl.BlockSpec((1, block_q, d), q_idx_dkv),
+        pl.BlockSpec((1, block_q, 1), q_idx_dkv),
+        pl.BlockSpec((1, block_q, 1), q_idx_dkv),
+    ]
+    dkv_args = [q, k, v, do, lse, delta]
+    if has_seg:
+        seg3 = segment_ids[:, :, None]
+        dkv_in_specs += [
+            pl.BlockSpec((1, block_q, 1),
+                         lambda b, j, rr, i: (b // hkv, i, 0)),
+            pl.BlockSpec((1, block_k, 1),
+                         lambda b, j, rr, i: (b // hkv, j, 0)),
+        ]
+        dkv_args += [seg3, seg3]
+
     dkv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, causal=causal, sm_scale=sm_scale,
                           block_q=block_q, block_k=block_k,
                           num_q_blocks=s_q // block_q, rep_heads=rep,
-                          offset=s_k - s_q),
+                          offset=s_k - s_q, has_segments=has_seg),
         grid=(bh_kv, s_k // block_k, rep, s_q // block_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), q_idx_dkv),
-            pl.BlockSpec((1, block_k, d), kv_idx_dkv),
-            pl.BlockSpec((1, block_k, d), kv_idx_dkv),
-            pl.BlockSpec((1, block_q, d), q_idx_dkv),
-            pl.BlockSpec((1, block_q, 1), q_idx_dkv),
-            pl.BlockSpec((1, block_q, 1), q_idx_dkv),
-        ],
+        in_specs=dkv_in_specs,
         out_specs=[
             pl.BlockSpec((1, block_k, d), kv_idx_dkv),
             pl.BlockSpec((1, block_k, d), kv_idx_dkv),
@@ -292,32 +339,42 @@ def _bwd_call(res, g, causal, sm_scale, interpret, n_q_heads=None,
             dimension_semantics=("parallel", "parallel", "arbitrary",
                                  "arbitrary")),
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(*dkv_args)
     dk, dv = dkv
 
     def kv_idx_dq(b, i, j):
         return ((b // hq) * hkv + (b % hq) // rep, j, 0)
 
+    dq_in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), kv_idx_dq),
+        pl.BlockSpec((1, block_k, d), kv_idx_dq),
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+    ]
+    dq_args = [q, k, v, do, lse, delta]
+    if has_seg:
+        dq_in_specs += [
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b // hq, i, 0)),
+            pl.BlockSpec((1, block_k, 1), lambda b, i, j: (b // hq, j, 0)),
+        ]
+        dq_args += [segment_ids[:, :, None], segment_ids[:, :, None]]
+
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, causal=causal, sm_scale=sm_scale,
                           block_q=block_q, block_k=block_k,
-                          num_k_blocks=s_k // block_k, offset=s_k - s_q),
+                          num_k_blocks=s_k // block_k, offset=s_k - s_q,
+                          has_segments=has_seg),
         grid=(bh, s_q // block_q, s_k // block_k),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), kv_idx_dq),
-            pl.BlockSpec((1, block_k, d), kv_idx_dq),
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
-        ],
+        in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, s_q, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(*dq_args)
     return dq, dk, dv
 
 
@@ -325,13 +382,12 @@ def _bwd_call(res, g, causal, sm_scale, interpret, n_q_heads=None,
 # Public op: [B, S, H, D] layout with custom VJP
 # ---------------------------------------------------------------------------
 @functools.lru_cache(maxsize=16)
-def _make_op(causal: bool, interpret: bool):
-    @jax.custom_vjp
-    def op(q, k, v):
-        o, _ = _fwd(q, k, v)
-        return o
+def _make_op(causal: bool, interpret: bool, has_segments: bool = False):
+    """has_segments: op takes a 4th arg seg [B, S] (f32 segment ids —
+    intra-segment attention only, the varlen/flash_attn_unpadded mask;
+    f32 so custom_vjp's cotangent contract stays uniform)."""
 
-    def _fwd(q, k, v):
+    def _fwd(q, k, v, *seg):
         b, s_q, h, d = q.shape
         s_k = k.shape[1]
         hkv = k.shape[2]
@@ -339,29 +395,47 @@ def _make_op(causal: bool, interpret: bool):
         qr = q.transpose(0, 2, 1, 3).reshape(b * h, s_q, d)
         kr = k.transpose(0, 2, 1, 3).reshape(b * hkv, s_k, d)
         vr = v.transpose(0, 2, 1, 3).reshape(b * hkv, s_k, d)
+        sids = seg[0] if seg else None
         o, lse = flash_attention_fwd_kernel_call(qr, kr, vr, causal, sm_scale,
                                                  interpret, n_q_heads=h,
-                                                 n_kv_heads=hkv)
+                                                 n_kv_heads=hkv,
+                                                 segment_ids=sids)
         o4 = o.reshape(b, h, s_q, d).transpose(0, 2, 1, 3)
         # name the bwd residuals so a save_only_these_names("fa_res") remat
         # policy keeps them and the backward skips re-running the fwd kernel
         from jax.ad_checkpoint import checkpoint_name
         res = tuple(checkpoint_name(x, "fa_res") for x in (qr, kr, vr, o, lse))
-        return o4, res + ((b, h, hkv, s_q, s_k, d),)
+        return o4, res + (sids, (b, h, hkv, s_q, s_k, d))
 
-    def fwd(q, k, v):
-        o4, res = _fwd(q, k, v)
-        return o4, res
+    if has_segments:
+        @jax.custom_vjp
+        def op(q, k, v, seg):
+            o, _ = _fwd(q, k, v, seg)
+            return o
+
+        def fwd(q, k, v, seg):
+            return _fwd(q, k, v, seg)
+    else:
+        @jax.custom_vjp
+        def op(q, k, v):
+            o, _ = _fwd(q, k, v)
+            return o
+
+        def fwd(q, k, v):
+            return _fwd(q, k, v)
 
     def bwd(res, g):
-        qr, kr, vr, o, lse, (b, h, hkv, s_q, s_k, d) = res
+        qr, kr, vr, o, lse, sids, (b, h, hkv, s_q, s_k, d) = res
         sm_scale = 1.0 / math.sqrt(d)
         do = g.transpose(0, 2, 1, 3).reshape(b * h, s_q, d)
         dq, dk, dv = _bwd_call((qr, kr, vr, o, lse), do, causal, sm_scale,
-                               interpret, n_q_heads=h, n_kv_heads=hkv)
+                               interpret, n_q_heads=h, n_kv_heads=hkv,
+                               segment_ids=sids)
         dq4 = dq.reshape(b, h, s_q, d).transpose(0, 2, 1, 3)
         dk4 = dk.reshape(b, hkv, s_k, d).transpose(0, 2, 1, 3)
         dv4 = dv.reshape(b, hkv, s_k, d).transpose(0, 2, 1, 3)
+        if has_segments:
+            return dq4, dk4, dv4, jnp.zeros_like(sids)
         return dq4, dk4, dv4
 
     op.defvjp(fwd, bwd)
@@ -390,9 +464,19 @@ def _supported(q, k, causal=False):
     return True
 
 
-def flash_attention(q, k, v, causal=False, interpret=False):
+def flash_attention(q, k, v, causal=False, interpret=False, segment_ids=None):
     """[B, S, H, D] flash attention; falls back unsupported shapes to the
-    caller (returns None so the dispatch default runs)."""
+    caller (returns None so the dispatch default runs).
+
+    segment_ids: optional int [B, S] — attention stays within equal-id
+    spans (the varlen/flash_attn_unpadded mask; reference
+    flash_attn_kernel.cu varlen entries). Requires s_q == s_k.
+    """
     if not _supported(q, k, causal):
         return None
+    if segment_ids is not None:
+        if q.shape[1] != k.shape[1]:
+            return None
+        sids = segment_ids.astype(jnp.float32)
+        return _make_op(bool(causal), bool(interpret), True)(q, k, v, sids)
     return _make_op(bool(causal), bool(interpret))(q, k, v)

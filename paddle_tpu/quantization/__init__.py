@@ -12,7 +12,9 @@ from ..core.dispatch import op_call
 from ..core.tensor import Tensor
 from ..nn.layer import Layer
 
-__all__ = ["QuantConfig", "QAT", "PTQ", "quanter", "fake_quant_abs_max"]
+__all__ = ["QuantConfig", "QAT", "PTQ", "quanter", "fake_quant_abs_max",
+           "AbsMaxObserver", "EMAObserver", "quantize_weight",
+           "dequantize_weight"]
 
 
 def fake_quant_abs_max(x, bit_length=8):
@@ -72,5 +74,93 @@ class QAT:
         return model
 
 
+class AbsMaxObserver:
+    """Tracks the running abs-max of a tensor stream (reference
+    quantization/observers/abs_max.py)."""
+
+    def __init__(self, quant_bits=8):
+        self.bits = quant_bits
+        self.absmax = 0.0
+
+    def update(self, value):
+        import numpy as np
+        v = value._value if isinstance(value, Tensor) else value
+        self.absmax = max(self.absmax, float(jnp.max(jnp.abs(v))))
+
+    def scale(self):
+        qmax = 2.0 ** (self.bits - 1) - 1
+        return max(self.absmax, 1e-8) / qmax
+
+
+class EMAObserver(AbsMaxObserver):
+    """Exponential-moving-average abs-max (reference mse/ema observers)."""
+
+    def __init__(self, quant_bits=8, momentum=0.9):
+        super().__init__(quant_bits)
+        self.momentum = momentum
+        self._seen = False
+
+    def update(self, value):
+        v = value._value if isinstance(value, Tensor) else value
+        cur = float(jnp.max(jnp.abs(v)))
+        if not self._seen:
+            self.absmax = cur
+            self._seen = True
+        else:
+            self.absmax = self.momentum * self.absmax + (1 - self.momentum) * cur
+
+
+def quantize_weight(w, bits=8):
+    """-> (int8 values, scale): symmetric per-tensor quantization."""
+    qmax = 2.0 ** (bits - 1) - 1
+    scale = jnp.maximum(jnp.max(jnp.abs(w)), 1e-8) / qmax
+    q = jnp.clip(jnp.round(w / scale), -qmax - 1, qmax).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_weight(q, scale, dtype=jnp.float32):
+    return q.astype(dtype) * scale
+
+
 class PTQ(QAT):
-    pass
+    """Post-training quantization: calibrate activation observers over
+    sample data, then convert — weights snap to the int8 grid and each
+    quantized layer records its activation/weight scales (reference
+    quantization/ptq.py flow)."""
+
+    def __init__(self, config: QuantConfig = None):
+        super().__init__(config or QuantConfig())
+        self._observers = {}
+
+    def quantize(self, model: Layer, inplace=False):
+        """Install calibration observers (run sample batches afterwards)."""
+        from ..nn import Linear, Conv2D
+        for name, sub in model.named_sublayers(include_self=True):
+            if isinstance(sub, (Linear, Conv2D)):
+                obs = AbsMaxObserver()
+
+                def hook(layer, inputs, _obs=obs):
+                    for i in inputs:
+                        if isinstance(i, Tensor):
+                            _obs.update(i)
+                    return inputs
+                handle = sub.register_forward_pre_hook(hook)
+                self._observers[name] = (sub, obs, handle)
+        return model
+
+    def convert(self, model: Layer, inplace=False):
+        """Bake scales: weights move onto the int8 grid (stored dequantized
+        for TPU matmul; int values + scales attached for serialization).
+        Calibration hooks are removed — converted models jit cleanly."""
+        for name, (sub, obs, handle) in self._observers.items():
+            try:
+                handle.remove()
+            except Exception:
+                pass
+            q, w_scale = quantize_weight(sub.weight._value)
+            sub.weight._set_value(dequantize_weight(q, w_scale,
+                                                    sub.weight._value.dtype))
+            sub.weight_quant = {"int_values": q, "scale": float(w_scale)}
+            sub.activation_scale = obs.scale()
+        self._observers = {}
+        return model

@@ -55,9 +55,26 @@ class program_guard:
 
 def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
                          program=None, **kwargs):
-    raise NotImplementedError(
-        "On the TPU backend use paddle_tpu.jit.save(layer, path, input_spec) — "
-        "the StableHLO export is the inference model artifact.")
+    """reference static/io.py save_inference_model. On the TPU backend the
+    inference artifact is the StableHLO export: pass the model Layer as
+    `fetch_vars` (or `program`) and InputSpecs as `feed_vars` and this
+    delegates to paddle_tpu.jit.save."""
+    from ..nn.layer import Layer
+    from ..jit import save as jit_save
+    layer = None
+    for cand in (fetch_vars, program, kwargs.get("layer")):
+        if isinstance(cand, Layer):
+            layer = cand
+            break
+    if layer is None:
+        raise TypeError(
+            "save_inference_model on the TPU backend exports a Layer: pass "
+            "the model as fetch_vars/program (got "
+            f"{type(fetch_vars).__name__}); the StableHLO artifact is the "
+            "inference model.")
+    specs = feed_vars if isinstance(feed_vars, (list, tuple)) else [feed_vars]
+    jit_save(layer, path_prefix, input_spec=list(specs))
+    return path_prefix
 
 
 def load_inference_model(path_prefix, executor=None, **kwargs):

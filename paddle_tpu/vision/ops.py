@@ -1,6 +1,9 @@
 """Vision ops (reference: python/paddle/vision/ops.py) — detection helpers."""
 from __future__ import annotations
 
+import math
+
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -37,15 +40,229 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
 
 
 def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_size",
-              box_normalized=True, axis=0):
-    raise NotImplementedError("box_coder lands with the detection model family")
+              box_normalized=True, axis=0, name=None):
+    """Encode/decode boxes against priors (reference phi box_coder kernel).
+    Boxes are [x1, y1, x2, y2]."""
+    def impl(prior, target, *var):
+        pv = var[0] if var else None
+        norm = 0.0 if box_normalized else 1.0
+        pw = prior[:, 2] - prior[:, 0] + norm
+        ph = prior[:, 3] - prior[:, 1] + norm
+        pcx = prior[:, 0] + pw * 0.5
+        pcy = prior[:, 1] + ph * 0.5
+        if code_type in ("encode_center_size", "EncodeCenterSize"):
+            tw = target[:, 2] - target[:, 0] + norm
+            th = target[:, 3] - target[:, 1] + norm
+            tcx = target[:, 0] + tw * 0.5
+            tcy = target[:, 1] + th * 0.5
+            out = jnp.stack([(tcx[:, None] - pcx[None, :]) / pw[None, :],
+                             (tcy[:, None] - pcy[None, :]) / ph[None, :],
+                             jnp.log(tw[:, None] / pw[None, :]),
+                             jnp.log(th[:, None] / ph[None, :])], axis=-1)
+            if pv is not None:
+                out = out / pv[None, :, :]
+            return out
+        # decode_center_size: target [N, M, 4] deltas against M priors
+        t = target
+        if pv is not None:
+            t = t * (pv[None, :, :] if pv.ndim == 2 else pv)
+        if axis == 0:
+            pw_, ph_, pcx_, pcy_ = (a[None, :] for a in (pw, ph, pcx, pcy))
+        else:
+            pw_, ph_, pcx_, pcy_ = (a[:, None] for a in (pw, ph, pcx, pcy))
+        cx = t[..., 0] * pw_ + pcx_
+        cy = t[..., 1] * ph_ + pcy_
+        w = jnp.exp(t[..., 2]) * pw_
+        h = jnp.exp(t[..., 3]) * ph_
+        return jnp.stack([cx - w * 0.5, cy - h * 0.5,
+                          cx + w * 0.5 - norm, cy + h * 0.5 - norm], axis=-1)
+
+    if isinstance(prior_box_var, (list, tuple)):
+        # paddle accepts a 4-float list: broadcast to every prior
+        n_priors = prior_box.shape[0]
+        prior_box_var = jnp.broadcast_to(
+            jnp.asarray(prior_box_var, jnp.float32), (n_priors, 4))
+    args = [prior_box, target_box]
+    if prior_box_var is not None:
+        args.append(prior_box_var)
+    return op_call("box_coder", impl, *args)
 
 
 def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
-              sampling_ratio=-1, aligned=True):
-    raise NotImplementedError("roi_align lands with the detection model family")
+              sampling_ratio=-1, aligned=True, name=None):
+    """RoIAlign with bilinear sampling (reference phi roi_align kernel).
+    x: [B, C, H, W]; boxes: [R, 4] (x1,y1,x2,y2); boxes_num: [B] rois per
+    image. Static shapes: R and output_size fixed."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+
+    # adaptive sampling (reference: ceil(roi_size / pooled_size) per roi)
+    # needs concrete boxes — shapes must be static under jit; traced boxes
+    # fall back to 2 samples per bin axis
+    ns_static = sampling_ratio if sampling_ratio > 0 else 2
+    if sampling_ratio <= 0:
+        try:
+            bnp = np.asarray(boxes._value if hasattr(boxes, "_value") else boxes)
+            rh = (bnp[:, 3] - bnp[:, 1]) * spatial_scale
+            rw = (bnp[:, 2] - bnp[:, 0]) * spatial_scale
+            ns_static = max(1, int(max(
+                math.ceil(float(rh.max()) / ph),
+                math.ceil(float(rw.max()) / pw))))
+        except Exception:
+            pass  # tracer: keep the fixed fallback
+
+    def impl(xv, bv, bn):
+        B, C, H, W = xv.shape
+        R = bv.shape[0]
+        # map each roi to its image index from boxes_num
+        cum = jnp.cumsum(bn)
+        img_idx = jnp.sum(jnp.arange(R)[:, None] >= cum[None, :], axis=1)
+
+        off = 0.5 if aligned else 0.0
+        x1 = bv[:, 0] * spatial_scale - off
+        y1 = bv[:, 1] * spatial_scale - off
+        x2 = bv[:, 2] * spatial_scale - off
+        y2 = bv[:, 3] * spatial_scale - off
+        rw = x2 - x1
+        rh = y2 - y1
+        if not aligned:
+            rw = jnp.maximum(rw, 1.0)
+            rh = jnp.maximum(rh, 1.0)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        ns = ns_static
+
+        # sample grid: [R, ph, ns] y coords × [R, pw, ns] x coords
+        iy = (jnp.arange(ph)[None, :, None]
+              + (jnp.arange(ns)[None, None, :] + 0.5) / ns)
+        ys = y1[:, None, None] + iy * bin_h[:, None, None]      # [R, ph, ns]
+        ix = (jnp.arange(pw)[None, :, None]
+              + (jnp.arange(ns)[None, None, :] + 0.5) / ns)
+        xs = x1[:, None, None] + ix * bin_w[:, None, None]      # [R, pw, ns]
+
+        def bilinear(img, yy, xx):
+            # img [C, H, W]; yy [ph*ns], xx [pw*ns] -> [C, ph*ns, pw*ns]
+            y0 = jnp.clip(jnp.floor(yy), 0, H - 1)
+            x0 = jnp.clip(jnp.floor(xx), 0, W - 1)
+            y1i = jnp.clip(y0 + 1, 0, H - 1).astype(jnp.int32)
+            x1i = jnp.clip(x0 + 1, 0, W - 1).astype(jnp.int32)
+            y0i = y0.astype(jnp.int32)
+            x0i = x0.astype(jnp.int32)
+            wy1 = jnp.clip(yy - y0, 0.0, 1.0)
+            wx1 = jnp.clip(xx - x0, 0.0, 1.0)
+            wy0 = 1.0 - wy1
+            wx0 = 1.0 - wx1
+            v00 = img[:, y0i][:, :, x0i]
+            v01 = img[:, y0i][:, :, x1i]
+            v10 = img[:, y1i][:, :, x0i]
+            v11 = img[:, y1i][:, :, x1i]
+            return (v00 * (wy0[:, None] * wx0[None, :])[None]
+                    + v01 * (wy0[:, None] * wx1[None, :])[None]
+                    + v10 * (wy1[:, None] * wx0[None, :])[None]
+                    + v11 * (wy1[:, None] * wx1[None, :])[None])
+
+        def one_roi(r):
+            img = xv[img_idx[r]]
+            yy = ys[r].reshape(ph * ns)
+            xx = xs[r].reshape(pw * ns)
+            sampled = bilinear(img, yy, xx)           # [C, ph*ns, pw*ns]
+            sampled = sampled.reshape(C, ph, ns, pw, ns)
+            return jnp.mean(sampled, axis=(2, 4))     # [C, ph, pw]
+
+        return jax.vmap(one_roi)(jnp.arange(R))
+
+    return op_call("roi_align", impl, x, boxes, boxes_num)
 
 
 def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0, dilation=1,
-                  deformable_groups=1, groups=1, mask=None):
-    raise NotImplementedError("deform_conv2d lands with the detection model family")
+                  deformable_groups=1, groups=1, mask=None, name=None):
+    """Deformable conv v1/v2 (reference phi deformable_conv kernel): bilinear
+    sampling at offset positions + dense matmul. x [B,C,H,W]; offset
+    [B, 2*dg*kh*kw, Ho, Wo]; weight [Co, C/groups, kh, kw]; mask (v2)
+    [B, dg*kh*kw, Ho, Wo]."""
+    s = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    p = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    d = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+
+    def impl(xv, ov, wv, *rest):
+        mv = bv = None
+        rest = list(rest)
+        if mask is not None:
+            mv = rest.pop(0)
+        if bias is not None:
+            bv = rest.pop(0)
+        B, C, H, W = xv.shape
+        Co, Cg, kh, kw = wv.shape
+        Ho = (H + 2 * p[0] - d[0] * (kh - 1) - 1) // s[0] + 1
+        Wo = (W + 2 * p[1] - d[1] * (kw - 1) - 1) // s[1] + 1
+        dg = deformable_groups
+        cpg = C // dg                               # channels per deform group
+
+        # base sampling positions per output pixel per kernel tap
+        oy = jnp.arange(Ho) * s[0] - p[0]
+        ox = jnp.arange(Wo) * s[1] - p[1]
+        ky = jnp.arange(kh) * d[0]
+        kx = jnp.arange(kw) * d[1]
+        base_y = oy[:, None, None, None] + ky[None, None, :, None]  # [Ho,1,kh,1]
+        base_x = ox[None, :, None, None] + kx[None, None, None, :]  # [1,Wo,1,kw]
+        base_y = jnp.broadcast_to(base_y, (Ho, Wo, kh, kw)).astype(jnp.float32)
+        base_x = jnp.broadcast_to(base_x, (Ho, Wo, kh, kw)).astype(jnp.float32)
+
+        ov = ov.reshape(B, dg, kh * kw, 2, Ho, Wo)   # dy at [...,0], dx at 1
+        mvr = (mv.reshape(B, dg, kh * kw, Ho, Wo) if mv is not None else None)
+
+        def sample_img(img, yy, xx):
+            # img [cpg, H, W]; yy/xx [Ho, Wo, kh, kw]
+            y0 = jnp.floor(yy)
+            x0 = jnp.floor(xx)
+            wy1 = yy - y0
+            wx1 = xx - x0
+            out = 0.0
+            for (yi, wy) in ((y0, 1.0 - wy1), (y0 + 1, wy1)):
+                for (xi, wx) in ((x0, 1.0 - wx1), (x0 + 1, wx1)):
+                    valid = ((yi >= 0) & (yi < H) & (xi >= 0) & (xi < W))
+                    yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+                    xc = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+                    v = img[:, yc, xc]               # [cpg, Ho, Wo, kh, kw]
+                    out = out + v * (wy * wx * valid)[None]
+            return out
+
+        def one_image(xi, oi, mi):
+            cols = []
+            for g in range(dg):
+                yy = base_y + oi[g, :, 0].reshape(kh, kw, Ho, Wo) \
+                    .transpose(2, 3, 0, 1)
+                xx = base_x + oi[g, :, 1].reshape(kh, kw, Ho, Wo) \
+                    .transpose(2, 3, 0, 1)
+                sm = sample_img(xi[g * cpg:(g + 1) * cpg], yy, xx)
+                if mi is not None:
+                    sm = sm * mi[g].reshape(kh, kw, Ho, Wo) \
+                        .transpose(2, 3, 0, 1)[None]
+                cols.append(sm)
+            col = jnp.concatenate(cols, axis=0)       # [C, Ho, Wo, kh, kw]
+            col = col.transpose(1, 2, 0, 3, 4).reshape(Ho * Wo, C * kh * kw)
+            wmat = wv.reshape(Co, Cg * kh * kw)
+            if groups == 1:
+                out = col @ wmat.T                    # [Ho*Wo, Co]
+            else:
+                cols_g = col.reshape(Ho * Wo, groups, Cg * kh * kw)
+                w_g = wmat.reshape(groups, Co // groups, Cg * kh * kw)
+                out = jnp.einsum("ngk,gok->ngo", cols_g, w_g) \
+                    .reshape(Ho * Wo, Co)
+            return out.T.reshape(Co, Ho, Wo)
+
+        if mvr is not None:
+            out = jax.vmap(one_image)(xv, ov, mvr)
+        else:
+            out = jax.vmap(lambda xi, oi: one_image(xi, oi, None))(xv, ov)
+        if bv is not None:
+            out = out + bv[None, :, None, None]
+        return out
+
+    args = [x, offset, weight]
+    if mask is not None:
+        args.append(mask)
+    if bias is not None:
+        args.append(bias)
+    return op_call("deform_conv2d", impl, *args)

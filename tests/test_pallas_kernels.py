@@ -193,3 +193,79 @@ def test_fused_adamw_bf16_param_fp32_state():
     np_, nm, nv = res
     assert np_.dtype == jnp.bfloat16 and nm.dtype == jnp.float32
     assert bool(jnp.all(jnp.isfinite(nm)))
+
+
+# ---------------------------------------------------------------------------
+# Varlen / segment-ids (VERDICT r2 item #5 remainder)
+# ---------------------------------------------------------------------------
+def _ref_sdpa_segments(q, k, v, seg, causal):
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d)
+    mask = seg[:, None, :, None] == seg[:, None, None, :]
+    if causal:
+        sq = s.shape[-2]
+        mask = mask & jnp.tril(jnp.ones((sq, sq), bool))[None, None]
+    s = jnp.where(mask, s, -jnp.inf)
+    p = jax.nn.softmax(s, -1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_segment_ids_forward(causal):
+    B, S, H, D = 2, 256, 2, 64
+    q, k, v = (jnp.asarray(rng.standard_normal((B, S, H, D)).astype(np.float32))
+               for _ in range(3))
+    # two packed sequences per row: [0]*100 + [1]*156 (crosses block bounds)
+    seg = jnp.asarray(np.concatenate([np.zeros(100), np.ones(156)])[None]
+                      .repeat(B, 0).astype(np.int32))
+    out = flash_attention(q, k, v, causal=causal, interpret=True,
+                          segment_ids=seg)
+    assert out is not None
+    ref = _ref_sdpa_segments(q, k, v, seg, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_segment_ids_grads(causal):
+    B, S, H, D = 1, 128, 2, 64
+    q, k, v = (jnp.asarray(rng.standard_normal((B, S, H, D)).astype(np.float32))
+               for _ in range(3))
+    seg = jnp.asarray(np.concatenate([np.zeros(48), np.ones(80)])[None]
+                      .astype(np.int32))
+
+    def loss_fa(q, k, v):
+        return (flash_attention(q, k, v, causal=causal, interpret=True,
+                                segment_ids=seg) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        return (_ref_sdpa_segments(q, k, v, seg, causal) ** 2).sum()
+
+    g_fa = jax.grad(loss_fa, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_fa, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=3e-4, atol=3e-4)
+
+
+def test_flash_attn_unpadded_matches_per_sequence():
+    """Packed varlen == attending each sequence separately."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    H, D = 2, 32
+    lens = [5, 9, 4]
+    total = sum(lens)
+    qkv = rng.standard_normal((3, total, H, D)).astype(np.float32)
+    cu = np.cumsum([0] + lens).astype(np.int32)
+    out, _ = F.flash_attn_unpadded(
+        paddle.to_tensor(qkv[0]), paddle.to_tensor(qkv[1]),
+        paddle.to_tensor(qkv[2]), paddle.to_tensor(cu), paddle.to_tensor(cu),
+        max(lens), max(lens), causal=True)
+    out = np.asarray(out.numpy())
+    for i in range(len(lens)):
+        lo, hi = cu[i], cu[i + 1]
+        ref = _ref_sdpa(jnp.asarray(qkv[0][None, lo:hi]),
+                        jnp.asarray(qkv[1][None, lo:hi]),
+                        jnp.asarray(qkv[2][None, lo:hi]), True)
+        np.testing.assert_allclose(out[lo:hi], np.asarray(ref)[0],
+                                   rtol=2e-4, atol=2e-5)
