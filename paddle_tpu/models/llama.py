@@ -45,6 +45,12 @@ class LlamaConfig:
     use_flash_attention: bool = True
     tensor_parallel_degree: int = 1
     dtype: str = "float32"
+    # MoE variant (LLaMA-MoE / Mixtral-style): num_experts > 1 swaps the
+    # dense MLP for a MoELayer of per-expert SwiGLU FFNs
+    num_experts: int = 1
+    moe_topk: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_loss_weight: float = 0.01
 
 
 def llama_config_7b():
@@ -148,13 +154,55 @@ class LlamaMLP(Layer):
         return self.down_proj(F.swiglu(self.gate_proj(x), self.up_proj(x)))
 
 
+class LlamaMoEBlock(Layer):
+    """Mixtral/LLaMA-MoE-style sparse MLP: MoELayer over per-expert SwiGLU
+    FFNs (expert-parallel-ready via incubate moe; dense eager here)."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        if config.tensor_parallel_degree > 1:
+            raise NotImplementedError(
+                "LlamaMoEBlock: tensor-parallel experts are not implemented "
+                "— use expert parallelism (incubate moe ep_axis / moe_ffn "
+                "over an 'ep' mesh axis) instead of mp for the MoE variant")
+        from ..incubate.distributed.models.moe import MoELayer
+
+        class _Expert(Layer):
+            def __init__(self, c):
+                super().__init__()
+                self.gate_proj = Linear(c.hidden_size, c.intermediate_size,
+                                        bias_attr=False)
+                self.up_proj = Linear(c.hidden_size, c.intermediate_size,
+                                      bias_attr=False)
+                self.down_proj = Linear(c.intermediate_size, c.hidden_size,
+                                        bias_attr=False)
+
+            def forward(self, x):
+                return self.down_proj(
+                    F.swiglu(self.gate_proj(x), self.up_proj(x)))
+
+        self.moe = MoELayer(
+            d_model=config.hidden_size,
+            experts=[_Expert(config) for _ in range(config.num_experts)],
+            gate={"type": "gshard", "top_k": config.moe_topk},
+            capacity_factor=config.moe_capacity_factor)
+
+    def forward(self, x):
+        return self.moe(x)
+
+    def aux_loss(self):
+        l = self.moe.gate.get_loss()
+        return l
+
+
 class LlamaDecoderLayer(Layer):
     def __init__(self, config: LlamaConfig):
         super().__init__()
         self.input_layernorm = RMSNorm(config.hidden_size, config.rms_norm_eps)
         self.self_attn = LlamaAttention(config)
         self.post_attention_layernorm = RMSNorm(config.hidden_size, config.rms_norm_eps)
-        self.mlp = LlamaMLP(config)
+        self.mlp = LlamaMoEBlock(config) if config.num_experts > 1 \
+            else LlamaMLP(config)
 
     def forward(self, x, sin=None, cos=None):
         x = x + self.self_attn(self.input_layernorm(x), sin, cos)
@@ -205,6 +253,12 @@ class LlamaForCausalLM(Layer):
             loss = F.cross_entropy(
                 manip.reshape(logits, [-1, self.config.vocab_size]),
                 manip.reshape(labels, [-1]))
+            if self.config.num_experts > 1:
+                # collect per-layer MoE balance losses (Mixtral aux loss)
+                for layer in self.model.layers:
+                    aux = layer.mlp.aux_loss()
+                    if aux is not None:
+                        loss = loss + self.config.moe_aux_loss_weight * aux
             return loss, logits
         return logits
 

@@ -45,6 +45,7 @@ def _export_onnx(layer, path, input_spec, opset_version):
     from ..core.tensor import Tensor
     from ..nn.layer import functional_state
 
+    was_training = getattr(layer, "training", False)
     layer.eval()
     state = {n: p._value for n, p in layer.named_parameters()}
     state.update({n: b._value for n, b in layer.named_buffers()})
@@ -63,4 +64,6 @@ def _export_onnx(layer, path, input_spec, opset_version):
     out_path = path + ".onnx"
     with open(out_path, "wb") as f:
         f.write(onnx_model.SerializeToString())
+    if was_training:
+        layer.train()   # export must not mutate the caller's mode
     return out_path

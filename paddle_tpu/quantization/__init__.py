@@ -111,10 +111,12 @@ class EMAObserver(AbsMaxObserver):
 
 
 def quantize_weight(w, bits=8):
-    """-> (int8 values, scale): symmetric per-tensor quantization."""
+    """-> (int values, scale): symmetric per-tensor quantization (int8
+    storage up to 8 bits, int32 above)."""
     qmax = 2.0 ** (bits - 1) - 1
     scale = jnp.maximum(jnp.max(jnp.abs(w)), 1e-8) / qmax
-    q = jnp.clip(jnp.round(w / scale), -qmax - 1, qmax).astype(jnp.int8)
+    idtype = jnp.int8 if bits <= 8 else jnp.int32
+    q = jnp.clip(jnp.round(w / scale), -qmax - 1, qmax).astype(idtype)
     return q, scale
 
 
@@ -132,12 +134,31 @@ class PTQ(QAT):
         super().__init__(config or QuantConfig())
         self._observers = {}
 
+    def _make_activation_observer(self):
+        """Honor QuantConfig.activation: an observer instance (used as a
+        template) or class; default AbsMaxObserver(8)."""
+        tmpl = self.config.activation
+        if tmpl is None:
+            return AbsMaxObserver()
+        if isinstance(tmpl, type):
+            return tmpl()
+        obs = type(tmpl)(quant_bits=tmpl.bits)
+        if isinstance(tmpl, EMAObserver):
+            obs.momentum = tmpl.momentum
+        return obs
+
+    def _weight_bits(self):
+        w = self.config.weight
+        if w is None:
+            return 8
+        return getattr(w, "bits", w if isinstance(w, int) else 8)
+
     def quantize(self, model: Layer, inplace=False):
         """Install calibration observers (run sample batches afterwards)."""
         from ..nn import Linear, Conv2D
         for name, sub in model.named_sublayers(include_self=True):
             if isinstance(sub, (Linear, Conv2D)):
-                obs = AbsMaxObserver()
+                obs = self._make_activation_observer()
 
                 def hook(layer, inputs, _obs=obs):
                     for i in inputs:
@@ -152,12 +173,13 @@ class PTQ(QAT):
         """Bake scales: weights move onto the int8 grid (stored dequantized
         for TPU matmul; int values + scales attached for serialization).
         Calibration hooks are removed — converted models jit cleanly."""
+        bits = self._weight_bits()
         for name, (sub, obs, handle) in self._observers.items():
             try:
                 handle.remove()
             except Exception:
                 pass
-            q, w_scale = quantize_weight(sub.weight._value)
+            q, w_scale = quantize_weight(sub.weight._value, bits=bits)
             sub.weight._set_value(dequantize_weight(q, w_scale,
                                                     sub.weight._value.dtype))
             sub.weight_quant = {"int_values": q, "scale": float(w_scale)}

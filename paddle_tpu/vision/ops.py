@@ -65,7 +65,11 @@ def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_siz
         # decode_center_size: target [N, M, 4] deltas against M priors
         t = target
         if pv is not None:
-            t = t * (pv[None, :, :] if pv.ndim == 2 else pv)
+            if pv.ndim == 2:
+                # broadcast the per-prior variance along the SAME axis the
+                # prior geometry uses (axis = which target dim indexes priors)
+                pv = pv[None, :, :] if axis == 0 else pv[:, None, :]
+            t = t * pv
         if axis == 0:
             pw_, ph_, pcx_, pcy_ = (a[None, :] for a in (pw, ph, pcx, pcy))
         else:
@@ -97,18 +101,19 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
         output_size = (output_size, output_size)
     ph, pw = output_size
 
-    # adaptive sampling (reference: ceil(roi_size / pooled_size) per roi)
-    # needs concrete boxes — shapes must be static under jit; traced boxes
-    # fall back to 2 samples per bin axis
+    # adaptive sampling (reference: ceil(roi_size / pooled_size) PER ROI)
+    # needs concrete boxes — under jit (traced boxes) shapes must be static,
+    # so the fallback samples a fixed 2 points per bin axis
     ns_static = sampling_ratio if sampling_ratio > 0 else 2
+    ns_per_roi = None
     if sampling_ratio <= 0:
         try:
             bnp = np.asarray(boxes._value if hasattr(boxes, "_value") else boxes)
             rh = (bnp[:, 3] - bnp[:, 1]) * spatial_scale
             rw = (bnp[:, 2] - bnp[:, 0]) * spatial_scale
-            ns_static = max(1, int(max(
-                math.ceil(float(rh.max()) / ph),
-                math.ceil(float(rw.max()) / pw))))
+            ns_per_roi = [max(1, int(max(math.ceil(float(rh[r]) / ph),
+                                         math.ceil(float(rw[r]) / pw))))
+                          for r in range(len(bnp))]
         except Exception:
             pass  # tracer: keep the fixed fallback
 
@@ -131,15 +136,6 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
             rh = jnp.maximum(rh, 1.0)
         bin_h = rh / ph
         bin_w = rw / pw
-        ns = ns_static
-
-        # sample grid: [R, ph, ns] y coords × [R, pw, ns] x coords
-        iy = (jnp.arange(ph)[None, :, None]
-              + (jnp.arange(ns)[None, None, :] + 0.5) / ns)
-        ys = y1[:, None, None] + iy * bin_h[:, None, None]      # [R, ph, ns]
-        ix = (jnp.arange(pw)[None, :, None]
-              + (jnp.arange(ns)[None, None, :] + 0.5) / ns)
-        xs = x1[:, None, None] + ix * bin_w[:, None, None]      # [R, pw, ns]
 
         def bilinear(img, yy, xx):
             # img [C, H, W]; yy [ph*ns], xx [pw*ns] -> [C, ph*ns, pw*ns]
@@ -162,15 +158,22 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
                     + v10 * (wy1[:, None] * wx0[None, :])[None]
                     + v11 * (wy1[:, None] * wx1[None, :])[None])
 
-        def one_roi(r):
+        def one_roi(r, ns):
             img = xv[img_idx[r]]
-            yy = ys[r].reshape(ph * ns)
-            xx = xs[r].reshape(pw * ns)
+            iy = (jnp.arange(ph)[:, None]
+                  + (jnp.arange(ns)[None, :] + 0.5) / ns)       # [ph, ns]
+            yy = (y1[r] + iy * bin_h[r]).reshape(ph * ns)
+            ix = (jnp.arange(pw)[:, None]
+                  + (jnp.arange(ns)[None, :] + 0.5) / ns)
+            xx = (x1[r] + ix * bin_w[r]).reshape(pw * ns)
             sampled = bilinear(img, yy, xx)           # [C, ph*ns, pw*ns]
             sampled = sampled.reshape(C, ph, ns, pw, ns)
             return jnp.mean(sampled, axis=(2, 4))     # [C, ph, pw]
 
-        return jax.vmap(one_roi)(jnp.arange(R))
+        if ns_per_roi is not None:
+            # eager adaptive path: per-roi sample counts (reference parity)
+            return jnp.stack([one_roi(r, ns_per_roi[r]) for r in range(R)])
+        return jax.vmap(lambda r: one_roi(r, ns_static))(jnp.arange(R))
 
     return op_call("roi_align", impl, x, boxes, boxes_num)
 
