@@ -1,0 +1,166 @@
+"""ElasticManager (reference fleet/elastic/manager.py:125).
+
+The reference watches an etcd prefix: each trainer registers
+`/job/nodes/<host>` with a TTL lease; the manager reacts to node
+join/leave by regenerating `PADDLE_TRAINER_ENDPOINTS`/rank env and
+relaunching trainers (fault tolerance = relaunch from checkpoint).
+
+TPU-native collapse: the coordination role is a pluggable `Store` —
+`MemoryStore` (in-process, tests), `FileStore` (shared filesystem, the
+single-host/NFS analog of etcd; heartbeat files with mtime as the TTL
+lease). The manager's state machine matches the reference:
+
+  register(host)           — lease registration
+  watch() -> ElasticStatus — HOLD (stable) / CHANGE (membership moved)
+                             / EXIT (below np_min after grace)
+  rank_map()               — deterministic host → rank assignment
+  on_change(cb)            — relaunch trigger (launch_gang restart hook)
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+import os
+import time
+from typing import Callable, Dict, List, Optional
+
+__all__ = ["ElasticStatus", "ElasticManager", "FileStore", "MemoryStore"]
+
+
+class ElasticStatus(enum.Enum):
+    HOLD = "hold"        # membership stable
+    CHANGE = "change"    # nodes joined/left within [np_min, np_max]
+    EXIT = "exit"        # below np_min past the grace period
+
+
+class MemoryStore:
+    """In-process membership store (unit tests / single-controller)."""
+
+    def __init__(self):
+        self._beats: Dict[str, float] = {}
+
+    def heartbeat(self, host: str, ts: float = None):
+        self._beats[host] = ts if ts is not None else time.time()
+
+    def remove(self, host: str):
+        self._beats.pop(host, None)
+
+    def alive(self, timeout: float) -> List[str]:
+        now = time.time()
+        return sorted(h for h, t in self._beats.items()
+                      if now - t <= timeout)
+
+
+class FileStore:
+    """Shared-filesystem membership store (the etcd-lease analog for
+    single-host / NFS deployments): one heartbeat file per host, mtime is
+    the lease."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, host):
+        return os.path.join(self.root, f"node.{host.replace(':', '_')}")
+
+    def heartbeat(self, host: str, ts: float = None):
+        p = self._path(host)
+        tmp = p + ".tmp"
+        # atomic rename: a concurrent alive() must never read a truncated
+        # host string (NFS deployment is this store's stated purpose)
+        with open(tmp, "w") as f:
+            f.write(host)
+        if ts is not None:
+            os.utime(tmp, (ts, ts))
+        os.replace(tmp, p)
+
+    def remove(self, host: str):
+        try:
+            os.unlink(self._path(host))
+        except FileNotFoundError:
+            pass
+
+    def alive(self, timeout: float) -> List[str]:
+        now = time.time()
+        out = []
+        for fn in os.listdir(self.root):
+            if not fn.startswith("node."):
+                continue
+            p = os.path.join(self.root, fn)
+            try:
+                if now - os.path.getmtime(p) <= timeout:
+                    out.append(open(p).read().strip())
+            except OSError:
+                continue
+        return sorted(out)
+
+
+@dataclasses.dataclass
+class _State:
+    members: tuple = ()
+    below_since: Optional[float] = None
+
+
+class ElasticManager:
+    """reference manager.py:125: membership watch + rank regeneration.
+
+    np_min/np_max: the elastic range (reference --np=min:max). A membership
+    change inside the range returns CHANGE (caller relaunches with the new
+    rank map, resuming from checkpoint); dropping below np_min starts the
+    grace clock and returns EXIT once expired.
+    """
+
+    def __init__(self, store, np_min: int, np_max: int = None,
+                 heartbeat_timeout: float = 10.0, grace_period: float = 30.0):
+        self.store = store
+        self.np_min = np_min
+        self.np_max = np_max or np_min
+        self.heartbeat_timeout = heartbeat_timeout
+        self.grace_period = grace_period
+        self._state = _State()
+        self._callbacks: List[Callable] = []
+
+    # -- lease/registration --------------------------------------------------
+    def register(self, host: str):
+        self.store.heartbeat(host)
+
+    def heartbeat(self, host: str):
+        self.store.heartbeat(host)
+
+    def deregister(self, host: str):
+        self.store.remove(host)
+
+    # -- membership ----------------------------------------------------------
+    def members(self) -> List[str]:
+        m = self.store.alive(self.heartbeat_timeout)
+        return m[: self.np_max]
+
+    def rank_map(self) -> Dict[str, int]:
+        """Deterministic host→rank map (sorted order, reference re-rank)."""
+        return {h: i for i, h in enumerate(self.members())}
+
+    def endpoints(self) -> str:
+        return ",".join(self.members())
+
+    def on_change(self, cb: Callable):
+        self._callbacks.append(cb)
+
+    # -- the watch step ------------------------------------------------------
+    def watch(self) -> ElasticStatus:
+        cur = tuple(self.members())
+        prev = self._state.members
+        if len(cur) < self.np_min:
+            if self._state.below_since is None:
+                self._state.below_since = time.time()
+            elif time.time() - self._state.below_since > self.grace_period:
+                return ElasticStatus.EXIT
+            self._state.members = cur
+            return ElasticStatus.HOLD   # waiting out the grace period
+        self._state.below_since = None
+        if prev and cur != prev:
+            self._state.members = cur
+            for cb in self._callbacks:
+                cb(self.rank_map())
+            return ElasticStatus.CHANGE
+        self._state.members = cur
+        return ElasticStatus.HOLD

@@ -48,9 +48,9 @@ def _free_port():
 def _parse_args(argv):
     p = argparse.ArgumentParser(
         prog="python -m paddle_tpu.distributed.launch",
-        description="paddle_tpu distributed launcher")
-    p.add_argument("--nproc_per_node", type=int,
-                   default=int(os.environ.get("PADDLE_NPROC_PER_NODE", 1)))
+        description="paddle_tpu distributed launcher",
+        allow_abbrev=False)  # '--np' must never be read as --nproc_per_node
+    p.add_argument("--nproc_per_node", type=int, default=None)
     p.add_argument("--nnodes", type=int, default=1)
     p.add_argument("--node_rank", type=int, default=0)
     p.add_argument("--master", type=str, default=None,
@@ -60,6 +60,9 @@ def _parse_args(argv):
     p.add_argument("--log_dir", type=str, default=None)
     p.add_argument("--max_restarts", type=int, default=0,
                    help="relaunch the gang up to N times on failure")
+    p.add_argument("--np", type=str, default=None,
+                   help="elastic range 'min:max' (reference --np): start at "
+                        "max procs, scale in toward min on repeated failure")
     p.add_argument("--devices", type=str, default=None,
                    help="comma list of device ids to pin per local rank")
     p.add_argument("script", type=str)
@@ -191,11 +194,62 @@ def main(argv=None):
         script_args = script_args[1:]
     cmd = [sys.executable, "-u", args.script] + script_args
     devices = args.devices.split(",") if args.devices else None
-    rc = launch_gang(cmd, nproc=args.nproc_per_node, master=args.master,
+    if args.np:
+        if args.nproc_per_node is not None:
+            sys.exit("[launch] --np and --nproc_per_node are mutually "
+                     "exclusive")
+        if args.nnodes != 1:
+            sys.exit("[launch] elastic --np supports single-node gangs only "
+                     "(multi-node membership needs a shared coordination "
+                     "store; see fleet.elastic.ElasticManager + FileStore)")
+        parts = args.np.split(":")
+        try:
+            np_min = int(parts[0])
+            np_max = int(parts[1]) if len(parts) > 1 else np_min
+            if len(parts) > 2 or np_min < 1 or np_min > np_max:
+                raise ValueError
+        except ValueError:
+            sys.exit(f"[launch] invalid --np {args.np!r}: expected "
+                     "'min:max' with 1 <= min <= max")
+        sys.exit(_elastic_loop(cmd, np_min, np_max, args, devices))
+    nproc = args.nproc_per_node if args.nproc_per_node is not None else \
+        int(os.environ.get("PADDLE_NPROC_PER_NODE", 1))
+    rc = launch_gang(cmd, nproc=nproc, master=args.master,
                      nnodes=args.nnodes, node_rank=args.node_rank,
                      log_dir=args.log_dir, max_restarts=args.max_restarts,
                      devices=devices)
     sys.exit(rc)
+
+
+def _elastic_loop(cmd, np_min, np_max, args, devices):
+    """Elastic mode (reference CollectiveElasticController): the membership
+    store holds one slot per local worker; a gang failure retires a slot
+    (the node-leave analog), ElasticManager.watch() reports the CHANGE, and
+    the gang relaunches at the new world size until EXIT below np_min."""
+    from ..fleet.elastic import ElasticManager, ElasticStatus, MemoryStore
+
+    store = MemoryStore()
+    mgr = ElasticManager(store, np_min=np_min, np_max=np_max,
+                         heartbeat_timeout=1e9, grace_period=0.0)
+    for i in range(np_max):
+        mgr.register(f"local:{i}")
+    mgr.watch()                                  # seed the stable membership
+    while True:
+        world = len(mgr.members())
+        rc = launch_gang(cmd, nproc=world, master=args.master,
+                         nnodes=1, node_rank=0, log_dir=args.log_dir,
+                         max_restarts=args.max_restarts, devices=devices)
+        if rc == 0:
+            return 0
+        # retire one slot and consult the manager
+        mgr.deregister(mgr.members()[-1])
+        status = mgr.watch()
+        if status == ElasticStatus.EXIT or len(mgr.members()) < np_min:
+            print(f"[launch] elastic: below np_min={np_min}; giving up",
+                  file=sys.stderr)
+            return rc
+        print(f"[launch] elastic: gang of {world} failed rc={rc}; "
+              f"scaling in to {len(mgr.members())}", file=sys.stderr)
 
 
 if __name__ == "__main__":

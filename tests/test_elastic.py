@@ -1,0 +1,104 @@
+"""Elastic manager tests (VERDICT aux-subsystem gap "failure detection /
+elastic: no"; reference fleet/elastic/manager.py:125): membership watch,
+rank-map regeneration on join/leave, grace-period exit, and the launch
+CLI's elastic scale-in."""
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.fleet.elastic import (ElasticManager,
+                                                  ElasticStatus, FileStore,
+                                                  MemoryStore)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_memory_store_membership_and_expiry():
+    st = MemoryStore()
+    st.heartbeat("a:1")
+    st.heartbeat("b:2")
+    assert st.alive(10.0) == ["a:1", "b:2"]
+    st.heartbeat("a:1", ts=time.time() - 100)   # stale lease
+    assert st.alive(10.0) == ["b:2"]
+
+
+def test_file_store_cross_process_semantics(tmp_path):
+    root = str(tmp_path / "store")
+    st1 = FileStore(root)
+    st2 = FileStore(root)                        # "another host"
+    st1.heartbeat("h1:7000")
+    st2.heartbeat("h2:7000")
+    assert st1.alive(10.0) == ["h1:7000", "h2:7000"]
+    st2.heartbeat("h2:7000", ts=time.time() - 60)  # lease expired
+    assert st1.alive(10.0) == ["h1:7000"]
+    st1.remove("h1:7000")
+    assert st1.alive(10.0) == []
+
+
+def test_manager_change_on_join_and_leave():
+    st = MemoryStore()
+    mgr = ElasticManager(st, np_min=1, np_max=4, heartbeat_timeout=10.0)
+    mgr.register("n0:1")
+    mgr.register("n1:1")
+    assert mgr.watch() == ElasticStatus.HOLD     # first observation
+    assert mgr.watch() == ElasticStatus.HOLD     # stable
+
+    events = []
+    mgr.on_change(lambda rm: events.append(rm))
+    mgr.register("n2:1")                         # scale out
+    assert mgr.watch() == ElasticStatus.CHANGE
+    assert events[-1] == {"n0:1": 0, "n1:1": 1, "n2:1": 2}
+
+    st.remove("n2:1")                            # scale in
+    assert mgr.watch() == ElasticStatus.CHANGE
+    assert events[-1] == {"n0:1": 0, "n1:1": 1}
+    assert mgr.endpoints() == "n0:1,n1:1"
+
+
+def test_manager_grace_period_then_exit():
+    st = MemoryStore()
+    mgr = ElasticManager(st, np_min=2, np_max=4, heartbeat_timeout=10.0,
+                         grace_period=0.2)
+    mgr.register("n0:1")
+    mgr.register("n1:1")
+    assert mgr.watch() == ElasticStatus.HOLD
+    st.remove("n1:1")                            # below np_min
+    assert mgr.watch() == ElasticStatus.HOLD     # grace clock running
+    time.sleep(0.3)
+    assert mgr.watch() == ElasticStatus.EXIT
+
+
+def test_manager_caps_members_at_np_max():
+    st = MemoryStore()
+    mgr = ElasticManager(st, np_min=1, np_max=2)
+    for i in range(4):
+        mgr.register(f"n{i}:1")
+    assert len(mgr.members()) == 2
+    assert mgr.rank_map() == {"n0:1": 0, "n1:1": 1}
+
+
+def test_launch_elastic_scale_in(tmp_path):
+    """--np 1:2: rank gang of 2 always fails (rank 1 exits 1), the elastic
+    loop scales in to a single-proc gang which succeeds."""
+    script = tmp_path / "rank.py"
+    script.write_text(
+        "import os, sys\n"
+        "world = int(os.environ['PADDLE_TRAINERS_NUM'])\n"
+        "rank = int(os.environ['PADDLE_TRAINER_ID'])\n"
+        "if world > 1 and rank == world - 1:\n"
+        "    sys.exit(7)\n"
+        "print(f'ELASTIC_OK world={world}')\n")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch", "--np", "1:2",
+         str(script)],
+        env=env, cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout
+    assert "ELASTIC_OK world=1" in proc.stdout, proc.stdout
+    assert "scaling in" in proc.stdout, proc.stdout
