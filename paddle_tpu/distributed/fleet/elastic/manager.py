@@ -99,6 +99,7 @@ class FileStore:
 class _State:
     members: tuple = ()
     below_since: Optional[float] = None
+    seen: bool = False          # first watch() is an observation, not a CHANGE
 
 
 class ElasticManager:
@@ -132,8 +133,18 @@ class ElasticManager:
 
     # -- membership ----------------------------------------------------------
     def members(self) -> List[str]:
-        m = self.store.alive(self.heartbeat_timeout)
-        return m[: self.np_max]
+        alive = self.store.alive(self.heartbeat_timeout)
+        if len(alive) <= self.np_max:
+            return alive
+        # at capacity: keep currently-active members (a joiner must not
+        # evict a healthy worker), fill remaining slots in sorted order
+        keep = [h for h in self._state.members if h in alive]
+        for h in alive:
+            if len(keep) >= self.np_max:
+                break
+            if h not in keep:
+                keep.append(h)
+        return sorted(keep[: self.np_max])
 
     def rank_map(self) -> Dict[str, int]:
         """Deterministic host→rank map (sorted order, reference re-rank)."""
@@ -157,10 +168,11 @@ class ElasticManager:
             self._state.members = cur
             return ElasticStatus.HOLD   # waiting out the grace period
         self._state.below_since = None
-        if prev and cur != prev:
+        if self._state.seen and cur != prev:
             self._state.members = cur
             for cb in self._callbacks:
                 cb(self.rank_map())
             return ElasticStatus.CHANGE
         self._state.members = cur
+        self._state.seen = True
         return ElasticStatus.HOLD

@@ -226,7 +226,7 @@ def _elastic_loop(cmd, np_min, np_max, args, devices):
     store holds one slot per local worker; a gang failure retires a slot
     (the node-leave analog), ElasticManager.watch() reports the CHANGE, and
     the gang relaunches at the new world size until EXIT below np_min."""
-    from ..fleet.elastic import ElasticManager, ElasticStatus, MemoryStore
+    from ..fleet.elastic import ElasticManager, MemoryStore
 
     store = MemoryStore()
     mgr = ElasticManager(store, np_min=np_min, np_max=np_max,
@@ -243,8 +243,8 @@ def _elastic_loop(cmd, np_min, np_max, args, devices):
             return 0
         # retire one slot and consult the manager
         mgr.deregister(mgr.members()[-1])
-        status = mgr.watch()
-        if status == ElasticStatus.EXIT or len(mgr.members()) < np_min:
+        mgr.watch()
+        if len(mgr.members()) < np_min:
             print(f"[launch] elastic: below np_min={np_min}; giving up",
                   file=sys.stderr)
             return rc

@@ -7,8 +7,6 @@ import subprocess
 import sys
 import time
 
-import numpy as np
-import pytest
 
 from paddle_tpu.distributed.fleet.elastic import (ElasticManager,
                                                   ElasticStatus, FileStore,
@@ -102,3 +100,32 @@ def test_launch_elastic_scale_in(tmp_path):
     assert proc.returncode == 0, proc.stdout
     assert "ELASTIC_OK world=1" in proc.stdout, proc.stdout
     assert "scaling in" in proc.stdout, proc.stdout
+
+
+def test_manager_change_fires_after_full_replacement():
+    """Regression: members fully replaced after a transient empty window
+    must still produce CHANGE (empty prev is not 'first observation')."""
+    st = MemoryStore()
+    mgr = ElasticManager(st, np_min=2, np_max=4, heartbeat_timeout=10.0,
+                         grace_period=60.0)
+    mgr.register("a:1")
+    mgr.register("b:1")
+    assert mgr.watch() == ElasticStatus.HOLD
+    st.remove("a:1")
+    st.remove("b:1")
+    assert mgr.watch() == ElasticStatus.HOLD     # grace running, members=()
+    mgr.register("c:1")
+    mgr.register("d:1")
+    assert mgr.watch() == ElasticStatus.CHANGE   # replacement detected
+    assert mgr.rank_map() == {"c:1": 0, "d:1": 1}
+
+
+def test_joiner_does_not_evict_active_member_at_capacity():
+    st = MemoryStore()
+    mgr = ElasticManager(st, np_min=1, np_max=2, heartbeat_timeout=10.0)
+    mgr.register("b:1")
+    mgr.register("c:1")
+    assert mgr.watch() == ElasticStatus.HOLD
+    mgr.register("a:1")                          # lexicographically first
+    assert mgr.watch() == ElasticStatus.HOLD     # no eviction at capacity
+    assert mgr.members() == ["b:1", "c:1"]
