@@ -34,21 +34,30 @@ class ElasticStatus(enum.Enum):
 
 
 class MemoryStore:
-    """In-process membership store (unit tests / single-controller)."""
+    """In-process membership store (unit tests / single-controller).
+
+    alive() orders by seniority (first registration time, then host name):
+    every manager derives the SAME membership prefix from the same store
+    state, so truncation at np_max is deterministic and joiners cannot
+    evict senior members."""
 
     def __init__(self):
         self._beats: Dict[str, float] = {}
+        self._first: Dict[str, float] = {}
 
     def heartbeat(self, host: str, ts: float = None):
-        self._beats[host] = ts if ts is not None else time.time()
+        now = ts if ts is not None else time.time()
+        self._beats[host] = now
+        self._first.setdefault(host, now)
 
     def remove(self, host: str):
         self._beats.pop(host, None)
+        self._first.pop(host, None)
 
     def alive(self, timeout: float) -> List[str]:
         now = time.time()
-        return sorted(h for h, t in self._beats.items()
-                      if now - t <= timeout)
+        live = [h for h, t in self._beats.items() if now - t <= timeout]
+        return sorted(live, key=lambda h: (self._first.get(h, 0.0), h))
 
 
 class FileStore:
@@ -65,11 +74,19 @@ class FileStore:
 
     def heartbeat(self, host: str, ts: float = None):
         p = self._path(host)
+        # preserve the first-registration time across beats (seniority key)
+        first = None
+        try:
+            first = open(p).read().split("\n")[1]
+        except (OSError, IndexError):
+            pass
+        if first is None:
+            first = repr(ts if ts is not None else time.time())
         tmp = p + ".tmp"
         # atomic rename: a concurrent alive() must never read a truncated
         # host string (NFS deployment is this store's stated purpose)
         with open(tmp, "w") as f:
-            f.write(host)
+            f.write(f"{host}\n{first}")
         if ts is not None:
             os.utime(tmp, (ts, ts))
         os.replace(tmp, p)
@@ -81,6 +98,8 @@ class FileStore:
             pass
 
     def alive(self, timeout: float) -> List[str]:
+        """Live hosts ordered by (first registration, host) — the same
+        deterministic prefix on every manager reading this store."""
         now = time.time()
         out = []
         for fn in os.listdir(self.root):
@@ -89,10 +108,14 @@ class FileStore:
             p = os.path.join(self.root, fn)
             try:
                 if now - os.path.getmtime(p) <= timeout:
-                    out.append(open(p).read().strip())
-            except OSError:
+                    parts = open(p).read().split("\n")
+                    host = parts[0].strip()
+                    first = float(parts[1]) if len(parts) > 1 else 0.0
+                    if host:
+                        out.append((first, host))
+            except (OSError, ValueError):
                 continue
-        return sorted(out)
+        return [h for _, h in sorted(out)]
 
 
 @dataclasses.dataclass
@@ -133,18 +156,11 @@ class ElasticManager:
 
     # -- membership ----------------------------------------------------------
     def members(self) -> List[str]:
-        alive = self.store.alive(self.heartbeat_timeout)
-        if len(alive) <= self.np_max:
-            return alive
-        # at capacity: keep currently-active members (a joiner must not
-        # evict a healthy worker), fill remaining slots in sorted order
-        keep = [h for h in self._state.members if h in alive]
-        for h in alive:
-            if len(keep) >= self.np_max:
-                break
-            if h not in keep:
-                keep.append(h)
-        return sorted(keep[: self.np_max])
+        """First np_max live hosts in the store's seniority order — pure
+        function of store state, so every manager (including a freshly
+        started one) derives the same membership, and a joiner can never
+        evict a senior active worker at capacity."""
+        return self.store.alive(self.heartbeat_timeout)[: self.np_max]
 
     def rank_map(self) -> Dict[str, int]:
         """Deterministic host→rank map (sorted order, reference re-rank)."""

@@ -224,8 +224,8 @@ def main(argv=None):
 def _elastic_loop(cmd, np_min, np_max, args, devices):
     """Elastic mode (reference CollectiveElasticController): the membership
     store holds one slot per local worker; a gang failure retires a slot
-    (the node-leave analog), ElasticManager.watch() reports the CHANGE, and
-    the gang relaunches at the new world size until EXIT below np_min."""
+    (the node-leave analog) and the gang relaunches at the surviving
+    member count, giving up once membership drops below np_min."""
     from ..fleet.elastic import ElasticManager, MemoryStore
 
     store = MemoryStore()

@@ -266,7 +266,8 @@ class LlamaForCausalLM(Layer):
 # ---------------------------------------------------------------------------
 # Functional form (pipeline/bench path)
 # ---------------------------------------------------------------------------
-def llama_block_specs(mp_axis: str = "mp"):
+def llama_block_specs(mp_axis: str = "mp", moe: bool = False,
+                      ep_axis: str = None):
     """Per-leaf PartitionSpec suffixes (excluding the leading layer dim) for
     Megatron-style tensor parallelism over `mp_axis`:
 
@@ -274,23 +275,35 @@ def llama_block_specs(mp_axis: str = "mp"):
       wo, wdown:           row-parallel (input dim sharded, psum after)
       ln1/ln2:             replicated
 
+    With moe=True the FFN leaves are the expert-stacked tensors; ep_axis
+    shards their expert dim (expert parallelism — reference moe_layer.py).
+
     Reference: fleet/layers/mpu/mp_layers.py:336 (ColumnParallelLinear),
     :543 (RowParallelLinear) — here the sharded matmuls live inside the
     pipeline stage function (block_apply) as rank-local dots + lax.psum.
     """
     col = (None, mp_axis)
     row = (mp_axis, None)
-    return {"ln1": (None,), "wq": col, "wk": col, "wv": col, "wo": row,
-            "ln2": (None,), "wgate": col, "wup": col, "wdown": row}
+    specs = {"ln1": (None,), "wq": col, "wk": col, "wv": col, "wo": row,
+             "ln2": (None,)}
+    if moe:
+        exp = (ep_axis, None, None)
+        specs.update({"gate_w": (None, None), "we_gate": exp, "we_up": exp,
+                      "we_down": exp})
+    else:
+        specs.update({"wgate": col, "wup": col, "wdown": row})
+    return specs
 
 
-def llama_microbatch_fns(config: LlamaConfig, mp_axis: str = None, dtype=None):
+def llama_microbatch_fns(config: LlamaConfig, mp_axis: str = None, dtype=None,
+                         ep_axis: str = None):
     """Per-microbatch (embed, block, head) adapters for the pipeline schedule
     step fns (Pipeline1F1BTrainStep et al.), without initializing a second
     parameter set: embed returns one [mbs, S, H] microbatch, head consumes a
     single microbatch activation."""
     _, _, _, ea1, ba1, hl1 = build_functional_llama(
-        config, n_micro=1, mp_axis=mp_axis, dtype=dtype, init_params=False)
+        config, n_micro=1, mp_axis=mp_axis, ep_axis=ep_axis, dtype=dtype,
+        init_params=False)
     embed_mb = lambda p, mb: ea1(p, mb)[0]
     head_mb = lambda p, y, mb: hl1(p, y[None], mb)
     return embed_mb, ba1, head_mb
@@ -298,7 +311,7 @@ def llama_microbatch_fns(config: LlamaConfig, mp_axis: str = None, dtype=None):
 
 def build_functional_llama(config: LlamaConfig, key=None, dtype=None,
                            n_micro: int = 1, mp_axis: str = None,
-                           init_params: bool = True):
+                           ep_axis: str = None, init_params: bool = True):
     """Returns (embed_params, block_params_stacked, head_params,
     embed_apply, block_apply, head_loss_apply).
 
@@ -326,6 +339,8 @@ def build_functional_llama(config: LlamaConfig, key=None, dtype=None,
 
     L = c.num_hidden_layers
     kv_dim = c.num_key_value_heads * head_dim
+    moe = c.num_experts > 1
+    E = c.num_experts
     if not init_params:
         embed_params = block_params = head_params = None
     else:
@@ -341,13 +356,38 @@ def build_functional_llama(config: LlamaConfig, key=None, dtype=None,
             "wo": jnp.stack([init(jax.random.fold_in(ks[4], i),
                                   (c.hidden_size, c.hidden_size)) for i in range(L)]),
             "ln2": jnp.ones((L, c.hidden_size), d),
-            "wgate": jnp.stack([init(jax.random.fold_in(ks[5], i),
-                                     (c.hidden_size, c.intermediate_size)) for i in range(L)]),
-            "wup": jnp.stack([init(jax.random.fold_in(ks[6], i),
-                                   (c.hidden_size, c.intermediate_size)) for i in range(L)]),
-            "wdown": jnp.stack([init(jax.random.fold_in(ks[7], i),
-                                     (c.intermediate_size, c.hidden_size)) for i in range(L)]),
         }
+        if moe:
+            # expert-stacked FFN (LLaMA-MoE / Mixtral; ep-shardable on dim 1)
+            block_params.update({
+                "gate_w": jnp.stack([init(jax.random.fold_in(ks[9], i),
+                                          (c.hidden_size, E), 0.02)
+                                     for i in range(L)]),
+                "we_gate": jnp.stack([init(jax.random.fold_in(ks[5], i),
+                                           (E, c.hidden_size,
+                                            c.intermediate_size),
+                                           1.0 / math.sqrt(c.hidden_size))
+                                      for i in range(L)]),
+                "we_up": jnp.stack([init(jax.random.fold_in(ks[6], i),
+                                         (E, c.hidden_size,
+                                          c.intermediate_size),
+                                         1.0 / math.sqrt(c.hidden_size))
+                                    for i in range(L)]),
+                "we_down": jnp.stack([init(jax.random.fold_in(ks[7], i),
+                                           (E, c.intermediate_size,
+                                            c.hidden_size),
+                                           1.0 / math.sqrt(c.intermediate_size))
+                                      for i in range(L)]),
+            })
+        else:
+            block_params.update({
+                "wgate": jnp.stack([init(jax.random.fold_in(ks[5], i),
+                                         (c.hidden_size, c.intermediate_size)) for i in range(L)]),
+                "wup": jnp.stack([init(jax.random.fold_in(ks[6], i),
+                                       (c.hidden_size, c.intermediate_size)) for i in range(L)]),
+                "wdown": jnp.stack([init(jax.random.fold_in(ks[7], i),
+                                         (c.intermediate_size, c.hidden_size)) for i in range(L)]),
+            })
         head_params = {"ln_f": jnp.ones((c.hidden_size,), d),
                        "lm": init(ks[8], (c.hidden_size, c.vocab_size), 0.02)}
 
@@ -409,8 +449,42 @@ def build_functional_llama(config: LlamaConfig, key=None, dtype=None,
         o = _mp_reduce(o.reshape(B, S, nh_l * head_dim) @ lp["wo"])
         x = x + o
         h = rms(x, lp["ln2"])
+        if moe:
+            return x + _moe_ffn_block(lp, h, B, S)
         ff = jax.nn.silu(h @ lp["wgate"]) * (h @ lp["wup"])
         return x + _mp_reduce(ff @ lp["wdown"])
+
+    def _moe_ffn_block(lp, h, B, S):
+        """Sparse SwiGLU FFN over the expert-stacked leaves. Under shard_map
+        with `ep_axis` in scope the expert dim of we_* is the LOCAL shard and
+        dispatch/combine ride lax.all_to_all (reference MoEScatter/MoEGather);
+        without ep_axis it is the dense single-mesh computation."""
+        from ..incubate.distributed.models.moe.gate import (top_k_gating,
+                                                            compute_capacity)
+        from ..incubate.distributed.models.moe.moe_layer import (
+            moe_dispatch, moe_combine, ep_all_to_all, ep_all_to_all_back)
+        T = B * S
+        xf = h.reshape(T, -1)
+        E_total = lp["gate_w"].shape[-1]
+        logits = (xf @ lp["gate_w"].astype(xf.dtype)).astype(jnp.float32)
+        capacity = compute_capacity(T, E_total, c.moe_topk,
+                                    c.moe_capacity_factor)
+        # balance aux loss is intentionally not routed through the pipeline
+        # loss (the per-stage schedules carry only the LM loss); use the
+        # eager LlamaMoEBlock path when the aux term must train the gate
+        combine, dispatch, _aux, _ = top_k_gating(
+            logits, c.moe_topk, capacity, balance_loss_weight=0.0)
+        disp = moe_dispatch(xf, dispatch)                 # [E_total, C, H]
+        if ep_axis is not None:
+            disp = ep_all_to_all(disp, ep_axis)           # [E_local, W*C, H]
+        ff = jax.nn.silu(jnp.einsum("ebd,edh->ebh", disp,
+                                    lp["we_gate"].astype(disp.dtype))) \
+            * jnp.einsum("ebd,edh->ebh", disp, lp["we_up"].astype(disp.dtype))
+        y = jnp.einsum("ebh,ehd->ebd", ff, lp["we_down"].astype(ff.dtype))
+        if ep_axis is not None:
+            y = ep_all_to_all_back(y, ep_axis)            # [E_total, C, H]
+        out = moe_combine(y, combine)
+        return out.reshape(B, S, -1).astype(h.dtype)
 
     def head_loss_apply(p, y, batch):
         # y: [n_micro, mbs, S, H]

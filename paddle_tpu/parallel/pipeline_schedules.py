@@ -50,7 +50,7 @@ def _vary(x, axes):
 
 def spmd_pipeline_1f1b(fwd_mb: Callable, params, n_micro: int,
                        act_sd, axis: str = "pp", n_chunks: int = 1,
-                       varying_axes=("dp", "pp", "mp")):
+                       varying_axes=("dp", "pp", "mp", "ep")):
     """Run the 1F1B (v=1) / interleaved (v>1) schedule inside shard_map.
 
     fwd_mb(params, chunk_idx, act_in, mb_idx) -> (act_out, loss_mb)
@@ -156,7 +156,8 @@ class Pipeline1F1BTrainStep:
         are placed P("pp", *suffix) and their grads are NOT averaged over the
         axes the suffix names (each rank owns a distinct shard)."""
         if batch_spec is None:
-            batch_spec = P("dp") if "dp" in mesh.axis_names else P()
+            data_axes = tuple(a for a in ("dp", "ep") if a in mesh.axis_names)
+            batch_spec = P(data_axes) if data_axes else P()
         if schedule not in ("1f1b", "zero_bubble"):
             raise ValueError(f"unknown schedule {schedule!r}")
         if schedule == "zero_bubble" and n_chunks != 1:
@@ -172,14 +173,15 @@ class Pipeline1F1BTrainStep:
             raise ValueError("block_specs requires dict block_params")
         self._block_specs = block_specs or {}
         # the grad-combine below (and spmd_pipeline_1f1b's varying_axes)
-        # assumes the tensor-parallel axis is literally named "mp"
+        # assumes the tensor-parallel axis is literally named "mp" and the
+        # expert-parallel axis "ep".
         # 'pp' is NOT allowed in suffixes: the leading stacked-layer dim is
         # already placed on 'pp', a suffix repeat would be a duplicate axis
         bad = {a for sfx in self._block_specs.values()
-               for a in sfx if a not in (None, "mp")}
+               for a in sfx if a not in (None, "mp", "ep")}
         if bad:
             raise ValueError(
-                f"block_specs may only shard over the 'mp' axis, got {bad}")
+                f"block_specs may only shard over 'mp'/'ep', got {bad}")
 
         L = jax.tree_util.tree_leaves(block_params)[0].shape[0]
         if L % (n_pp * n_chunks) != 0:
@@ -319,29 +321,33 @@ class Pipeline1F1BTrainStep:
             if "dp" in mesh.axis_names:
                 ge, gb, gh = jax.tree_util.tree_map(
                     lambda va: jax.lax.pmean(va, "dp"), (ge, gb, gh))
-            if "mp" in mesh.axis_names:
+            for ax in ("mp", "ep"):
+                if ax not in mesh.axis_names:
+                    continue
                 ge, gh = jax.tree_util.tree_map(
-                    lambda va: jax.lax.pmean(va, "mp"), (ge, gh))
+                    lambda va: jax.lax.pmean(va, ax), (ge, gh))
                 # replicated block leaves: copies hold rank-partial grads
-                # under TP (and full grads when mp is replicated-compute) —
-                # pmean is right for both: per-tick vjp seeds the loss on
-                # every mp rank, so partial sums arrive psum'd * mp.
-                # mp-sharded leaves: each rank owns a distinct shard whose
-                # accumulated grad is mp x the true shard grad (the
+                # (TP psum transpose / EP batch split) — pmean is right for
+                # both: per-tick vjp seeds the loss on every rank of the
+                # axis, so partial sums arrive psum'd * n_ax.
+                # axis-sharded leaves: each rank owns a distinct shard whose
+                # accumulated grad is n_ax x the true shard grad (TP: the
                 # row-parallel psum/pvary transpose broadcasts the summed
-                # cotangent to all ranks) -> scale by 1/mp, no collective.
-                inv_mp = 1.0 / mesh.shape["mp"]
+                # cotangent; EP: every rank's 1/T_local loss normalisation
+                # over-counts by the axis size vs the global mean) -> scale
+                # by 1/n_ax, no collective.
+                inv_ax = 1.0 / mesh.shape[ax]
 
-                def _combine_mp(name, g):
-                    if "mp" in self._block_specs.get(name, ()):
-                        return g * inv_mp
-                    return jax.lax.pmean(g, "mp")
+                def _combine(name, g, ax=ax, inv_ax=inv_ax):
+                    if ax in self._block_specs.get(name, ()):
+                        return g * inv_ax
+                    return jax.lax.pmean(g, ax)
                 if isinstance(gb, dict) and self._block_specs:
-                    gb = {name: _combine_mp(name, g)
+                    gb = {name: _combine(name, g)
                           for name, g in gb.items()}
                 else:
                     gb = jax.tree_util.tree_map(
-                        lambda va: jax.lax.pmean(va, "mp"), gb)
+                        lambda va, ax=ax: jax.lax.pmean(va, ax), gb)
             ne, neo = self.opt.apply_gradients_functional(
                 _flatten(embed_p), _flatten(ge), eo, lr=lr)
             nb, nbo = self.opt.apply_gradients_functional(

@@ -163,7 +163,7 @@ def _depths(rows, n_micro):
 
 def spmd_pipeline_zero_bubble(fwd_mb: Callable, params, n_micro: int,
                               act_sd, axis: str = "pp", policy: str = "zb1",
-                              varying_axes=("dp", "pp", "mp")):
+                              varying_axes=("dp", "pp", "mp", "ep")):
     """Run the slot-table schedule inside shard_map over `axis`.
 
     fwd_mb(params, c, act_in, mb_idx) -> (act_out, loss_mb) — same contract

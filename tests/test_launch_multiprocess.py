@@ -107,12 +107,11 @@ def test_launch_watcher_kills_gang_on_failure(tmp_path):
 
 
 def test_launch_max_restarts_recovers(tmp_path):
-    marker = tmp_path / "attempt"
     script = tmp_path / "flaky_rank.py"
     # per-rank done FILES, not stdout: concurrent children interleave prints
     script.write_text(
         "import os, sys\n"
-        f"base = {str(repr(str(tmp_path)))}\n"
+        f"base = {repr(str(tmp_path))}\n"
         "m = os.path.join(base, 'attempt')\n"
         "rank = int(os.environ['PADDLE_TRAINER_ID'])\n"
         "if rank == 0 and not os.path.exists(m):\n"
