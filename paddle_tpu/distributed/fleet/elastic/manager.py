@@ -45,8 +45,14 @@ class MemoryStore:
         self._beats: Dict[str, float] = {}
         self._first: Dict[str, float] = {}
 
-    def heartbeat(self, host: str, ts: float = None):
+    def heartbeat(self, host: str, ts: float = None, stale_after: float = None):
         now = ts if ts is not None else time.time()
+        prev = self._beats.get(host)
+        if prev is not None and stale_after is not None \
+                and now - prev > stale_after:
+            # the lease lapsed: a returning host re-enters as a JOINER —
+            # it must not evict whoever replaced it (seniority resets)
+            self._first.pop(host, None)
         self._beats[host] = now
         self._first.setdefault(host, now)
 
@@ -72,16 +78,19 @@ class FileStore:
     def _path(self, host):
         return os.path.join(self.root, f"node.{host.replace(':', '_')}")
 
-    def heartbeat(self, host: str, ts: float = None):
+    def heartbeat(self, host: str, ts: float = None, stale_after: float = None):
         p = self._path(host)
-        # preserve the first-registration time across beats (seniority key)
+        now = ts if ts is not None else time.time()
+        # preserve the first-registration time across beats (seniority key);
+        # a lapsed lease resets it — the returning host re-enters as a joiner
         first = None
         try:
-            first = open(p).read().split("\n")[1]
+            if stale_after is None or now - os.path.getmtime(p) <= stale_after:
+                first = open(p).read().split("\n")[1]
         except (OSError, IndexError):
             pass
         if first is None:
-            first = repr(ts if ts is not None else time.time())
+            first = repr(now)
         tmp = p + ".tmp"
         # atomic rename: a concurrent alive() must never read a truncated
         # host string (NFS deployment is this store's stated purpose)
@@ -146,10 +155,10 @@ class ElasticManager:
 
     # -- lease/registration --------------------------------------------------
     def register(self, host: str):
-        self.store.heartbeat(host)
+        self.store.heartbeat(host, stale_after=self.heartbeat_timeout)
 
     def heartbeat(self, host: str):
-        self.store.heartbeat(host)
+        self.store.heartbeat(host, stale_after=self.heartbeat_timeout)
 
     def deregister(self, host: str):
         self.store.remove(host)

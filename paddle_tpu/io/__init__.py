@@ -286,7 +286,10 @@ def default_collate_fn(batch):
         import jax.numpy as jnp
         return Tensor(jnp.stack([b._value for b in batch]))
     if isinstance(sample, np.ndarray):
-        return Tensor(np.stack(batch))
+        # native multithreaded collate for big uniform batches (the C++
+        # data_feed.cc batch-assembly analog; numpy fallback inside)
+        from . import native as _native
+        return Tensor(_native.collate(batch))
     if isinstance(sample, (int, float, np.integer, np.floating)):
         return Tensor(np.asarray(batch))
     if isinstance(sample, (list, tuple)):

@@ -1,0 +1,115 @@
+"""Native (C++) host-side data-pipeline kernels.
+
+The reference implements its DataLoader hot path in C++
+(paddle/fluid/framework/data_feed.cc; multiprocess workers feed batches
+through shared memory). On TPU the device side is XLA's, but batch
+collation and image normalization still run on the host per step — this
+module compiles `batcher.cc` once (g++ -O3, cached .so beside the source)
+and exposes it through ctypes. Everything degrades gracefully to numpy
+when no toolchain is available, so the package never hard-depends on a
+compiler at runtime.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+__all__ = ["available", "collate", "normalize_images", "load_library"]
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "batcher.cc")
+_SO = os.path.join(_HERE, "_batcher.so")
+_lock = threading.Lock()
+_lib = [None, False]   # (handle, attempted)
+
+
+def _build():
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+           _SRC, "-o", _SO]
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+
+
+def load_library():
+    """Compile (if stale) and load the native library; None on failure."""
+    with _lock:
+        if _lib[1]:
+            return _lib[0]
+        _lib[1] = True
+        try:
+            if (not os.path.exists(_SO)
+                    or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+                _build()
+            lib = ctypes.CDLL(_SO)
+            lib.pt_collate.argtypes = [
+                ctypes.POINTER(ctypes.c_void_p), ctypes.c_int64,
+                ctypes.c_int64, ctypes.c_void_p, ctypes.c_int32]
+            lib.pt_normalize_batch.argtypes = [
+                ctypes.POINTER(ctypes.c_void_p), ctypes.c_void_p,
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_float, ctypes.c_int32]
+            _lib[0] = lib
+        except Exception:
+            _lib[0] = None
+        return _lib[0]
+
+
+def available() -> bool:
+    return load_library() is not None
+
+
+_COLLATE_MIN_BYTES = 1 << 16   # small batches: numpy stack is fine
+
+
+def collate(samples, n_threads: int = 4):
+    """Stack a list of equal-shape/dtype numpy arrays into one batch array
+    using the native multithreaded memcpy; falls back to np.stack."""
+    lib = load_library()
+    n = len(samples)
+    if n == 0:
+        raise ValueError("empty batch")
+    first = samples[0]
+    sample_bytes = first.nbytes
+    if (lib is None or n * sample_bytes < _COLLATE_MIN_BYTES
+            or first.dtype.hasobject   # PyObject* must NOT be raw-memcpy'd
+            or any(s.shape != first.shape or s.dtype != first.dtype
+                   or not s.flags.c_contiguous for s in samples)):
+        return np.stack(samples)
+    out = np.empty((n,) + first.shape, first.dtype)
+    ptrs = (ctypes.c_void_p * n)(
+        *[s.ctypes.data_as(ctypes.c_void_p).value for s in samples])
+    lib.pt_collate(ptrs, n, sample_bytes,
+                   out.ctypes.data_as(ctypes.c_void_p), n_threads)
+    return out
+
+
+def normalize_images(images, mean, std, scale: float = 1.0 / 255.0,
+                     n_threads: int = 4):
+    """uint8 HWC images (list or [N,H,W,C] array) -> normalized f32 NCHW.
+    The fused ToTensor+Normalize host kernel; numpy fallback otherwise."""
+    if isinstance(images, np.ndarray) and images.ndim == 4:
+        images = list(images)
+    n = len(images)
+    h, w, c = images[0].shape
+    mean = np.ascontiguousarray(mean, np.float32)
+    std = np.ascontiguousarray(std, np.float32)
+    lib = load_library()
+    ok = (lib is not None
+          and all(im.dtype == np.uint8 and im.shape == (h, w, c)
+                  and im.flags.c_contiguous for im in images))
+    if not ok:
+        arr = np.stack(images).astype(np.float32) * scale
+        arr = (arr - mean.reshape(1, 1, 1, c)) / std.reshape(1, 1, 1, c)
+        return np.ascontiguousarray(arr.transpose(0, 3, 1, 2))
+    out = np.empty((n, c, h, w), np.float32)
+    ptrs = (ctypes.c_void_p * n)(
+        *[im.ctypes.data_as(ctypes.c_void_p).value for im in images])
+    lib.pt_normalize_batch(
+        ptrs, out.ctypes.data_as(ctypes.c_void_p), n, h, w, c,
+        mean.ctypes.data_as(ctypes.c_void_p),
+        std.ctypes.data_as(ctypes.c_void_p), scale, n_threads)
+    return out

@@ -1,0 +1,95 @@
+// Native host-side data-pipeline kernels (the C++ analog of the reference's
+// paddle/fluid/framework/data_feed.cc batch assembly: the DataLoader's hot
+// host path — batch collation and image normalization — runs in compiled
+// code instead of the Python interpreter).
+//
+// Built by paddle_tpu/io/native/__init__.py with `g++ -O3 -shared -fPIC`
+// and loaded via ctypes (no pybind dependency; plain C ABI).
+
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// Stack n equally-sized samples into one contiguous batch buffer.
+// samples: array of n pointers, each to sample_bytes of data.
+// Multithreaded memcpy for large batches (HBM-feed staging is
+// memory-bandwidth-bound; threads saturate it).
+void pt_collate(const void** samples, int64_t n, int64_t sample_bytes,
+                void* out, int32_t n_threads) {
+  char* dst = static_cast<char*>(out);
+  if (n_threads <= 1 || n < 4) {
+    for (int64_t i = 0; i < n; ++i) {
+      std::memcpy(dst + i * sample_bytes, samples[i], sample_bytes);
+    }
+    return;
+  }
+  if (n_threads > n) n_threads = static_cast<int32_t>(n);
+  std::vector<std::thread> threads;
+  threads.reserve(n_threads);
+  int64_t per = (n + n_threads - 1) / n_threads;
+  for (int32_t t = 0; t < n_threads; ++t) {
+    int64_t lo = t * per;
+    int64_t hi = lo + per < n ? lo + per : n;
+    if (lo >= hi) break;
+    threads.emplace_back([=]() {
+      for (int64_t i = lo; i < hi; ++i) {
+        std::memcpy(dst + i * sample_bytes, samples[i], sample_bytes);
+      }
+    });
+  }
+  for (auto& th : threads) th.join();
+}
+
+// uint8 HWC image -> normalized float32 CHW (the torchvision/paddle
+// ToTensor+Normalize fusion, the per-image hot loop of vision input
+// pipelines). mean/std are per-channel, scale applied first (1/255).
+void pt_normalize_hwc_to_chw(const uint8_t* in, float* out, int64_t h,
+                             int64_t w, int64_t c, const float* mean,
+                             const float* stddev, float scale) {
+  std::vector<float> inv(c);
+  for (int64_t ch = 0; ch < c; ++ch) inv[ch] = 1.0f / stddev[ch];
+  const int64_t hw = h * w;
+  for (int64_t y = 0; y < h; ++y) {
+    for (int64_t x = 0; x < w; ++x) {
+      const uint8_t* px = in + (y * w + x) * c;
+      for (int64_t ch = 0; ch < c; ++ch) {
+        out[ch * hw + y * w + x] =
+            (static_cast<float>(px[ch]) * scale - mean[ch]) * inv[ch];
+      }
+    }
+  }
+}
+
+// Batched variant: n images in one call (one thread per slice of images).
+void pt_normalize_batch(const uint8_t** imgs, float* out, int64_t n,
+                        int64_t h, int64_t w, int64_t c, const float* mean,
+                        const float* stddev, float scale, int32_t n_threads) {
+  const int64_t img_elems = c * h * w;
+  if (n_threads <= 1 || n < 2) {
+    for (int64_t i = 0; i < n; ++i) {
+      pt_normalize_hwc_to_chw(imgs[i], out + i * img_elems, h, w, c, mean,
+                              stddev, scale);
+    }
+    return;
+  }
+  if (n_threads > n) n_threads = static_cast<int32_t>(n);
+  std::vector<std::thread> threads;
+  int64_t per = (n + n_threads - 1) / n_threads;
+  for (int32_t t = 0; t < n_threads; ++t) {
+    int64_t lo = t * per;
+    int64_t hi = lo + per < n ? lo + per : n;
+    if (lo >= hi) break;
+    threads.emplace_back([=]() {
+      for (int64_t i = lo; i < hi; ++i) {
+        pt_normalize_hwc_to_chw(imgs[i], out + i * img_elems, h, w, c, mean,
+                                stddev, scale);
+      }
+    });
+  }
+  for (auto& th : threads) th.join();
+}
+
+}  // extern "C"

@@ -20,7 +20,8 @@ from ..core.tensor import Tensor
 from ..core import random as random_mod
 from ..nn.layer import Layer, functional_state
 
-__all__ = ["to_static", "not_to_static", "StaticFunction", "ignore_module"]
+__all__ = ["to_static", "not_to_static", "StaticFunction",
+           "SymbolicStaticFunction", "ignore_module"]
 
 
 def _find_layer(fn):
@@ -101,21 +102,131 @@ class StaticFunction:
         return self
 
 
+class SymbolicStaticFunction(StaticFunction):
+    """The SOT analog (reference jit/sot/opcode_translator/: symbolic
+    bytecode execution with Guards + graph-break fallback,
+    `api.py:302 SymbolicStaticFunction`).
+
+    The reference intercepts CPython bytecode, symbolically executes it into
+    a FunctionGraph, caches per-Guard compiled programs, and falls back to
+    the original bytecode on a graph break. Under jax the trace IS the
+    symbolic executor; what this class adds over plain jit capture:
+
+    * **guards** — the compiled-program cache is keyed on (python-scalar
+      argument VALUES, layer training mode, pytree structure) in addition to
+      jax's shape/dtype keying: scalars are baked static per variant, so
+      `if flag:` branches re-specialize per value exactly like SOT guards;
+    * **graph breaks** — a trace failure from data-dependent python control
+      flow (`if tensor.sum() > 0:` → TracerBoolConversionError, .numpy() on
+      a tracer, dynamic shapes) permanently marks that guard key broken and
+      executes eagerly (the pycode_generator fallback), instead of raising;
+    * introspection: `compiled_count` / `graph_break_count` /
+      `broken_reasons` (the SOT info-collector analog).
+    """
+
+    _BREAK_ERRORS = (jax.errors.TracerBoolConversionError,
+                     jax.errors.ConcretizationTypeError,
+                     jax.errors.TracerArrayConversionError,
+                     jax.errors.TracerIntegerConversionError,
+                     NotImplementedError)
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self._broken = {}       # guard_key -> reason string
+        self._variants = {}     # guard_key -> jitted fn (scalars baked in)
+        self.graph_break_count = 0
+
+    @property
+    def compiled_count(self):
+        return len(self._variants)
+
+    @property
+    def broken_reasons(self):
+        return dict(self._broken)
+
+    @staticmethod
+    def _split_static(tree):
+        """Replace python-scalar leaves with placeholders; return
+        (traced_tree, static_leaves_tuple) — the guard's value part."""
+        leaves, treedef = jax.tree_util.tree_flatten(
+            tree, is_leaf=lambda x: isinstance(x, (bool, int, float, str,
+                                                   type(None))))
+        statics = []
+        traced = []
+        for i, l in enumerate(leaves):
+            if isinstance(l, (bool, int, float, str, type(None))) and \
+                    not isinstance(l, Tensor):
+                # type is part of the guard: hash(2) == hash(2.0) == hash(True)
+                # would otherwise reuse a variant baked with the wrong dtype
+                statics.append((i, type(l).__name__, l))
+                traced.append(None)
+            else:
+                traced.append(l)
+        return jax.tree_util.tree_unflatten(treedef, traced), \
+            tuple(statics), treedef
+
+    def __call__(self, *args, **kwargs):
+        traced_args, statics, treedef = self._split_static((args, kwargs))
+        training = getattr(self._layer, "training", None)
+        guard = (statics, training, str(treedef))
+        if guard in self._broken:
+            return self._call_raw(*args, **kwargs)      # graph-break: eager
+
+        if guard not in self._variants:
+            def traced_call(state, rng, traced):
+                # re-insert the guarded static values into the pytree
+                leaves, td = jax.tree_util.tree_flatten(
+                    traced, is_leaf=lambda x: x is None)
+                for i, _tname, v in statics:
+                    leaves[i] = v
+                a, k = jax.tree_util.tree_unflatten(td, leaves)
+                return self._traced_call(state, rng, a, k)
+            self._variants[guard] = jax.jit(traced_call)
+
+        state = {}
+        if self._layer is not None:
+            state = {n: p._value for n, p in self._layer.named_parameters()}
+            state.update({n: b._value
+                          for n, b in self._layer.named_buffers()})
+        rng = random_mod.split_key()
+        try:
+            out, new_state = self._variants[guard](state, rng, traced_args)
+        except self._BREAK_ERRORS as e:
+            # graph break: this guard key runs eagerly from now on
+            self._broken[guard] = f"{type(e).__name__}: {e}"
+            self._variants.pop(guard, None)
+            self.graph_break_count += 1
+            return self._call_raw(*args, **kwargs)
+        if self._layer is not None and new_state:
+            buffer_map = dict(self._layer.named_buffers())
+            for name, v in new_state.items():
+                t = buffer_map.get(name)
+                if t is not None and t._value is not v:
+                    t._set_value(v)
+        return out
+
+
 def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
               full_graph=True, **kwargs):
-    """paddle.jit.to_static parity. Under the TPU design full_graph=True and
-    False converge: jax tracing handles arbitrary python control flow by
-    unrolling (AST-transpiler analog); data-dependent branching should use
-    paddle_tpu.static.nn.cond / while_loop (lax control flow)."""
+    """paddle.jit.to_static parity (reference api.py:197).
+
+    full_graph=True → ASTStaticFunction analog: plain jax capture; python
+    control flow unrolls at trace time, data-dependent branching must use
+    paddle_tpu.static.nn.cond / while_loop.
+    full_graph=False → SymbolicStaticFunction (the SOT analog): scalar-value
+    guards + graph-break fallback to eager on untraceable control flow.
+    """
+    cls = StaticFunction if full_graph else SymbolicStaticFunction
+
     def deco(fn):
         if isinstance(fn, Layer):
             # capture the ORIGINAL forward before rebinding (else sf recurses)
             orig_forward = fn.forward
-            sf = StaticFunction(orig_forward, layer=fn, input_spec=input_spec,
-                                full_graph=full_graph)
+            sf = cls(orig_forward, layer=fn, input_spec=input_spec,
+                     full_graph=full_graph)
             fn.forward = sf
             return fn
-        return StaticFunction(fn, input_spec=input_spec, full_graph=full_graph)
+        return cls(fn, input_spec=input_spec, full_graph=full_graph)
     if function is not None:
         return deco(function)
     return deco

@@ -129,3 +129,19 @@ def test_joiner_does_not_evict_active_member_at_capacity():
     mgr.register("a:1")                          # lexicographically first
     assert mgr.watch() == ElasticStatus.HOLD     # no eviction at capacity
     assert mgr.members() == ["b:1", "c:1"]
+
+
+def test_returning_host_after_lapse_is_a_joiner():
+    """A host whose lease lapsed must NOT reclaim seniority and evict the
+    junior that replaced it."""
+    st = MemoryStore()
+    mgr = ElasticManager(st, np_min=1, np_max=2, heartbeat_timeout=10.0)
+    t0 = time.time()
+    st.heartbeat("a:1", ts=t0 - 100, stale_after=10.0)   # senior...
+    mgr.register("b:1")
+    mgr.register("c:1")                                  # ...a already stale
+    assert mgr.watch() == ElasticStatus.HOLD
+    assert mgr.members() == ["b:1", "c:1"]
+    mgr.heartbeat("a:1")                                 # a returns
+    # lease lapsed -> a re-registered as the JUNIOR: b, c keep their slots
+    assert mgr.members() == ["b:1", "c:1"]

@@ -1,0 +1,72 @@
+"""Native C++ data-pipeline kernel tests (the data_feed.cc analog:
+compiled batch collation + fused image normalization loaded via ctypes,
+with numpy fallback)."""
+import numpy as np
+import pytest
+
+from paddle_tpu.io import native
+
+
+def test_library_builds_and_loads():
+    assert native.available(), "g++ toolchain is baked into this image"
+
+
+def test_native_collate_matches_numpy_stack():
+    rng = np.random.default_rng(0)
+    samples = [rng.normal(0, 1, (64, 128)).astype(np.float32)
+               for _ in range(32)]
+    out = native.collate(samples)
+    np.testing.assert_array_equal(out, np.stack(samples))
+    assert out.dtype == np.float32 and out.shape == (32, 64, 128)
+
+
+def test_native_collate_int_and_odd_shapes():
+    rng = np.random.default_rng(1)
+    samples = [rng.integers(0, 255, (37, 53, 3)).astype(np.uint8)
+               for _ in range(9)]
+    out = native.collate(samples)
+    np.testing.assert_array_equal(out, np.stack(samples))
+
+
+def test_collate_fallback_on_mixed_inputs():
+    a = np.zeros((4, 4), np.float32)
+    b = np.zeros((4, 4), np.float64)
+    # dtype mismatch takes the numpy path (np.stack upcasts)
+    out = native.collate([a, b])
+    assert out.dtype == np.float64
+    # shape mismatch propagates numpy's error
+    with pytest.raises(Exception):
+        native.collate([a, np.zeros((3, 4), np.float32)])
+
+
+def test_normalize_images_matches_numpy():
+    rng = np.random.default_rng(2)
+    imgs = [rng.integers(0, 256, (32, 48, 3)).astype(np.uint8)
+            for _ in range(8)]
+    mean = np.asarray([0.485, 0.456, 0.406], np.float32)
+    std = np.asarray([0.229, 0.224, 0.225], np.float32)
+    out = native.normalize_images(imgs, mean, std)
+    ref = np.stack(imgs).astype(np.float32) / 255.0
+    ref = (ref - mean.reshape(1, 1, 1, 3)) / std.reshape(1, 1, 1, 3)
+    ref = ref.transpose(0, 3, 1, 2)
+    assert out.shape == (8, 3, 32, 48)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_dataloader_uses_native_collate():
+    import paddle_tpu as paddle
+    from paddle_tpu.io import Dataset, DataLoader
+
+    class DS(Dataset):
+        def __len__(self):
+            return 16
+
+        def __getitem__(self, i):
+            return (np.full((128, 64), i, np.float32),
+                    np.asarray(i, np.int64))
+
+    dl = DataLoader(DS(), batch_size=8, shuffle=False)
+    x, y = next(iter(dl))
+    assert tuple(x.shape) == (8, 128, 64)
+    np.testing.assert_array_equal(np.asarray(y.numpy()), np.arange(8))
+    np.testing.assert_allclose(np.asarray(x.numpy())[3], 3.0)

@@ -1,0 +1,118 @@
+"""SOT-analog tests (reference jit/sot/: guards + graph-break fallback;
+VERDICT L4b gap "no SOT/guards/graph-break"). to_static(full_graph=False)
+must: specialize per python-scalar value (guards), fall back to eager on
+data-dependent python control flow (graph break), and re-specialize on
+train/eval mode."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.jit.api import to_static, SymbolicStaticFunction
+
+
+def test_scalar_value_guards_specialize():
+    calls = []
+
+    @to_static(full_graph=False)
+    def f(x, scale, double):
+        calls.append(1)          # python body runs once per trace
+        y = x * scale
+        if double:               # python branch on a guarded scalar
+            y = y * 2
+        return y
+
+    x = paddle.to_tensor(np.ones(4, np.float32))
+    np.testing.assert_allclose(np.asarray(f(x, 3.0, False).numpy()), 3.0)
+    np.testing.assert_allclose(np.asarray(f(x, 3.0, True).numpy()), 6.0)
+    np.testing.assert_allclose(np.asarray(f(x, 5.0, True).numpy()), 10.0)
+    # three distinct guard keys -> three compiled variants
+    assert f.compiled_count == 3
+    n_traces = len(calls)
+    # cached: repeat calls re-trace nothing
+    f(x, 3.0, False)
+    f(x, 5.0, True)
+    assert len(calls) == n_traces
+    assert f.graph_break_count == 0
+
+
+def test_graph_break_falls_back_to_eager():
+    @to_static(full_graph=False)
+    def f(x):
+        if float(x.sum().numpy()) > 0:     # data-dependent python branch
+            return x * 2
+        return x - 1
+
+    xp = paddle.to_tensor(np.ones(4, np.float32))
+    xn = paddle.to_tensor(np.full(4, -1.0, np.float32))
+    np.testing.assert_allclose(np.asarray(f(xp).numpy()), 2.0)
+    np.testing.assert_allclose(np.asarray(f(xn).numpy()), -2.0)
+    assert f.graph_break_count >= 1
+    assert f.broken_reasons, "break reason should be recorded"
+    # subsequent calls keep working eagerly
+    np.testing.assert_allclose(np.asarray(f(xp).numpy()), 2.0)
+
+
+def test_training_mode_guard():
+    paddle.seed(0)
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(8, 8)
+            self.drop = nn.Dropout(0.9)
+
+        def forward(self, x):
+            return self.drop(self.fc(x))
+
+    net = to_static(Net(), full_graph=False)
+    x = paddle.to_tensor(np.ones((4, 8), np.float32))
+    net.train()
+    out_t = np.asarray(net.forward(x).numpy())
+    net.eval()
+    out_e = np.asarray(net.forward(x).numpy())
+    # train mode drops ~90%; eval drops nothing — the mode is a guard,
+    # not a stale cache
+    assert (out_t == 0).mean() > 0.5
+    assert (out_e == 0).mean() < 0.2
+    assert net.forward.compiled_count >= 2
+
+
+def test_clean_function_compiles_once():
+    @to_static(full_graph=False)
+    def f(x):
+        return (x * x).sum()
+
+    x = paddle.to_tensor(np.ones((4, 4), np.float32))
+    for _ in range(5):
+        out = f(x)
+    assert float(out.numpy()) == 16.0
+    assert f.compiled_count == 1
+    assert f.graph_break_count == 0
+
+
+def test_full_graph_true_still_raises_on_breaks():
+    """ASTStaticFunction analog keeps strict semantics: no silent fallback."""
+    @to_static(full_graph=True)
+    def f(x):
+        if float(x.sum().numpy()) > 0:
+            return x * 2
+        return x
+
+    with pytest.raises(Exception):
+        f(paddle.to_tensor(np.ones(4, np.float32)))
+
+def test_scalar_type_is_part_of_guard():
+    """2, 2.0 and True must compile distinct variants (hash-equal scalars
+    would otherwise reuse a wrong-dtype baked trace)."""
+    @to_static(full_graph=False)
+    def f(x, s):
+        return x * s
+
+    x = paddle.to_tensor(np.ones(4, np.int32))
+    out_i = f(x, 2)
+    out_f = f(x, 2.0)
+    out_b = f(x, True)
+    assert f.compiled_count == 3
+    assert str(out_i.dtype) != str(out_f.dtype)   # int32 vs float
+    np.testing.assert_allclose(np.asarray(out_b.numpy()), 1)
