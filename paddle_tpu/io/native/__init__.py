@@ -28,9 +28,14 @@ _lib = [None, False]   # (handle, attempted)
 
 
 def _build():
+    # build to a unique temp path, then atomic-rename: forked DataLoader
+    # workers may race here, and another process must never dlopen a
+    # partially written ELF
+    tmp = f"{_SO}.{os.getpid()}.tmp"
     cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
-           _SRC, "-o", _SO]
+           _SRC, "-o", tmp]
     subprocess.run(cmd, check=True, capture_output=True, text=True)
+    os.replace(tmp, _SO)
 
 
 def load_library():
@@ -99,6 +104,7 @@ def normalize_images(images, mean, std, scale: float = 1.0 / 255.0,
     std = np.ascontiguousarray(std, np.float32)
     lib = load_library()
     ok = (lib is not None
+          and mean.size == c and std.size == c   # OOB read guard in C++
           and all(im.dtype == np.uint8 and im.shape == (h, w, c)
                   and im.flags.c_contiguous for im in images))
     if not ok:

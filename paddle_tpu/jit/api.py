@@ -168,7 +168,13 @@ class SymbolicStaticFunction(StaticFunction):
     def __call__(self, *args, **kwargs):
         traced_args, statics, treedef = self._split_static((args, kwargs))
         training = getattr(self._layer, "training", None)
-        guard = (statics, training, str(treedef))
+        # the guard keys on input SIGNATURE too (reference SOT guards per
+        # shape/dtype): a graph break on one shape must not de-optimize the
+        # compiled variants of other shapes
+        avals = tuple(
+            (tuple(getattr(l, "shape", ())), str(getattr(l, "dtype", "")))
+            for l in jax.tree_util.tree_leaves(traced_args))
+        guard = (statics, training, str(treedef), avals)
         if guard in self._broken:
             return self._call_raw(*args, **kwargs)      # graph-break: eager
 

@@ -142,10 +142,10 @@ def glu(x, axis=-1, name=None):
 def softmax(x, axis=-1, dtype=None, name=None):
     from ...core.dtype import convert_dtype
     d = convert_dtype(dtype)
-    def impl(v):
-        vv = v.astype(d) if d is not None else v
+    def impl(v, axis=axis, cast_dtype=d):
+        vv = v.astype(cast_dtype) if cast_dtype is not None else v
         return jax.nn.softmax(vv, axis=axis)
-    return op_call("softmax", impl, x)
+    return op_call("softmax", impl, x, axis=axis, cast_dtype=d)
 
 
 def softmax_(x, axis=-1, dtype=None, name=None):

@@ -74,27 +74,39 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
     return out
 
 
+def layer_norm_ref(v, w=None, b=None, n_axes=1, epsilon=1e-5):
+    """The single jnp-level LayerNorm fallback (fp32 stats). Shared by the
+    functional dispatch default and the Pallas untileable fallback."""
+    axes = tuple(range(v.ndim - n_axes, v.ndim))
+    mean = jnp.mean(v.astype(jnp.float32), axis=axes, keepdims=True)
+    var = jnp.var(v.astype(jnp.float32), axis=axes, keepdims=True)
+    out = ((v - mean) * jax.lax.rsqrt(var + epsilon)).astype(v.dtype)
+    if w is not None:
+        out = out * w
+    if b is not None:
+        out = out + b
+    return out
+
+
 def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=None):
     if isinstance(normalized_shape, int):
         normalized_shape = (normalized_shape,)
     n_axes = len(tuple(normalized_shape))
+    if weight is None and bias is not None:
+        # bias must apply independently of weight (paddle semantics)
+        import paddle_tpu
+        weight = paddle_tpu.ones(list(bias.shape), dtype=str(bias.dtype))
 
-    def impl(v, *rest):
-        axes = tuple(range(v.ndim - n_axes, v.ndim))
-        mean = jnp.mean(v.astype(jnp.float32), axis=axes, keepdims=True)
-        var = jnp.var(v.astype(jnp.float32), axis=axes, keepdims=True)
-        out = ((v - mean) * jax.lax.rsqrt(var + epsilon)).astype(v.dtype)
-        if rest:
-            out = out * rest[0]
-            if len(rest) > 1:
-                out = out + rest[1]
-        return out
+    def impl(v, *rest, n_axes=n_axes, epsilon=epsilon):
+        w = rest[0] if rest else None
+        b = rest[1] if len(rest) > 1 else None
+        return layer_norm_ref(v, w, b, n_axes, epsilon)
     args = [x]
     if weight is not None:
         args.append(weight)
         if bias is not None:
             args.append(bias)
-    return op_call("layer_norm", impl, *args)
+    return op_call("layer_norm", impl, *args, n_axes=n_axes, epsilon=epsilon)
 
 
 def rms_norm_ref(v, w=None, epsilon=1e-6):

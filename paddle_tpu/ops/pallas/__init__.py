@@ -36,6 +36,29 @@ def _naive_sdpa(q, k, v, causal):
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 
 
+def _softmax_pallas(x, *, axis=-1, cast_dtype=None):
+    from . import fused
+    if cast_dtype is not None:
+        x = x.astype(cast_dtype)
+    if axis in (-1, x.ndim - 1):
+        out = fused.softmax(x)
+        if out is not None:
+            return out
+    return jax.nn.softmax(x, axis=axis)
+
+
+def _layer_norm_pallas(x, *rest, n_axes=1, epsilon=1e-5):
+    from . import fused
+    if n_axes == 1 and len(rest) == 2:
+        out = fused.layer_norm(x, rest[0], rest[1], eps=epsilon)
+        if out is not None:
+            return out
+    # unaffine / multi-axis / untileable: the shared jnp fallback
+    from ...nn.functional.norm import layer_norm_ref
+    return layer_norm_ref(x, rest[0] if rest else None,
+                          rest[1] if len(rest) > 1 else None, n_axes, epsilon)
+
+
 def _rms_norm_pallas(x, *rest, epsilon=1e-6):
     from . import fused
     if rest:
@@ -80,6 +103,8 @@ def register_all(force=False):
     register_kernel("flash_attention_causal", impl="pallas")(_fa_causal)
     register_kernel("rms_norm", impl="pallas")(_rms_norm_pallas)
     register_kernel("flash_attention_varlen", impl="pallas")(_fa_varlen)
+    register_kernel("softmax", impl="pallas")(_softmax_pallas)
+    register_kernel("layer_norm", impl="pallas")(_layer_norm_pallas)
     from .fused import adamw_update
     register_kernel("adamw_fused", impl="pallas")(adamw_update)
     _registered[0] = True

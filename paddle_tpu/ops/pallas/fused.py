@@ -20,7 +20,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["rms_norm", "adamw_update"]
+__all__ = ["rms_norm", "adamw_update", "softmax", "layer_norm"]
 
 
 # ---------------------------------------------------------------------------
@@ -209,3 +209,182 @@ def adamw_update(p, g, m, v, *, lr, beta1=0.9, beta2=0.999, eps=1e-8,
     v2 = v.reshape(rows, _LANE).astype(jnp.float32)
     np_, nm, nv = _make_adamw(bool(interpret))(p2, g2, m2, v2, scalars)
     return np_.reshape(shape), nm.reshape(shape), nv.reshape(shape)
+
+
+# ---------------------------------------------------------------------------
+# Softmax (last axis)
+# ---------------------------------------------------------------------------
+def _softmax_fwd_kernel(x_ref, o_ref):
+    x = x_ref[...].astype(jnp.float32)
+    m = jnp.max(x, axis=-1, keepdims=True)
+    e = jnp.exp(x - m)
+    o_ref[...] = (e / jnp.sum(e, axis=-1, keepdims=True)).astype(o_ref.dtype)
+
+
+def _softmax_bwd_kernel(o_ref, g_ref, dx_ref):
+    o = o_ref[...].astype(jnp.float32)
+    g = g_ref[...].astype(jnp.float32)
+    s = jnp.sum(g * o, axis=-1, keepdims=True)
+    dx_ref[...] = (o * (g - s)).astype(dx_ref.dtype)
+
+
+@functools.lru_cache(maxsize=4)
+def _make_softmax(interpret: bool):
+    @jax.custom_vjp
+    def op(x):
+        return fwd(x)[0]
+
+    def fwd(x):
+        n, h = x.shape
+        bn = _rms_block_rows(n, h)
+        o = pl.pallas_call(
+            _softmax_fwd_kernel,
+            grid=(n // bn,),
+            in_specs=[pl.BlockSpec((bn, h), lambda i: (i, 0))],
+            out_specs=pl.BlockSpec((bn, h), lambda i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((n, h), x.dtype),
+            interpret=interpret,
+        )(x)
+        return o, o
+
+    def bwd(o, g):
+        n, h = o.shape
+        bn = _rms_block_rows(n, h)
+        dx = pl.pallas_call(
+            _softmax_bwd_kernel,
+            grid=(n // bn,),
+            in_specs=[pl.BlockSpec((bn, h), lambda i: (i, 0)),
+                      pl.BlockSpec((bn, h), lambda i: (i, 0))],
+            out_specs=pl.BlockSpec((bn, h), lambda i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((n, h), o.dtype),
+            interpret=interpret,
+        )(o, g)
+        return (dx,)
+
+    op.defvjp(fwd, bwd)
+    return op
+
+
+def softmax(x, interpret=False):
+    """Fused last-axis softmax over rows; None when untileable."""
+    h = x.shape[-1]
+    lead = 1
+    for s in x.shape[:-1]:
+        lead *= s
+    if h % 128 != 0 or lead % 8 != 0 or x.ndim < 2:
+        return None
+    out = _make_softmax(bool(interpret))(x.reshape(lead, h))
+    return out.reshape(x.shape)
+
+
+# ---------------------------------------------------------------------------
+# LayerNorm (last axis, affine)
+# ---------------------------------------------------------------------------
+def _ln_fwd_kernel(x_ref, w_ref, b_ref, o_ref, mu_ref, inv_ref, *, eps):
+    x = x_ref[...].astype(jnp.float32)
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    xc = x - mu
+    var = jnp.mean(xc * xc, axis=-1, keepdims=True)
+    inv = jax.lax.rsqrt(var + eps)
+    o_ref[...] = (xc * inv * w_ref[...].astype(jnp.float32)
+                  + b_ref[...].astype(jnp.float32)).astype(o_ref.dtype)
+    mu_ref[...] = mu
+    inv_ref[...] = inv
+
+
+def _ln_bwd_kernel(x_ref, w_ref, mu_ref, inv_ref, g_ref,
+                   dx_ref, dw_ref, db_ref, dw_scr, db_scr, *, num_blocks):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        dw_scr[...] = jnp.zeros_like(dw_scr)
+        db_scr[...] = jnp.zeros_like(db_scr)
+
+    x = x_ref[...].astype(jnp.float32)
+    w = w_ref[...].astype(jnp.float32)
+    g = g_ref[...].astype(jnp.float32)
+    mu = mu_ref[...]
+    inv = inv_ref[...]
+    xhat = (x - mu) * inv
+    gw = g * w
+    m1 = jnp.mean(gw, axis=-1, keepdims=True)
+    m2 = jnp.mean(gw * xhat, axis=-1, keepdims=True)
+    dx_ref[...] = (inv * (gw - m1 - xhat * m2)).astype(dx_ref.dtype)
+    dw_scr[...] += jnp.sum(g * xhat, axis=0, keepdims=True)
+    db_scr[...] += jnp.sum(g, axis=0, keepdims=True)
+
+    @pl.when(i == num_blocks - 1)
+    def _fin():
+        dw_ref[...] = dw_scr[...]
+        db_ref[...] = db_scr[...]
+
+
+@functools.lru_cache(maxsize=8)
+def _make_layer_norm(eps: float, interpret: bool):
+    @jax.custom_vjp
+    def op(x, w, b):
+        o, _ = fwd(x, w, b)
+        return o
+
+    def fwd(x, w, b):
+        n, h = x.shape
+        bn = _rms_block_rows(n, h)
+        o, mu, inv = pl.pallas_call(
+            functools.partial(_ln_fwd_kernel, eps=eps),
+            grid=(n // bn,),
+            in_specs=[pl.BlockSpec((bn, h), lambda i: (i, 0)),
+                      pl.BlockSpec((1, h), lambda i: (0, 0)),
+                      pl.BlockSpec((1, h), lambda i: (0, 0))],
+            out_specs=[pl.BlockSpec((bn, h), lambda i: (i, 0)),
+                       pl.BlockSpec((bn, 1), lambda i: (i, 0)),
+                       pl.BlockSpec((bn, 1), lambda i: (i, 0))],
+            out_shape=[jax.ShapeDtypeStruct((n, h), x.dtype),
+                       jax.ShapeDtypeStruct((n, 1), jnp.float32),
+                       jax.ShapeDtypeStruct((n, 1), jnp.float32)],
+            interpret=interpret,
+        )(x, w.reshape(1, h), b.reshape(1, h))
+        return o, (x, w, b, mu, inv)
+
+    def bwd(res, g):
+        x, w, b, mu, inv = res
+        n, h = x.shape
+        bn = _rms_block_rows(n, h)
+        dx, dw, db = pl.pallas_call(
+            functools.partial(_ln_bwd_kernel, num_blocks=n // bn),
+            grid=(n // bn,),
+            in_specs=[pl.BlockSpec((bn, h), lambda i: (i, 0)),
+                      pl.BlockSpec((1, h), lambda i: (0, 0)),
+                      pl.BlockSpec((bn, 1), lambda i: (i, 0)),
+                      pl.BlockSpec((bn, 1), lambda i: (i, 0)),
+                      pl.BlockSpec((bn, h), lambda i: (i, 0))],
+            out_specs=[pl.BlockSpec((bn, h), lambda i: (i, 0)),
+                       pl.BlockSpec((1, h), lambda i: (0, 0)),
+                       pl.BlockSpec((1, h), lambda i: (0, 0))],
+            out_shape=[jax.ShapeDtypeStruct((n, h), x.dtype),
+                       jax.ShapeDtypeStruct((1, h), jnp.float32),
+                       jax.ShapeDtypeStruct((1, h), jnp.float32)],
+            scratch_shapes=[pltpu.VMEM((1, h), jnp.float32),
+                            pltpu.VMEM((1, h), jnp.float32)],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("arbitrary",)),
+            interpret=interpret,
+        )(x, w.reshape(1, h), mu, inv, g)
+        return dx, dw.reshape(w.shape).astype(w.dtype), \
+            db.reshape(b.shape).astype(b.dtype)
+
+    op.defvjp(fwd, bwd)
+    return op
+
+
+def layer_norm(x, weight, bias, eps=1e-5, interpret=False):
+    """Fused affine LayerNorm over the last dim; None when untileable."""
+    h = x.shape[-1]
+    lead = 1
+    for s in x.shape[:-1]:
+        lead *= s
+    if h % 128 != 0 or lead % 8 != 0 or x.ndim < 2:
+        return None
+    out = _make_layer_norm(float(eps), bool(interpret))(
+        x.reshape(lead, h), weight, bias)
+    return out.reshape(x.shape)

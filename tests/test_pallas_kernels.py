@@ -269,3 +269,49 @@ def test_flash_attn_unpadded_matches_per_sequence():
                         jnp.asarray(qkv[2][None, lo:hi]), True)
         np.testing.assert_allclose(out[lo:hi], np.asarray(ref)[0],
                                    rtol=2e-4, atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# Fused softmax / layer_norm kernels (SURVEY §2.1 north star completion)
+# ---------------------------------------------------------------------------
+def test_fused_softmax_forward_and_grads():
+    from paddle_tpu.ops.pallas.fused import softmax as psoftmax
+    x = jnp.asarray(rng.standard_normal((16, 256)).astype(np.float32))
+    out = psoftmax(x, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(jax.nn.softmax(x, -1)),
+                               rtol=1e-5, atol=1e-6)
+    g_k = jax.grad(lambda v: (psoftmax(v, interpret=True) ** 2).sum())(x)
+    g_r = jax.grad(lambda v: (jax.nn.softmax(v, -1) ** 2).sum())(x)
+    np.testing.assert_allclose(np.asarray(g_k), np.asarray(g_r),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_fused_softmax_untileable_returns_none():
+    from paddle_tpu.ops.pallas.fused import softmax as psoftmax
+    assert psoftmax(jnp.zeros((4, 100)), interpret=True) is None
+    assert psoftmax(jnp.zeros((128,)), interpret=True) is None
+
+
+def test_fused_layer_norm_forward_and_grads():
+    from paddle_tpu.ops.pallas.fused import layer_norm as pln
+    N, H = 16, 128
+    eps = 1e-5
+    x = jnp.asarray(rng.standard_normal((N, H)).astype(np.float32))
+    w = jnp.asarray(rng.standard_normal((H,)).astype(np.float32))
+    b = jnp.asarray(rng.standard_normal((H,)).astype(np.float32))
+
+    def ref(x, w, b):
+        mu = jnp.mean(x, -1, keepdims=True)
+        var = jnp.var(x, -1, keepdims=True)
+        return (x - mu) * jax.lax.rsqrt(var + eps) * w + b
+
+    out = pln(x, w, b, eps=eps, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref(x, w, b)),
+                               rtol=1e-5, atol=1e-5)
+    g_k = jax.grad(lambda x, w, b: (pln(x, w, b, eps=eps, interpret=True) ** 2).sum(),
+                   argnums=(0, 1, 2))(x, w, b)
+    g_r = jax.grad(lambda x, w, b: (ref(x, w, b) ** 2).sum(),
+                   argnums=(0, 1, 2))(x, w, b)
+    for a, bb in zip(g_k, g_r):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(bb),
+                                   rtol=2e-4, atol=2e-4)
