@@ -11,6 +11,7 @@ Two forms:
 """
 from __future__ import annotations
 
+import functools
 import math
 from dataclasses import dataclass, field
 
@@ -27,7 +28,7 @@ from ..incubate.nn.functional import fused_rotary_position_embedding
 __all__ = ["LlamaConfig", "LlamaForCausalLM", "LlamaModel", "LlamaDecoderLayer",
            "build_functional_llama", "llama_microbatch_fns", "llama_block_specs",
            "llama_config_7b", "llama_config_tiny", "build_llama_decode",
-           "functional_params_from_layer"]
+           "functional_params_from_layer", "llama_generate"]
 
 
 @dataclass
@@ -245,6 +246,29 @@ class LlamaForCausalLM(Layer):
         self.lm_head = Linear(config.hidden_size, config.vocab_size, bias_attr=False)
         if config.tie_word_embeddings:
             self.lm_head.weight = self.model.embed_tokens.weight
+
+    def generate(self, input_ids, max_new_tokens=32, temperature=0.0,
+                 top_k=0, top_p=1.0, eos_token_id=None, seed=0):
+        """Compiled KV-cache generation (PaddleNLP model.generate analog):
+        exports this Layer's weights to the functional decode path once and
+        decodes with a jitted per-token step."""
+        if self.config.tensor_parallel_degree > 1:
+            raise NotImplementedError("generate() needs full weights on this "
+                                      "host (tensor_parallel_degree == 1)")
+        if self.config.num_experts > 1:
+            raise NotImplementedError(
+                "generate() does not support the MoE variant — the functional "
+                "decode path computes the dense FFN")
+        # re-export per call: weights may have trained since the last one
+        params = functional_params_from_layer(self)
+        ids = input_ids._value if isinstance(input_ids, Tensor) \
+            else jnp.asarray(input_ids)
+        out = llama_generate(params, self.config, ids,
+                             max_new_tokens=max_new_tokens,
+                             temperature=temperature, top_k=top_k,
+                             top_p=top_p, eos_token_id=eos_token_id,
+                             seed=seed)
+        return Tensor(out)
 
     def forward(self, input_ids, labels=None):
         h = self.model(input_ids)
@@ -615,7 +639,12 @@ def build_llama_decode(config: LlamaConfig, max_seq: int = None, dtype=None):
 def functional_params_from_layer(model: "LlamaForCausalLM"):
     """Stack an eager LlamaForCausalLM's per-layer weights into the
     (embed, block, head) pytrees the functional/decode paths consume.
-    Requires tensor_parallel_degree == 1 (full weights on this host)."""
+    Requires tensor_parallel_degree == 1 (full weights on this host) and
+    the dense (non-MoE) variant."""
+    if getattr(model.config, "num_experts", 1) > 1:
+        raise NotImplementedError(
+            "functional_params_from_layer: MoE experts do not map onto the "
+            "dense wgate/wup/wdown leaves")
     m = model.model
     def val(p):
         return p._value
@@ -633,3 +662,96 @@ def functional_params_from_layer(model: "LlamaForCausalLM"):
     ep = {"tok": val(m.embed_tokens.weight)}
     hp = {"ln_f": val(m.norm.weight), "lm": val(model.lm_head.weight)}
     return ep, bp, hp
+
+
+def _sample_token(logits, key, temperature=1.0, top_k=0, top_p=1.0):
+    """logits [B, V] -> token ids [B] (greedy when temperature == 0)."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k and top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # smallest set with cumulative prob >= top_p; keep at least 1
+        cutoff_idx = jnp.sum(cum < top_p, axis=-1)
+        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx[:, None], -1)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+def llama_generate(params, config: LlamaConfig, input_ids, max_new_tokens=32,
+                   temperature=0.0, top_k=0, top_p=1.0, eos_token_id=None,
+                   seed=0, max_seq=None):
+    """Compiled autoregressive generation over the KV-cache decode path
+    (the PaddleNLP `model.generate` analog for the functional params).
+
+    input_ids: int [B, T_prompt] (numpy/jax). Returns int32 of FIXED shape
+    [B, T_prompt + max_new_tokens]; once a sequence emits eos_token_id its
+    tail is padded with eos. Raises when the total length exceeds the cache
+    (max_seq / max_position_embeddings). The jitted prefill/decode/sample
+    executables are cached per (config, lengths, sampling knobs) so serving
+    loops compile once.
+    """
+    c = config
+    if c.num_experts > 1:
+        raise NotImplementedError(
+            "llama_generate: the MoE decode path is not implemented — "
+            "build_llama_decode computes the dense FFN")
+    ids = jnp.asarray(input_ids, jnp.int32)
+    B, T = ids.shape
+    required = T + max_new_tokens
+    S_max = max_seq or min(c.max_position_embeddings, required)
+    if required > S_max:
+        raise ValueError(
+            f"prompt ({T}) + max_new_tokens ({max_new_tokens}) = {required} "
+            f"exceeds the KV cache length {S_max}; raise max_seq / "
+            "max_position_embeddings or generate fewer tokens")
+    prefill, decode, sample = _generate_executables(
+        c, S_max, temperature, top_k, top_p)
+    key = jax.random.PRNGKey(seed)
+
+    logits, cache = prefill(params, ids)
+    out = [ids]
+    done = jnp.zeros((B,), bool)
+    for i in range(max_new_tokens):
+        key, sub = jax.random.split(key)
+        tok = sample(logits, sub)
+        if eos_token_id is not None:
+            tok = jnp.where(done, eos_token_id, tok)
+            done = done | (tok == eos_token_id)
+        out.append(tok[:, None])
+        if i == max_new_tokens - 1:
+            break                        # the next logits would be discarded
+        if eos_token_id is not None and bool(done.all()):
+            # every sequence finished: pad the tail to the fixed shape
+            pad = jnp.full((B, max_new_tokens - 1 - i), eos_token_id,
+                           jnp.int32)
+            out.append(pad)
+            break
+        logits, cache = decode(params, tok, cache)
+    return jnp.concatenate(out, axis=1)
+
+
+_GENERATE_CACHE = {}
+
+
+def _generate_executables(config, S_max, temperature, top_k, top_p):
+    """(prefill, decode, sample) jitted once per key — new closures per call
+    would defeat jax.jit's cache entirely."""
+    ckey = (tuple(sorted(config.__dict__.items())), S_max,
+            float(temperature), int(top_k), float(top_p))
+    hit = _GENERATE_CACHE.get(ckey)
+    if hit is not None:
+        return hit
+    _, prefill, decode_step = build_llama_decode(config, max_seq=S_max)
+    entry = (jax.jit(prefill), jax.jit(decode_step),
+             jax.jit(functools.partial(_sample_token, temperature=temperature,
+                                       top_k=top_k, top_p=top_p)))
+    if len(_GENERATE_CACHE) > 16:
+        _GENERATE_CACHE.clear()          # bound the executable cache
+    _GENERATE_CACHE[ckey] = entry
+    return entry

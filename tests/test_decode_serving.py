@@ -131,3 +131,99 @@ def test_predictor_over_stablehlo_artifact(tmp_path):
     with paddle.no_grad():
         ref = net(paddle.to_tensor(x)).numpy()
     np.testing.assert_allclose(outs[0], np.asarray(ref), rtol=1e-5, atol=1e-6)
+
+
+def test_llama_generate_sampling_modes():
+    from paddle_tpu.models.llama import (build_functional_llama,
+                                         llama_generate)
+    cfg = _tiny()
+    params = _params(cfg, seed=9)
+    rng = np.random.default_rng(9)
+    prompt = rng.integers(0, 64, (2, 4)).astype(np.int32)
+    # greedy is deterministic
+    a = llama_generate(params, cfg, prompt, max_new_tokens=6, temperature=0.0)
+    b = llama_generate(params, cfg, prompt, max_new_tokens=6, temperature=0.0)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert a.shape == (2, 10)
+    np.testing.assert_array_equal(np.asarray(a)[:, :4], prompt)
+    # sampling with same seed is reproducible; different seeds diverge
+    s1 = llama_generate(params, cfg, prompt, max_new_tokens=6,
+                        temperature=1.0, top_k=8, top_p=0.9, seed=1)
+    s2 = llama_generate(params, cfg, prompt, max_new_tokens=6,
+                        temperature=1.0, top_k=8, top_p=0.9, seed=1)
+    s3 = llama_generate(params, cfg, prompt, max_new_tokens=6,
+                        temperature=1.0, top_k=8, top_p=0.9, seed=2)
+    np.testing.assert_array_equal(np.asarray(s1), np.asarray(s2))
+    assert not np.array_equal(np.asarray(s1), np.asarray(s3))
+
+
+def test_llama_generate_eos_freezes_sequences():
+    from paddle_tpu.models.llama import llama_generate
+    cfg = _tiny()
+    params = _params(cfg, seed=10)
+    prompt = np.asarray([[1, 2, 3, 4]], np.int32)
+    out = np.asarray(llama_generate(params, cfg, prompt, max_new_tokens=8,
+                                    temperature=0.0, eos_token_id=0))
+    # after the first 0 (if any) everything stays 0
+    gen = out[0, 4:]
+    if (gen == 0).any():
+        first = int(np.argmax(gen == 0))
+        assert (gen[first:] == 0).all()
+
+
+def test_layer_generate_method():
+    cfg = _tiny()
+    paddle.seed(11)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    ids = np.random.default_rng(11).integers(0, 64, (1, 5)).astype(np.int32)
+    out = model.generate(paddle.to_tensor(ids), max_new_tokens=4)
+    assert tuple(out.shape) == (1, 9)
+    # teacher-check the first generated token against the model's own argmax
+    with paddle.no_grad():
+        logits = model(paddle.to_tensor(ids))
+    expect = int(np.argmax(np.asarray(logits.numpy())[0, -1]))
+    assert int(np.asarray(out.numpy())[0, 5]) == expect
+
+
+def test_generate_rejects_overlong_and_moe():
+    from paddle_tpu.models.llama import llama_generate, LlamaConfig
+    cfg = _tiny()                                  # seq cap 32
+    params = _params(cfg)
+    prompt = np.zeros((1, 30), np.int32)
+    with pytest.raises(ValueError, match="exceeds the KV cache"):
+        llama_generate(params, cfg, prompt, max_new_tokens=8)
+    moe_cfg = LlamaConfig(vocab_size=64, hidden_size=32, intermediate_size=48,
+                          num_hidden_layers=2, num_attention_heads=4,
+                          num_key_value_heads=4, max_position_embeddings=32,
+                          num_experts=4)
+    with pytest.raises(NotImplementedError, match="MoE"):
+        llama_generate(params, moe_cfg, np.zeros((1, 4), np.int32))
+
+
+def test_generate_eos_keeps_fixed_shape():
+    from paddle_tpu.models.llama import llama_generate
+    cfg = _tiny()
+    params = _params(cfg, seed=12)
+    prompt = np.asarray([[1, 2, 3, 4]], np.int32)
+    # force instant eos: whatever greedy emits first, treat as eos
+    first = int(np.asarray(llama_generate(params, cfg, prompt,
+                                          max_new_tokens=1,
+                                          temperature=0.0))[0, 4])
+    out = np.asarray(llama_generate(params, cfg, prompt, max_new_tokens=6,
+                                    temperature=0.0, eos_token_id=first))
+    assert out.shape == (1, 10)                    # fixed length, eos-padded
+    assert (out[0, 4:] == first).all()
+
+
+def test_generate_executable_cache_hits():
+    from paddle_tpu.models import llama as llama_mod
+    cfg = _tiny()
+    params = _params(cfg, seed=13)
+    llama_mod._GENERATE_CACHE.clear()
+    prompt = np.zeros((1, 4), np.int32)
+    llama_generate_kwargs = dict(max_new_tokens=3, temperature=0.0)
+    llama_mod.llama_generate(params, cfg, prompt, **llama_generate_kwargs)
+    assert len(llama_mod._GENERATE_CACHE) == 1
+    llama_mod.llama_generate(params, cfg, prompt, **llama_generate_kwargs)
+    assert len(llama_mod._GENERATE_CACHE) == 1     # reused, not rebuilt

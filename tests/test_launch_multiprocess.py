@@ -125,3 +125,18 @@ def test_launch_max_restarts_recovers(tmp_path):
     assert proc.returncode == 0, proc.stdout
     assert (tmp_path / "done.0").exists(), proc.stdout   # rank0 survived retry
     assert (tmp_path / "done.1").exists(), proc.stdout
+
+
+def test_launch_two_process_full_collective_set(tmp_path):
+    """psum / all_gather / psum_scatter / all_to_all / ppermute across a
+    REAL process boundary (shard_map over the 2-process global mesh)."""
+    log_dir = str(tmp_path / "logs")
+    proc = _launch("collectives_check.py", nproc=2, log_dir=log_dir)
+    logs = ""
+    for r in (0, 1):
+        p = os.path.join(log_dir, f"workerlog.{r}")
+        if os.path.exists(p):
+            logs += open(p).read()
+    assert proc.returncode == 0, f"launch failed:\n{proc.stdout}\n{logs}"
+    assert "RANK0 COLLECTIVES_OK" in logs, logs
+    assert "RANK1 COLLECTIVES_OK" in logs, logs
