@@ -217,20 +217,30 @@ def op_call(name: str, fn: Callable, *args, nondiff: bool = False, **static_kwar
     need_grad = (not nondiff) and _GradMode.enabled and any(
         not args[i].stop_gradient for i in tensor_idx)
 
-    if need_grad:
+    # Under an outer jit/grad trace the tape is NOT the autodiff engine —
+    # the outer jax transform differentiates the staged ops directly.
+    # Recording the inner jax.vjp there is wasted work AND breaks
+    # custom_vjp kernel impls (the outer grad would have to differentiate
+    # through the inner linearization: "Linearization failed to produce
+    # known values"). Stage the op plainly and let outer autodiff own it.
+    tracing = any(isinstance(v, jax.core.Tracer) for v in vals)
+
+    if need_grad and not tracing:
         # differentiate only w.r.t. inexact-dtype tensor inputs
         diff_idx = [i for i in tensor_idx
                     if jnp.issubdtype(jnp.result_type(vals[i]), jnp.inexact)]
         need_grad = bool(diff_idx)
 
-    if not need_grad:
+    if not need_grad or tracing:
         out = impl(*vals, **static_kwargs)
         multi = isinstance(out, (tuple, list))
         outs = tuple(out) if multi else (out,)
         if flags.get_flag("check_nan_inf"):
             _check_numerics(name, outs)
         _record_op(name, vals, outs, impl, static_kwargs)
-        wrapped = tuple(Tensor(o, stop_gradient=True) if not isinstance(o, Tensor) else o
+        # keep differentiability visible to downstream eager semantics
+        sg = (not need_grad) if tracing else True
+        wrapped = tuple(Tensor(o, stop_gradient=sg) if not isinstance(o, Tensor) else o
                         for o in outs)
         return wrapped if multi else wrapped[0]
 

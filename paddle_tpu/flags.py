@@ -106,6 +106,9 @@ define_flag("check_nan_inf_level", 0, "0: abort on NaN/Inf; >=1: warn only.", ty
 define_flag("benchmark", False, "Block on every op for accurate timing.", type=bool)
 define_flag("paddle_tpu_deterministic", False, "Force deterministic kernels.", type=bool)
 define_flag("use_pallas_kernels", True, "Enable Pallas kernel overrides for hot ops.", type=bool)
+define_flag("use_pallas_norm_kernels", False, "Also override softmax/layer_norm with the "
+            "Pallas kernels (measured slower than XLA's own fusion inside full models "
+            "on v5e — opt-in; the kernels themselves are tested and correct).", type=bool)
 define_flag("log_level", 0, "VLOG-style verbosity.", type=int)
 define_flag("amp_dtype", "bfloat16", "Default AMP low-precision dtype on TPU.", type=str)
 define_flag("allocator_strategy", "xla", "Informational: HBM is managed by XLA.", type=str,

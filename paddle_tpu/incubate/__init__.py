@@ -4,5 +4,6 @@ Pallas overrides provide the fusion on TPU."""
 from . import nn
 from . import autograd
 from . import distributed
+from . import asp
 
-__all__ = ["nn", "autograd", "distributed"]
+__all__ = ["nn", "autograd", "distributed", "asp"]

@@ -1,0 +1,127 @@
+"""ASP — automatic structured (n:m) sparsity (reference:
+python/paddle/incubate/asp/ — ASPHelper, calculate_density,
+create_mask/check_mask 2:4 patterns, decorate() masked optimizer).
+
+TPU-native note: XLA has no sparse-MXU path, so n:m sparsity here delivers
+the reference's TRAINING workflow (prune → masked fine-tune → export masks)
+rather than a speedup; the masks ride along for deployment stacks that can
+exploit them.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...nn.layer import Layer
+
+__all__ = ["calculate_density", "create_mask", "check_sparsity",
+           "prune_model", "decorate", "reset_excluded_layers",
+           "set_excluded_layers"]
+
+_EXCLUDED = set()
+
+
+def set_excluded_layers(param_names, main_program=None):
+    _EXCLUDED.update(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    _EXCLUDED.clear()
+
+
+def calculate_density(x) -> float:
+    v = np.asarray(x._value if hasattr(x, "_value") else x)
+    return float((v != 0).sum() / v.size)
+
+
+def create_mask(w, n: int = 2, m: int = 4):
+    """n:m mask along the LAST dim: in every group of m consecutive values
+    keep the n largest magnitudes (reference create_mask / get_mask_2d
+    best-effort for non-divisible tails)."""
+    v = jnp.asarray(w._value if hasattr(w, "_value") else w)
+    shape = v.shape
+    last = shape[-1]
+    pad = (-last) % m
+    flat = v.reshape(-1, last)
+    if pad:
+        flat = jnp.concatenate(
+            [flat, jnp.zeros((flat.shape[0], pad), flat.dtype)], axis=1)
+    groups = flat.reshape(flat.shape[0], -1, m)
+    # rank within each group; keep the n largest |values|
+    order = jnp.argsort(jnp.abs(groups), axis=-1)
+    ranks = jnp.argsort(order, axis=-1)        # rank of each element
+    mask = (ranks >= m - n).astype(v.dtype)
+    mask = mask.reshape(flat.shape[0], -1)[:, :last].reshape(shape)
+    return mask
+
+
+def check_sparsity(w, n: int = 2, m: int = 4) -> bool:
+    """True when every complete m-group has at most n nonzeros."""
+    v = np.asarray(w._value if hasattr(w, "_value") else w)
+    last = v.shape[-1]
+    usable = last - last % m
+    if usable == 0:
+        return True
+    g = v.reshape(-1, last)[:, :usable].reshape(-1, m)
+    return bool(((g != 0).sum(axis=-1) <= n).all())
+
+
+def _prunable(model: Layer):
+    from ...nn import Linear, Conv2D
+    for name, sub in model.named_sublayers(include_self=True):
+        if isinstance(sub, (Linear, Conv2D)):
+            pname = f"{name}.weight" if name else "weight"
+            if pname in _EXCLUDED or name in _EXCLUDED:
+                continue
+            yield pname, sub
+
+
+# module-level mask registry (the reference ASPHelper keeps one too):
+# prune_model registers layers here so decorate() works regardless of
+# call order and with the reference's decorate(optimizer) signature
+_MASKED_LAYERS = []
+
+
+def prune_model(model: Layer, n: int = 2, m: int = 4, mask_algo="mask_1d",
+                with_mask=True):
+    """Apply n:m masks to every Linear/Conv2D weight (reference
+    ASPHelper.prune_model). Masks are recorded on the layer
+    (`sub.asp_mask`), in the module registry, and in the returned dict."""
+    masks = {}
+    for pname, sub in _prunable(model):
+        mask = create_mask(sub.weight, n, m)
+        sub.weight._set_value(sub.weight._value * mask)
+        sub.asp_mask = mask
+        masks[pname] = mask
+        if all(existing is not sub for existing in _MASKED_LAYERS):
+            _MASKED_LAYERS.append(sub)
+    model._asp_masks = masks
+    return masks
+
+
+def decorate(optimizer, model: Layer = None):
+    """Wrap optimizer.step to re-apply the pruning masks after every update
+    (reference OptimizerWithSparsityGuarantee): gradients may point off the
+    sparse support, the mask projection puts the weights back on it.
+
+    Masks are looked up AT STEP TIME (model sublayers when given, else the
+    module registry prune_model fills), so decorate-before-prune — the
+    reference's documented order — works."""
+    orig_step = optimizer.step
+
+    def step(*a, **kw):
+        out = orig_step(*a, **kw)
+        if model is not None:
+            layers = (sub for _, sub in _prunable(model))
+        else:
+            layers = iter(_MASKED_LAYERS)
+        for sub in layers:
+            mask = getattr(sub, "asp_mask", None)
+            if mask is not None:
+                sub.weight._set_value(sub.weight._value * mask)
+        return out
+
+    optimizer.step = step
+    optimizer._asp_decorated = True
+    return optimizer

@@ -704,7 +704,10 @@ def llama_generate(params, config: LlamaConfig, input_ids, max_new_tokens=32,
     ids = jnp.asarray(input_ids, jnp.int32)
     B, T = ids.shape
     required = T + max_new_tokens
-    S_max = max_seq or min(c.max_position_embeddings, required)
+    # default the cache to the model's full context so the decode executable
+    # is SHARED across prompt lengths (a per-request S_max would recompile
+    # per distinct length); prefill still re-traces per prompt length only
+    S_max = max_seq or c.max_position_embeddings
     if required > S_max:
         raise ValueError(
             f"prompt ({T}) + max_new_tokens ({max_new_tokens}) = {required} "
@@ -752,6 +755,7 @@ def _generate_executables(config, S_max, temperature, top_k, top_p):
              jax.jit(functools.partial(_sample_token, temperature=temperature,
                                        top_k=top_k, top_p=top_p)))
     if len(_GENERATE_CACHE) > 16:
-        _GENERATE_CACHE.clear()          # bound the executable cache
+        # FIFO-evict ONE entry; clearing all would thrash hot executables
+        _GENERATE_CACHE.pop(next(iter(_GENERATE_CACHE)))
     _GENERATE_CACHE[ckey] = entry
     return entry

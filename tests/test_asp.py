@@ -1,0 +1,84 @@
+"""ASP structured-sparsity tests (reference incubate/asp: ASPHelper,
+create_mask 2:4, prune_model, masked-optimizer decorate)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.incubate import asp
+
+
+def test_create_mask_2_4_property():
+    rng = np.random.default_rng(0)
+    w = rng.normal(0, 1, (16, 64)).astype(np.float32)
+    mask = np.asarray(asp.create_mask(w, 2, 4))
+    g = mask.reshape(-1, 4)
+    assert (g.sum(axis=-1) == 2).all()           # exactly 2 of every 4 kept
+    # kept entries are the 2 largest magnitudes per group
+    wg = np.abs(w.reshape(-1, 4))
+    for i in range(wg.shape[0]):
+        kept = set(np.where(g[i] > 0)[0])
+        top2 = set(np.argsort(-wg[i])[:2])
+        assert kept == top2
+
+
+def test_prune_model_and_density():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(32, 64), nn.ReLU(), nn.Linear(64, 8))
+    masks = asp.prune_model(net, 2, 4)
+    assert len(masks) == 2
+    for lin in (net[0], net[2]):
+        assert asp.check_sparsity(lin.weight, 2, 4)
+        assert abs(asp.calculate_density(lin.weight) - 0.5) < 0.05
+
+
+def test_decorated_optimizer_keeps_sparsity():
+    paddle.seed(1)
+    net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 1))
+    asp.prune_model(net, 2, 4)
+    opt = asp.decorate(
+        optimizer.AdamW(learning_rate=1e-2, parameters=net.parameters()), net)
+    rng = np.random.default_rng(1)
+    x = paddle.to_tensor(rng.normal(0, 1, (8, 16)).astype(np.float32))
+    y = paddle.to_tensor(rng.normal(0, 1, (8, 1)).astype(np.float32))
+    losses = []
+    for _ in range(8):
+        loss = ((net(x) - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]                # masked training converges
+    for lin in (net[0], net[2]):
+        assert asp.check_sparsity(lin.weight, 2, 4)   # sparsity survived
+
+
+def test_excluded_layers_skipped():
+    paddle.seed(2)
+    net = nn.Sequential(nn.Linear(8, 8), nn.Linear(8, 8))
+    asp.reset_excluded_layers()
+    asp.set_excluded_layers(["0"])
+    masks = asp.prune_model(net, 2, 4)
+    asp.reset_excluded_layers()
+    assert "0.weight" not in masks and "1.weight" in masks
+    assert asp.calculate_density(net[0].weight) > 0.9   # untouched
+
+
+def test_decorate_before_prune_reference_order():
+    """Regression: the reference workflow decorates the optimizer BEFORE
+    prune_model — masks must still be re-applied at step time."""
+    paddle.seed(3)
+    net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 1))
+    opt = asp.decorate(
+        optimizer.AdamW(learning_rate=1e-2, parameters=net.parameters()))
+    asp.prune_model(net, 2, 4)           # after decorate, no model arg above
+    rng = np.random.default_rng(3)
+    x = paddle.to_tensor(rng.normal(0, 1, (8, 16)).astype(np.float32))
+    y = paddle.to_tensor(rng.normal(0, 1, (8, 1)).astype(np.float32))
+    for _ in range(3):
+        loss = ((net(x) - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    for lin in (net[0], net[2]):
+        assert asp.check_sparsity(lin.weight, 2, 4)

@@ -208,3 +208,37 @@ def test_state_dict_with_prefix_and_buffer():
     l = nn.BatchNorm1D(4)
     sd = l.state_dict(structured_name_prefix="model.")
     assert any(k.startswith("model.") and k.endswith("_mean") for k in sd)
+
+
+def test_op_call_custom_vjp_kernel_under_outer_grad():
+    """Regression (r3 dispatch fix): an op whose registered kernel is a
+    jax.custom_vjp must be differentiable by an OUTER jax.grad over eager
+    Layer code traced via functional_state/jit — the tape must stage the
+    op plainly under tracing instead of wrapping it in an inner jax.vjp
+    ('Linearization failed to produce known values' otherwise)."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.core.dispatch import register_kernel, _KERNELS, op_call
+    from paddle_tpu.core.tensor import Tensor
+
+    @jax.custom_vjp
+    def triple(v):
+        return v * 3.0
+
+    triple.defvjp(lambda v: (v * 3.0, None), lambda _, g: (g * 3.0,))
+    register_kernel("triple_demo_cvjp")(lambda v: triple(v))
+    try:
+        def fn(x):
+            t = Tensor(x, stop_gradient=False)
+            out = op_call("triple_demo_cvjp", lambda v: v * 3.0, t)
+            # traced outputs of differentiable ops keep stop_gradient=False
+            assert out.stop_gradient is False
+            return (out._value ** 2).sum()
+
+        x = jnp.arange(4, dtype=jnp.float32)
+        g = jax.jit(jax.grad(fn))(x)
+        # d/dx (3x)^2 = 18x
+        np.testing.assert_allclose(np.asarray(g), 18.0 * np.arange(4),
+                                   rtol=1e-6)
+    finally:
+        _KERNELS.pop("triple_demo_cvjp", None)
