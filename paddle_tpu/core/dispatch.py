@@ -225,8 +225,9 @@ def op_call(name: str, fn: Callable, *args, nondiff: bool = False, **static_kwar
     # known values"). Stage the op plainly and let outer autodiff own it.
     tracing = any(isinstance(v, jax.core.Tracer) for v in vals)
 
-    if need_grad and not tracing:
-        # differentiate only w.r.t. inexact-dtype tensor inputs
+    if need_grad:
+        # differentiate only w.r.t. inexact-dtype tensor inputs (refined in
+        # BOTH modes so traced/eager stop_gradient semantics agree)
         diff_idx = [i for i in tensor_idx
                     if jnp.issubdtype(jnp.result_type(vals[i]), jnp.inexact)]
         need_grad = bool(diff_idx)

@@ -9,6 +9,8 @@ exploit them.
 """
 from __future__ import annotations
 
+import weakref
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -57,8 +59,11 @@ def create_mask(w, n: int = 2, m: int = 4):
 
 
 def check_sparsity(w, n: int = 2, m: int = 4) -> bool:
-    """True when every complete m-group has at most n nonzeros."""
+    """True when every complete m-group has at most n nonzeros (convs are
+    checked over the in*kh*kw GEMM view, matching prune_model)."""
     v = np.asarray(w._value if hasattr(w, "_value") else w)
+    if v.ndim > 2:
+        v = v.reshape(v.shape[0], -1)
     last = v.shape[-1]
     usable = last - last % m
     if usable == 0:
@@ -79,8 +84,21 @@ def _prunable(model: Layer):
 
 # module-level mask registry (the reference ASPHelper keeps one too):
 # prune_model registers layers here so decorate() works regardless of
-# call order and with the reference's decorate(optimizer) signature
+# call order and with the reference's decorate(optimizer) signature.
+# WEAK references: discarded models must be garbage-collectable.
 _MASKED_LAYERS = []
+
+
+def _live_masked_layers():
+    out = []
+    alive = []
+    for ref in _MASKED_LAYERS:
+        sub = ref()
+        if sub is not None:
+            alive.append(ref)
+            out.append(sub)
+    _MASKED_LAYERS[:] = alive
+    return out
 
 
 def prune_model(model: Layer, n: int = 2, m: int = 4, mask_algo="mask_1d",
@@ -90,12 +108,19 @@ def prune_model(model: Layer, n: int = 2, m: int = 4, mask_algo="mask_1d",
     (`sub.asp_mask`), in the module registry, and in the returned dict."""
     masks = {}
     for pname, sub in _prunable(model):
-        mask = create_mask(sub.weight, n, m)
-        sub.weight._set_value(sub.weight._value * mask)
+        w = sub.weight._value
+        if w.ndim > 2:
+            # conv OIHW: mask over the GEMM reduction view in*kh*kw (the
+            # reference prunes the im2col matrix, not the kw axis alone)
+            m2d = create_mask(w.reshape(w.shape[0], -1), n, m)
+            mask = m2d.reshape(w.shape)
+        else:
+            mask = create_mask(w, n, m)
+        sub.weight._set_value(w * mask)
         sub.asp_mask = mask
         masks[pname] = mask
-        if all(existing is not sub for existing in _MASKED_LAYERS):
-            _MASKED_LAYERS.append(sub)
+        if all(ref() is not sub for ref in _MASKED_LAYERS):
+            _MASKED_LAYERS.append(weakref.ref(sub))
     model._asp_masks = masks
     return masks
 
@@ -115,7 +140,7 @@ def decorate(optimizer, model: Layer = None):
         if model is not None:
             layers = (sub for _, sub in _prunable(model))
         else:
-            layers = iter(_MASKED_LAYERS)
+            layers = iter(_live_masked_layers())
         for sub in layers:
             mask = getattr(sub, "asp_mask", None)
             if mask is not None:

@@ -704,10 +704,11 @@ def llama_generate(params, config: LlamaConfig, input_ids, max_new_tokens=32,
     ids = jnp.asarray(input_ids, jnp.int32)
     B, T = ids.shape
     required = T + max_new_tokens
-    # default the cache to the model's full context so the decode executable
-    # is SHARED across prompt lengths (a per-request S_max would recompile
-    # per distinct length); prefill still re-traces per prompt length only
-    S_max = max_seq or c.max_position_embeddings
+    # bucket the cache length (multiple of 256, capped by the model
+    # context): requests in the same bucket SHARE the decode executable,
+    # without allocating a full-context KV cache for short generations
+    bucket = min(c.max_position_embeddings, ((required + 255) // 256) * 256)
+    S_max = max_seq or bucket
     if required > S_max:
         raise ValueError(
             f"prompt ({T}) + max_new_tokens ({max_new_tokens}) = {required} "

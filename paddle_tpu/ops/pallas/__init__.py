@@ -38,9 +38,11 @@ def _naive_sdpa(q, k, v, causal):
 
 def _softmax_pallas(x, *, axis=-1, cast_dtype=None):
     from . import fused
+    from ... import flags as _flags
     if cast_dtype is not None:
         x = x.astype(cast_dtype)
-    if axis in (-1, x.ndim - 1):
+    # flag read at CALL time so toggling works after first registration
+    if _flags.get_flag("use_pallas_norm_kernels") and axis in (-1, x.ndim - 1):
         out = fused.softmax(x)
         if out is not None:
             return out
@@ -49,11 +51,13 @@ def _softmax_pallas(x, *, axis=-1, cast_dtype=None):
 
 def _layer_norm_pallas(x, *rest, n_axes=1, epsilon=1e-5):
     from . import fused
-    if n_axes == 1 and len(rest) == 2:
+    from ... import flags as _flags
+    if _flags.get_flag("use_pallas_norm_kernels") and n_axes == 1 \
+            and len(rest) == 2:
         out = fused.layer_norm(x, rest[0], rest[1], eps=epsilon)
         if out is not None:
             return out
-    # unaffine / multi-axis / untileable: the shared jnp fallback
+    # flag off / unaffine / multi-axis / untileable: the shared jnp fallback
     from ...nn.functional.norm import layer_norm_ref
     return layer_norm_ref(x, rest[0] if rest else None,
                           rest[1] if len(rest) > 1 else None, n_axes, epsilon)
@@ -103,14 +107,11 @@ def register_all(force=False):
     register_kernel("flash_attention_causal", impl="pallas")(_fa_causal)
     register_kernel("rms_norm", impl="pallas")(_rms_norm_pallas)
     register_kernel("flash_attention_varlen", impl="pallas")(_fa_varlen)
-    # softmax/layer_norm kernels are opt-in: XLA's own fusion measured
-    # faster inside full models on v5e (bench r3: ViT-L 239→211 img/s with
-    # these engaged); they remain available for kernel-level use and via
-    # FLAGS_use_pallas_norm_kernels
-    from ... import flags as _flags
-    if _flags.get_flag("use_pallas_norm_kernels"):
-        register_kernel("softmax", impl="pallas")(_softmax_pallas)
-        register_kernel("layer_norm", impl="pallas")(_layer_norm_pallas)
+    # softmax/layer_norm kernels are opt-in (FLAGS_use_pallas_norm_kernels,
+    # checked at CALL time inside the impls): XLA's own fusion measured
+    # faster inside full models on v5e (bench r3: ViT-L 239→211 img/s)
+    register_kernel("softmax", impl="pallas")(_softmax_pallas)
+    register_kernel("layer_norm", impl="pallas")(_layer_norm_pallas)
     from .fused import adamw_update
     register_kernel("adamw_fused", impl="pallas")(adamw_update)
     _registered[0] = True

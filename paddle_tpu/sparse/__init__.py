@@ -111,3 +111,160 @@ class _SparseNN:
 
 
 nn = _SparseNN()
+
+
+# ---------------------------------------------------------------------------
+# Round-3 surface expansion (reference python/paddle/sparse/unary.py,
+# binary.py, multiary.py, creation CSR)
+# ---------------------------------------------------------------------------
+class SparseCsrTensor(SparseCooTensor):
+    """CSR view (reference SparseCsrTensor): stored as BCOO (TPU kernels are
+    COO-oriented), CSR accessors derived on demand."""
+
+    def __init__(self, bcoo, stop_gradient=True):
+        super().__init__(bcoo, stop_gradient=stop_gradient)
+
+    def _csr(self):
+        cached = getattr(self, "_csr_cache", None)
+        if cached is not None:
+            return cached
+        idx = np.asarray(self._bcoo.indices)
+        rows, cols = idx[:, 0], idx[:, 1]
+        order = np.lexsort((cols, rows))
+        crows = np.zeros(self.shape[0] + 1, np.int64)
+        np.add.at(crows[1:], rows, 1)
+        self._csr_cache = (np.cumsum(crows), cols[order],
+                           np.asarray(self._bcoo.data)[order])
+        return self._csr_cache
+
+    def crows(self):
+        return Tensor(jnp.asarray(self._csr()[0]))
+
+    def cols(self):
+        return Tensor(jnp.asarray(self._csr()[1]))
+
+    def values(self):
+        return Tensor(jnp.asarray(self._csr()[2]))
+
+    def is_sparse_coo(self):
+        return False
+
+    def is_sparse_csr(self):
+        return True
+
+
+def from_dense(x, sparse_dim=None):
+    """Dense Tensor/array -> SparseCooTensor (reference Tensor.to_sparse_coo)."""
+    v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    return SparseCooTensor(jsparse.BCOO.fromdense(v))
+
+
+def to_sparse_csr(x):
+    """COO/dense -> SparseCsrTensor (2-D only, reference to_sparse_csr)."""
+    if isinstance(x, SparseCooTensor):
+        bcoo = x._bcoo
+    else:
+        v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+        bcoo = jsparse.BCOO.fromdense(v)
+    if len(bcoo.shape) != 2:
+        raise ValueError("to_sparse_csr supports 2-D tensors")
+    return SparseCsrTensor(bcoo)
+
+
+def coalesce(x, name=None):
+    """Merge duplicate indices (reference sparse.coalesce)."""
+    return SparseCooTensor(jsparse.BCOO.sum_duplicates(x._bcoo))
+
+
+def transpose(x, perm, name=None):
+    """Sparse transpose (reference sparse.transpose)."""
+    idx = x._bcoo.indices[:, jnp.asarray(perm)]
+    shape = tuple(x._bcoo.shape[p] for p in perm)
+    return SparseCooTensor(jsparse.BCOO((x._bcoo.data, idx), shape=shape))
+
+
+def mv(x, vec, name=None):
+    """Sparse matrix × dense vector (reference sparse.mv)."""
+    v = vec._value if isinstance(vec, Tensor) else jnp.asarray(vec)
+    return Tensor(x._bcoo @ v)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """beta*input + alpha*(x @ y) with sparse x (reference sparse.addmm)."""
+    base = input._value if isinstance(input, Tensor) else jnp.asarray(input)
+    yv = y._value if isinstance(y, Tensor) else jnp.asarray(y)
+    prod = x._bcoo @ yv if isinstance(x, SparseCooTensor) else \
+        (x._value if isinstance(x, Tensor) else jnp.asarray(x)) @ yv
+    return Tensor(beta * base + alpha * prod)
+
+
+def _value_unary(fn_jax, name):
+    """Value-wise unary op preserving the sparsity pattern (the reference
+    unary.py contract: applied to stored values only — valid for f(0)=0)."""
+    def op(x, *a, **kw):
+        if isinstance(x, SparseCooTensor):
+            return type(x)(jsparse.BCOO(
+                (fn_jax(x._bcoo.data, *a, **kw), x._bcoo.indices),
+                shape=x._bcoo.shape))
+        import paddle_tpu
+        return getattr(paddle_tpu, name)(x, *a, **kw)
+    op.__name__ = name
+    return op
+
+
+sin = _value_unary(jnp.sin, "sin")
+tan = _value_unary(jnp.tan, "tan")
+asin = _value_unary(jnp.arcsin, "asin")
+atan = _value_unary(jnp.arctan, "atan")
+sinh = _value_unary(jnp.sinh, "sinh")
+tanh = _value_unary(jnp.tanh, "tanh")
+asinh = _value_unary(jnp.arcsinh, "asinh")
+atanh = _value_unary(jnp.arctanh, "atanh")
+sqrt = _value_unary(jnp.sqrt, "sqrt")
+square = _value_unary(jnp.square, "square")
+log1p = _value_unary(jnp.log1p, "log1p")
+abs = _value_unary(jnp.abs, "abs")
+expm1 = _value_unary(jnp.expm1, "expm1")
+neg = _value_unary(jnp.negative, "neg")
+
+
+def pow(x, factor, name=None):
+    if isinstance(x, SparseCooTensor):
+        return _value_unary(lambda v: jnp.power(v, factor), "pow")(x)
+    import paddle_tpu
+    return paddle_tpu.pow(x, factor)
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    data = x._bcoo.data
+    idx = x._bcoo.indices
+    if value_dtype is not None:
+        data = data.astype(value_dtype)
+    if index_dtype is not None:
+        idx = idx.astype(index_dtype)
+    return type(x)(jsparse.BCOO((data, idx), shape=x._bcoo.shape))
+
+
+def _sparse_binary(merge, name):
+    def op(x, y, name=None):
+        if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+            # implemented over dense for correctness (XLA fuses; the
+            # reference's CSR kernels are a CUDA specialization)
+            return from_dense(merge(x._bcoo.todense(), y._bcoo.todense()))
+        xd = x.to_dense() if isinstance(x, SparseCooTensor) else x
+        yd = y.to_dense() if isinstance(y, SparseCooTensor) else y
+        xv = xd._value if isinstance(xd, Tensor) else jnp.asarray(xd)
+        yv = yd._value if isinstance(yd, Tensor) else jnp.asarray(yd)
+        return Tensor(merge(xv, yv))
+    op.__name__ = name
+    return op
+
+
+subtract = _sparse_binary(jnp.subtract, "subtract")
+multiply = _sparse_binary(jnp.multiply, "multiply")
+divide = _sparse_binary(jnp.divide, "divide")
+
+__all__ += ["SparseCsrTensor", "from_dense", "to_sparse_csr", "coalesce",
+            "transpose", "mv", "addmm", "sin", "tan", "asin", "atan", "sinh",
+            "tanh", "asinh", "atanh", "sqrt", "square", "log1p", "abs",
+            "expm1", "neg", "pow", "cast", "subtract", "multiply", "divide"]

@@ -1,0 +1,82 @@
+"""Expanded sparse surface (reference python/paddle/sparse/): CSR tensor,
+value-wise unary set, binary ops, mv/addmm, coalesce/transpose."""
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse
+
+
+def _coo():
+    idx = np.asarray([[0, 0, 1, 2], [0, 2, 1, 0]])
+    vals = np.asarray([1.0, 2.0, -3.0, 4.0], np.float32)
+    return sparse.sparse_coo_tensor(idx, vals, shape=(3, 3)), idx, vals
+
+
+def test_csr_accessors_roundtrip():
+    coo, idx, vals = _coo()
+    csr = sparse.to_sparse_csr(coo)
+    assert csr.is_sparse_csr() and not csr.is_sparse_coo()
+    np.testing.assert_array_equal(np.asarray(csr.crows().numpy()), [0, 2, 3, 4])
+    np.testing.assert_array_equal(np.asarray(csr.cols().numpy()), [0, 2, 1, 0])
+    np.testing.assert_allclose(np.asarray(csr.values().numpy()),
+                               [1.0, 2.0, -3.0, 4.0])
+    np.testing.assert_allclose(np.asarray(csr.to_dense().numpy()),
+                               np.asarray(coo.to_dense().numpy()))
+
+
+def test_from_dense_and_unary_value_ops():
+    d = np.zeros((4, 4), np.float32)
+    d[0, 1] = 4.0
+    d[2, 3] = -9.0
+    sp = sparse.from_dense(paddle.to_tensor(d))
+    assert sp.nnz == 2
+    out = sparse.abs(sp)
+    np.testing.assert_allclose(np.asarray(out.to_dense().numpy()), np.abs(d))
+    out2 = sparse.square(sp)
+    np.testing.assert_allclose(np.asarray(out2.to_dense().numpy()), d * d)
+    out3 = sparse.tanh(sp)
+    np.testing.assert_allclose(np.asarray(out3.to_dense().numpy()),
+                               np.tanh(d), rtol=1e-6)
+    # sparsity pattern preserved
+    assert out3.nnz == 2
+
+
+def test_binary_and_matmul_ops():
+    coo, _, _ = _coo()
+    dense = coo.to_dense().numpy()
+    other = sparse.from_dense(np.eye(3, dtype=np.float32))
+    s = sparse.subtract(coo, other)
+    np.testing.assert_allclose(np.asarray(s.to_dense().numpy()),
+                               np.asarray(dense) - np.eye(3))
+    m = sparse.multiply(coo, other)
+    np.testing.assert_allclose(np.asarray(m.to_dense().numpy()),
+                               np.asarray(dense) * np.eye(3))
+    vec = np.asarray([1.0, 2.0, 3.0], np.float32)
+    np.testing.assert_allclose(np.asarray(sparse.mv(coo, vec).numpy()),
+                               np.asarray(dense) @ vec)
+    y = np.random.default_rng(0).normal(0, 1, (3, 2)).astype(np.float32)
+    base = np.ones((3, 2), np.float32)
+    out = sparse.addmm(base, coo, y, beta=0.5, alpha=2.0)
+    np.testing.assert_allclose(np.asarray(out.numpy()),
+                               0.5 * base + 2.0 * np.asarray(dense) @ y,
+                               rtol=1e-5)
+
+
+def test_coalesce_and_transpose():
+    idx = np.asarray([[0, 0], [1, 1]])          # duplicate (0,1)
+    vals = np.asarray([2.0, 5.0], np.float32)
+    sp = sparse.sparse_coo_tensor(idx, vals, shape=(2, 2))
+    co = sparse.coalesce(sp)
+    assert float(co.to_dense().numpy()[0, 1]) == 7.0
+    coo, _, _ = _coo()
+    t = sparse.transpose(coo, [1, 0])
+    np.testing.assert_allclose(np.asarray(t.to_dense().numpy()),
+                               np.asarray(coo.to_dense().numpy()).T)
+
+
+def test_cast_changes_dtypes():
+    coo, _, _ = _coo()
+    out = sparse.cast(coo, index_dtype="int64", value_dtype=jnp.float64)
+    assert str(out.values().numpy().dtype).startswith("float")
