@@ -67,6 +67,7 @@ from . import text  # noqa: F401
 from . import audio  # noqa: F401
 from . import onnx  # noqa: F401
 from . import quantization  # noqa: F401
+from . import version  # noqa: F401
 
 from .nn.layer import Layer  # convenience re-export used widely in reference code
 from .distributed.parallel import DataParallel  # noqa: F401

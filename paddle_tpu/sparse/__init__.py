@@ -154,9 +154,15 @@ class SparseCsrTensor(SparseCooTensor):
 
 
 def from_dense(x, sparse_dim=None):
-    """Dense Tensor/array -> SparseCooTensor (reference Tensor.to_sparse_coo)."""
+    """Dense Tensor/array -> SparseCooTensor (reference Tensor.to_sparse_coo).
+    sparse_dim: leading dims that are sparse; the rest stay dense (hybrid
+    layout — BCOO n_dense)."""
     v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
-    return SparseCooTensor(jsparse.BCOO.fromdense(v))
+    n_dense = 0 if sparse_dim is None else v.ndim - int(sparse_dim)
+    if sparse_dim is not None and not 1 <= int(sparse_dim) <= v.ndim:
+        raise ValueError(f"sparse_dim {sparse_dim} out of range for "
+                         f"{v.ndim}-D tensor")
+    return SparseCooTensor(jsparse.BCOO.fromdense(v, n_dense=n_dense))
 
 
 def to_sparse_csr(x):
@@ -184,9 +190,9 @@ def transpose(x, perm, name=None):
 
 
 def mv(x, vec, name=None):
-    """Sparse matrix × dense vector (reference sparse.mv)."""
-    v = vec._value if isinstance(vec, Tensor) else jnp.asarray(vec)
-    return Tensor(x._bcoo @ v)
+    """Sparse matrix × dense vector (reference sparse.mv) — delegates to
+    matmul's sparse dispatch."""
+    return matmul(x, vec)
 
 
 def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
@@ -262,7 +268,16 @@ def _sparse_binary(merge, name):
 
 subtract = _sparse_binary(jnp.subtract, "subtract")
 multiply = _sparse_binary(jnp.multiply, "multiply")
-divide = _sparse_binary(jnp.divide, "divide")
+
+
+def _safe_divide(xv, yv):
+    # divide only on the support: implicit zeros stay implicit (0/0 must
+    # not become NaN and densify the result)
+    support = (xv != 0) & (yv != 0)
+    return jnp.where(support, xv / jnp.where(support, yv, 1.0), 0.0)
+
+
+divide = _sparse_binary(_safe_divide, "divide")
 
 __all__ += ["SparseCsrTensor", "from_dense", "to_sparse_csr", "coalesce",
             "transpose", "mv", "addmm", "sin", "tan", "asin", "atan", "sinh",

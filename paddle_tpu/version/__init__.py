@@ -1,0 +1,42 @@
+"""paddle.version parity (reference: python/paddle/version/__init__.py —
+generated at build time with commit/cuda/cudnn info; here: jax/libtpu)."""
+from __future__ import annotations
+
+full_version = "0.1.0"
+major, minor, patch = "0", "1", "0"
+rc = "0"
+commit = "unknown"
+
+
+def _backend_versions():
+    import jax
+    import jaxlib
+    return {"jax": jax.__version__, "jaxlib": jaxlib.__version__}
+
+
+def cuda():
+    return False  # TPU build
+
+
+def cudnn():
+    return False
+
+
+def xpu():
+    return False
+
+
+def tpu() -> str:
+    try:
+        import jax
+        kinds = {d.device_kind for d in jax.devices() if d.platform == "tpu"}
+        return ",".join(sorted(kinds)) if kinds else "none"
+    except Exception:
+        return "unknown"
+
+
+def show():
+    print(f"paddle_tpu {full_version}")
+    for k, v in _backend_versions().items():
+        print(f"{k}: {v}")
+    print(f"commit: {commit}")

@@ -78,5 +78,26 @@ def test_coalesce_and_transpose():
 
 def test_cast_changes_dtypes():
     coo, _, _ = _coo()
-    out = sparse.cast(coo, index_dtype="int64", value_dtype=jnp.float64)
-    assert str(out.values().numpy().dtype).startswith("float")
+    out = sparse.cast(coo, value_dtype=jnp.float16)
+    assert str(np.asarray(out._bcoo.data).dtype) == "float16"
+    out2 = sparse.cast(coo, index_dtype=jnp.int16)
+    assert str(np.asarray(out2._bcoo.indices).dtype) == "int16"
+
+
+def test_divide_keeps_implicit_zeros_implicit():
+    coo, _, _ = _coo()
+    q = sparse.divide(coo, coo)
+    d = np.asarray(q.to_dense().numpy())
+    assert np.isfinite(d).all()                     # no 0/0 NaNs
+    # support/support = 1, off-support stays exactly 0
+    ref = (np.asarray(coo.to_dense().numpy()) != 0).astype(np.float32)
+    np.testing.assert_allclose(d, ref)
+    assert q.nnz <= coo.nnz
+
+
+def test_from_dense_hybrid_layout():
+    d = np.zeros((4, 3), np.float32)
+    d[1] = [1.0, 2.0, 3.0]
+    sp = sparse.from_dense(d, sparse_dim=1)         # rows sparse, cols dense
+    assert sp.nnz == 1                              # one nonzero ROW
+    np.testing.assert_allclose(np.asarray(sp.to_dense().numpy()), d)
