@@ -44,35 +44,24 @@ def _chip_peak_flops(device):
     return 197e12  # conservative default (v5e-class)
 
 
-def bench_llama():
+def _llama_train_tps(cfg, B, S, steps, warmup, dtype, assert_fa=True):
+    """Shared timed-train-step scaffold (full-block remat, donated buffers).
+    Returns (tokens_per_sec, n_params, loss)."""
     import jax
     import jax.numpy as jnp
-    from paddle_tpu.models.llama import LlamaConfig, build_functional_llama
+    from paddle_tpu.models.llama import build_functional_llama
     from paddle_tpu.parallel.pipeline import _flatten, _unflatten
     from paddle_tpu import optimizer
     from paddle_tpu.core.dispatch import get_kernel
 
-    on_tpu = any(d.platform == "tpu" for d in jax.devices())
-    if on_tpu:
-        cfg = LlamaConfig(vocab_size=32000, hidden_size=1024, intermediate_size=2816,
-                          num_hidden_layers=16, num_attention_heads=16,
-                          num_key_value_heads=16, max_position_embeddings=2048)
-        B, S, steps, warmup = 8, 2048, 20, 3
+    if assert_fa:
         # the perf contract: Pallas flash attention must be engaged
         k = get_kernel("flash_attention_causal")
         assert k is not None and "pallas" in (k.__module__ or ""), \
             f"Pallas flash attention not engaged: {k}"
-    else:  # CPU smoke
-        cfg = LlamaConfig(vocab_size=512, hidden_size=128, intermediate_size=384,
-                          num_hidden_layers=2, num_attention_heads=4,
-                          num_key_value_heads=4, max_position_embeddings=256)
-        B, S, steps, warmup = 2, 128, 5, 1
 
-    dtype = jnp.bfloat16 if on_tpu else jnp.float32
     ep, bp, hp, ea, ba, hl = build_functional_llama(cfg, dtype=dtype, n_micro=1)
     opt = optimizer.AdamW(learning_rate=1e-4, parameters=[])
-
-    # full-block remat: measured fastest on v5e (see module docstring)
     ba_ckpt = jax.checkpoint(ba)
 
     def loss_fn(ep, bp, hp, batch):
@@ -97,25 +86,43 @@ def bench_llama():
                 neo, nbo, nho, loss)
 
     step = jax.jit(step, donate_argnums=tuple(range(6)))
-
     rng = np.random.default_rng(0)
     ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, S)).astype(np.int32))
     batch = (ids, ids)
-
     for _ in range(warmup):
         ep, bp, hp, eo, bo, ho, loss = step(ep, bp, hp, eo, bo, ho, batch)
     jax.block_until_ready(loss)
-
     t0 = time.perf_counter()
     for _ in range(steps):
         ep, bp, hp, eo, bo, ho, loss = step(ep, bp, hp, eo, bo, ho, batch)
     jax.block_until_ready(loss)
-    dt = time.perf_counter() - t0
-
-    tps = B * S * steps / dt
+    tps = B * S * steps / (time.perf_counter() - t0)
     n_params = sum(int(np.prod(v.shape)) for v in
                    list(_flatten(ep).values()) + list(_flatten(bp).values()) +
                    list(_flatten(hp).values()))
+    return tps, n_params, float(loss)
+
+
+def bench_llama():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.models.llama import LlamaConfig
+
+    on_tpu = any(d.platform == "tpu" for d in jax.devices())
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+                          num_hidden_layers=16, num_attention_heads=16,
+                          num_key_value_heads=16, max_position_embeddings=2048)
+        B, S, steps, warmup = 8, 2048, 20, 3
+    else:  # CPU smoke
+        cfg = LlamaConfig(vocab_size=512, hidden_size=128, intermediate_size=384,
+                          num_hidden_layers=2, num_attention_heads=4,
+                          num_key_value_heads=4, max_position_embeddings=256)
+        B, S, steps, warmup = 2, 128, 5, 1
+
+    dtype = jnp.bfloat16 if on_tpu else jnp.float32
+    tps, n_params, loss = _llama_train_tps(cfg, B, S, steps, warmup, dtype,
+                                           assert_fa=on_tpu)
     # model FLOPs/token: 6N + causal attn 6·L·S·H (PaLM MFU convention)
     flops_tok = 6.0 * n_params + 6.0 * cfg.num_hidden_layers * S * cfg.hidden_size
     peak = _chip_peak_flops(jax.devices()[0]) if on_tpu else None
@@ -128,8 +135,23 @@ def bench_llama():
         "model_flops_per_token": round(flops_tok / 1e9, 3),
         "chip_peak_tflops_bf16": peak / 1e12 if on_tpu else None,
         "device_kind": jax.devices()[0].device_kind,
-        "loss": round(float(loss), 4),
+        "loss": round(loss, 4),
     }
+
+
+def bench_llama_long_context():
+    """Long-context extra: the same 271M architecture at S=8192 (first-class
+    long-sequence support; the asserted Pallas flash attention keeps the
+    8k x 8k score matrix out of HBM)."""
+    import jax.numpy as jnp
+    from paddle_tpu.models.llama import LlamaConfig
+
+    cfg = LlamaConfig(vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+                      num_hidden_layers=16, num_attention_heads=16,
+                      num_key_value_heads=16, max_position_embeddings=8192)
+    tps, _, _ = _llama_train_tps(cfg, 2, 8192, 6, 1, jnp.bfloat16,
+                                 assert_fa=True)
+    return round(tps, 1)
 
 
 def bench_vit_l16():
@@ -235,7 +257,8 @@ def main():
     extras = {}
     on_tpu = any(d.platform == "tpu" for d in jax.devices())
     secondary = (("vit_l16_images_per_sec", bench_vit_l16),
-                 ("resnet50_images_per_sec", bench_resnet50)) \
+                 ("resnet50_images_per_sec", bench_resnet50),
+                 ("llama_271M_seq8192_tokens_per_sec", bench_llama_long_context)) \
         if on_tpu else ()
     import signal
 
@@ -243,7 +266,7 @@ def main():
         raise TimeoutError("secondary bench exceeded its time slice")
 
     for name, fn in secondary:
-        if time.perf_counter() - t_start > 360:
+        if time.perf_counter() - t_start > 480:
             extras[name] = "skipped: bench time budget"
             continue
         try:
