@@ -112,7 +112,67 @@ _SPECS = [
            custom_vjp=(lambda x: (x * 1.0, None),
                        lambda res, g: (2.0 * g,)),
            test=OpTest(shapes=((4, 8),), grad=False)),
+    # --- round-3 widening: comparison / logical (nondiff) -----------------
+    _b(jnp.equal, np.equal, "t_equal", grad=False),
+    _b(jnp.not_equal, np.not_equal, "t_not_equal", grad=False),
+    _b(jnp.greater, np.greater, "t_greater", grad=False),
+    _b(jnp.greater_equal, np.greater_equal, "t_greater_equal", grad=False),
+    _b(jnp.less, np.less, "t_less", grad=False),
+    _b(jnp.less_equal, np.less_equal, "t_less_equal", grad=False),
+    _b(jnp.logical_and, np.logical_and, "t_logical_and", grad=False),
+    _b(jnp.logical_or, np.logical_or, "t_logical_or", grad=False),
+    _b(jnp.logical_xor, np.logical_xor, "t_logical_xor", grad=False),
+    _u(jnp.logical_not, np.logical_not, "t_logical_not", grad=False),
+    # --- more elementwise --------------------------------------------------
+    _u(jnp.rint, np.rint, "t_rint", grad=False),
+    _u(jnp.trunc, np.trunc, "t_trunc", grad=False),
+    _u(jnp.cbrt, np.cbrt, "t_cbrt", low=0.2, high=4.0),
+    _u(jnp.exp2, np.exp2, "t_exp2"),
+    _u(jax.scipy.special.erfc, None, "t_erfc"),
+    _u(jnp.deg2rad, np.deg2rad, "t_deg2rad"),
+    _u(jnp.rad2deg, np.rad2deg, "t_rad2deg"),
+    _b(jnp.hypot, np.hypot, "t_hypot", low=0.5, high=3.0),
+    _b(jnp.logaddexp, np.logaddexp, "t_logaddexp"),
+    _b(jnp.copysign, np.copysign, "t_copysign", grad=False),
+    _b(jnp.nextafter, np.nextafter, "t_nextafter", grad=False),
+    _b(jnp.fmod, np.fmod, "t_fmod", grad=False, low=0.5, high=3.0),
+    # --- reductions with kwargs --------------------------------------------
+    OpSpec(name="t_amax", impl=lambda x: jnp.max(x, axis=-1),
+           np_ref=lambda x: np.max(x, axis=-1),
+           test=OpTest(shapes=((4, 8),), grad=False)),
+    OpSpec(name="t_amin", impl=lambda x: jnp.min(x, axis=-1),
+           np_ref=lambda x: np.min(x, axis=-1),
+           test=OpTest(shapes=((4, 8),), grad=False)),
+    OpSpec(name="t_prod", impl=jnp.prod, np_ref=np.prod, amp="deny",
+           test=OpTest(shapes=((2, 4),), grad=True, low=0.5, high=1.5)),
+    OpSpec(name="t_var", impl=jnp.var, np_ref=np.var, amp="deny",
+           test=OpTest(shapes=((4, 8),), grad=True)),
+    OpSpec(name="t_std", impl=jnp.std, np_ref=np.std, amp="deny",
+           test=OpTest(shapes=((4, 8),), grad=True)),
+    OpSpec(name="t_cumsum", impl=lambda x: jnp.cumsum(x, axis=-1),
+           np_ref=lambda x: np.cumsum(x, axis=-1), amp="deny",
+           test=OpTest(shapes=((4, 8),), grad=True)),
+    OpSpec(name="t_cumprod", impl=lambda x: jnp.cumprod(x, axis=-1),
+           np_ref=lambda x: np.cumprod(x, axis=-1),
+           test=OpTest(shapes=((4, 8),), grad=True, low=0.5, high=1.5)),
+    # --- shape / index -----------------------------------------------------
+    OpSpec(name="t_transpose2d", impl=lambda x: jnp.swapaxes(x, -1, -2),
+           np_ref=lambda x: np.swapaxes(x, -1, -2),
+           test=OpTest(shapes=((4, 8),), grad=True)),
+    OpSpec(name="t_flip", impl=lambda x: jnp.flip(x, axis=-1),
+           np_ref=lambda x: np.flip(x, axis=-1),
+           test=OpTest(shapes=((4, 8),), grad=True)),
+    OpSpec(name="t_argmax", impl=lambda x: jnp.argmax(x, axis=-1),
+           np_ref=lambda x: np.argmax(x, axis=-1), nondiff=True,
+           test=OpTest(shapes=((4, 8),), grad=False)),
+    OpSpec(name="t_argmin", impl=lambda x: jnp.argmin(x, axis=-1),
+           np_ref=lambda x: np.argmin(x, axis=-1), nondiff=True,
+           test=OpTest(shapes=((4, 8),), grad=False)),
+    OpSpec(name="t_sort", impl=lambda x: jnp.sort(x, axis=-1),
+           np_ref=lambda x: np.sort(x, axis=-1),
+           test=OpTest(shapes=((4, 8),), grad=False)),
 ]
+
 
 TABLE_OPS = {spec.name: register_op(spec) for spec in _SPECS}
 
