@@ -115,7 +115,9 @@ class AutoTuner:
             step = ShardedTrainStep(mesh, loss_fn, (ep, bp, hp), opt,
                                     stage=max(cfg["zero_stage"], 0), axis="dp")
 
-        for _ in range(warmup):
+        # always run >=1 untimed step so compile cost never lands in the
+        # timed loop (and `loss` is defined even when warmup=0)
+        for _ in range(max(warmup, 1)):
             loss = step(batch)
         jax.block_until_ready(loss._value if hasattr(loss, "_value") else loss)
         t0 = time.perf_counter()

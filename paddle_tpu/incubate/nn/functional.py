@@ -125,22 +125,23 @@ def masked_multihead_attention(x, cache_kv=None, src_mask=None, bias=None,
             "implemented")
     if bias is not None:
         x = x + bias
+    if sequence_lengths is None:
+        # The reference CUDA kernel derives the write position from cache
+        # metadata; our cache is a bare array, so without sequence_lengths
+        # every call would silently write (and attend to) position 0 only.
+        raise ValueError(
+            "masked_multihead_attention requires sequence_lengths (int32 "
+            "[B, 1], the number of cached tokens per sequence) — without it "
+            "repeated decode calls would overwrite cache position 0. Track "
+            "the position explicitly like models/llama.py "
+            "build_llama_decode's cache['pos'].")
 
-    def impl(xv, cache, *rest):
-        seq_lens = None
-        mask = None
-        ri = 0
-        if sequence_lengths is not None:
-            seq_lens = rest[ri]; ri += 1
-        if src_mask is not None:
-            mask = rest[ri]; ri += 1
+    def impl(xv, cache, seq_lens, *rest):
+        mask = rest[0] if src_mask is not None else None
         two, B, H, S_max, D = cache.shape
         qkv = xv.reshape(B, 3, H, D)
         q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]       # [B, H, D]
-        if seq_lens is None:
-            pos = jnp.zeros((B,), jnp.int32)
-        else:
-            pos = seq_lens.reshape(B).astype(jnp.int32)
+        pos = seq_lens.reshape(B).astype(jnp.int32)
         # write k/v at each sequence's position
         bidx = jnp.arange(B)
         cache = cache.at[0, bidx, :, pos, :].set(k)
@@ -156,9 +157,7 @@ def masked_multihead_attention(x, cache_kv=None, src_mask=None, bias=None,
         o = jnp.einsum("bhs,bhsd->bhd", p.astype(vc.dtype), vc)
         return o.reshape(B, H * D), cache
 
-    args = [x, cache_kv]
-    if sequence_lengths is not None:
-        args.append(sequence_lengths)
+    args = [x, cache_kv, sequence_lengths]
     if src_mask is not None:
         args.append(src_mask)
     return op_call("masked_multihead_attention", impl, *args)

@@ -135,25 +135,44 @@ def schedule_stats(rows):
 
 def _depths(rows, n_micro):
     """Ring-buffer depths: max lifetime span (in distinct mbs) of saved
-    activations (F..W) and cotangents (B-arrival..W)."""
+    activations and cotangents.
+
+    Lifetimes MUST start at the *arrival* tick, not this stage's own
+    execution tick: stage s ingests mb m's activation at f_done[s-1][m]+1
+    (cotangent at b_done[s+1][m]+1), and the scan's ingest phase runs
+    *before* the slot executes — so an arrival at tick t conflicts with a
+    same-tick W reading another mb in the same slot.  Lifetimes end at this
+    stage's W tick inclusive (W re-reads both the activation and the
+    cotangent).  Deriving the window from local F/B ticks (the pre-round-4
+    bug) silently corrupted last-stage weight grads whenever
+    n_micro > n_stages."""
     S = len(rows)
-    act_d, cot_d = 1, 1
+    T = len(rows[0])
+    f_t = [{} for _ in range(S)]
+    b_t = [{} for _ in range(S)]
+    w_t = [{} for _ in range(S)]
     for s in range(S):
-        f_t = {}
-        w_t = {}
-        b_t = {}
         for t, (k, m) in enumerate(rows[s]):
             if k == F:
-                f_t[m] = t
+                f_t[s][m] = t
             elif k == B:
-                b_t[m] = t
+                b_t[s][m] = t
             elif k == W:
-                w_t[m] = t
-        for t in range(len(rows[s])):
+                w_t[s][m] = t
+    act_d, cot_d = 1, 1
+    for s in range(S):
+        for t in range(T):
+            # activation arrival: upstream F + 1 (stage 0 never ingests —
+            # its act_buf slot is only ever the zeros it was initialised to)
             live_a = [m for m in range(n_micro)
-                      if f_t.get(m, 10**9) <= t and w_t.get(m, 10**9) >= t]
+                      if (f_t[s - 1].get(m, 10**9) + 1 if s > 0
+                          else f_t[s].get(m, 10**9)) <= t
+                      and w_t[s].get(m, -1) >= t]
+            # cotangent arrival: downstream B + 1 (last stage never ingests)
             live_c = [m for m in range(n_micro)
-                      if b_t.get(m, 10**9) - 1 <= t and w_t.get(m, 10**9) >= t]
+                      if (b_t[s + 1].get(m, 10**9) + 1 if s < S - 1
+                          else b_t[s].get(m, 10**9)) <= t
+                      and w_t[s].get(m, -1) >= t]
             if live_a:
                 act_d = max(act_d, max(live_a) - min(live_a) + 1)
             if live_c:

@@ -110,6 +110,16 @@ def test_masked_multihead_attention_matches_naive():
                                        rtol=2e-4, atol=2e-5)
 
 
+def test_masked_multihead_attention_requires_sequence_lengths():
+    """Advisor r3: sequence_lengths=None silently wrote every token at cache
+    position 0; now it must raise instead."""
+    from paddle_tpu.incubate.nn.functional import masked_multihead_attention
+    x = paddle.to_tensor(np.zeros((1, 3 * 2 * 4), np.float32))
+    cache = paddle.to_tensor(np.zeros((2, 1, 2, 8, 4), np.float32))
+    with pytest.raises(ValueError, match="sequence_lengths"):
+        masked_multihead_attention(x, cache)
+
+
 def test_predictor_over_stablehlo_artifact(tmp_path):
     from paddle_tpu import nn
     from paddle_tpu.static import InputSpec

@@ -91,6 +91,45 @@ def test_zero_bubble_matches_1f1b_training():
 
 
 @requires_8
+@pytest.mark.parametrize("pp,n_micro", [(2, 4), (2, 6), (4, 8)])
+def test_zero_bubble_grads_match_1f1b_n_micro_gt_pp(pp, n_micro):
+    """Regression (advisor r3, zero_bubble.py _depths): with n_micro >
+    n_stages the ring buffers sized from *local* F/B ticks let an arriving
+    microbatch overwrite a slot a same-tick W still reads, silently
+    corrupting last-stage weight grads.  Loss matches either way (it comes
+    from F slots), so compare the *parameters* after an lr=0.1 step."""
+    cfg = llama_config_tiny(vocab=64, hidden=32, layers=pp * 2, heads=4,
+                            seq=16)
+    devs = jax.devices()[:pp]
+    mesh = build_mesh({"pp": pp}, devices=devs)
+
+    def make_step(schedule):
+        ep, bp, hp, _, _, _ = build_functional_llama(
+            cfg, key=jax.random.PRNGKey(7), n_micro=n_micro)
+        ea, ba, hl = llama_microbatch_fns(cfg)
+        opt = optimizer.SGD(learning_rate=0.1, parameters=[])
+        return Pipeline1F1BTrainStep(mesh, ea, ba, hl, ep, bp, hp, opt,
+                                     n_micro=n_micro, schedule=schedule)
+
+    rng = np.random.default_rng(2)
+    ids = jnp.asarray(rng.integers(0, 64, (n_micro, 16)).astype(np.int32))
+    labels = jnp.asarray(rng.integers(0, 64, (n_micro, 16)).astype(np.int32))
+
+    step_zb = make_step("zero_bubble")
+    step_1f = make_step("1f1b")
+    step_zb((ids, labels))
+    step_1f((ids, labels))
+    for name in ("embed_params", "block_params", "head_params"):
+        t_zb = jax.tree_util.tree_map(np.asarray, getattr(step_zb, name))
+        t_1f = jax.tree_util.tree_map(np.asarray, getattr(step_1f, name))
+        flat_zb, _ = jax.tree_util.tree_flatten(t_zb)
+        flat_1f, _ = jax.tree_util.tree_flatten(t_1f)
+        for a, b in zip(flat_zb, flat_1f):
+            np.testing.assert_allclose(a, b, rtol=3e-5, atol=3e-6,
+                                       err_msg=name)
+
+
+@requires_8
 def test_zero_bubble_with_dp():
     cfg = llama_config_tiny(vocab=64, hidden=32, layers=2, heads=4, seq=16)
     n_micro = 2
