@@ -65,6 +65,8 @@ from . import static  # noqa: F401
 from . import sparse  # noqa: F401
 from . import text  # noqa: F401
 from . import audio  # noqa: F401
+from . import fft  # noqa: F401
+from . import signal  # noqa: F401
 from . import onnx  # noqa: F401
 from . import quantization  # noqa: F401
 from . import version  # noqa: F401

@@ -1,4 +1,5 @@
 """paddle.audio parity (reference: python/paddle/audio/ — spectral features)."""
 from . import functional
+from . import features
 
-__all__ = ["functional"]
+__all__ = ["functional", "features"]

@@ -1,0 +1,109 @@
+"""Audio feature layers (reference: python/paddle/audio/features/layers.py —
+Spectrogram / MelSpectrogram / LogMelSpectrogram / MFCC), built on
+`paddle_tpu.signal.stft` + the functional helpers; the heavy compute is the
+framed rFFT on the TPU FFT op and two small matmuls."""
+from __future__ import annotations
+
+from functools import partial
+
+from ..nn.layer import Layer
+from ..core.tensor import Tensor
+from .. import signal as _signal
+from .functional import (get_window, compute_fbank_matrix, create_dct,
+                         power_to_db)
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+class Spectrogram(Layer):
+    """|stft|^power of waveforms [N, T] -> [N, n_fft//2+1, num_frames]."""
+
+    def __init__(self, n_fft=512, hop_length=512, win_length=None,
+                 window="hann", power=1.0, center=True, pad_mode="reflect",
+                 dtype="float32"):
+        super().__init__()
+        if power <= 0:
+            raise ValueError("Power of spectrogram must be > 0.")
+        self.power = power
+        if win_length is None:
+            win_length = n_fft
+        self.fft_window = get_window(window, win_length, fftbins=True,
+                                     dtype=dtype)
+        self._stft = partial(_signal.stft, n_fft=n_fft,
+                             hop_length=hop_length, win_length=win_length,
+                             window=self.fft_window, center=center,
+                             pad_mode=pad_mode)
+        self.register_buffer("fft_window", self.fft_window)
+
+    def forward(self, x):
+        spec = self._stft(x)
+        return (spec.real() ** 2 + spec.imag() ** 2) ** (self.power / 2)
+
+
+class MelSpectrogram(Layer):
+    """fbank_matrix @ Spectrogram: [N, T] -> [N, n_mels, num_frames]."""
+
+    def __init__(self, sr=22050, n_fft=2048, hop_length=512, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 dtype="float32"):
+        super().__init__()
+        self._spectrogram = Spectrogram(n_fft=n_fft, hop_length=hop_length,
+                                        win_length=win_length, window=window,
+                                        power=power, center=center,
+                                        pad_mode=pad_mode, dtype=dtype)
+        self.fbank_matrix = compute_fbank_matrix(
+            sr=sr, n_fft=n_fft, n_mels=n_mels, f_min=f_min, f_max=f_max,
+            htk=htk, norm=norm, dtype=dtype)
+        self.register_buffer("fbank_matrix", self.fbank_matrix)
+
+    def forward(self, x):
+        return self.fbank_matrix @ self._spectrogram(x)
+
+
+class LogMelSpectrogram(Layer):
+    """power_to_db(MelSpectrogram): [N, T] -> [N, n_mels, num_frames]."""
+
+    def __init__(self, sr=22050, n_fft=512, hop_length=512, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 ref_value=1.0, amin=1e-10, top_db=None, dtype="float32"):
+        super().__init__()
+        self._melspectrogram = MelSpectrogram(
+            sr=sr, n_fft=n_fft, hop_length=hop_length, win_length=win_length,
+            window=window, power=power, center=center, pad_mode=pad_mode,
+            n_mels=n_mels, f_min=f_min, f_max=f_max, htk=htk, norm=norm,
+            dtype=dtype)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        return power_to_db(self._melspectrogram(x), ref_value=self.ref_value,
+                           amin=self.amin, top_db=self.top_db)
+
+
+class MFCC(Layer):
+    """DCT-II of the log-mel spectrogram: [N, T] -> [N, n_mfcc, num_frames]."""
+
+    def __init__(self, sr=22050, n_mfcc=40, norm="ortho", n_fft=512,
+                 hop_length=512, win_length=None, window="hann", power=2.0,
+                 center=True, pad_mode="reflect", n_mels=64, f_min=50.0,
+                 f_max=None, htk=False, ref_value=1.0, amin=1e-10,
+                 top_db=None, dtype="float32"):
+        super().__init__()
+        if n_mfcc > n_mels:
+            raise ValueError("n_mfcc cannot be larger than n_mels")
+        self._log_melspectrogram = LogMelSpectrogram(
+            sr=sr, n_fft=n_fft, hop_length=hop_length, win_length=win_length,
+            window=window, power=power, center=center, pad_mode=pad_mode,
+            n_mels=n_mels, f_min=f_min, f_max=f_max, htk=htk,
+            ref_value=ref_value, amin=amin, top_db=top_db, dtype=dtype)
+        self.dct_matrix = create_dct(n_mfcc=n_mfcc, n_mels=n_mels, norm=norm,
+                                     dtype=dtype)
+        self.register_buffer("dct_matrix", self.dct_matrix)
+
+    def forward(self, x):
+        logmel = self._log_melspectrogram(x)          # [N, n_mels, L]
+        return (logmel.transpose((0, 2, 1)) @ self.dct_matrix
+                ).transpose((0, 2, 1))                # [N, n_mfcc, L]

@@ -35,6 +35,11 @@ def monkey_patch_method(name):
     return deco
 
 
+@jax.jit
+def _split_complex(a):
+    return jnp.real(a), jnp.imag(a)
+
+
 class Tensor:
     __slots__ = ("_value", "stop_gradient", "_grad", "_grad_node", "_out_index",
                  "name", "persistable", "_backward_hooks", "trainable",
@@ -94,7 +99,20 @@ class Tensor:
         return self._grad_node is None
 
     def numpy(self):
-        return np.asarray(jax.device_get(self._value))
+        v = self._value
+        # some TPU transports (axon tunnel) cannot fetch complex arrays, and
+        # a failed attempt poisons the stream — split complex into two real
+        # transfers up front (as a compiled program; eager complex ops are
+        # equally unreliable there) and recombine on host
+        if (isinstance(v, jax.Array) and not isinstance(v, jax.core.Tracer)
+                and jnp.issubdtype(v.dtype, jnp.complexfloating)
+                and any(d.platform not in ("cpu", "gpu")
+                        for d in v.devices())):
+            re, im = _split_complex(v)
+            return (np.asarray(jax.device_get(re))
+                    + 1j * np.asarray(jax.device_get(im))
+                    ).astype(np.dtype(v.dtype))
+        return np.asarray(jax.device_get(v))
 
     def item(self, *args):
         if args:

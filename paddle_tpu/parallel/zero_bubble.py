@@ -162,21 +162,23 @@ def _depths(rows, n_micro):
     act_d, cot_d = 1, 1
     for s in range(S):
         for t in range(T):
-            # activation arrival: upstream F + 1 (stage 0 never ingests —
-            # its act_buf slot is only ever the zeros it was initialised to)
-            live_a = [m for m in range(n_micro)
-                      if (f_t[s - 1].get(m, 10**9) + 1 if s > 0
-                          else f_t[s].get(m, 10**9)) <= t
-                      and w_t[s].get(m, -1) >= t]
-            # cotangent arrival: downstream B + 1 (last stage never ingests)
-            live_c = [m for m in range(n_micro)
-                      if (b_t[s + 1].get(m, 10**9) + 1 if s < S - 1
-                          else b_t[s].get(m, 10**9)) <= t
-                      and w_t[s].get(m, -1) >= t]
-            if live_a:
-                act_d = max(act_d, max(live_a) - min(live_a) + 1)
-            if live_c:
-                cot_d = max(cot_d, max(live_c) - min(live_c) + 1)
+            # activation arrival: upstream F + 1.  Slot conflicts only come
+            # from ingest writes, so stage 0 (which never ingests — take_f
+            # requires r > 0; its act_buf stays the zeros it was initialised
+            # to) and the last stage's cotangents (take_b requires r < n-1;
+            # g_in is masked by is_last) don't constrain the buffers.
+            if s > 0:
+                live_a = [m for m in range(n_micro)
+                          if f_t[s - 1].get(m, 10**9) + 1 <= t
+                          and w_t[s].get(m, -1) >= t]
+                if live_a:
+                    act_d = max(act_d, max(live_a) - min(live_a) + 1)
+            if s < S - 1:
+                live_c = [m for m in range(n_micro)
+                          if b_t[s + 1].get(m, 10**9) + 1 <= t
+                          and w_t[s].get(m, -1) >= t]
+                if live_c:
+                    cot_d = max(cot_d, max(live_c) - min(live_c) + 1)
     return min(act_d, n_micro), min(cot_d, n_micro)
 
 
