@@ -335,7 +335,8 @@ def llama_microbatch_fns(config: LlamaConfig, mp_axis: str = None, dtype=None,
 
 def build_functional_llama(config: LlamaConfig, key=None, dtype=None,
                            n_micro: int = 1, mp_axis: str = None,
-                           ep_axis: str = None, init_params: bool = True):
+                           ep_axis: str = None, init_params: bool = True,
+                           head_chunks: int = 0):
     """Returns (embed_params, block_params_stacked, head_params,
     embed_apply, block_apply, head_loss_apply).
 
@@ -517,6 +518,16 @@ def build_functional_llama(config: LlamaConfig, key=None, dtype=None,
         mbs = B // n_micro
         lab = labels.reshape(n_micro, mbs, -1)
         h = rms(y, p["ln_f"])
+        if head_chunks:
+            # vocab-chunked online-logsumexp head: the [*, V] logits tensor
+            # never materializes (round-4 perf work; see
+            # incubate.nn.functional.fused_linear_cross_entropy_impl)
+            from ..incubate.nn.functional import \
+                fused_linear_cross_entropy_impl
+            nllv = fused_linear_cross_entropy_impl(
+                h.reshape(-1, c.hidden_size), p["lm"], lab.reshape(-1),
+                n_chunks=head_chunks)
+            return jnp.mean(nllv)
         logits = h @ p["lm"]
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
         nll = -jnp.take_along_axis(logp, lab[..., None].astype(jnp.int32), -1)

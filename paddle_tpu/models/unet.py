@@ -187,7 +187,9 @@ class UNet2DConditionModel(Layer):
 
     def forward(self, latents, timesteps, context):
         c = self.config
-        temb = timestep_embedding(timesteps, self.t_dim0)
+        # sinusoidal table is f32; follow the latents' compute dtype so the
+        # time-projection adds don't promote the conv stream back to f32
+        temb = timestep_embedding(timesteps, self.t_dim0).astype(latents.dtype)
         temb = self.time_fc2(F.silu(self.time_fc1(temb)))
 
         x = self.conv_in(latents)

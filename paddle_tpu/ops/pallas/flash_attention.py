@@ -31,8 +31,15 @@ NEG_INF = -1e30
 
 
 def _block_sizes(s_q, s_k, d):
-    bq = min(512, s_q) if s_q % 512 == 0 else (128 if s_q % 128 == 0 else s_q)
-    bk = min(512, s_k) if s_k % 512 == 0 else (128 if s_k % 128 == 0 else s_k)
+    """v5e-measured defaults (round-4 sweep on the 271M llama train step):
+    k-blocks of 1024 beat 512 at every config (+6% at S=2048); q-blocks of
+    512 win at S<=4k, 1024 at S>=8k (+5% at S=8192).  128-multiple
+    fallbacks keep odd shapes tileable."""
+    bq_pref = 1024 if s_q >= 8192 else 512
+    bq = next((b for b in (bq_pref, 512, 256, 128) if s_q % b == 0 and b <= s_q),
+              s_q)
+    bk = next((b for b in (1024, 512, 256, 128) if s_k % b == 0 and b <= s_k),
+              s_k)
     return bq, bk
 
 
