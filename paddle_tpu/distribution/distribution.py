@@ -1,0 +1,154 @@
+"""Distribution base classes (reference:
+python/paddle/distribution/distribution.py and exponential_family.py).
+
+TPU-native design: parameters are stored as jnp arrays (broadcast once at
+construction), every public method goes through `op_call` so results join
+the eager autograd tape, and sampling draws from the framework PRNG
+(`core.random.split_key`) so `paddle.seed` governs reproducibility.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..core.dispatch import op_call
+from ..core.random import split_key
+
+__all__ = ["Distribution", "ExponentialFamily"]
+
+
+def _as_jnp(v, dtype=None):
+    if isinstance(v, Tensor):
+        a = v._value
+    elif isinstance(v, (int, float)):
+        a = jnp.asarray(v, jnp.float32)
+    else:
+        a = jnp.asarray(v)
+    if dtype is not None:
+        a = a.astype(dtype)
+    if jnp.issubdtype(a.dtype, jnp.integer) or a.dtype == jnp.bool_:
+        a = a.astype(jnp.float32)
+    return a
+
+
+def _sample_shape(shape):
+    if shape is None:
+        return ()
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s) for s in shape)
+
+
+class Distribution:
+    """Base class (reference distribution.py:36 Distribution): exposes
+    batch_shape/event_shape, sample/rsample, log_prob/prob, entropy, kl."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(int(s) for s in batch_shape)
+        self._event_shape = tuple(int(s) for s in event_shape)
+
+    def _wrap_params(self, **kw):
+        """Remember the ORIGINAL Tensor arguments so log_prob/rsample/kl
+        op_calls join the caller's autograd tape (raw-array attrs keep the
+        broadcast values for shape/moment math)."""
+        self._orig_params = {k: v for k, v in kw.items()
+                             if isinstance(v, Tensor)}
+
+    def _pt(self, name):
+        orig = getattr(self, "_orig_params", {})
+        if name in orig:
+            return orig[name]
+        return Tensor(getattr(self, name))
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    @property
+    def stddev(self):
+        return op_call("dist_stddev", jnp.sqrt, Tensor(self.variance._value)
+                       if isinstance(self.variance, Tensor) else self.variance)
+
+    def sample(self, shape=()):
+        """Non-differentiable draw (stop_gradient=True)."""
+        out = self._sample(_sample_shape(shape), split_key())
+        t = Tensor(out)
+        t.stop_gradient = True
+        return t
+
+    def rsample(self, shape=()):
+        """Reparameterized draw; gradients flow to the parameters."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not implement rsample")
+
+    def _sample(self, shape, key):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        lp = self.log_prob(value)
+        return op_call("dist_prob", jnp.exp, lp)
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        from .kl import kl_divergence
+        return kl_divergence(self, other)
+
+    def _extend_shape(self, sample_shape):
+        return tuple(sample_shape) + self._batch_shape + self._event_shape
+
+    def __repr__(self):
+        return (f"{type(self).__name__}(batch_shape={self._batch_shape}, "
+                f"event_shape={self._event_shape})")
+
+
+class ExponentialFamily(Distribution):
+    """Exponential-family base (reference exponential_family.py:24): provides
+    the Bregman-divergence entropy via `_log_normalizer` autodiff — the same
+    trick the reference implements with paddle.grad, here with jax.grad."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        raise NotImplementedError
+
+    def entropy(self):
+        """-H = E[log p] via Bregman identity: entropy = A(θ) - <θ, ∇A(θ)>
+        + E[carrier] (reference exponential_family.py:48)."""
+        nat = [np_.astype(jnp.float32) for np_ in self._natural_parameters]
+
+        def impl(*nat_arrs):
+            lognorm = self._log_normalizer(*nat_arrs)
+            grads = jax.grad(
+                lambda *ps: jnp.sum(self._log_normalizer(*ps)),
+                argnums=tuple(range(len(nat_arrs))))(*nat_arrs)
+            ent = lognorm - self._mean_carrier_measure
+            for p, g in zip(nat_arrs, grads):
+                ent = ent - p * g
+            return ent
+        return op_call("dist_expfam_entropy", impl,
+                       *[Tensor(n) for n in nat])

@@ -1,0 +1,223 @@
+"""kl_divergence + register_kl double-dispatch registry (reference:
+python/paddle/distribution/kl.py:52,84 — most-specific-superclass-pair
+resolution, plus the Bregman-divergence fallback for exponential families).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.scipy import special as jsp
+
+from ..core.tensor import Tensor
+from ..core.dispatch import op_call
+from .distribution import Distribution, ExponentialFamily
+from .distributions import (Normal, Uniform, Bernoulli, Categorical, Beta,
+                            Dirichlet, Gamma, Laplace, LogNormal,
+                            Exponential, Geometric, Poisson, Cauchy,
+                            MultivariateNormal)
+
+__all__ = ["kl_divergence", "register_kl"]
+
+_REGISTRY = {}
+
+
+def register_kl(cls_p, cls_q):
+    """Decorator registering a KL(p||q) rule for a class pair; dispatch
+    picks the most specific registered (super)class pair."""
+    if not (issubclass(cls_p, Distribution)
+            and issubclass(cls_q, Distribution)):
+        raise TypeError("cls_p and cls_q must be subclass of Distribution")
+
+    def deco(fn):
+        _REGISTRY[(cls_p, cls_q)] = fn
+        return fn
+    return deco
+
+
+def _dispatch(cls_p, cls_q):
+    matches = [(p, q) for (p, q) in _REGISTRY
+               if issubclass(cls_p, p) and issubclass(cls_q, q)]
+    if not matches:
+        raise NotImplementedError(
+            f"Can't compute kl_divergence({cls_p.__name__}, "
+            f"{cls_q.__name__}); register it with register_kl.")
+
+    def depth(pair):
+        p, q = pair
+        return cls_p.__mro__.index(p) + cls_q.__mro__.index(q)
+    return _REGISTRY[min(matches, key=depth)]
+
+
+def kl_divergence(p, q):
+    return _dispatch(type(p), type(q))(p, q)
+
+
+# --- closed forms (reference kl.py:181-300) --------------------------------
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    def impl(lp, sp, lq, sq):
+        var_ratio = (sp / sq) ** 2
+        t1 = ((lp - lq) / sq) ** 2
+        return 0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio))
+    return op_call("kl_normal_normal", impl, Tensor(p.loc), Tensor(p.scale),
+                   Tensor(q.loc), Tensor(q.scale))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform_uniform(p, q):
+    def impl(al, ah, bl, bh):
+        out = jnp.log((bh - bl) / (ah - al))
+        return jnp.where((bl > al) | (bh < ah), jnp.inf, out)
+    return op_call("kl_uniform_uniform", impl, Tensor(p.low), Tensor(p.high),
+                   Tensor(q.low), Tensor(q.high))
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli_bernoulli(p, q):
+    def impl(pp, pq):
+        return (jsp.xlogy(pp, pp / pq)
+                + jsp.xlogy(1 - pp, (1 - pp) / (1 - pq)))
+    return op_call("kl_bernoulli_bernoulli", impl, Tensor(p.probs),
+                   Tensor(q.probs))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical_categorical(p, q):
+    def impl(pp, pq):
+        return jnp.sum(jsp.xlogy(pp, pp / pq), -1)
+    return op_call("kl_categorical_categorical", impl, Tensor(p._p),
+                   Tensor(q._p))
+
+
+@register_kl(Beta, Beta)
+def _kl_beta_beta(p, q):
+    def impl(a1, b1, a2, b2):
+        s1 = a1 + b1
+        return (jsp.betaln(a2, b2) - jsp.betaln(a1, b1)
+                + (a1 - a2) * jsp.digamma(a1)
+                + (b1 - b2) * jsp.digamma(b1)
+                + (a2 - a1 + b2 - b1) * jsp.digamma(s1))
+    return op_call("kl_beta_beta", impl, Tensor(p.alpha), Tensor(p.beta),
+                   Tensor(q.alpha), Tensor(q.beta))
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet_dirichlet(p, q):
+    def impl(c1, c2):
+        s1 = jnp.sum(c1, -1)
+        return (jsp.gammaln(s1) - jnp.sum(jsp.gammaln(c1), -1)
+                - jsp.gammaln(jnp.sum(c2, -1))
+                + jnp.sum(jsp.gammaln(c2), -1)
+                + jnp.sum((c1 - c2) * (jsp.digamma(c1)
+                                       - jsp.digamma(s1[..., None])), -1))
+    return op_call("kl_dirichlet_dirichlet", impl, Tensor(p.concentration),
+                   Tensor(q.concentration))
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma_gamma(p, q):
+    def impl(c1, r1, c2, r2):
+        return ((c1 - c2) * jsp.digamma(c1) - jsp.gammaln(c1)
+                + jsp.gammaln(c2) + c2 * (jnp.log(r1) - jnp.log(r2))
+                + c1 * (r2 / r1 - 1))
+    return op_call("kl_gamma_gamma", impl, Tensor(p.concentration),
+                   Tensor(p.rate), Tensor(q.concentration), Tensor(q.rate))
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace_laplace(p, q):
+    def impl(lp, sp, lq, sq):
+        d = jnp.abs(lp - lq)
+        return (jnp.log(sq / sp) + d / sq
+                + sp / sq * jnp.exp(-d / sp) - 1)
+    return op_call("kl_laplace_laplace", impl, Tensor(p.loc),
+                   Tensor(p.scale), Tensor(q.loc), Tensor(q.scale))
+
+
+@register_kl(LogNormal, LogNormal)
+def _kl_lognormal_lognormal(p, q):
+    # KL is invariant under the shared exp bijection -> underlying normals
+    def impl(lp, sp, lq, sq):
+        var_ratio = (sp / sq) ** 2
+        t1 = ((lp - lq) / sq) ** 2
+        return 0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio))
+    return op_call("kl_lognormal_lognormal", impl, Tensor(p.loc),
+                   Tensor(p.scale), Tensor(q.loc), Tensor(q.scale))
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential_exponential(p, q):
+    def impl(r1, r2):
+        return jnp.log(r1) - jnp.log(r2) + r2 / r1 - 1
+    return op_call("kl_exponential_exponential", impl, Tensor(p.rate),
+                   Tensor(q.rate))
+
+
+@register_kl(Geometric, Geometric)
+def _kl_geometric_geometric(p, q):
+    # E_p[log p_k - log q_k] with E_p[k] = (1-p)/p
+    def impl(pp, pq):
+        ek = 1 / pp - 1
+        return (jsp.xlog1py(ek, -pp) + jnp.log(pp)
+                - jsp.xlog1py(ek, -pq) - jnp.log(pq))
+    return op_call("kl_geometric_geometric", impl, Tensor(p.probs),
+                   Tensor(q.probs))
+
+
+@register_kl(Poisson, Poisson)
+def _kl_poisson_poisson(p, q):
+    def impl(r1, r2):
+        return r1 * (jnp.log(r1) - jnp.log(r2)) - r1 + r2
+    return op_call("kl_poisson_poisson", impl, Tensor(p.rate),
+                   Tensor(q.rate))
+
+
+@register_kl(Cauchy, Cauchy)
+def _kl_cauchy_cauchy(p, q):
+    def impl(lp, sp, lq, sq):
+        return (jnp.log(((sp + sq) ** 2 + (lp - lq) ** 2)
+                        / (4 * sp * sq)))
+    return op_call("kl_cauchy_cauchy", impl, Tensor(p.loc), Tensor(p.scale),
+                   Tensor(q.loc), Tensor(q.scale))
+
+
+@register_kl(MultivariateNormal, MultivariateNormal)
+def _kl_mvn_mvn(p, q):
+    def impl(lp, tp, lq, tq):
+        d = lp.shape[-1]
+        logdet_p = jnp.sum(jnp.log(jnp.diagonal(tp, axis1=-2, axis2=-1)), -1)
+        logdet_q = jnp.sum(jnp.log(jnp.diagonal(tq, axis1=-2, axis2=-1)), -1)
+        m = jax.scipy.linalg.solve_triangular(tq, tp, lower=True)
+        tr = jnp.sum(m ** 2, (-2, -1))
+        diff = jax.scipy.linalg.solve_triangular(
+            tq, (lq - lp)[..., None], lower=True)[..., 0]
+        md = jnp.sum(diff ** 2, -1)
+        return logdet_q - logdet_p + 0.5 * (tr + md - d)
+    return op_call("kl_mvn_mvn", impl, Tensor(p.loc), Tensor(p._tril),
+                   Tensor(q.loc), Tensor(q._tril))
+
+
+@register_kl(ExponentialFamily, ExponentialFamily)
+def _kl_expfamily_expfamily(p, q):
+    """Bregman-divergence fallback (reference kl.py:243): valid when p and q
+    are the same exponential family."""
+    if type(p) is not type(q):
+        raise NotImplementedError(
+            "Bregman KL fallback needs matching exponential families; "
+            f"got {type(p).__name__} vs {type(q).__name__}")
+    p_nat = [n.astype(jnp.float32) for n in p._natural_parameters]
+    q_nat = [n.astype(jnp.float32) for n in q._natural_parameters]
+
+    def impl(*nats):
+        k = len(nats) // 2
+        pn, qn = nats[:k], nats[k:]
+        lp = p._log_normalizer(*pn)
+        lq = q._log_normalizer(*qn)
+        grads = jax.grad(lambda *ps: jnp.sum(p._log_normalizer(*ps)),
+                         argnums=tuple(range(k)))(*pn)
+        out = lq - lp
+        for pi, qi, g in zip(pn, qn, grads):
+            out = out - (qi - pi) * g
+        return out
+    return op_call("kl_expfam_expfam", impl,
+                   *[Tensor(n) for n in p_nat + q_nat])

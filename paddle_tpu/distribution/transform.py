@@ -1,0 +1,386 @@
+"""Random-variable transforms (reference:
+python/paddle/distribution/transform.py — Transform base with
+forward/inverse/log-det-jacobian and the concrete Abs/Affine/Chain/Exp/
+Independent/Power/Reshape/Sigmoid/Softmax/Stack/StickBreaking/Tanh set).
+
+Each transform's math runs through op_call so forward/inverse/ldj join the
+eager autograd tape; under jit the same impls stage into XLA.
+"""
+from __future__ import annotations
+
+import enum
+import math
+import operator
+from functools import reduce
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dispatch import op_call
+
+__all__ = [
+    "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+    "ExpTransform", "IndependentTransform", "PowerTransform",
+    "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+    "StackTransform", "StickBreakingTransform", "TanhTransform",
+]
+
+
+class Type(enum.Enum):
+    BIJECTION = "bijection"
+    INJECTION = "injection"
+    SURJECTION = "surjection"
+    OTHER = "other"
+
+    @classmethod
+    def is_injective(cls, t):
+        return t in (cls.BIJECTION, cls.INJECTION)
+
+
+class Transform:
+    _type = Type.INJECTION
+    # rank of the event block this transform consumes/produces (0 =
+    # elementwise); used by TransformedDistribution's log-det accounting
+    _event_rank = 0
+
+    @classmethod
+    def _is_injective(cls):
+        return Type.is_injective(cls._type)
+
+    def __call__(self, x):
+        from .transformed_distribution import TransformedDistribution
+        from .distribution import Distribution
+        if isinstance(x, Distribution):
+            return TransformedDistribution(x, [self])
+        if isinstance(x, Transform):
+            return ChainTransform([x, self])
+        return self.forward(x)
+
+    def forward(self, x):
+        return op_call(f"transform_{type(self).__name__}_fwd",
+                       self._forward, x)
+
+    def inverse(self, y):
+        return op_call(f"transform_{type(self).__name__}_inv",
+                       self._inverse, y)
+
+    def forward_log_det_jacobian(self, x):
+        if hasattr(self, "_forward_log_det_jacobian"):
+            return op_call(f"transform_{type(self).__name__}_fldj",
+                           self._forward_log_det_jacobian, x)
+        if hasattr(self, "_inverse_log_det_jacobian"):
+            def impl(v):
+                return -self._inverse_log_det_jacobian(self._forward(v))
+            return op_call(f"transform_{type(self).__name__}_fldj", impl, x)
+        raise NotImplementedError
+
+    def inverse_log_det_jacobian(self, y):
+        if hasattr(self, "_inverse_log_det_jacobian"):
+            return op_call(f"transform_{type(self).__name__}_ildj",
+                           self._inverse_log_det_jacobian, y)
+        if hasattr(self, "_forward_log_det_jacobian"):
+            def impl(v):
+                return -self._forward_log_det_jacobian(self._inverse(v))
+            return op_call(f"transform_{type(self).__name__}_ildj", impl, y)
+        raise NotImplementedError
+
+    def forward_shape(self, shape):
+        return tuple(shape)
+
+    def inverse_shape(self, shape):
+        return tuple(shape)
+
+
+class AbsTransform(Transform):
+    """y = |x| (surjective; reference transform.py:372)."""
+    _type = Type.SURJECTION
+
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return -y, y
+
+
+class AffineTransform(Transform):
+    """y = loc + scale * x (reference transform.py:445)."""
+    _type = Type.BIJECTION
+
+    def __init__(self, loc, scale):
+        self.loc = loc._value if isinstance(loc, Tensor) else jnp.asarray(loc)
+        self.scale = scale._value if isinstance(scale, Tensor) \
+            else jnp.asarray(scale)
+
+    def _forward(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.broadcast_to(
+            jnp.log(jnp.abs(self.scale)), jnp.broadcast_shapes(
+                x.shape, self.scale.shape)).astype(x.dtype)
+
+
+class ExpTransform(Transform):
+    """y = exp(x) (reference transform.py:657)."""
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    """y = x ** power on the positive half-line (reference
+    transform.py:802)."""
+    _type = Type.BIJECTION
+
+    def __init__(self, power):
+        self.power = power._value if isinstance(power, Tensor) \
+            else jnp.asarray(power)
+
+    def _forward(self, x):
+        return jnp.power(x, self.power)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self.power)
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.log(jnp.abs(self.power * jnp.power(x, self.power - 1)))
+
+
+class SigmoidTransform(Transform):
+    """y = sigmoid(x) (reference transform.py:995)."""
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _forward_log_det_jacobian(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    """y = tanh(x) (reference transform.py:1281)."""
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _forward_log_det_jacobian(self, x):
+        # 2 (log2 - x - softplus(-2x)), the numerically-stable form the
+        # reference uses
+        return 2.0 * (math.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class SoftmaxTransform(Transform):
+    """y = softmax(x) over the last axis (reference transform.py:1038;
+    not injective — no log-det)."""
+    _type = Type.OTHER
+    _event_rank = 1
+
+    def _forward(self, x):
+        return jax.nn.softmax(x, -1)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def forward_shape(self, shape):
+        if len(shape) < 1:
+            raise ValueError("SoftmaxTransform needs rank >= 1")
+        return tuple(shape)
+
+    inverse_shape = forward_shape
+
+
+class StickBreakingTransform(Transform):
+    """R^{K-1} -> simplex^K stick-breaking (reference transform.py:1215)."""
+    _type = Type.BIJECTION
+    _event_rank = 1
+
+    def _forward(self, x):
+        offset = x.shape[-1] - jnp.arange(x.shape[-1], dtype=x.dtype)
+        z = jax.nn.sigmoid(x - jnp.log(offset))
+        zc = jnp.cumprod(1 - z, -1)
+        lead = jnp.concatenate(
+            [jnp.ones_like(zc[..., :1]), zc[..., :-1]], -1)
+        y1 = z * lead
+        return jnp.concatenate([y1, zc[..., -1:]], -1)
+
+    def _inverse(self, y):
+        # x_i = logit(z_i) + log(K - i) with z_i = y_i / stick_before_i,
+        # i.e. log y_i - log(stick remaining AFTER i) + log offset
+        y_crop = y[..., :-1]
+        offset = y_crop.shape[-1] - jnp.arange(
+            y_crop.shape[-1], dtype=y.dtype)
+        sf_after = 1 - jnp.cumsum(y_crop, -1)
+        return jnp.log(y_crop) - jnp.log(sf_after) + jnp.log(offset)
+
+    def _forward_log_det_jacobian(self, x):
+        offset = x.shape[-1] - jnp.arange(x.shape[-1], dtype=x.dtype)
+        z = jax.nn.sigmoid(x - jnp.log(offset))
+        zc = jnp.cumprod(1 - z, -1)
+        lead = jnp.concatenate(
+            [jnp.ones_like(zc[..., :1]), zc[..., :-1]], -1)
+        xo = x - jnp.log(offset)
+        return jnp.sum(jnp.log(z) - jax.nn.softplus(xo) + jnp.log(lead), -1)
+
+    def forward_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] + 1,)
+
+    def inverse_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] - 1,)
+
+
+class ChainTransform(Transform):
+    """Composition t_n ∘ ... ∘ t_1 (reference transform.py:532)."""
+
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+        self._type = Type.BIJECTION if all(
+            t._type == Type.BIJECTION for t in self.transforms) \
+            else Type.INJECTION
+        self._event_rank = max(
+            (t._event_rank for t in self.transforms), default=0)
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _forward_log_det_jacobian(self, x):
+        acc = 0.0
+        for t in self.transforms:
+            if hasattr(t, "_forward_log_det_jacobian"):
+                acc = acc + t._forward_log_det_jacobian(x)
+            else:
+                acc = acc - t._inverse_log_det_jacobian(t._forward(x))
+            x = t._forward(x)
+        return acc
+
+    def forward_shape(self, shape):
+        return reduce(lambda s, t: t.forward_shape(s), self.transforms,
+                      tuple(shape))
+
+    def inverse_shape(self, shape):
+        return reduce(lambda s, t: t.inverse_shape(s),
+                      reversed(self.transforms), tuple(shape))
+
+
+class IndependentTransform(Transform):
+    """Reinterprets the rightmost `reinterpreted_batch_rank` dims as event
+    dims: the log-det sums over them (reference transform.py:707)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.reinterpreted_batch_rank = int(reinterpreted_batch_rank)
+        self._type = base._type
+        self._event_rank = base._event_rank + self.reinterpreted_batch_rank
+
+    def _forward(self, x):
+        return self.base._forward(x)
+
+    def _inverse(self, y):
+        return self.base._inverse(y)
+
+    def _forward_log_det_jacobian(self, x):
+        ldj = self.base._forward_log_det_jacobian(x) \
+            if hasattr(self.base, "_forward_log_det_jacobian") \
+            else -self.base._inverse_log_det_jacobian(self.base._forward(x))
+        axes = tuple(range(-self.reinterpreted_batch_rank, 0))
+        return jnp.sum(ldj, axes)
+
+    def forward_shape(self, shape):
+        return self.base.forward_shape(shape)
+
+    def inverse_shape(self, shape):
+        return self.base.inverse_shape(shape)
+
+
+class ReshapeTransform(Transform):
+    """Reshape the event shape (reference transform.py:869)."""
+    _type = Type.BIJECTION
+
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+        self._event_rank = len(self.out_event_shape)
+        if reduce(operator.mul, self.in_event_shape, 1) != \
+                reduce(operator.mul, self.out_event_shape, 1):
+            raise ValueError("in/out event sizes must match")
+
+    def _forward(self, x):
+        lead = x.shape[: x.ndim - len(self.in_event_shape)]
+        return x.reshape(lead + self.out_event_shape)
+
+    def _inverse(self, y):
+        lead = y.shape[: y.ndim - len(self.out_event_shape)]
+        return y.reshape(lead + self.in_event_shape)
+
+    def _forward_log_det_jacobian(self, x):
+        lead = x.shape[: x.ndim - len(self.in_event_shape)]
+        return jnp.zeros(lead, x.dtype)
+
+    def forward_shape(self, shape):
+        n = len(self.in_event_shape)
+        if tuple(shape[len(shape) - n:]) != self.in_event_shape:
+            raise ValueError("shape mismatch")
+        return tuple(shape[: len(shape) - n]) + self.out_event_shape
+
+    def inverse_shape(self, shape):
+        n = len(self.out_event_shape)
+        if tuple(shape[len(shape) - n:]) != self.out_event_shape:
+            raise ValueError("shape mismatch")
+        return tuple(shape[: len(shape) - n]) + self.in_event_shape
+
+
+class StackTransform(Transform):
+    """Apply a sequence of transforms to slices along `axis` (reference
+    transform.py:1095)."""
+
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self.axis = int(axis)
+        self._type = Type.BIJECTION if all(
+            t._type == Type.BIJECTION for t in self.transforms) \
+            else Type.INJECTION
+
+    def _split(self, v):
+        return [jnp.squeeze(s, self.axis) for s in
+                jnp.split(v, len(self.transforms), self.axis)]
+
+    def _forward(self, x):
+        return jnp.stack([t._forward(s) for t, s in
+                          zip(self.transforms, self._split(x))], self.axis)
+
+    def _inverse(self, y):
+        return jnp.stack([t._inverse(s) for t, s in
+                          zip(self.transforms, self._split(y))], self.axis)
+
+    def _forward_log_det_jacobian(self, x):
+        outs = []
+        for t, s in zip(self.transforms, self._split(x)):
+            if hasattr(t, "_forward_log_det_jacobian"):
+                outs.append(t._forward_log_det_jacobian(s))
+            else:
+                outs.append(-t._inverse_log_det_jacobian(t._forward(s)))
+        return jnp.stack(outs, self.axis)

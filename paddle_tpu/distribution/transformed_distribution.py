@@ -1,0 +1,122 @@
+"""TransformedDistribution + Independent (reference:
+python/paddle/distribution/transformed_distribution.py, independent.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dispatch import op_call
+from .distribution import Distribution
+from .transform import ChainTransform, Type
+
+__all__ = ["TransformedDistribution", "Independent"]
+
+
+class TransformedDistribution(Distribution):
+    """base distribution pushed through a chain of transforms:
+    log p(y) = log p_base(x) - sum fldj(x) with x = inv(y)."""
+
+    def __init__(self, base, transforms):
+        if not isinstance(transforms, (list, tuple)):
+            raise TypeError("transforms must be a list of Transform")
+        self.base = base
+        self.transforms = list(transforms)
+        chain = ChainTransform(self.transforms)
+        if not Type.is_injective(chain._type):
+            raise ValueError(
+                "TransformedDistribution requires injective transforms")
+        self._chain = chain
+        shape = base.batch_shape + base.event_shape
+        out_shape = chain.forward_shape(shape)
+        # event rank: max of what the base owns and what the transform
+        # consumes (elementwise transforms have _event_rank 0)
+        ev = max(len(base.event_shape), chain._event_rank)
+        self._event_rank_td = ev
+        super().__init__(out_shape[: len(out_shape) - ev],
+                         out_shape[len(out_shape) - ev:])
+
+    def _sample(self, shape, key):
+        x = self.base._sample(shape, key)
+        return self._chain._forward(x)
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        out = op_call("dist_transformed_sample", self._chain._forward, x)
+        out.stop_gradient = True
+        return out
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape)
+        return op_call("dist_transformed_rsample", self._chain._forward, x)
+
+    def log_prob(self, value):
+        """log p(y) = log p_base(inv(y)) - fldj(inv(y)), with event-rank
+        bookkeeping: base.log_prob already reduces the base's own event
+        dims; any dims the transform treats as event beyond that are summed
+        out of lp, and a base event rank beyond the transform's elementwise
+        ldj is summed out of the ldj."""
+        e_chain = self._chain._event_rank
+        e_base = len(self.base.event_shape)
+        e_td = self._event_rank_td
+
+        def impl(v):
+            x = self._chain._inverse(v)
+            lp = self.base.log_prob(Tensor(x))
+            lp = lp._value if isinstance(lp, Tensor) else lp
+            ldj = self._chain._forward_log_det_jacobian(x)
+            extra_lp = e_chain - e_base
+            if extra_lp > 0:
+                lp = jnp.sum(lp, tuple(range(-extra_lp, 0)))
+            extra_ldj = e_td - e_chain
+            if hasattr(ldj, "ndim") and extra_ldj > 0 and ldj.ndim:
+                ldj = jnp.sum(ldj, tuple(range(-extra_ldj, 0)))
+            return lp - ldj
+        return op_call("dist_transformed_log_prob", impl, value)
+
+
+class Independent(Distribution):
+    """Reinterprets the rightmost `reinterpreted_batch_rank` batch dims of a
+    base distribution as event dims (reference independent.py:25)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        r = int(reinterpreted_batch_rank)
+        if not 0 < r <= len(base.batch_shape):
+            raise ValueError(
+                "reinterpreted_batch_rank must be in (0, "
+                f"{len(base.batch_shape)}], got {r}")
+        self.base = base
+        self.reinterpreted_batch_rank = r
+        nb = len(base.batch_shape) - r
+        super().__init__(base.batch_shape[:nb],
+                         base.batch_shape[nb:] + base.event_shape)
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+    def _sample(self, shape, key):
+        return self.base._sample(shape, key)
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+
+        def impl(v):
+            return jnp.sum(v, tuple(range(-self.reinterpreted_batch_rank, 0)))
+        return op_call("dist_independent_log_prob", impl, lp)
+
+    def entropy(self):
+        ent = self.base.entropy()
+
+        def impl(v):
+            return jnp.sum(v, tuple(range(-self.reinterpreted_batch_rank, 0)))
+        return op_call("dist_independent_entropy", impl, ent)

@@ -1,0 +1,376 @@
+"""paddle.distribution parity tests (VERDICT r3 missing item #1; reference
+python/paddle/distribution/). log_prob/entropy/kl checked against
+scipy.stats closed forms; sampling checked by moments; rsample by gradient
+flow; transforms by round-trip + log-det; kl by analytic/MC agreement."""
+import numpy as np
+import pytest
+import scipy.stats as st
+import jax
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+
+rng = np.random.default_rng(11)
+
+
+def _t(a, dtype=np.float32):
+    return paddle.to_tensor(np.asarray(a, dtype))
+
+
+# ---------------------------------------------------------------------------
+# log_prob / entropy vs scipy
+# ---------------------------------------------------------------------------
+CASES = [
+    ("Normal", lambda: D.Normal(1.5, 2.0), st.norm(1.5, 2.0), (3,), "c"),
+    ("Uniform", lambda: D.Uniform(-1.0, 3.0), st.uniform(-1.0, 4.0), (3,), "c"),
+    ("Laplace", lambda: D.Laplace(0.5, 1.5), st.laplace(0.5, 1.5), (3,), "c"),
+    ("LogNormal", lambda: D.LogNormal(0.2, 0.7), st.lognorm(0.7, scale=np.exp(0.2)), (3,), "p"),
+    ("Exponential", lambda: D.Exponential(1.7), st.expon(scale=1 / 1.7), (3,), "p"),
+    ("Gamma", lambda: D.Gamma(2.5, 1.3), st.gamma(2.5, scale=1 / 1.3), (3,), "p"),
+    ("Beta", lambda: D.Beta(2.0, 3.5), st.beta(2.0, 3.5), (3,), "u"),
+    ("Gumbel", lambda: D.Gumbel(0.3, 1.2), st.gumbel_r(0.3, 1.2), (3,), "c"),
+    ("Cauchy", lambda: D.Cauchy(0.1, 0.8), st.cauchy(0.1, 0.8), (3,), "c"),
+    ("Chi2", lambda: D.Chi2(5.0), st.chi2(5.0), (3,), "p"),
+    ("StudentT", lambda: D.StudentT(4.0, 0.5, 1.5), st.t(4.0, 0.5, 1.5), (3,), "c"),
+]
+
+
+@pytest.mark.parametrize("name,mk,ref,shape,support", CASES,
+                         ids=[c[0] for c in CASES])
+def test_log_prob_and_entropy_vs_scipy(name, mk, ref, shape, support):
+    d = mk()
+    if support == "c":
+        x = rng.normal(0.5, 1.0, shape).astype(np.float32)
+    elif support == "p":
+        x = rng.gamma(2.0, 1.0, shape).astype(np.float32) + 0.1
+    else:
+        x = rng.uniform(0.05, 0.95, shape).astype(np.float32)
+    lp = d.log_prob(_t(x)).numpy()
+    np.testing.assert_allclose(lp, ref.logpdf(x), rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(d.entropy().numpy()),
+                               ref.entropy(), rtol=2e-4, atol=2e-5)
+
+
+def test_discrete_log_prob_vs_scipy():
+    b = D.Bernoulli(0.3)
+    np.testing.assert_allclose(b.log_prob(_t([0., 1.])).numpy(),
+                               st.bernoulli(0.3).logpmf([0, 1]), rtol=1e-5)
+    np.testing.assert_allclose(float(b.entropy().numpy()),
+                               st.bernoulli(0.3).entropy(), rtol=1e-5)
+    g = D.Geometric(0.25)
+    # paddle support k = 0, 1, ... (failures before success)
+    np.testing.assert_allclose(g.log_pmf(_t([0., 2., 5.])).numpy(),
+                               st.geom(0.25, loc=-1).logpmf([0, 2, 5]),
+                               rtol=1e-5)
+    po = D.Poisson(3.5)
+    np.testing.assert_allclose(po.log_prob(_t([0., 2., 7.])).numpy(),
+                               st.poisson(3.5).logpmf([0, 2, 7]), rtol=1e-5)
+    np.testing.assert_allclose(float(po.entropy().numpy()),
+                               st.poisson(3.5).entropy(), rtol=1e-4)
+    bi = D.Binomial(10, 0.35)
+    np.testing.assert_allclose(bi.log_prob(_t([0., 4., 10.])).numpy(),
+                               st.binom(10, 0.35).logpmf([0, 4, 10]),
+                               rtol=1e-4)
+    np.testing.assert_allclose(float(bi.entropy().numpy()),
+                               st.binom(10, 0.35).entropy(), rtol=1e-4)
+
+
+def test_categorical_reference_semantics():
+    """Reference categorical.py:149 — logits are unnormalized PROBS."""
+    logits = np.array([2.0, 1.0, 1.0], np.float32)
+    c = D.Categorical(_t(logits))
+    np.testing.assert_allclose(c.probs(_t([0, 1])).numpy(), [0.5, 0.25],
+                               rtol=1e-5)
+    np.testing.assert_allclose(c.log_prob(_t([2])).numpy(), np.log([0.25]),
+                               rtol=1e-5)
+    np.testing.assert_allclose(
+        float(c.entropy().numpy()),
+        st.entropy([0.5, 0.25, 0.25]), rtol=1e-5)
+    s = c.sample((1000,))
+    assert tuple(s.shape) == (1000,)
+    freq = np.bincount(s.numpy().astype(int), minlength=3) / 1000
+    np.testing.assert_allclose(freq, [0.5, 0.25, 0.25], atol=0.06)
+
+
+def test_dirichlet_multinomial_mvn():
+    conc = np.array([2.0, 3.0, 4.0], np.float32)
+    d = D.Dirichlet(_t(conc))
+    x = np.array([0.2, 0.3, 0.5], np.float32)
+    np.testing.assert_allclose(d.log_prob(_t(x)).numpy(),
+                               st.dirichlet(conc).logpdf(x), rtol=1e-4)
+    np.testing.assert_allclose(float(d.entropy().numpy()),
+                               st.dirichlet(conc).entropy(), rtol=1e-4)
+    np.testing.assert_allclose(d.mean.numpy(), conc / conc.sum(), rtol=1e-5)
+
+    m = D.Multinomial(6, _t([0.2, 0.3, 0.5]))
+    v = np.array([1.0, 2.0, 3.0], np.float32)
+    np.testing.assert_allclose(
+        m.log_prob(_t(v)).numpy(),
+        st.multinomial(6, [0.2, 0.3, 0.5]).logpmf(v), rtol=1e-4)
+    s = m.sample((50,))
+    assert tuple(s.shape) == (50, 3)
+    np.testing.assert_allclose(np.asarray(s.numpy()).sum(-1), 6.0)
+
+    mu = np.array([1.0, -1.0], np.float32)
+    cov = np.array([[2.0, 0.5], [0.5, 1.0]], np.float32)
+    mvn = D.MultivariateNormal(_t(mu), covariance_matrix=_t(cov))
+    xv = np.array([0.3, 0.7], np.float32)
+    np.testing.assert_allclose(mvn.log_prob(_t(xv)).numpy(),
+                               st.multivariate_normal(mu, cov).logpdf(xv),
+                               rtol=1e-4)
+    np.testing.assert_allclose(float(mvn.entropy().numpy()),
+                               st.multivariate_normal(mu, cov).entropy(),
+                               rtol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# sampling moments + rsample gradients
+# ---------------------------------------------------------------------------
+def test_sampling_moments():
+    paddle.seed(7)
+    n = 20000
+    for d, mean, std in [
+        (D.Normal(2.0, 3.0), 2.0, 3.0),
+        (D.Uniform(0.0, 4.0), 2.0, 4.0 / np.sqrt(12)),
+        (D.Gamma(3.0, 2.0), 1.5, np.sqrt(3.0) / 2.0),
+        (D.Laplace(1.0, 2.0), 1.0, np.sqrt(8.0)),
+        (D.Exponential(2.0), 0.5, 0.5),
+        (D.Beta(2.0, 2.0), 0.5, np.sqrt(1 / 20)),
+        (D.Gumbel(0.0, 1.0), 0.5772, np.pi / np.sqrt(6)),
+        (D.Poisson(4.0), 4.0, 2.0),
+        (D.Binomial(10, 0.4), 4.0, np.sqrt(2.4)),
+        (D.Geometric(0.5), 1.0, np.sqrt(2.0)),
+    ]:
+        s = np.asarray(d.sample((n,)).numpy())
+        assert s.shape[0] == n
+        np.testing.assert_allclose(s.mean(0), mean, atol=5 * std / np.sqrt(n) + 1e-3)
+        np.testing.assert_allclose(s.std(0), std, rtol=0.08)
+
+
+def test_rsample_gradients_flow():
+    paddle.seed(3)
+    loc = paddle.to_tensor(np.float32(0.5), stop_gradient=False)
+    scale = paddle.to_tensor(np.float32(1.2), stop_gradient=False)
+    d = D.Normal(loc, scale)
+    s = d.rsample((256,))
+    assert not s.stop_gradient
+    (s ** 2).mean().backward()
+    assert loc.grad is not None and np.isfinite(loc.grad.numpy())
+    assert scale.grad is not None and abs(float(scale.grad.numpy())) > 0.1
+
+    conc = paddle.to_tensor(np.float32(2.0), stop_gradient=False)
+    g = D.Gamma(conc, 1.0)
+    gs = g.rsample((256,))
+    gs.mean().backward()
+    # d E[gamma(a)]/da = 1 -> MC estimate near 1
+    assert abs(float(conc.grad.numpy()) - 1.0) < 0.3
+
+
+def test_mean_variance_match_scipy():
+    d = D.Beta(2.0, 5.0)
+    np.testing.assert_allclose(float(d.mean.numpy()), st.beta(2, 5).mean(),
+                               rtol=1e-5)
+    np.testing.assert_allclose(float(d.variance.numpy()),
+                               st.beta(2, 5).var(), rtol=1e-5)
+    ln = D.LogNormal(0.3, 0.6)
+    np.testing.assert_allclose(float(ln.mean.numpy()),
+                               st.lognorm(0.6, scale=np.exp(0.3)).mean(),
+                               rtol=1e-5)
+    np.testing.assert_allclose(float(ln.variance.numpy()),
+                               st.lognorm(0.6, scale=np.exp(0.3)).var(),
+                               rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# KL divergence
+# ---------------------------------------------------------------------------
+def _mc_kl(p, q, n=400000):
+    paddle.seed(12)
+    x = p.sample((n,))
+    return float((p.log_prob(x) - q.log_prob(x)).mean().numpy())
+
+
+@pytest.mark.parametrize("mkp,mkq", [
+    (lambda: D.Normal(0.0, 1.0), lambda: D.Normal(1.0, 2.0)),
+    (lambda: D.Gamma(2.0, 1.0), lambda: D.Gamma(3.0, 2.0)),
+    (lambda: D.Beta(2.0, 3.0), lambda: D.Beta(4.0, 2.0)),
+    (lambda: D.Laplace(0.0, 1.0), lambda: D.Laplace(1.0, 2.0)),
+    (lambda: D.Exponential(2.0), lambda: D.Exponential(0.5)),
+    (lambda: D.LogNormal(0.0, 1.0), lambda: D.LogNormal(0.5, 0.8)),
+    (lambda: D.Poisson(3.0), lambda: D.Poisson(5.0)),
+    (lambda: D.Geometric(0.4), lambda: D.Geometric(0.6)),
+    (lambda: D.Cauchy(0.0, 1.0), lambda: D.Cauchy(1.0, 2.0)),
+], ids=["normal", "gamma", "beta", "laplace", "exponential", "lognormal",
+        "poisson", "geometric", "cauchy"])
+def test_kl_closed_form_vs_monte_carlo(mkp, mkq):
+    p, q = mkp(), mkq()
+    kl = float(D.kl_divergence(p, q).numpy())
+    assert kl >= -1e-6
+    mc = _mc_kl(p, q)
+    np.testing.assert_allclose(kl, mc, rtol=0.05, atol=0.01)
+
+
+def test_kl_exact_analytic_cases():
+    # N(0,1) || N(1,1) = 0.5
+    np.testing.assert_allclose(
+        float(D.kl_divergence(D.Normal(0.0, 1.0), D.Normal(1.0, 1.0)).numpy()),
+        0.5, rtol=1e-5)
+    # same distribution -> 0
+    for p in [D.Gamma(2.0, 2.0), D.Beta(2.0, 2.0),
+              D.Dirichlet(_t([1.0, 2.0, 3.0])), D.Bernoulli(0.3),
+              D.Categorical(_t([1.0, 2.0, 3.0]))]:
+        np.testing.assert_allclose(
+            np.asarray(D.kl_divergence(p, p).numpy()), 0.0, atol=1e-5)
+    # categorical closed form
+    c1 = D.Categorical(_t([1.0, 1.0]))
+    c2 = D.Categorical(_t([1.0, 3.0]))
+    expect = 0.5 * np.log(0.5 / 0.25) + 0.5 * np.log(0.5 / 0.75)
+    np.testing.assert_allclose(float(D.kl_divergence(c1, c2).numpy()),
+                               expect, rtol=1e-5)
+    # uniform disjointness -> inf
+    assert np.isinf(float(D.kl_divergence(
+        D.Uniform(0.0, 2.0), D.Uniform(0.5, 1.5)).numpy()))
+
+
+def test_kl_registry_dispatch_and_expfamily_fallback():
+    class MyNormal(D.Normal):
+        pass
+    # subclass resolves to the (Normal, Normal) rule
+    np.testing.assert_allclose(
+        float(D.kl_divergence(MyNormal(0.0, 1.0), D.Normal(1.0, 1.0)).numpy()),
+        0.5, rtol=1e-5)
+
+    # Bregman fallback: Bernoulli pair via ExponentialFamily rule directly
+    from paddle_tpu.distribution.kl import _kl_expfamily_expfamily
+    p, q = D.Bernoulli(0.3), D.Bernoulli(0.6)
+    np.testing.assert_allclose(
+        float(_kl_expfamily_expfamily(p, q).numpy()),
+        float(D.kl_divergence(p, q).numpy()), rtol=1e-4)
+
+    with pytest.raises(NotImplementedError):
+        D.kl_divergence(D.Cauchy(0.0, 1.0), D.Gumbel(0.0, 1.0))
+
+    @D.register_kl(D.Cauchy, D.Gumbel)
+    def _custom(p, q):
+        return paddle.to_tensor(np.float32(42.0))
+    try:
+        assert float(D.kl_divergence(
+            D.Cauchy(0.0, 1.0), D.Gumbel(0.0, 1.0)).numpy()) == 42.0
+    finally:
+        from paddle_tpu.distribution.kl import _REGISTRY
+        _REGISTRY.pop((D.Cauchy, D.Gumbel))
+
+
+def test_expfamily_entropy_matches_closed_form():
+    """ExponentialFamily.entropy (Bregman autodiff) vs the closed forms."""
+    from paddle_tpu.distribution.distribution import ExponentialFamily
+    b = D.Bernoulli(0.3)
+    np.testing.assert_allclose(
+        float(ExponentialFamily.entropy(b).numpy()),
+        float(b.entropy().numpy()), rtol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# transforms + TransformedDistribution + Independent
+# ---------------------------------------------------------------------------
+def test_transform_roundtrips_and_ldj():
+    x = rng.normal(0, 1, (5,)).astype(np.float32)
+    for tr, xv in [
+        (D.AffineTransform(_t(1.0), _t(2.0)), x),
+        (D.ExpTransform(), x),
+        (D.SigmoidTransform(), x),
+        (D.TanhTransform(), x * 0.5),
+        (D.PowerTransform(_t(2.0)), np.abs(x) + 0.1),
+    ]:
+        y = tr.forward(_t(xv))
+        back = tr.inverse(y).numpy()
+        np.testing.assert_allclose(back, xv, rtol=1e-4, atol=1e-5)
+        # fldj vs numeric jacobian
+        fldj = tr.forward_log_det_jacobian(_t(xv)).numpy()
+        eps = 1e-3
+        num = (tr.forward(_t(xv + eps)).numpy()
+               - tr.forward(_t(xv - eps)).numpy()) / (2 * eps)
+        np.testing.assert_allclose(fldj, np.log(np.abs(num)), rtol=5e-3,
+                                   atol=5e-3)
+        ildj = tr.inverse_log_det_jacobian(y).numpy()
+        np.testing.assert_allclose(ildj, -fldj, rtol=1e-4, atol=1e-4)
+
+
+def test_chain_and_stack_and_reshape_transforms():
+    x = rng.normal(0, 1, (4,)).astype(np.float32)
+    chain = D.ChainTransform([D.AffineTransform(_t(0.0), _t(3.0)),
+                              D.ExpTransform()])
+    y = chain.forward(_t(x)).numpy()
+    np.testing.assert_allclose(y, np.exp(3 * x), rtol=1e-5)
+    np.testing.assert_allclose(chain.inverse(_t(y)).numpy(), x, rtol=1e-4)
+    np.testing.assert_allclose(
+        chain.forward_log_det_jacobian(_t(x)).numpy(),
+        np.log(3.0) + 3 * x, rtol=1e-4, atol=1e-5)
+
+    stk = D.StackTransform([D.ExpTransform(), D.AffineTransform(_t(0.0), _t(2.0))], axis=0)
+    xs = np.stack([x, x])
+    ys = stk.forward(_t(xs)).numpy()
+    np.testing.assert_allclose(ys[0], np.exp(x), rtol=1e-5)
+    np.testing.assert_allclose(ys[1], 2 * x, rtol=1e-5)
+    np.testing.assert_allclose(stk.inverse(_t(ys)).numpy(), xs, rtol=1e-4)
+
+    rsh = D.ReshapeTransform((4,), (2, 2))
+    assert tuple(rsh.forward(_t(x)).shape) == (2, 2)
+    assert rsh.forward_shape((7, 4)) == (7, 2, 2)
+    assert rsh.inverse_shape((7, 2, 2)) == (7, 4)
+
+
+def test_stickbreaking_transform():
+    x = rng.normal(0, 0.5, (3,)).astype(np.float32)
+    tr = D.StickBreakingTransform()
+    y = tr.forward(_t(x)).numpy()
+    assert y.shape == (4,)
+    np.testing.assert_allclose(y.sum(), 1.0, rtol=1e-5)
+    assert (y > 0).all()
+    np.testing.assert_allclose(tr.inverse(_t(y)).numpy(), x, rtol=1e-3,
+                               atol=1e-4)
+    assert tr.forward_shape((3,)) == (4,)
+
+
+def test_transformed_distribution_matches_lognormal():
+    base = D.Normal(0.3, 0.6)
+    td = D.TransformedDistribution(base, [D.ExpTransform()])
+    ln = st.lognorm(0.6, scale=np.exp(0.3))
+    x = rng.gamma(2.0, 1.0, (5,)).astype(np.float32) + 0.1
+    np.testing.assert_allclose(td.log_prob(_t(x)).numpy(), ln.logpdf(x),
+                               rtol=1e-4)
+    paddle.seed(5)
+    s = np.asarray(td.sample((20000,)).numpy())
+    np.testing.assert_allclose(s.mean(), ln.mean(), rtol=0.1)
+    # rsample grads flow through the transform into base params
+    loc = paddle.to_tensor(np.float32(0.0), stop_gradient=False)
+    td2 = D.TransformedDistribution(D.Normal(loc, 1.0), [D.ExpTransform()])
+    td2.rsample((64,)).mean().backward()
+    assert loc.grad is not None and np.isfinite(loc.grad.numpy())
+
+
+def test_independent_distribution():
+    d = D.Independent(D.Normal(_t(np.zeros((3, 4))), _t(np.ones((3, 4)))), 1)
+    assert d.batch_shape == (3,)
+    assert d.event_shape == (4,)
+    x = rng.normal(0, 1, (3, 4)).astype(np.float32)
+    lp = d.log_prob(_t(x)).numpy()
+    assert lp.shape == (3,)
+    np.testing.assert_allclose(lp, st.norm(0, 1).logpdf(x).sum(-1),
+                               rtol=1e-4)
+    ent = d.entropy().numpy()
+    np.testing.assert_allclose(ent, 4 * st.norm(0, 1).entropy() * np.ones(3),
+                               rtol=1e-5)
+
+
+def test_log_prob_gradients_through_tape():
+    """log_prob joins the eager autograd tape (parameter gradients)."""
+    mu = paddle.to_tensor(np.float32(0.0), stop_gradient=False)
+    sig = paddle.to_tensor(np.float32(1.0), stop_gradient=False)
+    d = D.Normal(mu, sig)
+    x = _t([0.5, -0.5, 1.0])
+    nll = -d.log_prob(x).mean()
+    nll.backward()
+    # d(-logp)/dmu = -mean((x-mu)/sig^2) = -mean(x)
+    np.testing.assert_allclose(float(mu.grad.numpy()), -1 / 3, rtol=1e-4)
+    assert np.isfinite(sig.grad.numpy())
