@@ -1,0 +1,282 @@
+"""paddle.geometric parity (reference: python/paddle/geometric/ — segment
+math, message-passing send/recv, graph reindex, neighbor sampling).
+
+TPU-native: segment reductions and message passing lower to
+`jax.ops.segment_*` / scatter-reduce index maps (the graph_send_recv CUDA
+kernels collapse into XLA scatter); reindex/sampling are host-side graph
+bookkeeping and run eagerly on numpy, exactly like the reference's CPU
+kernels.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..core.dispatch import op_call
+from ..core.random import split_key
+
+__all__ = [
+    "send_u_recv", "send_ue_recv", "send_uv",
+    "segment_sum", "segment_mean", "segment_min", "segment_max",
+    "reindex_graph", "reindex_heter_graph",
+    "sample_neighbors", "weighted_sample_neighbors",
+]
+
+
+def _num_segments(ids, out_size):
+    if out_size is not None and not isinstance(out_size, Tensor) \
+            and int(out_size) > 0:
+        return int(out_size)
+    if isinstance(out_size, Tensor):
+        n = int(np.asarray(out_size.numpy()))
+        if n > 0:
+            return n
+    return None
+
+
+def _segment(name, reduce_fn, x, segment_ids, n=None):
+    def impl(v, ids):
+        ids = ids.astype(jnp.int32)
+        if n is not None:
+            num = n
+        elif isinstance(ids, jax.core.Tracer):
+            raise ValueError(
+                f"{name} under jit needs a static segment count — ids are "
+                "traced; compute eagerly or use send_u_recv(out_size=...)")
+        else:
+            num = int(ids.max()) + 1 if ids.size else 0
+        return reduce_fn(v, ids, num)
+    return op_call(name, impl, x, segment_ids)
+
+
+def segment_sum(data, segment_ids, name=None):
+    """reference geometric/math.py:29 — rows of `data` summed per segment
+    id (ids must be sorted ascending like the reference contract)."""
+    return _segment("segment_sum",
+                    lambda v, i, n: jax.ops.segment_sum(v, i, n),
+                    data, segment_ids)
+
+
+def segment_mean(data, segment_ids, name=None):
+    def red(v, i, n):
+        s = jax.ops.segment_sum(v, i, n)
+        c = jax.ops.segment_sum(jnp.ones(v.shape[:1], v.dtype), i, n)
+        return s / jnp.maximum(c, 1).reshape((-1,) + (1,) * (v.ndim - 1))
+    return _segment("segment_mean", red, data, segment_ids)
+
+
+def segment_min(data, segment_ids, name=None):
+    def red(v, i, n):
+        out = jax.ops.segment_min(v, i, n)
+        # empty segments: reference returns 0, jax returns +inf
+        has = jax.ops.segment_sum(jnp.ones(v.shape[:1], jnp.float32), i, n) > 0
+        return jnp.where(has.reshape((-1,) + (1,) * (v.ndim - 1)), out,
+                         jnp.zeros_like(out))
+    return _segment("segment_min", red, data, segment_ids)
+
+
+def segment_max(data, segment_ids, name=None):
+    def red(v, i, n):
+        out = jax.ops.segment_max(v, i, n)
+        has = jax.ops.segment_sum(jnp.ones(v.shape[:1], jnp.float32), i, n) > 0
+        return jnp.where(has.reshape((-1,) + (1,) * (v.ndim - 1)), out,
+                         jnp.zeros_like(out))
+    return _segment("segment_max", red, data, segment_ids)
+
+
+_REDUCERS = {
+    "sum": lambda v, i, n: jax.ops.segment_sum(v, i, n),
+    "mean": lambda v, i, n: (
+        jax.ops.segment_sum(v, i, n)
+        / jnp.maximum(jax.ops.segment_sum(
+            jnp.ones(v.shape[:1], v.dtype), i, n), 1
+        ).reshape((-1,) + (1,) * (v.ndim - 1))),
+    "min": lambda v, i, n: jnp.where(
+        (jax.ops.segment_sum(jnp.ones(v.shape[:1], jnp.float32), i, n) > 0
+         ).reshape((-1,) + (1,) * (v.ndim - 1)),
+        jax.ops.segment_min(v, i, n), 0),
+    "max": lambda v, i, n: jnp.where(
+        (jax.ops.segment_sum(jnp.ones(v.shape[:1], jnp.float32), i, n) > 0
+         ).reshape((-1,) + (1,) * (v.ndim - 1)),
+        jax.ops.segment_max(v, i, n), 0),
+}
+
+_MESSAGE_OPS = {
+    "add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+    "div": jnp.divide,
+}
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Gather x[src_index], reduce into dst_index slots (reference
+    message_passing/send_recv.py:55 graph_send_recv kernel)."""
+    if reduce_op not in _REDUCERS:
+        raise ValueError(f"unsupported reduce_op {reduce_op!r}")
+    n_static = _num_segments(dst_index, out_size)
+
+    def impl(v, src, dst):
+        src = src.astype(jnp.int32)
+        dst = dst.astype(jnp.int32)
+        n = n_static if n_static is not None else v.shape[0]
+        return _REDUCERS[reduce_op](v[src], dst, n)
+    return op_call("graph_send_recv", impl, x, src_index, dst_index)
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """Gather x[src_index], combine with edge features y via message_op,
+    reduce into dst_index slots (reference send_recv.py:210)."""
+    if message_op not in _MESSAGE_OPS:
+        raise ValueError(f"unsupported message_op {message_op!r}")
+    if reduce_op not in _REDUCERS:
+        raise ValueError(f"unsupported reduce_op {reduce_op!r}")
+    n_static = _num_segments(dst_index, out_size)
+
+    def impl(xv, yv, src, dst):
+        src = src.astype(jnp.int32)
+        dst = dst.astype(jnp.int32)
+        msg = xv[src]
+        yb = yv.reshape(yv.shape[:1] + (1,) * (msg.ndim - yv.ndim)
+                        + yv.shape[1:]) if yv.ndim < msg.ndim else yv
+        msg = _MESSAGE_OPS[message_op](msg, yb.astype(msg.dtype))
+        n = n_static if n_static is not None else xv.shape[0]
+        return _REDUCERS[reduce_op](msg, dst, n)
+    return op_call("graph_send_ue_recv", impl, x, y, src_index, dst_index)
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Edge-wise message x[src] op y[dst] (reference send_recv.py:413)."""
+    if message_op not in _MESSAGE_OPS:
+        raise ValueError(f"unsupported message_op {message_op!r}")
+
+    def impl(xv, yv, src, dst):
+        return _MESSAGE_OPS[message_op](
+            xv[src.astype(jnp.int32)], yv[dst.astype(jnp.int32)])
+    return op_call("graph_send_uv", impl, x, y, src_index, dst_index)
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """Renumber (x, neighbors) to local ids with x first (reference
+    reindex.py:34). Host-side bookkeeping, eager numpy."""
+    xs = np.asarray(x.numpy() if isinstance(x, Tensor) else x)
+    nb = np.asarray(neighbors.numpy() if isinstance(neighbors, Tensor)
+                    else neighbors)
+    cnt = np.asarray(count.numpy() if isinstance(count, Tensor) else count)
+    mapping = {int(v): i for i, v in enumerate(xs)}
+    out_nodes = list(xs)
+    for v in nb:
+        vi = int(v)
+        if vi not in mapping:
+            mapping[vi] = len(out_nodes)
+            out_nodes.append(vi)
+    reindex_src = np.asarray([mapping[int(v)] for v in nb], xs.dtype)
+    reindex_dst = np.repeat(np.arange(len(cnt)), cnt).astype(xs.dtype)
+    return (Tensor(jnp.asarray(reindex_src)),
+            Tensor(jnp.asarray(reindex_dst)),
+            Tensor(jnp.asarray(np.asarray(out_nodes, xs.dtype))))
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """Heterogeneous variant (reference reindex.py:153): neighbors/count per
+    edge type; one shared node renumbering, per-type edges concatenated."""
+    srcs, dsts = [], []
+    xs = np.asarray(x.numpy() if isinstance(x, Tensor) else x)
+    mapping = {int(v): i for i, v in enumerate(xs)}
+    out_nodes = list(xs)
+    for nb_t, cnt_t in zip(neighbors, count):
+        nb = np.asarray(nb_t.numpy() if isinstance(nb_t, Tensor) else nb_t)
+        cnt = np.asarray(cnt_t.numpy() if isinstance(cnt_t, Tensor) else cnt_t)
+        for v in nb:
+            vi = int(v)
+            if vi not in mapping:
+                mapping[vi] = len(out_nodes)
+                out_nodes.append(vi)
+        srcs.append(np.asarray([mapping[int(v)] for v in nb], xs.dtype))
+        dsts.append(np.repeat(np.arange(len(cnt)), cnt).astype(xs.dtype))
+    return (Tensor(jnp.asarray(np.concatenate(srcs))),
+            Tensor(jnp.asarray(np.concatenate(dsts))),
+            Tensor(jnp.asarray(np.asarray(out_nodes, xs.dtype))))
+
+
+def _csr_of(row, colptr):
+    rowv = np.asarray(row.numpy() if isinstance(row, Tensor) else row)
+    ptr = np.asarray(colptr.numpy() if isinstance(colptr, Tensor) else colptr)
+    return rowv, ptr
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    """Uniform neighbor sampling over a CSC graph (reference
+    sampling/neighbors.py:30): returns (out_neighbors, out_count[, eids])."""
+    rowv, ptr = _csr_of(row, colptr)
+    nodes = np.asarray(input_nodes.numpy() if isinstance(input_nodes, Tensor)
+                       else input_nodes)
+    rng = np.random.default_rng(int(jax.random.randint(
+        split_key(), (), 0, 2**31 - 1)))
+    outs, counts, eout = [], [], []
+    for nid in nodes:
+        lo, hi = int(ptr[int(nid)]), int(ptr[int(nid) + 1])
+        deg = hi - lo
+        if sample_size < 0 or deg <= sample_size:
+            idx = np.arange(lo, hi)
+        else:
+            idx = lo + rng.choice(deg, size=sample_size, replace=False)
+        outs.append(rowv[idx])
+        counts.append(len(idx))
+        if return_eids:
+            ev = np.asarray(eids.numpy() if isinstance(eids, Tensor) else eids)
+            eout.append(ev[idx])
+    nbrs = Tensor(jnp.asarray(np.concatenate(outs) if outs
+                              else np.zeros(0, rowv.dtype)))
+    cnts = Tensor(jnp.asarray(np.asarray(counts, np.int32)))
+    if return_eids:
+        return nbrs, cnts, Tensor(jnp.asarray(
+            np.concatenate(eout) if eout else np.zeros(0, rowv.dtype)))
+    return nbrs, cnts
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None, return_eids=False,
+                              name=None):
+    """Weight-proportional sampling without replacement (reference
+    sampling/neighbors.py:218)."""
+    rowv, ptr = _csr_of(row, colptr)
+    wv = np.asarray(edge_weight.numpy() if isinstance(edge_weight, Tensor)
+                    else edge_weight).astype(np.float64)
+    nodes = np.asarray(input_nodes.numpy() if isinstance(input_nodes, Tensor)
+                       else input_nodes)
+    rng = np.random.default_rng(int(jax.random.randint(
+        split_key(), (), 0, 2**31 - 1)))
+    outs, counts, eout = [], [], []
+    for nid in nodes:
+        lo, hi = int(ptr[int(nid)]), int(ptr[int(nid) + 1])
+        deg = hi - lo
+        if deg == 0:
+            counts.append(0)
+            outs.append(np.zeros(0, rowv.dtype))
+            if return_eids:
+                eout.append(np.zeros(0, rowv.dtype))
+            continue
+        if sample_size < 0 or deg <= sample_size:
+            idx = np.arange(lo, hi)
+        else:
+            p = wv[lo:hi]
+            p = p / p.sum()
+            idx = lo + rng.choice(deg, size=sample_size, replace=False, p=p)
+        outs.append(rowv[idx])
+        counts.append(len(idx))
+        if return_eids:
+            ev = np.asarray(eids.numpy() if isinstance(eids, Tensor) else eids)
+            eout.append(ev[idx])
+    nbrs = Tensor(jnp.asarray(np.concatenate(outs) if outs
+                              else np.zeros(0, rowv.dtype)))
+    cnts = Tensor(jnp.asarray(np.asarray(counts, np.int32)))
+    if return_eids:
+        return nbrs, cnts, Tensor(jnp.asarray(
+            np.concatenate(eout) if eout else np.zeros(0, rowv.dtype)))
+    return nbrs, cnts

@@ -28,6 +28,11 @@ class SparseCooTensor(Tensor):
         return Tensor(self._bcoo.indices.T)
 
     def values(self):
+        # keep the autograd tape when the producing op attached its
+        # value Tensor (sparse nn layers do)
+        vt = getattr(self, "_values_t", None)
+        if vt is not None:
+            return vt
         return Tensor(self._bcoo.data)
 
     def to_dense(self):
@@ -99,7 +104,9 @@ def masked_matmul(x, y, mask, name=None):
 
 
 class _SparseNN:
-    """paddle.sparse.nn subset (ReLU on sparse values)."""
+    """paddle.sparse.nn namespace: layer classes (lazily bound from
+    nn_layers to avoid an import cycle with the Layer base) plus the
+    functional relu shim kept from round 3."""
 
     @staticmethod
     def relu(x):
@@ -108,6 +115,13 @@ class _SparseNN:
                 (jax.nn.relu(x._bcoo.data), x._bcoo.indices), shape=x._bcoo.shape))
         from ..nn.functional import relu as dense_relu
         return dense_relu(x)
+
+    def __getattr__(self, name):
+        from . import nn_layers
+        try:
+            return getattr(nn_layers, name)
+        except AttributeError:
+            raise AttributeError(f"paddle.sparse.nn has no attribute {name!r}")
 
 
 nn = _SparseNN()

@@ -1,0 +1,136 @@
+"""paddle.geometric parity tests (VERDICT r3 missing #3 / next-round #9;
+reference python/paddle/geometric/). Numeric checks against the reference
+docstring examples and dense numpy reductions."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import geometric as G
+
+rng = np.random.default_rng(3)
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+def test_segment_ops_reference_examples():
+    data = np.array([[1., 2., 3.], [3., 2., 1.], [4., 5., 6.]], np.float32)
+    ids = np.array([0, 0, 1], np.int32)
+    np.testing.assert_allclose(G.segment_sum(_t(data), _t(ids)).numpy(),
+                               [[4, 4, 4], [4, 5, 6]])
+    np.testing.assert_allclose(G.segment_mean(_t(data), _t(ids)).numpy(),
+                               [[2, 2, 2], [4, 5, 6]])
+    np.testing.assert_allclose(G.segment_min(_t(data), _t(ids)).numpy(),
+                               [[1, 2, 1], [4, 5, 6]])
+    np.testing.assert_allclose(G.segment_max(_t(data), _t(ids)).numpy(),
+                               [[3, 2, 3], [4, 5, 6]])
+
+
+def test_segment_ops_random_vs_numpy():
+    x = rng.normal(0, 1, (40, 5)).astype(np.float32)
+    ids = np.sort(rng.integers(0, 7, 40)).astype(np.int32)
+    out = G.segment_sum(_t(x), _t(ids)).numpy()
+    for s in range(ids.max() + 1):
+        np.testing.assert_allclose(out[s], x[ids == s].sum(0), rtol=1e-5,
+                                   atol=1e-5)
+    outm = G.segment_mean(_t(x), _t(ids)).numpy()
+    for s in range(ids.max() + 1):
+        np.testing.assert_allclose(outm[s], x[ids == s].mean(0), rtol=1e-5,
+                                   atol=1e-5)
+
+
+def test_send_u_recv_reference_example():
+    x = np.array([[0, 2, 3], [1, 4, 5], [2, 6, 7]], np.float32)
+    src = np.array([0, 1, 2, 0], np.int32)
+    dst = np.array([1, 2, 1, 0], np.int32)
+    out = G.send_u_recv(_t(x), _t(src), _t(dst), reduce_op="sum").numpy()
+    np.testing.assert_allclose(out, [[0, 2, 3], [2, 8, 10], [1, 4, 5]])
+    # out_size clips the output rows
+    out2 = G.send_u_recv(_t(x), _t(src), _t(dst), reduce_op="sum",
+                         out_size=2).numpy()
+    np.testing.assert_allclose(out2, [[0, 2, 3], [2, 8, 10]])
+    outmax = G.send_u_recv(_t(x), _t(src), _t(dst), reduce_op="max").numpy()
+    np.testing.assert_allclose(outmax, [[0, 2, 3], [2, 6, 7], [1, 4, 5]])
+    with pytest.raises(ValueError):
+        G.send_u_recv(_t(x), _t(src), _t(dst), reduce_op="prod")
+
+
+def test_send_ue_recv_and_send_uv():
+    x = np.array([[0, 2, 3], [1, 4, 5], [2, 6, 7]], np.float32)
+    y = np.array([1., 1., 1., 1.], np.float32)
+    src = np.array([0, 1, 2, 0], np.int32)
+    dst = np.array([1, 2, 1, 0], np.int32)
+    out = G.send_ue_recv(_t(x), _t(y), _t(src), _t(dst),
+                         message_op="add", reduce_op="sum").numpy()
+    np.testing.assert_allclose(out, [[1, 3, 4], [4, 10, 12], [2, 5, 6]])
+    out_uv = G.send_uv(_t(x), _t(x), _t(src), _t(dst),
+                       message_op="mul").numpy()
+    expect = x[src] * x[dst]
+    np.testing.assert_allclose(out_uv, expect)
+
+
+def test_send_u_recv_gradients():
+    x = paddle.to_tensor(rng.normal(0, 1, (4, 3)).astype(np.float32),
+                         stop_gradient=False)
+    src = _t(np.array([0, 1, 2, 3], np.int32))
+    dst = _t(np.array([0, 0, 1, 1], np.int32))
+    out = G.send_u_recv(x, src, dst, reduce_op="sum", out_size=2)
+    out.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), np.ones((4, 3)), rtol=1e-6)
+
+
+def test_reindex_graph_reference_example():
+    x = np.array([0, 1, 2], np.int64)
+    neighbors = np.array([8, 9, 0, 4, 7, 6, 7], np.int64)
+    count = np.array([2, 3, 2], np.int32)
+    rs, rd, nodes = G.reindex_graph(_t(x), _t(neighbors), _t(count))
+    np.testing.assert_array_equal(rs.numpy(), [3, 4, 0, 5, 6, 7, 6])
+    np.testing.assert_array_equal(rd.numpy(), [0, 0, 1, 1, 1, 2, 2])
+    np.testing.assert_array_equal(nodes.numpy(), [0, 1, 2, 8, 9, 4, 7, 6])
+
+
+def test_reindex_heter_graph():
+    x = np.array([0, 1, 2], np.int64)
+    n1 = np.array([8, 9, 0, 4, 7, 6, 7], np.int64)
+    c1 = np.array([2, 3, 2], np.int32)
+    n2 = np.array([0, 2, 3, 5, 1], np.int64)
+    c2 = np.array([1, 3, 1], np.int32)
+    rs, rd, nodes = G.reindex_heter_graph(_t(x), [_t(n1), _t(n2)],
+                                          [_t(c1), _t(c2)])
+    nd = nodes.numpy()
+    assert list(nd[:3]) == [0, 1, 2]
+    assert len(set(nd.tolist())) == len(nd)
+    # edges reference valid local ids and map back to the original graph
+    rsv, rdv = rs.numpy(), rd.numpy()
+    np.testing.assert_array_equal(nd[rsv[:7]], n1)
+    np.testing.assert_array_equal(nd[rsv[7:]], n2)
+    np.testing.assert_array_equal(rdv[:7], [0, 0, 1, 1, 1, 2, 2])
+
+
+def test_sample_neighbors_csc():
+    # CSC: colptr over 4 nodes; node 0 has nbrs [1,2,3], node 1 [0], ...
+    row = np.array([1, 2, 3, 0, 0, 1, 2], np.int64)
+    colptr = np.array([0, 3, 4, 6, 7], np.int64)
+    paddle.seed(0)
+    nbrs, cnts = G.sample_neighbors(_t(row), _t(colptr), _t(np.array([0, 2])),
+                                    sample_size=2)
+    c = cnts.numpy()
+    assert list(c) == [2, 2]
+    n = nbrs.numpy()
+    assert set(n[:2]).issubset({1, 2, 3})
+    assert set(n[2:]).issubset({0, 1})
+    # full neighborhoods when sample_size = -1
+    nbrs_all, cnts_all = G.sample_neighbors(_t(row), _t(colptr),
+                                            _t(np.array([0, 1])))
+    assert list(cnts_all.numpy()) == [3, 1]
+    np.testing.assert_array_equal(nbrs_all.numpy(), [1, 2, 3, 0])
+
+    w = np.array([0.1, 0.1, 10.0, 1.0, 1.0, 1.0, 1.0], np.float32)
+    paddle.seed(1)
+    hits = 0
+    for _ in range(20):
+        nb, _c = G.weighted_sample_neighbors(
+            _t(row), _t(colptr), _t(w), _t(np.array([0])), sample_size=1)
+        hits += int(nb.numpy()[0] == 3)
+    assert hits >= 15  # weight-10 neighbor dominates

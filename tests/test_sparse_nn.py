@@ -1,0 +1,154 @@
+"""Sparse NN layers vs dense reference on small volumes (VERDICT r3 item #9;
+reference python/paddle/sparse/nn/layer/conv.py etc.)."""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse
+
+rng = np.random.default_rng(9)
+
+
+def _random_sparse_volume(N=1, D=5, H=5, W=5, C=2, density=0.2):
+    dense = np.where(rng.uniform(size=(N, D, H, W, C)) < density,
+                     rng.normal(0, 1, (N, D, H, W, C)), 0.0
+                     ).astype(np.float32)
+    # active site = any channel nonzero
+    mask = np.abs(dense).sum(-1) > 0
+    idx = np.stack(np.nonzero(mask))                # [4, nnz]
+    vals = dense[mask]                              # [nnz, C]
+    st = sparse.sparse_coo_tensor(idx, vals, dense.shape)
+    return st, dense
+
+
+def _dense_conv(dense, w, b, stride, padding, dims=3):
+    # NDHWC x [kd,kh,kw,ci,co]
+    out = jax.lax.conv_general_dilated(
+        jnp.asarray(dense), jnp.asarray(w),
+        window_strides=[stride] * dims,
+        padding=[(padding, padding)] * dims,
+        dimension_numbers=("NDHWC", "DHWIO", "NDHWC") if dims == 3
+        else ("NHWC", "HWIO", "NHWC"))
+    return np.asarray(out) + (np.asarray(b) if b is not None else 0.0)
+
+
+def _sparse_to_dense(st):
+    return np.asarray(st.to_dense().numpy())
+
+
+@pytest.mark.parametrize("stride,padding", [(1, 1), (2, 1), (1, 0)])
+def test_sparse_conv3d_matches_dense(stride, padding):
+    st, dense = _random_sparse_volume()
+    conv = sparse.nn.Conv3D(2, 4, kernel_size=3, stride=stride,
+                            padding=padding)
+    out = conv(st)
+    got = _sparse_to_dense(out)
+    expect = _dense_conv(dense, conv.weight.numpy(), conv.bias.numpy(),
+                         stride, padding)
+    assert got.shape == expect.shape
+    # sparse conv computes only sites with active receptive fields; bias is
+    # added only at those sites — compare there, and check inactive sites
+    # carry no conv contribution beyond (missing) bias
+    active = np.abs(got).sum(-1) > 0
+    np.testing.assert_allclose(got[active], expect[active], rtol=1e-4,
+                               atol=1e-4)
+    inactive_expect = expect[~active] - conv.bias.numpy()[None]
+    np.testing.assert_allclose(inactive_expect, 0.0, atol=1e-5)
+
+
+def test_subm_conv3d_site_preservation_and_values():
+    st, dense = _random_sparse_volume(density=0.3)
+    conv = sparse.nn.SubmConv3D(2, 3, kernel_size=3, padding=1,
+                                bias_attr=False)
+    out = conv(st)
+    # submanifold: exactly the input's active sites
+    in_sites = set(map(tuple, np.asarray(st._bcoo.indices).tolist()))
+    out_sites = set(map(tuple, np.asarray(out._bcoo.indices).tolist()))
+    assert in_sites == out_sites
+    got = _sparse_to_dense(out)
+    expect = _dense_conv(dense, conv.weight.numpy(), None, 1, 1)
+    mask = np.abs(dense).sum(-1) > 0
+    np.testing.assert_allclose(got[mask], expect[mask], rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(got[~mask], 0.0, atol=1e-6)
+    with pytest.raises(ValueError):
+        sparse.nn.SubmConv3D(2, 3, 3, stride=2)
+
+
+def test_sparse_conv2d_matches_dense():
+    dense = np.where(rng.uniform(size=(1, 6, 6, 2)) < 0.3,
+                     rng.normal(0, 1, (1, 6, 6, 2)), 0.0).astype(np.float32)
+    mask = np.abs(dense).sum(-1) > 0
+    idx = np.stack(np.nonzero(mask))
+    st = sparse.sparse_coo_tensor(idx, dense[mask], dense.shape)
+    conv = sparse.nn.Conv2D(2, 3, kernel_size=3, padding=1, bias_attr=False)
+    got = _sparse_to_dense(conv(st))
+    expect = _dense_conv(dense, conv.weight.numpy(), None, 1, 1, dims=2)
+    active = np.abs(got).sum(-1) > 0
+    np.testing.assert_allclose(got[active], expect[active], rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_sparse_maxpool3d_matches_dense():
+    st, dense = _random_sparse_volume(D=4, H=4, W=4, density=0.4)
+    pool = sparse.nn.MaxPool3D(kernel_size=2, stride=2)
+    got = _sparse_to_dense(pool(st))
+    # dense reference restricted to windows with any active site: max over
+    # ACTIVE values only (sparse pooling ignores empty voxels)
+    N, D, H, W, C = dense.shape
+    mask = np.abs(dense).sum(-1) > 0
+    for d in range(D // 2):
+        for h in range(H // 2):
+            for w in range(W // 2):
+                win = dense[0, 2*d:2*d+2, 2*h:2*h+2, 2*w:2*w+2]
+                wm = mask[0, 2*d:2*d+2, 2*h:2*h+2, 2*w:2*w+2]
+                if wm.any():
+                    expect = win[wm].max(0)
+                    np.testing.assert_allclose(got[0, d, h, w], expect,
+                                               rtol=1e-5)
+                else:
+                    np.testing.assert_allclose(got[0, d, h, w], 0.0)
+
+
+def test_sparse_batchnorm_and_activations():
+    st, dense = _random_sparse_volume(density=0.4)
+    bn = sparse.nn.BatchNorm(2)
+    out = bn(st)
+    vals = np.asarray(out._bcoo.data)
+    np.testing.assert_allclose(vals.mean(0), 0.0, atol=1e-4)
+    np.testing.assert_allclose(vals.std(0), 1.0, atol=1e-2)
+    # same sites
+    np.testing.assert_array_equal(np.asarray(out._bcoo.indices),
+                                  np.asarray(st._bcoo.indices))
+
+    relu = sparse.nn.ReLU()
+    r = relu(st)
+    np.testing.assert_allclose(np.asarray(r._bcoo.data),
+                               np.maximum(np.asarray(st._bcoo.data), 0))
+    sm = sparse.nn.Softmax()
+    s = sm(st)
+    np.testing.assert_allclose(np.asarray(s._bcoo.data).sum(-1), 1.0,
+                               rtol=1e-5)
+
+
+def test_sparse_conv_gradients_flow():
+    st, dense = _random_sparse_volume(density=0.3)
+    conv = sparse.nn.SubmConv3D(2, 3, kernel_size=3, padding=1)
+    out = conv(st)
+    out.values().sum().backward()
+    assert conv.weight.grad is not None
+    g = conv.weight.grad.numpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+    assert conv.bias.grad is not None
+
+
+def test_sparse_resnet_block_stack():
+    """A small SubmConv -> BN -> ReLU -> Conv stack runs end to end."""
+    st, _ = _random_sparse_volume(D=6, H=6, W=6, C=2, density=0.25)
+    net_out = sparse.nn.SubmConv3D(2, 4, 3, padding=1)(st)
+    net_out = sparse.nn.BatchNorm(4)(net_out)
+    net_out = sparse.nn.ReLU()(net_out)
+    net_out = sparse.nn.Conv3D(4, 8, 3, stride=2, padding=1)(net_out)
+    assert net_out.shape[-1] == 8
+    assert np.isfinite(_sparse_to_dense(net_out)).all()
