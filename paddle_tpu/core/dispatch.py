@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import functools
 import threading
+import time
 from typing import Any, Callable, Dict, Optional
 
 import jax
@@ -173,6 +174,33 @@ def _record_op(name, vals, outs, impl=None, static_kwargs=None):
     rec.append((name, tuple(vals), tuple(outs), impl, dict(static_kwargs or {})))
 
 
+# profiler op-timing hook (reference profiler_statistic.py's host-op events):
+# when set (by profiler.Profiler.start), every eager op_call appends
+# (name, t_start_s, dur_s, out_shapes). Timing is blocking — the profiler
+# trades throughput for per-op attribution, like the reference's tracer.
+_op_timer = [None]
+
+
+def _timed_exec(name, fn):
+    timer = _op_timer[0]
+    if timer is None:
+        return fn()
+    t0 = time.perf_counter()
+    out = fn()
+    try:
+        arrs = [x for x in jax.tree_util.tree_leaves(out)
+                if isinstance(x, jax.Array)]
+        jax.block_until_ready(arrs)
+    except Exception:
+        pass
+    dur = time.perf_counter() - t0
+    outs = out if isinstance(out, (tuple, list)) else (out,)
+    shapes = tuple(tuple(getattr(o, "shape", ())) for o in outs
+                   if hasattr(o, "shape"))
+    timer.append((name, t0, dur, shapes))
+    return out
+
+
 def _check_numerics(name, vals):
     import numpy as np
     for v in vals:
@@ -233,7 +261,7 @@ def op_call(name: str, fn: Callable, *args, nondiff: bool = False, **static_kwar
         need_grad = bool(diff_idx)
 
     if not need_grad or tracing:
-        out = impl(*vals, **static_kwargs)
+        out = _timed_exec(name, lambda: impl(*vals, **static_kwargs))
         multi = isinstance(out, (tuple, list))
         outs = tuple(out) if multi else (out,)
         if flags.get_flag("check_nan_inf"):
@@ -252,7 +280,7 @@ def op_call(name: str, fn: Callable, *args, nondiff: bool = False, **static_kwar
         return impl(*vv, **static_kwargs)
 
     primals = [vals[i] for i in diff_idx]
-    out, vjp_fn = jax.vjp(f, *primals)
+    out, vjp_fn = _timed_exec(name, lambda: jax.vjp(f, *primals))
     multi = isinstance(out, (tuple, list))
     outs = tuple(out) if multi else (out,)
     if flags.get_flag("check_nan_inf"):

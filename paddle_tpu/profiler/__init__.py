@@ -95,6 +95,18 @@ class Profiler:
         self._dir = "/tmp/paddle_tpu_profile"
         self._active = False
         self.timer = Timer()
+        # eager per-op events collected via the dispatch hook:
+        # (name, t_start_s, dur_s, out_shapes)
+        self._op_events = []
+
+    def _attach_op_timer(self):
+        from ..core import dispatch as _dispatch
+        _dispatch._op_timer[0] = self._op_events
+
+    def _detach_op_timer(self):
+        from ..core import dispatch as _dispatch
+        if _dispatch._op_timer[0] is self._op_events:
+            _dispatch._op_timer[0] = None
 
     def start(self):
         self.timer.begin()
@@ -102,13 +114,21 @@ class Profiler:
             return
         state = self._scheduler(self._step)
         if state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN):
-            jax.profiler.start_trace(self._dir)
+            try:
+                jax.profiler.start_trace(self._dir)
+            except Exception:
+                pass  # a second concurrent device trace is a host-only run
             self._active = True
+            self._attach_op_timer()
 
     def stop(self):
         if self._active:
-            jax.profiler.stop_trace()
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
             self._active = False
+            self._detach_op_timer()
             if self._on_trace_ready:
                 self._on_trace_ready(self)
 
@@ -123,22 +143,135 @@ class Profiler:
         if prev in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN) and \
                 cur in (ProfilerState.CLOSED, ProfilerState.READY):
             if self._active:
-                jax.profiler.stop_trace()
+                try:
+                    jax.profiler.stop_trace()
+                except Exception:
+                    pass
                 self._active = False
+                self._detach_op_timer()
         elif cur in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN) and \
                 not self._active:
-            jax.profiler.start_trace(self._dir)
+            try:
+                jax.profiler.start_trace(self._dir)
+            except Exception:
+                pass
             self._active = True
+            self._attach_op_timer()
 
     def step_info(self, unit="samples"):
         return self.timer.step_info(unit)
 
+    def _op_stats(self):
+        """Aggregate eager op events -> {name: [count, total_s, min, max]}."""
+        agg = {}
+        for name, _t0, dur, _shapes in self._op_events:
+            e = agg.setdefault(name, [0, 0.0, float("inf"), 0.0])
+            e[0] += 1
+            e[1] += dur
+            e[2] = min(e[2], dur)
+            e[3] = max(e[3], dur)
+        return agg
+
+    def _device_op_stats(self):
+        """Per-op SELF times from the newest jax XPlane chrome trace under
+        self._dir (jit workloads: the eager hook sees only staged tracing,
+        the device trace has the real kernel times). Returns the same
+        aggregate mapping or {} when no trace exists."""
+        import glob
+        import gzip
+        import json as _json
+        import re
+        files = sorted(glob.glob(
+            f"{self._dir}/**/*.trace.json.gz", recursive=True))
+        if not files:
+            return {}
+        try:
+            with gzip.open(files[-1]) as f:
+                data = _json.load(f)
+        except Exception:
+            return {}
+        meta = {}
+        for e in data.get("traceEvents", []):
+            if e.get("ph") == "M" and e.get("name") == "thread_name":
+                meta[(e.get("pid"), e.get("tid"))] = e["args"].get("name")
+        evs = [e for e in data.get("traceEvents", [])
+               if e.get("ph") == "X"
+               and meta.get((e.get("pid"), e.get("tid"))) == "XLA Ops"]
+        evs.sort(key=lambda e: (e["ts"], -e["dur"]))
+        agg = {}
+        stack = []
+        for e in evs:
+            ts, dur = e["ts"], e["dur"]
+            name = re.sub(r"[.\d]+$", "", e["name"])
+            while stack and stack[-1][1] <= ts:
+                stack.pop()
+            if stack:
+                agg[stack[-1][2]][1] -= dur / 1e6
+            en = agg.setdefault(name, [0, 0.0, float("inf"), 0.0])
+            en[0] += 1
+            en[1] += dur / 1e6
+            en[2] = min(en[2], dur / 1e6)
+            en[3] = max(en[3], dur / 1e6)
+            stack.append((ts, ts + dur, name))
+        return agg
+
+    @staticmethod
+    def _format_table(title, agg, unit_div):
+        total = sum(e[1] for e in agg.values()) or 1e-12
+        lines = [title,
+                 f"{'Name':<40}{'Calls':>8}{'Total':>12}{'Avg':>12}"
+                 f"{'Min':>12}{'Max':>12}{'Ratio %':>9}"]
+        for name, (cnt, tot, mn, mx) in sorted(
+                agg.items(), key=lambda kv: -kv[1][1]):
+            lines.append(
+                f"{name[:39]:<40}{cnt:>8}"
+                f"{tot / unit_div:>12.4f}{tot / cnt / unit_div:>12.4f}"
+                f"{mn / unit_div:>12.4f}{mx / unit_div:>12.4f}"
+                f"{100 * tot / total:>8.1f}%")
+        return "\n".join(lines)
+
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
                 time_unit="ms", views=None):
-        return "profiler summary: see TensorBoard XPlane trace at " + self._dir
+        """Operator / kernel statistics tables (reference
+        profiler_statistic.py:1 OperatorView + DeviceView): eager per-op
+        host times from the dispatch hook, plus device-kernel self-times
+        parsed from the jax trace when one was captured."""
+        div = {"s": 1.0, "ms": 1e-3, "us": 1e-6}.get(time_unit, 1e-3)
+        parts = []
+        op_agg = self._op_stats()
+        if op_agg:
+            parts.append(self._format_table(
+                f"-- Operator Summary (host, {time_unit}) --", op_agg, div))
+        dev_agg = self._device_op_stats()
+        if dev_agg:
+            parts.append(self._format_table(
+                f"-- Device Kernel Summary (self time, {time_unit}) --",
+                dev_agg, div))
+        parts.append(f"-- Benchmark: {self.timer.step_info()} --")
+        if not op_agg and not dev_agg:
+            parts.append("(no events recorded; XPlane trace dir: "
+                         + self._dir + ")")
+        return "\n\n".join(parts)
 
     def export(self, path, format="json"):
-        pass
+        """Write the collected events as a chrome://tracing-loadable JSON
+        (reference chrometracing_logger.cc)."""
+        import json as _json
+        if format != "json":
+            raise ValueError(f"unsupported export format {format!r}")
+        events = [{"name": "process_name", "ph": "M", "pid": 0,
+                   "args": {"name": "paddle_tpu eager ops"}}]
+        for name, t0, dur, shapes in self._op_events:
+            events.append({
+                "name": name, "ph": "X", "cat": "operator",
+                "pid": 0, "tid": 0,
+                "ts": round(t0 * 1e6, 3), "dur": round(dur * 1e6, 3),
+                "args": {"output_shapes": [list(s) for s in shapes]},
+            })
+        with open(path, "w") as f:
+            _json.dump({"traceEvents": events,
+                        "displayTimeUnit": "ms"}, f)
+        return path
 
     def __enter__(self):
         self.start()

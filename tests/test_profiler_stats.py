@@ -1,0 +1,82 @@
+"""Profiler statistics (VERDICT r3 missing #5 / weak #7; reference
+python/paddle/profiler/profiler_statistic.py + chrometracing_logger.cc):
+summary() must produce real per-op tables and export() a loadable chrome
+trace."""
+import json
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import profiler
+from paddle_tpu import nn, optimizer
+
+
+def _train_some(steps=3):
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    opt = optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+    x = paddle.to_tensor(np.random.default_rng(0).normal(
+        size=(16, 8)).astype(np.float32))
+    y = paddle.to_tensor(np.zeros((16, 4), np.float32))
+    for _ in range(steps):
+        loss = ((net(x) - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    return float(loss.numpy())
+
+
+def test_profiler_summary_has_named_ops_with_nonzero_times():
+    prof = profiler.Profiler(targets=[profiler.ProfilerTarget.CPU])
+    prof.start()
+    _train_some()
+    prof.stop()
+    s = prof.summary()
+    assert "Operator Summary" in s
+    assert "linear" in s
+    assert "Calls" in s and "Total" in s and "Ratio" in s
+    stats = prof._op_stats()
+    assert stats["linear"][0] >= 6          # 2 linears x 3 steps
+    assert stats["linear"][1] > 0           # nonzero total time
+    # every recorded op has positive duration
+    assert all(tot > 0 for _, tot, _, _ in stats.values())
+
+
+def test_profiler_detaches_on_stop():
+    from paddle_tpu.core.dispatch import _op_timer
+    prof = profiler.Profiler()
+    prof.start()
+    assert _op_timer[0] is prof._op_events
+    prof.stop()
+    assert _op_timer[0] is None
+    n = len(prof._op_events)
+    _train_some(1)
+    assert len(prof._op_events) == n        # no recording after stop
+
+
+def test_profiler_export_chrome_trace(tmp_path):
+    prof = profiler.Profiler()
+    with prof:
+        _train_some(2)
+    out = tmp_path / "trace.json"
+    prof.export(str(out))
+    data = json.loads(out.read_text())
+    evs = [e for e in data["traceEvents"] if e.get("ph") == "X"]
+    assert len(evs) > 10
+    assert all(e["dur"] >= 0 and "ts" in e for e in evs)
+    names = {e["name"] for e in evs}
+    assert "linear" in names
+    with pytest.raises(ValueError):
+        prof.export(str(out), format="protobuf")
+
+
+def test_profiler_scheduler_gates_recording():
+    sched = profiler.make_scheduler(closed=1, ready=0, record=1, repeat=0)
+    prof = profiler.Profiler(scheduler=sched)
+    prof.start()           # step 0: CLOSED -> no device trace, no op hook
+    _train_some(1)
+    assert len(prof._op_events) == 0
+    prof.step()            # -> step 1: RECORD
+    _train_some(1)
+    assert len(prof._op_events) > 0
+    prof.stop()
