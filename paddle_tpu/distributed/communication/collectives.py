@@ -185,33 +185,106 @@ def all_to_all_single(out_tensor, in_tensor, out_split_sizes=None,
     return out_tensor
 
 
-def send(tensor, dst=0, group=None, sync_op=True):
-    """In-graph p2p via ppermute (pipeline stages); eager send between
-    processes is expressed through the pipeline schedule's compiled steps on
-    TPU (no raw NCCL-like eager p2p)."""
+def _p2p_shift(group, peer, kind):
+    """Uniform cyclic shift realizing this rank's p2p request.
+
+    SPMD semantics (reference send.py/recv.py are MPI-style p2p; XLA has no
+    point-to-point — ppermute is the primitive): `send(t, dst)` on rank r
+    and the matching `recv(t, src=r)` on rank dst both lower to the SAME
+    ppermute with shift = (dst - r) mod n, so the per-rank branches of a
+    multi-controller program stay identical XLA programs.  Within one
+    traced program (shard_map over a virtual axis) the single shift
+    generalizes the pair to a uniform cyclic rotation — non-uniform
+    patterns need lax.ppermute directly."""
+    g = group if group is not None else get_group(0)
+    n = max(g.nranks, 1)
+    me = g.rank
+    if me < 0:
+        me = 0
+    peer_local = g.get_group_rank(peer)
+    if peer_local < 0:
+        raise ValueError(
+            f"p2p peer {peer} is not a member of group ranks {g.ranks}")
+    if kind == "send":
+        return (peer_local - me) % n, n
+    return (me - peer_local) % n, n
+
+
+@functools.lru_cache(maxsize=256)
+def _eager_ppermute_fn(mesh, spec, axis, shift):
+    from jax import shard_map
+    n = mesh.shape[axis]
+    perm = [(i, (i + shift) % n) for i in range(n)]
+
+    def body(x):
+        return jax.lax.ppermute(x, axis, perm)
+    return jax.jit(shard_map(body, mesh=mesh, in_specs=(spec,),
+                             out_specs=spec))
+
+
+def _axis_size_static(axis, eager_mesh=None, traced=False):
+    """Static size of the ppermute axis: under a trace the axis environment
+    is authoritative (jax.lax.axis_size); eagerly, the array's mesh."""
+    if traced:
+        try:
+            return int(jax.lax.axis_size(axis))
+        except Exception:
+            return None
+    if eager_mesh is not None and axis in eager_mesh.axis_names:
+        return eager_mesh.shape[axis]
+    return None
+
+
+def _p2p(tensor, peer, group, kind):
     v = tensor._value
     axis = _axis(group)
+    shift, n = _p2p_shift(group, peer, kind)
+    if n == 1:
+        return tensor
+    mesh = None if _is_traced(v) else _mesh_of(v)
+    axis_n = _axis_size_static(axis, mesh, traced=_is_traced(v))
+    if axis_n is not None and axis_n != n:
+        # perms address AXIS indices — a group that doesn't span the mesh
+        # axis would silently deliver to the wrong member
+        raise ValueError(
+            f"p2p group has {n} ranks but mesh axis {axis!r} has size "
+            f"{axis_n}; p2p groups must span their mesh axis (use "
+            "lax.ppermute directly for sub-axis patterns)")
     if _is_traced(v):
-        n = 1
-        perm = None  # ring shift to neighbor: dst relative
-        return Tensor(jax.lax.ppermute(v, axis, _ring_perm(axis, +1)))
-    return tensor
+        perm = [(i, (i + shift) % n) for i in range(n)]
+        out = jax.lax.ppermute(v, axis, perm)
+        if kind == "recv":
+            tensor._set_value(out)
+            return tensor
+        # keep the collective alive on sending ranks (multi-controller
+        # programs must stay identical across the send/recv branches)
+        return Tensor(out)
+    if mesh is not None and axis in mesh.axis_names and mesh.shape[axis] > 1:
+        out = _eager_ppermute_fn(mesh, v.sharding.spec, axis, shift)(v)
+        if kind == "recv":
+            tensor._set_value(out)
+            return tensor
+        return Tensor(out)
+    raise RuntimeError(
+        f"eager {kind} with {n} participants needs a mesh-sharded array "
+        f"(global array over a Mesh with axis {axis!r}) or a traced "
+        "context; a silent no-op would deliver nothing. Wrap the exchange "
+        "in shard_map/jit or build the tensor over the process mesh.")
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    """P2P send to `dst` (reference communication/send.py). Lowers to a
+    cyclic ppermute with shift (dst - rank) mod n — see _p2p_shift for the
+    exact SPMD contract; the matching recv on rank dst produces the same
+    program. Returns the permuted tensor on sending ranks so the collective
+    stays live in traced graphs."""
+    return _p2p(tensor, dst, group, "send")
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
-    v = tensor._value
-    axis = _axis(group)
-    if _is_traced(v):
-        return Tensor(jax.lax.ppermute(v, axis, _ring_perm(axis, +1)))
-    return tensor
-
-
-def _ring_perm(axis, shift):
-    # resolved at trace time using the bound mesh
-    from ..topology import get_default_mesh
-    mesh = get_default_mesh()
-    n = mesh.shape[axis] if axis in mesh.axis_names else 1
-    return [(i, (i + shift) % n) for i in range(n)]
+    """P2P receive from `src` into `tensor` (in place; reference
+    communication/recv.py)."""
+    return _p2p(tensor, src, group, "recv")
 
 
 class P2POp:
@@ -222,27 +295,31 @@ class P2POp:
         self.group = group
 
 
+class _P2PTask:
+    """Completed-on-issue task handle (XLA collectives are scheduled by the
+    compiler; there is no NCCL-like async stream to wait on)."""
+
+    def __init__(self, result=None):
+        self.result = result
+
+    def wait(self):
+        return self.result
+
+    def is_completed(self):
+        return True
+
+
 def isend(tensor, dst=0, group=None):
-    send(tensor, dst, group)
-    class _Task:
-        def wait(self):
-            pass
-    return _Task()
+    return _P2PTask(send(tensor, dst, group))
 
 
 def irecv(tensor, src=0, group=None):
-    recv(tensor, src, group)
-    class _Task:
-        def wait(self):
-            pass
-    return _Task()
+    return _P2PTask(recv(tensor, src, group))
 
 
 def batch_isend_irecv(p2p_op_list):
-    tasks = []
-    for op in p2p_op_list:
-        tasks.append(op.op(op.tensor, op.peer, op.group))
-    return tasks
+    return [_P2PTask(op.op(op.tensor, op.peer, op.group))
+            for op in p2p_op_list]
 
 
 def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):

