@@ -67,6 +67,9 @@ from . import text  # noqa: F401
 from . import audio  # noqa: F401
 from . import fft  # noqa: F401
 from . import signal  # noqa: F401
+from . import distribution  # noqa: F401
+from . import geometric  # noqa: F401
+from . import regularizer  # noqa: F401
 from . import onnx  # noqa: F401
 from . import quantization  # noqa: F401
 from . import version  # noqa: F401

@@ -4,7 +4,8 @@ from __future__ import annotations
 from .optimizer import Optimizer
 from .optimizers import (SGD, Momentum, Adam, AdamW, Adamax, Adagrad, RMSProp,
                          Adadelta, Lamb, NAdam, RAdam, ASGD, Rprop)
+from .lbfgs import LBFGS
 from . import lr
 
 __all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax", "Adagrad",
-           "RMSProp", "Adadelta", "Lamb", "NAdam", "RAdam", "ASGD", "Rprop", "lr"]
+           "RMSProp", "Adadelta", "Lamb", "NAdam", "RAdam", "ASGD", "Rprop", "LBFGS", "lr"]

@@ -1,0 +1,193 @@
+"""L-BFGS optimizer (reference: python/paddle/optimizer/lbfgs.py — Nocedal &
+Wright Algorithm 7.5 with optional strong-Wolfe line search).
+
+Closure-driven like the reference: `opt.step(closure)` re-evaluates the
+loss/grads as the line search probes points. History and two-loop recursion
+run on flattened f32 vectors (jnp on-device; the control flow is host-side,
+matching the reference's dygraph implementation)."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dispatch import no_grad
+from .optimizer import Optimizer
+
+__all__ = ["LBFGS"]
+
+
+class LBFGS(Optimizer):
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9, history_size=100,
+                 line_search_fn=None, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate=learning_rate, parameters=parameters,
+                         weight_decay=weight_decay, grad_clip=grad_clip,
+                         name=name)
+        if line_search_fn not in (None, "strong_wolfe"):
+            raise ValueError("line_search_fn must be None or 'strong_wolfe'")
+        self._max_iter = max_iter
+        self._max_eval = max_eval if max_eval is not None \
+            else int(max_iter * 1.25)
+        self._tol_grad = tolerance_grad
+        self._tol_change = tolerance_change
+        self._history = history_size
+        self._line_search = line_search_fn
+        self._s, self._y = [], []   # curvature history
+        self._prev_flat_grad = None
+        self._n_evals = 0
+
+    # -- flat <-> param views ----------------------------------------------
+    def _gather_flat(self, attr="_value"):
+        return jnp.concatenate([
+            jnp.ravel(getattr(p, attr)).astype(jnp.float32)
+            for p in self._parameter_list])
+
+    def _flat_grad(self):
+        outs = []
+        for p in self._parameter_list:
+            g = p._grad
+            gv = g._value if isinstance(g, Tensor) else g
+            outs.append(jnp.ravel(
+                gv if gv is not None else jnp.zeros_like(p._value)
+            ).astype(jnp.float32))
+        return jnp.concatenate(outs)
+
+    def _set_flat(self, flat):
+        off = 0
+        for p in self._parameter_list:
+            n = int(np.prod(p._value.shape)) if p._value.shape else 1
+            chunk = flat[off:off + n].reshape(p._value.shape)
+            p._set_value(chunk.astype(p._value.dtype))
+            off += n
+
+    def _eval(self, closure, flat_x):
+        self._set_flat(flat_x)
+        loss = closure()
+        self._n_evals += 1
+        lv = float(loss.numpy() if isinstance(loss, Tensor) else loss)
+        return lv, self._flat_grad()
+
+    # -- two-loop recursion -------------------------------------------------
+    def _direction(self, g):
+        q = -g
+        if not self._s:
+            return q
+        alphas = []
+        for s, y in reversed(list(zip(self._s, self._y))):
+            rho = 1.0 / float(jnp.vdot(y, s))
+            a = rho * float(jnp.vdot(s, q))
+            q = q - a * y
+            alphas.append((a, rho, s, y))
+        s_l, y_l = self._s[-1], self._y[-1]
+        gamma = float(jnp.vdot(s_l, y_l)) / float(jnp.vdot(y_l, y_l))
+        q = q * gamma
+        for a, rho, s, y in reversed(alphas):
+            b = rho * float(jnp.vdot(y, q))
+            q = q + (a - b) * s
+        return q
+
+    def _strong_wolfe(self, closure, x, f0, g0, d, t, c1=1e-4, c2=0.9,
+                      max_ls=25):
+        """Strong-Wolfe line search (reference lbfgs.py _strong_wolfe)."""
+        d_norm = float(jnp.max(jnp.abs(d)))
+        gtd0 = float(jnp.vdot(g0, d))
+        if gtd0 > -1e-16:
+            return f0, g0, 0.0
+        f_prev, g_prev, t_prev = f0, g0, 0.0
+        for i in range(max_ls):
+            f_new, g_new = self._eval(closure, x + t * d)
+            gtd = float(jnp.vdot(g_new, d))
+            if f_new > f0 + c1 * t * gtd0 or (i > 0 and f_new >= f_prev):
+                return self._zoom(closure, x, f0, gtd0, d, t_prev, t, f_prev,
+                                  c1, c2)
+            if abs(gtd) <= -c2 * gtd0:
+                return f_new, g_new, t
+            if gtd >= 0:
+                return self._zoom(closure, x, f0, gtd0, d, t, t_prev, f_new,
+                                  c1, c2)
+            f_prev, g_prev, t_prev = f_new, g_new, t
+            t *= 2.0
+            if t * d_norm > 1e10:
+                break
+        return f_new, g_new, t
+
+    def _zoom(self, closure, x, f0, gtd0, d, lo, hi, f_lo, c1, c2,
+              max_zoom=25):
+        for _ in range(max_zoom):
+            t = 0.5 * (lo + hi)
+            f_new, g_new = self._eval(closure, x + t * d)
+            gtd = float(jnp.vdot(g_new, d))
+            if f_new > f0 + c1 * t * gtd0 or f_new >= f_lo:
+                hi = t
+            else:
+                if abs(gtd) <= -c2 * gtd0:
+                    return f_new, g_new, t
+                if gtd * (hi - lo) >= 0:
+                    hi = lo
+                lo, f_lo = t, f_new
+            if abs(hi - lo) < 1e-9:
+                break
+        return f_new, g_new, t
+
+    # -- the step ------------------------------------------------------------
+    @no_grad()
+    def step(self, closure=None):
+        if closure is None:
+            raise ValueError("LBFGS.step requires a closure that "
+                             "re-evaluates the model and returns the loss")
+        self._n_evals = 0
+        x = self._gather_flat()
+        with_grad_closure = closure
+
+        def eval_closure():
+            for p in self._parameter_list:
+                if hasattr(p, "clear_grad"):
+                    p.clear_grad()
+            from ..core.dispatch import enable_grad
+            with enable_grad():
+                loss = with_grad_closure()
+                # reference lbfgs.py: step() owns the backward; the user
+                # closure just builds the loss
+                if isinstance(loss, Tensor) and not loss.stop_gradient:
+                    loss.backward()
+            return loss
+
+        f, g = self._eval(eval_closure, x)
+        orig_loss = f
+        for _it in range(self._max_iter):
+            if float(jnp.max(jnp.abs(g))) <= self._tol_grad:
+                break
+            if self._n_evals >= self._max_eval:
+                break
+            d = self._direction(g)
+            lr = float(self.get_lr())
+            t = min(1.0, 1.0 / max(float(jnp.sum(jnp.abs(g))), 1e-10)) * lr \
+                if _it == 0 and not self._s else lr
+            g_old = g
+            if self._line_search == "strong_wolfe":
+                f_new, g_new, t = self._strong_wolfe(eval_closure, x, f, g, d,
+                                                     t)
+                if t == 0.0:
+                    break
+                x_new = x + t * d
+            else:
+                x_new = x + t * d
+                f_new, g_new = self._eval(eval_closure, x_new)
+            s = x_new - x
+            y = g_new - g_old
+            if float(jnp.vdot(s, y)) > 1e-10:
+                self._s.append(s)
+                self._y.append(y)
+                if len(self._s) > self._history:
+                    self._s.pop(0)
+                    self._y.pop(0)
+            if abs(f_new - f) < self._tol_change and \
+                    float(jnp.max(jnp.abs(s))) < self._tol_change:
+                x, f, g = x_new, f_new, g_new
+                break
+            x, f, g = x_new, f_new, g_new
+        self._set_flat(x)
+        self._global_step += 1
+        return Tensor(jnp.asarray(orig_loss, jnp.float32))

@@ -99,8 +99,16 @@ class Optimizer:
                 continue
             gv = g._value if isinstance(g, Tensor) else g
             pv = p._value
-            if wd is not None and self._decoupled_wd is False and getattr(p, "regularizer", None) is None:
-                gv = gv + float(wd) * pv
+            # per-parameter regularizer objects (reference regularizer.py via
+            # ParamAttr) override the optimizer-global weight_decay
+            preg = getattr(p, "regularizer", None)
+            if preg is not None and callable(preg):
+                gv = gv + preg(pv)
+            elif wd is not None and self._decoupled_wd is False:
+                if callable(wd):          # L1Decay/L2Decay instance
+                    gv = gv + wd(pv)
+                else:
+                    gv = gv + float(wd) * pv
             state = self._get_state(p)
             plr = lr * p.optimize_attr.get("learning_rate", 1.0) \
                 if hasattr(p, "optimize_attr") else lr
@@ -148,7 +156,8 @@ class Optimizer:
                 new_state[name] = opt_state.get(name, {})
                 continue
             if wd is not None and self._decoupled_wd is False:
-                gv = gv + float(wd) * pv
+                # same L1Decay/L2Decay-object handling as the eager step()
+                gv = gv + (wd(pv) if callable(wd) else float(wd) * pv)
             st = opt_state.get(name)
             if st is None or not st:
                 st = self._init_state(pv)

@@ -1,0 +1,43 @@
+"""Regularizers (reference: python/paddle/regularizer.py — L1Decay/L2Decay
+objects consumed per-parameter via ParamAttr.regularizer or globally via
+Optimizer(weight_decay=...)); applied in Optimizer.step as a gradient
+augmentation, exactly the reference's append_regularization_ops semantics."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["WeightDecayRegularizer", "L1Decay", "L2Decay"]
+
+
+class WeightDecayRegularizer:
+    """Base class (reference regularizer.py:25)."""
+
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+    @property
+    def coeff(self):
+        return self._coeff
+
+    def __call__(self, param_value):
+        """Return d(penalty)/d(param) to add to the gradient."""
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"{type(self).__name__}(coeff={self._coeff})"
+
+
+class L1Decay(WeightDecayRegularizer):
+    """penalty = coeff * sum |w|  ->  grad += coeff * sign(w)
+    (reference regularizer.py:60)."""
+
+    def __call__(self, param_value):
+        return self._coeff * jnp.sign(param_value)
+
+
+class L2Decay(WeightDecayRegularizer):
+    """penalty = 0.5 * coeff * sum w^2  ->  grad += coeff * w
+    (reference regularizer.py:141)."""
+
+    def __call__(self, param_value):
+        return self._coeff * param_value

@@ -2,3 +2,10 @@ from .resnet import (ResNet, BasicBlock, BottleneckBlock, resnet18, resnet34,  #
                      resnet50, resnet101, resnet152, wide_resnet50_2,
                      wide_resnet101_2, resnext50_32x4d, resnext101_32x4d)
 from .vit import VisionTransformer, vit_b_16, vit_l_16  # noqa: F401
+from .mobilenet import (MobileNetV1, MobileNetV2, MobileNetV3Small,  # noqa: F401
+                        MobileNetV3Large, mobilenet_v1, mobilenet_v2,
+                        mobilenet_v3_small, mobilenet_v3_large)
+from .densenet import (DenseNet, densenet121, densenet161, densenet169,  # noqa: F401
+                       densenet201, densenet264, SqueezeNet, squeezenet1_0,
+                       squeezenet1_1, ShuffleNetV2, shufflenet_v2_x1_0,
+                       AlexNet, alexnet, VGG, vgg11, vgg13, vgg16, vgg19)

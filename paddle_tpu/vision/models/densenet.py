@@ -1,0 +1,363 @@
+"""DenseNet + SqueezeNet + ShuffleNetV2 + AlexNet + VGG (reference:
+python/paddle/vision/models/{densenet,squeezenet,shufflenetv2,alexnet,
+vgg}.py — standard architectures, original jax-backed Layer bodies)."""
+from __future__ import annotations
+
+from ...nn.layer import Layer
+from ...nn import (Conv2D, BatchNorm2D, ReLU, MaxPool2D, AvgPool2D,
+                   AdaptiveAvgPool2D, Linear, Sequential, Dropout)
+from ...tensor import manipulation as manip
+
+__all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
+           "densenet201", "densenet264", "SqueezeNet", "squeezenet1_0",
+           "squeezenet1_1", "ShuffleNetV2", "shufflenet_v2_x1_0",
+           "AlexNet", "alexnet", "VGG", "vgg11", "vgg13", "vgg16", "vgg19"]
+
+
+# ---------------------------------------------------------------------------
+# DenseNet (reference densenet.py)
+# ---------------------------------------------------------------------------
+class _DenseLayer(Layer):
+    def __init__(self, cin, growth_rate, bn_size, dropout):
+        super().__init__()
+        self.bn1 = BatchNorm2D(cin)
+        self.relu = ReLU()
+        self.conv1 = Conv2D(cin, bn_size * growth_rate, 1, bias_attr=False)
+        self.bn2 = BatchNorm2D(bn_size * growth_rate)
+        self.conv2 = Conv2D(bn_size * growth_rate, growth_rate, 3, padding=1,
+                            bias_attr=False)
+        self.dropout = Dropout(dropout) if dropout else None
+
+    def forward(self, x):
+        out = self.conv1(self.relu(self.bn1(x)))
+        out = self.conv2(self.relu(self.bn2(out)))
+        if self.dropout is not None:
+            out = self.dropout(out)
+        return manip.concat([x, out], axis=1)
+
+
+class _Transition(Layer):
+    def __init__(self, cin, cout):
+        super().__init__()
+        self.bn = BatchNorm2D(cin)
+        self.relu = ReLU()
+        self.conv = Conv2D(cin, cout, 1, bias_attr=False)
+        self.pool = AvgPool2D(2, stride=2)
+
+    def forward(self, x):
+        return self.pool(self.conv(self.relu(self.bn(x))))
+
+
+_DENSE_CFG = {121: (6, 12, 24, 16), 161: (6, 12, 36, 24),
+              169: (6, 12, 32, 32), 201: (6, 12, 48, 32),
+              264: (6, 12, 64, 48)}
+
+
+class DenseNet(Layer):
+    """reference densenet.py:208 DenseNet."""
+
+    def __init__(self, layers=121, bn_size=4, dropout=0.0, num_classes=1000,
+                 with_pool=True, growth_rate=None):
+        super().__init__()
+        if layers not in _DENSE_CFG:
+            raise ValueError(f"supported layers: {sorted(_DENSE_CFG)}")
+        block_cfg = _DENSE_CFG[layers]
+        growth = growth_rate or (48 if layers == 161 else 32)
+        init_ch = 2 * growth
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = Sequential(
+            Conv2D(3, init_ch, 7, stride=2, padding=3, bias_attr=False),
+            BatchNorm2D(init_ch), ReLU(), MaxPool2D(3, stride=2, padding=1))
+        blocks = []
+        ch = init_ch
+        for bi, n in enumerate(block_cfg):
+            for _ in range(n):
+                blocks.append(_DenseLayer(ch, growth, bn_size, dropout))
+                ch += growth
+            if bi != len(block_cfg) - 1:
+                blocks.append(_Transition(ch, ch // 2))
+                ch //= 2
+        self.features = Sequential(*blocks)
+        self.bn_final = BatchNorm2D(ch)
+        self.relu = ReLU()
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = Linear(ch, num_classes)
+
+    def forward(self, x):
+        x = self.relu(self.bn_final(self.features(self.stem(x))))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = manip.reshape(x, [x.shape[0], -1])
+            x = self.fc(x)
+        return x
+
+
+def _dn(layers):
+    def fn(pretrained=False, **kwargs):
+        if pretrained:
+            raise NotImplementedError("pretrained weights are not bundled")
+        return DenseNet(layers=layers, **kwargs)
+    fn.__name__ = f"densenet{layers}"
+    return fn
+
+
+densenet121 = _dn(121)
+densenet161 = _dn(161)
+densenet169 = _dn(169)
+densenet201 = _dn(201)
+densenet264 = _dn(264)
+
+
+# ---------------------------------------------------------------------------
+# SqueezeNet (reference squeezenet.py)
+# ---------------------------------------------------------------------------
+class _Fire(Layer):
+    def __init__(self, cin, squeeze, e1, e3):
+        super().__init__()
+        self.squeeze = Conv2D(cin, squeeze, 1)
+        self.relu = ReLU()
+        self.e1 = Conv2D(squeeze, e1, 1)
+        self.e3 = Conv2D(squeeze, e3, 3, padding=1)
+
+    def forward(self, x):
+        s = self.relu(self.squeeze(x))
+        return manip.concat([self.relu(self.e1(s)), self.relu(self.e3(s))],
+                            axis=1)
+
+
+class SqueezeNet(Layer):
+    """reference squeezenet.py:91."""
+
+    def __init__(self, version="1.0", num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        if version == "1.0":
+            feats = [Conv2D(3, 96, 7, stride=2), ReLU(),
+                     MaxPool2D(3, stride=2),
+                     _Fire(96, 16, 64, 64), _Fire(128, 16, 64, 64),
+                     _Fire(128, 32, 128, 128), MaxPool2D(3, stride=2),
+                     _Fire(256, 32, 128, 128), _Fire(256, 48, 192, 192),
+                     _Fire(384, 48, 192, 192), _Fire(384, 64, 256, 256),
+                     MaxPool2D(3, stride=2), _Fire(512, 64, 256, 256)]
+        elif version == "1.1":
+            feats = [Conv2D(3, 64, 3, stride=2), ReLU(),
+                     MaxPool2D(3, stride=2),
+                     _Fire(64, 16, 64, 64), _Fire(128, 16, 64, 64),
+                     MaxPool2D(3, stride=2),
+                     _Fire(128, 32, 128, 128), _Fire(256, 32, 128, 128),
+                     MaxPool2D(3, stride=2),
+                     _Fire(256, 48, 192, 192), _Fire(384, 48, 192, 192),
+                     _Fire(384, 64, 256, 256), _Fire(512, 64, 256, 256)]
+        else:
+            raise ValueError("version must be '1.0' or '1.1'")
+        self.features = Sequential(*feats)
+        self.classifier = Sequential(Dropout(0.5),
+                                     Conv2D(512, num_classes, 1), ReLU(),
+                                     AdaptiveAvgPool2D(1))
+
+    def forward(self, x):
+        x = self.classifier(self.features(x))
+        return manip.reshape(x, [x.shape[0], -1])
+
+
+def squeezenet1_0(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights are not bundled")
+    return SqueezeNet("1.0", **kwargs)
+
+
+def squeezenet1_1(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights are not bundled")
+    return SqueezeNet("1.1", **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# ShuffleNetV2 (reference shufflenetv2.py)
+# ---------------------------------------------------------------------------
+def _channel_shuffle(x, groups):
+    n, c, h, w = x.shape
+    x = manip.reshape(x, [n, groups, c // groups, h, w])
+    x = manip.transpose(x, [0, 2, 1, 3, 4])
+    return manip.reshape(x, [n, c, h, w])
+
+
+class _ShuffleUnit(Layer):
+    def __init__(self, cin, cout, stride):
+        super().__init__()
+        self.stride = stride
+        branch = cout // 2
+        if stride == 1:
+            self.branch2 = Sequential(
+                Conv2D(cin // 2, branch, 1, bias_attr=False),
+                BatchNorm2D(branch), ReLU(),
+                Conv2D(branch, branch, 3, stride=1, padding=1, groups=branch,
+                       bias_attr=False), BatchNorm2D(branch),
+                Conv2D(branch, branch, 1, bias_attr=False),
+                BatchNorm2D(branch), ReLU())
+            self.branch1 = None
+        else:
+            self.branch1 = Sequential(
+                Conv2D(cin, cin, 3, stride=stride, padding=1, groups=cin,
+                       bias_attr=False), BatchNorm2D(cin),
+                Conv2D(cin, branch, 1, bias_attr=False), BatchNorm2D(branch),
+                ReLU())
+            self.branch2 = Sequential(
+                Conv2D(cin, branch, 1, bias_attr=False), BatchNorm2D(branch),
+                ReLU(),
+                Conv2D(branch, branch, 3, stride=stride, padding=1,
+                       groups=branch, bias_attr=False), BatchNorm2D(branch),
+                Conv2D(branch, branch, 1, bias_attr=False),
+                BatchNorm2D(branch), ReLU())
+
+    def forward(self, x):
+        if self.stride == 1:
+            c = x.shape[1] // 2
+            x1 = x[:, :c]
+            x2 = x[:, c:]
+            out = manip.concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = manip.concat([self.branch1(x), self.branch2(x)], axis=1)
+        return _channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(Layer):
+    """reference shufflenetv2.py:31."""
+
+    _CFG = {0.5: (48, 96, 192, 1024), 1.0: (116, 232, 464, 1024),
+            1.5: (176, 352, 704, 1024), 2.0: (244, 488, 976, 2048)}
+
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        c1, c2, c3, cout = self._CFG[scale]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = Sequential(Conv2D(3, 24, 3, stride=2, padding=1,
+                                      bias_attr=False),
+                               BatchNorm2D(24), ReLU(),
+                               MaxPool2D(3, stride=2, padding=1))
+        stages = []
+        cin = 24
+        for cmid, reps in ((c1, 4), (c2, 8), (c3, 4)):
+            stages.append(_ShuffleUnit(cin, cmid, 2))
+            for _ in range(reps - 1):
+                stages.append(_ShuffleUnit(cmid, cmid, 1))
+            cin = cmid
+        self.stages = Sequential(*stages)
+        self.conv_last = Sequential(Conv2D(cin, cout, 1, bias_attr=False),
+                                    BatchNorm2D(cout), ReLU())
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = Linear(cout, num_classes)
+
+    def forward(self, x):
+        x = self.conv_last(self.stages(self.stem(x)))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = manip.reshape(x, [x.shape[0], -1])
+            x = self.fc(x)
+        return x
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights are not bundled")
+    return ShuffleNetV2(scale=1.0, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# AlexNet + VGG (reference alexnet.py, vgg.py)
+# ---------------------------------------------------------------------------
+class AlexNet(Layer):
+    """reference alexnet.py:46."""
+
+    def __init__(self, num_classes=1000):
+        super().__init__()
+        self.num_classes = num_classes
+        self.features = Sequential(
+            Conv2D(3, 64, 11, stride=4, padding=2), ReLU(),
+            MaxPool2D(3, stride=2),
+            Conv2D(64, 192, 5, padding=2), ReLU(), MaxPool2D(3, stride=2),
+            Conv2D(192, 384, 3, padding=1), ReLU(),
+            Conv2D(384, 256, 3, padding=1), ReLU(),
+            Conv2D(256, 256, 3, padding=1), ReLU(), MaxPool2D(3, stride=2))
+        self.pool = AdaptiveAvgPool2D(6)
+        self.classifier = Sequential(
+            Dropout(0.5), Linear(256 * 36, 4096), ReLU(),
+            Dropout(0.5), Linear(4096, 4096), ReLU(),
+            Linear(4096, num_classes))
+
+    def forward(self, x):
+        x = self.pool(self.features(x))
+        x = manip.reshape(x, [x.shape[0], -1])
+        return self.classifier(x)
+
+
+def alexnet(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights are not bundled")
+    return AlexNet(**kwargs)
+
+
+_VGG_CFG = {
+    11: (64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"),
+    13: (64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M",
+         512, 512, "M"),
+    16: (64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512, "M",
+         512, 512, 512, "M"),
+    19: (64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+         512, 512, 512, 512, "M", 512, 512, 512, 512, "M"),
+}
+
+
+class VGG(Layer):
+    """reference vgg.py:36."""
+
+    def __init__(self, layers=16, batch_norm=False, num_classes=1000):
+        super().__init__()
+        self.num_classes = num_classes
+        feats = []
+        cin = 3
+        for v in _VGG_CFG[layers]:
+            if v == "M":
+                feats.append(MaxPool2D(2, stride=2))
+            else:
+                feats.append(Conv2D(cin, v, 3, padding=1))
+                if batch_norm:
+                    feats.append(BatchNorm2D(v))
+                feats.append(ReLU())
+                cin = v
+        self.features = Sequential(*feats)
+        self.pool = AdaptiveAvgPool2D(7)
+        self.classifier = Sequential(
+            Linear(512 * 49, 4096), ReLU(), Dropout(0.5),
+            Linear(4096, 4096), ReLU(), Dropout(0.5),
+            Linear(4096, num_classes))
+
+    def forward(self, x):
+        x = self.pool(self.features(x))
+        x = manip.reshape(x, [x.shape[0], -1])
+        return self.classifier(x)
+
+
+def _vgg(layers):
+    def fn(pretrained=False, batch_norm=False, **kwargs):
+        if pretrained:
+            raise NotImplementedError("pretrained weights are not bundled")
+        return VGG(layers=layers, batch_norm=batch_norm, **kwargs)
+    fn.__name__ = f"vgg{layers}"
+    return fn
+
+
+vgg11 = _vgg(11)
+vgg13 = _vgg(13)
+vgg16 = _vgg(16)
+vgg19 = _vgg(19)

@@ -1,0 +1,246 @@
+"""MobileNet V1/V2/V3 (reference: python/paddle/vision/models/
+{mobilenetv1,mobilenetv2,mobilenetv3}.py — standard architectures; bodies
+are original jax-backed Layer code)."""
+from __future__ import annotations
+
+from ...nn.layer import Layer
+from ...nn import (Conv2D, BatchNorm2D, ReLU, ReLU6, Hardswish, Hardsigmoid,
+                   Linear, Sequential, AdaptiveAvgPool2D, Dropout, Flatten)
+from ...tensor import manipulation as manip
+
+__all__ = ["MobileNetV1", "MobileNetV2", "MobileNetV3Small",
+           "MobileNetV3Large", "mobilenet_v1", "mobilenet_v2",
+           "mobilenet_v3_small", "mobilenet_v3_large"]
+
+
+def _make_divisible(v, divisor=8, min_value=None):
+    min_value = min_value or divisor
+    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+def _conv_bn(cin, cout, k, stride=1, groups=1, act=ReLU):
+    pad = (k - 1) // 2
+    layers = [Conv2D(cin, cout, k, stride=stride, padding=pad, groups=groups,
+                     bias_attr=False), BatchNorm2D(cout)]
+    if act is not None:
+        layers.append(act())
+    return Sequential(*layers)
+
+
+class MobileNetV1(Layer):
+    """reference mobilenetv1.py: depthwise-separable stacks."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        def dw_sep(cin, cout, stride):
+            return Sequential(
+                _conv_bn(cin, cin, 3, stride=stride, groups=cin),
+                _conv_bn(cin, cout, 1))
+        s = lambda c: int(c * scale)
+        cfg = [(s(32), s(64), 1), (s(64), s(128), 2), (s(128), s(128), 1),
+               (s(128), s(256), 2), (s(256), s(256), 1), (s(256), s(512), 2)] \
+            + [(s(512), s(512), 1)] * 5 + [(s(512), s(1024), 2),
+                                           (s(1024), s(1024), 1)]
+        blocks = [_conv_bn(3, s(32), 3, stride=2)]
+        blocks += [dw_sep(a, b, st) for a, b, st in cfg]
+        self.features = Sequential(*blocks)
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = Linear(s(1024), num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = manip.reshape(x, [x.shape[0], -1])
+            x = self.fc(x)
+        return x
+
+
+class InvertedResidual(Layer):
+    """V2 block (reference mobilenetv2.py:30)."""
+
+    def __init__(self, cin, cout, stride, expand_ratio):
+        super().__init__()
+        hidden = int(round(cin * expand_ratio))
+        self.use_res = stride == 1 and cin == cout
+        layers = []
+        if expand_ratio != 1:
+            layers.append(_conv_bn(cin, hidden, 1, act=ReLU6))
+        layers += [_conv_bn(hidden, hidden, 3, stride=stride, groups=hidden,
+                            act=ReLU6),
+                   _conv_bn(hidden, cout, 1, act=None)]
+        self.conv = Sequential(*layers)
+
+    def forward(self, x):
+        out = self.conv(x)
+        return x + out if self.use_res else out
+
+
+class MobileNetV2(Layer):
+    """reference mobilenetv2.py:84."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        cfg = [(1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+               (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1)]
+        cin = _make_divisible(32 * scale)
+        last = _make_divisible(1280 * max(1.0, scale))
+        feats = [_conv_bn(3, cin, 3, stride=2, act=ReLU6)]
+        for t, c, n, s in cfg:
+            cout = _make_divisible(c * scale)
+            for i in range(n):
+                feats.append(InvertedResidual(cin, cout,
+                                              s if i == 0 else 1, t))
+                cin = cout
+        feats.append(_conv_bn(cin, last, 1, act=ReLU6))
+        self.features = Sequential(*feats)
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = Sequential(Dropout(0.2), Linear(last, num_classes))
+        self._last = last
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = manip.reshape(x, [x.shape[0], -1])
+            x = self.classifier(x)
+        return x
+
+
+class SqueezeExcitation(Layer):
+    def __init__(self, channels, squeeze):
+        super().__init__()
+        self.avg = AdaptiveAvgPool2D(1)
+        self.fc1 = Conv2D(channels, squeeze, 1)
+        self.relu = ReLU()
+        self.fc2 = Conv2D(squeeze, channels, 1)
+        self.hsig = Hardsigmoid()
+
+    def forward(self, x):
+        s = self.hsig(self.fc2(self.relu(self.fc1(self.avg(x)))))
+        return x * s
+
+
+class _V3Block(Layer):
+    def __init__(self, cin, hidden, cout, k, stride, use_se, act):
+        super().__init__()
+        self.use_res = stride == 1 and cin == cout
+        layers = []
+        if hidden != cin:
+            layers.append(_conv_bn(cin, hidden, 1, act=act))
+        layers.append(_conv_bn(hidden, hidden, k, stride=stride,
+                               groups=hidden, act=act))
+        if use_se:
+            layers.append(SqueezeExcitation(hidden,
+                                            _make_divisible(hidden // 4)))
+        layers.append(_conv_bn(hidden, cout, 1, act=None))
+        self.block = Sequential(*layers)
+
+    def forward(self, x):
+        out = self.block(x)
+        return x + out if self.use_res else out
+
+
+_V3_LARGE = [
+    # k, exp, out, se, act, stride
+    (3, 16, 16, False, ReLU, 1), (3, 64, 24, False, ReLU, 2),
+    (3, 72, 24, False, ReLU, 1), (5, 72, 40, True, ReLU, 2),
+    (5, 120, 40, True, ReLU, 1), (5, 120, 40, True, ReLU, 1),
+    (3, 240, 80, False, Hardswish, 2), (3, 200, 80, False, Hardswish, 1),
+    (3, 184, 80, False, Hardswish, 1), (3, 184, 80, False, Hardswish, 1),
+    (3, 480, 112, True, Hardswish, 1), (3, 672, 112, True, Hardswish, 1),
+    (5, 672, 160, True, Hardswish, 2), (5, 960, 160, True, Hardswish, 1),
+    (5, 960, 160, True, Hardswish, 1),
+]
+_V3_SMALL = [
+    (3, 16, 16, True, ReLU, 2), (3, 72, 24, False, ReLU, 2),
+    (3, 88, 24, False, ReLU, 1), (5, 96, 40, True, Hardswish, 2),
+    (5, 240, 40, True, Hardswish, 1), (5, 240, 40, True, Hardswish, 1),
+    (5, 120, 48, True, Hardswish, 1), (5, 144, 48, True, Hardswish, 1),
+    (5, 288, 96, True, Hardswish, 2), (5, 576, 96, True, Hardswish, 1),
+    (5, 576, 96, True, Hardswish, 1),
+]
+
+
+class _MobileNetV3(Layer):
+    """reference mobilenetv3.py:129 MobileNetV3."""
+
+    def __init__(self, cfg, last_exp, scale=1.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        cin = _make_divisible(16 * scale)
+        feats = [_conv_bn(3, cin, 3, stride=2, act=Hardswish)]
+        for k, exp, out, se, act, stride in cfg:
+            hidden = _make_divisible(exp * scale)
+            cout = _make_divisible(out * scale)
+            feats.append(_V3Block(cin, hidden, cout, k, stride, se, act))
+            cin = cout
+        lastconv = _make_divisible(last_exp * scale)
+        feats.append(_conv_bn(cin, lastconv, 1, act=Hardswish))
+        self.features = Sequential(*feats)
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            head = 1280 if last_exp == 960 else 1024
+            self.classifier = Sequential(
+                Linear(lastconv, head), Hardswish(), Dropout(0.2),
+                Linear(head, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = manip.reshape(x, [x.shape[0], -1])
+            x = self.classifier(x)
+        return x
+
+
+class MobileNetV3Large(_MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_V3_LARGE, 960, scale, num_classes, with_pool)
+
+
+class MobileNetV3Small(_MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_V3_SMALL, 576, scale, num_classes, with_pool)
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights are not bundled")
+    return MobileNetV1(scale=scale, **kwargs)
+
+
+def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights are not bundled")
+    return MobileNetV2(scale=scale, **kwargs)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights are not bundled")
+    return MobileNetV3Small(scale=scale, **kwargs)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights are not bundled")
+    return MobileNetV3Large(scale=scale, **kwargs)

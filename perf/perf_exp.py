@@ -1,0 +1,169 @@
+"""Round-4 perf experiments on the headline 271M config.
+
+Variants (run sequentially, each timed like bench.py's scaffold):
+  A  baseline: scan over stacked blocks + full-block remat (current bench)
+  B  unrolled python loop over blocks + per-block remat
+  C  unrolled + NO remat
+  D  unrolled + NO remat + chunked-CE head (online-logsumexp over vocab chunks)
+  E  scan + remat + chunked-CE head
+  F  unrolled + remat every 2nd block
+"""
+import json
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.models.llama import LlamaConfig, build_functional_llama
+from paddle_tpu.parallel.pipeline import _flatten, _unflatten
+from paddle_tpu import optimizer
+
+cfg = LlamaConfig(vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+                  num_hidden_layers=16, num_attention_heads=16,
+                  num_key_value_heads=16, max_position_embeddings=2048)
+B, S = 8, 2048
+dtype = jnp.bfloat16
+L = cfg.num_hidden_layers
+H = cfg.hidden_size
+V = cfg.vocab_size
+
+ep, bp, hp, ea, ba, hl = build_functional_llama(cfg, dtype=dtype, n_micro=1)
+opt = optimizer.AdamW(learning_rate=1e-4, parameters=[])
+rng = np.random.default_rng(0)
+ids = jnp.asarray(rng.integers(0, V, (B, S)).astype(np.int32))
+batch = (ids, ids)
+lr = jnp.asarray(1e-4, jnp.float32)
+
+EPS = cfg.rms_norm_eps
+
+
+def rms_ref(x, w):
+    xf = x.astype(jnp.float32)
+    y = xf * jax.lax.rsqrt(jnp.mean(xf * xf, -1, keepdims=True) + EPS)
+    return (y * w.astype(jnp.float32)).astype(x.dtype)
+
+
+def chunked_ce_head(p, y, batch, n_chunks=8):
+    """Head loss without materializing [B,S,V] logits: online logsumexp over
+    vocab chunks; per-chunk body rematted so bwd recomputes chunk logits."""
+    _, labels = batch
+    hn = rms_ref(y[0], p["ln_f"])
+    x = hn.reshape(-1, H)                      # [T, H] bf16
+    lab = labels.reshape(-1).astype(jnp.int32)  # [T]
+    T = x.shape[0]
+    C = V // n_chunks
+    Wc = jnp.swapaxes(p["lm"].reshape(H, n_chunks, C), 0, 1)  # [n, H, C]
+
+    @jax.checkpoint
+    def body(carry, xs):
+        m, s, ll = carry
+        w, base = xs
+        logits = jax.lax.dot_general(
+            x, w, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [T, C] f32
+        m_new = jnp.maximum(m, logits.max(-1))
+        s = s * jnp.exp(m - m_new) + jnp.exp(
+            logits - m_new[:, None]).sum(-1)
+        rel = lab - base
+        inside = (rel >= 0) & (rel < C)
+        picked = jnp.take_along_axis(
+            logits, jnp.clip(rel, 0, C - 1)[:, None], -1)[:, 0]
+        ll = jnp.where(inside, picked, ll)
+        return (m_new, s, ll), None
+
+    carry = (jnp.full((T,), -jnp.inf, jnp.float32),
+             jnp.zeros((T,), jnp.float32),
+             jnp.zeros((T,), jnp.float32))
+    bases = jnp.arange(n_chunks, dtype=jnp.int32) * C
+    (m, s, ll), _ = jax.lax.scan(body, carry, (Wc, bases))
+    lse = m + jnp.log(s)
+    return jnp.mean(lse - ll)
+
+
+def make_loss(variant):
+    ba_ckpt = jax.checkpoint(ba)
+    head = chunked_ce_head if variant in ("D", "E") else \
+        (lambda p, y, b: hl(p, y, b))
+
+    if variant in ("A", "E"):
+        def loss_fn(ep_, bp_, hp_, batch):
+            x = ea(ep_, batch)[0]
+            def body(a, lp):
+                return ba_ckpt(lp, a), None
+            x, _ = jax.lax.scan(body, x, bp_)
+            return head(hp_, x[None], batch)
+    elif variant == "B":
+        def loss_fn(ep_, bp_, hp_, batch):
+            x = ea(ep_, batch)[0]
+            for i in range(L):
+                lp = jax.tree_util.tree_map(lambda v: v[i], bp_)
+                x = ba_ckpt(lp, x)
+            return head(hp_, x[None], batch)
+    elif variant in ("C", "D"):
+        def loss_fn(ep_, bp_, hp_, batch):
+            x = ea(ep_, batch)[0]
+            for i in range(L):
+                lp = jax.tree_util.tree_map(lambda v: v[i], bp_)
+                x = ba(lp, x)
+            return head(hp_, x[None], batch)
+    elif variant == "F":
+        def pair(lp2, x):
+            for i in range(2):
+                x = ba(jax.tree_util.tree_map(lambda v: v[i], lp2), x)
+            return x
+        pair_ckpt = jax.checkpoint(pair)
+        def loss_fn(ep_, bp_, hp_, batch):
+            x = ea(ep_, batch)[0]
+            for i in range(0, L, 2):
+                lp2 = jax.tree_util.tree_map(lambda v: v[i:i + 2], bp_)
+                x = pair_ckpt(lp2, x)
+            return head(hp_, x[None], batch)
+    else:
+        raise ValueError(variant)
+    return loss_fn
+
+
+def run(variant, steps=10, warmup=2):
+    loss_fn = make_loss(variant)
+    eo = opt.init_opt_state(_flatten(ep))
+    bo = opt.init_opt_state(_flatten(bp))
+    ho = opt.init_opt_state(_flatten(hp))
+
+    def step(ep_, bp_, hp_, eo, bo, ho, batch):
+        loss, (ge, gb, gh) = jax.value_and_grad(loss_fn, argnums=(0, 1, 2))(
+            ep_, bp_, hp_, batch)
+        ne, neo = opt.apply_gradients_functional(_flatten(ep_), _flatten(ge), eo, lr=lr)
+        nb, nbo = opt.apply_gradients_functional(_flatten(bp_), _flatten(gb), bo, lr=lr)
+        nh, nho = opt.apply_gradients_functional(_flatten(hp_), _flatten(gh), ho, lr=lr)
+        return (_unflatten(ne, ep_), _unflatten(nb, bp_), _unflatten(nh, hp_),
+                neo, nbo, nho, loss)
+
+    stepj = jax.jit(step, donate_argnums=(3, 4, 5))
+    e2, b2, h2 = ep, bp, hp
+    t_c0 = time.perf_counter()
+    for _ in range(warmup):
+        e2, b2, h2, eo, bo, ho, loss = stepj(e2, b2, h2, eo, bo, ho, batch)
+    jax.block_until_ready(loss)
+    compile_s = time.perf_counter() - t_c0
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        e2, b2, h2, eo, bo, ho, loss = stepj(e2, b2, h2, eo, bo, ho, batch)
+    jax.block_until_ready(loss)
+    dt = (time.perf_counter() - t0) / steps
+    print(json.dumps({"variant": variant, "ms": round(dt * 1e3, 2),
+                      "tok_s": round(B * S / dt, 1),
+                      "loss": round(float(loss), 4),
+                      "compile_s": round(compile_s, 1)}), flush=True)
+
+
+variants = sys.argv[1] if len(sys.argv) > 1 else "AEBFCD"
+for v in variants:
+    try:
+        run(v)
+    except Exception as e:
+        print(json.dumps({"variant": v,
+                          "error": f"{type(e).__name__}: {e}"[:300]}),
+              flush=True)
+    jax.clear_caches()

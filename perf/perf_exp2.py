@@ -1,0 +1,176 @@
+"""Round-4 perf experiments, set 2: kernel ablations + FA block sizes +
+partial-remat memory ladder.
+
+  G   scan+remat, Pallas rms_norm DISABLED (jnp rms_norm_ref)
+  H   scan+remat, Pallas adamw_fused DISABLED
+  J1  FA block sizes (1024, 512)     J2 (512, 1024)     J3 (1024, 1024)
+  K   unrolled + chunked-CE + donate params too
+  D2  no-remat + chunked-CE + donate all (OOM probe)
+  P8  remat first 8 blocks only, plain last 8, chunked CE, donate all
+  P12 remat first 12, plain last 4
+"""
+import json
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu  # registers kernels
+from paddle_tpu.core.dispatch import _KERNELS
+from paddle_tpu.models.llama import LlamaConfig, build_functional_llama
+from paddle_tpu.parallel.pipeline import _flatten, _unflatten
+from paddle_tpu import optimizer
+import importlib
+fa_mod = importlib.import_module("paddle_tpu.ops.pallas.flash_attention")
+
+cfg = LlamaConfig(vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+                  num_hidden_layers=16, num_attention_heads=16,
+                  num_key_value_heads=16, max_position_embeddings=2048)
+B, S = 8, 2048
+dtype = jnp.bfloat16
+L, H, V = cfg.num_hidden_layers, cfg.hidden_size, cfg.vocab_size
+
+ep, bp, hp, ea, ba, hl = build_functional_llama(cfg, dtype=dtype, n_micro=1)
+opt = optimizer.AdamW(learning_rate=1e-4, parameters=[])
+rng = np.random.default_rng(0)
+ids = jnp.asarray(rng.integers(0, V, (B, S)).astype(np.int32))
+batch = (ids, ids)
+lr = jnp.asarray(1e-4, jnp.float32)
+EPS = cfg.rms_norm_eps
+
+
+def chunked_ce_head(p, y, batch, n_chunks=8):
+    _, labels = batch
+    from paddle_tpu.nn.functional.norm import rms_norm_ref
+    hn = rms_norm_ref(y[0], p["ln_f"], EPS)
+    x = hn.reshape(-1, H)
+    lab = labels.reshape(-1).astype(jnp.int32)
+    T = x.shape[0]
+    C = V // n_chunks
+    Wc = jnp.swapaxes(p["lm"].reshape(H, n_chunks, C), 0, 1)
+
+    @jax.checkpoint
+    def body(carry, xs):
+        m, s, ll = carry
+        w, base = xs
+        logits = jax.lax.dot_general(x, w, (((1,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+        m_new = jnp.maximum(m, logits.max(-1))
+        s = s * jnp.exp(m - m_new) + jnp.exp(logits - m_new[:, None]).sum(-1)
+        rel = lab - base
+        inside = (rel >= 0) & (rel < C)
+        picked = jnp.take_along_axis(
+            logits, jnp.clip(rel, 0, C - 1)[:, None], -1)[:, 0]
+        ll = jnp.where(inside, picked, ll)
+        return (m_new, s, ll), None
+
+    carry = (jnp.full((T,), -jnp.inf, jnp.float32),
+             jnp.zeros((T,), jnp.float32), jnp.zeros((T,), jnp.float32))
+    bases = jnp.arange(n_chunks, dtype=jnp.int32) * C
+    (m, s, ll), _ = jax.lax.scan(body, carry, (Wc, bases))
+    return jnp.mean(m + jnp.log(s) - ll)
+
+
+def make_loss(variant):
+    ba_ckpt = jax.checkpoint(ba)
+    if variant in ("G", "H") or variant.startswith("J"):
+        def loss_fn(ep_, bp_, hp_, batch):
+            x = ea(ep_, batch)[0]
+            def body(a, lp):
+                return ba_ckpt(lp, a), None
+            x, _ = jax.lax.scan(body, x, bp_)
+            return hl(hp_, x[None], batch)
+    elif variant == "K":
+        def loss_fn(ep_, bp_, hp_, batch):
+            x = ea(ep_, batch)[0]
+            for i in range(L):
+                x = ba_ckpt(jax.tree_util.tree_map(lambda v: v[i], bp_), x)
+            return chunked_ce_head(hp_, x[None], batch)
+    elif variant == "D2":
+        def loss_fn(ep_, bp_, hp_, batch):
+            x = ea(ep_, batch)[0]
+            for i in range(L):
+                x = ba(jax.tree_util.tree_map(lambda v: v[i], bp_), x)
+            return chunked_ce_head(hp_, x[None], batch)
+    elif variant.startswith("P"):
+        k = int(variant[1:])
+        def loss_fn(ep_, bp_, hp_, batch):
+            x = ea(ep_, batch)[0]
+            for i in range(L):
+                lp = jax.tree_util.tree_map(lambda v: v[i], bp_)
+                x = ba_ckpt(lp, x) if i < k else ba(lp, x)
+            return chunked_ce_head(hp_, x[None], batch)
+    else:
+        raise ValueError(variant)
+    return loss_fn
+
+
+def run(variant, steps=10, warmup=2):
+    saved = {}
+    if variant == "G":
+        saved["rms_norm"] = _KERNELS.pop("rms_norm", None)
+    if variant == "H":
+        saved["adamw_fused"] = _KERNELS.pop("adamw_fused", None)
+    orig_bs = fa_mod._block_sizes
+    if variant == "J1":
+        fa_mod._block_sizes = lambda sq, sk, d: (min(1024, sq), min(512, sk))
+    elif variant == "J2":
+        fa_mod._block_sizes = lambda sq, sk, d: (min(512, sq), min(1024, sk))
+    elif variant == "J3":
+        fa_mod._block_sizes = lambda sq, sk, d: (min(1024, sq), min(1024, sk))
+    try:
+        loss_fn = make_loss(variant)
+        eo = opt.init_opt_state(_flatten(ep))
+        bo = opt.init_opt_state(_flatten(bp))
+        ho = opt.init_opt_state(_flatten(hp))
+
+        def step(ep_, bp_, hp_, eo, bo, ho, batch):
+            loss, (ge, gb, gh) = jax.value_and_grad(
+                loss_fn, argnums=(0, 1, 2))(ep_, bp_, hp_, batch)
+            ne, neo = opt.apply_gradients_functional(
+                _flatten(ep_), _flatten(ge), eo, lr=lr)
+            nb, nbo = opt.apply_gradients_functional(
+                _flatten(bp_), _flatten(gb), bo, lr=lr)
+            nh, nho = opt.apply_gradients_functional(
+                _flatten(hp_), _flatten(gh), ho, lr=lr)
+            return (_unflatten(ne, ep_), _unflatten(nb, bp_),
+                    _unflatten(nh, hp_), neo, nbo, nho, loss)
+
+        donate = tuple(range(6))
+        stepj = jax.jit(step, donate_argnums=donate)
+        e2 = jax.tree_util.tree_map(jnp.copy, ep)
+        b2 = jax.tree_util.tree_map(jnp.copy, bp)
+        h2 = jax.tree_util.tree_map(jnp.copy, hp)
+        t0c = time.perf_counter()
+        for _ in range(warmup):
+            e2, b2, h2, eo, bo, ho, loss = stepj(e2, b2, h2, eo, bo, ho, batch)
+        jax.block_until_ready(loss)
+        comp = time.perf_counter() - t0c
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            e2, b2, h2, eo, bo, ho, loss = stepj(e2, b2, h2, eo, bo, ho, batch)
+        jax.block_until_ready(loss)
+        dt = (time.perf_counter() - t0) / steps
+        print(json.dumps({"variant": variant, "ms": round(dt * 1e3, 2),
+                          "tok_s": round(B * S / dt, 1),
+                          "loss": round(float(loss), 4),
+                          "compile_s": round(comp, 1)}), flush=True)
+    finally:
+        fa_mod._block_sizes = orig_bs
+        for k2, v2 in saved.items():
+            if v2 is not None:
+                _KERNELS[k2] = v2
+
+
+variants = sys.argv[1:] if len(sys.argv) > 1 else \
+    ["G", "H", "J1", "J2", "J3", "K", "D2", "P8", "P12"]
+for v in variants:
+    try:
+        run(v)
+    except Exception as e:
+        print(json.dumps({"variant": v,
+                          "error": f"{type(e).__name__}: {e}"[:200]}),
+              flush=True)
+    jax.clear_caches()

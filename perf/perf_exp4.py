@@ -1,0 +1,110 @@
+"""Set 4: refine BEST2. All no-remat + chunked CE + no pallas adamw."""
+import json, sys, time
+import numpy as np
+import jax, jax.numpy as jnp
+import importlib
+import paddle_tpu
+from paddle_tpu.core.dispatch import _KERNELS
+from paddle_tpu.models.llama import LlamaConfig, build_functional_llama
+from paddle_tpu.parallel.pipeline import _flatten, _unflatten
+from paddle_tpu import optimizer
+fa_mod = importlib.import_module("paddle_tpu.ops.pallas.flash_attention")
+
+def build(cfgkw, B, S):
+    cfg = LlamaConfig(**cfgkw)
+    return cfg, build_functional_llama(cfg, dtype=jnp.bfloat16, n_micro=1)
+
+CFG271 = dict(vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+              num_hidden_layers=16, num_attention_heads=16,
+              num_key_value_heads=16, max_position_embeddings=2048)
+CFG271L = dict(CFG271, max_position_embeddings=8192)
+
+def chunked_ce_head(p, y, batch, H, V, EPS, n_chunks=8):
+    _, labels = batch
+    from paddle_tpu.nn.functional.norm import rms_norm_ref
+    hn = rms_norm_ref(y[0], p["ln_f"], EPS)
+    x = hn.reshape(-1, H)
+    lab = labels.reshape(-1).astype(jnp.int32)
+    T = x.shape[0]
+    C = V // n_chunks
+    Wc = jnp.swapaxes(p["lm"].reshape(H, n_chunks, C), 0, 1)
+    @jax.checkpoint
+    def body(carry, xs):
+        m, s, ll = carry
+        w, base = xs
+        logits = jax.lax.dot_general(x, w, (((1,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+        m_new = jnp.maximum(m, logits.max(-1))
+        s = s * jnp.exp(m - m_new) + jnp.exp(logits - m_new[:, None]).sum(-1)
+        rel = lab - base
+        inside = (rel >= 0) & (rel < C)
+        picked = jnp.take_along_axis(logits, jnp.clip(rel, 0, C-1)[:, None], -1)[:, 0]
+        ll = jnp.where(inside, picked, ll)
+        return (m_new, s, ll), None
+    carry = (jnp.full((T,), -jnp.inf, jnp.float32),
+             jnp.zeros((T,), jnp.float32), jnp.zeros((T,), jnp.float32))
+    bases = jnp.arange(n_chunks, dtype=jnp.int32) * C
+    (m, s, ll), _ = jax.lax.scan(body, carry, (Wc, bases))
+    return jnp.mean(m + jnp.log(s) - ll)
+
+def run(name, cfgkw, B, S, fa, n_chunks, steps=12, warmup=2, remat_k=0):
+    cfg, (ep, bp, hp, ea, ba, hl) = build(cfgkw, B, S)
+    L, H, V, EPS = cfg.num_hidden_layers, cfg.hidden_size, cfg.vocab_size, cfg.rms_norm_eps
+    opt = optimizer.AdamW(learning_rate=1e-4, parameters=[])
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, V, (B, S)).astype(np.int32))
+    batch = (ids, ids)
+    lr = jnp.asarray(1e-4, jnp.float32)
+    saved = _KERNELS.pop("adamw_fused", None)
+    orig_bs = fa_mod._block_sizes
+    bq0, bk0 = fa
+    fa_mod._block_sizes = lambda sq, sk, d: (min(bq0, sq), min(bk0, sk))
+    try:
+        ba_ckpt = jax.checkpoint(ba)
+        def loss_fn(ep_, bp_, hp_, batch):
+            x = ea(ep_, batch)[0]
+            for i in range(L):
+                lp = jax.tree_util.tree_map(lambda v: v[i], bp_)
+                x = ba_ckpt(lp, x) if i < remat_k else ba(lp, x)
+            return chunked_ce_head(hp_, x[None], batch, H, V, EPS, n_chunks)
+        def step(ep_, bp_, hp_, eo, bo, ho, batch):
+            loss, (ge, gb, gh) = jax.value_and_grad(loss_fn, argnums=(0,1,2))(ep_, bp_, hp_, batch)
+            ne, neo = opt.apply_gradients_functional(_flatten(ep_), _flatten(ge), eo, lr=lr)
+            nb, nbo = opt.apply_gradients_functional(_flatten(bp_), _flatten(gb), bo, lr=lr)
+            nh, nho = opt.apply_gradients_functional(_flatten(hp_), _flatten(gh), ho, lr=lr)
+            return (_unflatten(ne, ep_), _unflatten(nb, bp_), _unflatten(nh, hp_), neo, nbo, nho, loss)
+        eo = opt.init_opt_state(_flatten(ep)); bo = opt.init_opt_state(_flatten(bp)); ho = opt.init_opt_state(_flatten(hp))
+        stepj = jax.jit(step, donate_argnums=tuple(range(6)))
+        e2 = jax.tree_util.tree_map(jnp.copy, ep); b2 = jax.tree_util.tree_map(jnp.copy, bp); h2 = jax.tree_util.tree_map(jnp.copy, hp)
+        losses = []
+        for _ in range(warmup):
+            e2, b2, h2, eo, bo, ho, loss = stepj(e2, b2, h2, eo, bo, ho, batch)
+            losses.append(float(loss))
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            e2, b2, h2, eo, bo, ho, loss = stepj(e2, b2, h2, eo, bo, ho, batch)
+        lf = float(loss)
+        dt = (time.perf_counter() - t0) / steps
+        assert np.isfinite(lf) and lf < losses[0]
+        print(json.dumps({"variant": name, "ms": round(dt*1e3, 2),
+                          "tok_s": round(B*S/dt, 1), "lossN": round(lf, 4)}), flush=True)
+    finally:
+        fa_mod._block_sizes = orig_bs
+        if saved is not None:
+            _KERNELS["adamw_fused"] = saved
+
+JOBS = {
+  "BEST3_fa1024": (CFG271, 8, 2048, (1024, 1024), 8, 0),
+  "BEST2_c4":     (CFG271, 8, 2048, (512, 1024), 4, 0),
+  "BEST2_c16":    (CFG271, 8, 2048, (512, 1024), 16, 0),
+  "LC8192":       (CFG271L, 2, 8192, (512, 1024), 8, 0),
+  "LC8192_fa1024":(CFG271L, 2, 8192, (1024, 1024), 8, 0),
+  "B16":          (CFG271, 16, 2048, (512, 1024), 8, 0),
+}
+for n in (sys.argv[1:] or list(JOBS)):
+    cfgkw, B, S, fa, nc, rk = JOBS[n]
+    try:
+        run(n, cfgkw, B, S, fa, nc, remat_k=rk)
+    except Exception as e:
+        print(json.dumps({"variant": n, "error": f"{type(e).__name__}: {e}"[:160]}), flush=True)
+    jax.clear_caches()

@@ -1,0 +1,157 @@
+"""Round-4 perf probe: per-component timing of the headline 271M train step.
+
+Ablation-based breakdown (the axon tunnel may not support device traces):
+each piece is jitted and timed alone on the real chip; also attempts a
+jax.profiler trace. Results feed PERF.md.
+"""
+import json
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.models.llama import LlamaConfig, build_functional_llama
+from paddle_tpu.parallel.pipeline import _flatten, _unflatten
+from paddle_tpu import optimizer
+
+cfg = LlamaConfig(vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+                  num_hidden_layers=16, num_attention_heads=16,
+                  num_key_value_heads=16, max_position_embeddings=2048)
+B, S = 8, 2048
+dtype = jnp.bfloat16
+
+ep, bp, hp, ea, ba, hl = build_functional_llama(cfg, dtype=dtype, n_micro=1)
+opt = optimizer.AdamW(learning_rate=1e-4, parameters=[])
+ba_ckpt = jax.checkpoint(ba)
+
+rng = np.random.default_rng(0)
+ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, S)).astype(np.int32))
+batch = (ids, ids)
+
+eo = opt.init_opt_state(_flatten(ep))
+bo = opt.init_opt_state(_flatten(bp))
+ho = opt.init_opt_state(_flatten(hp))
+lr = jnp.asarray(1e-4, jnp.float32)
+
+
+def timeit(name, fn, *args, steps=10, warmup=2):
+    out = None
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / steps
+    print(json.dumps({"probe": name, "ms": round(dt * 1e3, 2)}), flush=True)
+    return dt
+
+
+def loss_fn(ep, bp, hp, batch):
+    x = ea(ep, batch)[0]
+    def body(a, lp):
+        return ba_ckpt(lp, a), None
+    x, _ = jax.lax.scan(body, x, bp)
+    return hl(hp, x[None], batch)
+
+
+# 1. full step (the benched thing), no donation to keep buffers reusable
+def full_step(ep, bp, hp, eo, bo, ho, batch):
+    loss, (ge, gb, gh) = jax.value_and_grad(loss_fn, argnums=(0, 1, 2))(
+        ep, bp, hp, batch)
+    ne, neo = opt.apply_gradients_functional(_flatten(ep), _flatten(ge), eo, lr=lr)
+    nb, nbo = opt.apply_gradients_functional(_flatten(bp), _flatten(gb), bo, lr=lr)
+    nh, nho = opt.apply_gradients_functional(_flatten(hp), _flatten(gh), ho, lr=lr)
+    return (_unflatten(ne, ep), _unflatten(nb, bp), _unflatten(nh, hp),
+            neo, nbo, nho, loss)
+
+
+t_full = timeit("full_step", jax.jit(full_step), ep, bp, hp, eo, bo, ho, batch,
+                steps=10, warmup=2)
+
+# 2. forward-only loss
+t_fwd = timeit("fwd_loss_only", jax.jit(loss_fn), ep, bp, hp, batch)
+
+# 3. fwd+bwd (no optimizer)
+def grad_only(ep, bp, hp, batch):
+    return jax.value_and_grad(loss_fn, argnums=(0, 1, 2))(ep, bp, hp, batch)
+t_grad = timeit("fwd_bwd_no_opt", jax.jit(grad_only), ep, bp, hp, batch)
+
+# 4. body-only fwd+bwd: scan over blocks, mean-loss head (no vocab matmul)
+def body_loss(ep, bp, batch):
+    x = ea(ep, batch)[0]
+    def body(a, lp):
+        return ba_ckpt(lp, a), None
+    x, _ = jax.lax.scan(body, x, bp)
+    return jnp.mean(x.astype(jnp.float32))
+def body_grad(ep, bp, batch):
+    return jax.value_and_grad(body_loss, argnums=(0, 1))(ep, bp, batch)
+t_body = timeit("body_fwd_bwd_meanhead", jax.jit(body_grad), ep, bp, batch)
+
+# 5. head-only fwd+bwd on a precomputed final hidden state
+x_final = jax.jit(lambda ep, bp, batch: jax.lax.scan(
+    lambda a, lp: (ba(lp, a), None), ea(ep, batch)[0], bp)[0])(ep, bp, batch)
+x_final = jax.block_until_ready(x_final)
+def head_grad(hp, x, batch):
+    return jax.value_and_grad(
+        lambda hp: hl(hp, x[None], batch))(hp)
+t_head = timeit("head_fwd_bwd", jax.jit(head_grad), hp, x_final, batch)
+
+# 6. optimizer-only
+def opt_only(ep, bp, hp, eo, bo, ho):
+    ge = jax.tree_util.tree_map(lambda p: p * 1e-3, ep)
+    gb = jax.tree_util.tree_map(lambda p: p * 1e-3, bp)
+    gh = jax.tree_util.tree_map(lambda p: p * 1e-3, hp)
+    ne, neo = opt.apply_gradients_functional(_flatten(ep), _flatten(ge), eo, lr=lr)
+    nb, nbo = opt.apply_gradients_functional(_flatten(bp), _flatten(gb), bo, lr=lr)
+    nh, nho = opt.apply_gradients_functional(_flatten(hp), _flatten(gh), ho, lr=lr)
+    return neo, nbo, nho
+t_opt = timeit("opt_only(incl_fake_grad_mul)", jax.jit(opt_only), ep, bp, hp, eo, bo, ho)
+
+# 7. single block fwd+bwd, not rematted, x16 would be ideal-no-remat cost
+x0 = jax.block_until_ready(jax.jit(lambda ep, batch: ea(ep, batch)[0])(ep, batch))
+lp0 = jax.tree_util.tree_map(lambda v: v[0], bp)
+def blk_grad(lp, x):
+    def f(lp, x):
+        return jnp.mean(ba(lp, x).astype(jnp.float32))
+    return jax.value_and_grad(f, argnums=(0, 1))(lp, x)
+t_blk = timeit("one_block_fwd_bwd_noremat", jax.jit(blk_grad), lp0, x0)
+
+# 8. single block fwd only
+t_blkf = timeit("one_block_fwd_only", jax.jit(lambda lp, x: ba(lp, x)), lp0, x0)
+
+# 9. attention alone (jitted FA fwd+bwd at model shapes)
+from paddle_tpu.core.dispatch import get_kernel
+fa = get_kernel("flash_attention_causal")
+q = jnp.asarray(rng.normal(0, 1, (B, S, 16, 64)), dtype)
+def fa_grad(q):
+    def f(q):
+        return jnp.mean(fa(q, q, q).astype(jnp.float32))
+    return jax.value_and_grad(f)(q)
+t_fa = timeit("fa_fwd_bwd_16L_equiv(x1)", jax.jit(fa_grad), q)
+
+summary = {
+    "full_ms": t_full * 1e3, "fwd_ms": t_fwd * 1e3, "grad_ms": t_grad * 1e3,
+    "body_grad_ms": t_body * 1e3, "head_grad_ms": t_head * 1e3,
+    "opt_ms": t_opt * 1e3, "blk_grad_ms": t_blk * 1e3,
+    "blk_fwd_ms": t_blkf * 1e3, "fa_grad_1L_ms": t_fa * 1e3,
+    "tok_per_s": B * S / t_full,
+}
+print(json.dumps({k: round(v, 2) for k, v in summary.items()}), flush=True)
+
+# 10. attempt a device trace (may not be supported through the tunnel)
+try:
+    import shutil, glob, os
+    os.makedirs(".perf", exist_ok=True)
+    shutil.rmtree(".perf/trace", ignore_errors=True)
+    with jax.profiler.trace(".perf/trace"):
+        for _ in range(3):
+            out = jax.jit(full_step)(ep, bp, hp, eo, bo, ho, batch)
+        jax.block_until_ready(out)
+    files = glob.glob(".perf/trace/**/*", recursive=True)
+    print(json.dumps({"trace_files": [f for f in files if os.path.isfile(f)][:20]}),
+          flush=True)
+except Exception as e:
+    print(json.dumps({"trace_error": f"{type(e).__name__}: {e}"[:300]}), flush=True)

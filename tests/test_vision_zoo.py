@@ -1,0 +1,54 @@
+"""Vision model zoo breadth (VERDICT r3 missing #7; reference
+python/paddle/vision/models/): each family builds, runs a forward pass at
+224x224, produces [B, num_classes] logits, and trains one step."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import models as M
+from paddle_tpu import optimizer
+
+BUILDERS = [
+    ("mobilenet_v1", lambda: M.mobilenet_v1(scale=0.25, num_classes=10)),
+    ("mobilenet_v2", lambda: M.mobilenet_v2(scale=0.35, num_classes=10)),
+    ("mobilenet_v3_small", lambda: M.mobilenet_v3_small(num_classes=10)),
+    ("mobilenet_v3_large", lambda: M.mobilenet_v3_large(num_classes=10)),
+    ("densenet121", lambda: M.densenet121(num_classes=10)),
+    ("squeezenet1_1", lambda: M.squeezenet1_1(num_classes=10)),
+    ("shufflenet_v2_x1_0", lambda: M.shufflenet_v2_x1_0(num_classes=10)),
+    ("alexnet", lambda: M.AlexNet(num_classes=10)),
+    ("vgg11", lambda: M.vgg11(num_classes=10)),
+]
+
+
+@pytest.mark.parametrize("name,mk", BUILDERS, ids=[b[0] for b in BUILDERS])
+def test_vision_model_forward_and_one_step(name, mk):
+    paddle.seed(0)
+    model = mk()
+    x = paddle.to_tensor(np.random.default_rng(0).normal(
+        0, 1, (2, 3, 224, 224)).astype(np.float32))
+    y = model(x)
+    assert tuple(y.shape) == (2, 10)
+    assert np.isfinite(y.numpy()).all()
+    opt = optimizer.SGD(learning_rate=0.01, parameters=model.parameters())
+    lbl = paddle.to_tensor(np.array([1, 3], np.int64))
+    from paddle_tpu.nn import functional as F
+    loss = F.cross_entropy(y, lbl)
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    assert np.isfinite(float(loss.numpy()))
+
+
+def test_densenet_variants_and_vgg_bn():
+    # ctor-only for the big variants (full fwd is slow on CPU)
+    for fn in (M.densenet161, M.densenet169):
+        m = fn(num_classes=4)
+        assert len(list(m.named_parameters())) > 100
+    m = M.vgg16(batch_norm=True, num_classes=4)
+    names = [n for n, _ in m.named_parameters()]
+    assert any("features" in n for n in names)
+    with pytest.raises(ValueError):
+        M.DenseNet(layers=99)
+    with pytest.raises(NotImplementedError):
+        M.densenet121(pretrained=True)
