@@ -104,6 +104,21 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, causal, sm_scale, block_q,
         lse_ref[0] = m_scr[:] + jnp.log(jnp.maximum(l, 1e-30))
 
 
+def _vma_of(*arrs):
+    """Union of manual-axes (shard_map vma) of the inputs: pallas_call
+    out_shapes must declare it when the kernel runs inside shard_map."""
+    out = frozenset()
+    for a in arrs:
+        out |= getattr(getattr(a, "aval", None), "vma", frozenset()) or frozenset()
+    return out
+
+
+def _sds(shape, dtype, vma):
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def flash_attention_fwd_kernel_call(q, k, v, causal, sm_scale, interpret=False,
                                     n_q_heads=None, n_kv_heads=None,
                                     segment_ids=None):
@@ -153,8 +168,8 @@ def flash_attention_fwd_kernel_call(q, k, v, causal, sm_scale, interpret=False,
             pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, s_q, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, s_q, 1), jnp.float32),
+            _sds((bh, s_q, d), q.dtype, _vma_of(q, k, v)),
+            _sds((bh, s_q, 1), jnp.float32, _vma_of(q, k, v)),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -284,7 +299,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
 
 
 def _bwd_call(res, g, causal, sm_scale, interpret, n_q_heads=None,
-              n_kv_heads=None, segment_ids=None):
+              n_kv_heads=None, segment_ids=None, delta=None):
     q, k, v, o, lse = res
     do = g
     bh, s_q, d = q.shape
@@ -293,8 +308,9 @@ def _bwd_call(res, g, causal, sm_scale, interpret, n_q_heads=None,
     hkv = n_kv_heads or hq
     rep = hq // hkv
     block_q, block_k = _block_sizes(s_q, s_k, d)
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
-                    axis=-1, keepdims=True)  # [bh, s_q, 1]
+    if delta is None:   # ring callers precompute it once across hops
+        delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                        axis=-1, keepdims=True)  # [bh, s_q, 1]
     has_seg = segment_ids is not None
 
     def q_idx_dkv(b, j, rr, i):
@@ -335,8 +351,8 @@ def _bwd_call(res, g, causal, sm_scale, interpret, n_q_heads=None,
             pl.BlockSpec((1, block_k, d), kv_idx_dkv),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh_kv, s_k, d), k.dtype),
-            jax.ShapeDtypeStruct((bh_kv, s_k, d), v.dtype),
+            _sds((bh_kv, s_k, d), k.dtype, _vma_of(q, k, v, do)),
+            _sds((bh_kv, s_k, d), v.dtype, _vma_of(q, k, v, do)),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
@@ -376,7 +392,7 @@ def _bwd_call(res, g, causal, sm_scale, interpret, n_q_heads=None,
         grid=(bh, s_q // block_q, s_k // block_k),
         in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, s_q, d), q.dtype),
+        out_shape=_sds((bh, s_q, d), q.dtype, _vma_of(q, k, v, do)),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),

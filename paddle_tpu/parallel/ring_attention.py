@@ -23,7 +23,7 @@ import math
 import jax
 import jax.numpy as jnp
 
-__all__ = ["ring_attention", "ulysses_attention"]
+__all__ = ["ring_attention", "ulysses_attention", "ring_flash_attention"]
 
 
 def _block_attn_lse(q, k, v, scale, mask=None):
@@ -122,3 +122,167 @@ def ulysses_attention(q, k, v, axis: str = "sep", causal: bool = False):
     p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
     oh = jnp.einsum("bhqk,bkhd->bqhd", p, vh)
     return head2seq(oh)
+
+
+# ---------------------------------------------------------------------------
+# Pallas-grade ring flash attention (VERDICT r4 item #6 / SURVEY §5's
+# "ring/blockwise attention as a Pallas kernel over the ICI ring")
+# ---------------------------------------------------------------------------
+def _vary_axis(x, axis):
+    from .pipeline_schedules import _vary
+    return _vary(x, (axis,))
+
+
+def _to_kernel_layout(x):
+    # [B, S, H, D] -> [B*H, S, D]
+    b, s, h, d = x.shape
+    return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, s, d)
+
+
+def _from_kernel_layout(x, b, h):
+    bh, s, d = x.shape
+    return jnp.transpose(x.reshape(b, h, s, d), (0, 2, 1, 3))
+
+
+def ring_flash_attention(q, k, v, axis: str = "sep", causal: bool = False,
+                         interpret: bool = False):
+    """Ring attention where every hop runs the Pallas FA kernel and the
+    per-hop (out, lse) pairs merge by online-softmax rescaling; GQA rides
+    the kernel's native KV-head index maps.
+
+    Backward is a custom_vjp that RE-ROTATES the saved local KV shard
+    around the ring (residuals are only the local q/k/v/out/lse — O(S/N)
+    per chip, asserted in tests) and runs the FA backward kernels per hop
+    with the GLOBAL lse/delta, which makes the flash decomposition exact
+    per KV block; dk/dv accumulators rotate along with the KV so each
+    shard's gradient arrives home after the full cycle.
+
+    Causal hop-skipping: with block-aligned shards, hops holding a strictly
+    future shard (src > r) are skipped via lax.switch — ~half the FLOPs at
+    scale, the blockwise-causal schedule the jnp fallback can't exploit.
+    """
+    from ..ops.pallas.flash_attention import (
+        flash_attention_fwd_kernel_call, _bwd_call)
+
+    n = jax.lax.psum(1, axis)          # static: axis size
+    b, s_local, hq, d = q.shape
+    hkv = k.shape[2]
+    scale = 1.0 / math.sqrt(d)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def fwd_hop(qk, k_cur, v_cur, hop_kind):
+        """hop_kind: 0 skip, 1 diagonal (causal), 2 full."""
+        kk = _to_kernel_layout(k_cur)
+        vk = _to_kernel_layout(v_cur)
+
+        def run(flag_causal):
+            def f(_):
+                o, lse = flash_attention_fwd_kernel_call(
+                    qk, kk, vk, flag_causal, scale, interpret=interpret,
+                    n_q_heads=hq, n_kv_heads=hkv)
+                return (_vary_axis(o.astype(jnp.float32), axis),
+                        _vary_axis(lse, axis))
+            return f
+
+        def skip(_):
+            return (_vary_axis(jnp.zeros((b * hq, s_local, d), jnp.float32),
+                               axis),
+                    _vary_axis(jnp.full((b * hq, s_local, 1), -jnp.inf,
+                                        jnp.float32), axis))
+
+        return jax.lax.switch(hop_kind, [skip, run(True), run(False)], 0)
+
+    def hop_kind_of(t, r):
+        src = jnp.mod(r - t, n)
+        if not causal:
+            return jnp.int32(2)
+        return jnp.where(src > r, 0, jnp.where(src == r, 1, 2)).astype(
+            jnp.int32)
+
+    @jax.custom_vjp
+    def _ring(q, k, v):
+        out, _lse = _ring_fwd(q, k, v)[0]
+        return out
+
+    def _ring_fwd(q, k, v):
+        r = jax.lax.axis_index(axis)
+        qk = _to_kernel_layout(q)
+        o_acc = _vary_axis(jnp.zeros((b * hq, s_local, d), jnp.float32), axis)
+        lse_acc = _vary_axis(
+            jnp.full((b * hq, s_local, 1), -jnp.inf, jnp.float32), axis)
+        k_cur, v_cur = k, v
+        for t in range(n):
+            o_t, lse_t = fwd_hop(qk, k_cur, v_cur, hop_kind_of(t, r))
+            lse_new = jnp.logaddexp(lse_acc, lse_t)
+            a_old = jnp.exp(lse_acc - lse_new)
+            a_new = jnp.exp(lse_t - lse_new)
+            o_acc = o_acc * a_old + o_t * a_new
+            lse_acc = lse_new
+            if t != n - 1:
+                k_cur = jax.lax.ppermute(k_cur, axis, perm)
+                v_cur = jax.lax.ppermute(v_cur, axis, perm)
+        out = _from_kernel_layout(o_acc.astype(q.dtype), b, hq)
+        return (out, lse_acc), (q, k, v, out, lse_acc)
+
+    def _ring_bwd(res, g):
+        q, k, v, out, lse = res
+        r = jax.lax.axis_index(axis)
+        qk = _to_kernel_layout(q)
+        ok = _to_kernel_layout(out)
+        gk = _to_kernel_layout(g.astype(out.dtype))
+        # delta = rowsum(do*o) is hop-invariant: compute once, not per hop
+        delta = jnp.sum(gk.astype(jnp.float32) * ok.astype(jnp.float32),
+                        axis=-1, keepdims=True)
+        dq_acc = _vary_axis(jnp.zeros_like(qk, jnp.float32), axis)
+        k_cur, v_cur = k, v
+        dk_acc = _vary_axis(jnp.zeros(k.shape, jnp.float32), axis)
+        dv_acc = _vary_axis(jnp.zeros(v.shape, jnp.float32), axis)
+
+        def bwd_hop(k_cur, v_cur, hop_kind):
+            kk = _to_kernel_layout(k_cur)
+            vk = _to_kernel_layout(v_cur)
+
+            def run(flag_causal):
+                def f(_):
+                    dq, dk, dv = _bwd_call(
+                        (qk, kk, vk, ok, lse), gk, flag_causal, scale,
+                        interpret, n_q_heads=hq, n_kv_heads=hkv,
+                        delta=delta)
+                    return (_vary_axis(dq.astype(jnp.float32), axis),
+                            _vary_axis(dk.astype(jnp.float32), axis),
+                            _vary_axis(dv.astype(jnp.float32), axis))
+                return f
+
+            def skip(_):
+                z = lambda s: _vary_axis(jnp.zeros(s, jnp.float32), axis)
+                return (z((b * hq, s_local, d)),
+                        z((b * hkv, s_local, d)),
+                        z((b * hkv, s_local, d)))
+
+            dq, dk, dv = jax.lax.switch(
+                hop_kind, [skip, run(True), run(False)], 0)
+            return (dq, _from_kernel_layout(dk, b, hkv),
+                    _from_kernel_layout(dv, b, hkv))
+
+        for t in range(n):
+            dq_t, dk_t, dv_t = bwd_hop(k_cur, v_cur, hop_kind_of(t, r))
+            dq_acc = dq_acc + dq_t
+            dk_acc = dk_acc + dk_t
+            dv_acc = dv_acc + dv_t
+            # grad accumulators rotate the FULL cycle (n hops) so each
+            # shard's sum lands back at its owner; KV itself only needs the
+            # first n-1 rotations
+            if t != n - 1:
+                k_cur = jax.lax.ppermute(k_cur, axis, perm)
+                v_cur = jax.lax.ppermute(v_cur, axis, perm)
+            dk_acc = jax.lax.ppermute(dk_acc, axis, perm)
+            dv_acc = jax.lax.ppermute(dv_acc, axis, perm)
+        dq = _from_kernel_layout(dq_acc, b, hq).astype(q.dtype)
+        return dq, dk_acc.astype(k.dtype), dv_acc.astype(v.dtype)
+
+    def _fwd_rule(q, k, v):
+        (out, _lse), res = _ring_fwd(q, k, v)
+        return out, res
+
+    _ring.defvjp(_fwd_rule, _ring_bwd)
+    return _ring(q, k, v)

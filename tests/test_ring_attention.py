@@ -3,7 +3,8 @@ import numpy as np
 import pytest
 import jax
 import jax.numpy as jnp
-from jax.sharding import PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
 
 from paddle_tpu.distributed.topology import build_mesh
 from paddle_tpu.parallel.ring_attention import ring_attention, ulysses_attention
@@ -74,3 +75,148 @@ def test_ring_attention_differentiable():
     gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(g, gr):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-4)
+
+
+# ---------------------------------------------------------------------------
+# Pallas-grade ring flash attention (VERDICT r4 item #6)
+# ---------------------------------------------------------------------------
+def _dense_ref(q, k, v, causal):
+    d = q.shape[-1]
+    if k.shape[2] != q.shape[2]:
+        rep = q.shape[2] // k.shape[2]
+        k = np.repeat(k, rep, axis=2)
+        v = np.repeat(v, rep, axis=2)
+    s = np.einsum("bqhd,bkhd->bhqk", q.astype(np.float32),
+                  k.astype(np.float32)) / np.sqrt(d)
+    if causal:
+        S = s.shape[-1]
+        s = np.where(np.tril(np.ones((S, S), bool)), s, -1e30)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    return np.einsum("bhqk,bkhd->bqhd", p, v.astype(np.float32))
+
+
+@pytest.mark.parametrize("causal,hkv", [(False, 4), (True, 4), (True, 2)],
+                         ids=["full", "causal", "causal-gqa"])
+def test_ring_flash_attention_matches_dense(causal, hkv):
+    from paddle_tpu.parallel.ring_attention import ring_flash_attention
+    if jax.device_count() < 4:
+        pytest.skip("needs 4 devices")
+    n, B, S_local, H, D = 4, 1, 128, 4, 64
+    mesh = Mesh(np.array(jax.devices()[:4]), ("sep",))
+    rng_l = np.random.default_rng(5)
+    S = n * S_local
+    q = rng_l.normal(0, 1, (B, S, H, D)).astype(np.float32)
+    k = rng_l.normal(0, 1, (B, S, hkv, D)).astype(np.float32)
+    v = rng_l.normal(0, 1, (B, S, hkv, D)).astype(np.float32)
+
+    def body(q, k, v):
+        return ring_flash_attention(q, k, v, axis="sep", causal=causal,
+                                    interpret=True)
+
+    f = jax.jit(shard_map(body, mesh=mesh,
+                          in_specs=(P(None, "sep"), P(None, "sep"),
+                                    P(None, "sep")),
+                          out_specs=P(None, "sep"), check_vma=False))
+    out = np.asarray(f(q, k, v))
+    ref = _dense_ref(q, k, v, causal)
+    np.testing.assert_allclose(out, ref, rtol=2e-3, atol=2e-3)
+
+
+def test_ring_flash_attention_backward_matches_dense():
+    from paddle_tpu.parallel.ring_attention import ring_flash_attention
+    if jax.device_count() < 4:
+        pytest.skip("needs 4 devices")
+    n, B, S_local, H, D = 4, 1, 128, 2, 64
+    mesh = Mesh(np.array(jax.devices()[:4]), ("sep",))
+    rng_l = np.random.default_rng(6)
+    S = n * S_local
+    q = rng_l.normal(0, 1, (B, S, H, D)).astype(np.float32)
+    k = rng_l.normal(0, 1, (B, S, H, D)).astype(np.float32)
+    v = rng_l.normal(0, 1, (B, S, H, D)).astype(np.float32)
+
+    def loss_ring(q, k, v):
+        def body(q, k, v):
+            o = ring_flash_attention(q, k, v, axis="sep", causal=True,
+                                     interpret=True)
+            return jax.lax.psum(jnp.sum(o.astype(jnp.float32) ** 2), "sep")
+        summed = shard_map(body, mesh=mesh,
+                           in_specs=(P(None, "sep"), P(None, "sep"),
+                                     P(None, "sep")),
+                           out_specs=P(), check_vma=False)(q, k, v)
+        return summed
+
+    g_ring = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+
+    def loss_dense(q, k, v):
+        d = q.shape[-1]
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d)
+        S_ = s.shape[-1]
+        s = jnp.where(jnp.tril(jnp.ones((S_, S_), bool)), s, -1e30)
+        p = jax.nn.softmax(s, -1)
+        o = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+        return jnp.sum(o ** 2)
+
+    g_dense = jax.jit(jax.grad(loss_dense, argnums=(0, 1, 2)))(q, k, v)
+    for a, b_ in zip(g_ring, g_dense):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=5e-3, atol=5e-3)
+
+
+def test_ring_flash_residuals_are_local_shards():
+    """VERDICT r4 item #6: backward residuals must be O(S/N) — only the
+    LOCAL q/k/v/out/lse shards, never a gathered sequence or per-hop KV."""
+    from paddle_tpu.parallel import ring_attention as ra
+    if jax.device_count() < 4:
+        pytest.skip("needs 4 devices")
+    n, B, S_local, H, D = 4, 1, 128, 2, 64
+    mesh = Mesh(np.array(jax.devices()[:4]), ("sep",))
+    q = np.zeros((B, n * S_local, H, D), np.float32)
+
+    def body(qq, kk, vv):
+        out = ra.ring_flash_attention(qq, kk, vv, axis="sep", causal=False,
+                                      interpret=True)
+        return jax.lax.psum(jnp.sum(out.astype(jnp.float32)), "sep")
+
+    def loss(qq, kk, vv):
+        return shard_map(body, mesh=mesh,
+                         in_specs=(P(None, "sep"),) * 3, out_specs=P(),
+                         check_vma=False)(qq, kk, vv)
+
+    # jaxpr of the grad: every residual array must have seq dim <= S_local
+    jaxpr = jax.make_jaxpr(jax.grad(loss, argnums=(0, 1, 2)))(q, q, q)
+    S = n * S_local
+    # the global [B, S, ...] inputs live OUTSIDE the shard_map; inside its
+    # sub-jaxprs every aval must be S_local-sized — a full-seq intermediate
+    # would betray an all-gather / saved-per-hop-KV regression
+    def sub_jaxprs_of(eqn):
+        for val in eqn.params.values():
+            if isinstance(val, jax.extend.core.ClosedJaxpr):
+                yield val.jaxpr
+            elif isinstance(val, jax.extend.core.Jaxpr):
+                yield val
+            elif isinstance(val, (list, tuple)):
+                for item in val:
+                    if isinstance(item, jax.extend.core.ClosedJaxpr):
+                        yield item.jaxpr
+                    elif isinstance(item, jax.extend.core.Jaxpr):
+                        yield item
+
+    def full_seq_avals(jx):
+        found = []
+        for eqn in jx.eqns:
+            for sub in sub_jaxprs_of(eqn):
+                found += full_seq_avals(sub)
+            for var in eqn.outvars:
+                av = getattr(var, "aval", None)
+                if av is not None and hasattr(av, "shape"):
+                    shp = tuple(av.shape)
+                    if len(shp) >= 2 and S in shp:
+                        found.append(shp)
+        return found
+    # top-level holds the global-input shapes only; dive into the shard_map
+    offenders = []
+    for eqn in jaxpr.jaxpr.eqns:
+        for sub in sub_jaxprs_of(eqn):
+            offenders += full_seq_avals(sub)
+    assert offenders == [], f"gathered full-seq intermediates: {offenders}"
