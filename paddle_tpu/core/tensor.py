@@ -40,6 +40,20 @@ def _split_complex(a):
     return jnp.real(a), jnp.imag(a)
 
 
+# concretization listener (jit SOT tape recorder): when set, every
+# device->host fetch that can steer python control flow reports
+# (jax_value, python_result) — the reference SOT's "graph break on
+# data-dependent control flow" observation points.
+_concretize_hook = [None]
+
+
+def _notify_concretize(value, result):
+    hook = _concretize_hook[0]
+    if hook is not None:
+        hook(value, result)
+    return result
+
+
 class Tensor:
     __slots__ = ("_value", "stop_gradient", "_grad", "_grad_node", "_out_index",
                  "name", "persistable", "_backward_hooks", "trainable",
@@ -100,6 +114,14 @@ class Tensor:
 
     def numpy(self):
         v = self._value
+        if _concretize_hook[0] is not None:
+            # host fetches can steer python control flow: report to the
+            # SOT tape recorder (guarded on the full array)
+            return _notify_concretize(v, self._numpy_raw())
+        return self._numpy_raw()
+
+    def _numpy_raw(self):
+        v = self._value
         # some TPU transports (axon tunnel) cannot fetch complex arrays, and
         # a failed attempt poisons the stream — split complex into two real
         # transfers up front (as a compiled program; eager complex ops are
@@ -116,8 +138,8 @@ class Tensor:
 
     def item(self, *args):
         if args:
-            return self.numpy().item(*args)
-        return self.numpy().item()
+            return _notify_concretize(self._value, self.numpy().item(*args))
+        return _notify_concretize(self._value, self.numpy().item())
 
     def tolist(self):
         return self.numpy().tolist()
@@ -253,16 +275,16 @@ class Tensor:
         return id(self)
 
     def __bool__(self):
-        return bool(self._value)
+        return _notify_concretize(self._value, bool(self._value))
 
     def __int__(self):
-        return int(self._value)
+        return _notify_concretize(self._value, int(self._value))
 
     def __float__(self):
-        return float(self._value)
+        return _notify_concretize(self._value, float(self._value))
 
     def __index__(self):
-        return int(self._value)
+        return _notify_concretize(self._value, int(self._value))
 
     def __iter__(self):
         for i in range(len(self)):

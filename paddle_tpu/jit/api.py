@@ -130,10 +130,19 @@ class SymbolicStaticFunction(StaticFunction):
                      jax.errors.TracerIntegerConversionError,
                      NotImplementedError)
 
+    #: guard-cache capacity (reference SOT bounds its cache too): a training
+    #: loop passing an ever-changing python float would otherwise compile a
+    #: new variant per value forever. LRU-evicted beyond this.
+    max_variants = 32
+    #: tape programs kept per broken guard key (one per value path)
+    max_tapes_per_guard = 8
+
     def __init__(self, *a, **kw):
         super().__init__(*a, **kw)
-        self._broken = {}       # guard_key -> reason string
-        self._variants = {}     # guard_key -> jitted fn (scalars baked in)
+        from collections import OrderedDict
+        self._broken = OrderedDict()    # guard_key -> reason string
+        self._variants = OrderedDict()  # guard_key -> jitted fn
+        self._tapes = OrderedDict()     # guard_key -> [TapeProgram, ...]
         self.graph_break_count = 0
 
     @property
@@ -141,8 +150,87 @@ class SymbolicStaticFunction(StaticFunction):
         return len(self._variants)
 
     @property
+    def partial_graph_count(self):
+        """Broken guard keys currently served by compiled tape segments
+        (the pycode_generator analog) instead of pure eager."""
+        return sum(1 for e in self._tapes.values() if e.get("progs"))
+
+    @property
     def broken_reasons(self):
         return dict(self._broken)
+
+    def _lru_put(self, od, key, value, cap):
+        od[key] = value
+        od.move_to_end(key)
+        while len(od) > cap:
+            od.popitem(last=False)
+
+    # -- partial-graph fallback (tape replay; see jit/sot_tape.py) ----------
+    def _sot_inputs(self, args, kwargs):
+        import numpy as _np
+        named = {}
+        state_tensors = []
+        for i, l in enumerate(jax.tree_util.tree_leaves(
+                (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))):
+            if isinstance(l, Tensor):
+                named[f"a{i}"] = l._value
+            elif isinstance(l, (_np.ndarray, jax.Array)):
+                # raw-array args are runtime data too; if the function
+                # converts them through an unrecorded path the tape builder
+                # refuses (unreferenced-input rule) rather than baking them
+                named[f"a{i}"] = l
+        if self._layer is not None:
+            for n, p in self._layer.named_parameters():
+                named[f"s:{n}"] = p._value
+                state_tensors.append(p)
+            for n, b in self._layer.named_buffers():
+                named[f"s:{n}"] = b._value
+                state_tensors.append(b)
+        return named, state_tensors
+
+    #: consecutive replay misses before a guard goes permanently eager
+    max_path_misses = 8
+
+    def _sot_fallback(self, guard, args, kwargs):
+        """Broken guard: replay a compiled tape when one matches the
+        observed value path; otherwise run eagerly ONCE while recording a
+        new tape (compiled prefix -> eager fetch -> compiled rest). Guards
+        whose fetched values never stabilise (continuous floats) go
+        permanently eager after max_path_misses consecutive misses."""
+        from . import sot_tape
+        from .sot_tape import record_tape, PathMismatch
+        if sot_tape.is_recording():
+            # nested broken call during an outer recording: run plain eager
+            # so our ops land on the OUTER tape
+            return self._call_raw(*args, **kwargs)
+        entry = self._tapes.get(guard)
+        if entry is None:
+            entry = {"progs": [], "misses": 0}
+            self._lru_put(self._tapes, guard, entry, self.max_variants)
+        if entry["misses"] >= self.max_path_misses:
+            return self._call_raw(*args, **kwargs)     # unstable: eager
+        named, state_tensors = self._sot_inputs(args, kwargs)
+        for prog in list(entry["progs"]):
+            try:
+                out = prog.replay(named)
+                entry["misses"] = 0
+                self._tapes.move_to_end(guard)
+                return out
+            except PathMismatch:
+                continue
+            except Exception:
+                entry["progs"].remove(prog)  # stale tape: drop, keep probing
+        entry["misses"] += 1
+        if len(entry["progs"]) >= self.max_tapes_per_guard:
+            # cache full: recording again would only be thrown away
+            return self._call_raw(*args, **kwargs)
+        out, prog = record_tape(lambda: self._call_raw(*args, **kwargs),
+                                named, state_tensors)
+        if prog is not None and prog.n_segments > 0:
+            entry["progs"].append(prog)
+        elif prog is None:
+            entry["misses"] = self.max_path_misses   # untapeable: eager
+        return out
 
     @staticmethod
     def _split_static(tree):
@@ -176,7 +264,9 @@ class SymbolicStaticFunction(StaticFunction):
             for l in jax.tree_util.tree_leaves(traced_args))
         guard = (statics, training, str(treedef), avals)
         if guard in self._broken:
-            return self._call_raw(*args, **kwargs)      # graph-break: eager
+            # graph-break path: compiled tape segments around the break
+            self._broken.move_to_end(guard)
+            return self._sot_fallback(guard, args, kwargs)
 
         if guard not in self._variants:
             def traced_call(state, rng, traced):
@@ -187,7 +277,10 @@ class SymbolicStaticFunction(StaticFunction):
                     leaves[i] = v
                 a, k = jax.tree_util.tree_unflatten(td, leaves)
                 return self._traced_call(state, rng, a, k)
-            self._variants[guard] = jax.jit(traced_call)
+            self._lru_put(self._variants, guard, jax.jit(traced_call),
+                          self.max_variants)
+        else:
+            self._variants.move_to_end(guard)
 
         state = {}
         if self._layer is not None:
@@ -198,11 +291,13 @@ class SymbolicStaticFunction(StaticFunction):
         try:
             out, new_state = self._variants[guard](state, rng, traced_args)
         except self._BREAK_ERRORS as e:
-            # graph break: this guard key runs eagerly from now on
-            self._broken[guard] = f"{type(e).__name__}: {e}"
+            # graph break: serve this guard key via tape-replay partial
+            # graphs from now on (compiled prefix/tail, eager break region)
+            self._lru_put(self._broken, guard, f"{type(e).__name__}: {e}",
+                          self.max_variants)
             self._variants.pop(guard, None)
             self.graph_break_count += 1
-            return self._call_raw(*args, **kwargs)
+            return self._sot_fallback(guard, args, kwargs)
         if self._layer is not None and new_state:
             buffer_map = dict(self._layer.named_buffers())
             for name, v in new_state.items():

@@ -1,0 +1,238 @@
+"""SOT partial-graph compilation via tape replay (reference:
+python/paddle/jit/sot/opcode_translator/executor/pycode_generator.py — on a
+graph break the reference regenerates bytecode so the compiled prefix still
+runs and only the breaking region is eager).
+
+TPU-native analog: CPython bytecode is out of reach, but the eager dispatch
+layer can RECORD the op tape of one eager execution together with every
+concretization event (a `bool()`/`item()`/`numpy()` fetch that steered
+python control flow). The tape then replays as a chain of jitted SEGMENTS
+split at those events:
+
+    compiled segment -> host fetch (the breaking region) -> compiled segment
+
+Each segment's guard is the full fetched ARRAY recorded at tape time:
+matching content ⇒ the python control flow between the ops took the same
+path ⇒ the recorded op sequence is exactly what the function would do, so
+the replay is sound. A mismatch aborts the replay and the caller records a
+fresh tape for the new value path (bool branches need at most two tapes).
+
+Soundness guards — the program REFUSES to build (permanent eager fallback)
+when replay could silently diverge from eager semantics:
+  * differentiable outputs (the eager autograd tape cannot be replayed),
+  * layer parameters/buffers mutated during the recorded call (replay has
+    no side effects),
+  * a declared runtime input never referenced by any recorded op (its data
+    reached the ops through an unrecorded transform — AMP casts, numpy
+    conversions — and would otherwise be baked stale),
+  * a concretize event whose fetched array cannot be resolved to a tape
+    value (its guard would be unenforceable), or is too large to guard on.
+
+Layer parameters/buffers are recognised by identity against the state
+snapshot taken at record time and become named runtime inputs (re-read each
+call, so optimizer updates are visible); remaining arrays are baked
+constants (safe by the unused-input refusal above).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, _concretize_hook
+from ..core import dispatch as _dispatch
+
+__all__ = ["record_tape", "TapeProgram", "PathMismatch", "is_recording"]
+
+_GUARD_MAX_ELEMS = 65536
+
+# nested broken to_static calls must NOT replay their own tapes while an
+# outer recording is active — their eager ops need to land on the outer tape
+_recording_depth = [0]
+
+
+def is_recording():
+    return _recording_depth[0] > 0
+
+
+class PathMismatch(Exception):
+    """A segment's fetched value diverged from the recorded path."""
+
+
+class _Untapeable(Exception):
+    pass
+
+
+class _Recording:
+    def __init__(self):
+        self.ops = []        # dispatch records (name, vals, outs, impl, kw)
+        self.events = []     # (op_index_at_fetch, value_id, np_guard_array)
+
+
+def record_tape(fn, inputs_named, state_tensors=()):
+    """Run `fn()` eagerly while recording the op tape + concretize events.
+
+    inputs_named: {name: jax_array} — runtime inputs (function args
+    flattened + layer state). state_tensors: Tensors whose in-place
+    mutation during the call makes the tape unsound.
+    Returns (fn_output, TapeProgram or None)."""
+    rec = _Recording()
+    prev_rec = _dispatch._op_recorder[0]
+    prev_hook = _concretize_hook[0]
+    state_ids = [id(t._value) for t in state_tensors]
+
+    def on_concretize(value, result):
+        try:
+            arr = np.asarray(jax.device_get(value))
+        except Exception:
+            arr = None
+        rec.events.append((len(rec.ops), id(value), arr))
+
+    _dispatch._op_recorder[0] = rec.ops
+    _concretize_hook[0] = on_concretize
+    _recording_depth[0] += 1
+    try:
+        out = fn()
+    finally:
+        _recording_depth[0] -= 1
+        _dispatch._op_recorder[0] = prev_rec
+        _concretize_hook[0] = prev_hook
+    leaves = jax.tree_util.tree_leaves(
+        out, is_leaf=lambda x: isinstance(x, Tensor))
+    if any(isinstance(l, Tensor) and not l.stop_gradient for l in leaves):
+        # differentiable outputs ride the eager autograd tape, which the
+        # replay cannot reproduce — keep this path fully eager
+        return out, None
+    if any(id(t._value) != i for t, i in zip(state_tensors, state_ids)):
+        return out, None   # in-place state mutation: replay would skip it
+    try:
+        prog = TapeProgram(rec, inputs_named, out)
+    except Exception:
+        prog = None  # untapeable structure: permanent-eager fallback
+    return out, prog
+
+
+class TapeProgram:
+    """Replayable straight-line program: jitted segments split at
+    concretization events, array-guarded."""
+
+    def __init__(self, rec, inputs_named, out):
+        self._refs = {}              # id(array) -> ref
+        self._consts = []            # baked arrays
+        self._input_names = list(inputs_named)
+        for i, (name, v) in enumerate(inputs_named.items()):
+            self._refs[id(v)] = ("in", i)
+        self._records = []           # (impl, kwargs, in_refs, n_out)
+        used_inputs = set()
+        for op_cursor, (name, vals, outs, impl, kw) in enumerate(rec.ops):
+            in_refs = tuple(self._ref_of(v) for v in vals)
+            for r in in_refs:
+                if r[0] == "in":
+                    used_inputs.add(r[1])
+            for j, o in enumerate(outs):
+                if isinstance(o, (jnp.ndarray, jax.Array)):
+                    self._refs.setdefault(id(o), ("op", op_cursor, j))
+            self._records.append((impl, kw, in_refs, len(outs)))
+        if self._records and len(used_inputs) < len(self._input_names):
+            # some input's data reached the ops through an unrecorded
+            # transform (AMP cast, numpy conversion): it would be baked
+            # stale — refuse
+            raise _Untapeable("unreferenced runtime input")
+        # events -> (op_index, ref, np_guard)
+        self._events = []
+        for op_idx, vid, guard_arr in rec.events:
+            ref = self._refs.get(vid)
+            if ref is None or guard_arr is None:
+                raise _Untapeable("unguardable concretize event")
+            if guard_arr.size > _GUARD_MAX_ELEMS:
+                raise _Untapeable("concretize guard too large")
+            self._events.append((op_idx, ref, guard_arr))
+        # output template
+        self._out_leaves, self._out_tree = jax.tree_util.tree_flatten(
+            out, is_leaf=lambda x: isinstance(x, Tensor))
+        self._out_refs = []
+        for leaf in self._out_leaves:
+            v = leaf._value if isinstance(leaf, Tensor) else leaf
+            self._out_refs.append(self._ref_of(v)
+                                  if isinstance(v, (jnp.ndarray, jax.Array))
+                                  else ("lit", v))
+        # segment boundaries (unique, sorted op indices of events)
+        bounds = sorted({e[0] for e in self._events})
+        self._segments = []
+        start = 0
+        for b in bounds + [len(self._records)]:
+            if b >= start:
+                self._segments.append((start, b))
+                start = b
+        if start < len(self._records):
+            self._segments.append((start, len(self._records)))
+        self._jitted = [self._compile_segment(a, b)
+                        for a, b in self._segments]
+
+    # -- refs ----------------------------------------------------------------
+    def _ref_of(self, v):
+        if not isinstance(v, (jnp.ndarray, jax.Array)):
+            return ("lit", v)
+        r = self._refs.get(id(v))
+        if r is not None:
+            return r
+        self._consts.append(v)
+        r = ("const", len(self._consts) - 1)
+        self._refs[id(v)] = r
+        return r
+
+    def _resolve(self, ref, inputs, env):
+        kind = ref[0]
+        if kind == "in":
+            return inputs[ref[1]]
+        if kind == "op":
+            return env[ref[1]][ref[2]]
+        if kind == "const":
+            return self._consts[ref[1]]
+        return ref[1]                      # literal
+
+    # -- compilation ---------------------------------------------------------
+    def _compile_segment(self, a, b):
+        records = self._records[a:b]
+
+        def run(inputs, env_flat):
+            env = dict(env_flat)
+            for off, (impl, kw, in_refs, _n) in enumerate(records):
+                vals = [self._resolve(r, inputs, env) for r in in_refs]
+                out = impl(*vals, **kw)
+                outs = tuple(out) if isinstance(out, (tuple, list)) else (out,)
+                env[a + off] = outs
+            return {i: env[i] for i in env if i >= a}
+        return jax.jit(run)
+
+    @property
+    def n_segments(self):
+        return len(self._segments)
+
+    # -- replay --------------------------------------------------------------
+    def replay(self, inputs_named):
+        """Run the compiled segments; raises PathMismatch when a fetched
+        array differs from the recorded guard."""
+        inputs = [inputs_named[n] for n in self._input_names]
+        env = {}
+        ev = list(self._events)
+        for (a, b), fn in zip(self._segments, self._jitted):
+            new = fn(inputs, env)
+            env.update(new)
+            while ev and ev[0][0] == b:
+                _idx, ref, expect = ev.pop(0)
+                got = np.asarray(jax.device_get(
+                    self._resolve(ref, inputs, env)))
+                if got.shape != expect.shape or not np.array_equal(
+                        got, expect, equal_nan=True):
+                    raise PathMismatch()
+        out_vals = [self._resolve(r, inputs, env) for r in self._out_refs]
+        leaves = []
+        for tmpl, v in zip(self._out_leaves, out_vals):
+            if isinstance(tmpl, Tensor):
+                t = Tensor(v)
+                t.stop_gradient = tmpl.stop_gradient
+                leaves.append(t)
+            else:
+                leaves.append(v)
+        return jax.tree_util.tree_unflatten(self._out_tree, leaves)
