@@ -60,7 +60,7 @@ from . import metric  # noqa: F401
 from . import profiler  # noqa: F401
 from . import hapi  # noqa: F401
 from . import inference  # noqa: F401
-from .hapi import Model, summary  # noqa: F401
+from .hapi import Model, summary, flops  # noqa: F401
 from . import static  # noqa: F401
 from . import sparse  # noqa: F401
 from . import text  # noqa: F401

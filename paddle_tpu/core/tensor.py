@@ -137,9 +137,11 @@ class Tensor:
         return np.asarray(jax.device_get(v))
 
     def item(self, *args):
+        # _numpy_raw: exactly one concretize notification per fetch
         if args:
-            return _notify_concretize(self._value, self.numpy().item(*args))
-        return _notify_concretize(self._value, self.numpy().item())
+            return _notify_concretize(self._value,
+                                      self._numpy_raw().item(*args))
+        return _notify_concretize(self._value, self._numpy_raw().item())
 
     def tolist(self):
         return self.numpy().tolist()

@@ -1,7 +1,8 @@
 """High-level API (reference: python/paddle/hapi/ — Model.fit model.py:1472,
-callbacks, summary)."""
+callbacks, summary, dynamic_flops)."""
 from .model import Model
 from .summary import summary
+from .dynamic_flops import flops
 from . import callbacks
 
-__all__ = ["Model", "summary", "callbacks"]
+__all__ = ["Model", "summary", "flops", "callbacks"]

@@ -1,0 +1,113 @@
+"""paddle.flops (reference: python/paddle/hapi/dynamic_flops.py:40) —
+per-layer FLOP/parameter counting via forward hooks on a probe pass."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+from ..nn import (Conv2D, Linear, BatchNorm2D, BatchNorm1D, LayerNorm,
+                  ReLU, AvgPool2D, MaxPool2D, AdaptiveAvgPool2D)
+
+__all__ = ["flops"]
+
+
+def _numel(shape):
+    n = 1
+    for s in shape:
+        n *= int(s)
+    return n
+
+
+def _count_conv2d(layer, x, y):
+    kh, kw = layer._kernel_size if isinstance(layer._kernel_size, (list, tuple)) \
+        else (layer._kernel_size, layer._kernel_size)
+    cin = layer._in_channels
+    groups = getattr(layer, "_groups", 1)
+    out_elems = _numel(y.shape)
+    macs = out_elems * cin // groups * kh * kw
+    if getattr(layer, "bias", None) is not None:
+        macs += out_elems
+    return macs
+
+
+def _count_linear(layer, x, y):
+    macs = _numel(y.shape) * layer._in_features
+    if getattr(layer, "bias", None) is not None:
+        macs += _numel(y.shape)
+    return macs
+
+
+def _count_norm(layer, x, y):
+    return 2 * _numel(x.shape)
+
+
+def _count_act(layer, x, y):
+    return _numel(y.shape)
+
+
+def _count_pool(layer, x, y):
+    return _numel(y.shape)
+
+
+_COUNTERS = [
+    (Conv2D, _count_conv2d),
+    (Linear, _count_linear),
+    (BatchNorm2D, _count_norm), (BatchNorm1D, _count_norm),
+    (LayerNorm, _count_norm),
+    (ReLU, _count_act),
+    (AvgPool2D, _count_pool), (MaxPool2D, _count_pool),
+    (AdaptiveAvgPool2D, _count_pool),
+]
+
+
+def flops(net: Layer, input_size, custom_ops=None, print_detail=False):
+    """Probe-run `net` on zeros of `input_size` and report total FLOPs
+    (counted as MACs, matching the reference convention). custom_ops:
+    {LayerType: fn(layer, input, output) -> macs}."""
+    custom = dict(custom_ops or {})
+    rows = []
+    handles = []
+
+    def make_hook(counter):
+        def hook(layer, inputs, output):
+            x = inputs[0] if isinstance(inputs, (tuple, list)) else inputs
+            macs = counter(layer, x, output)
+            n_params = sum(_numel(p.shape) for _, p in
+                           layer.named_parameters(include_sublayers=False))
+            rows.append((type(layer).__name__, tuple(x.shape),
+                         tuple(output.shape), n_params, int(macs)))
+        return hook
+
+    for sub in net.sublayers(include_self=True):
+        counter = custom.get(type(sub))
+        if counter is None:
+            for cls, fn in _COUNTERS:
+                if type(sub) is cls:
+                    counter = fn
+                    break
+        if counter is not None:
+            handles.append(sub.register_forward_post_hook(make_hook(counter)))
+
+    was_training = getattr(net, "training", False)
+    net.eval()
+    try:
+        x = Tensor(np.zeros(tuple(input_size), np.float32))
+        net(x)
+    finally:
+        for h in handles:
+            if hasattr(h, "remove"):
+                h.remove()
+        if was_training:
+            net.train()
+
+    total = sum(r[4] for r in rows)
+    total_params = sum(r[3] for r in rows)
+    if print_detail:
+        print(f"{'Layer':<20}{'Input':<20}{'Output':<20}"
+              f"{'Params':>12}{'FLOPs':>16}")
+        for name, ishape, oshape, n_params, macs in rows:
+            print(f"{name:<20}{str(ishape):<20}{str(oshape):<20}"
+                  f"{n_params:>12}{macs:>16}")
+        print(f"Total params: {total_params}  Total FLOPs: {total}")
+    return total

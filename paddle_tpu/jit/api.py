@@ -66,6 +66,10 @@ class StaticFunction:
         return self._raw_fn(*args, **kwargs)
 
     def __call__(self, *args, **kwargs):
+        from . import sot_tape
+        # a compiled call inside an active tape recording computes arrays
+        # the recorder cannot see: invalidate the outer tape
+        sot_tape.taint_recording("nested compiled StaticFunction")
         state = {}
         if self._layer is not None:
             state = {name: p._value for name, p in self._layer.named_parameters()}

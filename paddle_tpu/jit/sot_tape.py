@@ -49,10 +49,19 @@ _GUARD_MAX_ELEMS = 65536
 # nested broken to_static calls must NOT replay their own tapes while an
 # outer recording is active — their eager ops need to land on the outer tape
 _recording_depth = [0]
+_recording_tainted = [False]
 
 
 def is_recording():
     return _recording_depth[0] > 0
+
+
+def taint_recording(reason=""):
+    """Called by code that computes arrays OUTSIDE the eager dispatch layer
+    while a tape is being recorded (e.g. a nested to_static call that runs
+    compiled): its outputs would be baked stale, so the tape must refuse."""
+    if _recording_depth[0] > 0:
+        _recording_tainted[0] = True
 
 
 class PathMismatch(Exception):
@@ -66,7 +75,7 @@ class _Untapeable(Exception):
 class _Recording:
     def __init__(self):
         self.ops = []        # dispatch records (name, vals, outs, impl, kw)
-        self.events = []     # (op_index_at_fetch, value_id, np_guard_array)
+        self.events = []     # (op_index_at_fetch, value_obj, np_guard_array)
 
 
 def record_tape(fn, inputs_named, state_tensors=()):
@@ -86,14 +95,20 @@ def record_tape(fn, inputs_named, state_tensors=()):
             arr = np.asarray(jax.device_get(value))
         except Exception:
             arr = None
-        rec.events.append((len(rec.ops), id(value), arr))
+        # hold the VALUE OBJECT (not just its id): a freed array's id could
+        # be recycled by a later op output, mis-wiring the guard
+        rec.events.append((len(rec.ops), value, arr))
 
     _dispatch._op_recorder[0] = rec.ops
     _concretize_hook[0] = on_concretize
     _recording_depth[0] += 1
+    prev_taint = _recording_tainted[0]
+    _recording_tainted[0] = False
     try:
         out = fn()
     finally:
+        tainted = _recording_tainted[0]
+        _recording_tainted[0] = prev_taint
         _recording_depth[0] -= 1
         _dispatch._op_recorder[0] = prev_rec
         _concretize_hook[0] = prev_hook
@@ -105,6 +120,8 @@ def record_tape(fn, inputs_named, state_tensors=()):
         return out, None
     if any(id(t._value) != i for t, i in zip(state_tensors, state_ids)):
         return out, None   # in-place state mutation: replay would skip it
+    if tainted:
+        return out, None   # nested compiled call: its outputs would bake
     try:
         prog = TapeProgram(rec, inputs_named, out)
     except Exception:
@@ -133,15 +150,18 @@ class TapeProgram:
                 if isinstance(o, (jnp.ndarray, jax.Array)):
                     self._refs.setdefault(id(o), ("op", op_cursor, j))
             self._records.append((impl, kw, in_refs, len(outs)))
-        if self._records and len(used_inputs) < len(self._input_names):
+        if not self._records:
+            # zero recorded ops: the output could only be a baked literal
+            raise _Untapeable("no recorded ops")
+        if len(used_inputs) < len(self._input_names):
             # some input's data reached the ops through an unrecorded
             # transform (AMP cast, numpy conversion): it would be baked
             # stale — refuse
             raise _Untapeable("unreferenced runtime input")
         # events -> (op_index, ref, np_guard)
         self._events = []
-        for op_idx, vid, guard_arr in rec.events:
-            ref = self._refs.get(vid)
+        for op_idx, vobj, guard_arr in rec.events:
+            ref = self._refs.get(id(vobj))
             if ref is None or guard_arr is None:
                 raise _Untapeable("unguardable concretize event")
             if guard_arr.size > _GUARD_MAX_ELEMS:
@@ -153,9 +173,15 @@ class TapeProgram:
         self._out_refs = []
         for leaf in self._out_leaves:
             v = leaf._value if isinstance(leaf, Tensor) else leaf
-            self._out_refs.append(self._ref_of(v)
-                                  if isinstance(v, (jnp.ndarray, jax.Array))
-                                  else ("lit", v))
+            if isinstance(v, (jnp.ndarray, jax.Array)):
+                r = self._ref_of(v)
+                if r[0] == "const":
+                    # an array output not derived from any recorded op or
+                    # input would replay stale
+                    raise _Untapeable("baked array output")
+                self._out_refs.append(r)
+            else:
+                self._out_refs.append(("lit", v))
         # segment boundaries (unique, sorted op indices of events)
         bounds = sorted({e[0] for e in self._events})
         self._segments = []

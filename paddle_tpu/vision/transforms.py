@@ -137,3 +137,249 @@ class Transpose:
 
     def __call__(self, img):
         return np.asarray(img).transpose(self.order)
+
+
+# ---------------------------------------------------------------------------
+# Round-4 breadth (reference transforms.py: color jitter family, rotation,
+# pad, grayscale, vertical flip, erasing)
+# ---------------------------------------------------------------------------
+def _as_float_hwc(img):
+    arr = np.asarray(img)
+    was_uint8 = arr.dtype == np.uint8
+    a = arr.astype(np.float32)
+    return a, was_uint8
+
+
+def _restore(a, was_uint8):
+    if was_uint8:
+        return np.clip(a, 0, 255).astype(np.uint8)
+    return a
+
+
+def adjust_brightness(img, factor):
+    """reference functional.adjust_brightness: pixel * factor."""
+    a, u8 = _as_float_hwc(img)
+    return _restore(a * float(factor), u8)
+
+
+def adjust_contrast(img, factor):
+    """Blend with the mean GRAYSCALE level (reference functional
+    adjust_contrast uses the luma mean, not the raw RGB mean)."""
+    a, u8 = _as_float_hwc(img)
+    if a.ndim == 3 and a.shape[-1] == 3:
+        mean = (a @ np.asarray([0.299, 0.587, 0.114], np.float32)).mean()
+    else:
+        mean = a.mean()
+    return _restore(mean + (a - mean) * float(factor), u8)
+
+
+def adjust_saturation(img, factor):
+    """Blend with the per-pixel grayscale."""
+    a, u8 = _as_float_hwc(img)
+    gray = (a @ np.asarray([0.299, 0.587, 0.114], np.float32))[..., None]
+    return _restore(gray + (a - gray) * float(factor), u8)
+
+
+def adjust_hue(img, factor):
+    """Shift hue by factor (in [-0.5, 0.5] turns) via HSV round-trip."""
+    if not -0.5 <= factor <= 0.5:
+        raise ValueError("hue factor must be in [-0.5, 0.5]")
+    a, u8 = _as_float_hwc(img)
+    scale = 255.0 if u8 else 1.0
+    x = a / scale
+    mx = x.max(-1)
+    mn = x.min(-1)
+    diff = mx - mn + 1e-12
+    r, g, b = x[..., 0], x[..., 1], x[..., 2]
+    h = np.where(mx == r, (g - b) / diff % 6,
+                 np.where(mx == g, (b - r) / diff + 2, (r - g) / diff + 4))
+    h = (h / 6.0 + factor) % 1.0
+    s = np.where(mx > 0, diff / (mx + 1e-12), 0.0)
+    v = mx
+    i = np.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1 - s)
+    q = v * (1 - f * s)
+    t = v * (1 - (1 - f) * s)
+    i = i.astype(np.int32) % 6
+    out = np.stack([
+        np.choose(i, [v, q, p, p, t, v]),
+        np.choose(i, [t, v, v, q, p, p]),
+        np.choose(i, [p, p, t, v, v, q]),
+    ], axis=-1)
+    return _restore(out * scale, u8)
+
+
+def to_grayscale(img, num_output_channels=1):
+    a, u8 = _as_float_hwc(img)
+    gray = a @ np.asarray([0.299, 0.587, 0.114], np.float32)
+    out = np.repeat(gray[..., None], num_output_channels, -1)
+    return _restore(out, u8)
+
+
+class BrightnessTransform:
+    """reference transforms.py BrightnessTransform: factor ~ U[max(0,1-v), 1+v]."""
+
+    def __init__(self, value):
+        self.value = float(value)
+
+    def __call__(self, img):
+        if self.value == 0:
+            return np.asarray(img)
+        f = np.random.uniform(max(0.0, 1 - self.value), 1 + self.value)
+        return adjust_brightness(img, f)
+
+
+class ContrastTransform(BrightnessTransform):
+    def __call__(self, img):
+        if self.value == 0:
+            return np.asarray(img)
+        f = np.random.uniform(max(0.0, 1 - self.value), 1 + self.value)
+        return adjust_contrast(img, f)
+
+
+class SaturationTransform(BrightnessTransform):
+    def __call__(self, img):
+        if self.value == 0:
+            return np.asarray(img)
+        f = np.random.uniform(max(0.0, 1 - self.value), 1 + self.value)
+        return adjust_saturation(img, f)
+
+
+class HueTransform:
+    def __init__(self, value):
+        if not 0 <= value <= 0.5:
+            raise ValueError("hue value must be in [0, 0.5]")
+        self.value = float(value)
+
+    def __call__(self, img):
+        if self.value == 0:
+            return np.asarray(img)
+        return adjust_hue(img, np.random.uniform(-self.value, self.value))
+
+
+class ColorJitter:
+    """reference transforms.py ColorJitter: random-order composition of
+    brightness/contrast/saturation/hue jitter."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        self._ts = [BrightnessTransform(brightness),
+                    ContrastTransform(contrast),
+                    SaturationTransform(saturation), HueTransform(hue)]
+
+    def __call__(self, img):
+        order = np.random.permutation(len(self._ts))
+        arr = np.asarray(img)
+        for i in order:
+            arr = self._ts[i](arr)
+        return arr
+
+
+class RandomVerticalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        if np.random.rand() < self.prob:
+            return np.asarray(img)[::-1].copy()
+        return np.asarray(img)
+
+
+class Grayscale:
+    def __init__(self, num_output_channels=1):
+        self.num_output_channels = num_output_channels
+
+    def __call__(self, img):
+        return to_grayscale(img, self.num_output_channels)
+
+
+class Pad:
+    """reference transforms.py Pad (constant/edge/reflect)."""
+
+    def __init__(self, padding, fill=0, padding_mode="constant"):
+        if isinstance(padding, int):
+            padding = (padding,) * 4           # l, t, r, b
+        elif len(padding) == 2:
+            padding = (padding[0], padding[1], padding[0], padding[1])
+        self.padding = padding
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        l, t, r, b = self.padding
+        spec = ((t, b), (l, r)) + ((0, 0),) * (arr.ndim - 2)
+        if self.padding_mode == "constant":
+            return np.pad(arr, spec, constant_values=self.fill)
+        return np.pad(arr, spec, mode=self.padding_mode)
+
+
+class RandomRotation:
+    """reference transforms.py RandomRotation: nearest-sample rotation about
+    the image center."""
+
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0):
+        if isinstance(degrees, (int, float)):
+            degrees = (-abs(degrees), abs(degrees))
+        self.degrees = degrees
+        self.fill = fill
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        angle = np.radians(np.random.uniform(*self.degrees))
+        h, w = arr.shape[:2]
+        cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
+        yy, xx = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+        # inverse map: output pixel -> source pixel
+        ys = cy + (yy - cy) * np.cos(angle) - (xx - cx) * np.sin(angle)
+        xs = cx + (yy - cy) * np.sin(angle) + (xx - cx) * np.cos(angle)
+        yi = np.round(ys).astype(np.int64)
+        xi = np.round(xs).astype(np.int64)
+        valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+        out = np.full_like(arr, self.fill)
+        out[valid] = arr[yi[valid], xi[valid]]
+        return out
+
+
+class RandomErasing:
+    """reference transforms.py RandomErasing on HWC/CHW arrays."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False):
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+
+    def __call__(self, img):
+        if np.random.rand() >= self.prob:
+            return img
+        is_tensor = isinstance(img, Tensor)
+        arr = img.numpy() if is_tensor else np.asarray(img)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3) and \
+            arr.shape[0] < arr.shape[-1]
+        h, w = (arr.shape[1], arr.shape[2]) if chw else arr.shape[:2]
+        area = h * w
+        out = arr.copy()
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.random.uniform(*self.ratio)
+            eh = int(round(np.sqrt(target * ar)))
+            ew = int(round(np.sqrt(target / ar)))
+            if eh < h and ew < w:
+                i = np.random.randint(0, h - eh)
+                j = np.random.randint(0, w - ew)
+                if chw:
+                    out[:, i:i + eh, j:j + ew] = self.value
+                else:
+                    out[i:i + eh, j:j + ew] = self.value
+                break
+        return Tensor(out) if is_tensor else out
+
+
+__all__ += ["BrightnessTransform", "ContrastTransform", "SaturationTransform",
+            "HueTransform", "ColorJitter", "RandomVerticalFlip", "Grayscale",
+            "Pad", "RandomRotation", "RandomErasing", "adjust_brightness",
+            "adjust_contrast", "adjust_saturation", "adjust_hue",
+            "to_grayscale"]

@@ -154,7 +154,7 @@ def test_graph_break_compiles_around_the_break():
     out4 = f(xneg)                         # replay of the second path
     np.testing.assert_allclose(float(out4.numpy()), expect, rtol=1e-5)
     # both value paths now have programs under the same guard key
-    assert sum(len(p) for p in f._tapes.values()) >= 2
+    assert sum(len(e["progs"]) for e in f._tapes.values()) >= 2
 
 
 def test_tape_replay_matches_eager_values():

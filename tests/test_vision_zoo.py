@@ -52,3 +52,51 @@ def test_densenet_variants_and_vgg_bn():
         M.DenseNet(layers=99)
     with pytest.raises(NotImplementedError):
         M.densenet121(pretrained=True)
+
+
+# ---------------------------------------------------------------------------
+# transforms breadth (reference vision/transforms/transforms.py)
+# ---------------------------------------------------------------------------
+def test_color_transforms_values():
+    from paddle_tpu.vision import transforms as T
+    img = (np.arange(48).reshape(4, 4, 3) * 5).astype(np.uint8)
+    np.testing.assert_allclose(T.adjust_brightness(img, 2.0),
+                               np.clip(img.astype(np.float32) * 2, 0, 255)
+                               .astype(np.uint8))
+    c = T.adjust_contrast(img, 0.0)
+    assert np.unique(c).size <= 2          # collapses toward the mean
+    g = T.to_grayscale(img, 3)
+    assert g.shape == img.shape
+    assert np.allclose(g[..., 0], g[..., 1])
+    # hue shift by a full turn is identity (float path)
+    f = img.astype(np.float32) / 255.0
+    np.testing.assert_allclose(T.adjust_hue(f, 0.0), f, atol=1e-3)
+
+
+def test_geometric_transforms():
+    from paddle_tpu.vision import transforms as T
+    img = np.arange(36).reshape(6, 6).astype(np.float32)[..., None]
+    np.random.seed(0)
+    out = T.RandomVerticalFlip(prob=1.0)(img)
+    np.testing.assert_allclose(out, img[::-1])
+    p = T.Pad(2)(img)
+    assert p.shape == (10, 10, 1)
+    r0 = T.RandomRotation((0, 0))(img)
+    np.testing.assert_allclose(r0, img)
+    er = T.RandomErasing(prob=1.0, value=7)(np.ones((8, 8, 3), np.float32))
+    assert (er == 7).any()
+    cj = T.ColorJitter(0.4, 0.4, 0.4, 0.1)
+    out = cj((np.random.rand(5, 5, 3) * 255).astype(np.uint8))
+    assert out.shape == (5, 5, 3)
+
+
+def test_paddle_flops_counts_conv_and_linear():
+    """paddle.flops (reference hapi/dynamic_flops.py:40)."""
+    from paddle_tpu import nn
+    net = nn.Sequential(nn.Conv2D(3, 8, 3, padding=1), nn.ReLU(),
+                        nn.Flatten(), nn.Linear(8 * 8 * 8, 10))
+    total = paddle.flops(net, (1, 3, 8, 8), print_detail=False)
+    conv_macs = 8 * 8 * 8 * 3 * 9 + 8 * 8 * 8   # + bias
+    lin_macs = 10 * 512 + 10
+    relu = 8 * 8 * 8
+    assert total == conv_macs + lin_macs + relu, total
