@@ -26,7 +26,7 @@ __all__ = [
     "Normal", "Uniform", "Bernoulli", "Categorical", "Beta", "Dirichlet",
     "Gamma", "Laplace", "LogNormal", "Multinomial", "Exponential",
     "Geometric", "Gumbel", "Poisson", "Cauchy", "Chi2", "StudentT",
-    "Binomial", "MultivariateNormal",
+    "Binomial", "MultivariateNormal", "ContinuousBernoulli",
 ]
 
 _HALF_LOG_2PI = 0.5 * math.log(2.0 * math.pi)
@@ -904,3 +904,106 @@ class MultivariateNormal(Distribution):
                 jnp.diagonal(tril, axis1=-2, axis2=-1)), -1)
             return d * (0.5 + _HALF_LOG_2PI) + logdet
         return op_call("dist_mvn_entropy", impl, Tensor(self._tril))
+
+
+class ContinuousBernoulli(ExponentialFamily):
+    """Continuous Bernoulli on [0, 1] (reference continuous_bernoulli.py:36;
+    Loaiza-Ganem & Cunningham 2019). log C(λ) uses the Taylor expansion in
+    the numerically-degenerate window around λ=0.5."""
+
+    def __init__(self, probs, lims=(0.499, 0.501), name=None):
+        self._wrap_params(probs=probs)
+        self.probs = _as_jnp(probs)
+        self._lims = lims
+        super().__init__(self.probs.shape, ())
+
+    @staticmethod
+    def _outside(p, lims):
+        return (p < lims[0]) | (p > lims[1])
+
+    def _log_norm(self, p):
+        # log C(λ) = log|2 artanh(1-2λ)| - log|1-2λ|  (λ != 1/2)
+        psafe = jnp.where(self._outside(p, self._lims), p, 0.25)
+        out = jnp.log(jnp.abs(jnp.log1p(-psafe) - jnp.log(psafe))) \
+            - jnp.log(jnp.abs(1 - 2 * psafe))
+        x = p - 0.5
+        taylor = jnp.log(2.0) + (4.0 / 3.0 + 104.0 / 45.0 * x * x) * x * x
+        return jnp.where(self._outside(p, self._lims), out, taylor)
+
+    def _mean_of(self, p):
+        psafe = jnp.where(self._outside(p, self._lims), p, 0.25)
+        m = psafe / (2 * psafe - 1) \
+            + 1 / (jnp.log1p(-psafe) - jnp.log(psafe))
+        x = p - 0.5
+        taylor = 0.5 + (1.0 / 3.0 + 16.0 / 45.0 * x * x) * x
+        return jnp.where(self._outside(p, self._lims), m, taylor)
+
+    @property
+    def mean(self):
+        return _t(self._mean_of(self.probs))
+
+    @property
+    def variance(self):
+        p = self.probs
+        psafe = jnp.where(self._outside(p, self._lims), p, 0.25)
+        v = psafe * (psafe - 1) / (1 - 2 * psafe) ** 2 \
+            + 1 / (jnp.log1p(-psafe) - jnp.log(psafe)) ** 2
+        x = (p - 0.5) ** 2
+        taylor = 1.0 / 12.0 - (1.0 / 15.0 - 128.0 / 945.0 * x) * x
+        return _t(jnp.where(self._outside(p, self._lims), v, taylor))
+
+    def _icdf(self, p, u):
+        psafe = jnp.where(self._outside(p, self._lims), p, 0.25)
+        x = (jnp.log1p(u * (2 * psafe - 1) / (1 - psafe))
+             / (jnp.log(psafe) - jnp.log1p(-psafe)))
+        return jnp.where(self._outside(p, self._lims), x, u)
+
+    def _sample(self, shape, key):
+        u = jax.random.uniform(key, shape + self.probs.shape)
+        return self._icdf(self.probs, u)
+
+    def rsample(self, shape=()):
+        shape = _sample_shape(shape)
+        u = jax.random.uniform(split_key(), shape + self.probs.shape)
+        return op_call("dist_contbern_rsample",
+                       lambda p: self._icdf(p, u), self._pt("probs"))
+
+    def log_prob(self, value):
+        def impl(p, v):
+            return (jsp.xlogy(v, p) + jsp.xlog1py(1 - v, -p)
+                    + self._log_norm(p))
+        return op_call("dist_contbern_log_prob", impl, self._pt("probs"),
+                       value)
+
+    def cdf(self, value):
+        def impl(p, v):
+            psafe = jnp.where(self._outside(p, self._lims), p, 0.25)
+            num = (jnp.exp(jsp.xlogy(v, psafe) + jsp.xlog1py(1 - v, -psafe))
+                   + psafe - 1)
+            c = jnp.where(self._outside(p, self._lims),
+                          num / (2 * psafe - 1), v)
+            return jnp.clip(c, 0.0, 1.0)
+        return op_call("dist_contbern_cdf", impl, self._pt("probs"), value)
+
+    def entropy(self):
+        def impl(p):
+            # mean derived from the TRACED p: entropy must stay
+            # differentiable w.r.t. probs (score-identity terms cancel
+            # only when m carries its own dependence on p)
+            m = self._mean_of(p)
+            return -(jsp.xlogy(m, p) + jsp.xlog1py(1 - m, -p)
+                     + self._log_norm(p))
+        return op_call("dist_contbern_entropy", impl, self._pt("probs"))
+
+    @property
+    def _natural_parameters(self):
+        return (jnp.log(self.probs) - jnp.log1p(-self.probs),)
+
+    def _log_normalizer(self, x):
+        out = jnp.log(jnp.abs(jnp.expm1(x))) - jnp.log(jnp.abs(x))
+        return jnp.where(jnp.abs(x) > 2e-3, out,
+                         x / 2 + jnp.log(1 + x * x / 24))
+
+    @property
+    def _mean_carrier_measure(self):
+        return 0.0

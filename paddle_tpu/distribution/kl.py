@@ -14,7 +14,8 @@ from .distribution import Distribution, ExponentialFamily
 from .distributions import (Normal, Uniform, Bernoulli, Categorical, Beta,
                             Dirichlet, Gamma, Laplace, LogNormal,
                             Exponential, Geometric, Poisson, Cauchy,
-                            MultivariateNormal)
+                            MultivariateNormal, Binomial,
+                            ContinuousBernoulli)
 
 __all__ = ["kl_divergence", "register_kl"]
 
@@ -221,3 +222,32 @@ def _kl_expfamily_expfamily(p, q):
         return out
     return op_call("kl_expfam_expfam", impl,
                    *[Tensor(n) for n in p_nat + q_nat])
+
+
+@register_kl(Binomial, Binomial)
+def _kl_binomial_binomial(p, q):
+    if p.total_count != q.total_count:
+        raise NotImplementedError(
+            "KL between Binomials requires equal total_count")
+    n = float(p.total_count)
+
+    def impl(pp, pq):
+        return n * (jsp.xlogy(pp, pp / pq)
+                    + jsp.xlog1py(1 - pp, -pp)
+                    - jsp.xlog1py(1 - pp, -pq))
+    return op_call("kl_binomial_binomial", impl, p._pt("probs"),
+                   q._pt("probs"))
+
+
+@register_kl(ContinuousBernoulli, ContinuousBernoulli)
+def _kl_contbern_contbern(p, q):
+    # E_p[log p - log q] with E_p[x] = mean(pp) derived from the TRACED
+    # probs so d KL / d probs is exact (reference kl.py:212)
+    def impl(pp, pq):
+        logit = lambda t: jnp.log(t) - jnp.log1p(-t)
+        mean_p = p._mean_of(pp)
+        return (p._log_norm(pp) - q._log_norm(pq)
+                + mean_p * (logit(pp) - logit(pq))
+                + jnp.log1p(-pp) - jnp.log1p(-pq))
+    return op_call("kl_contbern_contbern", impl, p._pt("probs"),
+                   q._pt("probs"))

@@ -374,3 +374,66 @@ def test_log_prob_gradients_through_tape():
     # d(-logp)/dmu = -mean((x-mu)/sig^2) = -mean(x)
     np.testing.assert_allclose(float(mu.grad.numpy()), -1 / 3, rtol=1e-4)
     assert np.isfinite(sig.grad.numpy())
+
+
+def test_continuous_bernoulli():
+    """reference continuous_bernoulli.py: density integrates to 1, moments
+    match numeric integration, KL matches Monte Carlo, rsample grads flow."""
+    from scipy.integrate import quad
+    for lam in (0.2, 0.4999, 0.7):
+        d = D.ContinuousBernoulli(lam)
+        pdf = lambda x: float(np.exp(d.log_prob(_t(np.float32(x))).numpy()))
+        Z, _ = quad(pdf, 0, 1)
+        np.testing.assert_allclose(Z, 1.0, rtol=1e-4)
+        m_num, _ = quad(lambda x: x * pdf(x), 0, 1)
+        np.testing.assert_allclose(float(d.mean.numpy()), m_num, rtol=1e-3)
+        v_num, _ = quad(lambda x: (x - m_num) ** 2 * pdf(x), 0, 1)
+        np.testing.assert_allclose(float(d.variance.numpy()), v_num,
+                                   rtol=2e-3, atol=1e-5)
+    paddle.seed(4)
+    d = D.ContinuousBernoulli(0.7)
+    s = np.asarray(d.sample((20000,)).numpy())
+    assert ((s >= 0) & (s <= 1)).all()
+    np.testing.assert_allclose(s.mean(), float(d.mean.numpy()), atol=0.01)
+    # KL closed form vs MC
+    q = D.ContinuousBernoulli(0.3)
+    kl = float(D.kl_divergence(d, q).numpy())
+    mc = _mc_kl(d, q, n=200000)
+    np.testing.assert_allclose(kl, mc, rtol=0.05, atol=0.01)
+    # rsample reparameterization
+    lam_t = paddle.to_tensor(np.float32(0.6), stop_gradient=False)
+    dd = D.ContinuousBernoulli(lam_t)
+    dd.rsample((128,)).mean().backward()
+    assert np.isfinite(lam_t.grad.numpy())
+    # entropy + KL gradients vs finite differences (zero-grad regression:
+    # the mean term must be derived from the traced probs)
+    eps = 1e-3
+
+    def fd(f):
+        return (f(0.7 + eps) - f(0.7 - eps)) / (2 * eps)
+
+    t = paddle.to_tensor(np.float32(0.7), stop_gradient=False)
+    D.ContinuousBernoulli(t).entropy().backward()
+    np.testing.assert_allclose(
+        float(t.grad.numpy()),
+        fd(lambda v: float(D.ContinuousBernoulli(v).entropy().numpy())),
+        rtol=2e-2)
+    t2 = paddle.to_tensor(np.float32(0.7), stop_gradient=False)
+    D.kl_divergence(D.ContinuousBernoulli(t2),
+                    D.ContinuousBernoulli(0.3)).backward()
+    np.testing.assert_allclose(
+        float(t2.grad.numpy()),
+        fd(lambda v: float(D.kl_divergence(
+            D.ContinuousBernoulli(v),
+            D.ContinuousBernoulli(0.3)).numpy())), rtol=2e-2)
+
+
+def test_binomial_binomial_kl():
+    p, q = D.Binomial(12, 0.3), D.Binomial(12, 0.6)
+    kl = float(D.kl_divergence(p, q).numpy())
+    # exact: n * KL(Bern(p)||Bern(q))
+    import scipy.stats as st_
+    exact = 12 * (0.3 * np.log(0.3 / 0.6) + 0.7 * np.log(0.7 / 0.4))
+    np.testing.assert_allclose(kl, exact, rtol=1e-5)
+    with pytest.raises(NotImplementedError):
+        D.kl_divergence(D.Binomial(5, 0.3), D.Binomial(7, 0.3))
