@@ -28,11 +28,26 @@ config.
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import numpy as np
 
 R2_BASELINE_TPS = 36285.8   # BENCH_r02.json, same config/chip class
+
+
+def _setup_compile_cache():
+    """Persistent XLA compilation cache (verified working over the axon
+    transport: 1.75 s cold -> 0.05 s warm cross-process). The SD-UNet config
+    timed out its r4 slice purely on compile time — with the cache primed
+    (perf/prime_cache.py, run whenever bench configs change) the driver's
+    run pays ~zero compile."""
+    import jax
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                   ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
 _PEAK_BF16 = (
     ("v5 lite", 197e12), ("v5litepod", 197e12), ("v5e", 197e12),
@@ -172,22 +187,30 @@ def bench_llama_long_context():
     return round(tps, 1)
 
 
-def bench_vit_l16():
-    """ViT-L/16 compiled functional train step, images/sec (BASELINE.md #2)."""
+def bench_vit_l16(B=64):
+    """ViT-L/16 framework train step (AdamW via apply_gradients_functional —
+    the same optimizer path every compiled trainer in the framework uses),
+    images/sec (BASELINE.md #2)."""
     import jax
     import jax.numpy as jnp
     import paddle_tpu as paddle
+    from paddle_tpu import optimizer
     from paddle_tpu.core.tensor import Tensor
     from paddle_tpu.nn.layer import functional_state
     from paddle_tpu.vision.models import vit_l_16
 
     on_tpu = any(d.platform == "tpu" for d in jax.devices())
-    B, steps, warmup = (32, 6, 1) if on_tpu else (2, 2, 1)
+    steps, warmup = (20, 2) if on_tpu else (2, 1)
+    if not on_tpu:
+        B = 2
     paddle.seed(0)
     model = vit_l_16(num_classes=1000)
     # bf16 everywhere on TPU (a partial cast breaks conv dtype checks)
     cast = (lambda v: v.astype(jnp.bfloat16)) if on_tpu else (lambda v: v)
     params = {n: cast(p._value) for n, p in model.named_parameters()}
+    opt = optimizer.AdamW(learning_rate=1e-4, parameters=[])
+    opt_state = opt.init_opt_state(params)
+    lr = jnp.asarray(1e-4, jnp.float32)
 
     def loss_fn(params, x, y):
         with functional_state(model, params):
@@ -196,41 +219,50 @@ def bench_vit_l16():
         logp = jax.nn.log_softmax(lv, -1)
         return -jnp.mean(jnp.take_along_axis(logp, y[:, None], -1))
 
-    @jax.jit
-    def step(params, x, y):
+    def step(params, opt_state, x, y):
         loss, g = jax.value_and_grad(loss_fn)(params, x, y)
-        new = jax.tree_util.tree_map(lambda p, gg: p - 1e-4 * gg.astype(p.dtype),
-                                     params, g)
-        return new, loss
+        new, new_state = opt.apply_gradients_functional(params, g, opt_state,
+                                                        lr=lr)
+        return new, new_state, loss
 
+    step = jax.jit(step, donate_argnums=(0, 1))
     rng = np.random.default_rng(0)
     x = cast(jnp.asarray(rng.normal(0, 1, (B, 3, 224, 224)).astype(np.float32)))
     y = jnp.asarray(rng.integers(0, 1000, (B,)).astype(np.int32))
     for _ in range(warmup):
-        params, loss = step(params, x, y)
+        params, opt_state, loss = step(params, opt_state, x, y)
     _sync(loss)
     t0 = time.perf_counter()
     for _ in range(steps):
-        params, loss = step(params, x, y)
+        params, opt_state, loss = step(params, opt_state, x, y)
     _sync(loss)
     return round(B * steps / (time.perf_counter() - t0), 1)
 
 
-def bench_resnet50():
-    """ResNet-50 compiled functional train step, images/sec (BASELINE.md #1;
-    the eager dygraph mode benches the per-op dispatch path instead, but its
-    ~50 unique conv shapes each pay a remote AOT compile on this chip —
-    the compiled step is the comparable throughput number. BN running stats
-    are frozen under the functional capture)."""
+def bench_resnet50(B=256):
+    """ResNet-50 framework train step (Momentum via
+    apply_gradients_functional), images/sec (BASELINE.md #1; the eager
+    dygraph mode benches the per-op dispatch path instead, but its ~50
+    unique conv shapes each pay a remote AOT compile on this chip — the
+    compiled step is the comparable throughput number. BN running stats are
+    frozen under the functional capture).
+
+    Round-5 notes: the r3 1959 img/s was measured with the early-returning
+    `block_until_ready` barrier (see _sync) and a 6-step window — not
+    trustworthy; this step uses a 30-step window and a device-get barrier.
+    B=256 (vs r4's 64) amortizes the small-spatial tail stages."""
     import jax
     import jax.numpy as jnp
     import paddle_tpu as paddle
+    from paddle_tpu import optimizer
     from paddle_tpu.core.tensor import Tensor
     from paddle_tpu.nn.layer import functional_state
     from paddle_tpu.vision.models import resnet50
 
     on_tpu = any(d.platform == "tpu" for d in jax.devices())
-    B, steps, warmup = (64, 6, 1) if on_tpu else (2, 1, 1)
+    steps, warmup = (30, 2) if on_tpu else (1, 1)
+    if not on_tpu:
+        B = 2
     paddle.seed(0)
     model = resnet50(num_classes=1000)
     model.eval()  # frozen BN stats; conv/bn compute unchanged
@@ -238,6 +270,9 @@ def bench_resnet50():
             if v.dtype == jnp.float32 else v) if on_tpu else (lambda v: v)
     params = {n: cast(p._value) for n, p in model.named_parameters()}
     buffers = {n: cast(b._value) for n, b in model.named_buffers()}
+    opt = optimizer.Momentum(learning_rate=1e-3, momentum=0.9, parameters=[])
+    opt_state = opt.init_opt_state(params)
+    lr = jnp.asarray(1e-3, jnp.float32)
 
     def loss_fn(params, x, y):
         full = dict(params)
@@ -248,22 +283,22 @@ def bench_resnet50():
         logp = jax.nn.log_softmax(lv, -1)
         return -jnp.mean(jnp.take_along_axis(logp, y[:, None], -1))
 
-    @jax.jit
-    def step(params, x, y):
+    def step(params, opt_state, x, y):
         loss, g = jax.value_and_grad(loss_fn)(params, x, y)
-        new = jax.tree_util.tree_map(lambda p, gg: p - 1e-3 * gg.astype(p.dtype),
-                                     params, g)
-        return new, loss
+        new, new_state = opt.apply_gradients_functional(params, g, opt_state,
+                                                        lr=lr)
+        return new, new_state, loss
 
+    step = jax.jit(step, donate_argnums=(0, 1))
     rng = np.random.default_rng(0)
     x = cast(jnp.asarray(rng.normal(0, 1, (B, 3, 224, 224)).astype(np.float32)))
     y = jnp.asarray(rng.integers(0, 1000, (B,)).astype(np.int32))
     for _ in range(warmup):
-        params, loss = step(params, x, y)
+        params, opt_state, loss = step(params, opt_state, x, y)
     _sync(loss)
     t0 = time.perf_counter()
     for _ in range(steps):
-        params, loss = step(params, x, y)
+        params, opt_state, loss = step(params, opt_state, x, y)
     _sync(loss)
     return round(B * steps / (time.perf_counter() - t0), 1)
 
@@ -280,7 +315,7 @@ def bench_ernie_mlm():
     from paddle_tpu.models.ernie import ErnieForMaskedLM, ernie_config_base
 
     on_tpu = any(d.platform == "tpu" for d in jax.devices())
-    B, S, steps, warmup = (16, 512, 6, 1) if on_tpu else (2, 64, 1, 1)
+    B, S, steps, warmup = (32, 512, 20, 2) if on_tpu else (2, 64, 1, 1)
     paddle.seed(0)
     cfg = ernie_config_base()
     model = ErnieForMaskedLM(cfg)
@@ -329,7 +364,7 @@ def bench_sd_unet():
     from paddle_tpu.models.unet import UNet2DConditionModel, unet_config_sd15
 
     on_tpu = any(d.platform == "tpu" for d in jax.devices())
-    B, steps, warmup = (4, 4, 1) if on_tpu else (1, 1, 1)
+    B, steps, warmup = (8, 10, 2) if on_tpu else (1, 1, 1)
     paddle.seed(0)
     model = UNet2DConditionModel(unet_config_sd15())
     cast = (lambda v: v.astype(jnp.bfloat16)
@@ -365,18 +400,72 @@ def bench_sd_unet():
     return round(B * steps / (time.perf_counter() - t0), 2)
 
 
+def bench_llama_decode():
+    """Decode/serving throughput on the 271M config (VERDICT r4 missing #6:
+    inference as a first-class perf surface, reference paddle/fluid/inference/).
+
+    Reports, for B in {1, 8}: prefill tokens/s (prompt 128) and steady-state
+    per-step decode tokens/s over the jitted KV-cache decode path
+    (`models/llama.py build_llama_decode`, cache bucketed to 256)."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.models.llama import (LlamaConfig, build_functional_llama,
+                                         _generate_executables)
+
+    cfg = LlamaConfig(vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+                      num_hidden_layers=16, num_attention_heads=16,
+                      num_key_value_heads=16, max_position_embeddings=2048)
+    ep, bp, hp, *_ = build_functional_llama(cfg, dtype=jnp.bfloat16, n_micro=1)
+    params = (ep, bp, hp)
+    T_prompt, n_decode = 128, 64
+    out = {}
+    rng = np.random.default_rng(0)
+    for B in (1, 8):
+        ids = jnp.asarray(rng.integers(0, cfg.vocab_size,
+                                       (B, T_prompt)).astype(np.int32))
+        prefill, decode, sample = _generate_executables(cfg, 256, 0.0, 0, 1.0,
+                                                        dtype=jnp.bfloat16)
+        key = jax.random.PRNGKey(0)
+        # warmup/compile
+        logits, cache = prefill(params, ids)
+        tok = sample(logits, key)
+        logits2, cache = decode(params, tok, cache)
+        _sync(logits2[0, 0])
+        # timed prefill (fresh cache each call)
+        n_pre = 8
+        t0 = time.perf_counter()
+        for _ in range(n_pre):
+            logits, cache = prefill(params, ids)
+        _sync(logits[0, 0])
+        pre_tps = B * T_prompt * n_pre / (time.perf_counter() - t0)
+        # timed decode loop (serving-shaped: sample + step per token)
+        logits, cache = prefill(params, ids)
+        tok = sample(logits, key)
+        t0 = time.perf_counter()
+        for _ in range(n_decode):
+            logits, cache = decode(params, tok, cache)
+            tok = sample(logits, key)
+        _sync(tok[0])
+        dec_tps = B * n_decode / (time.perf_counter() - t0)
+        out[f"b{B}"] = {"prefill_tokens_per_sec": round(pre_tps, 1),
+                        "decode_tokens_per_sec": round(dec_tps, 1)}
+    return out
+
+
 def main():
     import jax
+    _setup_compile_cache()
     t_start = time.perf_counter()
     res = bench_llama()
     extras = {}
     on_tpu = any(d.platform == "tpu" for d in jax.devices())
-    secondary = (("vit_l16_images_per_sec", bench_vit_l16, 200),
-                 ("resnet50_images_per_sec", bench_resnet50, 200),
+    secondary = (("vit_l16_images_per_sec", bench_vit_l16, 250),
+                 ("resnet50_images_per_sec", bench_resnet50, 250),
                  ("llama_271M_seq8192_tokens_per_sec",
-                  bench_llama_long_context, 200),
-                 ("ernie_base_mlm", bench_ernie_mlm, 200),
-                 ("sd15_unet_images_per_sec", bench_sd_unet, 300)) \
+                  bench_llama_long_context, 250),
+                 ("ernie_base_mlm", bench_ernie_mlm, 250),
+                 ("sd15_unet_images_per_sec", bench_sd_unet, 450),
+                 ("llama_271M_decode", bench_llama_decode, 250)) \
         if on_tpu else ()
     import signal
 
@@ -384,7 +473,7 @@ def main():
         raise TimeoutError("secondary bench exceeded its time slice")
 
     for name, fn, cap in secondary:
-        if time.perf_counter() - t_start > 800:
+        if time.perf_counter() - t_start > 1000:
             extras[name] = "skipped: bench time budget"
             continue
         try:

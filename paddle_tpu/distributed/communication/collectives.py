@@ -201,6 +201,18 @@ def _p2p_shift(group, peer, kind):
     me = g.rank
     if me < 0:
         me = 0
+    ranks = list(getattr(g, "ranks", []) or [])
+    if ranks and ranks != sorted(ranks):
+        # The ppermute perm addresses MESH-AXIS indices; the shift below is
+        # computed from group-local positions. These only coincide when the
+        # group's ranks are listed in axis order — a permuted order (e.g.
+        # new_group([1, 0])) would pass the axis-size check yet silently
+        # deliver to the wrong peer.
+        raise ValueError(
+            f"p2p group ranks {ranks} are not in ascending (mesh-axis) "
+            "order; group-local shifts would address the wrong axis "
+            "members. Create the group with sorted ranks, or use "
+            "lax.ppermute with an explicit perm.")
     peer_local = g.get_group_rank(peer)
     if peer_local < 0:
         raise ValueError(

@@ -24,9 +24,9 @@ __all__ = ["fused_rms_norm", "fused_layer_norm", "fused_rotary_position_embeddin
            "fused_linear_cross_entropy"]
 
 
-def fused_linear_cross_entropy_impl(x, weight, labels, n_chunks=8):
-    """jax-level core: per-token NLL of softmax(x @ weight) WITHOUT ever
-    materializing the [T, V] logits (reference intent: the CUDA
+def fused_linear_cross_entropy_impl(x, weight, labels, n_chunks=8, bias=None):
+    """jax-level core: per-token NLL of softmax(x @ weight [+ bias]) WITHOUT
+    ever materializing the [T, V] logits (reference intent: the CUDA
     c_softmax_with_cross_entropy / flash-like head kernels — here an
     online-logsumexp lax.scan over vocab chunks with a rematted body, so
     backward recomputes each chunk's logits and peak memory is O(T·V/n)).
@@ -36,7 +36,8 @@ def fused_linear_cross_entropy_impl(x, weight, labels, n_chunks=8):
     HBM (+41% tokens/s end-to-end vs the materialized head + full remat).
 
     x: [T, H] (any float dtype; logits accumulate in f32)
-    weight: [H, V]; labels: int [T]. Returns per-token NLL [T] (f32).
+    weight: [H, V]; labels: int [T]; bias: optional [V].
+    Returns per-token NLL [T] (f32).
     """
     T, H = x.shape
     V = weight.shape[1]
@@ -46,14 +47,23 @@ def fused_linear_cross_entropy_impl(x, weight, labels, n_chunks=8):
         n_chunks = next(d for d in range(n_chunks, 0, -1) if V % d == 0)
     C = V // n_chunks
     Wc = jnp.swapaxes(weight.reshape(H, n_chunks, C), 0, 1)  # [n, H, C]
+    # bias-free callers (the LLaMA head — the benched hot path) must not pay
+    # a scanned zeros add, so the xs tuple only carries a bias when one exists
+    Bc = (None if bias is None
+          else bias.astype(jnp.float32).reshape(n_chunks, C))
     lab = labels.reshape(-1).astype(jnp.int32)
 
     @jax.checkpoint
     def body(carry, xs):
         m, s, ll = carry
-        w, base = xs
+        if Bc is None:
+            w, base = xs
+        else:
+            w, b, base = xs
         logits = jax.lax.dot_general(x, w, (((1,), (0,)), ((), ())),
                                      preferred_element_type=jnp.float32)
+        if Bc is not None:
+            logits = logits + b[None, :]
         m_new = jnp.maximum(m, logits.max(-1))
         s = s * jnp.exp(m - m_new) + jnp.exp(logits - m_new[:, None]).sum(-1)
         rel = lab - base
@@ -66,19 +76,29 @@ def fused_linear_cross_entropy_impl(x, weight, labels, n_chunks=8):
     carry = (jnp.full((T,), -jnp.inf, jnp.float32),
              jnp.zeros((T,), jnp.float32), jnp.zeros((T,), jnp.float32))
     bases = jnp.arange(n_chunks, dtype=jnp.int32) * C
-    (m, s, ll), _ = jax.lax.scan(body, carry, (Wc, bases))
+    xs = (Wc, bases) if Bc is None else (Wc, Bc, bases)
+    (m, s, ll), _ = jax.lax.scan(body, carry, xs)
     return m + jnp.log(s) - ll
 
 
-def fused_linear_cross_entropy(x, weight, labels, n_chunks=8, name=None):
+def fused_linear_cross_entropy(x, weight, labels, n_chunks=8, bias=None,
+                               ignore_index=None, name=None):
     """Mean NLL of a linear head + softmax cross-entropy, vocab-chunked so
     the full logits tensor never exists (see fused_linear_cross_entropy_impl).
-    x: [..., H] is flattened over leading dims; labels matches them."""
-    def impl(xv, wv, lv):
+    x: [..., H] is flattened over leading dims; labels matches them.
+    With `ignore_index`, the mean runs over the non-ignored tokens only
+    (F.cross_entropy parity)."""
+    def impl(xv, wv, lv, *rest):
+        bv = rest[0] if rest else None
         x2 = xv.reshape(-1, xv.shape[-1])
-        return jnp.mean(fused_linear_cross_entropy_impl(
-            x2, wv, lv.reshape(-1), n_chunks=n_chunks))
-    return op_call("fused_linear_cross_entropy", impl, x, weight, labels)
+        nll = fused_linear_cross_entropy_impl(
+            x2, wv, lv.reshape(-1), n_chunks=n_chunks, bias=bv)
+        if ignore_index is None:
+            return jnp.mean(nll)
+        valid = (lv.reshape(-1) != ignore_index).astype(jnp.float32)
+        return jnp.sum(nll * valid) / jnp.maximum(jnp.sum(valid), 1.0)
+    args = (x, weight, labels) if bias is None else (x, weight, labels, bias)
+    return op_call("fused_linear_cross_entropy", impl, *args)
 
 
 def fused_rms_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-6,

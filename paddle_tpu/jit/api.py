@@ -11,6 +11,7 @@ key drawn per call (deterministic under paddle.seed).
 from __future__ import annotations
 
 import functools
+import logging
 from typing import Any, Callable, Optional
 
 import jax
@@ -222,8 +223,15 @@ class SymbolicStaticFunction(StaticFunction):
                 return out
             except PathMismatch:
                 continue
-            except Exception:
-                entry["progs"].remove(prog)  # stale tape: drop, keep probing
+            except Exception as e:  # noqa: BLE001 — staleness surfaces as
+                # KeyError/TypeError/ValueError depending on which segment
+                # drifted; dropping the tape and re-recording is the
+                # self-healing path. Log it so a genuine replay bug (OOM,
+                # compilation failure) is visible instead of silently eaten.
+                logging.getLogger(__name__).warning(
+                    "sot: dropping tape for %r after replay error %s: %s",
+                    guard, type(e).__name__, e)
+                entry["progs"].remove(prog)
         entry["misses"] += 1
         if len(entry["progs"]) >= self.max_tapes_per_guard:
             # cache full: recording again would only be thrown away

@@ -157,13 +157,17 @@ class ErnieForMaskedLM(Layer):
         seq, _ = self.ernie(input_ids, token_type_ids,
                             attention_mask=attention_mask)
         h = self.layer_norm(F.gelu(self.transform(seq)))
-        logits = self.decoder(h)
         if labels is not None:
-            loss = F.cross_entropy(
-                manip.reshape(logits, [-1, self.config.vocab_size]),
-                manip.reshape(labels, [-1]), ignore_index=ignore_index)
-            return loss, logits
-        return logits
+            # Vocab-chunked online-logsumexp head: the [B,S,V] logits tensor
+            # never materializes (same chunked-CE design that broke the LLaMA
+            # perf plateau, PERF.md §3) — loss matches
+            # F.cross_entropy(decoder(h), labels) to f32 accumulation.
+            from ..incubate.nn import functional as IF
+            loss = IF.fused_linear_cross_entropy(
+                h, self.decoder.weight, labels, n_chunks=8,
+                bias=self.decoder.bias, ignore_index=ignore_index)
+            return loss, None
+        return self.decoder(h)
 
 
 class ErnieForSequenceClassification(Layer):

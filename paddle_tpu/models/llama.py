@@ -696,7 +696,7 @@ def _sample_token(logits, key, temperature=1.0, top_k=0, top_p=1.0):
 
 def llama_generate(params, config: LlamaConfig, input_ids, max_new_tokens=32,
                    temperature=0.0, top_k=0, top_p=1.0, eos_token_id=None,
-                   seed=0, max_seq=None):
+                   seed=0, max_seq=None, dtype=None):
     """Compiled autoregressive generation over the KV-cache decode path
     (the PaddleNLP `model.generate` analog for the functional params).
 
@@ -726,7 +726,7 @@ def llama_generate(params, config: LlamaConfig, input_ids, max_new_tokens=32,
             f"exceeds the KV cache length {S_max}; raise max_seq / "
             "max_position_embeddings or generate fewer tokens")
     prefill, decode, sample = _generate_executables(
-        c, S_max, temperature, top_k, top_p)
+        c, S_max, temperature, top_k, top_p, dtype=dtype)
     key = jax.random.PRNGKey(seed)
 
     logits, cache = prefill(params, ids)
@@ -754,15 +754,19 @@ def llama_generate(params, config: LlamaConfig, input_ids, max_new_tokens=32,
 _GENERATE_CACHE = {}
 
 
-def _generate_executables(config, S_max, temperature, top_k, top_p):
+def _generate_executables(config, S_max, temperature, top_k, top_p,
+                          dtype=None):
     """(prefill, decode, sample) jitted once per key — new closures per call
-    would defeat jax.jit's cache entirely."""
+    would defeat jax.jit's cache entirely. `dtype` is the activation/KV-cache
+    compute dtype (None = f32; serve bf16 params with dtype=bf16)."""
     ckey = (tuple(sorted(config.__dict__.items())), S_max,
-            float(temperature), int(top_k), float(top_p))
+            float(temperature), int(top_k), float(top_p),
+            None if dtype is None else jnp.dtype(dtype).name)
     hit = _GENERATE_CACHE.get(ckey)
     if hit is not None:
         return hit
-    _, prefill, decode_step = build_llama_decode(config, max_seq=S_max)
+    _, prefill, decode_step = build_llama_decode(config, max_seq=S_max,
+                                                 dtype=dtype)
     entry = (jax.jit(prefill), jax.jit(decode_step),
              jax.jit(functools.partial(_sample_token, temperature=temperature,
                                        top_k=top_k, top_p=top_p)))

@@ -185,6 +185,10 @@ def _istft_exec(n_fft, hop_length, center, normalized, onesided, length,
             sig = sig[:, start: start + length]
             env = env[start: start + length]
         envmin = jnp.min(jnp.abs(env))
+        # NOLA-degenerate bins divide by ~0 — clamp so traced callers (where
+        # the eager-only hard NOLA error in istft() can't fire) get finite
+        # output instead of silent inf/nan; a healthy envelope is untouched.
+        env = jnp.where(jnp.abs(env) > 1e-11, env, jnp.ones_like(env))
         sig = sig / env
         return (sig[0] if v.ndim == 2 else sig), envmin
     return run
@@ -228,6 +232,9 @@ def istft(x, n_fft, hop_length=None, win_length=None, window=None,
     sig, envmin = op_call("istft", exec_fn, x,
                           w if isinstance(w, Tensor) else Tensor(wv))
     ev = envmin._value if isinstance(envmin, Tensor) else envmin
+    # The hard NOLA error is EAGER-ONLY: under jit/compiled pipelines envmin
+    # is a tracer, and the jitted body instead clamps degenerate envelope
+    # bins to 1 so traced callers degrade gracefully (finite output).
     if not isinstance(ev, jax.core.Tracer):
         if float(ev) < 1e-11:
             raise ValueError(

@@ -324,6 +324,19 @@ class RandomRotation:
             degrees = (-abs(degrees), abs(degrees))
         self.degrees = degrees
         self.fill = fill
+        # only the default sampling mode is implemented — raise instead of
+        # silently diverging from the reference for non-default arguments
+        if interpolation != "nearest":
+            raise NotImplementedError(
+                f"RandomRotation: interpolation={interpolation!r} is not "
+                "implemented (only 'nearest')")
+        if expand:
+            raise NotImplementedError(
+                "RandomRotation: expand=True is not implemented")
+        if center is not None:
+            raise NotImplementedError(
+                "RandomRotation: a custom center is not implemented "
+                "(rotation is about the image center)")
 
     def __call__(self, img):
         arr = np.asarray(img)

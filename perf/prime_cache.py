@@ -1,0 +1,15 @@
+"""Prime the persistent XLA compile cache (.jax_cache/) for every bench
+config by running the full bench once on the real chip. Run after any bench
+or model change so the driver's timed run pays ~zero compile.
+
+Usage: python perf/prime_cache.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import bench  # noqa: E402
+
+if __name__ == "__main__":
+    bench.main()

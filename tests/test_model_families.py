@@ -30,13 +30,23 @@ def test_ernie_mlm_forward_and_training():
     y = paddle.to_tensor(labels)
     losses = []
     for _ in range(12):
-        loss, logits = model(x, labels=y)
+        loss, _ = model(x, labels=y)
         loss.backward()
         opt.step()
         opt.clear_grad()
         losses.append(float(loss.numpy()))
+    with paddle.no_grad():
+        logits = model(x)           # inference path materializes logits
     assert tuple(logits.shape) == (4, 16, 200)
     assert losses[-1] < losses[0] * 0.8, losses
+    # chunked-CE training loss == dense-logits cross entropy (f32 accumulation)
+    from paddle_tpu.nn import functional as F
+    from paddle_tpu.tensor import manipulation as manip
+    loss2, _ = model(x, labels=y)
+    dense = F.cross_entropy(manip.reshape(logits.astype("float32"), [-1, 200]),
+                            manip.reshape(y, [-1]), ignore_index=-100)
+    np.testing.assert_allclose(float(loss2.numpy()), float(dense.numpy()),
+                               rtol=2e-5, atol=2e-5)
 
 
 def test_ernie_attention_mask_and_classifier():
