@@ -315,7 +315,7 @@ def bench_ernie_mlm():
     from paddle_tpu.models.ernie import ErnieForMaskedLM, ernie_config_base
 
     on_tpu = any(d.platform == "tpu" for d in jax.devices())
-    B, S, steps, warmup = (32, 512, 20, 2) if on_tpu else (2, 64, 1, 1)
+    B, S, steps, warmup = (64, 512, 20, 2) if on_tpu else (2, 64, 1, 1)
     paddle.seed(0)
     cfg = ernie_config_base()
     model = ErnieForMaskedLM(cfg)
@@ -472,16 +472,19 @@ def main():
     def _alarm(_sig, _frm):
         raise TimeoutError("secondary bench exceeded its time slice")
 
+    # priming mode (perf/prime_cache.py): no budget gate, no alarms — the
+    # whole point is to let every cold compile finish into the cache
+    no_caps = os.environ.get("BENCH_NO_CAPS") == "1"
     for name, fn, cap in secondary:
-        if time.perf_counter() - t_start > 1000:
+        if not no_caps and time.perf_counter() - t_start > 1000:
             extras[name] = "skipped: bench time budget"
             continue
         try:
             jax.clear_caches()  # release the previous bench's HBM footprint
             prev = signal.signal(signal.SIGALRM, _alarm)
-            signal.alarm(cap)   # hard cap per extra (remote AOT compile
-            try:                # can exceed any soft budget)
-                extras[name] = fn()
+            signal.alarm(0 if no_caps else cap)
+            try:                # hard cap per extra (remote AOT compile
+                extras[name] = fn()   # can exceed any soft budget)
             finally:
                 signal.alarm(0)
                 signal.signal(signal.SIGALRM, prev)

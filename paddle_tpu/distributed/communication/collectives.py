@@ -82,6 +82,39 @@ def _eager_allreduce_fn(mesh, spec, op, axis):
     return jax.jit(fn)
 
 
+@functools.lru_cache(maxsize=256)
+def _eager_crossproc_allreduce_fn(shape, dtype, op):
+    """Cross-process all-reduce for eager LOCAL arrays (the DataParallel
+    grad-hook path): stack the per-process values on a process mesh, psum
+    inside shard_map, read back this process's shard.  The pre-round-5
+    fallback silently returned the local value — half-magnitude DP grads
+    that no error ever surfaced (caught by the hapi distributed-fit
+    loss-curve test)."""
+    import numpy as _np
+    from jax import shard_map
+    n = jax.process_count()
+    # ONE device per process: hosts with several local chips would otherwise
+    # make the axis larger than the shard count we stack below
+    per_proc = {}
+    for d in jax.devices():
+        per_proc.setdefault(d.process_index, d)
+    devs = [per_proc[i] for i in sorted(per_proc)]
+    mesh = Mesh(_np.array(devs), ("_ar",))
+
+    def body(x):
+        return _reduce_traced(x, op, "_ar")
+    fn = jax.jit(shard_map(body, mesh=mesh, in_specs=(P("_ar"),),
+                           out_specs=P("_ar")))
+
+    def run(v):
+        g = jax.make_array_from_single_device_arrays(
+            (n,) + shape, NamedSharding(mesh, P("_ar")),
+            [jax.device_put(v[None], per_proc[jax.process_index()])])
+        out = fn(g)
+        return out.addressable_data(0)[0]
+    return run
+
+
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     """In place on the Tensor (reference semantics)."""
     v = tensor._value
@@ -94,6 +127,17 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     if mesh is not None and axis in mesh.axis_names and mesh.shape[axis] > 1:
         spec = v.sharding.spec
         out = _eager_allreduce_fn(mesh, spec, op, axis)(v)
+        tensor._set_value(out)
+        return tensor
+    if jax.process_count() > 1:
+        g = group if group is not None else get_group(0)
+        if getattr(g, "nranks", jax.process_count()) not in (
+                0, jax.process_count()):
+            raise NotImplementedError(
+                "eager all_reduce over a strict sub-group of processes is "
+                "not supported for local arrays — shard the tensor over a "
+                "mesh that names the group axis")
+        out = _eager_crossproc_allreduce_fn(v.shape, str(v.dtype), op)(v)
         tensor._set_value(out)
         return tensor
     # single participant: identity

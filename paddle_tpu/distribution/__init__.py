@@ -1,13 +1,14 @@
 """paddle.distribution parity (reference:
 python/paddle/distribution/__init__.py — 27-class family + kl registry +
 transforms). Implemented TPU-native over jnp/jax.random/jax.scipy.special;
-LKJCholesky is not yet ported (documented gap)."""
+round-5 adds LKJCholesky (onion + cvine samplers)."""
 from .distribution import Distribution, ExponentialFamily
 from .distributions import (Normal, Uniform, Bernoulli, Categorical, Beta,
                             Dirichlet, Gamma, Laplace, LogNormal,
                             Multinomial, Exponential, Geometric, Gumbel,
                             Poisson, Cauchy, Chi2, StudentT, Binomial,
-                            MultivariateNormal, ContinuousBernoulli)
+                            MultivariateNormal, ContinuousBernoulli,
+                            LKJCholesky)
 from .transformed_distribution import TransformedDistribution, Independent
 from .kl import kl_divergence, register_kl
 from .transform import (Transform, AbsTransform, AffineTransform,
@@ -21,7 +22,7 @@ __all__ = [
     "Categorical", "Beta", "Dirichlet", "Gamma", "Laplace", "LogNormal",
     "Multinomial", "Exponential", "Geometric", "Gumbel", "Poisson",
     "Cauchy", "Chi2", "StudentT", "Binomial", "MultivariateNormal",
-    "ContinuousBernoulli",
+    "ContinuousBernoulli", "LKJCholesky",
     "TransformedDistribution", "Independent", "kl_divergence", "register_kl",
     "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
     "ExpTransform", "IndependentTransform", "PowerTransform",

@@ -26,7 +26,7 @@ __all__ = [
     "Normal", "Uniform", "Bernoulli", "Categorical", "Beta", "Dirichlet",
     "Gamma", "Laplace", "LogNormal", "Multinomial", "Exponential",
     "Geometric", "Gumbel", "Poisson", "Cauchy", "Chi2", "StudentT",
-    "Binomial", "MultivariateNormal", "ContinuousBernoulli",
+    "Binomial", "MultivariateNormal", "ContinuousBernoulli", "LKJCholesky",
 ]
 
 _HALF_LOG_2PI = 0.5 * math.log(2.0 * math.pi)
@@ -1007,3 +1007,103 @@ class ContinuousBernoulli(ExponentialFamily):
     @property
     def _mean_carrier_measure(self):
         return 0.0
+
+
+class LKJCholesky(Distribution):
+    """LKJ distribution over Cholesky factors of correlation matrices
+    (reference python/paddle/distribution/lkj_cholesky.py:128; "Generating
+    random correlation matrices based on vines and extended onion method",
+    Lewandowski, Kurowicka & Joe 2009).
+
+    dim: correlation-matrix size D; concentration eta > 0 (eta = 1 is
+    uniform over correlation matrices).  sample_method: "onion" | "cvine".
+    Samples are lower-triangular [.., D, D] Cholesky factors with unit row
+    norms; log_prob matches the LKJ density on the Cholesky parametrization.
+    """
+
+    def __init__(self, dim=2, concentration=1.0, sample_method="onion",
+                 name=None):
+        if int(dim) < 2:
+            raise ValueError(f"dim must be >= 2, got {dim}")
+        if sample_method not in ("onion", "cvine"):
+            raise ValueError("sample_method should be 'onion' or 'cvine'")
+        self.dim = int(dim)
+        self._wrap_params(concentration=concentration)
+        self.concentration = _as_jnp(concentration)
+        self.sample_method = sample_method
+        marginal = self.concentration + 0.5 * (self.dim - 2)
+        off = jnp.arange(self.dim - 1, dtype=self.concentration.dtype)
+        if sample_method == "onion":
+            off = jnp.concatenate([jnp.zeros((1,), off.dtype), off])
+            self._beta = Beta(off + 0.5, marginal[..., None] - 0.5 * off)
+        else:
+            tri = jnp.tril(jnp.broadcast_to(
+                0.5 * off, (self.dim - 1, self.dim - 1)))
+            rows = jnp.tril_indices(self.dim - 1)
+            conc = marginal[..., None] - tri[rows]
+            self._beta = Beta(conc, conc)
+        super().__init__(self.concentration.shape, (self.dim, self.dim))
+
+    def _onion(self, shape, key):
+        kb, kn = jax.random.split(key)
+        y = self._beta._sample(shape, kb)[..., None]
+        D = self.dim
+        u = jax.random.normal(kn, shape + self._batch_shape + (D, D),
+                              dtype=y.dtype)
+        u = jnp.tril(u, -1)
+        norm = jnp.linalg.norm(u, axis=-1, keepdims=True)
+        u_hyp = u / jnp.where(norm == 0, 1.0, norm)
+        u_hyp = u_hyp.at[..., 0, :].set(0.0)
+        w = jnp.sqrt(y) * u_hyp
+        tiny = jnp.finfo(w.dtype).tiny
+        diag = jnp.sqrt(jnp.clip(1 - jnp.sum(w ** 2, -1), tiny))
+        return w + jnp.vectorize(jnp.diag, signature="(k)->(k,k)")(diag)
+
+    def _cvine(self, shape, key):
+        beta = self._beta._sample(shape, key)        # [.., D(D-1)/2]
+        pc = 2 * beta - 1
+        D = self.dim
+        rows = jnp.tril_indices(D - 1)
+        r = jnp.zeros(shape + self._batch_shape + (D - 1, D - 1), beta.dtype)
+        r = r.at[..., rows[0], rows[1]].set(pc)
+        tiny = jnp.finfo(beta.dtype).tiny
+        # pad into the [D, D] strictly-lower block
+        r_full = jnp.zeros(shape + self._batch_shape + (D, D), beta.dtype)
+        r_full = r_full.at[..., 1:, :-1].set(r)
+        r_full = jnp.clip(r_full, -1 + tiny, 1 - tiny)
+        z = r_full ** 2
+        cum = jnp.cumprod(jnp.sqrt(1 - z), axis=-1)
+        shifted = jnp.concatenate(
+            [jnp.ones_like(cum[..., :1]), cum[..., :-1]], axis=-1)
+        eye = jnp.eye(D, dtype=beta.dtype)
+        return jnp.tril((r_full + eye) * shifted)
+
+    def sample(self, shape=()):
+        shape = _sample_shape(shape)
+        key = split_key()
+
+        def impl(_c):
+            if self.sample_method == "onion":
+                return self._onion(shape, key)
+            return self._cvine(shape, key)
+        out = op_call("dist_lkj_sample", impl, self._pt("concentration"))
+        t = Tensor(out._value if isinstance(out, Tensor) else out)
+        t.stop_gradient = True
+        return t
+
+    def log_prob(self, value):
+        D = self.dim
+
+        def impl(conc, v):
+            diag = jnp.diagonal(v, axis1=-2, axis2=-1)[..., 1:]
+            order = jnp.arange(2, D + 1, dtype=conc.dtype)
+            order = 2 * (conc[..., None] - 1) + D - order
+            unnorm = jnp.sum(order * jnp.log(diag), -1)
+            dm1 = D - 1
+            alpha = conc + 0.5 * dm1
+            denom = jsp.gammaln(alpha) * dm1
+            numer = jsp.multigammaln(alpha - 0.5, dm1)
+            pi_const = 0.5 * dm1 * math.log(math.pi)
+            return unnorm - (pi_const + numer - denom)
+        return op_call("dist_lkj_log_prob", impl,
+                       self._pt("concentration"), value)

@@ -21,14 +21,54 @@ class Model:
         self._optimizer = None
         self._loss = None
         self._metrics = []
+        self._inputs = inputs if isinstance(inputs, (list, tuple)) else \
+            ([inputs] if inputs is not None else None)
+        self._amp_level = "O0"
+        self._amp_custom = {}
+        self._scaler = None
         self.stop_training = False
 
     def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        """amp_configs (reference model.py:1577): either a level string
+        ('O1'/'O2') or a dict {'level', 'custom_white_list',
+        'custom_black_list', 'init_loss_scaling', ...} — drives
+        amp.auto_cast around every train/eval forward and a GradScaler
+        around the backward (O2 additionally decorates the network/optimizer
+        to bf16 master weights via amp.decorate)."""
         self._optimizer = optimizer
         self._loss = loss
         self._metrics = metrics if isinstance(metrics, (list, tuple)) else \
             ([metrics] if metrics is not None else [])
+        if amp_configs is not None:
+            from .. import amp as amp_mod
+            if isinstance(amp_configs, str):
+                amp_configs = {"level": amp_configs}
+            level = amp_configs.get("level", "O1")
+            if level not in ("O0", "O1", "O2"):
+                raise ValueError(f"unsupported amp level {level!r}")
+            self._amp_level = level
+            self._amp_custom = {
+                k: amp_configs[k] for k in
+                ("custom_white_list", "custom_black_list") if k in amp_configs}
+            if level != "O0":
+                scaler_kw = {k: v for k, v in amp_configs.items()
+                             if k in ("init_loss_scaling", "incr_ratio",
+                                      "decr_ratio", "incr_every_n_steps",
+                                      "decr_every_n_nan_or_inf",
+                                      "use_dynamic_loss_scaling")}
+                self._scaler = amp_mod.GradScaler(**scaler_kw)
+            if level == "O2" and optimizer is not None:
+                self.network, self._optimizer = amp_mod.decorate(
+                    self.network, optimizer, level="O2")
         return self
+
+    def _amp_ctx(self):
+        from .. import amp as amp_mod
+        if self._amp_level in ("O1", "O2"):
+            return amp_mod.auto_cast(True, level=self._amp_level,
+                                     **self._amp_custom)
+        import contextlib
+        return contextlib.nullcontext()
 
     def _to_loader(self, data, batch_size, shuffle):
         if data is None:
@@ -42,15 +82,27 @@ class Model:
     def train_batch(self, inputs, labels=None, update=True):
         self.network.train()
         inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
-        outputs = self.network(*inputs)
-        losses = []
+        with self._amp_ctx():
+            outputs = self.network(*inputs)
+            losses = []
+            if self._loss is not None and labels is not None:
+                labels_l = labels if isinstance(labels, (list, tuple)) \
+                    else [labels]
+                loss = self._loss(outputs, *labels_l)
         if self._loss is not None and labels is not None:
-            labels_l = labels if isinstance(labels, (list, tuple)) else [labels]
-            loss = self._loss(outputs, *labels_l)
-            loss.backward()
-            if update:
-                self._optimizer.step()
-                self._optimizer.clear_grad()
+            if self._scaler is not None:
+                self._scaler.scale(loss).backward()
+                if update:
+                    self._scaler.step(self._optimizer)
+                    self._scaler.update()   # dynamic-scale bookkeeping:
+                    # without it an overflow would freeze the scale and
+                    # silently skip every subsequent step
+                    self._optimizer.clear_grad()
+            else:
+                loss.backward()
+                if update:
+                    self._optimizer.step()
+                    self._optimizer.clear_grad()
             losses.append(float(loss.numpy()))
         metrics = []
         if labels is not None:
@@ -64,7 +116,7 @@ class Model:
         self.network.eval()
         inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
         from ..core.dispatch import no_grad
-        with no_grad():
+        with no_grad(), self._amp_ctx():
             outputs = self.network(*inputs)
             losses = []
             if self._loss is not None and labels is not None:
@@ -147,6 +199,25 @@ class Model:
         return outs
 
     def save(self, path, training=True):
+        """training=True: checkpoint (params + optimizer state).
+        training=False: INFERENCE artifact — the StableHLO export via
+        jit.save, loadable with paddle.jit.load / the inference Predictor
+        (reference model.py:1472 Model.save's save_inference_model branch).
+        Requires input specs: pass them at construction
+        (Model(net, inputs=[InputSpec(...)])) or infer from static
+        metadata."""
+        if not training:
+            if not self._inputs:
+                raise ValueError(
+                    "Model.save(training=False) exports an inference "
+                    "artifact and needs input specs: construct the Model "
+                    "with inputs=[InputSpec(shape, dtype)]")
+            from ..jit import save as jit_save
+            net = self.network
+            inner = getattr(net, "_inner_layer", None)
+            jit_save(inner if isinstance(inner, Layer) else net, path,
+                     input_spec=list(self._inputs))
+            return
         framework.save(self.network.state_dict(), path + ".pdparams")
         if training and self._optimizer is not None:
             framework.save(self._optimizer.state_dict(), path + ".pdopt")

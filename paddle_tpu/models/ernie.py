@@ -153,15 +153,28 @@ class ErnieForMaskedLM(Layer):
         self.decoder = Linear(c.hidden_size, c.vocab_size)
 
     def forward(self, input_ids, token_type_ids=None, attention_mask=None,
-                labels=None, ignore_index=-100):
+                labels=None, ignore_index=-100, return_logits=False):
+        """With labels, returns (loss, logits_or_None).
+
+        The training loss runs through the vocab-chunked online-logsumexp
+        head (the same chunked-CE design that broke the LLaMA perf plateau,
+        PERF.md §3): the [B, S, V] logits tensor never materializes, and the
+        second element of the return is **None** — a deliberate departure
+        from the reference's (loss, prediction_scores) contract, because
+        materializing 40k-vocab logits nobody reads is exactly the HBM
+        traffic the head removes.  Callers that need the scores pass
+        `return_logits=True` to get the dense head + dense CE (identical
+        loss to f32 accumulation, reference-shaped return)."""
         seq, _ = self.ernie(input_ids, token_type_ids,
                             attention_mask=attention_mask)
         h = self.layer_norm(F.gelu(self.transform(seq)))
         if labels is not None:
-            # Vocab-chunked online-logsumexp head: the [B,S,V] logits tensor
-            # never materializes (same chunked-CE design that broke the LLaMA
-            # perf plateau, PERF.md §3) — loss matches
-            # F.cross_entropy(decoder(h), labels) to f32 accumulation.
+            if return_logits:
+                logits = self.decoder(h)
+                loss = F.cross_entropy(
+                    manip.reshape(logits, [-1, self.config.vocab_size]),
+                    manip.reshape(labels, [-1]), ignore_index=ignore_index)
+                return loss, logits
             from ..incubate.nn import functional as IF
             loss = IF.fused_linear_cross_entropy(
                 h, self.decoder.weight, labels, n_chunks=8,

@@ -8,5 +8,6 @@ from .norm import *  # noqa: F401,F403
 from .pooling import *  # noqa: F401,F403
 from .loss import *  # noqa: F401,F403
 from .attention import *  # noqa: F401,F403
+from .vision import *  # noqa: F401,F403
 
 from . import flash_attention  # noqa: F401

@@ -52,6 +52,24 @@ def _sdpa_ref(q, k, v, mask=None, dropout=0.0, causal=False, scale=None,
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+def _dispatch_flash_dropout(query, key, value, rate, causal):
+    """Route unmasked dropout attention through the in-kernel-dropout flash
+    op when registered (regenerable per-block mask — the [B,H,S,S] probs
+    never materialize); returns None when the kernel is unavailable so the
+    caller runs its XLA fallback.  Shared by scaled_dot_product_attention
+    and flash_attention."""
+    if get_kernel("flash_attention_dropout") is None:
+        return None
+    dk = split_key()
+    seed = jax.random.randint(dk, (), 0, 1 << 23).astype(jnp.float32)
+
+    def impl(q, k, v, sd, rate=None, causal=None):
+        return _sdpa_ref(q, k, v, dropout=rate, causal=causal,
+                         dropout_key=dk)
+    return op_call("flash_attention_dropout", impl, query, key, value,
+                   seed, rate=float(rate), causal=bool(causal))
+
+
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False, training=True,
                                  name=None):
@@ -65,6 +83,10 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
             return _sdpa_ref(q, k, v, causal=is_causal)
         name_ = "flash_attention_causal" if is_causal else "flash_attention"
         return op_call(name_, impl, query, key, value)
+    if attn_mask is None and use_dropout:
+        out = _dispatch_flash_dropout(query, key, value, dropout_p, is_causal)
+        if out is not None:
+            return out
     dk = split_key() if use_dropout else None
     def impl(q, k, v, *rest):
         m = rest[0] if rest else None
@@ -79,6 +101,10 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
                     training=True, name=None):
     """reference flash_attention.py:358. Returns (out, softmax_lse-like None)."""
     use_dropout = dropout > 0.0 and training
+    if use_dropout:
+        out = _dispatch_flash_dropout(query, key, value, dropout, causal)
+        if out is not None:
+            return out, None
     dk = split_key() if use_dropout else None
     def impl(q, k, v):
         return _sdpa_ref(q, k, v, dropout=dropout if training else 0.0,
@@ -114,12 +140,14 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
                                                             _np.asarray(cu_k))))
             except Exception:
                 same_boundaries = False  # traced: can't prove equality
-        if tq == tk and same_boundaries and not use_dropout and scale is None:
-            # Pallas varlen kernel: block-diagonal via in-kernel segment ids
+        if tq == tk and same_boundaries and scale is None:
+            # Pallas varlen kernel: block-diagonal via in-kernel segment
+            # ids; dropout (if any) runs in-kernel too
             varlen_k = get_kernel("flash_attention_varlen")
             if varlen_k is not None:
                 out = varlen_k(q[None], k[None], v[None], seg_q[None],
-                               causal=causal)
+                               causal=causal,
+                               rate=float(dropout) if use_dropout else 0.0)
                 if out is not None:
                     return out[0]
         mask = seg_q[:, None] == seg_k[None, :]
@@ -129,7 +157,8 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
             mask = mask & (pos_q[:, None] >= pos_k[None, :])
         out = _sdpa_ref(q[None], k[None], v[None], mask=mask[None, None],
                         dropout=dropout if training else 0.0, causal=False,
-                        scale=scale)
+                        scale=scale,
+                        dropout_key=split_key() if use_dropout else None)
         return out[0]
     out = op_call("flash_attn_unpadded", impl, query, key, value)
     return out, None

@@ -1,0 +1,151 @@
+"""Vision functionals: grid_sample + affine_grid (reference:
+python/paddle/nn/functional/vision.py:80 affine_grid, :139 grid_sample).
+
+TPU-first design: the sampler is pure gather + elementwise arithmetic —
+one fused XLA program, fully differentiable w.r.t. both the input and the
+grid (the reference ships dedicated CUDA fwd/bwd kernels; here jax.vjp
+derives the backward through the same gathers).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import op_call
+
+__all__ = ["grid_sample", "affine_grid"]
+
+
+def _unnormalize(coord, size, align_corners):
+    """[-1, 1] grid coordinate -> pixel coordinate."""
+    if align_corners:
+        return (coord + 1.0) * 0.5 * (size - 1)
+    return ((coord + 1.0) * size - 1.0) * 0.5
+
+
+def _reflect(x, lo, hi):
+    """Reflect x into [lo, hi] (inclusive), the 'reflection' padding rule."""
+    rng = hi - lo
+    if rng <= 0:
+        return jnp.zeros_like(x)
+    dbl = 2 * rng
+    x = jnp.mod(jnp.abs(x - lo), dbl)
+    return lo + jnp.minimum(x, dbl - x)
+
+
+def _resolve(coord, size, padding_mode, align_corners):
+    """Apply the padding rule to an (unnormalized, float) coordinate.
+    Returns (coord, in_bounds_weight_mask_needed)."""
+    if padding_mode == "border":
+        return jnp.clip(coord, 0, size - 1)
+    if padding_mode == "reflection":
+        if align_corners:
+            coord = _reflect(coord, 0.0, float(size - 1))
+        else:
+            coord = _reflect(coord, -0.5, size - 0.5)
+        return jnp.clip(coord, 0, size - 1)
+    return coord  # zeros: handled by masking the gathered values
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """Sample `x` at `grid` locations (reference vision.py:139).
+
+    x: [N, C, H, W] (4-D) or [N, C, D, H, W] (5-D)
+    grid: [N, Ho, Wo, 2] ((x, y) in [-1, 1]) or [N, Do, Ho, Wo, 3]
+    mode: 'bilinear' | 'nearest'; padding_mode: 'zeros'|'border'|'reflection'
+    """
+    if mode not in ("bilinear", "nearest"):
+        raise ValueError(f"unsupported mode {mode!r}")
+    if padding_mode not in ("zeros", "border", "reflection"):
+        raise ValueError(f"unsupported padding_mode {padding_mode!r}")
+    nd = len(x.shape) - 2
+    if nd not in (2, 3):
+        raise ValueError(f"x must be 4-D or 5-D, got rank {len(x.shape)}")
+    if len(grid.shape) != nd + 2 or grid.shape[-1] != nd:
+        raise ValueError(
+            f"grid rank/last-dim must match x: expected [N, ...spatial, {nd}]"
+            f", got {tuple(grid.shape)}")
+
+    def impl(xv, gv):
+        sizes = xv.shape[2:]                     # (H, W) or (D, H, W)
+        # grid's last dim orders coords fastest-varying first: (x, y[, z])
+        # i.e. gv[..., 0] indexes W, gv[..., 1] indexes H, gv[..., 2] D
+        coords = []
+        for i in range(nd):
+            size = sizes[nd - 1 - i]
+            c = _unnormalize(gv[..., i].astype(jnp.float32), size,
+                             align_corners)
+            coords.append(_resolve(c, size, padding_mode, align_corners))
+        coords = coords[::-1]                    # now ordered like sizes
+
+        def gather(idx_list):
+            """idx_list: int coords per spatial dim, each [N, *out_sp].
+            Returns [N, C, *out_sp] with zeros-mode OOB masked."""
+            valid = None
+            gather_idx = []
+            for i, idx in enumerate(idx_list):
+                size = sizes[i]
+                ok = (idx >= 0) & (idx <= size - 1)
+                valid = ok if valid is None else (valid & ok)
+                gather_idx.append(jnp.clip(idx, 0, size - 1))
+            n = xv.shape[0]
+            bidx = jnp.arange(n).reshape((n,) + (1,) * (gv.ndim - 2))
+            bidx = jnp.broadcast_to(bidx, gather_idx[0].shape)
+            # [N, *out_sp, C] -> [N, C, *out_sp]
+            vals = xv.transpose((0,) + tuple(range(2, xv.ndim)) + (1,))[
+                (bidx,) + tuple(gather_idx)]
+            vals = jnp.moveaxis(vals, -1, 1)
+            if padding_mode == "zeros":
+                vals = vals * valid[:, None].astype(vals.dtype)
+            return vals
+
+        if mode == "nearest":
+            idx = [jnp.round(c).astype(jnp.int32) for c in coords]
+            return gather(idx).astype(xv.dtype)
+
+        # bilinear / trilinear: corner product over 2^nd corners
+        lo = [jnp.floor(c) for c in coords]
+        frac = [c - l for c, l in zip(coords, lo)]
+        out = None
+        for corner in range(2 ** nd):
+            idx = []
+            w = None
+            for i in range(nd):
+                hi_side = (corner >> i) & 1
+                ci = lo[i] + hi_side
+                wi = frac[i] if hi_side else (1.0 - frac[i])
+                idx.append(ci.astype(jnp.int32))
+                w = wi if w is None else w * wi
+            contrib = gather(idx) * w[:, None]
+            out = contrib if out is None else out + contrib
+        return out.astype(xv.dtype)
+
+    return op_call("grid_sample", impl, x, grid)
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """2-D/3-D sampling grid from batched affine matrices (reference
+    vision.py:80).  theta [N, 2, 3] -> grid [N, H, W, 2];
+    theta [N, 3, 4] -> grid [N, D, H, W, 3].  out_shape: [N, C, H, W] or
+    [N, C, D, H, W]."""
+    shape = [int(s) for s in out_shape]
+    nd = len(shape) - 2
+    if nd not in (2, 3):
+        raise ValueError("out_shape must have 4 or 5 entries")
+
+    def impl(tv):
+        def base(size):
+            if align_corners:
+                return jnp.linspace(-1.0, 1.0, size)
+            return (jnp.arange(size, dtype=jnp.float32) * 2 + 1) / size - 1.0
+        axes = [base(s) for s in shape[2:]]          # D?, H, W
+        mesh = jnp.meshgrid(*axes, indexing="ij")
+        # homogeneous coords ordered (x, y[, z]) = (W, H[, D])
+        ones = jnp.ones_like(mesh[0])
+        cols = list(mesh[::-1]) + [ones]
+        pts = jnp.stack([c.reshape(-1) for c in cols], -1)  # [P, nd+1]
+        grid = jnp.einsum("pk,nik->npi", pts, tv.astype(jnp.float32))
+        return grid.reshape((tv.shape[0],) + tuple(shape[2:]) + (nd,))
+
+    return op_call("affine_grid", impl, theta)

@@ -74,15 +74,31 @@ def _rms_norm_pallas(x, *rest, epsilon=1e-6):
     return rms_norm_ref(x, rest[0] if rest else None, epsilon)
 
 
-def _fa_varlen(q, k, v, seg, causal=False):
-    """Segment-masked (varlen) flash attention; None on unsupported shapes
-    so the caller's block-diagonal XLA fallback runs."""
-    return fa_mod.flash_attention(q, k, v, causal=causal, segment_ids=seg)
+def _fa_varlen(q, k, v, seg, causal=False, rate=0.0, seed=None):
+    """Segment-masked (varlen) flash attention, optionally with in-kernel
+    dropout; None on unsupported shapes so the caller's block-diagonal XLA
+    fallback runs."""
+    return fa_mod.flash_attention(q, k, v, causal=causal, segment_ids=seg,
+                                  dropout_rate=rate, dropout_seed=seed)
 
 
 def _fa_plain(q, k, v):
     out = fa_mod.flash_attention(q, k, v, causal=False)
     return out if out is not None else _naive_sdpa(q, k, v, False)
+
+
+def _fa_dropout(q, k, v, seed, rate=0.1, causal=False):
+    """Attention-probability dropout INSIDE the flash kernel (the mask is
+    regenerated per block from `seed`, never materialized) — keeps
+    dropout-training attention off the [B,H,S,S]-materializing XLA path.
+    Falls back to the fused-softmax XLA path on unsupported shapes."""
+    out = fa_mod.flash_attention(q, k, v, causal=causal, dropout_rate=rate,
+                                 dropout_seed=seed)
+    if out is not None:
+        return out
+    from ...nn.functional.attention import _sdpa_ref
+    key = jax.random.PRNGKey(jnp.asarray(seed, jnp.int32))
+    return _sdpa_ref(q, k, v, dropout=rate, causal=causal, dropout_key=key)
 
 
 def _fa_causal(q, k, v):
@@ -105,6 +121,7 @@ def register_all(force=False):
         return
     register_kernel("flash_attention", impl="pallas")(_fa_plain)
     register_kernel("flash_attention_causal", impl="pallas")(_fa_causal)
+    register_kernel("flash_attention_dropout", impl="pallas")(_fa_dropout)
     register_kernel("rms_norm", impl="pallas")(_rms_norm_pallas)
     register_kernel("flash_attention_varlen", impl="pallas")(_fa_varlen)
     # softmax/layer_norm kernels are opt-in (FLAGS_use_pallas_norm_kernels,

@@ -46,12 +46,31 @@ def _block_sizes(s_q, s_k, d):
 # ---------------------------------------------------------------------------
 # Forward
 # ---------------------------------------------------------------------------
+def _dropout_mask(seed_f32, qh, i, j, n_i, n_j, shape, rate):
+    """Regenerable keep-mask scale for block (qh, i, j): seeds the per-core
+    PRNG deterministically so the backward kernels rebuild the identical
+    mask without it ever hitting HBM (the same trick the reference's CUDA
+    FA uses with its philox offset).  The TPU PRNG takes at most two seed
+    words, so the block coordinates mix into one int32 (unique per block:
+    i < n_i, j < n_j are grid sizes)."""
+    mix = (qh * n_i + i) * n_j + j
+    pltpu.prng_seed(jnp.int32(seed_f32), mix)
+    bits = pltpu.bitcast(pltpu.prng_random_bits(shape), jnp.uint32)
+    thresh = jnp.uint32(int(rate * 4294967296.0))
+    keep = bits >= thresh                       # P(keep) = 1 - rate
+    return keep.astype(jnp.float32) / (1.0 - rate)
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, *rest, causal, sm_scale, block_q,
-                block_k, num_k_blocks, offset, has_segments=False):
+                block_k, num_k_blocks, offset, has_segments=False,
+                dropout_rate=0.0, num_q_blocks=1):
+    rest = list(rest)
+    qseg_ref = kseg_ref = seed_ref = None
     if has_segments:
-        qseg_ref, kseg_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
-    else:
-        o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
+        qseg_ref, kseg_ref = rest.pop(0), rest.pop(0)
+    if dropout_rate > 0.0:
+        seed_ref = rest.pop(0)
+    o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
     j = pl.program_id(2)  # k-block index (innermost, reduction)
     i = pl.program_id(1)  # q-block index
 
@@ -89,9 +108,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, causal, sm_scale, block_q,
         m_new = jnp.maximum(m_prev, m_cur)
         p = jnp.exp(s - m_new)            # [bq, bk]
         alpha = jnp.exp(m_prev - m_new)   # [bq, 1]
+        # the softmax DENOMINATOR uses the un-dropped p (dropout applies to
+        # the normalized probabilities); only the V accumulation is masked
         l_new = alpha * l_scr[:] + jnp.sum(p, axis=1, keepdims=True)
+        pd = p
+        if dropout_rate > 0.0:
+            b = pl.program_id(0)
+            pd = p * _dropout_mask(seed_ref[0], b, i, j, num_q_blocks,
+                                   num_k_blocks, (block_q, block_k),
+                                   dropout_rate)
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            pd.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_scr[:] = m_new
         l_scr[:] = l_new
@@ -102,6 +129,27 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, causal, sm_scale, block_q,
         inv = jnp.where(l > 0.0, 1.0 / jnp.where(l > 0.0, l, 1.0), 0.0)
         o_ref[0] = (acc_scr[:] * inv).astype(o_ref.dtype)
         lse_ref[0] = m_scr[:] + jnp.log(jnp.maximum(l, 1e-30))
+
+
+def _pack_lse(lse3, interpret=False):
+    """[bh, s, 1] (tile-padded 1 -> 128 lanes in HBM: 128x memory) ->
+    compact [bh, s] via a repack kernel.  A plain squeeze does NOT work:
+    XLA lowers it as a bitcast that keeps the padded layout alive — with 24
+    saved lse residuals that measured 6 GB of pure padding (the r5 ViT
+    OOM).  Full-row blocks keep both sides tiling-compliant."""
+    bh, s, _ = lse3.shape
+
+    def kern(x_ref, o_ref):
+        o_ref[0] = x_ref[0][:, 0].reshape(s // 128, 128)
+
+    out = pl.pallas_call(
+        kern, grid=(bh,),
+        in_specs=[pl.BlockSpec((1, s, 1), lambda b: (b, 0, 0))],
+        out_specs=pl.BlockSpec((1, s // 128, 128), lambda b: (b, 0, 0)),
+        out_shape=_sds((bh, s // 128, 128), lse3.dtype, _vma_of(lse3)),
+        interpret=interpret,
+    )(lse3)
+    return out.reshape(bh, s)
 
 
 def _vma_of(*arrs):
@@ -121,7 +169,8 @@ def _sds(shape, dtype, vma):
 
 def flash_attention_fwd_kernel_call(q, k, v, causal, sm_scale, interpret=False,
                                     n_q_heads=None, n_kv_heads=None,
-                                    segment_ids=None):
+                                    segment_ids=None, dropout_rate=0.0,
+                                    dropout_seed=None):
     """q: [B*Hq, S, D], k/v: [B*Hkv, S, D] -> (o [B*Hq, Sq, D], lse).
 
     GQA (n_kv_heads < n_q_heads) is handled in the BlockSpec index maps: the
@@ -143,7 +192,8 @@ def flash_attention_fwd_kernel_call(q, k, v, causal, sm_scale, interpret=False,
     kernel = functools.partial(
         _fwd_kernel, causal=causal, sm_scale=sm_scale, block_q=block_q,
         block_k=block_k, num_k_blocks=s_k // block_k, offset=s_k - s_q,
-        has_segments=has_seg)
+        has_segments=has_seg, dropout_rate=dropout_rate,
+        num_q_blocks=s_q // block_q)
 
     in_specs = [
         pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -152,14 +202,21 @@ def flash_attention_fwd_kernel_call(q, k, v, causal, sm_scale, interpret=False,
     ]
     args = [q, k, v]
     if has_seg:
-        # segment ids per batch row [B, S] (f32), broadcast over heads
-        seg3 = segment_ids[:, :, None]   # [B, S, 1]: TPU tiling wants
-        in_specs += [                     # (8·k, full-last-dim) blocks
+        # segment ids per batch row [B, S] (f32), broadcast over heads.
+        # The [B, S, 1] kernel view tile-pads 1 -> 128 lanes, but only as a
+        # TRANSIENT around this call (the caller holds compact [B, S]) —
+        # TPU Pallas requires the last two block dims (8, 128)-aligned, so
+        # a 2-D (1, block) spec is not lowerable.
+        seg3 = segment_ids[:, :, None]
+        in_specs += [
             pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b // hq, i, 0)),
             pl.BlockSpec((1, block_k, 1), lambda b, i, j: (b // hq, j, 0)),
         ]
         args += [seg3, seg3]
-    return pl.pallas_call(
+    if dropout_rate > 0.0:
+        in_specs += [pl.BlockSpec(memory_space=pltpu.SMEM)]
+        args += [jnp.asarray(dropout_seed, jnp.float32).reshape(1)]
+    o, lse3 = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=in_specs,
@@ -180,18 +237,41 @@ def flash_attention_fwd_kernel_call(q, k, v, causal, sm_scale, interpret=False,
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(*args)
+    # COMPACT 2-D lse for the caller: the [bh, s, 1] kernel output tile-pads
+    # its last dim 1 -> 128 in HBM (measured 128x, 256 MB per ViT layer);
+    # _pack_lse forces a real re-layout (a squeeze is just a bitcast) so
+    # saved residuals cost s_q * 4 bytes per row, not 512
+    return o, _pack_lse(lse3, interpret=interpret)
 
 
 # ---------------------------------------------------------------------------
 # Backward
 # ---------------------------------------------------------------------------
+def _col_from_packed(ref, i, block_q, scr):
+    """Load this q-block's per-row stats from a COMPACT [s//128, 128] packed
+    row into a [block_q, 1] VMEM column.  The slice-store loop is the
+    relayout Mosaic can lower (a lanes->sublanes reshape is not); keeping
+    lse/delta packed end-to-end means the backward never materializes the
+    128x tile-padded [bh, s, 1] HBM tensors (the r5 ViT OOM came back via
+    scheduler-hoisted unpack kernels)."""
+    nch = block_q // 128
+    chunk = ref[0, pl.ds(i * nch, nch)]            # [bq//128, 128]
+    for t in range(nch):
+        scr[t * 128:(t + 1) * 128, 0] = chunk[t]
+    return scr[:]
+
+
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
                     causal, sm_scale, block_q, block_k, num_q_blocks,
-                    rep_heads, offset, has_segments=False):
+                    rep_heads, offset, has_segments=False, dropout_rate=0.0,
+                    hq=1, hkv=1, num_k_blocks=1):
+    rest = list(rest)
+    qseg_ref = kseg_ref = seed_ref = None
     if has_segments:
-        qseg_ref, kseg_ref, dk_ref, dv_ref, dk_scr, dv_scr = rest
-    else:
-        dk_ref, dv_ref, dk_scr, dv_scr = rest
+        qseg_ref, kseg_ref = rest.pop(0), rest.pop(0)
+    if dropout_rate > 0.0:
+        seed_ref = rest.pop(0)
+    dk_ref, dv_ref, dk_scr, dv_scr, lse_scr, delta_scr = rest
     # grid (bh_kv, j, rr, i): rr walks the rep q-heads sharing this kv head
     # (GQA — dk/dv accumulate over them), i walks q blocks
     j = pl.program_id(1)  # k-block
@@ -213,8 +293,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
         k = k_ref[0]
         v = v_ref[0]
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0]                                # [bq, 1]
-        delta = delta_ref[0]                            # [bq, 1]
+        lse = _col_from_packed(lse_ref, i, block_q, lse_scr)    # [bq, 1]
+        delta = _col_from_packed(delta_ref, i, block_q, delta_scr)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
         if causal:
@@ -227,14 +307,27 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
             s = jnp.where(qseg_ref[0, :, 0][:, None]
                           == kseg_ref[0, :, 0][None, :], s, NEG_INF)
         p = jnp.exp(s - lse)                            # [bq, bk]
-        # dv += p^T do
-        dv_scr[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        # dp = do v^T ; ds = p * (dp - delta) * scale
         dp = jax.lax.dot_general(do, v.astype(jnp.float32),
                                  (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if dropout_rate > 0.0:
+            # rebuild the forward's mask for THIS q-head block: the fwd grid
+            # b was the global q-row index
+            b = pl.program_id(0)
+            qh = (b // hkv) * hq + (b % hkv) * rep_heads + rr
+            m = _dropout_mask(seed_ref[0], qh, i, j, num_q_blocks,
+                              num_k_blocks, (block_q, block_k),
+                              dropout_rate)
+            pd = p * m
+            dp = dp * m
+        else:
+            pd = p
+        # dv += (masked p)^T do
+        dv_scr[:] += jax.lax.dot_general(
+            pd, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        # ds = p * (masked dp - delta) * scale  (delta = rowsum(do∘o) holds
+        # with dropout too: o already contains the mask)
         ds = p * (dp - delta) * sm_scale
         # dk += ds^T q
         dk_scr[:] += jax.lax.dot_general(
@@ -249,11 +342,14 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
                    causal, sm_scale, block_q, block_k, num_k_blocks, offset,
-                   has_segments=False):
+                   has_segments=False, dropout_rate=0.0, num_q_blocks=1):
+    rest = list(rest)
+    qseg_ref = kseg_ref = seed_ref = None
     if has_segments:
-        qseg_ref, kseg_ref, dq_ref, dq_scr = rest
-    else:
-        dq_ref, dq_scr = rest
+        qseg_ref, kseg_ref = rest.pop(0), rest.pop(0)
+    if dropout_rate > 0.0:
+        seed_ref = rest.pop(0)
+    dq_ref, dq_scr, lse_scr, delta_scr = rest
     j = pl.program_id(2)  # k-block (reduction)
     i = pl.program_id(1)  # q-block
 
@@ -271,8 +367,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
         k = k_ref[0]
         v = v_ref[0]
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0]
-        delta = delta_ref[0]
+        lse = _col_from_packed(lse_ref, i, block_q, lse_scr)
+        delta = _col_from_packed(delta_ref, i, block_q, delta_scr)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
         if causal:
@@ -288,6 +384,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
         dp = jax.lax.dot_general(do, v.astype(jnp.float32),
                                  (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if dropout_rate > 0.0:
+            b = pl.program_id(0)          # this grid's b IS the q-row index
+            dp = dp * _dropout_mask(seed_ref[0], b, i, j, num_q_blocks,
+                                    num_k_blocks, (block_q, block_k),
+                                    dropout_rate)
         ds = p * (dp - delta) * sm_scale
         dq_scr[:] += jax.lax.dot_general(
             ds, k.astype(jnp.float32), (((1,), (0,)), ((), ())),
@@ -299,7 +400,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
 
 
 def _bwd_call(res, g, causal, sm_scale, interpret, n_q_heads=None,
-              n_kv_heads=None, segment_ids=None, delta=None):
+              n_kv_heads=None, segment_ids=None, delta=None,
+              dropout_rate=0.0, dropout_seed=None):
     q, k, v, o, lse = res
     do = g
     bh, s_q, d = q.shape
@@ -310,8 +412,19 @@ def _bwd_call(res, g, causal, sm_scale, interpret, n_q_heads=None,
     block_q, block_k = _block_sizes(s_q, s_k, d)
     if delta is None:   # ring callers precompute it once across hops
         delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
-                        axis=-1, keepdims=True)  # [bh, s_q, 1]
+                        axis=-1)                 # [bh, s_q] compact 2-D
+    # lse/delta stay PACKED [bh, s//128, 128] end to end: the kernels read
+    # full packed rows and relayout per q-block in VMEM (_col_from_packed)
+    if lse.ndim == 3 and lse.shape[-1] == 1:
+        lse = _pack_lse(lse, interpret)
+    if delta.ndim == 3 and delta.shape[-1] == 1:
+        delta = _pack_lse(delta, interpret)
+    nch_q = s_q // 128
+    lse_p = lse.reshape(bh, nch_q, 128)
+    delta_p = delta.reshape(bh, nch_q, 128)
     has_seg = segment_ids is not None
+    seed_arr = (jnp.asarray(dropout_seed, jnp.float32).reshape(1)
+                if dropout_rate > 0.0 else None)
 
     def q_idx_dkv(b, j, rr, i):
         # b indexes B*Hkv; the q head is the rr-th member of its kv group
@@ -320,15 +433,19 @@ def _bwd_call(res, g, causal, sm_scale, interpret, n_q_heads=None,
     def kv_idx_dkv(b, j, rr, i):
         return (b, j, 0)
 
+    def stats_idx_dkv(b, j, rr, i):
+        # full packed row of the rr-th q head in this kv group
+        return ((b // hkv) * hq + (b % hkv) * rep + rr, 0, 0)
+
     dkv_in_specs = [
         pl.BlockSpec((1, block_q, d), q_idx_dkv),
         pl.BlockSpec((1, block_k, d), kv_idx_dkv),
         pl.BlockSpec((1, block_k, d), kv_idx_dkv),
         pl.BlockSpec((1, block_q, d), q_idx_dkv),
-        pl.BlockSpec((1, block_q, 1), q_idx_dkv),
-        pl.BlockSpec((1, block_q, 1), q_idx_dkv),
+        pl.BlockSpec((1, nch_q, 128), stats_idx_dkv),
+        pl.BlockSpec((1, nch_q, 128), stats_idx_dkv),
     ]
-    dkv_args = [q, k, v, do, lse, delta]
+    dkv_args = [q, k, v, do, lse_p, delta_p]
     if has_seg:
         seg3 = segment_ids[:, :, None]
         dkv_in_specs += [
@@ -338,12 +455,17 @@ def _bwd_call(res, g, causal, sm_scale, interpret, n_q_heads=None,
                          lambda b, j, rr, i: (b // hkv, j, 0)),
         ]
         dkv_args += [seg3, seg3]
+    if dropout_rate > 0.0:
+        dkv_in_specs += [pl.BlockSpec(memory_space=pltpu.SMEM)]
+        dkv_args += [seed_arr]
 
     dkv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, causal=causal, sm_scale=sm_scale,
                           block_q=block_q, block_k=block_k,
                           num_q_blocks=s_q // block_q, rep_heads=rep,
-                          offset=s_k - s_q, has_segments=has_seg),
+                          offset=s_k - s_q, has_segments=has_seg,
+                          dropout_rate=dropout_rate, hq=hq, hkv=hkv,
+                          num_k_blocks=s_k // block_k),
         grid=(bh_kv, s_k // block_k, rep, s_q // block_q),
         in_specs=dkv_in_specs,
         out_specs=[
@@ -357,6 +479,8 @@ def _bwd_call(res, g, causal, sm_scale, interpret, n_q_heads=None,
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary",
@@ -373,27 +497,33 @@ def _bwd_call(res, g, causal, sm_scale, interpret, n_q_heads=None,
         pl.BlockSpec((1, block_k, d), kv_idx_dq),
         pl.BlockSpec((1, block_k, d), kv_idx_dq),
         pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
-        pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, nch_q, 128), lambda b, i, j: (b, 0, 0)),
+        pl.BlockSpec((1, nch_q, 128), lambda b, i, j: (b, 0, 0)),
     ]
-    dq_args = [q, k, v, do, lse, delta]
+    dq_args = [q, k, v, do, lse_p, delta_p]
     if has_seg:
         dq_in_specs += [
             pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b // hq, i, 0)),
             pl.BlockSpec((1, block_k, 1), lambda b, i, j: (b // hq, j, 0)),
         ]
         dq_args += [segment_ids[:, :, None], segment_ids[:, :, None]]
+    if dropout_rate > 0.0:
+        dq_in_specs += [pl.BlockSpec(memory_space=pltpu.SMEM)]
+        dq_args += [seed_arr]
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, causal=causal, sm_scale=sm_scale,
                           block_q=block_q, block_k=block_k,
                           num_k_blocks=s_k // block_k, offset=s_k - s_q,
-                          has_segments=has_seg),
+                          has_segments=has_seg, dropout_rate=dropout_rate,
+                          num_q_blocks=s_q // block_q),
         grid=(bh, s_q // block_q, s_k // block_k),
         in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=_sds((bh, s_q, d), q.dtype, _vma_of(q, k, v, do)),
-        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32),
+                        pltpu.VMEM((block_q, 1), jnp.float32),
+                        pltpu.VMEM((block_q, 1), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
@@ -405,12 +535,23 @@ def _bwd_call(res, g, causal, sm_scale, interpret, n_q_heads=None,
 # Public op: [B, S, H, D] layout with custom VJP
 # ---------------------------------------------------------------------------
 @functools.lru_cache(maxsize=16)
-def _make_op(causal: bool, interpret: bool, has_segments: bool = False):
-    """has_segments: op takes a 4th arg seg [B, S] (f32 segment ids —
+def _make_op(causal: bool, interpret: bool, has_segments: bool = False,
+             dropout_rate: float = 0.0):
+    """has_segments: op takes an extra arg seg [B, S] (f32 segment ids —
     intra-segment attention only, the varlen/flash_attn_unpadded mask;
-    f32 so custom_vjp's cotangent contract stays uniform)."""
+    f32 so custom_vjp's cotangent contract stays uniform).
 
-    def _fwd(q, k, v, *seg):
+    dropout_rate > 0: op takes a trailing f32 scalar-array seed; the
+    attention-probability dropout runs INSIDE the kernels (per-block
+    regenerable PRNG — the S×S mask never exists in HBM), which is what
+    keeps dropout-training configs (ERNIE/BERT pretrain) on the flash path
+    instead of the materializing XLA fallback."""
+    has_drop = dropout_rate > 0.0
+
+    def _fwd(q, k, v, *rest):
+        rest = list(rest)
+        sids = rest.pop(0) if has_segments else None
+        seed = rest.pop(0) if has_drop else None
         b, s_q, h, d = q.shape
         s_k = k.shape[1]
         hkv = k.shape[2]
@@ -418,57 +559,68 @@ def _make_op(causal: bool, interpret: bool, has_segments: bool = False):
         qr = q.transpose(0, 2, 1, 3).reshape(b * h, s_q, d)
         kr = k.transpose(0, 2, 1, 3).reshape(b * hkv, s_k, d)
         vr = v.transpose(0, 2, 1, 3).reshape(b * hkv, s_k, d)
-        sids = seg[0] if seg else None
         o, lse = flash_attention_fwd_kernel_call(qr, kr, vr, causal, sm_scale,
                                                  interpret, n_q_heads=h,
                                                  n_kv_heads=hkv,
-                                                 segment_ids=sids)
+                                                 segment_ids=sids,
+                                                 dropout_rate=dropout_rate,
+                                                 dropout_seed=seed)
         o4 = o.reshape(b, h, s_q, d).transpose(0, 2, 1, 3)
         # name the bwd residuals so a save_only_these_names("fa_res") remat
         # policy keeps them and the backward skips re-running the fwd kernel
         from jax.ad_checkpoint import checkpoint_name
         res = tuple(checkpoint_name(x, "fa_res") for x in (qr, kr, vr, o, lse))
-        return o4, res + (sids, (b, h, hkv, s_q, s_k, d))
+        return o4, res + (sids, seed, (b, h, hkv, s_q, s_k, d))
 
-    if has_segments:
+    n_extra = (1 if has_segments else 0) + (1 if has_drop else 0)
+    if n_extra == 2:
         @jax.custom_vjp
-        def op(q, k, v, seg):
-            o, _ = _fwd(q, k, v, seg)
-            return o
+        def op(q, k, v, seg, seed):
+            return _fwd(q, k, v, seg, seed)[0]
 
-        def fwd(q, k, v, seg):
-            return _fwd(q, k, v, seg)
+        def fwd(q, k, v, seg, seed):
+            return _fwd(q, k, v, seg, seed)
+    elif n_extra == 1:
+        @jax.custom_vjp
+        def op(q, k, v, extra):
+            return _fwd(q, k, v, extra)[0]
+
+        def fwd(q, k, v, extra):
+            return _fwd(q, k, v, extra)
     else:
         @jax.custom_vjp
         def op(q, k, v):
-            o, _ = _fwd(q, k, v)
-            return o
+            return _fwd(q, k, v)[0]
 
         def fwd(q, k, v):
             return _fwd(q, k, v)
 
     def bwd(res, g):
-        qr, kr, vr, o, lse, sids, (b, h, hkv, s_q, s_k, d) = res
+        qr, kr, vr, o, lse, sids, seed, (b, h, hkv, s_q, s_k, d) = res
         sm_scale = 1.0 / math.sqrt(d)
         do = g.transpose(0, 2, 1, 3).reshape(b * h, s_q, d)
         dq, dk, dv = _bwd_call((qr, kr, vr, o, lse), do, causal, sm_scale,
                                interpret, n_q_heads=h, n_kv_heads=hkv,
-                               segment_ids=sids)
+                               segment_ids=sids, dropout_rate=dropout_rate,
+                               dropout_seed=seed)
         dq4 = dq.reshape(b, h, s_q, d).transpose(0, 2, 1, 3)
         dk4 = dk.reshape(b, hkv, s_k, d).transpose(0, 2, 1, 3)
         dv4 = dv.reshape(b, hkv, s_k, d).transpose(0, 2, 1, 3)
+        extras = ()
         if has_segments:
-            return dq4, dk4, dv4, jnp.zeros_like(sids)
-        return dq4, dk4, dv4
+            extras += (jnp.zeros_like(sids),)
+        if has_drop:
+            extras += (jnp.zeros_like(seed),)
+        return (dq4, dk4, dv4) + extras
 
     op.defvjp(fwd, bwd)
     return op
 
 
-def _supported(q, k, causal=False):
-    b, s_q, h, d = q.shape
-    s_k = k.shape[1]
-    hkv = k.shape[2]
+def _supported(q_shape, k_shape, causal=False):
+    b, s_q, h, d = q_shape
+    s_k = k_shape[1]
+    hkv = k_shape[2]
     if h % hkv != 0:
         return False
     if d > 256 or d % 8 != 0:
@@ -487,19 +639,77 @@ def _supported(q, k, causal=False):
     return True
 
 
-def flash_attention(q, k, v, causal=False, interpret=False, segment_ids=None):
+def _pad_to_tile(q, k, v, segment_ids):
+    """Pad an untileable sequence length up to the next 128-multiple and
+    mask the tail via the kernel's segment ids (padding gets a segment of
+    its own, so real tokens never attend it).  This is what keeps e.g.
+    ViT's S=197 attention on the flash path instead of the
+    [B,H,S,S]-materializing XLA fallback (round-5 ViT profile: the
+    materialized probs were both the memory AND the throughput ceiling)."""
+    b, s, h, d = q.shape
+    pad = (-s) % 128
+    qp = jnp.pad(q, [(0, 0), (0, pad), (0, 0), (0, 0)])
+    kp = jnp.pad(k, [(0, 0), (0, pad), (0, 0), (0, 0)])
+    vp = jnp.pad(v, [(0, 0), (0, pad), (0, 0), (0, 0)])
+    if segment_ids is None:
+        seg = jnp.zeros((b, s), jnp.float32)
+    else:
+        seg = segment_ids.astype(jnp.float32)
+    # the pad segment id must differ from every real id (real ids are
+    # small non-negative ints in practice; -1 stays distinct)
+    segp = jnp.pad(seg, [(0, 0), (0, pad)], constant_values=-1.0)
+    return qp, kp, vp, segp, s
+
+
+def flash_attention(q, k, v, causal=False, interpret=False, segment_ids=None,
+                    dropout_rate=0.0, dropout_seed=None):
     """[B, S, H, D] flash attention; falls back unsupported shapes to the
     caller (returns None so the dispatch default runs).
 
     segment_ids: optional int [B, S] — attention stays within equal-id
     spans (the varlen/flash_attn_unpadded mask; reference
     flash_attn_kernel.cu varlen entries). Requires s_q == s_k.
+
+    dropout_rate/dropout_seed: in-kernel attention-probability dropout
+    (per-block regenerable PRNG; the mask never exists in HBM).  seed may
+    be a traced scalar — it does not bake into the executable.
     """
-    if not _supported(q, k, causal):
-        return None
-    if segment_ids is not None:
+    unpad_to = None
+    if not _supported(q.shape, k.shape, causal):
+        s_q, s_k = q.shape[1], k.shape[1]
+        # pad-to-tile engages only for LONG untileable sequences: at short S
+        # the padded kernel's small tiles starve the MXU and lose to XLA's
+        # fused-softmax path (measured r5: ViT S=197->256 B=64, FA-pad 197
+        # img/s vs XLA 243) while the memory it saves is modest; at S >= 384
+        # the S^2 materialization cost dominates and FA wins
+        tileable = (s_q == s_k and s_q % 128 != 0 and s_q >= 384
+                    and _supported(q.shape[:1] + (128,) + q.shape[2:],
+                                   k.shape[:1] + (128,) + k.shape[2:],
+                                   causal))
+        if not tileable:
+            return None
+        q, k, v, segment_ids, unpad_to = _pad_to_tile(q, k, v, segment_ids)
+    drop = float(dropout_rate or 0.0)
+    if drop >= 1.0:
+        # torch/paddle semantics: dropout_p == 1 zeroes the output (the
+        # kernel's uint32 threshold would wrap and emit inf instead)
+        return jnp.zeros_like(q)
+    extras = ()
+    has_seg = segment_ids is not None
+    if has_seg:
         if q.shape[1] != k.shape[1]:
             return None
-        sids = segment_ids.astype(jnp.float32)
-        return _make_op(bool(causal), bool(interpret), True)(q, k, v, sids)
-    return _make_op(bool(causal), bool(interpret))(q, k, v)
+        extras += (segment_ids.astype(jnp.float32),)
+    if drop > 0.0:
+        if dropout_seed is None:
+            # fresh mask per call (the reference CUDA FA draws a philox seed
+            # when none is fixed) — a constant default would repeat the
+            # identical mask every step and layer
+            from ...core.random import split_key
+            dropout_seed = jax.random.randint(split_key(), (), 0, 1 << 23)
+        extras += (jnp.asarray(dropout_seed, jnp.float32),)
+    out = _make_op(bool(causal), bool(interpret), has_seg, drop)(
+        q, k, v, *extras)
+    if unpad_to is not None:
+        out = out[:, :unpad_to]
+    return out

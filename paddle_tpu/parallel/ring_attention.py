@@ -187,7 +187,7 @@ def ring_flash_attention(q, k, v, axis: str = "sep", causal: bool = False,
         def skip(_):
             return (_vary_axis(jnp.zeros((b * hq, s_local, d), jnp.float32),
                                axis),
-                    _vary_axis(jnp.full((b * hq, s_local, 1), -jnp.inf,
+                    _vary_axis(jnp.full((b * hq, s_local), -jnp.inf,
                                         jnp.float32), axis))
 
         return jax.lax.switch(hop_kind, [skip, run(True), run(False)], 0)
@@ -209,13 +209,13 @@ def ring_flash_attention(q, k, v, axis: str = "sep", causal: bool = False,
         qk = _to_kernel_layout(q)
         o_acc = _vary_axis(jnp.zeros((b * hq, s_local, d), jnp.float32), axis)
         lse_acc = _vary_axis(
-            jnp.full((b * hq, s_local, 1), -jnp.inf, jnp.float32), axis)
+            jnp.full((b * hq, s_local), -jnp.inf, jnp.float32), axis)
         k_cur, v_cur = k, v
         for t in range(n):
             o_t, lse_t = fwd_hop(qk, k_cur, v_cur, hop_kind_of(t, r))
             lse_new = jnp.logaddexp(lse_acc, lse_t)
-            a_old = jnp.exp(lse_acc - lse_new)
-            a_new = jnp.exp(lse_t - lse_new)
+            a_old = jnp.exp(lse_acc - lse_new)[..., None]
+            a_new = jnp.exp(lse_t - lse_new)[..., None]
             o_acc = o_acc * a_old + o_t * a_new
             lse_acc = lse_new
             if t != n - 1:
@@ -232,7 +232,7 @@ def ring_flash_attention(q, k, v, axis: str = "sep", causal: bool = False,
         gk = _to_kernel_layout(g.astype(out.dtype))
         # delta = rowsum(do*o) is hop-invariant: compute once, not per hop
         delta = jnp.sum(gk.astype(jnp.float32) * ok.astype(jnp.float32),
-                        axis=-1, keepdims=True)
+                        axis=-1)          # [bh, s] (2-D: lse layout contract)
         dq_acc = _vary_axis(jnp.zeros_like(qk, jnp.float32), axis)
         k_cur, v_cur = k, v
         dk_acc = _vary_axis(jnp.zeros(k.shape, jnp.float32), axis)

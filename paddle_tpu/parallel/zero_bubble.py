@@ -12,17 +12,30 @@ schedule TABLE (built in Python by a greedy list scheduler, one row per pp
 rank, one column per tick) assigns each rank one slot per tick:
 IDLE / F(mb) / B(mb) / W(mb).  Inside shard_map every tick executes
 `lax.switch` on this rank's table entry — real per-device control flow, so a
-tick costs one slot's work — then ppermutes the fwd/bwd rings.  With B on
-the critical path and W deferred into bubbles, the zero-bubble table's
-makespan is strictly shorter than the fine-grained 1F1B table's at the same
-(n_stages, n_micro); `build_schedule` exposes both policies so the bubble
-reduction is measurable (tests assert it).
+tick costs one slot's work — then ppermutes the fwd/bwd rings.
 
-Cost note: B and W each rematerialize the stage forward (jax.vjp over the
-input-only / params-only closure), so ZB trades one extra stage-forward per
-microbatch for bubble elimination — profitable when the bubble fraction
-2(S-1)/(n_micro+2(S-1)) exceeds the ~20% recompute overhead, i.e. small
-n_micro/S ratios, exactly the regime ZB targets.
+Backward-splitting without a recompute tax (round 5; the round-4 engine
+re-ran the stage forward inside BOTH the B and the W vjp, which is why it
+lost to 1F1B at large n_micro — PERF.md r4 §6):
+
+* the F slot runs the stage forward through `jax.vjp` and saves the
+  **residuals** (the AD tape: every intermediate the backward needs) into a
+  ring buffer, exactly like ZB-H1's activation store — this is the real
+  ZB memory model, the H1 in-flight cap bounds it to ~n_stages microbatches;
+* residual leaves that are literally the parameter arrays or the stage
+  input are deduped out of the buffer by tracer identity (the weights are
+  already resident; the stage input is already in the activation ring) —
+  only true intermediates are stored;
+* the B slot rebuilds the saved vjp and takes ONLY the input-cotangent —
+  XLA's dead-code elimination prunes the dW contractions, so B costs just
+  the dx matmul chain, no forward recompute;
+* the W slot rebuilds the same vjp and takes the weight-cotangent (the dx
+  chain inside the stage is re-derived from residuals — pure matmuls, no
+  forward — plus the dW contractions).
+
+Per microbatch this totals ≈ fwd + dx + (dx + dW): the same FLOPs as the
+fused-1F1B backward-with-recompute, with the critical-path B slot ~3×
+cheaper — so the table's bubble win is no longer paid back as recompute.
 """
 from __future__ import annotations
 
@@ -133,21 +146,8 @@ def schedule_stats(rows):
     return T, idle, idle / (T * len(rows))
 
 
-def _depths(rows, n_micro):
-    """Ring-buffer depths: max lifetime span (in distinct mbs) of saved
-    activations and cotangents.
-
-    Lifetimes MUST start at the *arrival* tick, not this stage's own
-    execution tick: stage s ingests mb m's activation at f_done[s-1][m]+1
-    (cotangent at b_done[s+1][m]+1), and the scan's ingest phase runs
-    *before* the slot executes — so an arrival at tick t conflicts with a
-    same-tick W reading another mb in the same slot.  Lifetimes end at this
-    stage's W tick inclusive (W re-reads both the activation and the
-    cotangent).  Deriving the window from local F/B ticks (the pre-round-4
-    bug) silently corrupted last-stage weight grads whenever
-    n_micro > n_stages."""
+def _slot_ticks(rows):
     S = len(rows)
-    T = len(rows[0])
     f_t = [{} for _ in range(S)]
     b_t = [{} for _ in range(S)]
     w_t = [{} for _ in range(S)]
@@ -159,7 +159,27 @@ def _depths(rows, n_micro):
                 b_t[s][m] = t
             elif k == W:
                 w_t[s][m] = t
-    act_d, cot_d = 1, 1
+    return f_t, b_t, w_t
+
+
+def _depths(rows, n_micro):
+    """Ring-buffer depths (act, cot, res): max lifetime span (in distinct
+    mbs) of saved activations, cotangents and vjp residuals.
+
+    Lifetimes MUST start at the *arrival* tick, not this stage's own
+    execution tick: stage s ingests mb m's activation at f_done[s-1][m]+1
+    (cotangent at b_done[s+1][m]+1), and the scan's ingest phase runs
+    *before* the slot executes — so an arrival at tick t conflicts with a
+    same-tick W reading another mb in the same slot.  Lifetimes end at this
+    stage's W tick inclusive (W re-reads the activation, the cotangent and
+    the residuals).  Residuals are written at this stage's own F tick and
+    read at B and W.  Deriving the window from local F/B ticks (the
+    pre-round-4 bug) silently corrupted last-stage weight grads whenever
+    n_micro > n_stages."""
+    S = len(rows)
+    T = len(rows[0])
+    f_t, b_t, w_t = _slot_ticks(rows)
+    act_d, cot_d, res_d = 1, 1, 1
     for s in range(S):
         for t in range(T):
             # activation arrival: upstream F + 1.  Slot conflicts only come
@@ -179,7 +199,14 @@ def _depths(rows, n_micro):
                           and w_t[s].get(m, -1) >= t]
                 if live_c:
                     cot_d = max(cot_d, max(live_c) - min(live_c) + 1)
-    return min(act_d, n_micro), min(cot_d, n_micro)
+            # residuals: written at OWN F tick (execution phase, after
+            # ingest), read through the W tick inclusive
+            live_r = [m for m in range(n_micro)
+                      if f_t[s].get(m, 10**9) <= t
+                      and w_t[s].get(m, -1) >= t]
+            if live_r:
+                res_d = max(res_d, max(live_r) - min(live_r) + 1)
+    return (min(act_d, n_micro), min(cot_d, n_micro), min(res_d, n_micro))
 
 
 def spmd_pipeline_zero_bubble(fwd_mb: Callable, params, n_micro: int,
@@ -196,7 +223,7 @@ def spmd_pipeline_zero_bubble(fwd_mb: Callable, params, n_micro: int,
     S = n
     rows = build_schedule(S, n_micro, policy)
     T = len(rows[0])
-    act_depth, cot_depth = _depths(rows, n_micro)
+    act_depth, cot_depth, res_depth = _depths(rows, n_micro)
     kind_arr = jnp.asarray([[k for k, _ in row] for row in rows], jnp.int32)
     mb_arr = jnp.asarray([[m for _, m in row] for row in rows], jnp.int32)
     perm_f = [(i, (i + 1) % n) for i in range(n)]
@@ -206,8 +233,45 @@ def spmd_pipeline_zero_bubble(fwd_mb: Callable, params, n_micro: int,
     params = jax.tree_util.tree_map(lambda p: _vary(p, va), params)
     mb_shape, mb_dtype = act_sd.shape, act_sd.dtype
 
+    # ---- residual structure probe -----------------------------------------
+    # Trace the stage vjp once (outputs unused -> the probe's compute is
+    # DCE'd) to learn the residual pytree: which leaves are true
+    # intermediates (buffered) vs. the parameter arrays / the stage input
+    # (deduped — substituted back at B/W time).  The per-tick do_F trace of
+    # the same function at the same shapes is deterministic, so leaf order
+    # matches.
+    param_leaves = jax.tree_util.tree_leaves(params)
+    param_ids = {id(l): i for i, l in enumerate(param_leaves)}
+    probe_a = _vary(jnp.zeros(mb_shape, mb_dtype), va)
+    probe_m = jnp.zeros((), jnp.int32)
+    _, probe_vjp = jax.vjp(
+        lambda a, p: fwd_mb(p, 0, a, probe_m), probe_a, params)
+    probe_leaves, vjp_treedef = jax.tree_util.tree_flatten(probe_vjp)
+    # leaf classification: ("param", idx) | ("act",) | ("buf", buf_slot)
+    leaf_kind = []
+    buf_shapes = []
+    for leaf in probe_leaves:
+        if id(leaf) in param_ids:
+            leaf_kind.append(("param", param_ids[id(leaf)]))
+        elif leaf is probe_a:
+            leaf_kind.append(("act",))
+        else:
+            leaf_kind.append(("buf", len(buf_shapes)))
+            buf_shapes.append((leaf.shape, leaf.dtype))
+
+    def _rebuild_vjp(buf_leaves, a_in):
+        leaves = []
+        for kind in leaf_kind:
+            if kind[0] == "param":
+                leaves.append(param_leaves[kind[1]])
+            elif kind[0] == "act":
+                leaves.append(a_in)
+            else:
+                leaves.append(buf_leaves[kind[1]])
+        return jax.tree_util.tree_unflatten(vjp_treedef, leaves)
+
     def tick(carry, t):
-        act_buf, cot_buf, gacc, loss_acc, send_f, send_b = carry
+        act_buf, cot_buf, res_buf, gacc, loss_acc, send_f, send_b = carry
         # ---- ingest last tick's arrivals (table-addressed) ---------------
         prev_r = jnp.mod(r - 1, n)
         next_r = jnp.mod(r + 1, n)
@@ -229,56 +293,85 @@ def spmd_pipeline_zero_bubble(fwd_mb: Callable, params, n_micro: int,
         my_k = kind_arr[r, t]
         my_m = mb_arr[r, t]
         a_in = act_buf[jnp.mod(my_m, act_depth)]
+        res_slot = jnp.mod(my_m, res_depth)
 
-        def norm_out(a, g, gp, l):
+        def load_res():
+            # called INSIDE do_B/do_W only: lax.switch operands are strict,
+            # so slicing before the switch would read every residual buffer
+            # on every tick (F/idle included) — the largest arrays in the
+            # carry
+            return tuple(buf[res_slot] for buf in res_buf)
+
+        def zeros_res():
+            return tuple(jnp.zeros(shp, dt) for shp, dt in buf_shapes)
+
+        def norm_out(a, g, gp, l, res):
             # align vma types across lax.switch branches
             return (_vary(a, va), _vary(g, va),
                     jax.tree_util.tree_map(lambda x: _vary(x, va), gp),
-                    _vary(l, va))
+                    _vary(l, va),
+                    tuple(_vary(x, va) for x in res))
 
         def do_idle(a_in, g_in):
             return norm_out(jnp.zeros(mb_shape, mb_dtype),
                             jnp.zeros(mb_shape, mb_dtype),
                             jax.tree_util.tree_map(jnp.zeros_like, params),
-                            jnp.zeros((), jnp.float32))
+                            jnp.zeros((), jnp.float32), zeros_res())
 
         def do_F(a_in, g_in):
-            a_out, l_mb = fwd_mb(params, 0, a_in, my_m)
+            # forward + residual capture (the AD tape for this mb's B and W)
+            (a_out, l_mb), vjp_fn = jax.vjp(
+                lambda a, p: fwd_mb(p, 0, a, my_m), a_in, params)
+            leaves = jax.tree_util.tree_leaves(vjp_fn)
+            res = tuple(leaves[i] for i, kind in enumerate(leaf_kind)
+                        if kind[0] == "buf")
             return norm_out(a_out, jnp.zeros(mb_shape, mb_dtype),
                             jax.tree_util.tree_map(jnp.zeros_like, params),
-                            l_mb.astype(jnp.float32))
+                            l_mb.astype(jnp.float32), res)
 
         def do_B(a_in, g_in):
-            # input-grad only: params closed over as constants
-            _, vjp_a = jax.vjp(lambda a: fwd_mb(params, 0, a, my_m), a_in)
+            # input-grad only from saved residuals: the dW contractions are
+            # dead code here (gp discarded) and get pruned by XLA — no
+            # forward recompute, just the dx chain
+            vjp_fn = _rebuild_vjp(load_res(), a_in)
             is_last = r == n - 1
             g_act = jnp.where(is_last, jnp.zeros(mb_shape, mb_dtype), g_in)
-            (ga,) = vjp_a((g_act, _vary(jnp.ones((), jnp.float32), va)))
+            ga, _ = vjp_fn((g_act, _vary(jnp.ones((), jnp.float32), va)))
             return norm_out(jnp.zeros(mb_shape, mb_dtype), ga,
                             jax.tree_util.tree_map(jnp.zeros_like, params),
-                            jnp.zeros((), jnp.float32))
+                            jnp.zeros((), jnp.float32), zeros_res())
 
         def do_W(a_in, g_in):
-            # weight-grad only: activation closed over as constant
-            _, vjp_p = jax.vjp(lambda p: fwd_mb(p, 0, a_in, my_m), params)
+            # weight-grad from the SAME saved residuals (ga discarded)
+            vjp_fn = _rebuild_vjp(load_res(), a_in)
             is_last = r == n - 1
             g_act = jnp.where(is_last, jnp.zeros(mb_shape, mb_dtype), g_in)
-            (gp,) = vjp_p((g_act, _vary(jnp.ones((), jnp.float32), va)))
+            _, gp = vjp_fn((g_act, _vary(jnp.ones((), jnp.float32), va)))
             return norm_out(jnp.zeros(mb_shape, mb_dtype),
                             jnp.zeros(mb_shape, mb_dtype), gp,
-                            jnp.zeros((), jnp.float32))
+                            jnp.zeros((), jnp.float32), zeros_res())
 
         g_in = cot_buf[jnp.mod(my_m, cot_depth)]
         branches = [do_idle, do_F, do_B, do_W]
-        a_out, g_out, gp, l_mb = jax.lax.switch(my_k, branches, a_in, g_in)
+        a_out, g_out, gp, l_mb, res_out = jax.lax.switch(
+            my_k, branches, a_in, g_in)
+        # write residuals on F slots only; lax.cond (not jnp.where) so the
+        # non-F path is a true no-op instead of a full-buffer select
+        res_buf = jax.lax.cond(
+            my_k == F,
+            lambda bufs: tuple(buf.at[res_slot].set(new)
+                               for buf, new in zip(bufs, res_out)),
+            lambda bufs: bufs, res_buf)
         # last stage's loss counts only on F slots (head runs there)
         loss_acc = loss_acc + jnp.where(my_k == F, l_mb, 0.0)
         gacc = jax.tree_util.tree_map(lambda acc, g: acc + g.astype(acc.dtype),
                                       gacc, gp)
-        return (act_buf, cot_buf, gacc, loss_acc, a_out, g_out), None
+        return (act_buf, cot_buf, res_buf, gacc, loss_acc, a_out, g_out), None
 
     carry = (jnp.zeros((act_depth,) + mb_shape, mb_dtype),
              jnp.zeros((cot_depth,) + mb_shape, mb_dtype),
+             tuple(jnp.zeros((res_depth,) + shp, dt)
+                   for shp, dt in buf_shapes),
              jax.tree_util.tree_map(
                  lambda p: jnp.zeros(p.shape, p.dtype), params),
              jnp.zeros((), jnp.float32),
@@ -286,6 +379,6 @@ def spmd_pipeline_zero_bubble(fwd_mb: Callable, params, n_micro: int,
              jnp.zeros(mb_shape, mb_dtype))
     if va:
         carry = jax.tree_util.tree_map(lambda x: _vary(x, va), carry)
-    (_, _, gacc, loss_acc, _, _), _ = jax.lax.scan(
+    (_, _, _, gacc, loss_acc, _, _), _ = jax.lax.scan(
         tick, carry, jnp.arange(T))
     return loss_acc, gacc

@@ -183,11 +183,17 @@ def meshgrid(*args, **kwargs):
 
 
 def assign(x, output=None):
-    v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    """Copy op — differentiable (grad of a copy is identity), so it must go
+    through op_call; the pre-round-5 bare Tensor(v) silently detached the
+    result from the tape."""
+    from ..core.dispatch import op_call
+    if not isinstance(x, Tensor):
+        x = Tensor(jnp.asarray(x))
+    out = op_call("assign", lambda v: v, x)
     if output is not None:
-        output._set_value(v)
+        output._set_value(out._value)
         return output
-    return Tensor(v)
+    return out
 
 
 def clone(x, name=None):

@@ -17,7 +17,7 @@ __all__ = [
     "lstsq", "det", "slogdet", "pinv", "matrix_power", "matrix_rank", "eig",
     "eigh", "eigvals", "eigvalsh", "lu", "lu_unpack", "triangular_solve",
     "multi_dot", "einsum", "cov", "corrcoef", "histogram", "histogramdd",
-    "cdist", "householder_product", "pca_lowrank", "matrix_exp", "ormqr",
+    "cdist", "pdist", "householder_product", "pca_lowrank", "matrix_exp", "ormqr",
 ]
 
 
@@ -244,16 +244,39 @@ def corrcoef(x, rowvar=True, name=None):
 
 
 def histogram(input, bins=100, min=0, max=0, weight=None, density=False, name=None):
-    v = np.asarray(input._value)
-    rng = None if (min == 0 and max == 0) else (min, max)
-    w = np.asarray(weight._value) if weight is not None else None
-    hist, _ = np.histogram(v, bins=bins, range=rng, weights=w, density=density)
-    return Tensor(jnp.asarray(hist if density or w is not None else hist.astype(np.int64)))
+    """Traceable histogram (the pre-round-5 version round-tripped through
+    host numpy and broke under jit).  With min == max == 0 the range comes
+    from the data (numpy semantics), which requires a concrete input —
+    eager falls back to value-dependent bounds, traced raises like the
+    reference's shape-inference would."""
+    def impl(v, *rest):
+        w = rest[0] if rest else None
+        if min == 0 and max == 0:
+            if isinstance(v, jax.core.Tracer):
+                raise ValueError(
+                    "histogram under jit needs explicit (min, max) — the "
+                    "data-dependent range is a host-side reduction")
+            lo, hi = float(jnp.min(v)), float(jnp.max(v))
+        else:
+            lo, hi = float(min), float(max)
+        hist, _ = jnp.histogram(v.reshape(-1), bins=bins, range=(lo, hi),
+                                weights=None if w is None else w.reshape(-1),
+                                density=density)
+        if density or w is not None:
+            return hist
+        return hist.astype(jnp.int64)
+    args = (input,) if weight is None else (input, weight)
+    return op_call("histogram", impl, *args, nondiff=True)
 
 
 def histogramdd(x, bins=10, ranges=None, density=False, weights=None, name=None):
     v = np.asarray(x._value)
     w = np.asarray(weights._value) if weights is not None else None
+    if ranges is not None:
+        # paddle passes a FLAT [lo0, hi0, lo1, hi1, ...] list (reference
+        # linalg.py histogramdd); numpy wants per-dimension pairs
+        flat = list(ranges)
+        ranges = [(flat[2 * i], flat[2 * i + 1]) for i in range(len(flat) // 2)]
     hist, edges = np.histogramdd(v, bins=bins, range=ranges, density=density, weights=w)
     return Tensor(jnp.asarray(hist)), [Tensor(jnp.asarray(e)) for e in edges]
 
@@ -265,6 +288,35 @@ def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary", name=
             return jnp.sqrt(jnp.sum(diff * diff, axis=-1) + 1e-30)
         return jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
     return op_call("cdist", impl, x, y)
+
+
+def pdist(x, p=2.0, name=None):
+    """Condensed pairwise distance of the rows of x [N, D] -> [N·(N-1)/2]
+    (reference python/paddle/tensor/linalg.py pdist; scipy.spatial.distance
+    .pdist ordering: (0,1), (0,2), ..., (N-2,N-1)).
+
+    p=2 uses the Gram-matrix identity |x_i - x_j|^2 = |x_i|^2 + |x_j|^2 -
+    2 x_i·x_j — one MXU matmul and an [N, N] intermediate instead of the
+    [N(N-1)/2, D] gathered-diff tensor (D× less memory); identical rows
+    yield exactly 0."""
+    n = int(x.shape[0])
+    iu, ju = np.triu_indices(n, k=1)
+
+    def impl(a):
+        if p == 2.0:
+            sq = jnp.sum(a * a, axis=-1)
+            d2 = sq[:, None] + sq[None, :] - 2.0 * (a @ a.T)
+            d2 = jnp.maximum(d2[iu, ju], 0.0)
+            # grad-safe sqrt: exactly 0 (with zero grad) at coincident rows
+            safe = jnp.where(d2 > 0.0, d2, 1.0)
+            return jnp.where(d2 > 0.0, jnp.sqrt(safe), 0.0)
+        diff = a[iu] - a[ju]
+        if p == 0.0:
+            return jnp.sum((diff != 0).astype(a.dtype), axis=-1)
+        if p == float("inf"):
+            return jnp.max(jnp.abs(diff), axis=-1)
+        return jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
+    return op_call("pdist", impl, x)
 
 
 def householder_product(x, tau, name=None):

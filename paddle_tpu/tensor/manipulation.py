@@ -188,8 +188,9 @@ def broadcast_to(x, shape, name=None):
 
 
 def broadcast_tensors(inputs, name=None):
-    vals = [t._value for t in inputs]
-    shape = np.broadcast_shapes(*[v.shape for v in vals])
+    inputs = [t if isinstance(t, Tensor) else Tensor(jnp.asarray(t))
+              for t in inputs]
+    shape = np.broadcast_shapes(*[t._value.shape for t in inputs])
     return [op_call("broadcast_to", lambda v, s=shape: jnp.broadcast_to(v, s), t)
             for t in inputs]
 

@@ -104,10 +104,47 @@ def kthvalue(x, k, axis=-1, keepdim=False, name=None):
 
 
 def mode(x, axis=-1, keepdim=False, name=None):
-    v = np.asarray(x._value)
-    from scipy import stats
-    m = stats.mode(v, axis=axis, keepdims=keepdim)
-    return Tensor(jnp.asarray(m.mode)), Tensor(jnp.asarray(m.count).astype(np.int64))
+    """Most frequent value along `axis` -> (values, indices); indices are
+    the LAST position of the modal value (torch/paddle convention).  Fully
+    traceable: sort + pairwise-equality counts (O(n^2) on the axis) instead
+    of the host scipy call the pre-round-5 version used — which also
+    returned counts where the API promises indices."""
+    def impl_vals(v):
+        m = jnp.moveaxis(v, axis, -1)
+        s = jnp.sort(m, axis=-1)
+        n = s.shape[-1]
+        idx = jnp.arange(n)
+        # run-length counts in O(n): for each sorted position, the first and
+        # last index of its equal-value run via cummax tricks (no [n, n]
+        # pairwise tensor — that was a 40 GB cliff at n=100k)
+        new_run = jnp.concatenate(
+            [jnp.ones_like(s[..., :1], bool), s[..., 1:] != s[..., :-1]], -1)
+        first = jax.lax.cummax(jnp.where(new_run, idx, 0), axis=s.ndim - 1)
+        run_end = jnp.concatenate(
+            [s[..., 1:] != s[..., :-1], jnp.ones_like(s[..., :1], bool)], -1)
+        last = (n - 1) - jnp.flip(jax.lax.cummax(
+            jnp.flip(jnp.where(run_end, (n - 1) - idx, 0), -1),
+            axis=s.ndim - 1), -1)
+        counts = last - first + 1
+        # argmax picks the FIRST max in sorted order -> smallest modal value
+        best = jnp.argmax(counts, axis=-1)
+        vals = jnp.take_along_axis(s, best[..., None], -1)[..., 0]
+        return jnp.expand_dims(vals, axis) if keepdim else vals
+
+    values = op_call("mode_values", impl_vals, x)
+
+    def impl_idx(v, vals):
+        m = jnp.moveaxis(v, axis, -1)
+        mv = jnp.moveaxis(vals, axis, -1)[..., 0] if keepdim else vals
+        n = m.shape[-1]
+        eq = m == mv[..., None]
+        idx = (n - 1) - jnp.argmax(jnp.flip(eq, -1), axis=-1)
+        if keepdim:
+            idx = jnp.expand_dims(idx, axis)
+        return idx.astype(jnp.int64)
+
+    indices = op_call("mode_indices", impl_idx, x, values, nondiff=True)
+    return values, indices
 
 
 def median(x, axis=None, keepdim=False, mode="avg", name=None):
@@ -152,6 +189,18 @@ def bincount(x, weights=None, minlength=0, name=None):
 
 
 def histogram_bin_edges(x, bins=100, min=0, max=0, name=None):
-    v = np.asarray(x._value)
-    rng = None if (min == 0 and max == 0) else (min, max)
-    return Tensor(jnp.asarray(np.histogram_bin_edges(v, bins=bins, range=rng)))
+    """Traceable when (min, max) are given; the data-dependent range (both
+    zero, numpy semantics) needs a concrete input (host reduction)."""
+    def impl(v):
+        if min == 0 and max == 0:
+            if isinstance(v, jax.core.Tracer):
+                raise ValueError(
+                    "histogram_bin_edges under jit needs explicit "
+                    "(min, max) — the data range is a host-side reduction")
+            lo, hi = float(jnp.min(v)), float(jnp.max(v))
+        else:
+            lo, hi = float(min), float(max)
+        if lo == hi:
+            lo, hi = lo - 0.5, hi + 0.5   # numpy's zero-width expansion
+        return jnp.linspace(lo, hi, int(bins) + 1, dtype=jnp.float32)
+    return op_call("histogram_bin_edges", impl, x, nondiff=True)

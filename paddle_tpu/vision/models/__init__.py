@@ -9,3 +9,5 @@ from .densenet import (DenseNet, densenet121, densenet161, densenet169,  # noqa:
                        densenet201, densenet264, SqueezeNet, squeezenet1_0,
                        squeezenet1_1, ShuffleNetV2, shufflenet_v2_x1_0,
                        AlexNet, alexnet, VGG, vgg11, vgg13, vgg16, vgg19)
+from .inception import (GoogLeNet, googlenet, InceptionV3,  # noqa: F401
+                        inception_v3, LeNet)

@@ -1,0 +1,287 @@
+"""GoogLeNet (Inception v1), Inception v3 and LeNet (reference:
+python/paddle/vision/models/{googlenet.py:130, inceptionv3.py:509,
+lenet.py:30} — standard architectures, original jax-backed Layer bodies).
+
+GoogLeNet keeps the reference's three-head return (main + two aux
+classifiers); Inception v3 keeps its channel schedule
+(A:192/256/288, B:288, C:768×4 with 128/160/160/192 7×7 widths, D:768,
+E:1280/2048).
+"""
+from __future__ import annotations
+
+from ...nn.layer import Layer
+from ...nn import (Conv2D, BatchNorm2D, ReLU, MaxPool2D, AvgPool2D,
+                   AdaptiveAvgPool2D, Linear, Sequential, Dropout, LayerList)
+from ...nn import functional as F
+from ...tensor import manipulation as manip
+
+__all__ = ["GoogLeNet", "googlenet", "InceptionV3", "inception_v3",
+           "LeNet"]
+
+
+# ---------------------------------------------------------------------------
+# LeNet (reference lenet.py:30)
+# ---------------------------------------------------------------------------
+class LeNet(Layer):
+    def __init__(self, num_classes=10):
+        super().__init__()
+        self.num_classes = num_classes
+        self.features = Sequential(
+            Conv2D(1, 6, 3, stride=1, padding=1), ReLU(), MaxPool2D(2, 2),
+            Conv2D(6, 16, 5, stride=1, padding=0), ReLU(), MaxPool2D(2, 2))
+        if num_classes > 0:
+            self.fc = Sequential(Linear(400, 120), Linear(120, 84),
+                                 Linear(84, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.num_classes > 0:
+            x = self.fc(manip.flatten(x, 1))
+        return x
+
+
+# ---------------------------------------------------------------------------
+# GoogLeNet / Inception v1 (reference googlenet.py:130)
+# ---------------------------------------------------------------------------
+class _Conv(Layer):
+    """plain conv (no BN — v1 predates it), 'same'-style padding."""
+
+    def __init__(self, cin, cout, k, stride=1):
+        super().__init__()
+        self.conv = Conv2D(cin, cout, k, stride=stride,
+                           padding=(k - 1) // 2, bias_attr=False)
+
+    def forward(self, x):
+        return self.conv(x)
+
+
+class _InceptionV1Block(Layer):
+    """Four parallel branches concatenated on channels, then one ReLU
+    (the reference applies relu to the concat, not per branch)."""
+
+    def __init__(self, cin, f1, f3r, f3, f5r, f5, proj):
+        super().__init__()
+        self.b1 = _Conv(cin, f1, 1)
+        self.b3r = _Conv(cin, f3r, 1)
+        self.b3 = _Conv(f3r, f3, 3)
+        self.b5r = _Conv(cin, f5r, 1)
+        self.b5 = _Conv(f5r, f5, 5)
+        self.pool = MaxPool2D(3, stride=1, padding=1)
+        self.proj = _Conv(cin, proj, 1)
+
+    def forward(self, x):
+        cat = manip.concat(
+            [self.b1(x), self.b3(self.b3r(x)), self.b5(self.b5r(x)),
+             self.proj(self.pool(x))], axis=1)
+        return F.relu(cat)
+
+
+class GoogLeNet(Layer):
+    """Returns (out, aux1, aux2) like the reference."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.conv1 = _Conv(3, 64, 7, 2)
+        self.pool = MaxPool2D(3, stride=2)
+        self.conv2 = _Conv(64, 64, 1)
+        self.conv3 = _Conv(64, 192, 3)
+        B = _InceptionV1Block
+        self.i3a = B(192, 64, 96, 128, 16, 32, 32)
+        self.i3b = B(256, 128, 128, 192, 32, 96, 64)
+        self.i4a = B(480, 192, 96, 208, 16, 48, 64)
+        self.i4b = B(512, 160, 112, 224, 24, 64, 64)
+        self.i4c = B(512, 128, 128, 256, 24, 64, 64)
+        self.i4d = B(512, 112, 144, 288, 32, 64, 64)
+        self.i4e = B(528, 256, 160, 320, 32, 128, 128)
+        self.i5a = B(832, 256, 160, 320, 32, 128, 128)
+        self.i5b = B(832, 384, 192, 384, 48, 128, 128)
+        if with_pool:
+            self.gap = AdaptiveAvgPool2D(1)
+            self.aux_pool = AvgPool2D(5, stride=3)
+        if num_classes > 0:
+            self.drop = Dropout(0.4)
+            self.fc = Linear(1024, num_classes)
+            self.aux1_conv = _Conv(512, 128, 1)
+            self.aux1_fc = Linear(1152, 1024)
+            self.aux1_drop = Dropout(0.7)
+            self.aux1_out = Linear(1024, num_classes)
+            self.aux2_conv = _Conv(528, 128, 1)
+            self.aux2_fc = Linear(1152, 1024)
+            self.aux2_drop = Dropout(0.7)
+            self.aux2_out = Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.pool(self.conv1(x))
+        x = self.pool(self.conv3(self.conv2(x)))
+        x = self.pool(self.i3b(self.i3a(x)))
+        a4a = self.i4a(x)
+        x = self.i4c(self.i4b(a4a))
+        a4d = self.i4d(x)
+        x = self.pool(self.i4e(a4d))
+        out = self.i5b(self.i5a(x))
+        out1, out2 = a4a, a4d
+        if self.with_pool:
+            out = self.gap(out)
+            out1 = self.aux_pool(out1)
+            out2 = self.aux_pool(out2)
+        if self.num_classes > 0:
+            out = self.fc(self.drop(manip.squeeze(out, axis=[2, 3])))
+            out1 = self.aux1_fc(manip.flatten(self.aux1_conv(out1), 1))
+            out1 = self.aux1_out(self.aux1_drop(F.relu(out1)))
+            out2 = self.aux2_fc(manip.flatten(self.aux2_conv(out2), 1))
+            out2 = self.aux2_out(self.aux2_drop(out2))
+        return out, out1, out2
+
+
+def googlenet(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights need network download (zero-egress build)")
+    return GoogLeNet(**kwargs)
+
+
+# ---------------------------------------------------------------------------
+# Inception v3 (reference inceptionv3.py:509)
+# ---------------------------------------------------------------------------
+class _ConvBN(Layer):
+    """conv + BN + ReLU with (possibly rectangular) kernel/padding."""
+
+    def __init__(self, cin, cout, k, stride=1, padding=0):
+        super().__init__()
+        self.conv = Conv2D(cin, cout, k, stride=stride, padding=padding,
+                           bias_attr=False)
+        self.bn = BatchNorm2D(cout)
+
+    def forward(self, x):
+        return F.relu(self.bn(self.conv(x)))
+
+
+class _IncA(Layer):
+    def __init__(self, cin, pool_features):
+        super().__init__()
+        self.b1 = _ConvBN(cin, 64, 1)
+        self.b5 = Sequential(_ConvBN(cin, 48, 1), _ConvBN(48, 64, 5, padding=2))
+        self.b3d = Sequential(_ConvBN(cin, 64, 1), _ConvBN(64, 96, 3, padding=1),
+                              _ConvBN(96, 96, 3, padding=1))
+        self.pool = AvgPool2D(3, stride=1, padding=1, exclusive=False)
+        self.bp = _ConvBN(cin, pool_features, 1)
+
+    def forward(self, x):
+        return manip.concat([self.b1(x), self.b5(x), self.b3d(x),
+                             self.bp(self.pool(x))], axis=1)
+
+
+class _IncB(Layer):
+    def __init__(self, cin):
+        super().__init__()
+        self.b3 = _ConvBN(cin, 384, 3, stride=2)
+        self.b3d = Sequential(_ConvBN(cin, 64, 1), _ConvBN(64, 96, 3, padding=1),
+                              _ConvBN(96, 96, 3, stride=2))
+        self.pool = MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return manip.concat([self.b3(x), self.b3d(x), self.pool(x)], axis=1)
+
+
+class _IncC(Layer):
+    def __init__(self, cin, c7):
+        super().__init__()
+        self.b1 = _ConvBN(cin, 192, 1)
+        self.b7 = Sequential(
+            _ConvBN(cin, c7, 1),
+            _ConvBN(c7, c7, (1, 7), padding=(0, 3)),
+            _ConvBN(c7, 192, (7, 1), padding=(3, 0)))
+        self.b7d = Sequential(
+            _ConvBN(cin, c7, 1),
+            _ConvBN(c7, c7, (7, 1), padding=(3, 0)),
+            _ConvBN(c7, c7, (1, 7), padding=(0, 3)),
+            _ConvBN(c7, c7, (7, 1), padding=(3, 0)),
+            _ConvBN(c7, 192, (1, 7), padding=(0, 3)))
+        self.pool = AvgPool2D(3, stride=1, padding=1, exclusive=False)
+        self.bp = _ConvBN(cin, 192, 1)
+
+    def forward(self, x):
+        return manip.concat([self.b1(x), self.b7(x), self.b7d(x),
+                             self.bp(self.pool(x))], axis=1)
+
+
+class _IncD(Layer):
+    def __init__(self, cin):
+        super().__init__()
+        self.b3 = Sequential(_ConvBN(cin, 192, 1), _ConvBN(192, 320, 3, stride=2))
+        self.b7 = Sequential(
+            _ConvBN(cin, 192, 1),
+            _ConvBN(192, 192, (1, 7), padding=(0, 3)),
+            _ConvBN(192, 192, (7, 1), padding=(3, 0)),
+            _ConvBN(192, 192, 3, stride=2))
+        self.pool = MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return manip.concat([self.b3(x), self.b7(x), self.pool(x)], axis=1)
+
+
+class _IncE(Layer):
+    def __init__(self, cin):
+        super().__init__()
+        self.b1 = _ConvBN(cin, 320, 1)
+        self.b3_1 = _ConvBN(cin, 384, 1)
+        self.b3_2a = _ConvBN(384, 384, (1, 3), padding=(0, 1))
+        self.b3_2b = _ConvBN(384, 384, (3, 1), padding=(1, 0))
+        self.b3d_1 = Sequential(_ConvBN(cin, 448, 1),
+                                _ConvBN(448, 384, 3, padding=1))
+        self.b3d_3a = _ConvBN(384, 384, (1, 3), padding=(0, 1))
+        self.b3d_3b = _ConvBN(384, 384, (3, 1), padding=(1, 0))
+        self.pool = AvgPool2D(3, stride=1, padding=1, exclusive=False)
+        self.bp = _ConvBN(cin, 192, 1)
+
+    def forward(self, x):
+        b3 = self.b3_1(x)
+        b3 = manip.concat([self.b3_2a(b3), self.b3_2b(b3)], axis=1)
+        b3d = self.b3d_1(x)
+        b3d = manip.concat([self.b3d_3a(b3d), self.b3d_3b(b3d)], axis=1)
+        return manip.concat([self.b1(x), b3, b3d,
+                             self.bp(self.pool(x))], axis=1)
+
+
+class InceptionV3(Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = Sequential(
+            _ConvBN(3, 32, 3, stride=2), _ConvBN(32, 32, 3),
+            _ConvBN(32, 64, 3, padding=1), MaxPool2D(3, stride=2),
+            _ConvBN(64, 80, 1), _ConvBN(80, 192, 3), MaxPool2D(3, stride=2))
+        blocks = []
+        for cin, pf in zip([192, 256, 288], [32, 64, 64]):
+            blocks.append(_IncA(cin, pf))
+        blocks.append(_IncB(288))
+        for cin, c7 in zip([768] * 4, [128, 160, 160, 192]):
+            blocks.append(_IncC(cin, c7))
+        blocks.append(_IncD(768))
+        blocks.extend([_IncE(1280), _IncE(2048)])
+        self.blocks = LayerList(blocks)
+        if with_pool:
+            self.gap = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = Dropout(0.2)
+            self.fc = Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.stem(x)
+        for b in self.blocks:
+            x = b(x)
+        if self.with_pool:
+            x = self.gap(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(manip.reshape(x, [-1, 2048])))
+        return x
+
+
+def inception_v3(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights need network download (zero-egress build)")
+    return InceptionV3(**kwargs)

@@ -1,0 +1,139 @@
+"""Ablation probe for the secondary bench configs (ERNIE MLM + ViT-L/16) —
+the PERF.md methodology applied to the two configs still under their MFU
+targets (VERDICT r4: ERNIE 0.29 -> target >= 0.35; ViT ~0.23 flat since r3).
+
+Each variant is a short timed run of the same jitted framework train step
+bench.py uses.  Run on the real chip: python perf/secondary_probe.py
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+
+def _sync(x):
+    import jax
+    return float(np.asarray(jax.device_get(x)))
+
+
+def time_step(step, args, steps=8, warmup=2):
+    for _ in range(warmup):
+        out = step(*args)
+        args = (out[0], out[1]) + args[2:]
+    _sync(out[2])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = step(*args)
+        args = (out[0], out[1]) + args[2:]
+    _sync(out[2])
+    return (time.perf_counter() - t0) / steps
+
+
+def ernie_variant(B=32, S=512, dropout=True, fused_head=True, label=""):
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.nn.layer import functional_state
+    from paddle_tpu.models.ernie import ErnieForMaskedLM, ErnieConfig
+
+    paddle.seed(0)
+    cfg = ErnieConfig()
+    if not dropout:
+        cfg.hidden_dropout_prob = 0.0
+        cfg.attention_probs_dropout_prob = 0.0
+    model = ErnieForMaskedLM(cfg)
+    params = {n: p._value.astype(jnp.bfloat16)
+              if p._value.dtype == jnp.float32 else p._value
+              for n, p in model.named_parameters()}
+    opt = optimizer.AdamW(learning_rate=1e-4, parameters=[])
+    opt_state = opt.init_opt_state(params)
+    lr = jnp.asarray(1e-4, jnp.float32)
+
+    def loss_fn(params, ids, labels):
+        with functional_state(model, params):
+            loss, _ = model(Tensor(ids), labels=Tensor(labels),
+                            return_logits=not fused_head)
+        return (loss._value if hasattr(loss, "_value") else loss).astype(
+            jnp.float32)
+
+    def step(params, opt_state, ids, labels):
+        loss, g = jax.value_and_grad(loss_fn)(params, ids, labels)
+        new, ns = opt.apply_gradients_functional(params, g, opt_state, lr=lr)
+        return new, ns, loss
+
+    step = jax.jit(step, donate_argnums=(0, 1))
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, S)).astype(np.int32))
+    dt = time_step(step, (params, opt_state, ids, ids))
+    tps = B * S / dt
+    n_params = sum(int(np.prod(v.shape)) for v in params.values())
+    flops_tok = 6.0 * n_params + 6.0 * cfg.num_hidden_layers * S * cfg.hidden_size
+    print(f"ernie {label:34s} B={B:3d} {dt*1e3:7.1f} ms  {tps:9.0f} tok/s "
+          f"mfu={flops_tok * tps / 197e12:.3f}", flush=True)
+
+
+def vit_variant(B=64, drop_head_f32=False, label=""):
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.nn.layer import functional_state
+    from paddle_tpu.vision.models import vit_l_16
+
+    paddle.seed(0)
+    model = vit_l_16(num_classes=1000)
+    params = {n: p._value.astype(jnp.bfloat16)
+              for n, p in model.named_parameters()}
+    opt = optimizer.AdamW(learning_rate=1e-4, parameters=[])
+    opt_state = opt.init_opt_state(params)
+    lr = jnp.asarray(1e-4, jnp.float32)
+
+    def loss_fn(params, x, y):
+        with functional_state(model, params):
+            logits = model(Tensor(x))
+        lv = logits._value.astype(jnp.float32)
+        logp = jax.nn.log_softmax(lv, -1)
+        return -jnp.mean(jnp.take_along_axis(logp, y[:, None], -1))
+
+    def step(params, opt_state, x, y):
+        loss, g = jax.value_and_grad(loss_fn)(params, x, y)
+        new, ns = opt.apply_gradients_functional(params, g, opt_state, lr=lr)
+        return new, ns, loss
+
+    step = jax.jit(step, donate_argnums=(0, 1))
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(0, 1, (B, 3, 224, 224)).astype(np.float32)
+                    ).astype(jnp.bfloat16)
+    y = jnp.asarray(rng.integers(0, 1000, (B,)).astype(np.int32))
+    dt = time_step(step, (params, opt_state, x, y))
+    ips = B / dt
+    # ViT-L/16 fwd ~61.6 GFLOPs/img (6N per token convention over 197 toks)
+    n_params = sum(int(np.prod(v.shape)) for v in params.values())
+    flops_img = 3 * (2.0 * n_params * 197 + 4 * 24 * 197 * 197 * 1024)
+    print(f"vit   {label:34s} B={B:3d} {dt*1e3:7.1f} ms  {ips:9.1f} img/s "
+          f"mfu={flops_img * ips / 197e12:.3f}", flush=True)
+
+
+if __name__ == "__main__":
+    import jax
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(os.path.dirname(__file__), "..",
+                                   ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    if which in ("all", "ernie"):
+        ernie_variant(B=32, label="baseline (r5 bench)")
+        ernie_variant(B=32, dropout=False, label="no dropout")
+        ernie_variant(B=64, label="B=64")
+        ernie_variant(B=64, dropout=False, label="B=64 no dropout")
+        ernie_variant(B=32, fused_head=False, label="dense head")
+    if which in ("all", "vit"):
+        vit_variant(B=64, label="baseline (r5 bench)")
+        vit_variant(B=128, label="B=128")
+        vit_variant(B=256, label="B=256")

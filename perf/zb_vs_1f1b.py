@@ -1,0 +1,71 @@
+"""Zero-bubble vs 1F1B wall-clock (PERF.md §6): pp=4 virtual CPU mesh,
+8-layer tiny-llama, per-step value-fetch sync, timed steps after warmup.
+
+The round-4 engine re-ran the stage forward in both the B and the W vjp and
+lost to 1F1B at n_micro=16 (1.17x).  The round-5 engine saves vjp residuals
+at F and splits the saved backward (B: dx only, dW DCE'd; W: dW from the
+same residuals) — same total FLOPs as the fused 1F1B backward, shorter
+critical path.
+
+Usage: XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+           python perf/zb_vs_1f1b.py
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np                      # noqa: E402
+import jax.numpy as jnp                 # noqa: E402
+
+from paddle_tpu.distributed.topology import build_mesh            # noqa: E402
+from paddle_tpu.parallel.pipeline_schedules import Pipeline1F1BTrainStep  # noqa: E402
+from paddle_tpu.models.llama import (llama_config_tiny,           # noqa: E402
+                                     build_functional_llama,
+                                     llama_microbatch_fns)
+from paddle_tpu import optimizer        # noqa: E402
+
+
+def run(pp=4, n_micro=8, steps=8, warmup=2, hidden=128, layers=8, seq=64):
+    cfg = llama_config_tiny(vocab=256, hidden=hidden, layers=layers, heads=4,
+                            seq=seq)
+    devs = jax.devices()[:pp]
+    mesh = build_mesh({"pp": pp}, devices=devs)
+
+    def make_step(schedule):
+        ep, bp, hp, _, _, _ = build_functional_llama(
+            cfg, key=jax.random.PRNGKey(3), n_micro=n_micro)
+        ea, ba, hl = llama_microbatch_fns(cfg)
+        opt = optimizer.AdamW(learning_rate=1e-3, parameters=[])
+        return Pipeline1F1BTrainStep(mesh, ea, ba, hl, ep, bp, hp, opt,
+                                     n_micro=n_micro, schedule=schedule)
+
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, 256, (n_micro, seq)).astype(np.int32))
+    out = {}
+    for schedule in ("1f1b", "zero_bubble"):
+        step = make_step(schedule)
+        for _ in range(warmup):
+            float(step((ids, ids)).numpy())
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            float(step((ids, ids)).numpy())   # value fetch = real barrier
+        out[schedule] = (time.perf_counter() - t0) / steps * 1000
+    return out
+
+
+if __name__ == "__main__":
+    print(f"{'n_micro':>8} {'1F1B ms':>10} {'ZB ms':>10} {'ratio':>7}")
+    for n_micro in (4, 8, 16):
+        r = run(n_micro=n_micro)
+        print(f"{n_micro:>8} {r['1f1b']:>10.1f} {r['zero_bubble']:>10.1f} "
+              f"{r['zero_bubble'] / r['1f1b']:>7.2f}")

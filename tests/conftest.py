@@ -11,8 +11,11 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 import jax  # noqa: E402
 
 # the environment's TPU tunnel plugin force-appends itself to jax_platforms;
-# pin CPU explicitly so tests always run on the 8-device virtual mesh
-jax.config.update("jax_platforms", "cpu")
+# pin CPU explicitly so tests always run on the 8-device virtual mesh.
+# PADDLE_TPU_TEST_REAL=1 opts out for the real-chip-only tests (the
+# Pallas-PRNG dropout checks have no interpret-mode lowering).
+if os.environ.get("PADDLE_TPU_TEST_REAL") != "1":
+    jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -69,9 +69,14 @@ def _assert_tree_close(out, ref, rtol, atol, tag):
         for o, r in zip(out, ref):
             _assert_tree_close(o, r, rtol, atol, tag)
         return
-    o = np.asarray(out.numpy() if isinstance(out, Tensor) else out, dtype=np.float64) \
-        if np.asarray(ref).dtype.kind in "fc" else np.asarray(
-            out.numpy() if isinstance(out, Tensor) else out)
+    kind = np.asarray(ref).dtype.kind
+    raw = out.numpy() if isinstance(out, Tensor) else out
+    if kind == "c":
+        o = np.asarray(raw, dtype=np.complex128)
+    elif kind == "f":
+        o = np.asarray(raw, dtype=np.float64)
+    else:
+        o = np.asarray(raw)
     np.testing.assert_allclose(o, ref, rtol=rtol, atol=atol,
                                err_msg=f"[{tag}] mismatch")
 

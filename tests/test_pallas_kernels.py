@@ -60,7 +60,9 @@ def test_flash_attention_cross_lengths():
 
 
 def test_unsupported_shape_returns_none():
-    q = jnp.zeros((1, 100, 1, 64))  # 100 not a multiple of 128
+    q = jnp.zeros((1, 197, 1, 64))  # short untileable S: XLA path wins
+    assert flash_attention(q, q, q) is None
+    q = jnp.zeros((1, 128, 1, 300))  # head_dim > 256
     assert flash_attention(q, q, q) is None
 
 
@@ -407,3 +409,101 @@ def test_pallas_adamw_now_optin():
         assert res is None or len(res) == 3   # kernel may decline shapes
     finally:
         paddle.set_flags({"use_pallas_adamw": False})
+
+
+# ---------------------------------------------------------------------------
+# In-kernel attention dropout (round 5)
+# ---------------------------------------------------------------------------
+_on_tpu = any(d.platform == "tpu" for d in jax.devices())
+
+
+@pytest.mark.skipif(not _on_tpu, reason="pltpu PRNG has no interpret-mode "
+                    "lowering; numeric checks ran on the real chip")
+def test_flash_attention_dropout_kernel():
+    """Determinism per seed, variation across seeds, mean ~ no-dropout, and
+    grad parity vs an XLA reference using the kernel's own extracted mask."""
+    import math
+    B, S, H, D = 1, 128, 1, 128
+    lr = np.random.default_rng(1)
+    q, k, v, w = (jnp.asarray(lr.normal(0, 1, (B, S, H, D)).astype(np.float32))
+                  for _ in range(4))
+    kw = dict(dropout_rate=0.4, dropout_seed=5)
+    a = np.asarray(flash_attention(q, k, v, causal=True, **kw))
+    b = np.asarray(flash_attention(q, k, v, causal=True, **kw))
+    assert np.array_equal(a, b)                      # deterministic per seed
+    c = np.asarray(flash_attention(q, k, v, causal=True, dropout_rate=0.4,
+                                   dropout_seed=6))
+    assert not np.array_equal(a, c)                  # seed matters
+    # mean over seeds approaches the no-dropout output
+    o0 = np.asarray(flash_attention(q, k, v, causal=True))
+    mean = np.mean([np.asarray(flash_attention(q, k, v, causal=True,
+                                               dropout_rate=0.4,
+                                               dropout_seed=s))
+                    for s in range(24)], axis=0)
+    assert np.abs(mean - o0).mean() < 0.35 * np.abs(o0).mean()
+    # extract the kernel's actual mask via v=I and check grads exactly
+    eye = jnp.eye(S, dtype=jnp.float32)[None, :, None, :]
+    pm = flash_attention(q, k, eye, causal=True, **kw)[0, :, 0, :]
+    pn = flash_attention(q, k, eye, causal=True)[0, :, 0, :]
+    m = jnp.where(pn > 1e-30, pm / jnp.maximum(pn, 1e-30), 0.0)
+
+    def ref_loss(q_, k_, v_):
+        s = jnp.einsum("bqhd,bkhd->bhqk", q_, k_) / math.sqrt(D)
+        s = jnp.where(jnp.tril(jnp.ones((S, S), bool)),
+                      s.astype(jnp.float32), -jnp.inf)
+        p = jax.nn.softmax(s, -1)
+        o = jnp.einsum("bhqk,bkhd->bqhd", p * m[None, None], v_)
+        return jnp.vdot(o, w) / 100.0
+
+    def fa_loss(q_, k_, v_):
+        return jnp.vdot(flash_attention(q_, k_, v_, causal=True, **kw),
+                        w) / 100.0
+
+    gr = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    gf = jax.grad(fa_loss, argnums=(0, 1, 2))(q, k, v)
+    for a_, b_ in zip(gr, gf):
+        np.testing.assert_allclose(np.asarray(a_), np.asarray(b_),
+                                   rtol=0.05, atol=5e-4)
+
+
+def test_flash_attention_dropout_rate0_matches_plain():
+    """rate=0 must be bit-identical to the plain kernel (shared cache key
+    would otherwise hide a plumbing bug)."""
+    B, S, H, D = 1, 128, 2, 64
+    lr = np.random.default_rng(2)
+    q, k, v = (jnp.asarray(lr.normal(0, 1, (B, S, H, D)).astype(np.float32))
+               for _ in range(3))
+    o0 = flash_attention(q, k, v, causal=False, interpret=True)
+    od = flash_attention(q, k, v, causal=False, interpret=True,
+                         dropout_rate=0.0, dropout_seed=3)
+    np.testing.assert_array_equal(np.asarray(o0), np.asarray(od))
+
+
+@pytest.mark.parametrize("S", [453, 390])
+def test_flash_attention_pad_to_tile(S):
+    """Long untileable sequence lengths pad to the next 128-multiple with a
+    pad segment — output and grads match the exact XLA reference on the
+    real rows.  (Short untileable S like ViT's 197 deliberately stays on
+    the XLA path: measured slower through the padded kernel.)"""
+    B, H, D = 2, 2, 64
+    lr = np.random.default_rng(3)
+    q, k, v = (jnp.asarray(lr.normal(0, 1, (B, S, H, D)).astype(np.float32))
+               for _ in range(3))
+    out = flash_attention(q, k, v, causal=False, interpret=True)
+    assert out is not None, "pad-to-tile path did not engage"
+    ref = _ref_sdpa(q, k, v, False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+    def loss_fa(q, k, v):
+        return (flash_attention(q, k, v, causal=False, interpret=True)
+                ** 2).sum()
+
+    def loss_ref(q, k, v):
+        return (_ref_sdpa(q, k, v, False) ** 2).sum()
+
+    gfa = jax.grad(loss_fa, argnums=(0, 1, 2))(q, k, v)
+    gref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gfa, gref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)

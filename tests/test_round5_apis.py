@@ -1,0 +1,214 @@
+"""Round-5 API gap closures (VERDICT r4 missing #4/#5): grid_sample +
+affine_grid, pdist, LKJCholesky, GoogLeNet/InceptionV3/LeNet.
+
+torch (CPU) serves as the independent reference where scipy has no
+equivalent (grid_sample semantics, LKJCholesky log_prob)."""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.nn import functional as F
+
+rng = np.random.default_rng(11)
+
+
+# ---------------------------------------------------------------------------
+# grid_sample / affine_grid
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("mode", ["bilinear", "nearest"])
+@pytest.mark.parametrize("padding_mode", ["zeros", "border", "reflection"])
+@pytest.mark.parametrize("align_corners", [True, False])
+def test_grid_sample_2d_vs_torch(mode, padding_mode, align_corners):
+    import torch
+    x = rng.normal(0, 1, (2, 3, 5, 6)).astype(np.float32)
+    grid = rng.uniform(-1.3, 1.3, (2, 4, 7, 2)).astype(np.float32)
+    out = F.grid_sample(paddle.to_tensor(x), paddle.to_tensor(grid),
+                        mode=mode, padding_mode=padding_mode,
+                        align_corners=align_corners)
+    ref = torch.nn.functional.grid_sample(
+        torch.from_numpy(x), torch.from_numpy(grid), mode=mode,
+        padding_mode=padding_mode, align_corners=align_corners).numpy()
+    np.testing.assert_allclose(np.asarray(out.numpy()), ref,
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_grid_sample_3d_vs_torch():
+    import torch
+    x = rng.normal(0, 1, (1, 2, 4, 5, 6)).astype(np.float32)
+    grid = rng.uniform(-1.1, 1.1, (1, 3, 4, 5, 3)).astype(np.float32)
+    out = F.grid_sample(paddle.to_tensor(x), paddle.to_tensor(grid),
+                        mode="bilinear", padding_mode="zeros",
+                        align_corners=True)
+    ref = torch.nn.functional.grid_sample(
+        torch.from_numpy(x), torch.from_numpy(grid), mode="bilinear",
+        padding_mode="zeros", align_corners=True).numpy()
+    np.testing.assert_allclose(np.asarray(out.numpy()), ref,
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_grid_sample_grads():
+    """Differentiable w.r.t. both input and grid (the reference ships
+    dedicated CUDA bwd kernels; jax.vjp must produce matching numerics)."""
+    import torch
+    x = rng.normal(0, 1, (1, 2, 4, 4)).astype(np.float32)
+    grid = rng.uniform(-0.8, 0.8, (1, 3, 3, 2)).astype(np.float32)
+
+    xt = paddle.to_tensor(x, stop_gradient=False)
+    gt = paddle.to_tensor(grid, stop_gradient=False)
+    out = F.grid_sample(xt, gt, align_corners=True)
+    out.sum().backward()
+
+    tx = torch.from_numpy(x).requires_grad_(True)
+    tg = torch.from_numpy(grid).requires_grad_(True)
+    torch.nn.functional.grid_sample(tx, tg, mode="bilinear",
+                                    padding_mode="zeros",
+                                    align_corners=True).sum().backward()
+    np.testing.assert_allclose(np.asarray(xt.grad.numpy()),
+                               tx.grad.numpy(), rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(gt.grad.numpy()),
+                               tg.grad.numpy(), rtol=1e-3, atol=1e-4)
+
+
+def test_affine_grid_vs_torch():
+    import torch
+    theta = rng.normal(0, 1, (2, 2, 3)).astype(np.float32)
+    for align in (True, False):
+        out = F.affine_grid(paddle.to_tensor(theta), [2, 3, 4, 5],
+                            align_corners=align)
+        ref = torch.nn.functional.affine_grid(
+            torch.from_numpy(theta), [2, 3, 4, 5],
+            align_corners=align).numpy()
+        np.testing.assert_allclose(np.asarray(out.numpy()), ref,
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_grid_sample_affine_grid_compose():
+    """Identity theta + grid_sample reproduces the input."""
+    x = rng.normal(0, 1, (1, 2, 6, 6)).astype(np.float32)
+    theta = np.tile(np.asarray([[1, 0, 0], [0, 1, 0]], np.float32), (1, 1, 1))
+    grid = F.affine_grid(paddle.to_tensor(theta), [1, 2, 6, 6],
+                         align_corners=True)
+    out = F.grid_sample(paddle.to_tensor(x), grid, align_corners=True)
+    np.testing.assert_allclose(np.asarray(out.numpy()), x, rtol=1e-4,
+                               atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# pdist
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("p", [2.0, 1.0, 3.0])
+def test_pdist_vs_scipy(p):
+    from scipy.spatial.distance import pdist as sp_pdist
+    x = rng.normal(0, 1, (7, 5)).astype(np.float32)
+    out = paddle.pdist(paddle.to_tensor(x), p=p)
+    ref = sp_pdist(x, metric="minkowski", p=p)
+    np.testing.assert_allclose(np.asarray(out.numpy()), ref, rtol=2e-4,
+                               atol=1e-5)
+
+
+def test_pdist_grad_matches_cdist():
+    x = rng.normal(0, 1, (5, 3)).astype(np.float32)
+    xt = paddle.to_tensor(x, stop_gradient=False)
+    paddle.pdist(xt).sum().backward()
+    g_pdist = np.asarray(xt.grad.numpy())
+    xt2 = paddle.to_tensor(x, stop_gradient=False)
+    full = paddle.cdist(xt2, xt2)
+    # sum of upper triangle == pdist sum
+    iu = np.triu_indices(5, k=1)
+    (full.sum() * 0.5).backward()
+    np.testing.assert_allclose(g_pdist, np.asarray(xt2.grad.numpy()),
+                               rtol=1e-3, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# LKJCholesky
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("method", ["onion", "cvine"])
+@pytest.mark.parametrize("dim", [2, 4])
+def test_lkj_sample_is_valid_cholesky(method, dim):
+    from paddle_tpu.distribution import LKJCholesky
+    paddle.seed(3)
+    d = LKJCholesky(dim=dim, concentration=1.5, sample_method=method)
+    s = np.asarray(d.sample([64]).numpy())
+    assert s.shape == (64, dim, dim)
+    # lower triangular with positive diagonal
+    assert np.allclose(s, np.tril(s), atol=1e-6)
+    assert (np.diagonal(s, axis1=-2, axis2=-1) > 0).all()
+    # rows have unit norm -> L L^T is a correlation matrix
+    corr = s @ np.swapaxes(s, -1, -2)
+    np.testing.assert_allclose(np.diagonal(corr, axis1=-2, axis2=-1), 1.0,
+                               atol=1e-5)
+    off = corr[:, ~np.eye(dim, dtype=bool)]
+    assert (np.abs(off) <= 1.0 + 1e-6).all()
+
+
+def test_lkj_log_prob_vs_torch():
+    import torch
+    from paddle_tpu.distribution import LKJCholesky
+    for dim, conc in [(2, 1.0), (3, 2.5), (4, 0.7)]:
+        d = LKJCholesky(dim=dim, concentration=conc)
+        td = torch.distributions.LKJCholesky(dim, concentration=conc)
+        val = np.asarray(d.sample([5]).numpy())
+        lp = np.asarray(d.log_prob(paddle.to_tensor(val)).numpy())
+        ref = td.log_prob(torch.from_numpy(val)).numpy()
+        np.testing.assert_allclose(lp, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_lkj_dim2_eta1_uniform_marginal():
+    """For D=2, eta=1 the off-diagonal correlation is Uniform(-1, 1)."""
+    from paddle_tpu.distribution import LKJCholesky
+    paddle.seed(7)
+    d = LKJCholesky(dim=2, concentration=1.0)
+    s = np.asarray(d.sample([4000]).numpy())
+    r = (s @ np.swapaxes(s, -1, -2))[:, 1, 0]
+    # mean ~ 0, var ~ 1/3, roughly uniform quartiles
+    assert abs(r.mean()) < 0.05
+    assert abs(r.var() - 1 / 3) < 0.03
+    assert abs(np.mean(np.abs(r) < 0.5) - 0.5) < 0.05
+
+
+# ---------------------------------------------------------------------------
+# vision models
+# ---------------------------------------------------------------------------
+def test_lenet_forward_and_training():
+    from paddle_tpu.vision.models import LeNet
+    from paddle_tpu import optimizer
+    paddle.seed(0)
+    m = LeNet(num_classes=10)
+    opt = optimizer.AdamW(learning_rate=1e-3, parameters=m.parameters())
+    x = paddle.to_tensor(rng.normal(0, 1, (4, 1, 28, 28)).astype(np.float32))
+    y = paddle.to_tensor(rng.integers(0, 10, (4,)).astype(np.int64))
+    losses = []
+    for _ in range(4):
+        loss = F.cross_entropy(m(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]
+
+
+def test_googlenet_three_heads():
+    from paddle_tpu.vision.models import googlenet
+    paddle.seed(0)
+    m = googlenet(num_classes=12)
+    m.eval()
+    x = paddle.to_tensor(rng.normal(0, 1, (1, 3, 224, 224)).astype(np.float32))
+    with paddle.no_grad():
+        out, a1, a2 = m(x)
+    assert tuple(out.shape) == (1, 12)
+    assert tuple(a1.shape) == (1, 12)
+    assert tuple(a2.shape) == (1, 12)
+
+
+def test_inception_v3_forward():
+    from paddle_tpu.vision.models import inception_v3
+    paddle.seed(0)
+    m = inception_v3(num_classes=7)
+    m.eval()
+    x = paddle.to_tensor(rng.normal(0, 1, (1, 3, 299, 299)).astype(np.float32))
+    with paddle.no_grad():
+        out = m(x)
+    assert tuple(out.shape) == (1, 7)
