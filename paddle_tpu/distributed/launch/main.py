@@ -63,6 +63,12 @@ def _parse_args(argv):
     p.add_argument("--np", type=str, default=None,
                    help="elastic range 'min:max' (reference --np): start at "
                         "max procs, scale in toward min on repeated failure")
+    p.add_argument("--elastic_store", type=str, default=None,
+                   help="directory for a FileStore membership store: external "
+                        "workers joining it trigger a live scale-OUT (gang "
+                        "interrupt + relaunch at the larger world, ranks "
+                        "resuming from their checkpoint — reference "
+                        "fleet/elastic/manager.py watch->re-rank->restart)")
     p.add_argument("--devices", type=str, default=None,
                    help="comma list of device ids to pin per local rank")
     p.add_argument("script", type=str)
@@ -99,11 +105,20 @@ def _rank_env(base_env, *, rank, local_rank, world, master, endpoints,
     return env
 
 
+#: sentinel return: the gang was interrupted by a membership change (the
+#: elastic loop relaunches at the new world size)
+MEMBERSHIP_CHANGED = -257
+
+
 def launch_gang(cmd, *, nproc, master=None, nnodes=1, node_rank=0,
                 env=None, log_dir=None, max_restarts=0, devices=None,
-                poll_interval=0.5):
+                poll_interval=0.5, interrupt_check=None):
     """Spawn and watch a gang of `nproc` rank processes running `cmd`
-    (a list, the per-rank argv). Returns the max child return code."""
+    (a list, the per-rank argv). Returns the max child return code.
+
+    interrupt_check: optional callable polled with the children; returning
+    True terminates the gang and returns MEMBERSHIP_CHANGED (elastic
+    scale-out: a joiner arrived and the gang must re-rank)."""
     base_env = dict(os.environ if env is None else env)
     if master is None:
         master = f"127.0.0.1:{_free_port()}"
@@ -142,6 +157,16 @@ def launch_gang(cmd, *, nproc, master=None, nnodes=1, node_rank=0,
                     except OSError:
                         pass
 
+        def _stop_gang():
+            _terminate_all()
+            deadline = time.time() + 10
+            for pr in procs:
+                t = max(0.1, deadline - time.time())
+                try:
+                    pr.wait(timeout=t)
+                except subprocess.TimeoutExpired:
+                    pr.kill()
+
         prev_handlers = {}
         for s in (signal.SIGINT, signal.SIGTERM):
             try:
@@ -157,18 +182,15 @@ def launch_gang(cmd, *, nproc, master=None, nnodes=1, node_rank=0,
                 codes = [pr.poll() for pr in procs]
                 failed = [c for c in codes if c not in (None, 0)]
                 if failed:
-                    _terminate_all()
-                    deadline = time.time() + 10
-                    for pr in procs:
-                        t = max(0.1, deadline - time.time())
-                        try:
-                            pr.wait(timeout=t)
-                        except subprocess.TimeoutExpired:
-                            pr.kill()
+                    _stop_gang()
                     rc = max(failed)
                     break
                 if all(c == 0 for c in codes):
                     rc = 0
+                    break
+                if interrupt_check is not None and interrupt_check():
+                    _stop_gang()
+                    rc = MEMBERSHIP_CHANGED
                     break
                 time.sleep(poll_interval)
         finally:
@@ -177,7 +199,7 @@ def launch_gang(cmd, *, nproc, master=None, nnodes=1, node_rank=0,
             for lf in logs:
                 lf.close()
 
-        if rc == 0 or attempts >= max_restarts:
+        if rc == 0 or rc == MEMBERSHIP_CHANGED or attempts >= max_restarts:
             return rc
         attempts += 1
         # elastic-style gang restart on a fresh rendezvous port
@@ -211,7 +233,8 @@ def main(argv=None):
         except ValueError:
             sys.exit(f"[launch] invalid --np {args.np!r}: expected "
                      "'min:max' with 1 <= min <= max")
-        sys.exit(_elastic_loop(cmd, np_min, np_max, args, devices))
+        sys.exit(_elastic_loop(cmd, np_min, np_max, args, devices,
+                                store_dir=args.elastic_store))
     nproc = args.nproc_per_node if args.nproc_per_node is not None else \
         int(os.environ.get("PADDLE_NPROC_PER_NODE", 1))
     rc = launch_gang(cmd, nproc=nproc, master=args.master,
@@ -221,24 +244,67 @@ def main(argv=None):
     sys.exit(rc)
 
 
-def _elastic_loop(cmd, np_min, np_max, args, devices):
+def _elastic_loop(cmd, np_min, np_max, args, devices, store_dir=None):
     """Elastic mode (reference CollectiveElasticController): the membership
     store holds one slot per local worker; a gang failure retires a slot
     (the node-leave analog) and the gang relaunches at the surviving
-    member count, giving up once membership drops below np_min."""
-    from ..fleet.elastic import ElasticManager, MemoryStore
+    member count, giving up once membership drops below np_min.
 
-    store = MemoryStore()
-    mgr = ElasticManager(store, np_min=np_min, np_max=np_max,
-                         heartbeat_timeout=1e9, grace_period=0.0)
-    for i in range(np_max):
-        mgr.register(f"local:{i}")
+    With --elastic_store the membership lives in a FileStore that EXTERNAL
+    joiners can register into: the watch loop interrupts a running gang on
+    a membership change and relaunches at the new (larger) world with a
+    regenerated rank map — the scale-OUT path (reference
+    fleet/elastic/manager.py watch -> re-rank -> restart on join)."""
+    from ..fleet.elastic import ElasticManager, MemoryStore, FileStore
+
+    if store_dir:
+        # finite lease: a crashed joiner (or a previous run's members) age
+        # out instead of inflating the gang forever; the watch poll below
+        # re-heartbeats this launcher's own slots
+        store = FileStore(store_dir)
+        mgr = ElasticManager(store, np_min=np_min, np_max=np_max,
+                             heartbeat_timeout=60.0, grace_period=0.0)
+        own = [f"local:{i}" for i in range(np_min)]
+        for h in own:                # joiners grow the gang toward np_max
+            mgr.register(h)
+    else:
+        store = MemoryStore()
+        mgr = ElasticManager(store, np_min=np_min, np_max=np_max,
+                             heartbeat_timeout=1e9, grace_period=0.0)
+        for i in range(np_max):
+            mgr.register(f"local:{i}")
     mgr.watch()                                  # seed the stable membership
+
+    def membership_changed():
+        if not store_dir:
+            return False
+        from ..fleet.elastic import ElasticStatus
+        for h in own:
+            mgr.heartbeat(h)
+        return mgr.watch() == ElasticStatus.CHANGE
+
+    try:
+        return _elastic_run(cmd, np_min, mgr, args, devices,
+                            membership_changed)
+    finally:
+        if store_dir:
+            for h in own:
+                mgr.deregister(h)    # don't resurrect in a reused store dir
+
+
+def _elastic_run(cmd, np_min, mgr, args, devices, membership_changed):
     while True:
         world = len(mgr.members())
         rc = launch_gang(cmd, nproc=world, master=args.master,
                          nnodes=1, node_rank=0, log_dir=args.log_dir,
-                         max_restarts=args.max_restarts, devices=devices)
+                         max_restarts=args.max_restarts, devices=devices,
+                         interrupt_check=membership_changed)
+        if rc == MEMBERSHIP_CHANGED:
+            new_world = len(mgr.members())
+            print(f"[launch] elastic: membership changed {world} -> "
+                  f"{new_world}; re-ranking and restarting "
+                  f"(rank map: {mgr.rank_map()})", file=sys.stderr)
+            continue
         if rc == 0:
             return 0
         # retire one slot and consult the manager

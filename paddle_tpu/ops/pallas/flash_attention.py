@@ -632,8 +632,6 @@ def _supported(q_shape, k_shape, causal=False):
         # the fallback for this shape.
         return False
     for s in (s_q, s_k):
-        if s % 128 != 0 and s < 128:
-            return False
         if s % 128 != 0:
             return False
     return True
@@ -674,6 +672,12 @@ def flash_attention(q, k, v, causal=False, interpret=False, segment_ids=None,
     (per-block regenerable PRNG; the mask never exists in HBM).  seed may
     be a traced scalar — it does not bake into the executable.
     """
+    drop = float(dropout_rate or 0.0)
+    if drop >= 1.0:
+        # torch/paddle semantics: dropout_p == 1 zeroes the output (the
+        # kernel's uint32 threshold would wrap and emit inf instead).
+        # Checked BEFORE pad-to-tile so the zeros match the caller's shape.
+        return jnp.zeros_like(q)
     unpad_to = None
     if not _supported(q.shape, k.shape, causal):
         s_q, s_k = q.shape[1], k.shape[1]
@@ -689,11 +693,6 @@ def flash_attention(q, k, v, causal=False, interpret=False, segment_ids=None,
         if not tileable:
             return None
         q, k, v, segment_ids, unpad_to = _pad_to_tile(q, k, v, segment_ids)
-    drop = float(dropout_rate or 0.0)
-    if drop >= 1.0:
-        # torch/paddle semantics: dropout_p == 1 zeroes the output (the
-        # kernel's uint32 threshold would wrap and emit inf instead)
-        return jnp.zeros_like(q)
     extras = ()
     has_seg = segment_ids is not None
     if has_seg:

