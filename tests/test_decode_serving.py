@@ -237,3 +237,29 @@ def test_generate_executable_cache_hits():
     assert len(llama_mod._GENERATE_CACHE) == 1
     llama_mod.llama_generate(params, cfg, prompt, **llama_generate_kwargs)
     assert len(llama_mod._GENERATE_CACHE) == 1     # reused, not rebuilt
+
+
+def test_fused_generate_matches_loop():
+    """llama_generate_fused (single-dispatch fori_loop generation) produces
+    the same tokens as the per-step loop for greedy decoding, incl. eos
+    masking."""
+    from paddle_tpu.models.llama import (llama_config_tiny,
+                                         build_functional_llama,
+                                         llama_generate,
+                                         llama_generate_fused)
+    cfg = llama_config_tiny(vocab=64, hidden=32, layers=2, heads=4, seq=64)
+    ep, bp, hp, *_ = build_functional_llama(cfg)
+    params = (ep, bp, hp)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 64, (2, 8)).astype(np.int32)
+    a = np.asarray(llama_generate(params, cfg, ids, max_new_tokens=6))
+    b = np.asarray(llama_generate_fused(params, cfg, ids, max_new_tokens=6))
+    np.testing.assert_array_equal(a, b)
+    # eos masking: once eos appears the tail stays eos
+    c = np.asarray(llama_generate_fused(params, cfg, ids, max_new_tokens=8,
+                                        eos_token_id=3))
+    for row in c:
+        tail = row[8:]
+        hits = np.where(tail == 3)[0]
+        if len(hits):
+            assert (tail[hits[0]:] == 3).all()
