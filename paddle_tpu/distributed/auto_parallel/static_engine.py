@@ -177,6 +177,82 @@ class Engine:
         self._dist.sync_to_network()
         return self.history
 
+    def cost(self, inputs_spec, labels_spec=None, mode="train"):
+        """Compiled-HLO cost summary for the current sharding plan
+        (reference auto_parallel/static/cost/: the cost model the static
+        pipeline consults; here the COMPILED program is the model — XLA has
+        already placed the collectives, so counting them and reading the
+        compiler's own cost/memory analysis explains WHY this plan costs
+        what it does, without running a step).
+
+        inputs_spec / labels_spec: shape/dtype specs (anything with .shape
+        and .dtype, e.g. static.InputSpec or jax.ShapeDtypeStruct); every
+        dim must be concrete — costs are per-shape.
+        Returns {flops, bytes_accessed, peak_hbm_bytes, collectives: {...},
+        output_bytes}.  The lowered step is cached on the DistModel, so a
+        later fit()/evaluate() reuses the same compilation.
+        """
+        import re as _re
+
+        if mode not in ("train", "eval", "predict"):
+            raise ValueError(f"cost(): unknown mode {mode!r}")
+        if mode in ("train", "eval") and labels_spec is None:
+            raise ValueError(f"cost(mode={mode!r}) requires labels_spec")
+        if mode == "train" and self.optimizer is None:
+            raise ValueError("cost(mode='train') requires an optimizer")
+        if mode in ("train", "eval") and self.loss is None:
+            raise ValueError(f"cost(mode={mode!r}) requires a loss")
+
+        def _sds(spec):
+            if any(d is None for d in spec.shape):
+                raise ValueError(
+                    f"cost() needs concrete dims, got {tuple(spec.shape)} — "
+                    "costs are per-shape (substitute the real batch size)")
+            return jax.ShapeDtypeStruct(tuple(int(d) for d in spec.shape),
+                                        jnp.dtype(spec.dtype))
+
+        x_sd = _sds(inputs_spec)
+        d = self._dist
+        if mode == "train":
+            if d._train_step is None:
+                d._train_step = d._build_train()
+            lr_sd = jax.ShapeDtypeStruct((), jnp.float32)
+            lowered = d._train_step.lower(d.params, d.opt_state, lr_sd,
+                                          x_sd, _sds(labels_spec))
+        elif mode == "eval":
+            if d._eval_step is None:
+                d._eval_step = d._build_eval()
+            lowered = d._eval_step.lower(d.params, x_sd, _sds(labels_spec))
+        else:
+            if d._pred_step is None:
+                d._pred_step = d._build_pred()
+            lowered = d._pred_step.lower(d.params, x_sd)
+        compiled = lowered.compile()
+        hlo = compiled.as_text()
+        colls = {}
+        for op in ("all-reduce", "all-gather", "reduce-scatter",
+                   "collective-permute", "all-to-all"):
+            n = len(_re.findall(rf"\b{op}(?:-start)?\.?\d*\s*=", hlo))
+            if n:
+                colls[op] = n
+        try:
+            ca = compiled.cost_analysis() or {}
+        except Exception:
+            ca = {}
+        try:
+            ma = compiled.memory_analysis()
+            peak = getattr(ma, "temp_size_in_bytes", None)
+        except Exception:
+            peak = None
+        return {
+            "flops": ca.get("flops"),
+            "bytes_accessed": ca.get("bytes accessed"),
+            # jax's key for the output operand is 'bytes accessedout{}'
+            "output_bytes": ca.get("bytes accessedout{}"),
+            "peak_hbm_bytes": peak,
+            "collectives": colls,
+        }
+
     def evaluate(self, valid_data, steps=None):
         self._dist.eval()
         losses = []

@@ -106,3 +106,37 @@ def test_dist_model_sharded_params_keep_sharding():
     assert l1 < l0
     w = dm.params["0.weight"]
     assert not w.sharding.is_fully_replicated, w.sharding
+
+
+def test_engine_cost_reports_collectives_and_flops():
+    """Engine.cost (VERDICT r4 stretch #9): compiled-HLO cost summary —
+    flops/bytes from the compiler's own cost analysis and the collective
+    count XLA actually placed for the sharding plan, without running a
+    step."""
+    import jax
+    from paddle_tpu.distributed.auto_parallel.static_engine import Engine
+    from paddle_tpu.distributed.auto_parallel import (shard_tensor,
+                                                      ProcessMesh)
+    from paddle_tpu.distributed.auto_parallel.placement_type import (
+        Shard, Replicate)
+    from paddle_tpu.static import InputSpec
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(16, 32), nn.Tanh(), nn.Linear(32, 4))
+    pmesh = ProcessMesh(np.arange(4).reshape(2, 2), dim_names=["dp", "mp"])
+    # column-shard the first weight over the mp mesh dim so XLA must place
+    # collectives for the replicated-output matmul chain
+    sharded = shard_tensor(net[0].weight, pmesh, [Replicate(), Shard(1)])
+    net[0].weight._set_value(sharded._value)
+    opt = optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+    eng = Engine(net, loss=nn.CrossEntropyLoss(), optimizer=opt)
+    cost = eng.cost(InputSpec([8, 16], "float32"),
+                    InputSpec([8], "int64"), mode="train")
+    assert cost["flops"] is None or cost["flops"] > 0
+    assert isinstance(cost["collectives"], dict)
+    # the mp-sharded matmul forces at least one cross-device op
+    assert sum(cost["collectives"].values()) >= 1, cost
+    # eval mode also lowers
+    cost_e = eng.cost(InputSpec([8, 16], "float32"),
+                      InputSpec([8], "int64"), mode="eval")
+    assert isinstance(cost_e["collectives"], dict)
