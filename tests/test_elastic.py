@@ -180,7 +180,17 @@ def test_launch_elastic_scale_out(tmp_path):
     from paddle_tpu.distributed.fleet.elastic import FileStore
 
     def join_later():
-        time.sleep(3.0)
+        # join only after the first gang has checkpointed real progress —
+        # under a loaded machine process startup can take seconds, and an
+        # earlier join would restart a gang that never reached step > 0
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            try:
+                if int(open(ckpt).read()) >= 2:
+                    break
+            except (OSError, ValueError):
+                pass
+            time.sleep(0.2)
         FileStore(store_dir).heartbeat("joiner:0", stale_after=1e9)
 
     t = threading.Thread(target=join_later)
