@@ -81,7 +81,11 @@ def run_spec(name, fn, spec: S):
     import paddle_tpu as paddle
     from paddle_tpu.core.tensor import Tensor
 
-    rng = np.random.default_rng(hash(name) % 2**31)
+    # crc32, not hash(): python str hashing is per-process randomized, so
+    # inputs would differ every run — a sample occasionally landing within
+    # grad-check eps of a kink (hinge losses) made the suite flake
+    import zlib
+    rng = np.random.default_rng(zlib.crc32(name.encode()) % 2**31)
     args, kwargs = build_args(spec, rng)
 
     if spec.ref is not None:

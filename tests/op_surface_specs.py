@@ -624,8 +624,12 @@ TENSOR = {
              rtol=2e-3, atol=2e-3),
     "eigh": C("test_ops_linalg.py"),
     "eigvals": C("test_ops_linalg.py"),
-    "eigvalsh": S(make=_mk(lambda rng: [_spd(rng)]),
-                  ref=np.linalg.eigvalsh, rtol=1e-3, atol=1e-3),
+    # well-separated spectrum: eigenvalue grads blow up numerically when
+    # eigenvalues nearly collide, so a random SPD draw is flaky
+    "eigvalsh": S(make=_mk(lambda rng: [
+        (np.diag([1.0, 3.0, 6.0, 10.0])
+         + 0.1 * _spd(rng) / 4).astype(np.float32)]),
+        ref=np.linalg.eigvalsh, rtol=1e-3, atol=1e-3),
     # householder_product(geqrf-packed A, tau) == Q (scipy orgqr reference)
     "householder_product": S(make=_mk(lambda rng: list(_geqrf(rng))),
                              ref=_np_q_from_geqrf, grad=False, jit=False,
@@ -959,11 +963,13 @@ FUNCTIONAL = {
         _i(np.array([3, 2], np.int64))]),
         ref=None, grad_args=[0], jit=False),
     # logits must be cosine similarities in (-1, 1): the margin path runs
-    # acos, whose gradient diverges outside the domain
+    # acos, whose gradient diverges outside the domain.  scale=4 (not the
+    # production 64): the default sharpens softmax enough that f32 central
+    # differences at eps=1e-2 disagree with the analytic grad
     "margin_cross_entropy": S(make=_mk(lambda rng: [
         rng.uniform(-0.8, 0.8, (4, 6)).astype(np.float32),
         _i(rng.integers(0, 6, (4,)).astype(np.int64))]),
-        ref=None, grad_args=[0], eps=1e-2),
+        kwargs={"scale": 4.0}, ref=None, grad_args=[0], eps=1e-2),
     "class_center_sample": S(make=_mk(lambda rng: [
         _i(rng.integers(0, 10, (8,)).astype(np.int64)), 10, 4]),
         ref=None, grad=False, jit=False),
