@@ -11,6 +11,7 @@ from .distributions import (Normal, Uniform, Bernoulli, Categorical, Beta,
                             LKJCholesky)
 from .transformed_distribution import TransformedDistribution, Independent
 from .kl import kl_divergence, register_kl
+from . import constraint, variable
 from .transform import (Transform, AbsTransform, AffineTransform,
                         ChainTransform, ExpTransform, IndependentTransform,
                         PowerTransform, ReshapeTransform, SigmoidTransform,
@@ -28,4 +29,5 @@ __all__ = [
     "ExpTransform", "IndependentTransform", "PowerTransform",
     "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
     "StackTransform", "StickBreakingTransform", "TanhTransform",
+    "constraint", "variable",
 ]

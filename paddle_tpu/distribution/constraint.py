@@ -1,0 +1,61 @@
+"""Constraint predicates for random variables (reference:
+python/paddle/distribution/constraint.py — the support-validation machinery
+`variable.Variable` wires into distributions).  jnp-vectorized: each check
+returns an elementwise/reduced boolean array instead of relying on python
+chained comparisons."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["Constraint", "Real", "Range", "Positive", "Simplex",
+           "real", "positive", "simplex", "_v"]
+
+
+def _v(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class Constraint:
+    """Constraint condition for a random variable."""
+
+    def __call__(self, value):
+        raise NotImplementedError
+
+    def check(self, value):
+        return self(value)
+
+
+class Real(Constraint):
+    def __call__(self, value):
+        v = _v(value)
+        return Tensor(v == v)
+
+
+class Range(Constraint):
+    def __init__(self, lower, upper):
+        self._lower = lower
+        self._upper = upper
+        super().__init__()
+
+    def __call__(self, value):
+        v = _v(value)
+        return Tensor((_v(self._lower) <= v) & (v <= _v(self._upper)))
+
+
+class Positive(Constraint):
+    def __call__(self, value):
+        return Tensor(_v(value) >= 0.0)
+
+
+class Simplex(Constraint):
+    def __call__(self, value):
+        v = _v(value)
+        return Tensor(jnp.all(v >= 0, axis=-1)
+                      & (jnp.abs(v.sum(-1) - 1) < 1e-6))
+
+
+real = Real()
+positive = Positive()
+simplex = Simplex()

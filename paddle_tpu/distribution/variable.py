@@ -1,0 +1,94 @@
+"""Random-variable metadata (reference:
+python/paddle/distribution/variable.py) — pairs a discreteness flag and
+event rank with a support Constraint, used by transforms/validation."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from . import constraint
+from .constraint import _v
+
+__all__ = ["Variable", "Real", "Positive", "Independent", "Stack",
+           "real", "positive"]
+
+
+class Variable:
+    """Random variable of a probability distribution."""
+
+    def __init__(self, is_discrete=False, event_rank=0, constraint=None):
+        self._is_discrete = is_discrete
+        self._event_rank = event_rank
+        self._constraint = constraint
+
+    @property
+    def is_discrete(self):
+        return self._is_discrete
+
+    @property
+    def event_rank(self):
+        return self._event_rank
+
+    def constraint(self, value):
+        """Check whether `value` meets this variable's support constraint."""
+        assert self._constraint is not None
+        return self._constraint.check(value)
+
+
+class Real(Variable):
+    def __init__(self, event_rank=0):
+        super().__init__(False, event_rank, constraint.real)
+
+
+class Positive(Variable):
+    def __init__(self, event_rank=0):
+        super().__init__(False, event_rank, constraint.positive)
+
+
+class Independent(Variable):
+    """Reinterprets the rightmost batch axes of a variable as event axes."""
+
+    def __init__(self, base: Variable, reinterpreted_batch_rank: int):
+        self._base = base
+        self._reinterpreted_batch_rank = reinterpreted_batch_rank
+        super().__init__(base.is_discrete,
+                         base.event_rank + reinterpreted_batch_rank)
+
+    def constraint(self, value):
+        v = _v(self._base.constraint(value))
+        for _ in range(self._reinterpreted_batch_rank):
+            v = jnp.all(v, axis=-1)
+        return Tensor(v)
+
+
+class Stack(Variable):
+    """A stack of variables along an axis (reference variable.py:100 Stack;
+    a negative axis landing inside the event dims bumps the event rank, per
+    the reference rule)."""
+
+    def __init__(self, vars, axis=0):
+        self._vars = list(vars)
+        self._axis = axis
+        rank = max(v.event_rank for v in self._vars)
+        if self._axis + rank < 0:
+            rank += 1
+        super().__init__(any(v.is_discrete for v in self._vars), rank)
+
+    def constraint(self, value):
+        v = _v(value)
+        if not (-v.ndim <= self._axis < v.ndim):
+            raise ValueError(
+                f"Input dimensions {v.ndim} should be greater than stack "
+                f"constraint axis {self._axis}.")
+        axis = self._axis % v.ndim
+        if v.shape[axis] != len(self._vars):
+            raise ValueError(
+                f"value has {v.shape[axis]} slices along axis {self._axis} "
+                f"but the Stack holds {len(self._vars)} variables")
+        parts = [_v(var.constraint(Tensor(jnp.take(v, i, axis=axis))))
+                 for i, var in enumerate(self._vars)]
+        return Tensor(jnp.stack(parts, axis=axis))
+
+
+real = Real()
+positive = Positive()

@@ -437,3 +437,29 @@ def test_binomial_binomial_kl():
     np.testing.assert_allclose(kl, exact, rtol=1e-5)
     with pytest.raises(NotImplementedError):
         D.kl_divergence(D.Binomial(5, 0.3), D.Binomial(7, 0.3))
+
+
+def test_constraint_and_variable_modules():
+    """reference distribution/{constraint,variable}.py parity: support
+    predicates + variable metadata (incl. Independent rank reinterpretation
+    and Stack)."""
+    from paddle_tpu.distribution import constraint, variable
+    import numpy as np
+    v = paddle.to_tensor(np.array([0.2, 0.3, 0.5], np.float32))
+    assert bool(np.asarray(constraint.simplex(v).numpy()))
+    assert not bool(np.asarray(constraint.simplex(
+        paddle.to_tensor(np.array([0.5, 0.9, -0.4], np.float32))).numpy()))
+    r = constraint.Range(0.0, 1.0)(v)
+    assert np.asarray(r.numpy()).all()
+    assert bool(np.asarray(constraint.positive(v).numpy()).all())
+
+    pos = variable.Positive()
+    assert not pos.is_discrete and pos.event_rank == 0
+    ind = variable.Independent(variable.Positive(), 1)
+    assert ind.event_rank == 1
+    m = paddle.to_tensor(np.array([[1.0, 2.0], [3.0, -1.0]], np.float32))
+    got = np.asarray(ind.constraint(m).numpy())
+    np.testing.assert_array_equal(got, [True, False])
+    st = variable.Stack([variable.Real(), variable.Positive()], axis=0)
+    got = np.asarray(st.constraint(m).numpy())
+    np.testing.assert_array_equal(got, [[True, True], [True, False]])
