@@ -3,35 +3,100 @@
 TPU-native collapse: the static graph IS the jaxpr/StableHLO that jax.jit
 traces (SURVEY.md L4b→XLA). This namespace keeps the user-facing pieces that
 still matter: InputSpec, structured control flow (lax-backed cond/while_loop —
-the controlflow-ops analog), and save/load_inference_model delegating to
-jit.save/load.
+the controlflow-ops analog), save/load_inference_model delegating to
+jit.save/load, and — round 5 — a WORKING Program/program_guard/data/Executor
+build-then-run workflow: `program_guard` records the dispatch-level op tape
+as ops execute on `data` placeholders, and `Executor.run` replays it with
+the fed values (reference static Program.build → Executor.run, collapsed
+onto the same op-record machinery the SOT tape uses).
 """
 from __future__ import annotations
 
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core import dispatch as _dispatch
 from .input_spec import InputSpec
 from . import nn
 
 __all__ = ["InputSpec", "nn", "save_inference_model", "load_inference_model",
            "Program", "program_guard", "default_main_program",
-           "default_startup_program", "gradients"]
+           "default_startup_program", "gradients", "data", "Executor"]
 
 
 class Program:
-    """Shim: programs are traced jaxprs; kept for scripts that construct
-    Program() handles."""
+    """A recorded static graph: the eager op tape captured under
+    `program_guard`, replayable by `Executor.run` with fed inputs
+    (reference framework.Program; the graph IR itself is the jaxpr XLA
+    sees — this object holds the build-time op sequence + placeholders)."""
 
     def __init__(self):
         self.random_seed = 0
+        self._ops = []           # (name, vals, outs, impl, static_kwargs)
+        self._feed_ids = {}      # feed name -> id(placeholder value)
 
     def global_block(self):
         return self
 
     def clone(self, for_test=False):
-        return Program()
+        p = Program()
+        p._ops = list(self._ops)
+        p._feed_ids = dict(self._feed_ids)
+        return p
+
+    # -- replay ------------------------------------------------------------
+    def _run(self, feed, fetch_vals):
+        env = {}
+        for name, pid in self._feed_ids.items():
+            if feed and name in feed:
+                fv = feed[name]
+                env[pid] = fv._value if isinstance(fv, Tensor) \
+                    else jnp.asarray(fv)
+        for op_name, vals, outs, impl, kw in self._ops:
+            new_vals = [env.get(id(v), v) if not isinstance(v, (int, float,
+                        str, bool, type(None))) else v for v in vals]
+            res = impl(*new_vals, **kw)
+            res_t = res if isinstance(res, tuple) else (res,)
+            for old, new in zip(outs, res_t):
+                env[id(old)] = new
+        return [env.get(id(v), v) for v in fetch_vals]
 
 
 _main = Program()
 _startup = Program()
+_active = [None]
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """Placeholder variable (reference static.data): a concrete zeros
+    Tensor (None/-1 dims -> 1) whose identity the active Program maps to
+    the feed name; Executor.run substitutes the fed array."""
+    concrete = tuple(1 if d in (None, -1) else int(d) for d in shape)
+    t = Tensor(jnp.zeros(concrete, dtype))
+    t.name = name
+    prog = _active[0] if _active[0] is not None else _main
+    prog._feed_ids[name] = id(t._value)
+    return t
+
+
+class Executor:
+    """reference static.Executor: run(program, feed, fetch_list) replays
+    the recorded op tape with the fed values."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None, **kw):
+        prog = program if isinstance(program, Program) else _main
+        if not prog._ops:        # startup program / empty graph: no-op
+            return []
+        fetch_list = fetch_list or []
+        fetch_vals = [f._value if isinstance(f, Tensor) else f
+                      for f in fetch_list]
+        outs = prog._run(feed, fetch_vals)
+        return [np.asarray(o) for o in outs]
 
 
 def default_main_program():
@@ -43,13 +108,23 @@ def default_startup_program():
 
 
 class program_guard:
+    """Record every dispatched op inside the block into `main_program`
+    (reference program_guard; ops still EXECUTE eagerly on the placeholder
+    values, which is what lets plain python build code run unchanged)."""
+
     def __init__(self, main_program=None, startup_program=None):
-        pass
+        self._prog = main_program if main_program is not None else _main
 
     def __enter__(self):
+        self._prev_active = _active[0]
+        self._prev_rec = _dispatch._op_recorder[0]
+        _active[0] = self._prog
+        _dispatch._op_recorder[0] = self._prog._ops
         return self
 
     def __exit__(self, *exc):
+        _dispatch._op_recorder[0] = self._prev_rec
+        _active[0] = self._prev_active
         return False
 
 
