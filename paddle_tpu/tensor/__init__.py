@@ -197,3 +197,68 @@ Tensor.sort = search.sort
 Tensor.unbind = manipulation.unbind
 Tensor.T = property(lambda self: op_call("T", lambda v: v.T, self))
 Tensor.mT = property(lambda self: op_call("mT", lambda v: jnp.swapaxes(v, -1, -2), self))
+
+
+# ---------------------------------------------------------------------------
+# Mechanical in-place variants (reference tensor/__init__.py's *_ surface):
+# every listed op gains `<name>_` = "compute out-of-place, write back into
+# the tensor's storage" — the reference's in-place kernels collapse onto
+# _set_value since jax arrays are immutable.
+# ---------------------------------------------------------------------------
+import sys as _sys
+
+_INPLACE_BASES = [
+    # unary math
+    "abs", "acos", "acosh", "asin", "asinh", "atan", "atanh", "ceil", "cos",
+    "cosh", "digamma", "erfinv", "exp", "expm1", "floor", "frac", "i0",
+    "lgamma", "gammaln", "log", "log10", "log1p", "log2", "logit", "neg",
+    "reciprocal", "round", "rsqrt", "sin", "sinh", "sqrt", "square", "tan",
+    "tanh", "trunc", "nan_to_num", "sgn",
+    # binary / misc
+    "pow", "divide", "floor_divide", "mod", "remainder", "copysign",
+    "hypot", "lerp", "ldexp", "gcd", "lcm", "gammainc", "gammaincc",
+    "polygamma", "renorm", "index_add", "index_fill", "index_put",
+    "masked_fill", "masked_scatter", "put_along_axis", "clip",
+    "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
+    "bitwise_left_shift", "bitwise_right_shift",
+    "logical_and", "logical_or", "logical_xor", "logical_not",
+    "equal", "not_equal", "greater_equal", "greater_than", "less_equal",
+    "less_than",
+]
+
+_this = _sys.modules[__name__]
+
+
+def _make_inplace(base_name, base_fn):
+    def _inplace(x, *args, **kwargs):
+        out = base_fn(x.detach(), *args, **kwargs)
+        if out._value.shape != x._value.shape:
+            raise ValueError(
+                f"{base_name}_: in-place result shape "
+                f"{out._value.shape} != tensor shape {x._value.shape} — "
+                "in-place ops must preserve shape (use the out-of-place "
+                f"{base_name} instead)")
+        return x._set_value(out._value)
+    _inplace.__name__ = base_name + "_"
+    _inplace.__qualname__ = base_name + "_"
+    _inplace.__doc__ = (f"In-place variant of `{base_name}` (reference "
+                        f"tensor API {base_name}_): writes the result back "
+                        "into this tensor's storage.")
+    return _inplace
+
+
+for _base in _INPLACE_BASES:
+    _iname = _base + "_"
+    if hasattr(_this, _iname):
+        continue
+    _fn = getattr(_this, _base, None)
+    if _fn is None:
+        continue
+    _ip = _make_inplace(_base, _fn)
+    setattr(_this, _iname, _ip)
+    if not hasattr(Tensor, _iname):
+        setattr(Tensor, _iname, _ip)
+
+# aliases the reference exposes at tensor level
+bitwise_invert = bitwise_not          # reference math.py bitwise_invert
+bitwise_invert_ = bitwise_not_

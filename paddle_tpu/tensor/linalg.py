@@ -355,3 +355,78 @@ def pca_lowrank(x, q=None, center=True, niter=2, name=None):
         k = q if q is not None else min(6, v.shape[-1])
         return u[..., :k], s[..., :k], jnp.swapaxes(vt, -1, -2)[..., :k]
     return op_call("pca_lowrank", impl, x)
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    """reference linalg.py trace."""
+    return op_call("trace", lambda v: jnp.trace(v, offset=offset, axis1=axis1,
+                                                axis2=axis2), x)
+
+
+def inverse(x, name=None):
+    """alias of inv (reference linalg.py inverse)."""
+    return inv(x)
+
+
+def cholesky_inverse(x, upper=False, name=None):
+    """Inverse of A from its Cholesky factor (reference cholesky_inverse)."""
+    def impl(L):
+        eye = jnp.eye(L.shape[-1], dtype=L.dtype)
+        return jax.scipy.linalg.cho_solve((L, not upper), eye)
+    return op_call("cholesky_inverse", impl, x)
+
+
+def matrix_transpose(x, name=None):
+    """Swap the last two dims (reference linalg.py matrix_transpose)."""
+    return op_call("matrix_transpose",
+                   lambda v: jnp.swapaxes(v, -1, -2), x)
+
+
+def cond(x, p=None, name=None):
+    """Matrix condition number (reference linalg.py cond)."""
+    def impl(v):
+        pp = 2 if p is None else p
+        if pp in (2, -2):
+            s = jnp.linalg.svd(v, compute_uv=False)
+            return s[..., 0] / s[..., -1] if pp == 2 else s[..., -1] / s[..., 0]
+        return jnp.linalg.norm(v, ord=pp, axis=(-2, -1)) * \
+            jnp.linalg.norm(jnp.linalg.inv(v), ord=pp, axis=(-2, -1))
+    return op_call("cond", impl, x, nondiff=True)
+
+
+def block_diag(inputs, name=None):
+    """Block-diagonal matrix from a list of 2-D tensors (reference
+    block_diag)."""
+    ts = [t if isinstance(t, Tensor) else Tensor(jnp.asarray(t))
+          for t in inputs]
+
+    def impl(*vals):
+        return jax.scipy.linalg.block_diag(*[jnp.atleast_2d(v)
+                                             for v in vals])
+    return op_call("block_diag", impl, *ts)
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    """Randomized low-rank SVD (reference linalg.py svd_lowrank): subspace
+    iteration on a fixed-seed Gaussian sketch — MXU-friendly (QR + matmuls),
+    rank-q factors for an [m, n] input."""
+    def impl(v, *rest):
+        if rest:
+            v = v - rest[0]          # centered/PCA variant (reference M)
+        m, n = v.shape[-2], v.shape[-1]
+        k = min(q, m, n)
+        key = jax.random.PRNGKey(0)
+        omega = jax.random.normal(key, v.shape[:-2] + (n, k), v.dtype)
+        y = v @ omega
+        for _ in range(niter):
+            y = v @ (jnp.swapaxes(v, -1, -2) @ y)
+        Q, _ = jnp.linalg.qr(y)
+        B = jnp.swapaxes(Q, -1, -2) @ v
+        u_b, s, vt = jnp.linalg.svd(B, full_matrices=False)
+        return Q @ u_b, s, jnp.swapaxes(vt, -1, -2)
+    args = (x,) if M is None else (x, M)
+    return op_call("svd_lowrank", impl, *args, nondiff=True)
+
+
+__all__ += ["trace", "inverse", "cholesky_inverse", "matrix_transpose",
+            "cond", "block_diag", "svd_lowrank"]

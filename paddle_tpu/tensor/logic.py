@@ -83,3 +83,36 @@ def is_integer(x):
 
 def is_complex(x):
     return jnp.issubdtype(x._value.dtype, jnp.complexfloating)
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    """reference logic.py all (boolean reduction)."""
+    return op_call("all", lambda v: jnp.all(v, axis=axis, keepdims=keepdim),
+                   x, nondiff=True)
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    """reference logic.py any."""
+    return op_call("any", lambda v: jnp.any(v, axis=axis, keepdims=keepdim),
+                   x, nondiff=True)
+
+
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    """reference logic.py isin (membership against test_x's elements)."""
+    def impl(v, t):
+        out = jnp.isin(v, t, assume_unique=assume_unique)
+        return ~out if invert else out
+    return op_call("isin", impl, x, test_x, nondiff=True)
+
+
+def signbit(x, name=None):
+    """reference math.py signbit (true where the sign bit is set)."""
+    return op_call("signbit", jnp.signbit, x, nondiff=True)
+
+
+def less(x, y, name=None):
+    """alias of less_than (reference logic.py less)."""
+    return less_than(x, y)
+
+
+__all__ += ["all", "any", "isin", "signbit", "less"]

@@ -609,3 +609,92 @@ def as_complex(x, name=None):
 
 def as_real(x, name=None):
     return op_call("as_real", lambda v: jnp.stack([jnp.real(v), jnp.imag(v)], axis=-1), x)
+
+
+def unflatten(x, axis, shape, name=None):
+    """Split one axis into the given shape (reference unflatten)."""
+    def impl(v):
+        ax = axis % v.ndim
+        tgt = list(shape)
+        if -1 in tgt:
+            known = int(np.prod([s for s in tgt if s != -1]))
+            tgt[tgt.index(-1)] = v.shape[ax] // known
+        return v.reshape(v.shape[:ax] + tuple(tgt) + v.shape[ax + 1:])
+    return op_call("unflatten", impl, x)
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    """Write y onto the selected diagonal of x (reference
+    diagonal_scatter)."""
+    def impl(v, w):
+        a1, a2 = axis1 % v.ndim, axis2 % v.ndim
+        n1, n2 = v.shape[a1], v.shape[a2]
+        if offset >= 0:
+            i = jnp.arange(min(n1, n2 - offset))
+            j = i + offset
+        else:
+            j = jnp.arange(min(n2, n1 + offset))
+            i = j - offset
+        # move the two diagonal axes to the front for a clean scatter;
+        # axes are normalized first — argsort of a perm with negatives is
+        # NOT its inverse
+        perm = [a1, a2] + [a for a in range(v.ndim) if a not in (a1, a2)]
+        inv = np.argsort(perm)
+        vt = jnp.transpose(v, perm)
+        wt = jnp.moveaxis(w, -1, 0) if w.ndim == v.ndim - 1 else w
+        vt = vt.at[i, j].set(wt)
+        return jnp.transpose(vt, inv)
+    return op_call("diagonal_scatter", impl, x, y)
+
+
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    """Write `value` into the strided slice of x (reference
+    slice_scatter)."""
+    def impl(v, w):
+        idx = [builtins.slice(None)] * v.ndim
+        for a, s, e, st in zip(axes, starts, ends, strides):
+            idx[a] = builtins.slice(s, e, st)
+        return v.at[tuple(idx)].set(w)
+    return op_call("slice_scatter", impl, x, value)
+
+
+def reverse(x, axis, name=None):
+    """alias of flip (reference manipulation reverse)."""
+    return flip(x, axis)
+
+
+def shape(x, name=None):
+    """Runtime shape as an int32 tensor (reference shape op)."""
+    return Tensor(jnp.asarray(x.shape, jnp.int32))
+
+
+def multiplex(inputs, index, name=None):
+    """Row-wise selection across candidate tensors (reference multiplex:
+    out[i] = inputs[index[i]][i])."""
+    ts = [t if isinstance(t, Tensor) else Tensor(jnp.asarray(t))
+          for t in inputs]
+    idx = index if isinstance(index, Tensor) else Tensor(jnp.asarray(index))
+
+    def impl(iv, *vals):
+        stacked = jnp.stack(vals)               # [k, B, ...]
+        rows = jnp.arange(stacked.shape[1])
+        return stacked[iv.reshape(-1).astype(jnp.int32), rows]
+    return op_call("multiplex", impl, idx, *ts)
+
+
+def reduce_as(x, target, name=None):
+    """Sum-reduce x down to target's shape (reference reduce_as — the
+    broadcast transpose)."""
+    def impl(v, t):
+        extra = v.ndim - t.ndim
+        out = v.sum(axis=tuple(range(extra))) if extra else v
+        axes = tuple(i for i, (a, b) in enumerate(zip(out.shape, t.shape))
+                     if a != b and b == 1)
+        if axes:
+            out = out.sum(axis=axes, keepdims=True)
+        return out
+    return op_call("reduce_as", impl, x, target)
+
+
+__all__ += ["unflatten", "diagonal_scatter", "slice_scatter", "reverse",
+            "shape", "multiplex", "reduce_as"]

@@ -416,3 +416,125 @@ def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
     return op_call("bucketize",
                    lambda v, s: jnp.searchsorted(s, v, side=side).astype(d),
                    x, sorted_sequence, nondiff=True)
+
+
+def add_n(inputs, name=None):
+    """Sum of a list of same-shape tensors (reference math.py add_n)."""
+    if isinstance(inputs, Tensor):
+        return op_call("add_n", lambda v: v, inputs)
+    ts = [t if isinstance(t, Tensor) else Tensor(jnp.asarray(t))
+          for t in inputs]
+
+    def impl(*vals):
+        out = vals[0]
+        for v in vals[1:]:
+            out = out + v
+        return out
+    return op_call("add_n", impl, *ts)
+
+
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    """reference math.py logcumsumexp — numerically stable cumulative
+    logsumexp via the running-max recurrence (an associative scan on the
+    (max, sumexp) pair, so XLA parallelizes it)."""
+    def impl(v):
+        vv = v.reshape(-1) if axis is None else v
+        ax = 0 if axis is None else axis
+
+        def combine(a, b):
+            am, asum = a
+            bm, bsum = b
+            m = jnp.maximum(am, bm)
+            # exp(-inf - -inf) is nan: when a side's max equals the joint
+            # max (incl. the all--inf case) its scale is exactly 1
+            ea = jnp.where(am == m, 1.0, jnp.exp(am - m))
+            eb = jnp.where(bm == m, 1.0, jnp.exp(bm - m))
+            return m, asum * ea + bsum * eb
+        m, s = jax.lax.associative_scan(
+            combine, (vv, jnp.ones_like(vv)), axis=ax)
+        out = m + jnp.log(s)
+        return out.astype(dtype) if dtype is not None else out
+    return op_call("logcumsumexp", impl, x)
+
+
+def sinc(x, name=None):
+    """reference math.py sinc (normalized: sin(pi x)/(pi x), 1 at 0)."""
+    return op_call("sinc", jnp.sinc, x)
+
+
+def frexp(x, name=None):
+    """reference math.py frexp -> (mantissa, exponent)."""
+    def impl(v):
+        m, e = jnp.frexp(v)
+        return m, e.astype(jnp.int32)
+    return op_call("frexp", impl, x, nondiff=True)
+
+
+def gammaln(x, name=None):
+    """alias of lgamma (reference math.py gammaln)."""
+    return lgamma(x)
+
+
+def gammainc(x, y, name=None):
+    """Regularized lower incomplete gamma P(x, y) (reference gammainc)."""
+    from jax.scipy import special as jsp
+    return op_call("gammainc", jsp.gammainc, x, y)
+
+
+def gammaincc(x, y, name=None):
+    """Regularized upper incomplete gamma Q(x, y)."""
+    from jax.scipy import special as jsp
+    return op_call("gammaincc", jsp.gammaincc, x, y)
+
+
+def polygamma(x, n, name=None):
+    """reference math.py polygamma(x, n) — n-th derivative of digamma."""
+    from jax.scipy import special as jsp
+    return op_call("polygamma", lambda v: jsp.polygamma(n, v), x)
+
+
+def floor_mod(x, y, name=None):
+    """alias of mod (reference math.py floor_mod)."""
+    return mod(x, y)
+
+
+def sgn(x, name=None):
+    """reference math.py sgn: sign for real, unit phasor for complex."""
+    def impl(v):
+        if jnp.issubdtype(v.dtype, jnp.complexfloating):
+            mag = jnp.abs(v)
+            return jnp.where(mag == 0, 0, v / jnp.where(mag == 0, 1, mag))
+        return jnp.sign(v)
+    return op_call("sgn", impl, x, nondiff=True)
+
+
+def negative(x, name=None):
+    """alias of neg."""
+    return neg(x)
+
+
+def positive(x, name=None):
+    """reference math.py positive (identity on numeric tensors)."""
+    return op_call("positive", lambda v: +v, x)
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    """reference math.py cumulative_trapezoid."""
+    def impl(yv, *rest):
+        import jax.numpy as jnp
+        d = dx if dx is not None else 1.0
+        y1 = jnp.take(yv, jnp.arange(1, yv.shape[axis]), axis=axis)
+        y0 = jnp.take(yv, jnp.arange(0, yv.shape[axis] - 1), axis=axis)
+        if rest:
+            xv = rest[0]
+            x1 = jnp.take(xv, jnp.arange(1, xv.shape[axis]), axis=axis)
+            x0 = jnp.take(xv, jnp.arange(0, xv.shape[axis] - 1), axis=axis)
+            d = x1 - x0
+        return jnp.cumsum((y1 + y0) * d / 2.0, axis=axis)
+    args = (y,) if x is None else (y, x)
+    return op_call("cumulative_trapezoid", impl, *args)
+
+
+__all__ += ["add_n", "logcumsumexp", "sinc", "frexp", "gammaln", "gammainc",
+            "gammaincc", "polygamma", "floor_mod", "sgn", "negative",
+            "positive", "cumulative_trapezoid"]

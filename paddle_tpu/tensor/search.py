@@ -204,3 +204,36 @@ def histogram_bin_edges(x, bins=100, min=0, max=0, name=None):
             lo, hi = lo - 0.5, hi + 0.5   # numpy's zero-width expansion
         return jnp.linspace(lo, hi, int(bins) + 1, dtype=jnp.float32)
     return op_call("histogram_bin_edges", impl, x, nondiff=True)
+
+
+def top_p_sampling(x, ps, threshold=None, seed=None, name=None):
+    """Nucleus sampling over the last axis (reference top_p_sampling):
+    returns (sampled values, sampled ids), one draw per row."""
+    from ..core.random import split_key
+
+    key = split_key() if seed is None else jax.random.PRNGKey(int(seed))
+
+    def impl(v, p, *rest):
+        sorted_logits = jnp.sort(v, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep_n = jnp.sum(cum < p[..., None], axis=-1)
+        cutoff = jnp.take_along_axis(sorted_logits, keep_n[..., None], -1)
+        masked = jnp.where(v < cutoff, -jnp.inf, v)
+        if rest:
+            # reference threshold: a per-row probability floor that further
+            # restricts the nucleus (keep at least the argmax)
+            full_probs = jax.nn.softmax(v, axis=-1)
+            floor = rest[0].reshape(v.shape[:-1] + (1,))
+            below = full_probs < floor
+            best = jnp.argmax(v, axis=-1, keepdims=True)
+            below = below & ~(jnp.arange(v.shape[-1]) == best)
+            masked = jnp.where(below, -jnp.inf, masked)
+        ids = jax.random.categorical(key, masked, axis=-1)
+        vals = jnp.take_along_axis(v, ids[..., None], -1)[..., 0]
+        return vals, ids.astype(jnp.int64)
+    args = (x, ps) if threshold is None else (x, ps, threshold)
+    return op_call("top_p_sampling", impl, *args, nondiff=True)
+
+
+__all__ += ["top_p_sampling"]

@@ -1096,3 +1096,119 @@ FUNCTIONAL = {
     "tanh_": S(ref=np.tanh, grad=False, jit=False),
     "softmax_": S(ref=_np_softmax, grad=False, jit=False),
 }
+
+
+# ---------------------------------------------------------------------------
+# Round-5 breadth additions
+# ---------------------------------------------------------------------------
+TENSOR.update({
+    "all": S(make=_mk(lambda rng: [
+        _i((rng.random((3, 4)) < 0.8))]),
+        ref=lambda x, axis=None: np.all(x, axis=axis), kwargs={"axis": 1},
+        grad=False),
+    "any": S(make=_mk(lambda rng: [
+        _i((rng.random((3, 4)) < 0.2))]),
+        ref=lambda x, axis=None: np.any(x, axis=axis), kwargs={"axis": 1},
+        grad=False),
+    "isin": S(make=_mk(lambda rng: [
+        _i(rng.integers(0, 8, (3, 4)).astype(np.int64)),
+        _i(np.array([1, 3, 5], np.int64))]),
+        ref=lambda x, t: np.isin(x, t), grad=False),
+    "signbit": S(np.signbit, grad=False),
+    "less": _a((3, 4), (3, 4), ref=np.less, grad=False),
+    "add_n": S(make=_mk(lambda rng: [[
+        rng.normal(0, 1, (3, 4)).astype(np.float32),
+        rng.normal(0, 1, (3, 4)).astype(np.float32)]]),
+        ref=lambda xs: xs[0] + xs[1], grad=False, jit=False),
+    "logcumsumexp": S(lambda x, axis=None: np.log(np.cumsum(
+        np.exp(x), axis=axis)), kwargs={"axis": 1}, rtol=1e-3),
+    "sinc": S(np.sinc, rtol=1e-3, atol=1e-4),
+    "frexp": S(lambda x: list(np.frexp(x)), grad=False, low=0.3),
+    "gammaln": S(sp.gammaln, low=0.5, high=4.0, rtol=1e-3),
+    "gammainc": _a((3, 4), (3, 4), ref=sp.gammainc, low=0.5, high=4.0,
+                   rtol=1e-3, grad=False),
+    "gammaincc": _a((3, 4), (3, 4), ref=sp.gammaincc, low=0.5, high=4.0,
+                    rtol=1e-3, grad=False),
+    "polygamma": S(lambda x, n: sp.polygamma(n, x), kwargs={"n": 1},
+                   low=0.5, high=4.0, rtol=1e-3),
+    "floor_mod": _a((3, 4), (3, 4), ref=np.mod, low=0.5, high=3.0,
+                    grad=False),
+    "sgn": S(np.sign, grad=False),
+    "negative": S(np.negative),
+    "positive": S(lambda x: +x),
+    "cumulative_trapezoid": S(lambda y, dx=1.0: np.array(
+        __import__("scipy.integrate", fromlist=["x"]).cumulative_trapezoid(
+            y, dx=dx, axis=-1)), kwargs={"dx": 0.5}, rtol=1e-4),
+    "trace": S(lambda x: np.trace(x), arrays=((4, 4),)),
+    "inverse": S(make=_mk(lambda rng: [_spd(rng)]), ref=np.linalg.inv,
+                 rtol=1e-3, atol=1e-3),
+    "cholesky_inverse": S(make=_mk(lambda rng: [
+        np.linalg.cholesky(_spd(rng)).astype(np.float32)]),
+        ref=lambda L: np.linalg.inv(L @ L.T), rtol=2e-3, atol=2e-3),
+    "matrix_transpose": S(lambda x: np.swapaxes(x, -1, -2),
+                          arrays=((2, 3, 4),)),
+    "cond": S(make=_mk(lambda rng: [_spd(rng)]),
+              ref=lambda x: np.linalg.cond(x), rtol=1e-3, atol=1e-3,
+              grad=False),
+    "block_diag": S(make=_mk(lambda rng: [[
+        rng.normal(0, 1, (2, 3)).astype(np.float32),
+        rng.normal(0, 1, (3, 2)).astype(np.float32)]]),
+        ref=lambda xs: __import__("scipy.linalg", fromlist=["block_diag"])
+        .block_diag(*xs), grad=False, jit=False),
+    "svd_lowrank": S(make=_mk(lambda rng: [
+        rng.normal(0, 1, (8, 5)).astype(np.float32)]),
+        kwargs={"q": 5}, grad=False, jit=False),
+    "unflatten": S(lambda x, axis, shape: x.reshape(3, 2, 2),
+                   kwargs={"axis": 1, "shape": [2, 2]}),
+    "diagonal_scatter": S(make=_mk(lambda rng: [
+        rng.normal(0, 1, (4, 4)).astype(np.float32),
+        rng.normal(0, 1, (4,)).astype(np.float32)]),
+        ref=lambda x, y: (lambda c: (np.fill_diagonal(c, y), c)[1])(
+            x.copy()), grad=False),
+    "slice_scatter": S(make=_mk(lambda rng: [
+        rng.normal(0, 1, (4, 4)).astype(np.float32),
+        rng.normal(0, 1, (2, 4)).astype(np.float32)]),
+        kwargs={"axes": [0], "starts": [1], "ends": [3], "strides": [1]},
+        ref=lambda x, v, axes, starts, ends, strides:
+        (lambda c: (c.__setitem__(slice(1, 3), v), c)[1])(x.copy()),
+        grad=False),
+    "reverse": S(lambda x, axis: np.flip(x, axis), kwargs={"axis": 0}),
+    "shape": S(lambda x: np.asarray(x.shape, np.int32), grad=False,
+               jit=False),
+    "multiplex": S(make=_mk(lambda rng: [[
+        rng.normal(0, 1, (3, 4)).astype(np.float32),
+        rng.normal(0, 1, (3, 4)).astype(np.float32)],
+        _i(np.array([[0], [1], [0]], np.int64))]),
+        ref=lambda xs, idx: np.stack(xs)[idx[:, 0], np.arange(3)],
+        grad=False, jit=False),
+    "reduce_as": _a((2, 3, 4), (3, 4),
+                    ref=lambda x, t: x.sum(0), grad_args=[0]),
+    "top_p_sampling": S(make=_mk(lambda rng: [
+        rng.normal(0, 1, (2, 8)).astype(np.float32),
+        np.full((2,), 0.8, np.float32)]), grad=False, jit=False),
+    "bitwise_invert": S(make=_mk(lambda rng: [
+        _i(rng.integers(0, 8, (3, 4)).astype(np.int32))]),
+        ref=np.bitwise_not, grad=False),
+})
+
+# the mechanically generated in-place variants: derive each spec from its
+# base op's S (eager-only check against the same reference — jit/grad are
+# the base op's job); bases mapped to C()/make-specs get a minimal
+# write-back sanity spec instead
+from paddle_tpu.tensor import _INPLACE_BASES as _IP_BASES  # noqa: E402
+
+
+def _inplace_spec(base_name):
+    base = TENSOR.get(base_name)
+    if isinstance(base, S):
+        return dataclasses.replace(base, grad=False, jit=False)
+    return S(ref=None, grad=False, jit=False)
+
+
+import dataclasses  # noqa: E402
+import paddle_tpu.tensor as _T  # noqa: E402
+
+for _b in list(_IP_BASES) + ["bitwise_invert"]:
+    _n = _b + "_"
+    if hasattr(_T, _n) and _n not in TENSOR:
+        TENSOR[_n] = _inplace_spec(_b)
