@@ -469,6 +469,141 @@ def bench_llama_decode():
     return out
 
 
+def bench_serving():
+    """Paged-KV continuous-batching serving throughput on a mixed-length
+    Poisson-ish request trace, vs the static-batch `llama_generate_fused`
+    baseline (PERF.md §8).
+
+    The engine (inference/paged.py ServingEngine) holds a fixed slot set,
+    admits arrivals into freed slots between jitted decode horizons, and
+    stores KV in pooled pages — so a short request neither pays for the
+    longest sequence in its batch nor blocks the batch on its own exit.
+    The static baseline batches the same requests in arrival order and pads
+    every prompt/generation to its batch max (what the fixed-batch fused
+    path must do).  Throughput counts USEFUL tokens only (each request's
+    own generation budget), so padding waste shows up honestly."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.models.llama import (LlamaConfig, build_functional_llama,
+                                         llama_generate_fused)
+    from paddle_tpu.inference.paged import ServingEngine
+
+    on_tpu = any(d.platform == "tpu" for d in jax.devices())
+    if on_tpu:
+        # GQA serving config of the 271M family (4 kv heads — the realistic
+        # serving shape, and the ragged kernel's native GQA grid)
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
+                          intermediate_size=2816, num_hidden_layers=16,
+                          num_attention_heads=16, num_key_value_heads=4,
+                          max_position_embeddings=2048)
+        dtype = jnp.bfloat16
+        n_req, slots, page_size, horizon = 16, 8, 64, 32
+        len_lo, len_hi, new_lo, new_hi = 32, 192, 16, 96
+        t_bucket, new_bucket = 128, 32
+    else:   # CPU: small GQA config, but big enough that compute (not
+        # dispatch) decides the comparison — same code path as TPU
+        cfg = LlamaConfig(vocab_size=2048, hidden_size=256,
+                          intermediate_size=768, num_hidden_layers=3,
+                          num_attention_heads=8, num_key_value_heads=2,
+                          max_position_embeddings=512)
+        dtype = jnp.float32
+        n_req, slots, page_size, horizon = 12, 4, 16, 12
+        len_lo, len_hi, new_lo, new_hi = 16, 128, 4, 96
+        t_bucket, new_bucket = 64, 16
+
+    ep, bp, hp, *_ = build_functional_llama(cfg, dtype=dtype, n_micro=1)
+    params = (ep, bp, hp)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, cfg.vocab_size, (int(t),)).astype(np.int32)
+               for t in rng.integers(len_lo, len_hi, n_req)]
+    max_news = [int(m) for m in rng.integers(new_lo, new_hi, n_req)]
+    arrivals = np.concatenate([[0.0], np.cumsum(
+        rng.exponential(sum(max_news) / (2.0 * n_req), n_req - 1))])
+
+    # per-seq page-table width + pool sized for the trace's worst case (+
+    # headroom): the table width bounds the attention grid, so keeping it
+    # tight matters as much as the pool size
+    worst = (max(t_bucket * ((len(p) + t_bucket - 1) // t_bucket)
+                 for p in prompts) + max(max_news) + horizon) \
+        // page_size + 2
+    eng = ServingEngine(params, cfg, num_slots=slots, page_size=page_size,
+                        num_pages=(slots + 2) * worst,
+                        max_pages_per_seq=worst, dtype=dtype,
+                        decode_horizon=horizon, prompt_bucket=t_bucket)
+
+    def drive(base_tok):
+        """Submit request i once `arrivals[i]` generated tokens have passed
+        (Poisson inter-arrivals in token time); run to completion."""
+        i = 0
+        while i < n_req or eng.num_active or eng._queue:
+            while (i < n_req
+                   and eng.tokens_generated - base_tok >= arrivals[i]):
+                eng.submit(prompts[i], max_new_tokens=max_news[i])
+                i += 1
+            if eng.num_active == 0 and not eng._queue:
+                if i >= n_req:
+                    break
+                eng.submit(prompts[i], max_new_tokens=max_news[i])  # idle jump
+                i += 1
+            eng.step()
+
+    # warm the engine's executables — one dummy request per prompt-length
+    # bucket in the trace (warms every prefill executable) plus the decode
+    # horizon; the measured drive reuses the SAME engine so nothing
+    # compiles inside the timed window
+    for Tb in sorted({((len(p) + t_bucket - 1) // t_bucket) * t_bucket
+                      for p in prompts}):
+        eng.submit(rng.integers(0, cfg.vocab_size, (Tb,)).astype(np.int32),
+                   max_new_tokens=horizon + 1)
+    eng.run()
+    t0 = time.perf_counter()
+    drive(base_tok=eng.tokens_generated)
+    _sync(eng._pages_k[0, 0, 0, 0, 0])
+    dt_engine = time.perf_counter() - t0
+    lat = [r.finish_time - r.submit_time
+           for r in list(eng._finished.values())[-n_req:]]
+    useful = sum(max_news)
+    serving_tps = useful / dt_engine
+
+    # static-batch fused baseline: batches of `slots` in arrival order, each
+    # padded to its batch max (prompt AND generation); bucketed shapes so
+    # the executable count stays small.  Run twice, time the second — the
+    # first full pass absorbs every compile
+    def run_baseline():
+        t0 = time.perf_counter()
+        done_at = []
+        for b0 in range(0, n_req, slots):
+            bp_ = prompts[b0:b0 + slots]
+            bn = max_news[b0:b0 + slots]
+            Tmax = ((max(len(p) for p in bp_) + t_bucket - 1)
+                    // t_bucket) * t_bucket
+            Nmax = ((max(bn) + new_bucket - 1) // new_bucket) * new_bucket
+            ids = np.zeros((len(bp_), Tmax), np.int32)
+            for j, p in enumerate(bp_):
+                ids[j, :len(p)] = p
+            out = llama_generate_fused(params, cfg, ids, max_new_tokens=Nmax,
+                                       dtype=dtype)
+            _sync(out[0, -1])
+            done_at.extend([time.perf_counter() - t0] * len(bp_))
+        return time.perf_counter() - t0, done_at
+
+    run_baseline()                         # compile warm-up
+    dt_base, base_done = run_baseline()
+    base_tps = useful / dt_base
+    return {
+        "serving_tokens_per_sec": round(serving_tps, 1),
+        "static_fused_tokens_per_sec": round(base_tps, 1),
+        "speedup_vs_static": round(serving_tps / base_tps, 3),
+        "n_requests": n_req,
+        "useful_tokens": int(useful),
+        "mean_request_latency_s": round(float(np.mean(lat)), 3),
+        "static_mean_completion_s": round(float(np.mean(base_done)), 3),
+        "decode_horizon": horizon,
+        "page_size": page_size,
+        "num_slots": slots,
+    }
+
+
 def main():
     import jax
     _setup_compile_cache()
@@ -482,8 +617,9 @@ def main():
                   bench_llama_long_context, 250),
                  ("ernie_base_mlm", bench_ernie_mlm, 250),
                  ("sd15_unet_images_per_sec", bench_sd_unet, 450),
-                 ("llama_271M_decode", bench_llama_decode, 250)) \
-        if on_tpu else ()
+                 ("llama_271M_decode", bench_llama_decode, 250),
+                 ("serving", bench_serving, 250)) \
+        if on_tpu else (("serving", bench_serving, 250),)
     import signal
 
     def _alarm(_sig, _frm):

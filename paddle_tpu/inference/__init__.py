@@ -11,7 +11,10 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["Config", "Predictor", "create_predictor", "PredictorHandle"]
+from .paged import PagePool, Request, ServingEngine, serve_requests
+
+__all__ = ["Config", "Predictor", "create_predictor", "PredictorHandle",
+           "PagePool", "Request", "ServingEngine", "serve_requests"]
 
 
 class Config:

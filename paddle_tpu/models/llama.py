@@ -28,7 +28,8 @@ from ..incubate.nn.functional import fused_rotary_position_embedding
 __all__ = ["LlamaConfig", "LlamaForCausalLM", "LlamaModel", "LlamaDecoderLayer",
            "build_functional_llama", "llama_microbatch_fns", "llama_block_specs",
            "llama_config_7b", "llama_config_tiny", "build_llama_decode",
-           "functional_params_from_layer", "llama_generate"]
+           "build_llama_paged_decode", "functional_params_from_layer",
+           "llama_generate"]
 
 
 @dataclass
@@ -647,6 +648,179 @@ def build_llama_decode(config: LlamaConfig, max_seq: int = None, dtype=None):
     return init_cache, prefill, decode_step
 
 
+# ---------------------------------------------------------------------------
+# Paged-KV serving decode path (ragged paged attention + page-pool cache)
+# ---------------------------------------------------------------------------
+def build_llama_paged_decode(config: LlamaConfig, page_size: int = 16,
+                             num_pages: int = 64, dtype=None,
+                             attention_impl: str = "auto",
+                             interpret: bool = False):
+    """Paged-KV decode path (the `block_multihead_attention` serving analog;
+    Ragged Paged Attention arxiv 2604.15464): the KV cache lives in a pool of
+    fixed-size pages shared by every in-flight request, so mixed-length
+    sequences occupy memory (and attention FLOPs) proportional to their OWN
+    length instead of the longest sequence in the batch.
+
+    Returns (init_pages, prefill, decode_step):
+
+      pages = init_pages()
+          {"k","v": [L, Hkv, num_pages + 1, page_size, head_dim]} — the last
+          page is the TRASH page inactive slots write into; the page pool
+          (inference/paged.py PagePool) hands out ids < num_pages.
+
+      logits, pages_k, pages_v = prefill(params, ids, true_len, page_row,
+                                         pages_k, pages_v)
+          ids [1, T_pad] right-padded prompt, true_len the real length,
+          page_row [P] this request's page table.  Dense causal attention
+          over the prompt; post-RoPE K/V scatter into the request's pages;
+          logits [vocab] for the LAST real token.
+
+      logits, pages_k, pages_v = decode_step(params, toks, lengths,
+                                             page_tables, pages_k, pages_v,
+                                             active)
+          One token per slot: toks [S], lengths [S] (tokens already cached —
+          the new token lands at position lengths[s]), page_tables [S, P],
+          active [S] bool.  Inactive slots write to the trash page and
+          produce garbage logits the engine discards.  Attention runs the
+          Pallas ragged paged kernel (attention_impl "pallas"/"auto"-on-TPU)
+          or its jnp gather fallback ("ref"/"auto"-off-TPU).
+
+    All shapes static; jit once and every decode step of a whole serving
+    run reuses the same executable regardless of which requests occupy
+    which slots.
+    """
+    from ..ops.pallas.paged_attention import (ragged_paged_attention_decode,
+                                              paged_attention_decode_ref)
+    c = config
+    d = jnp.dtype(dtype) if dtype is not None else jnp.float32
+    head_dim = c.hidden_size // c.num_attention_heads
+    L = c.num_hidden_layers
+    nkv = c.num_key_value_heads
+    nh = c.num_attention_heads
+    TRASH = num_pages
+    sin_t, cos_t = _rope_tables(c.max_position_embeddings, head_dim,
+                                c.rope_theta, d)
+    if attention_impl == "auto":
+        try:
+            use_kernel = any(dev.platform == "tpu" for dev in jax.devices())
+        except Exception:
+            use_kernel = False
+    else:
+        use_kernel = attention_impl == "pallas"
+
+    from ..nn.functional.norm import rms_norm_ref
+
+    def init_pages():
+        shape = (L, nkv, num_pages + 1, page_size, head_dim)
+        return {"k": jnp.zeros(shape, d), "v": jnp.zeros(shape, d)}
+
+    def _attn(q, kc_l, vc_l, page_tables, eff_len):
+        if use_kernel:
+            return ragged_paged_attention_decode(q, kc_l, vc_l, page_tables,
+                                                 eff_len, interpret=interpret)
+        return paged_attention_decode_ref(q, kc_l, vc_l, page_tables, eff_len)
+
+    def _rope_at(x, sin_p, cos_p):
+        # x: [S, H, D]; sin_p/cos_p: [S, D] (per-row positions)
+        half = x.shape[-1] // 2
+        x1, x2 = x[..., :half], x[..., half:]
+        rot = jnp.concatenate([-x2, x1], axis=-1)
+        return x * cos_p[:, None, :] + rot * sin_p[:, None, :]
+
+    def _head(hp, h_last):
+        h = rms_norm_ref(h_last, hp["ln_f"], c.rms_norm_eps)
+        return (h @ hp["lm"]).astype(jnp.float32)
+
+    def prefill(params, ids, true_len, page_row, pages_k, pages_v):
+        ep, bp, hp = params
+        T = ids.shape[1]
+        x = ep["tok"][ids[0]].astype(d)               # [T, H]
+        t_idx = jnp.arange(T)
+        valid = t_idx < true_len
+        page = jnp.where(valid, page_row[t_idx // page_size], TRASH)
+        off = t_idx % page_size
+        sin, cos = sin_t[:T], cos_t[:T]
+
+        def body(carry, layer_in):
+            xc, = carry
+            lp, kc_l, vc_l = layer_in
+            h = rms_norm_ref(xc, lp["ln1"], c.rms_norm_eps)
+            q = (h @ lp["wq"]).reshape(T, nh, head_dim)
+            k = (h @ lp["wk"]).reshape(T, nkv, head_dim)
+            v = (h @ lp["wv"]).reshape(T, nkv, head_dim)
+            q = _rope_at(q, sin, cos)
+            k = _rope_at(k, sin, cos)
+            kc_l = kc_l.at[:, page, off].set(
+                k.astype(d).transpose(1, 0, 2))
+            vc_l = vc_l.at[:, page, off].set(
+                v.astype(d).transpose(1, 0, 2))
+            rep = nh // nkv
+            kf = jnp.repeat(k, rep, axis=1) if rep > 1 else k
+            vf = jnp.repeat(v, rep, axis=1) if rep > 1 else v
+            s = jnp.einsum("qhd,khd->hqk", q.astype(jnp.float32),
+                           kf.astype(jnp.float32)) / math.sqrt(head_dim)
+            mask = (t_idx[None, :] <= t_idx[:, None]) & valid[None, :]
+            s = jnp.where(mask[None, :, :], s, -jnp.inf)
+            p = jax.nn.softmax(s, axis=-1).astype(xc.dtype)
+            o = jnp.einsum("hqk,khd->qhd", p, vf).reshape(T, nh * head_dim)
+            xc = xc + o @ lp["wo"]
+            h = rms_norm_ref(xc, lp["ln2"], c.rms_norm_eps)
+            ff = jax.nn.silu(h @ lp["wgate"]) * (h @ lp["wup"])
+            return (xc + ff @ lp["wdown"],), (kc_l, vc_l)
+
+        (x,), (ks, vs) = jax.lax.scan(body, (x,), (bp, pages_k, pages_v))
+        h_last = jax.lax.dynamic_index_in_dim(x, true_len - 1, 0,
+                                              keepdims=False)
+        return _head(hp, h_last), ks, vs
+
+    def decode_step(params, toks, lengths, page_tables, pages_k, pages_v,
+                    active):
+        ep, bp, hp = params
+        S = toks.shape[0]
+        x = ep["tok"][toks].astype(d)                 # [S, H]
+        pos = jnp.where(active, lengths, 0)
+        page = jnp.where(active, jnp.take_along_axis(
+            page_tables, (pos // page_size)[:, None], 1)[:, 0], TRASH)
+        off = pos % page_size
+        eff_len = jnp.where(active, lengths + 1, 0)
+        sin_p, cos_p = sin_t[pos], cos_t[pos]         # [S, D]
+
+        def body(carry, layer_in):
+            xc, = carry
+            lp, kc_l, vc_l = layer_in
+            h = rms_norm_ref(xc, lp["ln1"], c.rms_norm_eps)
+            q = (h @ lp["wq"]).reshape(S, nh, head_dim)
+            k = (h @ lp["wk"]).reshape(S, nkv, head_dim)
+            v = (h @ lp["wv"]).reshape(S, nkv, head_dim)
+            q = _rope_at(q, sin_p, cos_p)
+            k = _rope_at(k, sin_p, cos_p)
+            kc_l = kc_l.at[:, page, off].set(k.astype(d).transpose(1, 0, 2))
+            vc_l = vc_l.at[:, page, off].set(v.astype(d).transpose(1, 0, 2))
+            o = _attn(q, kc_l, vc_l, page_tables, eff_len)
+            xc = xc + o.reshape(S, nh * head_dim) @ lp["wo"]
+            h = rms_norm_ref(xc, lp["ln2"], c.rms_norm_eps)
+            ff = jax.nn.silu(h @ lp["wgate"]) * (h @ lp["wup"])
+            return (xc + ff @ lp["wdown"],), (kc_l, vc_l)
+
+        (x,), (ks, vs) = jax.lax.scan(body, (x,), (bp, pages_k, pages_v))
+        return _head(hp, x), ks, vs
+
+    return init_pages, prefill, decode_step
+
+
+def _sample_per_request(logits, key, temps, top_ps):
+    """Per-request sampling for the serving engine: logits [S, V], temps /
+    top_ps [S] -> token ids [S] int32.  temp <= 0 rows decode greedily; the
+    rest draw from the per-row nucleus (`tensor/search._top_p_mask` — the
+    same mask `top_p_sampling` applies)."""
+    from ..tensor.search import _top_p_mask
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+    masked = _top_p_mask(scaled, top_ps)
+    sampled = jax.random.categorical(key, masked, axis=-1).astype(jnp.int32)
+    return jnp.where(temps <= 0.0, greedy, sampled)
+
+
 def functional_params_from_layer(model: "LlamaForCausalLM"):
     """Stack an eager LlamaForCausalLM's per-layer weights into the
     (embed, block, head) pytrees the functional/decode paths consume.
@@ -786,6 +960,11 @@ def llama_generate_fused(params, config: LlamaConfig, input_ids,
     masked to eos_token_id, same output contract)."""
     c = config
     ids = jnp.asarray(input_ids, jnp.int32)
+    if max_new_tokens <= 0:
+        # parity with llama_generate: the prompt comes back unchanged
+        # (ADVICE r5 #3 — the fused loop's pre-loop sample would otherwise
+        # clobber the last prompt token via the clamped update at column T)
+        return ids
     B, T = ids.shape
     S_max = _resolve_cache_len(c, T, max_new_tokens, max_seq)
     fused = _generate_fused_executable(

@@ -14,11 +14,15 @@ import jax
 import jax.numpy as jnp
 
 from ...core.dispatch import register_kernel
+from . import _compat  # noqa: F401  (pltpu.CompilerParams alias, jax<=0.4)
 from . import flash_attention as fa_mod
+from . import paged_attention as pa_mod
 
-__all__ = ["register_all", "flash_attention"]
+__all__ = ["register_all", "flash_attention",
+           "ragged_paged_attention_decode"]
 
 flash_attention = fa_mod.flash_attention
+ragged_paged_attention_decode = pa_mod.ragged_paged_attention_decode
 
 
 def _naive_sdpa(q, k, v, causal):

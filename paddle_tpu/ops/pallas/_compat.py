@@ -1,0 +1,13 @@
+"""Pallas API compatibility across jax versions.
+
+The kernels target the current `pltpu.CompilerParams` spelling; older jax
+builds (<= 0.4.x, including the container's jax_graft toolchain) ship the
+same dataclass as `TPUCompilerParams`.  Alias it once here — every module
+in this package imports `_compat` before touching pltpu.
+"""
+from __future__ import annotations
+
+from jax.experimental.pallas import tpu as pltpu
+
+if not hasattr(pltpu, "CompilerParams") and hasattr(pltpu, "TPUCompilerParams"):
+    pltpu.CompilerParams = pltpu.TPUCompilerParams

@@ -136,16 +136,20 @@ def _pack_lse(lse3, interpret=False):
     compact [bh, s] via a repack kernel.  A plain squeeze does NOT work:
     XLA lowers it as a bitcast that keeps the padded layout alive — with 24
     saved lse residuals that measured 6 GB of pure padding (the r5 ViT
-    OOM).  Full-row blocks keep both sides tiling-compliant."""
+    OOM).  The grid walks s in fixed-size row CHUNKS (ADVICE r5 #4): a
+    full-row block holds ~512·s transient bytes of lane padding in VMEM,
+    which overflowed it at s >= ~16k even though the attention kernels
+    themselves tile fine there."""
     bh, s, _ = lse3.shape
+    chunk = next(b for b in (1024, 512, 256, 128) if s % b == 0)
 
     def kern(x_ref, o_ref):
-        o_ref[0] = x_ref[0][:, 0].reshape(s // 128, 128)
+        o_ref[0] = x_ref[0][:, 0].reshape(chunk // 128, 128)
 
     out = pl.pallas_call(
-        kern, grid=(bh,),
-        in_specs=[pl.BlockSpec((1, s, 1), lambda b: (b, 0, 0))],
-        out_specs=pl.BlockSpec((1, s // 128, 128), lambda b: (b, 0, 0)),
+        kern, grid=(bh, s // chunk),
+        in_specs=[pl.BlockSpec((1, chunk, 1), lambda b, i: (b, i, 0))],
+        out_specs=pl.BlockSpec((1, chunk // 128, 128), lambda b, i: (b, i, 0)),
         out_shape=_sds((bh, s // 128, 128), lse3.dtype, _vma_of(lse3)),
         interpret=interpret,
     )(lse3)

@@ -1,0 +1,186 @@
+"""Pallas TPU ragged paged attention (decode shape).
+
+The TPU-native analog of the reference's `block_multihead_attention`
+serving kernel (paddle/phi/kernels/fusion/gpu/block_multi_head_attention*)
+in the shape Ragged Paged Attention (arxiv 2604.15464) describes: the KV
+cache lives in fixed-size PAGES of `page_size` tokens, each sequence owns a
+per-sequence page table, and the kernel's grid walks each query's OWN page
+list — a ragged batch of mixed-length sequences therefore spends zero FLOPs
+(and zero DMA beyond one clamped dummy fetch) on padding to the longest
+sequence.
+
+Layout (lane-tiled — no 128x padding cliffs like PERF.md §7.2):
+
+  q          [S, Hq, D]          one query token per active sequence slot
+  k_pages    [Hkv, NP, ps, D]    page-pooled keys; last two dims are the
+  v_pages    [Hkv, NP, ps, D]    (sublane, lane) tile => D=128-friendly
+  page_table [S, P] int32        physical page of each logical page slot
+  lengths    [S]   int32         valid KV tokens per slot (0 = inactive)
+
+Grid: (S, Hkv, P) with the page dim innermost ("arbitrary" semantics) so
+the per-slot online-softmax scratch survives across a sequence's pages.
+The page table and lengths ride scalar prefetch
+(`pltpu.PrefetchScalarGridSpec`), so the K/V BlockSpec index maps resolve
+the PHYSICAL page to DMA before the kernel body runs — the indirection
+costs no kernel time.  GQA is native: the q block for grid step (s, h) is
+the `Hq // Hkv` query heads sharing kv head h, and K/V pages are fetched
+once per kv head, never materialized per q head.
+
+Pages past a sequence's length are skipped via `pl.when` (their table
+entries are clamped to a valid page id by the cache manager, so the
+speculative DMA stays in bounds); the final page is mask-tailed inside the
+kernel.  A slot with length 0 produces exact zeros.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from . import _compat  # noqa: F401  (pltpu.CompilerParams alias, jax<=0.4)
+
+__all__ = ["ragged_paged_attention_decode", "paged_attention_decode_ref",
+           "paged_gather_kv"]
+
+NEG_INF = -1e30
+
+
+def _decode_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_scr, l_scr, acc_scr, *, page_size, sm_scale):
+    b = pl.program_id(0)          # sequence slot
+    i = pl.program_id(2)          # logical page index (innermost, reduction)
+    n_pages = pl.num_programs(2)
+
+    @pl.when(i == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    length = len_ref[b]
+
+    @pl.when(i * page_size < length)
+    def _body():
+        q = q_ref[0].astype(jnp.float32)          # [rep, D]
+        k = k_ref[0, 0]                           # [ps, D]
+        v = v_ref[0, 0]                           # [ps, D]
+        s = jax.lax.dot_general(
+            q, k.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale     # [rep, ps]
+        pos = i * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where(pos < length, s, NEG_INF)
+        m_prev = m_scr[:]                         # [rep, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_scr[:] = alpha * l_scr[:] + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p, v.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = m_new
+
+    @pl.when(i == n_pages - 1)
+    def _finalize():
+        l = l_scr[:]
+        inv = jnp.where(l > 0.0, 1.0 / jnp.where(l > 0.0, l, 1.0), 0.0)
+        o_ref[0] = (acc_scr[:] * inv).astype(o_ref.dtype)
+
+
+def ragged_paged_attention_decode(q, k_pages, v_pages, page_table, lengths,
+                                  sm_scale=None, interpret=False,
+                                  out_dtype=None):
+    """One attention step per sequence slot over that slot's page list.
+
+    q [S, Hq, D], k_pages/v_pages [Hkv, NP, ps, D], page_table [S, P] int32
+    (entries past a sequence's pages must hold any in-range page id),
+    lengths [S] int32 -> o [S, Hq, D].  Requires Hq % Hkv == 0.
+
+    out_dtype: output dtype (default q.dtype).  Accumulation is f32 either
+    way; pass jnp.float32 with bf16 inputs to read the un-downcast result
+    (the parity tests' bf16→f32 bound).
+    """
+    s_slots, hq, d = q.shape
+    hkv, _np_, page_size, _d = k_pages.shape
+    n_ptab = page_table.shape[1]
+    if hq % hkv != 0:
+        raise ValueError(f"num q heads ({hq}) must be a multiple of kv "
+                         f"heads ({hkv})")
+    rep = hq // hkv
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+
+    grid = (s_slots, hkv, n_ptab)
+
+    def q_idx(b, h, i, pt, lens):
+        return (b, h, 0)
+
+    def kv_idx(b, h, i, pt, lens):
+        return (h, pt[b, i], 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, rep, d), q_idx),
+            pl.BlockSpec((1, 1, page_size, d), kv_idx),
+            pl.BlockSpec((1, 1, page_size, d), kv_idx),
+        ],
+        out_specs=pl.BlockSpec((1, rep, d), q_idx),
+        scratch_shapes=[
+            pltpu.VMEM((rep, 1), jnp.float32),
+            pltpu.VMEM((rep, 1), jnp.float32),
+            pltpu.VMEM((rep, d), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(_decode_kernel, page_size=page_size,
+                               sm_scale=sm_scale)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((s_slots, hq, d),
+                                       out_dtype or q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary", "arbitrary")),
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), lengths.astype(jnp.int32),
+      q, k_pages, v_pages)
+
+
+def paged_gather_kv(pages, page_table):
+    """Gather a slot-major dense view [S, P*ps, Hkv, D] out of the page pool
+    (pages [Hkv, NP, ps, D], page_table [S, P]) — the XLA fallback's (and
+    the parity tests') dense reconstruction."""
+    g = pages[:, page_table]                      # [Hkv, S, P, ps, D]
+    hkv, s, p, ps, d = g.shape
+    return g.transpose(1, 2, 3, 0, 4).reshape(s, p * ps, hkv, d)
+
+
+def paged_attention_decode_ref(q, k_pages, v_pages, page_table, lengths,
+                               sm_scale=None, out_dtype=None):
+    """jnp reference/fallback with identical semantics to the kernel
+    (gathers pages dense, masks positions >= length, zeros length-0 slots).
+    This is the CPU path the serving engine uses off-TPU."""
+    s_slots, hq, d = q.shape
+    hkv = k_pages.shape[0]
+    page_size = k_pages.shape[2]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    k = paged_gather_kv(k_pages, page_table)      # [S, T, Hkv, D]
+    v = paged_gather_kv(v_pages, page_table)
+    if hq != hkv:
+        repn = hq // hkv
+        k = jnp.repeat(k, repn, axis=2)
+        v = jnp.repeat(v, repn, axis=2)
+    s = jnp.einsum("shd,sthd->sht", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * sm_scale
+    t_pos = jnp.arange(s.shape[-1])[None, None, :]
+    s = jnp.where(t_pos < lengths[:, None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("sht,sthd->shd", p, v.astype(jnp.float32))
+    o = jnp.where(lengths[:, None, None] > 0, o, 0.0)
+    return o.astype(out_dtype or q.dtype)

@@ -35,7 +35,12 @@ class Program:
     def __init__(self):
         self.random_seed = 0
         self._ops = []           # (name, vals, outs, impl, static_kwargs)
-        self._feed_ids = {}      # feed name -> id(placeholder value)
+        # feed name -> the placeholder ARRAY itself.  A strong reference is
+        # load-bearing (ADVICE r5 #5): holding only id(array) let CPython
+        # recycle the id after a GC'd / rebound placeholder, silently
+        # binding the feed to an unrelated array at replay time.  Replay
+        # matches by identity against this held object.
+        self._feeds = {}
 
     def global_block(self):
         return self
@@ -43,16 +48,16 @@ class Program:
     def clone(self, for_test=False):
         p = Program()
         p._ops = list(self._ops)
-        p._feed_ids = dict(self._feed_ids)
+        p._feeds = dict(self._feeds)
         return p
 
     # -- replay ------------------------------------------------------------
     def _run(self, feed, fetch_vals):
         env = {}
-        for name, pid in self._feed_ids.items():
+        for name, placeholder in self._feeds.items():
             if feed and name in feed:
                 fv = feed[name]
-                env[pid] = fv._value if isinstance(fv, Tensor) \
+                env[id(placeholder)] = fv._value if isinstance(fv, Tensor) \
                     else jnp.asarray(fv)
         for op_name, vals, outs, impl, kw in self._ops:
             new_vals = [env.get(id(v), v) if not isinstance(v, (int, float,
@@ -77,7 +82,7 @@ def data(name, shape, dtype="float32", lod_level=0):
     t = Tensor(jnp.zeros(concrete, dtype))
     t.name = name
     prog = _active[0] if _active[0] is not None else _main
-    prog._feed_ids[name] = id(t._value)
+    prog._feeds[name] = t._value
     return t
 
 

@@ -231,6 +231,22 @@ _this = _sys.modules[__name__]
 
 def _make_inplace(base_name, base_fn):
     def _inplace(x, *args, **kwargs):
+        # the write-back goes through _set_value, which detaches from the
+        # tape — applied to a grad-requiring tensor this would SILENTLY
+        # corrupt autograd: a non-leaf drops gradients to its upstream
+        # producers, a leaf mutates the value its pending grads refer to.
+        # The reference raises for both under grad mode ("Leaf Var that
+        # doesn't stop gradient can't use inplace strategy" / the
+        # inplace-version check); match it (ADVICE r5 #2).
+        from ..core.dispatch import is_grad_enabled
+        if is_grad_enabled() and not x.stop_gradient:
+            kind = "leaf" if x.is_leaf else "non-leaf"
+            raise RuntimeError(
+                f"{base_name}_: in-place operation on a {kind} tensor that "
+                "requires grad is not supported — the write-back would "
+                "detach it from the autograd tape (reference in-place "
+                f"guard). Use the out-of-place `{base_name}`, or wrap the "
+                "call in paddle.no_grad().")
         out = base_fn(x.detach(), *args, **kwargs)
         if out._value.shape != x._value.shape:
             raise ValueError(

@@ -206,20 +206,33 @@ def histogram_bin_edges(x, bins=100, min=0, max=0, name=None):
     return op_call("histogram_bin_edges", impl, x, nondiff=True)
 
 
+def _top_p_mask(v, p):
+    """Nucleus mask over the last axis: keep the smallest set of
+    highest-probability entries whose cumulative probability reaches `p`
+    (always at least the argmax); everything else -> -inf.  `p` may be a
+    python scalar or a per-row array broadcastable to v.shape[:-1] — the
+    per-row form is what the paged serving engine's per-request sampling
+    rides (inference/paged.py)."""
+    pb = jnp.broadcast_to(jnp.asarray(p, jnp.float32), v.shape[:-1])
+    sorted_logits = jnp.sort(v, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep_n = jnp.sum(cum < pb[..., None], axis=-1)
+    cutoff = jnp.take_along_axis(sorted_logits, keep_n[..., None], -1)
+    return jnp.where(v < cutoff, -jnp.inf, v)
+
+
 def top_p_sampling(x, ps, threshold=None, seed=None, name=None):
     """Nucleus sampling over the last axis (reference top_p_sampling):
-    returns (sampled values, sampled ids), one draw per row."""
+    returns (sampled values [..., 1], sampled ids [..., 1]), one draw per
+    row — column tensors, matching the reference's shape=[B, 1] contract
+    (ADVICE r5 #1)."""
     from ..core.random import split_key
 
     key = split_key() if seed is None else jax.random.PRNGKey(int(seed))
 
     def impl(v, p, *rest):
-        sorted_logits = jnp.sort(v, axis=-1)[..., ::-1]
-        probs = jax.nn.softmax(sorted_logits, axis=-1)
-        cum = jnp.cumsum(probs, axis=-1)
-        keep_n = jnp.sum(cum < p[..., None], axis=-1)
-        cutoff = jnp.take_along_axis(sorted_logits, keep_n[..., None], -1)
-        masked = jnp.where(v < cutoff, -jnp.inf, v)
+        masked = _top_p_mask(v, p)
         if rest:
             # reference threshold: a per-row probability floor that further
             # restricts the nucleus (keep at least the argmax)
@@ -230,8 +243,8 @@ def top_p_sampling(x, ps, threshold=None, seed=None, name=None):
             below = below & ~(jnp.arange(v.shape[-1]) == best)
             masked = jnp.where(below, -jnp.inf, masked)
         ids = jax.random.categorical(key, masked, axis=-1)
-        vals = jnp.take_along_axis(v, ids[..., None], -1)[..., 0]
-        return vals, ids.astype(jnp.int64)
+        vals = jnp.take_along_axis(v, ids[..., None], -1)
+        return vals, ids[..., None].astype(jnp.int64)
     args = (x, ps) if threshold is None else (x, ps, threshold)
     return op_call("top_p_sampling", impl, *args, nondiff=True)
 

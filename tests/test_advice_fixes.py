@@ -295,3 +295,140 @@ class TestCausalOffset:
         q = jnp.zeros((1, 256, 2, 64))
         k = jnp.zeros((1, 128, 2, 64))
         assert flash_attention(q, k, k, causal=True, interpret=True) is None
+
+
+# ---------------------------------------------------------------------------
+# Round-5 advisor findings (ADVICE.md r5; closed in the paged-serving PR)
+# ---------------------------------------------------------------------------
+class TestTopPSamplingColumnShape:
+    """ADVICE r5 #1: top_p_sampling must return [B, 1] column tensors
+    (reference parity), not rank-1 [B]."""
+
+    def test_shapes_and_argmax_limit(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.tensor.search import top_p_sampling
+        rng = np.random.default_rng(0)
+        x = paddle.to_tensor(rng.normal(size=(3, 16)).astype("float32"))
+        ps = paddle.to_tensor(np.full((3,), 1e-6, np.float32))
+        vals, ids = top_p_sampling(x, ps, seed=0)
+        assert tuple(vals.shape) == (3, 1)
+        assert tuple(ids.shape) == (3, 1)
+        # int64 downcasts to int32 when x64 is disabled (conftest default)
+        assert str(ids.numpy().dtype) in ("int32", "int64")
+        # p ~ 0 keeps only the argmax -> callers indexing out[:, 0] get it
+        np.testing.assert_array_equal(
+            np.asarray(ids.numpy())[:, 0],
+            np.argmax(np.asarray(x.numpy()), -1))
+
+    def test_threshold_branch_keeps_shape(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.tensor.search import top_p_sampling
+        rng = np.random.default_rng(1)
+        x = paddle.to_tensor(rng.normal(size=(2, 8)).astype("float32"))
+        ps = paddle.to_tensor(np.full((2,), 0.9, np.float32))
+        thr = paddle.to_tensor(np.full((2,), 0.01, np.float32))
+        vals, ids = top_p_sampling(x, ps, threshold=thr, seed=1)
+        assert tuple(vals.shape) == (2, 1) and tuple(ids.shape) == (2, 1)
+
+
+class TestInplaceNonLeafGuard:
+    """ADVICE r5 #2: in-place variants on a grad-requiring NON-leaf must
+    raise instead of silently detaching upstream gradients."""
+
+    def test_nonleaf_raises(self):
+        import paddle_tpu as paddle
+        x = paddle.to_tensor(np.ones((3,), np.float32), stop_gradient=False)
+        y = x * 2.0                                # non-leaf on the tape
+        assert not y.stop_gradient and not y.is_leaf
+        with pytest.raises(RuntimeError, match="in-place"):
+            y.exp_()
+
+    def test_leaf_requiring_grad_raises_too(self):
+        """Reference parity: 'Leaf Var that doesn't stop gradient can't use
+        inplace strategy' — the leaf's pending grads would refer to the
+        pre-mutation value."""
+        import paddle_tpu as paddle
+        p = paddle.to_tensor(np.ones((3,), np.float32), stop_gradient=False)
+        with pytest.raises(RuntimeError, match="in-place"):
+            p.exp_()
+        with paddle.no_grad():                     # explicit opt-out works
+            p.exp_()
+        np.testing.assert_allclose(np.asarray(p.numpy()), np.exp(np.ones(3)),
+                                   rtol=1e-6)
+
+    def test_no_grad_paths_still_work(self):
+        import paddle_tpu as paddle
+        # non-leaf under no_grad: allowed
+        x = paddle.to_tensor(np.ones((3,), np.float32), stop_gradient=False)
+        y = x * 2.0
+        with paddle.no_grad():
+            y.sqrt_()
+        np.testing.assert_allclose(np.asarray(y.numpy()), np.sqrt(2.0),
+                                   rtol=1e-6)
+        # stop_gradient non-leaf value: allowed
+        z = paddle.to_tensor(np.full((3,), 4.0, np.float32))
+        w = z + 0.0
+        w.sqrt_()
+        np.testing.assert_allclose(np.asarray(w.numpy()), 2.0, rtol=1e-6)
+
+
+class TestFusedGenerateZeroNewTokens:
+    """ADVICE r5 #3: max_new_tokens <= 0 returns the prompt unchanged
+    instead of clobbering its last token."""
+
+    def test_prompt_returned_unchanged(self):
+        from paddle_tpu.models.llama import (llama_config_tiny,
+                                             build_functional_llama,
+                                             llama_generate,
+                                             llama_generate_fused)
+        cfg = llama_config_tiny(vocab=64, hidden=32, layers=2, heads=4,
+                                seq=32)
+        ep, bp, hp, *_ = build_functional_llama(cfg, key=jax.random.PRNGKey(0))
+        params = (ep, bp, hp)
+        ids = np.random.default_rng(0).integers(1, 64, (2, 6)).astype(np.int32)
+        out = np.asarray(llama_generate_fused(params, cfg, ids,
+                                              max_new_tokens=0))
+        np.testing.assert_array_equal(out, ids)
+        ref = np.asarray(llama_generate(params, cfg, ids, max_new_tokens=0))
+        np.testing.assert_array_equal(out, ref)
+
+
+class TestPackLseChunkedGrid:
+    """ADVICE r5 #4: _pack_lse grids over s in fixed row chunks, so the
+    repack stays correct (and VMEM-bounded) at multi-chunk lengths."""
+
+    @pytest.mark.parametrize("s", [128, 1024, 2048, 2176])
+    def test_multi_chunk_roundtrip(self, s):
+        from paddle_tpu.ops.pallas.flash_attention import _pack_lse
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(2, s, 1)).astype(np.float32)
+        out = _pack_lse(jnp.asarray(x), interpret=True)
+        assert out.shape == (2, s)
+        np.testing.assert_array_equal(np.asarray(out), x[:, :, 0])
+
+
+class TestProgramFeedStrongRef:
+    """ADVICE r5 #5: Program holds the placeholder array itself, so a GC'd
+    handle can never let CPython recycle the id into a misbind."""
+
+    def test_feed_survives_placeholder_gc(self):
+        import gc
+        import paddle_tpu as paddle
+        from paddle_tpu import static
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [2, 3], "float32")
+            y = x * 2.0
+        # the program itself must keep the placeholder value alive
+        assert "x" in prog._feeds
+        held = prog._feeds["x"]
+        del x
+        gc.collect()
+        # churn allocations to encourage id reuse of freed objects
+        junk = [np.zeros((2, 3), np.float32) + i for i in range(64)]
+        del junk
+        exe = static.Executor()
+        feed_val = np.arange(6, dtype=np.float32).reshape(2, 3)
+        (out,) = exe.run(prog, feed={"x": feed_val}, fetch_list=[y])
+        np.testing.assert_allclose(out, feed_val * 2.0, rtol=1e-6)
+        assert prog._feeds["x"] is held
