@@ -110,6 +110,13 @@ class GradScaler:
                 self._good_steps = 0
         self._found_inf = False
 
+    def notify_nonfinite(self):
+        """Backoff hook for compiled train loops (TrainStep's non-finite
+        sentinel): count a bad step and run the dynamic-loss-scale decay —
+        the skipped-step analog of found_inf inside minimize()."""
+        self._found_inf = True
+        self.update()
+
     def state_dict(self):
         return {"scale": self._scale, "incr_ratio": self._incr_ratio,
                 "decr_ratio": self._decr_ratio, "incr_count": self._good_steps,

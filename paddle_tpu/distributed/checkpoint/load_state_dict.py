@@ -6,6 +6,13 @@ parallelism degrees.
 
 Shard payloads are keyed by (name, global extent) so files from different
 ranks never collide (multi-host safe; see save_state_dict.py).
+
+Crash consistency: checkpoints written by the staged writer carry a per-file
+SHA-256 ``manifest.json``; :func:`verify_checkpoint` re-hashes every listed
+file and :func:`load_state_dict` refuses manifest mismatches outright — a
+torn or bit-flipped snapshot fails loudly instead of resuming training from
+silently wrong weights.  Manifest-less directories (pre-manifest saves) still
+load for backward compatibility.
 """
 from __future__ import annotations
 
@@ -18,8 +25,77 @@ import jax
 import jax.numpy as jnp
 
 from ...core.tensor import Tensor
+from .save_state_dict import _sha256, recover_interrupted_commit
 
-__all__ = ["load_state_dict"]
+__all__ = ["load_state_dict", "verify_checkpoint", "CheckpointCorruptError"]
+
+
+class CheckpointCorruptError(RuntimeError):
+    """The checkpoint directory fails manifest verification: files missing,
+    truncated, or altered since the manifest was written."""
+
+
+def _load_manifest(path):
+    """Parse ``path``'s manifest; raises CheckpointCorruptError when absent
+    or unreadable."""
+    if not os.path.isdir(path):
+        raise CheckpointCorruptError(f"{path}: not a checkpoint directory")
+    man_fn = os.path.join(path, "manifest.json")
+    if not os.path.exists(man_fn):
+        raise CheckpointCorruptError(
+            f"{path}: manifest.json missing — torn, uncommitted, or "
+            "pre-manifest checkpoint")
+    try:
+        with open(man_fn) as f:
+            man = json.load(f)
+    except (OSError, ValueError) as e:
+        raise CheckpointCorruptError(
+            f"{path}: unreadable manifest.json ({e})") from e
+    if "metadata.json" not in man.get("files", {}):
+        raise CheckpointCorruptError(
+            f"{path}: manifest does not cover metadata.json")
+    return man
+
+
+def _verify_file(path, fn, man):
+    info = man.get("files", {}).get(fn)
+    if info is None:
+        raise CheckpointCorruptError(
+            f"{path}: {fn} is not covered by the manifest")
+    full = os.path.join(path, fn)
+    if not os.path.exists(full):
+        raise CheckpointCorruptError(
+            f"{path}: {fn} listed in manifest but missing on disk")
+    try:
+        size = os.path.getsize(full)
+        digest = _sha256(full)
+    except OSError as e:  # unreadable counts as corrupt: discovery must
+        raise CheckpointCorruptError(  # skip it, not crash on it
+            f"{path}: {fn} unreadable ({e})") from e
+    if size != info.get("size"):
+        raise CheckpointCorruptError(
+            f"{path}: {fn} size {size} != manifest {info.get('size')} "
+            "(truncated or torn write)")
+    if digest != info.get("sha256"):
+        raise CheckpointCorruptError(
+            f"{path}: {fn} sha256 mismatch vs manifest — shard data "
+            "missing, torn, or altered")
+
+
+def verify_checkpoint(path):
+    """Verify EVERY manifest-listed file of ``path``; returns the manifest.
+
+    Raises :class:`CheckpointCorruptError` when the manifest is absent,
+    unreadable, or any listed file is missing / wrong size / wrong SHA-256 —
+    i.e. for every torn-write shape the staged writer can leave behind short
+    of a committed rename.  (load_state_dict verifies only the files it
+    actually reads — this full pass is for snapshot discovery, e.g.
+    CheckpointManager.find_latest_complete.)"""
+    recover_interrupted_commit(path)
+    man = _load_manifest(path)
+    for fn in man.get("files", {}):
+        _verify_file(path, fn, man)
+    return man
 
 
 def _flat_targets(state_dict, prefix=""):
@@ -35,6 +111,14 @@ def _flat_targets(state_dict, prefix=""):
 
 def load_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
                     unique_id=None, offload=False):
+    recover_interrupted_commit(path)
+    # verify ONLY what this load reads (manifest-covered metadata + the
+    # referenced shard files): a full-directory pass would make every rank
+    # re-hash every other rank's payload on the restart critical path
+    man = None
+    if os.path.exists(os.path.join(path, "manifest.json")):
+        man = _load_manifest(path)
+        _verify_file(path, "metadata.json", man)
     with open(os.path.join(path, "metadata.json")) as f:
         meta = json.load(f)
     # read only the shard files metadata references (never stray rank files
@@ -45,6 +129,8 @@ def load_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
             referenced.add(s["file"])
     data = {}
     for base in sorted(referenced):
+        if man is not None:
+            _verify_file(path, base, man)  # reject torn/altered shards loudly
         fn = os.path.join(path, base)
         with open(fn, "rb") as f:
             payload = pickle.load(f)

@@ -9,22 +9,40 @@ rank writes a sidecar ``rank{r}.meta.json`` describing its shard extents;
 after a global barrier the coordinator merges all sidecars into the single
 ``metadata.json`` (the analog of the reference's cross-rank metadata gather
 in save_state_dict).
+
+Crash consistency (the preemption discipline large TPU jobs live on): every
+file is staged into ``<path>.tmp`` with chunked writes + fsync, the
+coordinator records a per-file SHA-256 ``manifest.json``, and the single
+commit point is the atomic rename of the staging dir onto ``<path>``.  A
+crash at ANY instant — mid-file, between files, before the manifest, before
+the rename — leaves either the previous intact checkpoint or no final dir at
+all, never a load-able-but-wrong snapshot.  The writer consults the
+``ckpt.write`` / ``ckpt.commit`` fault points (resilience/faults.py) so all
+of those crash windows are exercised in CPU tests.
 """
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import pickle
+import shutil
 import threading
 
 import numpy as np
 import jax
 
 from ...core.tensor import Tensor
+from ...resilience.faults import fault_point
 
-__all__ = ["save_state_dict", "wait_async_save"]
+__all__ = ["save_state_dict", "wait_async_save", "WRITE_CHUNK"]
+
+# bytes written between ckpt.write fault-point consults (tests shrink this to
+# tear tiny files mid-write)
+WRITE_CHUNK = 1 << 20
 
 _async_threads: list[threading.Thread] = []
+_async_errors: list[BaseException] = []
 
 
 def _flat(state_dict, prefix=""):
@@ -54,19 +72,95 @@ def _barrier():
         multihost_utils.sync_global_devices("paddle_tpu_ckpt_save")
 
 
+def _fsync_dir(path):
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _write_durable(fn, data: bytes):
+    """Chunked write + fsync, consulting the ckpt.write fault point before
+    every chunk — an injected 'raise' tears the file at that byte offset,
+    exactly like a preemption mid-write."""
+    base = os.path.basename(fn)
+    with open(fn, "wb") as f:
+        for off in range(0, len(data), WRITE_CHUNK) or (0,):
+            fault_point("ckpt.write", file=base, offset=off)
+            f.write(data[off:off + WRITE_CHUNK])
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def _sha256(fn):
+    h = hashlib.sha256()
+    with open(fn, "rb") as f:
+        for block in iter(lambda: f.read(1 << 20), b""):
+            h.update(block)
+    return h.hexdigest()
+
+
+def _write_manifest(staging):
+    """Per-file SHA-256 manifest over everything staged so far; written last,
+    so its presence certifies every other file landed completely."""
+    files = sorted(fn for fn in os.listdir(staging) if fn != "manifest.json")
+    man = {"version": 1, "files": {
+        fn: {"sha256": _sha256(os.path.join(staging, fn)),
+             "size": os.path.getsize(os.path.join(staging, fn))}
+        for fn in files}}
+    _write_durable(os.path.join(staging, "manifest.json"),
+                   json.dumps(man).encode())
+
+
 def wait_async_save():
-    """Block until all pending async checkpoint writes are on disk."""
+    """Block until all pending async checkpoint writes are on disk; re-raises
+    the first exception raised inside a writer thread (a silently dropped
+    failed write would masquerade as a durable checkpoint)."""
     global _async_threads
     for t in _async_threads:
         t.join()
     _async_threads = []
+    if _async_errors:
+        first = _async_errors[0]
+        _async_errors.clear()
+        raise first
+
+
+def recover_interrupted_commit(path):
+    """A crash between the commit's two renames leaves the previous intact
+    checkpoint stranded at ``<path>.old`` with ``<path>`` missing — restore
+    it.  (When ``<path>`` exists, ``.old`` is just pre-rmtree debris.)
+    Called by both the saver and the loader, so the window self-heals on the
+    first touch after restart."""
+    path = os.fspath(path)
+    old = path + ".old"
+    if not os.path.exists(path) and os.path.isdir(old):
+        try:
+            os.rename(old, path)
+            return True
+        except OSError:
+            # several ranks can race this recovery on a shared filesystem —
+            # losing the rename is fine as long as somebody healed it
+            return os.path.exists(path)
+    return False
 
 
 def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
                     unique_id=None, async_save=False):
-    os.makedirs(path, exist_ok=True)
-    flat = _flat(state_dict)
+    path = os.fspath(path)
+    staging = path + ".tmp"
     rank = jax.process_index()
+    if rank == coordinator_rank:
+        recover_interrupted_commit(path)
+        for stale in (staging, path + ".old"):
+            shutil.rmtree(stale, ignore_errors=True)
+    _barrier()  # nobody writes into staging before the stale sweep
+    os.makedirs(staging, exist_ok=True)
+    flat = _flat(state_dict)
     # per-rank view of the metadata; merged by the coordinator at the end
     local_meta = {"version": 2, "tensors": {}}
     shards = {}
@@ -101,22 +195,55 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
         local_meta["tensors"][name] = entry
 
     def _write():
-        with open(os.path.join(path, f"rank{rank}.data"), "wb") as f:
-            pickle.dump(shards, f, protocol=4)
-        with open(os.path.join(path, f"rank{rank}.meta.json"), "w") as f:
-            json.dump(local_meta, f, default=str)
+        _write_durable(os.path.join(staging, f"rank{rank}.data"),
+                       pickle.dumps(shards, protocol=4))
+        _write_durable(os.path.join(staging, f"rank{rank}.meta.json"),
+                       json.dumps(local_meta, default=str).encode())
+
+    def _commit():
+        """Merge metadata, write the manifest, then the commit point: rename
+        staging onto the final path (the previous checkpoint, if any, stays
+        intact until after the new one is durable)."""
+        _merge_metadata(staging)
+        _write_manifest(staging)
+        _fsync_dir(staging)
+        fault_point("ckpt.commit", path=path, phase="pre")
+        old = path + ".old"
+        if os.path.exists(path):
+            os.rename(path, old)
+            # crash HERE strands the previous checkpoint at .old —
+            # recover_interrupted_commit() restores it on the next touch
+            fault_point("ckpt.commit", path=path, phase="swap")
+        os.rename(staging, path)
+        shutil.rmtree(old, ignore_errors=True)
+        _fsync_dir(os.path.dirname(os.path.abspath(path)) or ".")
 
     if async_save:
         # device_get already happened above; only the host-side serialization
         # and file IO run in the background thread.
-        th = threading.Thread(target=_write, daemon=False)
+        err_box: list[BaseException] = []
+
+        def _write_guarded():
+            try:
+                _write()
+            except BaseException as e:  # noqa: BLE001 — re-raised on join
+                err_box.append(e)
+                _async_errors.append(e)
+
+        th = threading.Thread(target=_write_guarded, daemon=False)
         th.start()
         _async_threads.append(th)
         if jax.process_count() == 1:
-            # single-controller: merge metadata after the write completes
+            # single-controller: merge + commit after the write completes;
+            # a failed write must never be committed (torn staging stays .tmp)
             def _finish():
                 th.join()
-                _merge_metadata(path)
+                if err_box:
+                    return
+                try:
+                    _commit()
+                except BaseException as e:  # noqa: BLE001
+                    _async_errors.append(e)
             fin = threading.Thread(target=_finish, daemon=False)
             fin.start()
             _async_threads.append(fin)
@@ -124,13 +251,25 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
         # multi-host async: caller must invoke wait_async_save() before the
         # barrier; fall through to synchronous merge for safety
         th.join()
+        if err_box:
+            # raised to the caller HERE — retract the queued copy so a later
+            # wait_async_save() doesn't re-raise an already-handled failure
+            try:
+                _async_errors.remove(err_box[0])
+            except ValueError:
+                pass
+            try:
+                _async_threads.remove(th)
+            except ValueError:
+                pass
+            raise err_box[0]
     else:
         _write()
 
     _barrier()  # all ranks' sidecars must be on disk before the merge
     if rank == coordinator_rank:
-        _merge_metadata(path)
-    _barrier()  # nobody returns until metadata.json exists
+        _commit()
+    _barrier()  # nobody returns until the final dir exists
 
 
 def _merge_metadata(path):
@@ -170,5 +309,5 @@ def _merge_metadata(path):
                 if ext not in have:
                     have.add(ext)
                     tgt["shards"].append(s)
-    with open(os.path.join(path, "metadata.json"), "w") as f:
-        json.dump(merged, f, default=str)
+    _write_durable(os.path.join(path, "metadata.json"),
+                   json.dumps(merged, default=str).encode())

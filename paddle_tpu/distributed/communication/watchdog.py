@@ -18,17 +18,34 @@ import jax
 import numpy as np
 
 from ... import flags
+from ...resilience.faults import fault_point
 
-__all__ = ["CommTimeoutError", "CommTaskManager", "wait_with_timeout",
-           "check_comm_result", "get_comm_task_manager"]
+__all__ = ["CommTimeoutError", "CommAggregateError", "CommTaskManager",
+           "wait_with_timeout", "check_comm_result", "get_comm_task_manager"]
 
 
 class CommTimeoutError(RuntimeError):
     pass
 
 
+class CommAggregateError(RuntimeError):
+    """Multiple tracked collectives failed in one wait_all(); `.errors` holds
+    every (op_name, exception) pair so one slow collective cannot mask NaNs
+    (or further timeouts) in the rest."""
+
+    def __init__(self, message, errors):
+        super().__init__(message)
+        self.errors = errors
+
+
 def wait_with_timeout(value, timeout: float, op_name: str = "collective"):
-    """Block until `value` is ready, at most `timeout` seconds."""
+    """Block until `value` is ready, at most `timeout` seconds.  The
+    `comm.ready` fault point simulates a peer whose collective never becomes
+    ready (deterministic CPU stand-in for a dead host / stuck DCN link)."""
+    if fault_point("comm.ready", op=op_name) is not None:
+        raise CommTimeoutError(
+            f"{op_name} not ready (injected delayed readiness) — peer "
+            f"failure or hung link (reference comm_task_manager.h IsTimeout)")
     done = threading.Event()
     err = []
 
@@ -84,12 +101,36 @@ class CommTaskManager:
             return len(self._tasks)
 
     def wait_all(self, timeout: float = None):
+        """Assert every tracked result lands AND is sane within the deadline.
+
+        Every task is checked even after one fails — a timeout mid-list must
+        not leave the tail unverified (a slow collective masking a NaN in a
+        later one).  The deadline is SHARED across the set (after it expires
+        each remaining task gets only a short grace to prove it already
+        landed), so one dead peer costs ~timeout, not N x timeout.  A single
+        failure re-raises as-is; multiple failures aggregate into
+        CommAggregateError naming every failed op."""
+        import time as _time
         timeout = timeout or self.default_timeout
         with self._lock:
             tasks, self._tasks = self._tasks, []
+        errors = []
+        start = _time.monotonic()
         for name, v in tasks:
-            wait_with_timeout(v, timeout, name)
-            check_comm_result(v, name)
+            remaining = max(0.05, timeout - (_time.monotonic() - start))
+            try:
+                wait_with_timeout(v, remaining, name)
+                check_comm_result(v, name)
+            except Exception as e:  # noqa: BLE001 — aggregated below
+                errors.append((name, e))
+        if len(errors) == 1:
+            raise errors[0][1]
+        if errors:
+            detail = "; ".join(f"{n}: {type(e).__name__}: {e}"
+                               for n, e in errors)
+            raise CommAggregateError(
+                f"{len(errors)} of {len(tasks)} tracked collectives failed "
+                f"— {detail}", errors)
 
 
 _manager = [None]

@@ -11,10 +11,12 @@ from __future__ import annotations
 
 import numpy as np
 
-from .paged import PagePool, Request, ServingEngine, serve_requests
+from .paged import (PagePool, Request, ServingEngine, serve_requests,
+                    PoolCapacityError, AdmissionRejected, EngineStalledError)
 
 __all__ = ["Config", "Predictor", "create_predictor", "PredictorHandle",
-           "PagePool", "Request", "ServingEngine", "serve_requests"]
+           "PagePool", "Request", "ServingEngine", "serve_requests",
+           "PoolCapacityError", "AdmissionRejected", "EngineStalledError"]
 
 
 class Config:

@@ -22,6 +22,22 @@ every moment, growing one page at a time as decode crosses page
 boundaries.  If the pool is momentarily empty, the slot simply stalls for
 a step (its pending token is masked inactive) until a retirement frees
 pages — admission control keeps this rare.
+
+Self-healing (the serving degradation ladder: admit -> queue -> reject ->
+preempt):
+
+  * a bounded admission queue rejects overflow with a typed
+    `AdmissionRejected` (backpressure) instead of growing unboundedly;
+  * per-request deadlines retire overdue work (slot or queue) with
+    `Request.timed_out` set, returning its pages;
+  * when no slot can make progress (the former hard-deadlock
+    RuntimeError), the engine PREEMPTS a victim — the youngest /
+    lowest-progress slot — returning its pages and requeueing it at the
+    queue head; re-admission re-prefills prompt + already-emitted tokens,
+    so greedy outputs stay step-exact vs a never-preempted run;
+  * injected page-pool pressure (`serve.pool_pressure` /
+    `pagepool.alloc` fault points, resilience/faults.py) exercises all of
+    the above deterministically on CPU.
 """
 from __future__ import annotations
 
@@ -32,7 +48,24 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
-__all__ = ["PagePool", "Request", "ServingEngine", "serve_requests"]
+from ..resilience.faults import fault_point
+
+__all__ = ["PagePool", "Request", "ServingEngine", "serve_requests",
+           "PoolCapacityError", "AdmissionRejected", "EngineStalledError"]
+
+
+class PoolCapacityError(ValueError):
+    """The request can NEVER fit the configured pool / page-table geometry
+    (a sizing error, distinct from malformed input)."""
+
+
+class AdmissionRejected(RuntimeError):
+    """The bounded admission queue is full — backpressure; retry later."""
+
+
+class EngineStalledError(RuntimeError):
+    """run() made no progress for max_stall_steps consecutive steps (only
+    reachable under a never-clearing injected pool fault)."""
 
 
 class PagePool:
@@ -58,12 +91,16 @@ class PagePool:
 
     def alloc(self, n: int):
         """Pop n pages; raises RuntimeError when the pool cannot satisfy the
-        request (callers check `num_free` first for graceful stalling)."""
+        request (callers check `num_free` first for graceful stalling).
+        Consults the `pagepool.alloc` fault point: a 'trigger' spec forces
+        the exhausted path, a 'raise' spec injects InjectedFault."""
         if n < 0:
             raise ValueError("alloc(n): n must be >= 0")
-        if n > len(self._free):
+        injected = fault_point("pagepool.alloc", n=n, free=len(self._free))
+        if n > len(self._free) or injected is not None:
             raise RuntimeError(
-                f"PagePool exhausted: requested {n} pages, {len(self._free)} "
+                f"PagePool exhausted{' (injected)' if injected else ''}: "
+                f"requested {n} pages, {len(self._free)} "
                 f"free of {self.num_pages}")
         pages = [self._free.pop() for _ in range(n)]
         self._allocated.update(pages)
@@ -88,10 +125,13 @@ class Request:
     temperature: float = 0.0
     top_p: float = 1.0
     eos_token_id: int | None = None
+    deadline: float | None = None      # absolute perf_counter() cutoff
     # filled by the engine
     generated: list = field(default_factory=list)
     submit_time: float = 0.0
     finish_time: float = 0.0
+    timed_out: bool = False            # retired overdue (possibly partial)
+    preemptions: int = 0               # times evicted + requeued mid-flight
 
     @property
     def output_ids(self) -> np.ndarray:
@@ -100,13 +140,14 @@ class Request:
 
 
 class _Slot:
-    __slots__ = ("req", "pages", "pending", "stalled")
+    __slots__ = ("req", "pages", "pending", "stalled", "admit_seq")
 
-    def __init__(self, req, pages, pending):
+    def __init__(self, req, pages, pending, admit_seq=0):
         self.req = req
         self.pages = pages             # list of physical page ids, in order
         self.pending = pending         # last sampled token, not yet in cache
         self.stalled = False
+        self.admit_seq = admit_seq     # monotonically increasing admit order
 
 
 class ServingEngine:
@@ -123,7 +164,7 @@ class ServingEngine:
                  max_pages_per_seq: int | None = None, dtype=None,
                  attention_impl: str = "auto", interpret: bool = False,
                  prompt_bucket: int = 32, decode_horizon: int = 8,
-                 seed: int = 0):
+                 seed: int = 0, max_queue: int | None = None):
         import jax
         import jax.numpy as jnp
         from ..models.llama import (build_llama_paged_decode,
@@ -215,12 +256,24 @@ class ServingEngine:
         self._finished: dict[int, Request] = {}
         self._next_rid = 0
         self._key = jax.random.PRNGKey(seed)
+        self.max_queue = None if max_queue is None else int(max_queue)
+        self._admit_seq = 0
+        self._pressure = False         # this-step injected pool pressure
         self.steps_run = 0
         self.tokens_generated = 0
+        self.preemptions = 0           # victim evictions (self-healing)
+        self.timeouts = 0              # deadline retirements
+        self.rejections = 0            # AdmissionRejected count
 
     # -- submission --------------------------------------------------------
     def submit(self, prompt, max_new_tokens: int = 32, temperature: float = 0.0,
-               top_p: float = 1.0, eos_token_id: int | None = None) -> int:
+               top_p: float = 1.0, eos_token_id: int | None = None,
+               timeout: float | None = None) -> int:
+        """Queue one request.  Raises `PoolCapacityError` for requests that
+        can NEVER fit the pool geometry, `AdmissionRejected` when the bounded
+        queue is full (backpressure), plain ValueError for malformed input.
+        `timeout` (seconds from now) retires the request — wherever it is —
+        once overdue, with `Request.timed_out` set."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if len(prompt) < 1:
             raise ValueError("prompt must hold at least one token")
@@ -236,18 +289,28 @@ class ServingEngine:
         # written); it must fit this request's page-table row
         need = math.ceil((total - 1) / self.page_size)
         if need > self.max_pages_per_seq:
-            raise ValueError(
+            raise PoolCapacityError(
                 f"request needs {need} pages > "
-                f"max_pages_per_seq={self.max_pages_per_seq}")
+                f"max_pages_per_seq={self.max_pages_per_seq} "
+                f"(prompt {len(prompt)} + max_new_tokens {max_new_tokens})")
         if need > self.pool.num_pages:
-            raise ValueError(
+            raise PoolCapacityError(
                 f"request needs {need} pages but the pool only has "
-                f"{self.pool.num_pages} — raise num_pages")
+                f"{self.pool.num_pages} ({self.pool.num_free} free) — raise "
+                f"num_pages")
+        if self.max_queue is not None and len(self._queue) >= self.max_queue:
+            self.rejections += 1
+            raise AdmissionRejected(
+                f"admission queue full ({len(self._queue)}/{self.max_queue} "
+                f"waiting, {self.num_active} active) — backpressure, retry "
+                f"later")
         rid = self._next_rid
         self._next_rid += 1
+        now = time.perf_counter()
         req = Request(rid=rid, prompt=prompt, max_new_tokens=int(max_new_tokens),
                       temperature=float(temperature), top_p=float(top_p),
-                      eos_token_id=eos_token_id, submit_time=time.perf_counter())
+                      eos_token_id=eos_token_id, submit_time=now,
+                      deadline=None if timeout is None else now + float(timeout))
         self._queue.append(req)
         return rid
 
@@ -256,14 +319,62 @@ class ServingEngine:
         self._key, sub = self._jax.random.split(self._key)
         return sub
 
-    def _finish(self, s: int):
+    def _avail(self) -> int:
+        """Free pages as THIS step sees them: zero while an injected
+        `serve.pool_pressure` window is active (exhaustion drills)."""
+        return 0 if self._pressure else self.pool.num_free
+
+    def _release_slot(self, s: int):
         slot = self._slots[s]
-        slot.req.finish_time = time.perf_counter()
         self.pool.free(slot.pages)
-        self._finished[slot.req.rid] = slot.req
         self._slots[s] = None
         self._page_tables[s] = 0
         self._lengths[s] = 0
+        return slot
+
+    def _finish(self, s: int):
+        slot = self._release_slot(s)
+        slot.req.finish_time = time.perf_counter()
+        self._finished[slot.req.rid] = slot.req
+
+    def _preempt(self, s: int):
+        """Victim preemption: return the slot's pages and requeue the request
+        at the queue head; re-admission re-prefills prompt + already-emitted
+        tokens, so greedy decoding resumes step-exact."""
+        slot = self._release_slot(s)
+        slot.req.preemptions += 1
+        self.preemptions += 1
+        self._queue.appendleft(slot.req)
+
+    def _pick_victim(self) -> int:
+        """Youngest / lowest-progress victim: fewest emitted tokens, ties
+        broken toward the most recent admission (least invested work)."""
+        return min((s for s, sl in enumerate(self._slots) if sl is not None),
+                   key=lambda s: (len(self._slots[s].req.generated),
+                                  -self._slots[s].admit_seq))
+
+    def _retire_overdue(self):
+        """Deadline enforcement: retire overdue requests wherever they live
+        (running slot or admission queue), marking them timed_out."""
+        now = time.perf_counter()
+        for s, slot in enumerate(self._slots):
+            if slot is not None and slot.req.deadline is not None \
+                    and now > slot.req.deadline:
+                slot.req.timed_out = True
+                self.timeouts += 1
+                self._finish(s)
+        if any(r.deadline is not None and now > r.deadline
+               for r in self._queue):
+            keep: deque[Request] = deque()
+            for req in self._queue:
+                if req.deadline is not None and now > req.deadline:
+                    req.timed_out = True
+                    req.finish_time = now
+                    self.timeouts += 1
+                    self._finished[req.rid] = req
+                else:
+                    keep.append(req)
+            self._queue = keep
 
     def _record_token(self, s: int, tok: int) -> bool:
         """Append a sampled token; returns True when the request finished."""
@@ -286,9 +397,15 @@ class ServingEngine:
             if not free_slots:
                 return
             req = self._queue[0]
-            T = len(req.prompt)
+            # resume path (preempted request): the cache must hold prompt +
+            # all emitted tokens except the last, which becomes the pending
+            # token — exactly the state the victim was evicted in
+            resuming = len(req.generated) > 0
+            ctx = req.prompt if not resuming else np.concatenate(
+                [req.prompt, np.asarray(req.generated[:-1], np.int32)])
+            T = len(ctx)
             n_pages = max(1, math.ceil(T / self.page_size))
-            if n_pages > self.pool.num_free:
+            if n_pages > self._avail():
                 return                 # wait for retirements to free pages
             self._queue.popleft()
             s = free_slots[0]
@@ -302,7 +419,7 @@ class ServingEngine:
                      math.ceil(T / self.prompt_bucket) * self.prompt_bucket)
             Tb = min(Tb, self.config.max_position_embeddings)
             ids = np.zeros((1, Tb), np.int32)
-            ids[0, :T] = req.prompt
+            ids[0, :T] = ctx
             greedy = req.temperature <= 0.0
             pf = self._prefill_jit.get((Tb, greedy))
             if pf is None:
@@ -317,12 +434,18 @@ class ServingEngine:
                 jnp.asarray(row), self._pages_k, self._pages_v,
                 self._split_key(), jnp.asarray(req.temperature, jnp.float32),
                 jnp.asarray(req.top_p, jnp.float32))
-            self._slots[s] = _Slot(req, pages, 0)
+            self._slots[s] = _Slot(req, pages, 0, admit_seq=self._admit_seq)
+            self._admit_seq += 1
             self._page_tables[s] = row
             self._lengths[s] = T
             self._temps[s] = req.temperature
             self._top_ps[s] = req.top_p
-            self._record_token(s, int(np.asarray(tok)))
+            if resuming:
+                # the re-prefill rebuilt the cache; the last emitted token is
+                # still the pending one — discard the redundant sample
+                self._slots[s].pending = int(req.generated[-1])
+            else:
+                self._record_token(s, int(np.asarray(tok)))
 
     def _remaining(self, s: int) -> int:
         req = self._slots[s].req
@@ -342,7 +465,7 @@ class ServingEngine:
             need = math.ceil((int(self._lengths[s]) + m) / self.page_size)
             grow = need - len(slot.pages)
             if grow > 0:
-                if grow > self.pool.num_free:
+                if grow > self._avail():
                     slot.stalled = True
                     continue
                 pages = self.pool.alloc(grow)
@@ -366,11 +489,20 @@ class ServingEngine:
     def num_active(self) -> int:
         return sum(1 for sl in self._slots if sl is not None)
 
-    def step(self):
-        """One engine step: admit queued requests into free slots, provision
-        pages for the decode horizon, run the jitted K-step decode, record
-        sampled tokens, retire finished requests."""
+    def step(self) -> bool:
+        """One engine step: retire overdue requests, admit queued requests
+        into free slots, provision pages for the decode horizon, run the
+        jitted K-step decode, record sampled tokens, retire finished
+        requests.  Returns True when any slot made progress.
+
+        When nobody can progress — the former hard-deadlock RuntimeError —
+        the engine self-heals by preempting victims (pages back to the pool,
+        request requeued for re-prefill) until a slot can run; under a fully
+        injected pool-pressure window it parks and reports no progress."""
         jnp = self._jnp
+        self._pressure = fault_point("serve.pool_pressure",
+                                     step=self.steps_run) is not None
+        self._retire_overdue()
         self._admit()
         K = self.decode_horizon
         run = self._provision(K)
@@ -379,18 +511,20 @@ class ServingEngine:
             # single-step pacing so retirements can still free pages
             K = 1
             run = self._provision(1)
+        # self-healing: evict ONE victim per no-progress step.  Freed pages
+        # go to the stalled SURVIVORS (no re-admission here — the victim at
+        # the queue head would immediately steal its own pages back and
+        # livelock).  One eviction always suffices for a real deadlock: a
+        # stalled slot's single-step growth need is <= 1 page and any victim
+        # frees >= 1, so a survivor runs; when it doesn't (an injected
+        # pool-pressure window hides every page), per-step budgeting bounds
+        # the wasted re-prefills to one victim per stalled step.
+        if not run and self.num_active > 0:
+            self._preempt(self._pick_victim())
+            K = 1
+            run = self._provision(1)
         if not run:
-            if self._queue or self.num_active:
-                # every active slot stalled on an empty pool (or nothing
-                # running and the queue head cannot be admitted): pages only
-                # free through retirement, which needs a step — fail loudly
-                # instead of spinning
-                raise RuntimeError(
-                    "ServingEngine deadlock: no slot can make progress "
-                    f"({self.num_active} active, {len(self._queue)} queued, "
-                    f"{self.pool.num_free} pages free of "
-                    f"{self.pool.num_pages}) — size the pool larger")
-            return
+            return False               # pool-pressure window or nothing to do
         S = self.num_slots
         active = np.zeros((S,), bool)
         active[run] = True
@@ -418,13 +552,30 @@ class ServingEngine:
             for tok in out[s]:
                 if self._record_token(s, int(tok)):
                     break
+        return True
 
-    def run(self, max_steps: int | None = None):
+    def run(self, max_steps: int | None = None,
+            max_stall_steps: int = 1000):
         """Drive until every submitted request finished; returns
-        {rid: Request} (each with .generated / .output_ids filled)."""
+        {rid: Request} (each with .generated / .output_ids filled).
+
+        Consecutive no-progress steps (possible only while an injected
+        pool-pressure window hides every page) are bounded by
+        `max_stall_steps`; exceeding it raises `EngineStalledError` — the
+        pool-sizing deadlock itself is resolved by preemption and can no
+        longer raise."""
         steps = 0
+        stalled = 0
         while self._queue or self.num_active:
-            self.step()
+            progressed = self.step()
+            stalled = 0 if progressed else stalled + 1
+            if stalled >= max_stall_steps:
+                raise EngineStalledError(
+                    f"no engine progress for {stalled} consecutive steps "
+                    f"({self.num_active} active, {len(self._queue)} queued, "
+                    f"{self.pool.num_free} pages free of "
+                    f"{self.pool.num_pages}) — a fault window that never "
+                    f"clears?")
             steps += 1
             if max_steps is not None and steps >= max_steps:
                 break
@@ -435,7 +586,8 @@ def serve_requests(params, config, prompts, **kw):
     """One-shot convenience: submit every (prompt, request-kwargs) pair and
     run to completion.  `prompts` is a list of token arrays or
     (token_array, {request kwargs}) tuples; engine kwargs ride **kw."""
-    req_kw_keys = ("max_new_tokens", "temperature", "top_p", "eos_token_id")
+    req_kw_keys = ("max_new_tokens", "temperature", "top_p", "eos_token_id",
+                   "timeout")
     default_req = {k: kw.pop(k) for k in req_kw_keys if k in kw}
     eng = ServingEngine(params, config, **kw)
     rids = []

@@ -25,11 +25,24 @@ __all__ = ["TrainStep", "compile_train_step"]
 class TrainStep:
     """Holds functional state (params, buffers, opt state) and a compiled
     step(batch) -> loss. Mutates the Layer's tensors only on `sync_to_model`.
+
+    Non-finite sentinel (`nonfinite_guard=M`): every step checks loss AND
+    every gradient for NaN/Inf inside the jitted step; a bad step is
+    skipped — params/opt-state/buffers keep their previous values — and
+    counted, raising FloatingPointError only after M CONSECUTIVE bad steps
+    (one preempted reduction or a loss-scale spike must not kill a
+    multi-day job; a persistently diverged one must).  An attached
+    GradScaler gets its dynamic-loss-scale backoff driven on every skipped
+    step.  The guard reads the good/bad flag to the host each step, so it
+    costs one device sync — leave it off (None) for pure-throughput loops.
+    The `train.nonfinite` fault point (resilience/faults.py) poisons a
+    step's loss+grads with NaN on demand, so the skip path is testable.
     """
 
     def __init__(self, model: Layer, opt: Optimizer, loss_fn: Callable,
                  donate: bool = True, in_shardings=None, with_amp=False,
-                 amp_dtype="bfloat16", grad_accum: int = 1):
+                 amp_dtype="bfloat16", grad_accum: int = 1,
+                 nonfinite_guard: Optional[int] = None, scaler=None):
         self.model = model
         self.opt = opt
         self.loss_fn = loss_fn
@@ -38,6 +51,15 @@ class TrainStep:
         if grad_accum < 1:
             raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
         self.grad_accum = int(grad_accum)
+        if nonfinite_guard is not None and nonfinite_guard < 1:
+            raise ValueError(
+                f"nonfinite_guard must be >= 1, got {nonfinite_guard}")
+        self.nonfinite_guard = nonfinite_guard
+        self.scaler = scaler
+        self.step_count = 0
+        self.skipped_steps = 0
+        self.consecutive_bad = 0
+        self.last_step_good = True
         self.params = {n: p._value for n, p in model.named_parameters()
                        if not p.stop_gradient}
         self._lr_scales = {
@@ -54,8 +76,12 @@ class TrainStep:
         donate_args = (0, 1, 2) if donate else ()
         self._step = jax.jit(self._step_impl, donate_argnums=donate_args)
 
-    # pure: (params, opt_state, buffers, rng, lr, *batch) -> (loss, ...)
-    def _step_impl(self, params, opt_state, buffers, rng, lr, *batch):
+    # pure: (params, opt_state, buffers, rng, lr, poison, *batch) ->
+    # (params', opt', buffers', loss, good).  `poison` is 0.0 normally and
+    # NaN when the train.nonfinite fault point fires — adding it to loss and
+    # grads is the identity for 0.0 and a full poisoning for NaN, keeping
+    # the executable identical either way.
+    def _step_impl(self, params, opt_state, buffers, rng, lr, poison, *batch):
         if self.grad_accum == 1:
             (loss_v, new_buffers), grads = jax.value_and_grad(
                 lambda p: self._loss_with(p, buffers, rng, batch),
@@ -93,9 +119,27 @@ class TrainStep:
                 (rngs, *micro))
             loss_v = loss_sum / a
             grads = jax.tree_util.tree_map(lambda g: g / a, gsum)
+        loss_v = loss_v + poison
+        grads = jax.tree_util.tree_map(lambda g: g + poison, grads)
         new_params, new_opt = self.opt.apply_gradients_functional(
             params, grads, opt_state, lr=lr, lr_scales=self._lr_scales or None)
-        return new_params, new_opt, new_buffers, loss_v
+        if self.nonfinite_guard is not None:
+            # the full-gradient finiteness reduction and the skip selects
+            # exist ONLY under the guard — the pure-throughput default pays
+            # nothing.  Skip-and-count: a bad step must leave params /
+            # opt-state / buffers untouched (NaN moments would otherwise
+            # poison every later step).
+            good = jnp.isfinite(loss_v)
+            for g in jax.tree_util.tree_leaves(grads):
+                good = good & jnp.all(jnp.isfinite(g))
+            keep = lambda new, old: jax.tree_util.tree_map(  # noqa: E731
+                lambda a_, b_: jnp.where(good, a_, b_), new, old)
+            new_params = keep(new_params, params)
+            new_opt = keep(new_opt, opt_state)
+            new_buffers = keep(new_buffers, buffers)
+        else:
+            good = jnp.bool_(True)     # constant: free for XLA
+        return new_params, new_opt, new_buffers, loss_v, good
 
     def _loss_with(self, params, buffers, rng, batch):
         """Single-microbatch loss; shared by the plain and grad-accum paths."""
@@ -119,12 +163,41 @@ class TrainStep:
         return lv, new_buffers
 
     def __call__(self, *batch):
+        from ..resilience.faults import fault_point
         vals = [b._value if isinstance(b, Tensor) else jnp.asarray(b) for b in batch]
         self._rng, sub = jax.random.split(self._rng)
         lr = jnp.asarray(self.opt.get_lr(), jnp.float32)
-        self.params, self.opt_state, self.buffers, loss = self._step(
-            self.params, self.opt_state, self.buffers, sub, lr, *vals)
-        self.opt.finish_step()
+        poison = 0.0
+        if fault_point("train.nonfinite", step=self.step_count) is not None:
+            poison = float("nan")
+        self.params, self.opt_state, self.buffers, loss, good = self._step(
+            self.params, self.opt_state, self.buffers, sub, lr,
+            jnp.asarray(poison, jnp.float32), *vals)
+        self.step_count += 1
+        if self.nonfinite_guard is None:
+            self.opt.finish_step()
+        else:
+            self.last_step_good = bool(good)
+            if self.last_step_good:
+                # finish_step (LR-schedule tick / global step) only on REAL
+                # progress — a skipped step must leave schedule state
+                # untouched too, or warmup/decay drifts ahead of the params
+                self.opt.finish_step()
+                self.consecutive_bad = 0
+                if self.scaler is not None:
+                    self.scaler.update()   # good step: drive scale regrowth
+            else:
+                self.skipped_steps += 1
+                self.consecutive_bad += 1
+                if self.scaler is not None:
+                    self.scaler.notify_nonfinite()
+                if self.consecutive_bad >= self.nonfinite_guard:
+                    raise FloatingPointError(
+                        f"non-finite loss/gradients for "
+                        f"{self.consecutive_bad} consecutive steps (step "
+                        f"{self.step_count - 1}, {self.skipped_steps} skipped "
+                        f"total) — the run has diverged; restore a "
+                        f"checkpoint or lower the learning rate")
         return Tensor(loss)
 
     def sync_to_model(self):

@@ -17,6 +17,10 @@ import jax  # noqa: E402
 if os.environ.get("PADDLE_TPU_TEST_REAL") != "1":
     jax.config.update("jax_platforms", "cpu")
 
+import paddle_tpu  # noqa: E402,F401 — installs the jax-version compat
+# shims (jax.shard_map / lax.pcast / lax.axis_size) BEFORE any test module
+# does `from jax import shard_map` at collection time
+
 import pytest  # noqa: E402
 
 
@@ -25,3 +29,17 @@ def _reseed():
     import paddle_tpu
     paddle_tpu.seed(2024)
     yield
+
+
+@pytest.fixture(autouse=True)
+def _no_fault_plan_leak():
+    """A test that exits with a live FaultPlan (inject() scope not closed)
+    would silently corrupt every later test's behavior — fail it here,
+    after clearing the leak so only the culprit fails."""
+    yield
+    from paddle_tpu.resilience import faults
+    leaked = faults.active_plan() is not None
+    faults._ACTIVE.clear()
+    assert not leaked, (
+        "test leaked a live FaultPlan into the next test — close the "
+        "resilience.inject() scope")

@@ -61,6 +61,7 @@ def test_1f1b_matches_gpipe(lm_setup):
     np.testing.assert_allclose(got, lm_setup["gpipe"], rtol=2e-4, atol=1e-5)
 
 
+@pytest.mark.slow  # heavy compile; un-broken by the r7 shard_map shim but too slow for the tier-1 budget
 def test_interleaved_vpp_matches_gpipe(lm_setup):
     ep, bp, hp = lm_setup["params"]
     embed_mb, ba, head_mb = lm_setup["fns"]
@@ -72,6 +73,7 @@ def test_interleaved_vpp_matches_gpipe(lm_setup):
     np.testing.assert_allclose(got, lm_setup["gpipe"], rtol=2e-4, atol=1e-5)
 
 
+@pytest.mark.slow  # heavy compile; un-broken by the r7 shard_map shim but too slow for the tier-1 budget
 def test_1f1b_uses_less_activation_memory_than_gpipe():
     """The 1F1B bound: compiled temp bytes shrink vs GPipe at large
     n_micro (saved activations ~ schedule depth, not n_micro)."""

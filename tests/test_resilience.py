@@ -1,0 +1,617 @@
+"""Fault-tolerant runtime tests (ISSUE 2 tentpole): deterministic fault
+injection, crash-consistent checkpointing + exact resume, the train-step
+non-finite sentinel, watchdog tail verification, and the self-healing
+serving engine under injected page-pool pressure."""
+import os
+import time
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+import paddle_tpu.nn.functional as F
+from paddle_tpu.resilience import (FaultPlan, FaultSpec, InjectedFault,
+                                   inject, fault_point, active_plan,
+                                   CheckpointManager)
+from paddle_tpu.distributed.checkpoint import (save_state_dict,
+                                               load_state_dict,
+                                               wait_async_save,
+                                               verify_checkpoint,
+                                               CheckpointCorruptError)
+
+rng = np.random.default_rng(21)
+
+
+# ---------------------------------------------------------------------------
+# fault plan semantics
+# ---------------------------------------------------------------------------
+class TestFaultPlan:
+    def test_no_plan_is_noop(self):
+        assert active_plan() is None
+        assert fault_point("ckpt.write", file="x", offset=0) is None
+
+    def test_at_fires_exactly_once(self):
+        plan = FaultPlan({"p": dict(action="trigger", at=2)})
+        with inject(plan):
+            fired = [fault_point("p") is not None for _ in range(6)]
+        assert fired == [False, False, True, False, False, False]
+        assert plan.fired("p") == 1 and plan.hits("p") == 6
+
+    def test_after_count_window(self):
+        with inject({"p": dict(action="trigger", after=1, count=3)}) as plan:
+            fired = [fault_point("p") is not None for _ in range(6)]
+        assert fired == [False, True, True, True, False, False]
+        assert plan.fired() == 3
+
+    def test_match_filters_ctx(self):
+        with inject({"p": dict(action="trigger", match={"file": "a"},
+                               count=None)}) as plan:
+            assert fault_point("p", file="b") is None
+            assert fault_point("p", file="a") is not None
+        assert plan.hits() == 1  # non-matching consults don't count hits
+
+    def test_raise_action(self):
+        with inject({"p": dict(at=0)}):
+            with pytest.raises(InjectedFault, match="injected fault at 'p'"):
+                fault_point("p")
+
+    def test_seeded_prob_is_deterministic(self):
+        def fire_pattern(seed):
+            with inject({"p": dict(action="trigger", prob=0.5, count=None)},
+                        seed=seed):
+                return [fault_point("p") is not None for _ in range(32)]
+        a, b = fire_pattern(5), fire_pattern(5)
+        assert a == b and any(a) and not all(a)
+        assert fire_pattern(6) != a
+
+    def test_scoped_and_nested(self):
+        outer = FaultPlan({"p": dict(action="trigger", count=None)})
+        inner = FaultPlan()
+        with inject(outer):
+            assert fault_point("p") is not None
+            with inject(inner):
+                assert active_plan() is inner
+                assert fault_point("p") is None  # innermost plan wins
+            assert fault_point("p") is not None
+        assert active_plan() is None
+
+    def test_spec_validation(self):
+        with pytest.raises(ValueError, match="action"):
+            FaultSpec(point="p", action="explode")
+
+
+# ---------------------------------------------------------------------------
+# crash-consistent checkpointing
+# ---------------------------------------------------------------------------
+def _small_chunks(monkeypatch, nbytes=64):
+    import sys
+    # the package re-exports the function under the module's name, so fetch
+    # the module object itself from sys.modules
+    mod = sys.modules["paddle_tpu.distributed.checkpoint.save_state_dict"]
+    monkeypatch.setattr(mod, "WRITE_CHUNK", nbytes)
+
+
+class TestCrashConsistentCheckpoint:
+    def test_roundtrip_carries_manifest(self, tmp_path):
+        w = paddle.to_tensor(np.arange(16, dtype="float32").reshape(4, 4))
+        p = str(tmp_path / "ck")
+        save_state_dict({"w": w, "step": 3}, p)
+        man = verify_checkpoint(p)
+        assert "metadata.json" in man["files"] and "rank0.data" in man["files"]
+        t = paddle.to_tensor(np.zeros((4, 4), "float32"))
+        load_state_dict({"w": t}, p)
+        np.testing.assert_array_equal(t.numpy(),
+                                      np.arange(16).reshape(4, 4))
+
+    @pytest.mark.parametrize("chunk_at", [0, 1, 3])
+    def test_torn_write_never_commits(self, tmp_path, monkeypatch, chunk_at):
+        """A crash at ANY injected byte offset leaves no final dir at all —
+        only the .tmp staging dir a later save sweeps away."""
+        _small_chunks(monkeypatch)
+        w = paddle.to_tensor(rng.standard_normal((16, 16)).astype(np.float32))
+        p = str(tmp_path / "ck")
+        with pytest.raises(InjectedFault):
+            with inject({"ckpt.write": dict(match={"file": "rank0.data"},
+                                            at=chunk_at)}):
+                save_state_dict({"w": w}, p)
+        assert not os.path.exists(p)
+        assert os.path.exists(p + ".tmp")  # torn staging, never load-able
+        with pytest.raises(CheckpointCorruptError):
+            verify_checkpoint(p)
+
+    def test_kill_between_files_never_commits(self, tmp_path):
+        w = paddle.to_tensor(np.ones((4,), "float32"))
+        p = str(tmp_path / "ck")
+        with pytest.raises(InjectedFault):
+            with inject({"ckpt.write": dict(match={"file": "rank0.meta.json"},
+                                            at=0)}):
+                save_state_dict({"w": w}, p)
+        assert not os.path.exists(p)
+
+    def test_kill_before_commit_point(self, tmp_path):
+        """Fully staged + manifested, killed just before the rename: the
+        final dir must still not exist (the rename IS the commit point)."""
+        w = paddle.to_tensor(np.ones((4,), "float32"))
+        p = str(tmp_path / "ck")
+        with pytest.raises(InjectedFault):
+            with inject({"ckpt.commit": dict(at=0)}):
+                save_state_dict({"w": w}, p)
+        assert not os.path.exists(p)
+        # the staging dir itself is complete — and the retry commits it
+        save_state_dict({"w": w}, p)
+        verify_checkpoint(p)
+
+    def test_crash_between_commit_renames_recovers_previous(self, tmp_path):
+        """The narrowest window: old checkpoint renamed to .old, crash before
+        the staging rename.  The next touch (load or save) must restore the
+        stranded previous snapshot instead of losing it."""
+        p = str(tmp_path / "ck")
+        save_state_dict({"w": paddle.to_tensor(np.full((4,), 1.0,
+                                                       "float32"))}, p)
+        with pytest.raises(InjectedFault):
+            with inject({"ckpt.commit": dict(match={"phase": "swap"},
+                                             at=0)}):
+                save_state_dict(
+                    {"w": paddle.to_tensor(np.full((4,), 2.0, "float32"))}, p)
+        assert not os.path.exists(p) and os.path.isdir(p + ".old")
+        t = paddle.to_tensor(np.zeros((4,), "float32"))
+        load_state_dict({"w": t}, p)     # loader self-heals the commit
+        np.testing.assert_array_equal(t.numpy(), np.full((4,), 1.0))
+        assert os.path.isdir(p) and not os.path.exists(p + ".old")
+        # and a retried save from this state lands the new snapshot
+        save_state_dict({"w": paddle.to_tensor(np.full((4,), 2.0,
+                                                       "float32"))}, p)
+        load_state_dict({"w": t}, p)
+        np.testing.assert_array_equal(t.numpy(), np.full((4,), 2.0))
+
+    def test_crashed_overwrite_keeps_previous_checkpoint(self, tmp_path):
+        p = str(tmp_path / "ck")
+        save_state_dict({"w": paddle.to_tensor(np.full((4,), 1.0, "float32"))}, p)
+        with pytest.raises(InjectedFault):
+            with inject({"ckpt.write": dict(match={"file": "rank0.data"},
+                                            at=0)}):
+                save_state_dict(
+                    {"w": paddle.to_tensor(np.full((4,), 2.0, "float32"))}, p)
+        verify_checkpoint(p)  # previous snapshot intact
+        t = paddle.to_tensor(np.zeros((4,), "float32"))
+        load_state_dict({"w": t}, p)
+        np.testing.assert_array_equal(t.numpy(), np.full((4,), 1.0))
+
+    def test_bitflip_rejected_on_load(self, tmp_path):
+        p = str(tmp_path / "ck")
+        save_state_dict({"w": paddle.to_tensor(np.ones((64,), "float32"))}, p)
+        with open(os.path.join(p, "rank0.data"), "r+b") as f:
+            f.seek(12)
+            b = f.read(1)
+            f.seek(12)
+            f.write(bytes([b[0] ^ 0xFF]))
+        with pytest.raises(CheckpointCorruptError, match="sha256 mismatch"):
+            load_state_dict({"w": paddle.to_tensor(np.zeros((64,),
+                                                            "float32"))}, p)
+
+    def test_wait_async_save_reraises_writer_exception(self, tmp_path):
+        """Satellite: a failed async write must surface on join, not vanish."""
+        p = str(tmp_path / "ck")
+        with inject({"ckpt.write": dict(match={"file": "rank0.data"}, at=0)}):
+            save_state_dict({"w": paddle.to_tensor(np.ones((4,), "float32"))},
+                            p, async_save=True)
+            with pytest.raises(InjectedFault):
+                wait_async_save()
+        assert not os.path.exists(p)
+        wait_async_save()  # error queue drained; second wait is clean
+
+    def test_async_save_happy_path(self, tmp_path):
+        p = str(tmp_path / "ck")
+        save_state_dict({"w": paddle.to_tensor(np.full((8,), 7.0, "float32"))},
+                        p, async_save=True)
+        wait_async_save()
+        verify_checkpoint(p)
+        t = paddle.to_tensor(np.zeros((8,), "float32"))
+        load_state_dict({"w": t}, p)
+        np.testing.assert_array_equal(t.numpy(), np.full((8,), 7.0))
+
+
+# ---------------------------------------------------------------------------
+# CheckpointManager: rotation, discovery, exact resume
+# ---------------------------------------------------------------------------
+def _make_job(seed):
+    paddle.seed(seed)
+    net = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 4))
+    opt = optimizer.Adam(learning_rate=0.01, parameters=net.parameters())
+    return net, opt
+
+
+def _batch(i):
+    r = np.random.default_rng(1000 + i)
+    return (r.standard_normal((16, 8)).astype(np.float32),
+            r.integers(0, 4, (16,)).astype(np.int64))
+
+
+def _train(net, opt, lo, hi, mgr=None, every=4):
+    losses = []
+    for i in range(lo, hi):
+        x, y = _batch(i)
+        loss = F.cross_entropy(net(paddle.to_tensor(x)), paddle.to_tensor(y))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+        if mgr is not None and mgr.should_save(i + 1):
+            mgr.save(i + 1)
+    return losses
+
+
+class TestCheckpointManager:
+    def test_rotation_keeps_last_n(self, tmp_path):
+        net, opt = _make_job(1)
+        mgr = CheckpointManager(str(tmp_path), model=net, optimizer=opt,
+                                save_interval=1, keep_last=2)
+        for s in (1, 2, 3, 4, 5):
+            mgr.save(s)
+        assert sorted(os.listdir(tmp_path)) == ["step_00000004",
+                                                "step_00000005"]
+
+    def test_find_latest_skips_torn_and_corrupt(self, tmp_path, monkeypatch):
+        _small_chunks(monkeypatch)
+        net, opt = _make_job(2)
+        mgr = CheckpointManager(str(tmp_path), model=net, optimizer=opt,
+                                keep_last=None)
+        mgr.save(4)
+        with pytest.raises(InjectedFault):
+            with inject({"ckpt.write": dict(match={"file": "rank0.data"},
+                                            at=1)}):
+                mgr.save(8)  # killed mid-file: staging only, no final dir
+        latest = mgr.find_latest_complete()
+        assert latest is not None and latest.endswith("step_00000004")
+        # a committed snapshot corrupted afterwards is skipped too
+        mgr.save(12)
+        with open(os.path.join(str(tmp_path), "step_00000012",
+                               "rank0.data"), "r+b") as f:
+            f.seek(6)
+            f.write(b"\x00\x01\x02")
+        latest = mgr.find_latest_complete()
+        assert latest.endswith("step_00000004")
+        assert mgr.restore() == 4
+
+    def test_find_latest_heals_stranded_old_snapshot(self, tmp_path):
+        """A crash in the commit swap window leaves the newest snapshot at
+        step_N.old; discovery must heal it back, not resume from older."""
+        net, opt = _make_job(3)
+        mgr = CheckpointManager(str(tmp_path), model=net, optimizer=opt,
+                                keep_last=None)
+        mgr.save(4)
+        mgr.save(8)
+        os.rename(os.path.join(str(tmp_path), "step_00000008"),
+                  os.path.join(str(tmp_path), "step_00000008.old"))
+        latest = mgr.find_latest_complete()
+        assert latest is not None and latest.endswith("step_00000008")
+        assert not os.path.exists(
+            os.path.join(str(tmp_path), "step_00000008.old"))
+
+    def test_resume_is_bit_identical(self, tmp_path):
+        """Acceptance: resume from a snapshot reproduces the uninterrupted
+        run's loss trajectory EXACTLY (same floats, not allclose)."""
+        net, opt = _make_job(7)
+        mgr = CheckpointManager(str(tmp_path), model=net, optimizer=opt,
+                                save_interval=4, keep_last=3)
+        ref = _train(net, opt, 0, 12, mgr)
+        # different seed: every weight/moment differs until restore overrides
+        net2, opt2 = _make_job(99)
+        mgr2 = CheckpointManager(str(tmp_path), model=net2, optimizer=opt2)
+        step = mgr2.restore(os.path.join(str(tmp_path), "step_00000008"))
+        assert step == 8
+        resumed = _train(net2, opt2, 8, 12)
+        assert resumed == ref[8:12]
+
+    def test_resume_after_killed_save_matches_uninterrupted(self, tmp_path,
+                                                            monkeypatch):
+        """Acceptance: kill the step-8 save mid-file; find_latest_complete()
+        lands on step 4 and the resumed trajectory is bit-identical to the
+        uninterrupted run from there."""
+        _small_chunks(monkeypatch)
+        net, opt = _make_job(7)
+        mgr = CheckpointManager(str(tmp_path / "a"), model=net, optimizer=opt,
+                                save_interval=4)
+        ref = _train(net, opt, 0, 12, mgr)
+
+        netc, optc = _make_job(7)
+        mgrc = CheckpointManager(str(tmp_path / "c"), model=netc,
+                                 optimizer=optc, save_interval=4)
+        _train(netc, optc, 0, 6, mgrc)           # step-4 save lands clean
+        with pytest.raises(InjectedFault):
+            with inject({"ckpt.write": dict(match={"file": "rank0.data"},
+                                            at=2)}):
+                _train(netc, optc, 6, 12, mgrc)  # dies saving at step 8
+        netr, optr = _make_job(5)
+        mgrr = CheckpointManager(str(tmp_path / "c"), model=netr,
+                                 optimizer=optr)
+        latest = mgrr.find_latest_complete()
+        assert latest.endswith("step_00000004")
+        assert mgrr.restore() == 4
+        resumed = _train(netr, optr, 4, 12)
+        assert resumed == ref[4:12]
+
+    def test_rng_scheduler_scaler_and_extra_roundtrip(self, tmp_path):
+        from paddle_tpu.optimizer.lr import StepDecay
+        sched = StepDecay(learning_rate=0.1, step_size=3)
+        scaler = paddle.amp.GradScaler(enable=True, init_loss_scaling=2048.0)
+        mgr = CheckpointManager(str(tmp_path), lr_scheduler=sched,
+                                scaler=scaler)
+        paddle.seed(77)
+        for _ in range(5):
+            sched.step()
+        scaler._scale = 512.0
+        draws_before = paddle.get_rng_state()[0]
+        mgr.save(5, extra_state={"tokens_seen": 12345})
+        # perturb everything
+        for _ in range(4):
+            sched.step()
+        scaler._scale = 1.0
+        paddle.seed(0)
+        assert mgr.restore() == 5
+        assert sched.last_epoch == 5 and scaler._scale == 512.0
+        assert mgr.last_extra == {"tokens_seen": 12345}
+        np.testing.assert_array_equal(np.asarray(paddle.get_rng_state()[0]),
+                                      np.asarray(draws_before))
+
+    def test_empty_root_restores_none(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        assert mgr.find_latest_complete() is None
+        assert mgr.restore() is None
+
+
+# ---------------------------------------------------------------------------
+# train-step non-finite sentinel
+# ---------------------------------------------------------------------------
+class TestTrainStepSentinel:
+    def _ts(self, guard=3, scaler=None):
+        from paddle_tpu.parallel.train_step import compile_train_step
+        paddle.seed(13)
+        net = nn.Linear(8, 4)
+        opt = optimizer.Adam(learning_rate=0.01, parameters=net.parameters())
+        ts = compile_train_step(net, opt, lambda m, x: m(x).mean(),
+                                nonfinite_guard=guard, scaler=scaler)
+        x = np.random.default_rng(0).standard_normal((4, 8)).astype(np.float32)
+        return ts, x
+
+    def test_bad_step_skipped_params_frozen(self):
+        ts, x = self._ts()
+        with inject({"train.nonfinite": dict(action="trigger", at=2)}):
+            for i in range(5):
+                before = {k: np.asarray(v) for k, v in ts.params.items()}
+                lv = float(ts(x).numpy())
+                if i == 2:
+                    assert np.isnan(lv) and not ts.last_step_good
+                    after = {k: np.asarray(v) for k, v in ts.params.items()}
+                    for k in before:
+                        np.testing.assert_array_equal(before[k], after[k])
+                else:
+                    assert np.isfinite(lv) and ts.last_step_good
+        assert ts.skipped_steps == 1 and ts.consecutive_bad == 0
+        # the skipped step must not tick the LR schedule / global step either
+        assert ts.opt._global_step == 4
+
+    def test_raises_after_m_consecutive(self):
+        ts, x = self._ts(guard=3)
+        with inject({"train.nonfinite": dict(action="trigger", after=0,
+                                             count=None)}):
+            with pytest.raises(FloatingPointError, match="3 consecutive"):
+                for _ in range(10):
+                    ts(x)
+        assert ts.skipped_steps == 3
+
+    def test_intermittent_never_raises(self):
+        ts, x = self._ts(guard=2)
+        # bad steps 1 and 3 — never two in a row
+        with inject([FaultSpec("train.nonfinite", action="trigger", at=1),
+                     FaultSpec("train.nonfinite", action="trigger", at=3)]):
+            for _ in range(6):
+                ts(x)
+        assert ts.skipped_steps == 2 and ts.consecutive_bad == 0
+
+    def test_scaler_backoff_on_skip(self):
+        scaler = paddle.amp.GradScaler(enable=True, init_loss_scaling=1024.0,
+                                       decr_every_n_nan_or_inf=1)
+        ts, x = self._ts(scaler=scaler)
+        with inject({"train.nonfinite": dict(action="trigger", at=1)}):
+            for _ in range(3):
+                ts(x)
+        assert scaler._scale == 512.0  # one bad step halved the loss scale
+
+
+# ---------------------------------------------------------------------------
+# watchdog: tail verification (satellite)
+# ---------------------------------------------------------------------------
+class TestWatchdogTail:
+    def test_timeout_does_not_mask_later_nan(self):
+        from paddle_tpu.distributed.communication.watchdog import (
+            CommTaskManager, CommAggregateError)
+        paddle.set_flags({"check_comm_nan": True})
+        try:
+            m = CommTaskManager(default_timeout=5.0)
+            m.track("op_a", jnp.ones((4,)))
+            m.track("op_b", jnp.asarray([1.0, np.nan]))
+            with inject({"comm.ready": dict(action="trigger",
+                                            match={"op": "op_a"})}):
+                with pytest.raises(CommAggregateError) as ei:
+                    m.wait_all(timeout=5.0)
+            failed = [n for n, _ in ei.value.errors]
+            assert failed == ["op_a", "op_b"]  # the tail WAS checked
+            assert "op_b" in str(ei.value) and "op_a" in str(ei.value)
+            assert m.pending() == 0
+        finally:
+            paddle.set_flags({"check_comm_nan": False})
+
+    def test_single_failure_reraises_original_type(self):
+        from paddle_tpu.distributed.communication.watchdog import (
+            CommTaskManager, CommTimeoutError)
+        m = CommTaskManager(default_timeout=5.0)
+        m.track("solo", jnp.ones((2,)))
+        with inject({"comm.ready": dict(action="trigger")}):
+            with pytest.raises(CommTimeoutError, match="injected delayed"):
+                m.wait_all(timeout=5.0)
+
+
+# ---------------------------------------------------------------------------
+# self-healing serving engine
+# ---------------------------------------------------------------------------
+from paddle_tpu.models.llama import (llama_config_tiny,  # noqa: E402
+                                     build_functional_llama, llama_generate)
+from paddle_tpu.inference.paged import (PagePool, ServingEngine,  # noqa: E402
+                                        PoolCapacityError, AdmissionRejected)
+
+
+def _llama(seed=1):
+    cfg = llama_config_tiny(vocab=64, hidden=32, layers=2, heads=4, seq=64)
+    ep, bp, hp, *_ = build_functional_llama(cfg, key=jax.random.PRNGKey(seed))
+    return cfg, (ep, bp, hp)
+
+
+class TestServingResilience:
+    def test_pool_capacity_error_is_typed_and_counted(self):
+        cfg, params = _llama()
+        eng = ServingEngine(params, cfg, num_slots=2, page_size=4,
+                            num_pages=4, max_pages_per_seq=8,
+                            attention_impl="ref")
+        with pytest.raises(PoolCapacityError, match=r"needs 5 pages.*only "
+                                                    r"has 4"):
+            eng.submit(np.ones((12,), np.int32), max_new_tokens=8)
+        assert issubclass(PoolCapacityError, ValueError)  # old callers OK
+
+    def test_admission_rejected_backpressure(self):
+        cfg, params = _llama()
+        eng = ServingEngine(params, cfg, num_slots=1, page_size=8,
+                            num_pages=8, attention_impl="ref", max_queue=2)
+        p = rng.integers(1, 64, (4,)).astype(np.int32)
+        eng.submit(p, max_new_tokens=4)
+        eng.submit(p, max_new_tokens=4)
+        with pytest.raises(AdmissionRejected, match="queue full"):
+            eng.submit(p, max_new_tokens=4)
+        assert eng.rejections == 1
+        done = eng.run()          # the admitted two still complete
+        assert len(done) == 2
+
+    def test_deadline_retires_queued_and_running(self):
+        cfg, params = _llama(seed=3)
+        eng = ServingEngine(params, cfg, num_slots=2, page_size=8,
+                            num_pages=24, attention_impl="ref",
+                            prompt_bucket=8, decode_horizon=2)
+        p = rng.integers(1, 64, (5,)).astype(np.int32)
+        r_dead = eng.submit(p, max_new_tokens=6, timeout=0.0)  # born overdue
+        r_ok = eng.submit(p, max_new_tokens=6)
+        eng.step()
+        done = eng.run()
+        assert done[r_dead].timed_out and done[r_dead].generated == []
+        assert not done[r_ok].timed_out
+        ref = np.asarray(llama_generate(params, cfg, p[None],
+                                        max_new_tokens=6))[0]
+        np.testing.assert_array_equal(done[r_ok].output_ids, ref)
+        # mid-flight deadline: admitted, then the clock runs out
+        r_mid = eng.submit(p, max_new_tokens=32)
+        eng.step()
+        req = next(sl.req for sl in eng._slots if sl is not None)
+        req.deadline = time.perf_counter() - 1.0
+        done = eng.run()
+        assert done[r_mid].timed_out and len(done[r_mid].generated) > 0
+        assert eng.pool.num_free == eng.pool.num_pages
+        assert eng.timeouts == 2
+
+    def test_injected_pool_pressure_completes_all_exactly(self):
+        """Acceptance: under injected page-pool exhaustion every request
+        completes via preemption + re-prefill, greedy outputs step-exact vs
+        the unpreempted baseline, and the old deadlock raise is gone."""
+        cfg, params = _llama(seed=5)
+        eng = ServingEngine(params, cfg, num_slots=2, page_size=2,
+                            num_pages=40, max_pages_per_seq=16,
+                            attention_impl="ref", prompt_bucket=8,
+                            decode_horizon=2)
+        prompts = [rng.integers(1, 64, (t,)).astype(np.int32)
+                   for t in (5, 7, 3)]
+        with inject({"serve.pool_pressure": dict(action="trigger", after=1,
+                                                 count=3)}) as plan:
+            rids = [eng.submit(p, max_new_tokens=8) for p in prompts]
+            done = eng.run()
+        assert plan.fired("serve.pool_pressure") == 3
+        assert len(done) == len(prompts)           # 100% completion
+        assert eng.preemptions >= 1                # healed, not deadlocked
+        for rid, p in zip(rids, prompts):
+            ref = np.asarray(llama_generate(params, cfg, p[None],
+                                            max_new_tokens=8))[0]
+            np.testing.assert_array_equal(done[rid].output_ids, ref)
+        assert eng.pool.num_free == eng.pool.num_pages
+
+    def test_pagepool_alloc_fault_point(self):
+        pool = PagePool(8, 16)
+        with inject({"pagepool.alloc": dict(action="trigger", at=1)}):
+            pool.alloc(2)
+            with pytest.raises(RuntimeError, match=r"exhausted \(injected\)"):
+                pool.alloc(2)
+            a = pool.alloc(2)      # window over: allocation works again
+        assert pool.num_allocated == 4
+        pool.free(a)
+
+
+# ---------------------------------------------------------------------------
+# chaos sweeps (slow: randomized seeds, excluded from tier-1)
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+class TestChaosSweeps:
+    def test_checkpoint_chaos(self, tmp_path, monkeypatch):
+        """Random kill offsets across repeated save/kill/resume cycles: the
+        latest complete snapshot must always load and always reproduce the
+        uninterrupted trajectory."""
+        _small_chunks(monkeypatch)
+        net, opt = _make_job(7)
+        mgr = CheckpointManager(str(tmp_path / "ref"), model=net,
+                                optimizer=opt, save_interval=2)
+        ref = _train(net, opt, 0, 10, mgr)
+        for seed in range(4):
+            r = np.random.default_rng(seed)
+            netc, optc = _make_job(7)
+            root = str(tmp_path / f"chaos{seed}")
+            mgrc = CheckpointManager(root, model=netc, optimizer=optc,
+                                     save_interval=2)
+            target = ["rank0.data", "rank0.meta.json", "metadata.json",
+                      "manifest.json"][r.integers(4)]
+            spec = {"ckpt.write": dict(match={"file": target},
+                                       at=int(r.integers(0, 4)),
+                                       after=int(r.integers(0, 3)))}
+            try:
+                with inject(spec, seed=seed):
+                    _train(netc, optc, 0, 10, mgrc)
+            except InjectedFault:
+                pass
+            netr, optr = _make_job(3)
+            mgrr = CheckpointManager(root, model=netr, optimizer=optr)
+            latest = mgrr.find_latest_complete()
+            if latest is None:
+                continue  # killed the very first save — nothing to resume
+            step = mgrr.restore()
+            resumed = _train(netr, optr, step, 10)
+            assert resumed == ref[step:10], f"seed {seed} diverged"
+
+    def test_serving_chaos(self):
+        """Randomized pool-pressure windows: completion and greedy exactness
+        must hold for every seed."""
+        cfg, params = _llama(seed=9)
+        prompts = [rng.integers(1, 64, (t,)).astype(np.int32)
+                   for t in (4, 9, 6)]
+        refs = [np.asarray(llama_generate(params, cfg, p[None],
+                                          max_new_tokens=6))[0]
+                for p in prompts]
+        for seed in range(4):
+            eng = ServingEngine(params, cfg, num_slots=2, page_size=2,
+                                num_pages=40, max_pages_per_seq=16,
+                                attention_impl="ref", prompt_bucket=8,
+                                decode_horizon=2)
+            with inject({"serve.pool_pressure": dict(
+                    action="trigger", prob=0.4, count=6)}, seed=seed):
+                rids = [eng.submit(p, max_new_tokens=6) for p in prompts]
+                done = eng.run()
+            assert len(done) == len(prompts)
+            for rid, ref in zip(rids, refs):
+                np.testing.assert_array_equal(done[rid].output_ids, ref)
+            assert eng.pool.num_free == eng.pool.num_pages

@@ -123,6 +123,7 @@ def test_ring_flash_attention_matches_dense(causal, hkv):
     np.testing.assert_allclose(out, ref, rtol=2e-3, atol=2e-3)
 
 
+@pytest.mark.slow  # heavy compile; un-broken by the r7 shard_map shim but too slow for the tier-1 budget
 def test_ring_flash_attention_backward_matches_dense():
     from paddle_tpu.parallel.ring_attention import ring_flash_attention
     if jax.device_count() < 4:

@@ -58,6 +58,7 @@ def _run_steps(mesh_axes, mp_axis, n_steps=3, n_micro=4, seed=7):
     return losses, step
 
 
+@pytest.mark.slow  # heavy compile; un-broken by the r7 shard_map shim but too slow for the tier-1 budget
 def test_mp2_loss_matches_mp1():
     losses_ref, _ = _run_steps({"dp": 2, "pp": 2, "mp": 1}, mp_axis=None)
     losses_tp, _ = _run_steps({"dp": 2, "pp": 2, "mp": 2}, mp_axis="mp")
@@ -107,6 +108,7 @@ def test_mp_collectives_in_hlo():
         "expected mp-axis replica groups [[0,1],[2,3]] in lowered StableHLO"
 
 
+@pytest.mark.slow  # heavy compile; un-broken by the r7 shard_map shim but too slow for the tier-1 budget
 def test_mp2_with_vpp_chunks():
     # interleaved schedule (n_chunks=2) composes with tensor parallelism
     cfg = llama_config_tiny(vocab=64, hidden=32, layers=8, heads=4, seq=16)
