@@ -44,6 +44,15 @@ WRITE_CHUNK = 1 << 20
 _async_threads: list[threading.Thread] = []
 _async_errors: list[BaseException] = []
 
+# per-file SHA-256 recorded WHILE the bytes are written (_write_durable), so
+# the manifest never needs a second synchronous read pass over the staged
+# payload: {staging_dir: {basename: (hexdigest, size)}}.  Only fully written
+# files are recorded — a write torn by an injected ckpt.write fault leaves no
+# digest, and the manifest read-fallback (other ranks' files on a shared
+# filesystem, which this process never wrote) keeps multi-host saves correct.
+_staged_digests: dict[str, dict[str, tuple[str, int]]] = {}
+_digest_lock = threading.Lock()
+
 
 def _flat(state_dict, prefix=""):
     out = {}
@@ -86,14 +95,23 @@ def _fsync_dir(path):
 def _write_durable(fn, data: bytes):
     """Chunked write + fsync, consulting the ckpt.write fault point before
     every chunk — an injected 'raise' tears the file at that byte offset,
-    exactly like a preemption mid-write."""
+    exactly like a preemption mid-write.  The SHA-256 is folded in while
+    the chunks stream out and recorded ONLY once the file is complete, so
+    the commit-time manifest costs no second read pass over the payload."""
     base = os.path.basename(fn)
+    h = hashlib.sha256()
     with open(fn, "wb") as f:
         for off in range(0, len(data), WRITE_CHUNK) or (0,):
             fault_point("ckpt.write", file=base, offset=off)
-            f.write(data[off:off + WRITE_CHUNK])
+            chunk = data[off:off + WRITE_CHUNK]
+            f.write(chunk)
+            h.update(chunk)
         f.flush()
         os.fsync(f.fileno())
+    with _digest_lock:
+        _staged_digests.setdefault(
+            os.path.dirname(os.path.abspath(fn)), {})[base] = (
+                h.hexdigest(), len(data))
 
 
 def _sha256(fn):
@@ -106,14 +124,31 @@ def _sha256(fn):
 
 def _write_manifest(staging):
     """Per-file SHA-256 manifest over everything staged so far; written last,
-    so its presence certifies every other file landed completely."""
+    so its presence certifies every other file landed completely.
+
+    Digests come from the hash-while-writing record `_write_durable` kept
+    (no second read pass over the payload — the old synchronous re-read
+    doubled save-path IO); only files this process did NOT write (other
+    ranks' shards on a shared filesystem) fall back to reading."""
+    key = os.path.abspath(staging)
+    with _digest_lock:
+        recorded = dict(_staged_digests.get(key, {}))
     files = sorted(fn for fn in os.listdir(staging) if fn != "manifest.json")
-    man = {"version": 1, "files": {
-        fn: {"sha256": _sha256(os.path.join(staging, fn)),
-             "size": os.path.getsize(os.path.join(staging, fn))}
-        for fn in files}}
+    entries = {}
+    for fn in files:
+        full = os.path.join(staging, fn)
+        size = os.path.getsize(full)
+        rec = recorded.get(fn)
+        if rec is not None and rec[1] == size:
+            digest = rec[0]
+        else:                          # not written by this process
+            digest = _sha256(full)
+        entries[fn] = {"sha256": digest, "size": size}
+    man = {"version": 1, "files": entries}
     _write_durable(os.path.join(staging, "manifest.json"),
                    json.dumps(man).encode())
+    with _digest_lock:
+        _staged_digests.pop(key, None)
 
 
 def wait_async_save():
@@ -158,6 +193,8 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
         recover_interrupted_commit(path)
         for stale in (staging, path + ".old"):
             shutil.rmtree(stale, ignore_errors=True)
+        with _digest_lock:   # digests of a previous torn attempt are stale
+            _staged_digests.pop(os.path.abspath(staging), None)
     _barrier()  # nobody writes into staging before the stale sweep
     os.makedirs(staging, exist_ok=True)
     flat = _flat(state_dict)

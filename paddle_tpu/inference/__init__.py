@@ -11,12 +11,14 @@ from __future__ import annotations
 
 import numpy as np
 
-from .paged import (PagePool, Request, ServingEngine, serve_requests,
-                    PoolCapacityError, AdmissionRejected, EngineStalledError)
+from .paged import (PagePool, PrefixCache, Request, ServingEngine,
+                    serve_requests, PoolCapacityError, AdmissionRejected,
+                    EngineStalledError, PageDoubleFreeError)
 
 __all__ = ["Config", "Predictor", "create_predictor", "PredictorHandle",
-           "PagePool", "Request", "ServingEngine", "serve_requests",
-           "PoolCapacityError", "AdmissionRejected", "EngineStalledError"]
+           "PagePool", "PrefixCache", "Request", "ServingEngine",
+           "serve_requests", "PoolCapacityError", "AdmissionRejected",
+           "EngineStalledError", "PageDoubleFreeError"]
 
 
 class Config:

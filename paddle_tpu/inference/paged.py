@@ -7,42 +7,65 @@ stack's `block_multihead_attention` + fused blockwise KV cache; the TPU
 shape follows Ragged Paged Attention (arxiv 2604.15464) + vLLM-style
 continuous batching:
 
-  * `PagePool` — fixed-size page allocator over the shared KV page pool
-    (free-list alloc/free, double-free/foreign-free guarded).
+  * `PagePool` — fixed-size REFCOUNTED page allocator over the shared KV
+    page pool: `alloc` hands out pages at refcount 1, `share` lets the
+    same physical page appear in many page tables (prefix cache), `free`
+    decrements and only returns a page to the free list at refcount 0.
+    Double frees, foreign pages, and duplicate ids inside one `free()`
+    batch raise a typed `PageDoubleFreeError` BEFORE any state mutates.
+  * `PrefixCache` — automatic prefix caching: a block-hash index (SHA-256
+    of each page_size-aligned token block, chained on the parent block's
+    hash, radix-style) mapping prompt prefixes to cached KV pages.
+    Finished/preempted requests retire their pages INTO the cache instead
+    of freeing them; later admissions attach the longest cached prefix
+    read-only and prefill only the suffix.  A partially filled cached
+    page is copied before anyone writes into it (copy-on-write).
   * `ServingEngine` — a fixed set of decode SLOTS stepped by ONE jitted
     executable; between steps, finished requests retire (EOS / token
-    budget), their pages return to the pool, and queued requests are
+    budget), their pages go to the prefix cache, and queued requests are
     admitted into the freed slots (prefill + first-token sample), so new
-    traffic joins a RUNNING batch instead of waiting for the whole batch to
-    drain — the throughput win `bench.py serving` measures against the
-    static-batch `llama_generate_fused` baseline.
+    traffic joins a RUNNING batch instead of waiting for the whole batch
+    to drain — the throughput win `bench.py serving` measures against the
+    static-batch `llama_generate_fused` baseline.  Long prompts prefill
+    in fixed `prefill_chunk`-token chunks interleaved with decode
+    horizons (chunked prefill), so time-to-first-token for queued short
+    requests is bounded instead of head-of-line blocked.
 
 Pages are allocated LAZILY: a request holds ceil(len/page_size) pages at
 every moment, growing one page at a time as decode crosses page
-boundaries.  If the pool is momentarily empty, the slot simply stalls for
-a step (its pending token is masked inactive) until a retirement frees
-pages — admission control keeps this rare.
+boundaries.  If the pool is momentarily empty, the engine walks the
+serving degradation ladder (below) before stalling the slot for a step.
 
 Self-healing (the serving degradation ladder: admit -> queue -> reject ->
-preempt):
+evict cache -> preempt):
 
   * a bounded admission queue rejects overflow with a typed
     `AdmissionRejected` (backpressure) instead of growing unboundedly;
   * per-request deadlines retire overdue work (slot or queue) with
     `Request.timed_out` set, returning its pages;
-  * when no slot can make progress (the former hard-deadlock
-    RuntimeError), the engine PREEMPTS a victim — the youngest /
-    lowest-progress slot — returning its pages and requeueing it at the
-    queue head; re-admission re-prefills prompt + already-emitted tokens,
-    so greedy outputs stay step-exact vs a never-preempted run;
+  * pool exhaustion first EVICTS unreferenced prefix-cache pages (LRU,
+    leaf-first along the hash chain) — cached pages are a performance
+    opportunity, never a reason to refuse work;
+  * when no slot can make progress even after eviction (the former
+    hard-deadlock RuntimeError), the engine PREEMPTS a victim — the
+    youngest / lowest-progress slot — returning its pages (via the cache,
+    so the re-prefill itself can hit) and requeueing it at the queue
+    head; re-admission re-prefills prompt + already-emitted tokens, so
+    greedy outputs stay step-exact vs a never-preempted run;
   * injected page-pool pressure (`serve.pool_pressure` /
     `pagepool.alloc` fault points, resilience/faults.py) exercises all of
     the above deterministically on CPU.
+
+Greedy outputs are bit-exact with the prefix cache on vs off (including
+across preemption + re-prefill) — `tests/test_prefix_cache.py` asserts
+token-for-token equality on every parity scenario.
 """
 from __future__ import annotations
 
+import hashlib
 import math
 import time
+import weakref
 from collections import deque
 from dataclasses import dataclass, field
 
@@ -50,8 +73,9 @@ import numpy as np
 
 from ..resilience.faults import fault_point
 
-__all__ = ["PagePool", "Request", "ServingEngine", "serve_requests",
-           "PoolCapacityError", "AdmissionRejected", "EngineStalledError"]
+__all__ = ["PagePool", "PrefixCache", "Request", "ServingEngine",
+           "serve_requests", "PoolCapacityError", "AdmissionRejected",
+           "EngineStalledError", "PageDoubleFreeError"]
 
 
 class PoolCapacityError(ValueError):
@@ -68,10 +92,19 @@ class EngineStalledError(RuntimeError):
     reachable under a never-clearing injected pool fault)."""
 
 
+class PageDoubleFreeError(RuntimeError):
+    """free()/share() saw a page holding no reference (double free or
+    foreign page), or the same page id twice within one free() batch."""
+
+
 class PagePool:
-    """Fixed-size page allocator (the BlockManager analog): page ids
-    0..num_pages-1, LIFO free list for locality, strict double-free /
-    foreign-free checks so fragmentation bugs surface immediately."""
+    """Fixed-size refcounted page allocator (the BlockManager analog):
+    page ids 0..num_pages-1, LIFO free list for locality.  `alloc` returns
+    pages at refcount 1; `share` lets a page appear in another page table
+    (+1); `free` decrements and recycles at 0.  All misuse — double free,
+    foreign page, duplicate ids in one batch — raises the typed
+    `PageDoubleFreeError` before any state mutates, so fragmentation bugs
+    surface immediately and never tear the pool."""
 
     def __init__(self, num_pages: int, page_size: int):
         if num_pages <= 0 or page_size <= 0:
@@ -79,7 +112,7 @@ class PagePool:
         self.num_pages = int(num_pages)
         self.page_size = int(page_size)
         self._free = list(range(self.num_pages - 1, -1, -1))
-        self._allocated = set()
+        self._refs: dict[int, int] = {}
 
     @property
     def num_free(self) -> int:
@@ -87,13 +120,29 @@ class PagePool:
 
     @property
     def num_allocated(self) -> int:
-        return len(self._allocated)
+        """Pages holding at least one reference."""
+        return len(self._refs)
+
+    @property
+    def num_referenced(self) -> int:
+        """Total references across all page tables + the prefix cache
+        (>= num_allocated; the excess is prefix sharing)."""
+        return sum(self._refs.values())
+
+    @property
+    def _allocated(self):
+        # backwards-compatible container view (tests use `p in _allocated`)
+        return self._refs.keys()
+
+    def refcount(self, page: int) -> int:
+        return self._refs.get(int(page), 0)
 
     def alloc(self, n: int):
-        """Pop n pages; raises RuntimeError when the pool cannot satisfy the
-        request (callers check `num_free` first for graceful stalling).
-        Consults the `pagepool.alloc` fault point: a 'trigger' spec forces
-        the exhausted path, a 'raise' spec injects InjectedFault."""
+        """Pop n pages at refcount 1; raises RuntimeError when the pool
+        cannot satisfy the request (callers check `num_free` first for
+        graceful stalling).  Consults the `pagepool.alloc` fault point: a
+        'trigger' spec forces the exhausted path, a 'raise' spec injects
+        InjectedFault."""
         if n < 0:
             raise ValueError("alloc(n): n must be >= 0")
         injected = fault_point("pagepool.alloc", n=n, free=len(self._free))
@@ -103,17 +152,235 @@ class PagePool:
                 f"requested {n} pages, {len(self._free)} "
                 f"free of {self.num_pages}")
         pages = [self._free.pop() for _ in range(n)]
-        self._allocated.update(pages)
+        for p in pages:
+            self._refs[p] = 1
+        return pages
+
+    def share(self, pages):
+        """+1 reference on each page (it appears in one more page table /
+        the prefix cache).  Sharing an unallocated page is typed misuse."""
+        pages = [int(p) for p in pages]
+        for p in pages:
+            if p not in self._refs:
+                raise PageDoubleFreeError(
+                    f"PagePool.share: page {p} is not allocated")
+        for p in pages:
+            self._refs[p] += 1
         return pages
 
     def free(self, pages):
+        """-1 reference on each page; a page returns to the free list when
+        its last reference drops.  The WHOLE batch is validated before any
+        decrement (duplicate ids in one batch, double frees, and foreign
+        pages raise `PageDoubleFreeError` with the pool untouched)."""
+        pages = [int(p) for p in pages]
+        seen = set()
         for p in pages:
-            if p not in self._allocated:
-                raise RuntimeError(
+            if p in seen:
+                raise PageDoubleFreeError(
+                    f"PagePool.free: page {p} appears more than once in one "
+                    f"free() batch (each reference must be freed by its own "
+                    f"holder)")
+            seen.add(p)
+            if p not in self._refs:
+                raise PageDoubleFreeError(
                     f"PagePool.free: page {p} is not allocated "
                     "(double free or foreign page)")
-            self._allocated.remove(p)
-            self._free.append(p)
+        for p in pages:
+            self._refs[p] -= 1
+            if self._refs[p] == 0:
+                del self._refs[p]
+                self._free.append(p)
+
+
+_ROOT = b"\x00root"                   # parent digest of block 0
+
+
+class _CacheEntry:
+    __slots__ = ("key", "parent", "page", "tokens", "tick", "children")
+
+    def __init__(self, key, parent, page, tokens=None):
+        self.key = key                # chained SHA-256 digest (None: partial)
+        self.parent = parent          # parent block's digest (or _ROOT)
+        self.page = page              # physical page id (cache holds 1 ref)
+        self.tokens = tokens          # None for full blocks; bytes for the
+        self.tick = 0                 #   partial tail block's token content
+        self.children = 0             # cached entries chained under this one
+
+
+class PrefixCache:
+    """Automatic prefix cache: a chained block-hash index over PagePool
+    pages (the vLLM automatic-prefix-caching / RadixAttention analog).
+
+    Every page_size-aligned token block hashes as
+    ``sha256(parent_digest + block_tokens)`` — chaining makes the digest
+    identify the whole prefix, so a dict lookup per block walks the radix
+    path without storing a tree.  Entries hold ONE pool reference each;
+    `lookup` returns matched pages WITHOUT taking references (callers
+    attach via `PagePool.share`).  A retired sequence's trailing partial
+    block is indexed too (by parent + exact token content): attaching it
+    saves up to page_size-1 more prefill tokens, and because the attaching
+    request will WRITE into that page's empty tail, the engine copies it
+    first (copy-on-write).
+
+    Eviction is LRU over entries that are pure cache (pool refcount 1)
+    and leaves of the hash chain (no cached children) — evicting an inner
+    block would strand its descendants unreachable while they still hold
+    pages."""
+
+    def __init__(self, pool: PagePool, page_size: int):
+        self.pool = pool
+        self.page_size = int(page_size)
+        self._full: dict[bytes, _CacheEntry] = {}
+        # partial-tail entries indexed by parent digest, so lookup touches
+        # only the tails chained under the matched prefix — never the
+        # whole cache (admission is the serving hot path)
+        self._partial: dict[bytes, dict[bytes, _CacheEntry]] = {}
+        self._tick = 0
+        self.insertions = 0
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        return len(self._full) + sum(len(d) for d in self._partial.values())
+
+    def pages(self):
+        """Every page the cache holds a reference on (one per entry)."""
+        for e in self._full.values():
+            yield e.page
+        for d in self._partial.values():
+            for e in d.values():
+                yield e.page
+
+    def _touch(self, e: _CacheEntry):
+        self._tick += 1
+        e.tick = self._tick
+
+    def _digest(self, parent: bytes, block) -> bytes:
+        return hashlib.sha256(
+            parent + np.ascontiguousarray(block, np.int32).tobytes()).digest()
+
+    # -- lookup / attach ---------------------------------------------------
+    def lookup(self, tokens):
+        """Longest cached prefix of `tokens` -> (full_pages, partial).
+
+        full_pages: page ids of the matched full blocks, in order.
+        partial: None, or (page_id, m) — a cached partially filled page
+        whose first m tokens extend the match (the attaching engine MUST
+        copy-on-write it before prefilling into its tail).
+
+        The match is capped at len(tokens)-1 so at least one suffix token
+        remains to prefill — its logits feed the first sample.  No
+        references are taken; callers `share()` what they attach."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        ps = self.page_size
+        limit = len(tokens) - 1
+        parent = _ROOT
+        pages = []
+        n = 0
+        while (n + 1) * ps <= limit:
+            key = self._digest(parent, tokens[n * ps:(n + 1) * ps])
+            e = self._full.get(key)
+            if e is None:
+                break
+            self._touch(e)
+            pages.append(e.page)
+            parent = key
+            n += 1
+        partial = None
+        rem = tokens[n * ps:limit]
+        if len(rem):
+            best_m, best_e = 0, None
+            for e in self._partial.get(parent, {}).values():
+                et = np.frombuffer(e.tokens, np.int32)
+                L = min(len(et), len(rem))
+                m = 0
+                while m < L and et[m] == rem[m]:
+                    m += 1
+                if m > best_m:
+                    best_m, best_e = m, e
+            if best_e is not None:
+                self._touch(best_e)
+                partial = (best_e.page, best_m)
+        return pages, partial
+
+    # -- insertion ---------------------------------------------------------
+    def register(self, tokens, pages, with_partial: bool = False):
+        """Index this sequence's blocks: every full block always, plus the
+        trailing partial block when `with_partial` (retire path — the page
+        will receive no more writes).  The cache takes its OWN pool
+        reference on each newly inserted page; blocks whose digest is
+        already cached are left as-is (first writer wins, the caller's
+        duplicate copy stays private)."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        ps = self.page_size
+        parent = _ROOT
+        n_full = len(tokens) // ps
+        for i in range(n_full):
+            key = self._digest(parent, tokens[i * ps:(i + 1) * ps])
+            e = self._full.get(key)
+            if e is None:
+                self.pool.share([pages[i]])
+                e = _CacheEntry(key, parent, int(pages[i]))
+                self._full[key] = e
+                if parent in self._full:
+                    self._full[parent].children += 1
+                self.insertions += 1
+            self._touch(e)
+            parent = key
+        if with_partial:
+            tail = np.ascontiguousarray(tokens[n_full * ps:], np.int32)
+            if len(tail) and n_full < len(pages):
+                tb = tail.tobytes()
+                tails = self._partial.setdefault(parent, {})
+                if tb not in tails:
+                    self.pool.share([pages[n_full]])
+                    e = _CacheEntry(None, parent, int(pages[n_full]),
+                                    tokens=tb)
+                    tails[tb] = e
+                    if parent in self._full:
+                        self._full[parent].children += 1
+                    self.insertions += 1
+                    self._touch(e)
+
+    # -- eviction ----------------------------------------------------------
+    def _evictable(self):
+        for d in self._partial.values():
+            for e in d.values():
+                if self.pool.refcount(e.page) == 1:
+                    yield e
+        for e in self._full.values():
+            if e.children == 0 and self.pool.refcount(e.page) == 1:
+                yield e
+
+    def evict(self, n_pages: int) -> int:
+        """Drop up to n_pages LRU cache-only leaf entries, returning their
+        pages to the free list; returns how many pages were freed.  Walks
+        chains back-to-front across calls: evicting a leaf makes its
+        parent a leaf for the next pass of the same call."""
+        freed = 0
+        while freed < n_pages:
+            cand = None
+            for e in self._evictable():
+                if cand is None or e.tick < cand.tick:
+                    cand = e
+            if cand is None:
+                break
+            self._drop(cand)
+            freed += 1
+        self.evictions += freed
+        return freed
+
+    def _drop(self, e: _CacheEntry):
+        if e.tokens is None:
+            del self._full[e.key]
+        else:
+            tails = self._partial[e.parent]
+            del tails[e.tokens]
+            if not tails:
+                del self._partial[e.parent]
+        if e.parent in self._full:
+            self._full[e.parent].children -= 1
+        self.pool.free([e.page])
 
 
 @dataclass
@@ -129,9 +396,12 @@ class Request:
     # filled by the engine
     generated: list = field(default_factory=list)
     submit_time: float = 0.0
+    first_token_time: float = 0.0      # TTFT = first_token_time - submit_time
     finish_time: float = 0.0
     timed_out: bool = False            # retired overdue (possibly partial)
     preemptions: int = 0               # times evicted + requeued mid-flight
+    cached_prefix_tokens: int = 0      # prefix-cache tokens attached (total
+                                       #   across re-prefills)
 
     @property
     def output_ids(self) -> np.ndarray:
@@ -140,7 +410,8 @@ class Request:
 
 
 class _Slot:
-    __slots__ = ("req", "pages", "pending", "stalled", "admit_seq")
+    __slots__ = ("req", "pages", "pending", "stalled", "admit_seq",
+                 "prefill_pos", "ctx", "resuming", "chunk_step")
 
     def __init__(self, req, pages, pending, admit_seq=0):
         self.req = req
@@ -148,6 +419,16 @@ class _Slot:
         self.pending = pending         # last sampled token, not yet in cache
         self.stalled = False
         self.admit_seq = admit_seq     # monotonically increasing admit order
+        self.prefill_pos = None        # tokens prefilled so far; None once
+        self.ctx = None                #   decoding (chunked-prefill state)
+        self.resuming = False          # re-admission after preemption
+        self.chunk_step = -1           # engine step of the last chunk run
+                                       #   (one chunk per slot per step)
+
+
+# every live engine, for the tests' refcount-invariant leak guard
+# (tests/conftest.py checks each one after every test)
+_LIVE_ENGINES: "weakref.WeakSet[ServingEngine]" = weakref.WeakSet()
 
 
 class ServingEngine:
@@ -156,15 +437,23 @@ class ServingEngine:
     params: the (embed, block, head) pytrees `build_functional_llama` /
     `functional_params_from_layer` produce.  One jitted decode executable
     covers the whole run; prefill executables are cached per prompt-length
-    bucket.
-    """
+    bucket (per chunk size once `prefill_chunk` is set).
+
+    `prefix_cache=True` (default) turns on automatic prefix caching:
+    retired requests park their KV pages in a block-hash index, and later
+    prompts sharing a page-aligned prefix attach those pages read-only and
+    prefill only the suffix.  `prefill_chunk=N` bounds any single prefill
+    dispatch to N tokens, interleaving long-prompt prefill with decode
+    horizons (chunked prefill).  Both knobs preserve greedy outputs
+    bit-exactly vs the cache-off engine."""
 
     def __init__(self, params, config, num_slots: int = 4,
                  page_size: int = 16, num_pages: int | None = None,
                  max_pages_per_seq: int | None = None, dtype=None,
                  attention_impl: str = "auto", interpret: bool = False,
                  prompt_bucket: int = 32, decode_horizon: int = 8,
-                 seed: int = 0, max_queue: int | None = None):
+                 seed: int = 0, max_queue: int | None = None,
+                 prefix_cache: bool = True, prefill_chunk: int | None = None):
         import jax
         import jax.numpy as jnp
         from ..models.llama import (build_llama_paged_decode,
@@ -179,12 +468,17 @@ class ServingEngine:
         if num_pages is None:
             num_pages = self.num_slots * self.max_pages_per_seq
         self.pool = PagePool(num_pages, page_size)
+        self.cache = PrefixCache(self.pool, page_size) if prefix_cache \
+            else None
+        self.prefill_chunk = None if prefill_chunk is None \
+            else max(1, int(prefill_chunk))
         self.prompt_bucket = int(prompt_bucket)
         self.decode_horizon = max(1, int(decode_horizon))
 
-        init_pages, prefill, decode_step = build_llama_paged_decode(
-            config, page_size=page_size, num_pages=num_pages, dtype=dtype,
-            attention_impl=attention_impl, interpret=interpret)
+        init_pages, prefill, prefill_chunk_fn, decode_step = \
+            build_llama_paged_decode(
+                config, page_size=page_size, num_pages=num_pages, dtype=dtype,
+                attention_impl=attention_impl, interpret=interpret)
         cache = init_pages()
         self._pages_k, self._pages_v = cache["k"], cache["v"]
 
@@ -240,10 +534,31 @@ class ServingEngine:
                                           top_p[None])[0]
             return tok, pk, pv
 
+        # single-logits sampler for the final chunk of a chunked / suffix
+        # prefill (the chunk executable itself is sampling-agnostic so one
+        # executable serves every request)
+        def _sample_logits(logits, key, temp, top_p, *, greedy):
+            if greedy:
+                return jnp.argmax(logits).astype(jnp.int32)
+            return _sample_per_request(logits[None], key, temp[None],
+                                       top_p[None])[0]
+
+        # copy-on-write page copy (src/dst are traced scalars: ONE
+        # executable covers every copy)
+        def _copy_page(pk, pv, src, dst):
+            return (pk.at[:, :, dst].set(pk[:, :, src]),
+                    pv.at[:, :, dst].set(pv[:, :, src]))
+
         self._horizon_fn = _horizon
         self._horizon_jit = {}         # (K, greedy) -> jitted horizon
         self._prefill_fn = _prefill_sample
         self._prefill_jit = {}         # (T_bucket, greedy) -> jitted prefill
+        # one wrapper: jax.jit already caches per (C_pad, P_slice) shape,
+        # and the chunk fn has no Python-level static knobs to key on
+        self._chunk_jit = jax.jit(prefill_chunk_fn, donate_argnums=(5, 6))
+        self._sample_fn = _sample_logits
+        self._sample_jit = {}          # greedy -> jitted sampler
+        self._copy_jit = jax.jit(_copy_page, donate_argnums=(0, 1))
 
         # host-side slot state
         S, P = self.num_slots, self.max_pages_per_seq
@@ -259,11 +574,18 @@ class ServingEngine:
         self.max_queue = None if max_queue is None else int(max_queue)
         self._admit_seq = 0
         self._pressure = False         # this-step injected pool pressure
+        self._step_seq = 0             # step() invocations (chunk pacing)
         self.steps_run = 0
         self.tokens_generated = 0
         self.preemptions = 0           # victim evictions (self-healing)
         self.timeouts = 0              # deadline retirements
         self.rejections = 0            # AdmissionRejected count
+        self.cache_hits = 0            # admissions that attached a prefix
+        self.cache_hit_tokens = 0      # prefill tokens skipped via the cache
+        self.prefill_tokens = 0        # prefill tokens actually executed
+        self.cache_evictions = 0       # cached pages evicted under pressure
+        self.cow_copies = 0            # copy-on-write page copies
+        _LIVE_ENGINES.add(self)
 
     # -- submission --------------------------------------------------------
     def submit(self, prompt, max_new_tokens: int = 32, temperature: float = 0.0,
@@ -324,6 +646,30 @@ class ServingEngine:
         `serve.pool_pressure` window is active (exhaustion drills)."""
         return 0 if self._pressure else self.pool.num_free
 
+    def _evict(self, n: int) -> int:
+        """Degradation-ladder rung between stall and preempt: reclaim up to
+        n pages from the prefix cache (LRU leaf-first)."""
+        if self.cache is None or n <= 0:
+            return 0
+        freed = self.cache.evict(n)
+        self.cache_evictions += freed
+        return freed
+
+    def _register_slot(self, s: int, with_partial: bool):
+        """Index the slot's written-so-far KV into the prefix cache (full
+        blocks always; the trailing partial block too on retire/preempt,
+        since nothing will write into it anymore)."""
+        if self.cache is None:
+            return
+        slot = self._slots[s]
+        valid = int(self._lengths[s])
+        if valid <= 0:
+            return
+        seq = np.concatenate(
+            [slot.req.prompt,
+             np.asarray(slot.req.generated, np.int32)])[:valid]
+        self.cache.register(seq, slot.pages, with_partial=with_partial)
+
     def _release_slot(self, s: int):
         slot = self._slots[s]
         self.pool.free(slot.pages)
@@ -333,14 +679,21 @@ class ServingEngine:
         return slot
 
     def _finish(self, s: int):
+        # retire INTO the cache: the pages this request wrote stay indexed
+        # (refcount 1, cache-held) until LRU eviction needs them back
+        self._register_slot(s, with_partial=True)
         slot = self._release_slot(s)
         slot.req.finish_time = time.perf_counter()
         self._finished[slot.req.rid] = slot.req
 
     def _preempt(self, s: int):
-        """Victim preemption: return the slot's pages and requeue the request
-        at the queue head; re-admission re-prefills prompt + already-emitted
-        tokens, so greedy decoding resumes step-exact."""
+        """Victim preemption: park the slot's written KV in the prefix
+        cache, return its page references, and requeue the request at the
+        queue head; re-admission re-prefills prompt + already-emitted
+        tokens — and that re-prefill can hit the very blocks parked here,
+        so a preemption usually costs one chunk of suffix prefill, not a
+        full re-prefill.  Greedy decoding resumes step-exact either way."""
+        self._register_slot(s, with_partial=True)
         slot = self._release_slot(s)
         slot.req.preemptions += 1
         self.preemptions += 1
@@ -381,6 +734,8 @@ class ServingEngine:
         slot = self._slots[s]
         req = slot.req
         req.generated.append(int(tok))
+        if req.first_token_time == 0.0:
+            req.first_token_time = time.perf_counter()
         self.tokens_generated += 1
         done = (req.eos_token_id is not None and int(tok) == req.eos_token_id) \
             or len(req.generated) >= req.max_new_tokens
@@ -389,6 +744,26 @@ class ServingEngine:
         else:
             slot.pending = int(tok)
         return done
+
+    def _cow(self, s: int, idx: int, src: int | None = None):
+        """Copy-on-write: give slot s its own copy of the (shared) page at
+        table index idx before anything writes into it.  `src` overrides
+        the copy source (admission attaches a cached partial page without
+        ever putting the shared id in the table)."""
+        jnp = self._jnp
+        slot = self._slots[s]
+        dst = slot.pages[idx]
+        if src is None:
+            src = dst
+            dst = self.pool.alloc(1)[0]
+        self._pages_k, self._pages_v = self._copy_jit(
+            self._pages_k, self._pages_v,
+            jnp.asarray(src, jnp.int32), jnp.asarray(dst, jnp.int32))
+        if slot.pages[idx] != dst:
+            self.pool.free([slot.pages[idx]])
+            slot.pages[idx] = dst
+        self._page_tables[s, idx] = dst
+        self.cow_copies += 1
 
     def _admit(self):
         jnp = self._jnp
@@ -404,67 +779,200 @@ class ServingEngine:
             ctx = req.prompt if not resuming else np.concatenate(
                 [req.prompt, np.asarray(req.generated[:-1], np.int32)])
             T = len(ctx)
-            n_pages = max(1, math.ceil(T / self.page_size))
-            if n_pages > self._avail():
+            total_pages = max(1, math.ceil(T / self.page_size))
+            # longest cached prefix: whole pages attach read-only; a cached
+            # partial tail page attaches via copy-on-write
+            shared, partial = ([], None)
+            if self.cache is not None:
+                shared, partial = self.cache.lookup(ctx)
+            n_shared = len(shared)
+            # pin the matched pages (take our references now) so the
+            # eviction below can never free them out from under us
+            pin = list(shared) + ([partial[0]] if partial is not None else [])
+            if pin:
+                self.pool.share(pin)
+            need = total_pages - n_shared   # pages this request must OWN
+            if need > self._avail():
+                # ladder: evict unreferenced cached pages before giving up
+                self._evict(need - self._avail())
+            if need > self._avail():
+                if pin:
+                    self.pool.free(pin)
                 return                 # wait for retirements to free pages
+            try:
+                own = self.pool.alloc(need)
+            except BaseException:
+                if pin:                # injected pagepool.alloc fault —
+                    self.pool.free(pin)  # roll back so no reference leaks
+                raise
             self._queue.popleft()
             s = free_slots[0]
-            pages = self.pool.alloc(n_pages)
-            row = np.zeros((self.max_pages_per_seq,), np.int32)
-            row[:n_pages] = pages
-            # bucketed prompt pad -> one prefill executable per bucket
-            # (clamped to the rope-table length: the bucket round-up may
-            # overshoot the model context even though the prompt fits)
-            Tb = max(self.prompt_bucket,
-                     math.ceil(T / self.prompt_bucket) * self.prompt_bucket)
-            Tb = min(Tb, self.config.max_position_embeddings)
-            ids = np.zeros((1, Tb), np.int32)
-            ids[0, :T] = ctx
-            greedy = req.temperature <= 0.0
-            pf = self._prefill_jit.get((Tb, greedy))
-            if pf is None:
-                fn = self._prefill_fn
-                pf = self._jax.jit(
-                    (lambda *a: fn(*a, greedy=True)) if greedy
-                    else (lambda *a: fn(*a, greedy=False)),
-                    donate_argnums=(4, 5))
-                self._prefill_jit[(Tb, greedy)] = pf
-            tok, self._pages_k, self._pages_v = pf(
-                self.params, jnp.asarray(ids), jnp.asarray(T, jnp.int32),
-                jnp.asarray(row), self._pages_k, self._pages_v,
-                self._split_key(), jnp.asarray(req.temperature, jnp.float32),
-                jnp.asarray(req.top_p, jnp.float32))
-            self._slots[s] = _Slot(req, pages, 0, admit_seq=self._admit_seq)
+            pages = shared + own
+            matched = n_shared * self.page_size
+            slot = _Slot(req, pages, 0, admit_seq=self._admit_seq)
+            slot.resuming = resuming
             self._admit_seq += 1
+            self._slots[s] = slot
+            row = np.zeros((self.max_pages_per_seq,), np.int32)
+            row[:len(pages)] = pages
             self._page_tables[s] = row
-            self._lengths[s] = T
+            if partial is not None:
+                # copy-on-write: the suffix prefill writes into this page's
+                # tail, and the cache (and possibly other requests) still
+                # reference it — copy first, then drop the pinned reference
+                src, m = partial
+                self._cow(s, n_shared, src=src)
+                self.pool.free([src])
+                matched += m
             self._temps[s] = req.temperature
             self._top_ps[s] = req.top_p
-            if resuming:
-                # the re-prefill rebuilt the cache; the last emitted token is
-                # still the pending one — discard the redundant sample
-                self._slots[s].pending = int(req.generated[-1])
+            if matched:
+                self.cache_hits += 1
+                self.cache_hit_tokens += matched
+                req.cached_prefix_tokens += matched
+            self.prefill_tokens += T - matched
+            chunked = self.prefill_chunk is not None \
+                and (T - matched) > self.prefill_chunk
+            if matched == 0 and not chunked:
+                # whole-prompt dense prefill + fused first sample — the
+                # pre-cache fast path, kept byte-identical so cache-off
+                # numerics never shift
+                self._lengths[s] = T
+                # bucketed prompt pad -> one prefill executable per bucket
+                # (clamped to the rope-table length: the bucket round-up may
+                # overshoot the model context even though the prompt fits)
+                Tb = max(self.prompt_bucket,
+                         math.ceil(T / self.prompt_bucket) * self.prompt_bucket)
+                Tb = min(Tb, self.config.max_position_embeddings)
+                ids = np.zeros((1, Tb), np.int32)
+                ids[0, :T] = ctx
+                greedy = req.temperature <= 0.0
+                pf = self._prefill_jit.get((Tb, greedy))
+                if pf is None:
+                    fn = self._prefill_fn
+                    pf = self._jax.jit(
+                        (lambda *a: fn(*a, greedy=True)) if greedy
+                        else (lambda *a: fn(*a, greedy=False)),
+                        donate_argnums=(4, 5))
+                    self._prefill_jit[(Tb, greedy)] = pf
+                tok, self._pages_k, self._pages_v = pf(
+                    self.params, jnp.asarray(ids), jnp.asarray(T, jnp.int32),
+                    jnp.asarray(row), self._pages_k, self._pages_v,
+                    self._split_key(),
+                    jnp.asarray(req.temperature, jnp.float32),
+                    jnp.asarray(req.top_p, jnp.float32))
+                if self.cache is not None:
+                    self.cache.register(ctx, pages)
+                if resuming:
+                    # the re-prefill rebuilt the cache; the last emitted
+                    # token is still the pending one — discard the
+                    # redundant sample
+                    slot.pending = int(req.generated[-1])
+                else:
+                    self._record_token(s, int(np.asarray(tok)))
             else:
-                self._record_token(s, int(np.asarray(tok)))
+                # suffix / chunked prefill: only the un-cached tokens run,
+                # at most prefill_chunk per engine step
+                slot.ctx = ctx
+                slot.prefill_pos = matched
+                self._lengths[s] = matched
+                self._prefill_advance(s)
+
+    def _prefill_advance(self, s: int):
+        """Run ONE prefill chunk for slot s (suffix prefill after a cache
+        hit is the single- or few-chunk case).  On the final chunk: index
+        the prompt's full blocks into the cache and sample the first
+        token."""
+        jnp = self._jnp
+        slot = self._slots[s]
+        req = slot.req
+        pos = slot.prefill_pos
+        T = len(slot.ctx)
+        c = T - pos
+        if self.prefill_chunk is not None:
+            c = min(c, self.prefill_chunk)
+        # bucket the chunk pad (a short suffix must not pay a full-chunk
+        # executable) and slice the page table to the pages this chunk can
+        # actually see (4-page granularity) — attention cost in the chunk
+        # executable is C_pad x table_width, so both knobs matter, and on
+        # TPU the kernel grid is proportional to the table width
+        Cb = max(self.prompt_bucket,
+                 math.ceil(c / self.prompt_bucket) * self.prompt_bucket)
+        if self.prefill_chunk is not None:
+            Cb = min(Cb, max(self.prompt_bucket, self.prefill_chunk))
+        Cb = min(Cb, self.config.max_position_embeddings)
+        ctx_pages = math.ceil((pos + c) / self.page_size)
+        Pb = min(self.max_pages_per_seq, math.ceil(ctx_pages / 4) * 4)
+        ids = np.zeros((1, Cb), np.int32)
+        ids[0, :c] = slot.ctx[pos:pos + c]
+        logits, self._pages_k, self._pages_v = self._chunk_jit(
+            self.params, jnp.asarray(ids), jnp.asarray(pos, jnp.int32),
+            jnp.asarray(c, jnp.int32),
+            jnp.asarray(self._page_tables[s, :Pb]),
+            self._pages_k, self._pages_v)
+        slot.chunk_step = self._step_seq
+        pos += c
+        slot.prefill_pos = pos
+        self._lengths[s] = pos
+        if pos < T:
+            return
+        # prefill complete -> decoding
+        slot.prefill_pos = None
+        ctx, slot.ctx = slot.ctx, None
+        if self.cache is not None:
+            self.cache.register(ctx, slot.pages)
+        if slot.resuming:
+            # the re-prefill rebuilt the cache; the last emitted token is
+            # still the pending one — no fresh sample needed
+            slot.pending = int(req.generated[-1])
+        else:
+            greedy = req.temperature <= 0.0
+            sf = self._sample_jit.get(greedy)
+            if sf is None:
+                fn = self._sample_fn
+                sf = self._jax.jit(
+                    (lambda *a: fn(*a, greedy=True)) if greedy
+                    else (lambda *a: fn(*a, greedy=False)))
+                self._sample_jit[greedy] = sf
+            tok = sf(logits, self._split_key(),
+                     jnp.asarray(req.temperature, jnp.float32),
+                     jnp.asarray(req.top_p, jnp.float32))
+            self._record_token(s, int(np.asarray(tok)))
 
     def _remaining(self, s: int) -> int:
         req = self._slots[s].req
         return req.max_new_tokens - len(req.generated)
 
     def _provision(self, steps: int):
-        """Lazy page growth for up to `steps` decode steps ahead: every slot
-        gets pages covering write positions < lengths + min(steps,
-        remaining); a slot the pool cannot fully cover stalls this horizon.
+        """Lazy page growth for up to `steps` decode steps ahead: every
+        DECODING slot gets pages covering write positions < lengths +
+        min(steps, remaining); mid-prefill slots are skipped (their pages
+        were provisioned at admission).  When the pool runs short the
+        prefix cache is evicted first (degradation ladder); a slot that
+        still cannot be covered stalls this horizon.  A shared page about
+        to receive a write is copied first (copy-on-write — belt and
+        braces: admission already copies the only shareable written page).
         Returns the list of runnable slot indices."""
         run = []
         for s, slot in enumerate(self._slots):
-            if slot is None:
+            if slot is None or slot.prefill_pos is not None:
                 continue
             slot.stalled = False
+            w0 = int(self._lengths[s]) // self.page_size
+            if w0 < len(slot.pages) \
+                    and self.pool.refcount(slot.pages[w0]) > 1:
+                if self._avail() < 1:
+                    self._evict(1)
+                if self._avail() < 1:
+                    slot.stalled = True
+                    continue
+                self._cow(s, w0)
             m = min(steps, self._remaining(s))
             need = math.ceil((int(self._lengths[s]) + m) / self.page_size)
             grow = need - len(slot.pages)
             if grow > 0:
+                if grow > self._avail():
+                    self._evict(grow - self._avail())
                 if grow > self._avail():
                     slot.stalled = True
                     continue
@@ -491,19 +999,37 @@ class ServingEngine:
 
     def step(self) -> bool:
         """One engine step: retire overdue requests, admit queued requests
-        into free slots, provision pages for the decode horizon, run the
-        jitted K-step decode, record sampled tokens, retire finished
-        requests.  Returns True when any slot made progress.
+        into free slots (attaching cached prefixes), advance each
+        mid-prefill slot by one chunk, provision pages for the decode
+        horizon, run the jitted K-step decode, record sampled tokens,
+        retire finished requests into the prefix cache.  Returns True when
+        any slot made progress.
 
         When nobody can progress — the former hard-deadlock RuntimeError —
-        the engine self-heals by preempting victims (pages back to the pool,
-        request requeued for re-prefill) until a slot can run; under a fully
-        injected pool-pressure window it parks and reports no progress."""
+        the engine walks the degradation ladder: evict unreferenced cached
+        pages, then preempt a victim (pages parked in the cache, request
+        requeued for re-prefill); under a fully injected pool-pressure
+        window it parks and reports no progress."""
         jnp = self._jnp
+        self._step_seq += 1
         self._pressure = fault_point("serve.pool_pressure",
                                      step=self.steps_run) is not None
         self._retire_overdue()
         self._admit()
+        # chunked prefill: each mid-prefill slot advances ONE chunk per
+        # step, interleaved with the decode horizon below — a long prompt
+        # never head-of-line blocks the running decodes or short arrivals.
+        # A slot admitted THIS step already ran its first chunk inside
+        # _admit (chunk_step guard), so the per-step prefill bound holds
+        # on the admission step too.
+        prefilled = False
+        for s, slot in enumerate(self._slots):
+            if slot is not None and slot.prefill_pos is not None \
+                    and slot.chunk_step != self._step_seq:
+                self._prefill_advance(s)
+                prefilled = True
+        if prefilled:
+            self._admit()              # a 1-token request may have retired
         K = self.decode_horizon
         run = self._provision(K)
         if not run and K > 1:
@@ -511,20 +1037,25 @@ class ServingEngine:
             # single-step pacing so retirements can still free pages
             K = 1
             run = self._provision(1)
-        # self-healing: evict ONE victim per no-progress step.  Freed pages
-        # go to the stalled SURVIVORS (no re-admission here — the victim at
-        # the queue head would immediately steal its own pages back and
-        # livelock).  One eviction always suffices for a real deadlock: a
-        # stalled slot's single-step growth need is <= 1 page and any victim
-        # frees >= 1, so a survivor runs; when it doesn't (an injected
-        # pool-pressure window hides every page), per-step budgeting bounds
-        # the wasted re-prefills to one victim per stalled step.
-        if not run and self.num_active > 0:
+        # self-healing: cache eviction happens inside _provision/_admit;
+        # when even that freed nothing usable, evict ONE victim per
+        # no-progress step.  Freed pages go to the stalled SURVIVORS (no
+        # re-admission here — the victim at the queue head would
+        # immediately steal its own pages back and livelock).  One
+        # eviction always suffices for a real deadlock: a stalled slot's
+        # single-step growth need is <= 1 page and any victim frees >= 1
+        # OWNED page (its suffix/COW page at minimum — cache-shared pages
+        # may stay parked), so a survivor runs; when it doesn't (an
+        # injected pool-pressure window hides every page), per-step
+        # budgeting bounds the wasted re-prefills to one victim per
+        # stalled step.
+        if not run and not prefilled and self.num_active > 0:
             self._preempt(self._pick_victim())
             K = 1
             run = self._provision(1)
         if not run:
-            return False               # pool-pressure window or nothing to do
+            # pure-prefill step, pool-pressure window, or nothing to do
+            return prefilled
         S = self.num_slots
         active = np.zeros((S,), bool)
         active[run] = True
@@ -546,6 +1077,8 @@ class ServingEngine:
             jnp.asarray(self._temps), jnp.asarray(self._top_ps),
             jnp.asarray(remaining), jnp.asarray(eos_ids))
         out = np.asarray(out)
+        # inactive slots (stalled or mid-prefill) echo their input length
+        # through the horizon unchanged, so the wholesale copy is safe
         self._lengths = np.asarray(new_lengths).astype(np.int32).copy()
         self.steps_run += 1
         for s in run:
@@ -562,8 +1095,8 @@ class ServingEngine:
         Consecutive no-progress steps (possible only while an injected
         pool-pressure window hides every page) are bounded by
         `max_stall_steps`; exceeding it raises `EngineStalledError` — the
-        pool-sizing deadlock itself is resolved by preemption and can no
-        longer raise."""
+        pool-sizing deadlock itself is resolved by cache eviction +
+        preemption and can no longer raise."""
         steps = 0
         stalled = 0
         while self._queue or self.num_active:
@@ -580,6 +1113,40 @@ class ServingEngine:
             if max_steps is not None and steps >= max_steps:
                 break
         return dict(self._finished)
+
+    # -- accounting / invariants -------------------------------------------
+    def release_cache(self) -> int:
+        """Drop every evictable cached page back to the free list (tests,
+        shutdown, or a host that wants its HBM back); returns pages
+        freed.  Pages attached to live requests are untouched."""
+        if self.cache is None:
+            return 0
+        freed = self.cache.evict(self.pool.num_pages)
+        self.cache_evictions += freed
+        return freed
+
+    def check_invariants(self):
+        """Page-refcount accounting must exactly equal what the live page
+        tables + prefix cache reference — called by the tests' leak guard
+        after every test, and valid at ANY step boundary."""
+        expect: dict[int, int] = {}
+        for slot in self._slots:
+            if slot is None:
+                continue
+            for p in slot.pages:
+                expect[p] = expect.get(p, 0) + 1
+        if self.cache is not None:
+            for p in self.cache.pages():
+                expect[p] = expect.get(p, 0) + 1
+        assert expect == self.pool._refs, (
+            f"page refcount drift: tables+cache say {expect}, "
+            f"pool says {self.pool._refs}")
+        assert self.pool.num_free + self.pool.num_allocated \
+            == self.pool.num_pages, "free + allocated != pool size"
+        free = self.pool._free
+        assert len(set(free)) == len(free), "duplicate page on the free list"
+        assert not (set(free) & set(self.pool._refs)), \
+            "page simultaneously free and referenced"
 
 
 def serve_requests(params, config, prompts, **kw):

@@ -661,7 +661,7 @@ def build_llama_paged_decode(config: LlamaConfig, page_size: int = 16,
     sequences occupy memory (and attention FLOPs) proportional to their OWN
     length instead of the longest sequence in the batch.
 
-    Returns (init_pages, prefill, decode_step):
+    Returns (init_pages, prefill, prefill_chunk, decode_step):
 
       pages = init_pages()
           {"k","v": [L, Hkv, num_pages + 1, page_size, head_dim]} — the last
@@ -674,6 +674,23 @@ def build_llama_paged_decode(config: LlamaConfig, page_size: int = 16,
           page_row [P] this request's page table.  Dense causal attention
           over the prompt; post-RoPE K/V scatter into the request's pages;
           logits [vocab] for the LAST real token.
+
+      logits, pages_k, pages_v = prefill_chunk(params, ids, start, chunk_len,
+                                               page_row, pages_k, pages_v)
+          CHUNKED / SUFFIX prefill for the prefix cache + chunked-prefill
+          scheduler: ids [1, C_pad] right-padded chunk of the prompt, start
+          the number of tokens ALREADY in this request's pages (a cached
+          prefix and/or earlier chunks), chunk_len the real chunk length.
+          The chunk's K/V scatter into the pages at absolute positions
+          start..start+chunk_len-1 (RoPE at those positions), then each
+          chunk token attends over the WHOLE cached context gathered
+          through the page table (causal across cache + chunk).  Returns
+          logits [vocab] for the LAST real chunk token — only the final
+          chunk's logits feed sampling.  `prefill_chunk(.., start=0,
+          chunk_len=T)` is semantically identical to `prefill` (the engine
+          keeps the dense path for the no-cache-hit whole-prompt case
+          purely so its numerics stay byte-identical with the pre-cache
+          engine).
 
       logits, pages_k, pages_v = decode_step(params, toks, lengths,
                                              page_tables, pages_k, pages_v,
@@ -773,6 +790,58 @@ def build_llama_paged_decode(config: LlamaConfig, page_size: int = 16,
                                               keepdims=False)
         return _head(hp, h_last), ks, vs
 
+    def prefill_chunk(params, ids, start, chunk_len, page_row, pages_k,
+                      pages_v):
+        ep, bp, hp = params
+        C = ids.shape[1]
+        P = page_row.shape[0]
+        x = ep["tok"][ids[0]].astype(d)               # [C, H]
+        i_idx = jnp.arange(C)
+        valid = i_idx < chunk_len
+        pos = start + i_idx                           # absolute positions
+        page = jnp.where(valid, page_row[pos // page_size], TRASH)
+        off = pos % page_size
+        sin, cos = jnp.take(sin_t, pos, axis=0), jnp.take(cos_t, pos, axis=0)
+        # key side: every position the page table can address, causal-masked
+        # against each chunk query's absolute position.  Slots past the
+        # written region (or recycled-page garbage) can never be <= a query
+        # position, so the mask alone keeps them out of the softmax.
+        kv_pos = jnp.arange(P * page_size)            # [P*ps] logical pos
+        mask = (kv_pos[None, :] <= pos[:, None]) & valid[:, None]  # [C, P*ps]
+
+        def body(carry, layer_in):
+            xc, = carry
+            lp, kc_l, vc_l = layer_in
+            h = rms_norm_ref(xc, lp["ln1"], c.rms_norm_eps)
+            q = (h @ lp["wq"]).reshape(C, nh, head_dim)
+            k = (h @ lp["wk"]).reshape(C, nkv, head_dim)
+            v = (h @ lp["wv"]).reshape(C, nkv, head_dim)
+            q = _rope_at(q, sin, cos)
+            k = _rope_at(k, sin, cos)
+            kc_l = kc_l.at[:, page, off].set(k.astype(d).transpose(1, 0, 2))
+            vc_l = vc_l.at[:, page, off].set(v.astype(d).transpose(1, 0, 2))
+            # gather this request's whole context through its page table
+            kf = kc_l[:, page_row].reshape(nkv, P * page_size, head_dim)
+            vf = vc_l[:, page_row].reshape(nkv, P * page_size, head_dim)
+            rep = nh // nkv
+            if rep > 1:
+                kf = jnp.repeat(kf, rep, axis=0)
+                vf = jnp.repeat(vf, rep, axis=0)
+            s = jnp.einsum("qhd,hkd->hqk", q.astype(jnp.float32),
+                           kf.astype(jnp.float32)) / math.sqrt(head_dim)
+            s = jnp.where(mask[None, :, :], s, -jnp.inf)
+            p = jax.nn.softmax(s, axis=-1).astype(xc.dtype)
+            o = jnp.einsum("hqk,hkd->qhd", p, vf).reshape(C, nh * head_dim)
+            xc = xc + o @ lp["wo"]
+            h = rms_norm_ref(xc, lp["ln2"], c.rms_norm_eps)
+            ff = jax.nn.silu(h @ lp["wgate"]) * (h @ lp["wup"])
+            return (xc + ff @ lp["wdown"],), (kc_l, vc_l)
+
+        (x,), (ks, vs) = jax.lax.scan(body, (x,), (bp, pages_k, pages_v))
+        h_last = jax.lax.dynamic_index_in_dim(x, chunk_len - 1, 0,
+                                              keepdims=False)
+        return _head(hp, h_last), ks, vs
+
     def decode_step(params, toks, lengths, page_tables, pages_k, pages_v,
                     active):
         ep, bp, hp = params
@@ -805,7 +874,7 @@ def build_llama_paged_decode(config: LlamaConfig, page_size: int = 16,
         (x,), (ks, vs) = jax.lax.scan(body, (x,), (bp, pages_k, pages_v))
         return _head(hp, x), ks, vs
 
-    return init_pages, prefill, decode_step
+    return init_pages, prefill, prefill_chunk, decode_step
 
 
 def _sample_per_request(logits, key, temps, top_ps):

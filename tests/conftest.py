@@ -32,6 +32,21 @@ def _reseed():
 
 
 @pytest.fixture(autouse=True)
+def _no_page_refcount_leak():
+    """Every ServingEngine's page-refcount bookkeeping must exactly match
+    its live page tables + prefix cache when the test ends — a drifted
+    refcount (leak, double-count, page simultaneously free and referenced)
+    fails the test that caused it, not a later one."""
+    yield
+    import sys
+    paged = sys.modules.get("paddle_tpu.inference.paged")
+    if paged is None:
+        return
+    for eng in list(paged._LIVE_ENGINES):
+        eng.check_invariants()
+
+
+@pytest.fixture(autouse=True)
 def _no_fault_plan_leak():
     """A test that exits with a live FaultPlan (inject() scope not closed)
     would silently corrupt every later test's behavior — fail it here,

@@ -15,6 +15,7 @@ requires_8 = pytest.mark.skipif(jax.device_count() < 8, reason="needs 8 devices"
 # ---------------------------------------------------------------------------
 # ERNIE
 # ---------------------------------------------------------------------------
+@pytest.mark.slow   # heavy CPU compile (tier-1 870 s budget; ROADMAP)
 def test_ernie_mlm_forward_and_training():
     from paddle_tpu.models.ernie import ernie_config_tiny, ErnieForMaskedLM
     cfg = ernie_config_tiny(vocab=200, hidden=32, layers=2, heads=4, seq=32)
@@ -108,6 +109,7 @@ def test_ernie_sharding_stage2():
 # ---------------------------------------------------------------------------
 # SD UNet
 # ---------------------------------------------------------------------------
+@pytest.mark.slow   # heavy CPU compile (tier-1 870 s budget; ROADMAP)
 def test_unet_forward_shape_and_training():
     from paddle_tpu.models.unet import unet_config_tiny, UNet2DConditionModel
     paddle.seed(3)

@@ -43,6 +43,7 @@ def _run(mesh_axes, ep_axis, n_steps=4, n_micro=2, B=4):
 
 
 @requires_8
+@pytest.mark.slow   # heavy CPU compile (tier-1 870 s budget; ROADMAP)
 def test_moe_pipeline_pp_ep_matches_pp_only():
     """{pp:2, ep:2} with expert-sharded weights + all_to_all dispatch must
     track {pp:2} dense-local MoE exactly (ample capacity, same params)."""

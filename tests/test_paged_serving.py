@@ -187,8 +187,9 @@ class TestPagedDecodePath:
         cfg = llama_config_tiny(vocab=64, hidden=32, layers=2, heads=4, seq=32)
         params = _params(cfg)
         ps, NP = 4, 16
-        init_pages, prefill, decode_step = build_llama_paged_decode(
-            cfg, page_size=ps, num_pages=NP, attention_impl="ref")
+        init_pages, prefill, _prefill_chunk, decode_step = \
+            build_llama_paged_decode(
+                cfg, page_size=ps, num_pages=NP, attention_impl="ref")
         _, dense_prefill, dense_step = build_llama_decode(cfg, max_seq=32)
         ids = rng.integers(1, 64, (1, 6)).astype(np.int32)
 
@@ -245,6 +246,9 @@ class TestServingEngine:
                                             max_new_tokens=6))[0]
             np.testing.assert_array_equal(done[rid].output_ids, ref)
         # every page returned
+        # retired pages park in the prefix cache; releasing it must
+        # return EVERY page (any leak fails here)
+        eng.release_cache()
         assert eng.pool.num_free == eng.pool.num_pages
 
     def test_gqa_engine_parity(self):
@@ -276,6 +280,9 @@ class TestServingEngine:
         # eos-pads to fixed shape — prefix must agree, tail must be padding
         np.testing.assert_array_equal(out, ref[:len(out)])
         assert out[-1] == eos and (ref[len(out):] == eos).all()
+        # retired pages park in the prefix cache; releasing it must
+        # return EVERY page (any leak fails here)
+        eng.release_cache()
         assert eng.pool.num_free == eng.pool.num_pages
 
     def test_tight_pool_stall_recovers(self):
@@ -296,6 +303,9 @@ class TestServingEngine:
             ref = np.asarray(llama_generate(params, cfg, p[None],
                                             max_new_tokens=n))[0]
             np.testing.assert_array_equal(done[rid].output_ids, ref)
+        # retired pages park in the prefix cache; releasing it must
+        # return EVERY page (any leak fails here)
+        eng.release_cache()
         assert eng.pool.num_free == eng.pool.num_pages
 
     def test_former_deadlock_self_heals_via_preemption(self):
@@ -324,6 +334,9 @@ class TestServingEngine:
             ref = np.asarray(llama_generate(params, cfg, p[None],
                                             max_new_tokens=8))[0]
             np.testing.assert_array_equal(done[rid].output_ids, ref)
+        # retired pages park in the prefix cache; releasing it must
+        # return EVERY page (any leak fails here)
+        eng.release_cache()
         assert eng.pool.num_free == eng.pool.num_pages
 
     def test_submit_validation(self):

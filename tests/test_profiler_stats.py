@@ -26,6 +26,7 @@ def _train_some(steps=3):
     return float(loss.numpy())
 
 
+@pytest.mark.slow   # heavy CPU compile (tier-1 870 s budget; ROADMAP)
 def test_profiler_summary_has_named_ops_with_nonzero_times():
     prof = profiler.Profiler(targets=[profiler.ProfilerTarget.CPU])
     prof.start()

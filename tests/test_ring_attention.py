@@ -56,6 +56,7 @@ def test_ulysses_attention_matches_full(causal):
 
 
 @requires_8
+@pytest.mark.slow   # heavy CPU compile (tier-1 870 s budget; ROADMAP)
 def test_ring_attention_differentiable():
     mesh = build_mesh({"sep": 8})
     B, S, H, D = 1, 64, 2, 16

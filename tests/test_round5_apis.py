@@ -108,6 +108,7 @@ def test_pdist_vs_scipy(p):
                                atol=1e-5)
 
 
+@pytest.mark.slow   # heavy CPU compile (tier-1 870 s budget; ROADMAP)
 def test_pdist_grad_matches_cdist():
     x = rng.normal(0, 1, (5, 3)).astype(np.float32)
     xt = paddle.to_tensor(x, stop_gradient=False)
@@ -144,6 +145,7 @@ def test_lkj_sample_is_valid_cholesky(method, dim):
     assert (np.abs(off) <= 1.0 + 1e-6).all()
 
 
+@pytest.mark.slow   # heavy CPU compile (tier-1 870 s budget; ROADMAP)
 def test_lkj_log_prob_vs_torch():
     import torch
     from paddle_tpu.distribution import LKJCholesky
@@ -172,6 +174,7 @@ def test_lkj_dim2_eta1_uniform_marginal():
 # ---------------------------------------------------------------------------
 # vision models
 # ---------------------------------------------------------------------------
+@pytest.mark.slow   # heavy CPU compile (tier-1 870 s budget; ROADMAP)
 def test_lenet_forward_and_training():
     from paddle_tpu.vision.models import LeNet
     from paddle_tpu import optimizer
@@ -190,6 +193,7 @@ def test_lenet_forward_and_training():
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow   # ~40 unique conv compiles on CPU (tier-1 870 s budget)
 def test_googlenet_three_heads():
     from paddle_tpu.vision.models import googlenet
     paddle.seed(0)
@@ -203,6 +207,7 @@ def test_googlenet_three_heads():
     assert tuple(a2.shape) == (1, 12)
 
 
+@pytest.mark.slow   # ~40 unique conv compiles on CPU (tier-1 870 s budget)
 def test_inception_v3_forward():
     from paddle_tpu.vision.models import inception_v3
     paddle.seed(0)

@@ -38,6 +38,7 @@ def _sparse_to_dense(st):
     return np.asarray(st.to_dense().numpy())
 
 
+@pytest.mark.slow   # heavy CPU compile (tier-1 870 s budget; ROADMAP)
 @pytest.mark.parametrize("stride,padding", [(1, 1), (2, 1), (1, 0)])
 def test_sparse_conv3d_matches_dense(stride, padding):
     st, dense = _random_sparse_volume()
@@ -132,6 +133,7 @@ def test_sparse_batchnorm_and_activations():
                                rtol=1e-5)
 
 
+@pytest.mark.slow   # heavy CPU compile (tier-1 870 s budget; ROADMAP)
 def test_sparse_conv_gradients_flow():
     st, dense = _random_sparse_volume(density=0.3)
     conv = sparse.nn.SubmConv3D(2, 3, kernel_size=3, padding=1)
@@ -143,6 +145,7 @@ def test_sparse_conv_gradients_flow():
     assert conv.bias.grad is not None
 
 
+@pytest.mark.slow   # heavy CPU compile (tier-1 870 s budget; ROADMAP)
 def test_sparse_resnet_block_stack():
     """A small SubmConv -> BN -> ReLU -> Conv stack runs end to end."""
     st, _ = _random_sparse_volume(D=6, H=6, W=6, C=2, density=0.25)

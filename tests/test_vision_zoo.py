@@ -8,20 +8,35 @@ import paddle_tpu as paddle
 from paddle_tpu.vision import models as M
 from paddle_tpu import optimizer
 
+# the depthwise/dense-block families each compile ~50 unique conv shapes
+# on CPU (60-270 s apiece) — slow lane, per the ROADMAP 870 s tier-1
+# budget; alexnet/vgg11 stay tier-1 as the cheap conv representatives
+_HEAVY = pytest.mark.slow
 BUILDERS = [
-    ("mobilenet_v1", lambda: M.mobilenet_v1(scale=0.25, num_classes=10)),
-    ("mobilenet_v2", lambda: M.mobilenet_v2(scale=0.35, num_classes=10)),
-    ("mobilenet_v3_small", lambda: M.mobilenet_v3_small(num_classes=10)),
-    ("mobilenet_v3_large", lambda: M.mobilenet_v3_large(num_classes=10)),
-    ("densenet121", lambda: M.densenet121(num_classes=10)),
-    ("squeezenet1_1", lambda: M.squeezenet1_1(num_classes=10)),
-    ("shufflenet_v2_x1_0", lambda: M.shufflenet_v2_x1_0(num_classes=10)),
+    pytest.param("mobilenet_v1",
+                 lambda: M.mobilenet_v1(scale=0.25, num_classes=10),
+                 marks=_HEAVY),
+    pytest.param("mobilenet_v2",
+                 lambda: M.mobilenet_v2(scale=0.35, num_classes=10),
+                 marks=_HEAVY),
+    pytest.param("mobilenet_v3_small",
+                 lambda: M.mobilenet_v3_small(num_classes=10), marks=_HEAVY),
+    pytest.param("mobilenet_v3_large",
+                 lambda: M.mobilenet_v3_large(num_classes=10), marks=_HEAVY),
+    pytest.param("densenet121", lambda: M.densenet121(num_classes=10),
+                 marks=_HEAVY),
+    pytest.param("squeezenet1_1", lambda: M.squeezenet1_1(num_classes=10),
+                 marks=_HEAVY),
+    pytest.param("shufflenet_v2_x1_0",
+                 lambda: M.shufflenet_v2_x1_0(num_classes=10), marks=_HEAVY),
     ("alexnet", lambda: M.AlexNet(num_classes=10)),
     ("vgg11", lambda: M.vgg11(num_classes=10)),
 ]
 
 
-@pytest.mark.parametrize("name,mk", BUILDERS, ids=[b[0] for b in BUILDERS])
+@pytest.mark.parametrize("name,mk", BUILDERS,
+                         ids=[b.values[0] if hasattr(b, "values") else b[0]
+                              for b in BUILDERS])
 def test_vision_model_forward_and_one_step(name, mk):
     paddle.seed(0)
     model = mk()
@@ -40,6 +55,7 @@ def test_vision_model_forward_and_one_step(name, mk):
     assert np.isfinite(float(loss.numpy()))
 
 
+@pytest.mark.slow   # densenet161/169 ctors build hundreds of layers
 def test_densenet_variants_and_vgg_bn():
     # ctor-only for the big variants (full fwd is slow on CPU)
     for fn in (M.densenet161, M.densenet169):

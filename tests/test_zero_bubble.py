@@ -63,6 +63,7 @@ def test_zero_bubble_reduces_bubble(S, M):
 
 
 @requires_8
+@pytest.mark.slow   # heavy CPU compile (tier-1 870 s budget; ROADMAP)
 def test_zero_bubble_matches_1f1b_training():
     cfg = llama_config_tiny(vocab=64, hidden=32, layers=4, heads=4, seq=16)
     n_micro = 4
@@ -91,6 +92,7 @@ def test_zero_bubble_matches_1f1b_training():
 
 
 @requires_8
+@pytest.mark.slow   # heavy CPU compile (tier-1 870 s budget; ROADMAP)
 @pytest.mark.parametrize("pp,n_micro", [(2, 4), (2, 6), (4, 8)])
 def test_zero_bubble_grads_match_1f1b_n_micro_gt_pp(pp, n_micro):
     """Regression (advisor r3, zero_bubble.py _depths): with n_micro >
@@ -130,6 +132,7 @@ def test_zero_bubble_grads_match_1f1b_n_micro_gt_pp(pp, n_micro):
 
 
 @requires_8
+@pytest.mark.slow   # heavy CPU compile (tier-1 870 s budget; ROADMAP)
 def test_zero_bubble_with_dp():
     cfg = llama_config_tiny(vocab=64, hidden=32, layers=2, heads=4, seq=16)
     n_micro = 2
