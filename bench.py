@@ -24,6 +24,11 @@ Extras (the remaining BASELINE.md measurement-plan rows): ViT-L/16 and
 ResNet-50 (compiled functional train steps) images/sec, ERNIE-base MLM
 tokens/sec, SD-1.5-scale UNet images/sec, and the S=8192 long-context LLaMA
 config.
+
+Serving traces run standalone via `--trace {serving,shared-prefix,
+spec-decode}`; `--json PATH` dumps the selected trace's metrics dict as a
+BENCH_r0x-style artifact and `--seed` reproduces/varies the generated
+trace (each trace's default seed reproduces the PERF.md numbers).
 """
 from __future__ import annotations
 
@@ -469,7 +474,7 @@ def bench_llama_decode():
     return out
 
 
-def bench_serving():
+def bench_serving(seed=0):
     """Paged-KV continuous-batching serving throughput on a mixed-length
     Poisson-ish request trace, vs the static-batch `llama_generate_fused`
     baseline (PERF.md §8).
@@ -513,7 +518,7 @@ def bench_serving():
 
     ep, bp, hp, *_ = build_functional_llama(cfg, dtype=dtype, n_micro=1)
     params = (ep, bp, hp)
-    rng = np.random.default_rng(0)
+    rng = np.random.default_rng(seed)
     prompts = [rng.integers(0, cfg.vocab_size, (int(t),)).astype(np.int32)
                for t in rng.integers(len_lo, len_hi, n_req)]
     max_news = [int(m) for m in rng.integers(new_lo, new_hi, n_req)]
@@ -601,10 +606,11 @@ def bench_serving():
         "decode_horizon": horizon,
         "page_size": page_size,
         "num_slots": slots,
+        "engine_stats": eng.stats(),
     }
 
 
-def bench_serving_shared_prefix():
+def bench_serving_shared_prefix(seed=7):
     """Prefix-cache + chunked-prefill serving trace (PERF.md §10): N users
     share one system prompt, then each sends multi-turn follow-ups whose
     prompts embed the full prior conversation — the dominant production
@@ -646,7 +652,7 @@ def bench_serving_shared_prefix():
 
     ep, bp, hp, *_ = build_functional_llama(cfg, dtype=dtype, n_micro=1)
     params = (ep, bp, hp)
-    rng = np.random.default_rng(7)
+    rng = np.random.default_rng(seed)
     system = rng.integers(0, cfg.vocab_size, (sys_len,)).astype(np.int32)
     msgs = [[rng.integers(0, cfg.vocab_size,
                           (int(rng.integers(msg_lo, msg_hi)),)).astype(np.int32)
@@ -733,6 +739,125 @@ def bench_serving_shared_prefix():
     }
 
 
+def bench_serving_spec_decode(seed=0):
+    """Lossless self-speculative decoding trace (PERF.md §11): prompt-lookup
+    n-gram drafting + the K+1-position `verify_step` vs the SAME engine
+    with speculation off, on a repetitive/extractive workload.
+
+    Speculation only pays when the output stream is predictable, and raw
+    random weights have no linguistic redundancy — their greedy outputs
+    are arbitrary.  The trace therefore biases the model toward echo
+    behavior (block weights down-scaled so the residual stream stays
+    embedding-dominated, LM head tied to the embedding transpose), which
+    makes greedy decode settle into repetition — the structural analog of
+    extractive / template / multi-turn-echo traffic, independent of model
+    quality.  Both engines run the SAME model and trace; greedy outputs
+    are asserted bit-identical before any number is reported, and the
+    measured acceptance rate prints alongside the speedup so the result
+    can't overclaim (acceptance ~1.0 here is the trace's design point;
+    mixed traffic sits in between — parity holds at ANY acceptance)."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.models.llama import LlamaConfig, build_functional_llama
+    from paddle_tpu.inference.paged import ServingEngine
+
+    on_tpu = any(d.platform == "tpu" for d in jax.devices())
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
+                          intermediate_size=2816, num_hidden_layers=16,
+                          num_attention_heads=16, num_key_value_heads=4,
+                          max_position_embeddings=2048)
+        dtype = jnp.bfloat16
+        n_req, slots, page_size, horizon, t_bucket = 16, 8, 64, 16, 128
+        len_lo, len_hi, max_new, spec_k = 32, 128, 192, 8
+    else:
+        # bigger than the other CPU shakeout configs ON PURPOSE: ~65 MB of
+        # f32 weights exceeds typical L3, so decode is memory-bound the way
+        # TPU batch-1 decode is MXU-starved — the regime speculation is
+        # for.  (At cache-resident sizes the comparison just measures the
+        # host's momentary cache state and flips run to run.)
+        cfg = LlamaConfig(vocab_size=4096, hidden_size=512,
+                          intermediate_size=1536, num_hidden_layers=4,
+                          num_attention_heads=8, num_key_value_heads=2,
+                          max_position_embeddings=512)
+        dtype = jnp.float32
+        n_req, slots, page_size, horizon, t_bucket = 8, 4, 16, 16, 32
+        len_lo, len_hi, max_new, spec_k = 16, 48, 96, 8
+
+    ep, bp, hp, *_ = build_functional_llama(cfg, dtype=dtype, n_micro=1)
+    bp = {k: (v * 0.05 if k.startswith("w") else v) for k, v in bp.items()}
+    hp = dict(hp, lm=(ep["tok"].T * 4.0).astype(hp["lm"].dtype))
+    params = (ep, bp, hp)
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(1, cfg.vocab_size, (int(t),)).astype(np.int32)
+               for t in rng.integers(len_lo, len_hi, n_req)]
+    # pool sized so the whole trace (live slots + retired pages parked in
+    # the prefix cache) fits without eviction churn: this trace measures
+    # speculation, not memory pressure (eviction has its own drills)
+    worst = (len_hi + max_new) // page_size + 2
+    # warm prompts fixed up front so BOTH engines see the identical set
+    warm = [rng.integers(1, cfg.vocab_size, (Tb,)).astype(np.int32)
+            for Tb in sorted({((len(p) + t_bucket - 1) // t_bucket)
+                              * t_bucket for p in prompts})]
+
+    def run_trace(spec):
+        eng = ServingEngine(params, cfg, num_slots=slots,
+                            page_size=page_size,
+                            num_pages=(n_req + slots + 2) * worst,
+                            max_pages_per_seq=worst, dtype=dtype,
+                            decode_horizon=horizon, prompt_bucket=t_bucket,
+                            speculative=spec)
+        # warm every executable (prefill buckets + horizon + verify)
+        for w in warm:
+            eng.submit(w, max_new_tokens=horizon + spec_k + 2)
+        eng.run()
+        base_stats = eng.stats()
+        t0 = time.perf_counter()
+        rids = [eng.submit(p, max_new_tokens=max_new) for p in prompts]
+        done = eng.run()
+        dt = time.perf_counter() - t0
+        _sync(eng._pages_k[0, 0, 0, 0, 0])
+        outs = [done[r].output_ids for r in rids]
+        ttfts = [done[r].first_token_time - done[r].submit_time for r in rids]
+        stats = eng.stats()
+        prop = stats["draft_tokens_proposed"] - base_stats[
+            "draft_tokens_proposed"]
+        acc = stats["draft_tokens_accepted"] - base_stats[
+            "draft_tokens_accepted"]
+        return outs, {
+            "tokens_per_sec": round(n_req * max_new / dt, 1),
+            "ttft_p50_ms": round(float(np.percentile(ttfts, 50)) * 1e3, 1),
+            "ttft_p95_ms": round(float(np.percentile(ttfts, 95)) * 1e3, 1),
+            "draft_tokens_proposed": int(prop),
+            "draft_tokens_accepted": int(acc),
+            "accept_rate": round(acc / prop, 4) if prop else None,
+            "verify_steps": stats["verify_steps"]
+            - base_stats["verify_steps"],
+            "decode_steps": stats["decode_steps"]
+            - base_stats["decode_steps"],
+            "engine_stats": stats,
+        }
+
+    out_off, s_off = run_trace(None)
+    out_on, s_on = run_trace(spec_k)
+    # lossless or the numbers lie: bit-exact greedy parity asserted FIRST
+    for a, b in zip(out_off, out_on):
+        np.testing.assert_array_equal(a, b)
+    return {
+        "trace": {"n_requests": n_req, "max_new_tokens": max_new,
+                  "speculative_k": spec_k, "decode_horizon": horizon,
+                  "num_slots": slots, "page_size": page_size,
+                  "seed": int(seed)},
+        "outputs_bit_exact": True,
+        "useful_tokens": int(n_req * max_new),
+        "accept_rate": s_on["accept_rate"],
+        "speculative": s_on,
+        "baseline": s_off,
+        "speedup_vs_no_spec": round(s_on["tokens_per_sec"]
+                                    / s_off["tokens_per_sec"], 3),
+    }
+
+
 def main():
     import jax
     _setup_compile_cache()
@@ -748,10 +873,13 @@ def main():
                  ("sd15_unet_images_per_sec", bench_sd_unet, 450),
                  ("llama_271M_decode", bench_llama_decode, 250),
                  ("serving", bench_serving, 250),
-                 ("serving_shared_prefix", bench_serving_shared_prefix, 250)) \
+                 ("serving_shared_prefix", bench_serving_shared_prefix, 250),
+                 ("serving_spec_decode", bench_serving_spec_decode, 250)) \
         if on_tpu else (("serving", bench_serving, 250),
                         ("serving_shared_prefix",
-                         bench_serving_shared_prefix, 250))
+                         bench_serving_shared_prefix, 250),
+                        ("serving_spec_decode",
+                         bench_serving_spec_decode, 250))
     import signal
 
     def _alarm(_sig, _frm):
@@ -809,18 +937,35 @@ def main():
 if __name__ == "__main__":
     import argparse
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--trace", choices=["shared-prefix", "serving"],
+    ap.add_argument("--trace",
+                    choices=["shared-prefix", "serving", "spec-decode"],
                     default=None,
                     help="run ONE serving trace and print its JSON line "
                          "(shared-prefix: prefix-cache hit-rate / "
                          "prefill-tokens-saved / TTFT; serving: the mixed-"
-                         "length continuous-batching trace)")
+                         "length continuous-batching trace; spec-decode: "
+                         "self-speculative decoding vs speculation off)")
+    ap.add_argument("--json", metavar="PATH", default=None,
+                    help="also dump the metrics dict to PATH as a JSON "
+                         "artifact (BENCH_r0x-style)")
+    ap.add_argument("--seed", type=int, default=None,
+                    help="seed for trace generation (default: each trace's "
+                         "own fixed seed, so unseeded runs reproduce the "
+                         "published numbers)")
     args = ap.parse_args()
+    if args.trace is None and (args.json or args.seed is not None):
+        ap.error("--json/--seed only apply to a serving trace; "
+                 "pass --trace {shared-prefix,serving,spec-decode}")
     if args.trace is not None:
         _setup_compile_cache()
         fn = {"shared-prefix": bench_serving_shared_prefix,
-              "serving": bench_serving}[args.trace]
-        print(json.dumps({"metric": f"trace_{args.trace.replace('-', '_')}",
-                          **fn()}))
+              "serving": bench_serving,
+              "spec-decode": bench_serving_spec_decode}[args.trace]
+        res = fn() if args.seed is None else fn(seed=args.seed)
+        out = {"metric": f"trace_{args.trace.replace('-', '_')}", **res}
+        print(json.dumps(out))
+        if args.json:
+            with open(args.json, "w") as f:
+                json.dump(out, f, indent=2)
     else:
         main()

@@ -29,7 +29,13 @@ continuous batching:
     static-batch `llama_generate_fused` baseline.  Long prompts prefill
     in fixed `prefill_chunk`-token chunks interleaved with decode
     horizons (chunked prefill), so time-to-first-token for queued short
-    requests is bounded instead of head-of-line blocked.
+    requests is bounded instead of head-of-line blocked.  With
+    `speculative=K`, a host-side prompt-lookup n-gram index drafts up to
+    K continuation tokens per greedy slot and one `verify_step` dispatch
+    scores all K+1 positions — the engine accepts the longest matching
+    draft prefix plus a bonus token (lossless under greedy sampling by
+    construction), multiplying useful tokens per forward pass on
+    repetitive/extractive traffic.
 
 Pages are allocated LAZILY: a request holds ceil(len/page_size) pages at
 every moment, growing one page at a time as decode crosses page
@@ -383,6 +389,64 @@ class PrefixCache:
         self.pool.free([e.page])
 
 
+class _NgramDraft:
+    """Prompt-lookup n-gram draft proposer (self-speculative decoding —
+    no draft model, no extra weights): a suffix-match index over this
+    request's prompt + emitted tokens.  Each (min_n..max_n)-gram maps to
+    the start of its most recent continuation; `propose(k)` returns up to
+    k tokens that followed the LONGEST matching suffix n-gram the last
+    time it occurred.  When the match runs off the end of the sequence,
+    the continuation extrapolates periodically at the match lag — exact
+    for cyclic output and free to be wrong otherwise (a rejected draft
+    costs nothing extra: the verify dispatch is padded to a static K
+    anyway).  Index updates are O(max_n) per emitted token."""
+
+    __slots__ = ("toks", "min_n", "max_n", "_idx")
+
+    def __init__(self, tokens, min_n: int = 1, max_n: int = 3):
+        self.min_n, self.max_n = int(min_n), int(max_n)
+        self._idx = [dict() for _ in range(self.max_n - self.min_n + 1)]
+        self.toks: list[int] = []
+        for t in np.asarray(tokens, np.int32).reshape(-1):
+            self.append(int(t))
+
+    def append(self, tok: int):
+        self.toks.append(int(tok))
+        # index the n-grams ending at the PREVIOUS position: deferring the
+        # insert by one token means (a) every indexed occurrence has at
+        # least one continuation token, and (b) the current suffix can
+        # never match itself
+        e = len(self.toks) - 1            # continuation start
+        if e <= 0:
+            return
+        for j in range(len(self._idx)):
+            n = self.min_n + j
+            if e >= n:
+                self._idx[j][tuple(self.toks[e - n:e])] = e
+
+    def propose(self, k: int) -> list:
+        """Up to k draft tokens continuing the longest-matching suffix
+        n-gram's most recent earlier occurrence; [] when nothing matches."""
+        if k <= 0:
+            return []
+        T = len(self.toks)
+        for j in range(len(self._idx) - 1, -1, -1):   # longest n first
+            n = self.min_n + j
+            if T < n:
+                continue
+            pos = self._idx[j].get(tuple(self.toks[-n:]))
+            if pos is None:
+                continue
+            out = []
+            for i in range(k):
+                src = pos + i
+                # past the end: the sequence "continues" with the lag-
+                # periodic extension (out already holds those predictions)
+                out.append(self.toks[src] if src < T else out[src - T])
+            return out
+        return []
+
+
 @dataclass
 class Request:
     """One serving request: prompt + generation budget + sampling params."""
@@ -402,6 +466,17 @@ class Request:
     preemptions: int = 0               # times evicted + requeued mid-flight
     cached_prefix_tokens: int = 0      # prefix-cache tokens attached (total
                                        #   across re-prefills)
+    draft_proposed: int = 0            # speculative draft tokens proposed
+    draft_accepted: int = 0            #   ... greedy-verified AND emitted
+                                       #   (an EOS/budget freeze mid-run
+                                       #   discards the tail uncounted)
+
+    @property
+    def draft_accept_rate(self) -> float:
+        """Fraction of this request's proposed draft tokens the verify
+        step accepted (0.0 when nothing was ever proposed)."""
+        return self.draft_accepted / self.draft_proposed \
+            if self.draft_proposed else 0.0
 
     @property
     def output_ids(self) -> np.ndarray:
@@ -411,7 +486,8 @@ class Request:
 
 class _Slot:
     __slots__ = ("req", "pages", "pending", "stalled", "admit_seq",
-                 "prefill_pos", "ctx", "resuming", "chunk_step")
+                 "prefill_pos", "ctx", "resuming", "chunk_step",
+                 "draft", "spec_k")
 
     def __init__(self, req, pages, pending, admit_seq=0):
         self.req = req
@@ -424,6 +500,8 @@ class _Slot:
         self.resuming = False          # re-admission after preemption
         self.chunk_step = -1           # engine step of the last chunk run
                                        #   (one chunk per slot per step)
+        self.draft = None              # _NgramDraft (speculative mode only)
+        self.spec_k = 0                # adaptive per-slot draft length
 
 
 # every live engine, for the tests' refcount-invariant leak guard
@@ -444,8 +522,14 @@ class ServingEngine:
     prompts sharing a page-aligned prefix attach those pages read-only and
     prefill only the suffix.  `prefill_chunk=N` bounds any single prefill
     dispatch to N tokens, interleaving long-prompt prefill with decode
-    horizons (chunked prefill).  Both knobs preserve greedy outputs
-    bit-exactly vs the cache-off engine."""
+    horizons (chunked prefill).  `speculative=K` turns on lossless
+    self-speculative decoding: a host-side n-gram index over each
+    request's prompt + emitted tokens drafts up to K continuation tokens
+    (prompt-lookup — no draft model), one `verify_step` dispatch scores
+    all K+1 positions, and the engine accepts the longest draft prefix
+    whose argmax matches, emitting up to K+1 tokens per forward pass.
+    All three knobs preserve greedy outputs bit-exactly vs the plain
+    engine."""
 
     def __init__(self, params, config, num_slots: int = 4,
                  page_size: int = 16, num_pages: int | None = None,
@@ -453,7 +537,8 @@ class ServingEngine:
                  attention_impl: str = "auto", interpret: bool = False,
                  prompt_bucket: int = 32, decode_horizon: int = 8,
                  seed: int = 0, max_queue: int | None = None,
-                 prefix_cache: bool = True, prefill_chunk: int | None = None):
+                 prefix_cache: bool = True, prefill_chunk: int | None = None,
+                 speculative: int | None = None, spec_max_ngram: int = 3):
         import jax
         import jax.numpy as jnp
         from ..models.llama import (build_llama_paged_decode,
@@ -474,8 +559,12 @@ class ServingEngine:
             else max(1, int(prefill_chunk))
         self.prompt_bucket = int(prompt_bucket)
         self.decode_horizon = max(1, int(decode_horizon))
+        # speculative=K: lossless self-speculative decoding — n-gram drafts
+        # verified K+1 positions at a time (greedy slots only; 0/None off)
+        self.speculative = 0 if not speculative else int(speculative)
+        self.spec_max_ngram = max(1, int(spec_max_ngram))
 
-        init_pages, prefill, prefill_chunk_fn, decode_step = \
+        init_pages, prefill, prefill_chunk_fn, decode_step, verify_step = \
             build_llama_paged_decode(
                 config, page_size=page_size, num_pages=num_pages, dtype=dtype,
                 attention_impl=attention_impl, interpret=interpret)
@@ -559,6 +648,10 @@ class ServingEngine:
         self._sample_fn = _sample_logits
         self._sample_jit = {}          # greedy -> jitted sampler
         self._copy_jit = jax.jit(_copy_page, donate_argnums=(0, 1))
+        # one wrapper: drafts pad to the STATIC K+1 query width, so the
+        # verify executable compiles once per engine K (jax.jit caches by
+        # shape) even when slots draft fewer tokens or none at all
+        self._verify_jit = jax.jit(verify_step, donate_argnums=(4, 5))
 
         # host-side slot state
         S, P = self.num_slots, self.max_pages_per_seq
@@ -585,6 +678,9 @@ class ServingEngine:
         self.prefill_tokens = 0        # prefill tokens actually executed
         self.cache_evictions = 0       # cached pages evicted under pressure
         self.cow_copies = 0            # copy-on-write page copies
+        self.verify_steps = 0          # speculative verify dispatches
+        self.draft_tokens_proposed = 0  # draft tokens sent to verify
+        self.draft_tokens_accepted = 0  # ... whose argmax matched
         _LIVE_ENGINES.add(self)
 
     # -- submission --------------------------------------------------------
@@ -734,6 +830,8 @@ class ServingEngine:
         slot = self._slots[s]
         req = slot.req
         req.generated.append(int(tok))
+        if slot.draft is not None:
+            slot.draft.append(int(tok))
         if req.first_token_time == 0.0:
             req.first_token_time = time.perf_counter()
         self.tokens_generated += 1
@@ -813,6 +911,15 @@ class ServingEngine:
             slot.resuming = resuming
             self._admit_seq += 1
             self._slots[s] = slot
+            if self.speculative and req.temperature <= 0.0:
+                # n-gram index over prompt + EVERY emitted token (ctx drops
+                # the pending one; a preemption victim's index rebuilds
+                # here from its full history)
+                slot.spec_k = self.speculative
+                slot.draft = _NgramDraft(
+                    req.prompt if not resuming else np.concatenate(
+                        [req.prompt, np.asarray(req.generated, np.int32)]),
+                    max_n=self.spec_max_ngram)
             row = np.zeros((self.max_pages_per_seq,), np.int32)
             row[:len(pages)] = pages
             self._page_tables[s] = row
@@ -926,37 +1033,48 @@ class ServingEngine:
             # still the pending one — no fresh sample needed
             slot.pending = int(req.generated[-1])
         else:
-            greedy = req.temperature <= 0.0
-            sf = self._sample_jit.get(greedy)
-            if sf is None:
-                fn = self._sample_fn
-                sf = self._jax.jit(
-                    (lambda *a: fn(*a, greedy=True)) if greedy
-                    else (lambda *a: fn(*a, greedy=False)))
-                self._sample_jit[greedy] = sf
-            tok = sf(logits, self._split_key(),
-                     jnp.asarray(req.temperature, jnp.float32),
-                     jnp.asarray(req.top_p, jnp.float32))
+            tok = self._sampler(req.temperature <= 0.0)(
+                logits, self._split_key(),
+                jnp.asarray(req.temperature, jnp.float32),
+                jnp.asarray(req.top_p, jnp.float32))
             self._record_token(s, int(np.asarray(tok)))
+
+    def _sampler(self, greedy: bool):
+        """Jitted single-logits sampler, cached per greedy flag (the final
+        chunk of a chunked/suffix prefill and the sampled lanes of a
+        speculative verify share it)."""
+        sf = self._sample_jit.get(greedy)
+        if sf is None:
+            fn = self._sample_fn
+            sf = self._jax.jit(
+                (lambda *a: fn(*a, greedy=True)) if greedy
+                else (lambda *a: fn(*a, greedy=False)))
+            self._sample_jit[greedy] = sf
+        return sf
 
     def _remaining(self, s: int) -> int:
         req = self._slots[s].req
         return req.max_new_tokens - len(req.generated)
 
-    def _provision(self, steps: int):
+    def _provision(self, steps):
         """Lazy page growth for up to `steps` decode steps ahead: every
         DECODING slot gets pages covering write positions < lengths +
         min(steps, remaining); mid-prefill slots are skipped (their pages
-        were provisioned at admission).  When the pool runs short the
-        prefix cache is evicted first (degradation ladder); a slot that
-        still cannot be covered stalls this horizon.  A shared page about
-        to receive a write is copied first (copy-on-write — belt and
-        braces: admission already copies the only shareable written page).
-        Returns the list of runnable slot indices."""
+        were provisioned at admission).  `steps` is an int (uniform
+        horizon) or a {slot: tokens} dict of per-slot needs (the verify
+        path: 1 + draft length; slots absent from the dict are draftless
+        ride-along lanes writing a single token).  When the pool runs
+        short the prefix cache is evicted first (degradation ladder); a
+        slot that still cannot be covered stalls this horizon.  A shared
+        page about to receive a write is copied first (copy-on-write —
+        belt and braces: admission already copies the only shareable
+        written page).  Returns the list of runnable slot indices."""
+        per_slot = steps if isinstance(steps, dict) else None
         run = []
         for s, slot in enumerate(self._slots):
             if slot is None or slot.prefill_pos is not None:
                 continue
+            want = per_slot.get(s, 1) if per_slot is not None else steps
             slot.stalled = False
             w0 = int(self._lengths[s]) // self.page_size
             if w0 < len(slot.pages) \
@@ -967,7 +1085,7 @@ class ServingEngine:
                     slot.stalled = True
                     continue
                 self._cow(s, w0)
-            m = min(steps, self._remaining(s))
+            m = min(want, self._remaining(s))
             need = math.ceil((int(self._lengths[s]) + m) / self.page_size)
             grow = need - len(slot.pages)
             if grow > 0:
@@ -982,6 +1100,101 @@ class ServingEngine:
                 self._page_tables[s, start:start + grow] = pages
             run.append(s)
         return run
+
+    # -- speculative decoding ----------------------------------------------
+    def _propose_drafts(self) -> dict:
+        """{slot -> draft tokens} for every decoding greedy slot whose
+        n-gram index has a match this step.  Draft length is clamped to
+        the slot's ADAPTIVE spec_k (shrunk while drafts keep missing,
+        regrown on full acceptance) and to remaining-1 so an accepted run
+        plus the bonus token can never overrun the request's budget — the
+        page math then stays within the pages `submit` promised."""
+        drafts = {}
+        for s, slot in enumerate(self._slots):
+            if slot is None or slot.prefill_pos is not None \
+                    or slot.draft is None:
+                continue
+            k = min(slot.spec_k, self.speculative, self._remaining(s) - 1)
+            if k <= 0:
+                continue
+            d = slot.draft.propose(k)
+            if d:
+                drafts[s] = d
+        return drafts
+
+    def _verify(self, run, drafts):
+        """One speculative verify dispatch over the runnable slots: score
+        pending + draft tokens at K+1 positions, accept the longest draft
+        prefix whose argmax matches (lossless under greedy sampling), emit
+        accepted tokens + the bonus token, and REWIND `lengths` past
+        rejected positions — the stale K/V scattered for rejected drafts
+        sits above the rewound length, is never attended (every attention
+        path masks by lengths), and is overwritten by the next write at
+        that position.  EOS/budget freezes mid-run exactly as in the
+        decode horizon (`_record_token` stops the emit loop); sampled
+        (temperature > 0) slots ride the same dispatch as single-token
+        lanes drawn from the position-0 logits."""
+        jnp = self._jnp
+        Q = self.speculative + 1
+        S = self.num_slots
+        toks = np.zeros((S, Q), np.int32)
+        n_q = np.zeros((S,), np.int32)
+        for s in run:
+            slot = self._slots[s]
+            d = drafts.get(s, ())
+            toks[s, 0] = slot.pending
+            if d:
+                toks[s, 1:1 + len(d)] = d
+            n_q[s] = 1 + len(d)
+        logits0, gtoks, self._pages_k, self._pages_v = self._verify_jit(
+            self.params, jnp.asarray(toks), jnp.asarray(self._lengths),
+            jnp.asarray(self._page_tables), self._pages_k, self._pages_v,
+            jnp.asarray(n_q))
+        gtoks = np.asarray(gtoks)
+        self.steps_run += 1
+        self.verify_steps += 1
+        for s in run:
+            slot = self._slots[s]
+            req = slot.req
+            d = list(drafts.get(s, ()))
+            nd = len(d)
+            old = int(self._lengths[s])
+            if req.temperature > 0.0:
+                tok = self._sampler(False)(
+                    logits0[s], self._split_key(),
+                    jnp.asarray(req.temperature, jnp.float32),
+                    jnp.asarray(req.top_p, jnp.float32))
+                emitted = [int(np.asarray(tok))]
+                acc = 0
+            else:
+                acc = 0
+                while acc < nd and int(gtoks[s, acc]) == d[acc]:
+                    acc += 1
+                emitted = d[:acc] + [int(gtoks[s, acc])]
+            if nd:
+                if acc == nd:          # fully accepted: regrow toward K
+                    slot.spec_k = min(self.speculative, slot.spec_k + 1)
+                elif acc == 0:         # whiffed: back off (floor 1 — the
+                    slot.spec_k = max(1, slot.spec_k // 2)  # lane is padded
+                                       # to static K either way)
+            n_emitted = 0
+            for i, tok in enumerate(emitted, 1):
+                # advance/rewind: cache now validly holds the pending token
+                # plus i-1 accepted drafts past the old length
+                self._lengths[s] = old + i
+                n_emitted = i
+                if self._record_token(s, int(tok)):
+                    break
+            if nd:
+                # credit only drafts that actually LANDED: an EOS/budget
+                # freeze mid-run discards the tail of an accepted run, and
+                # the reported acceptance rate must reflect useful tokens
+                # (spec_k adaptation above still keys off model-level acc)
+                used = min(acc, n_emitted)
+                self.draft_tokens_proposed += nd
+                self.draft_tokens_accepted += used
+                req.draft_proposed += nd
+                req.draft_accepted += used
 
     def _horizon_exec(self, K: int, greedy: bool):
         fn = self._horizon_jit.get((K, greedy))
@@ -1030,6 +1243,23 @@ class ServingEngine:
                 prefilled = True
         if prefilled:
             self._admit()              # a 1-token request may have retired
+        # speculative decoding: when any slot has a draft, ONE verify
+        # dispatch scores K+1 positions per slot (slots without drafts ride
+        # along as plain single-token lanes — mixed batches are the normal
+        # case).  Draftless steps and pool-tight steps fall through to the
+        # decode horizon below, so the degradation ladder is untouched.
+        if self.speculative:
+            drafts = self._propose_drafts()
+            if drafts:
+                # per-slot need: 1 + draft length covers every K/V write
+                # (padding lanes hit the trash page); draftless ride-along
+                # lanes need a single token — no K+1 over-provisioning
+                # that would evict cache / stall them under pool pressure
+                run = self._provision(
+                    {s: 1 + len(d) for s, d in drafts.items()})
+                if run:
+                    self._verify(run, drafts)
+                    return True
         K = self.decode_horizon
         run = self._provision(K)
         if not run and K > 1:
@@ -1115,6 +1345,31 @@ class ServingEngine:
         return dict(self._finished)
 
     # -- accounting / invariants -------------------------------------------
+    def stats(self) -> dict:
+        """Engine observability: one dict of monotonically increasing
+        counters (bench traces print it; dashboards diff it).
+        `decode_steps` and `verify_steps` are DISJOINT dispatch counts
+        (plain horizon vs speculative verify); their sum is the total
+        number of engine dispatches (`steps_run`)."""
+        prop = self.draft_tokens_proposed
+        acc = self.draft_tokens_accepted
+        return {
+            "tokens_generated": self.tokens_generated,
+            "decode_steps": self.steps_run - self.verify_steps,
+            "verify_steps": self.verify_steps,
+            "draft_tokens_proposed": prop,
+            "draft_tokens_accepted": acc,
+            "draft_accept_rate": round(acc / prop, 4) if prop else 0.0,
+            "prefill_tokens_executed": self.prefill_tokens,
+            "cached_prefix_tokens": self.cache_hit_tokens,
+            "cache_hits": self.cache_hits,
+            "cache_evictions": self.cache_evictions,
+            "cow_copies": self.cow_copies,
+            "preemptions": self.preemptions,
+            "timeouts": self.timeouts,
+            "rejections": self.rejections,
+        }
+
     def release_cache(self) -> int:
         """Drop every evictable cached page back to the free list (tests,
         shutdown, or a host that wants its HBM back); returns pages

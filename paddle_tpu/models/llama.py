@@ -661,7 +661,7 @@ def build_llama_paged_decode(config: LlamaConfig, page_size: int = 16,
     sequences occupy memory (and attention FLOPs) proportional to their OWN
     length instead of the longest sequence in the batch.
 
-    Returns (init_pages, prefill, prefill_chunk, decode_step):
+    Returns (init_pages, prefill, prefill_chunk, decode_step, verify_step):
 
       pages = init_pages()
           {"k","v": [L, Hkv, num_pages + 1, page_size, head_dim]} — the last
@@ -702,6 +702,16 @@ def build_llama_paged_decode(config: LlamaConfig, page_size: int = 16,
           Pallas ragged paged kernel (attention_impl "pallas"/"auto"-on-TPU)
           or its jnp gather fallback ("ref"/"auto"-off-TPU).
 
+      logits0, greedy, pages_k, pages_v = verify_step(params, toks, lengths,
+                                                      page_tables, pages_k,
+                                                      pages_v, n_q)
+          Speculative-decoding verify: toks [S, K+1] (pending token +
+          draft tokens per slot), n_q [S] valid query counts — scores all
+          K+1 positions in one dispatch so the engine can accept the
+          longest draft prefix whose argmax matches (lossless under
+          greedy sampling).  See the fn docstring for the rewind
+          contract.
+
     All shapes static; jit once and every decode step of a whole serving
     run reuses the same executable regardless of which requests occupy
     which slots.
@@ -738,11 +748,13 @@ def build_llama_paged_decode(config: LlamaConfig, page_size: int = 16,
         return paged_attention_decode_ref(q, kc_l, vc_l, page_tables, eff_len)
 
     def _rope_at(x, sin_p, cos_p):
-        # x: [S, H, D]; sin_p/cos_p: [S, D] (per-row positions)
+        # x: [..., H, D]; sin_p/cos_p: [..., D] (per-row positions — the
+        # leading dims are [S] for decode, [C] for chunks, [S, Q] for the
+        # multi-token verify step)
         half = x.shape[-1] // 2
         x1, x2 = x[..., :half], x[..., half:]
         rot = jnp.concatenate([-x2, x1], axis=-1)
-        return x * cos_p[:, None, :] + rot * sin_p[:, None, :]
+        return x * cos_p[..., None, :] + rot * sin_p[..., None, :]
 
     def _head(hp, h_last):
         h = rms_norm_ref(h_last, hp["ln_f"], c.rms_norm_eps)
@@ -874,7 +886,85 @@ def build_llama_paged_decode(config: LlamaConfig, page_size: int = 16,
         (x,), (ks, vs) = jax.lax.scan(body, (x,), (bp, pages_k, pages_v))
         return _head(hp, x), ks, vs
 
-    return init_pages, prefill, prefill_chunk, decode_step
+    def verify_step(params, toks, lengths, page_tables, pages_k, pages_v,
+                    n_q):
+        """Multi-token speculative VERIFY (self-speculative decoding):
+        score Q = K+1 query positions per slot in ONE dispatch.  Per slot,
+        toks[s, 0] is the pending token (the last sampled token, not yet
+        in the cache) and toks[s, 1:] its draft tokens; n_q[s] counts the
+        VALID queries (1 + drafts; 0 marks an inactive slot — padding
+        lanes write to the trash page and return garbage the engine
+        ignores).  Every valid query's K/V scatters into the slot's pages
+        at absolute positions lengths[s]..lengths[s]+n_q[s]-1 (RoPE at
+        those positions), then attends over the page-table-gathered
+        context under an intra-chunk causal mask — `prefill_chunk`'s
+        machinery, batched over slots.  Returns (logits0 [S, vocab] f32 —
+        position-0 logits for sampled slots; greedy [S, Q] int32 — argmax
+        per position, the engine's acceptance test; pages_k; pages_v).
+
+        Rewind contract: K/V written for drafts the engine then REJECTS
+        sits at positions >= the rewound `lengths` — every attention path
+        masks by `lengths`, so stale entries are overwritten by later
+        writes before any query can ever attend to them."""
+        ep, bp, hp = params
+        S, Q = toks.shape
+        P = page_tables.shape[1]
+        x = ep["tok"][toks].astype(d)                 # [S, Q, H]
+        q_idx = jnp.arange(Q)
+        valid = q_idx[None, :] < n_q[:, None]         # [S, Q]
+        pos = lengths[:, None] + q_idx[None, :]       # [S, Q] absolute
+        # out-of-range indices on the padding lanes clip (jax gather
+        # semantics) and are routed to TRASH by the `valid` mask anyway
+        page = jnp.where(valid, jnp.take_along_axis(
+            page_tables, pos // page_size, axis=1), TRASH)
+        off = pos % page_size
+        sin, cos = sin_t[pos], cos_t[pos]             # [S, Q, D]
+        kv_pos = jnp.arange(P * page_size)            # [P*ps] logical pos
+        mask = (kv_pos[None, None, :] <= pos[:, :, None]) \
+            & valid[:, :, None]                       # [S, Q, P*ps]
+        scale = 1.0 / math.sqrt(head_dim)
+
+        def body(carry, layer_in):
+            xc, = carry
+            lp, kc_l, vc_l = layer_in
+            h = rms_norm_ref(xc, lp["ln1"], c.rms_norm_eps)
+            q = (h @ lp["wq"]).reshape(S, Q, nh, head_dim)
+            k = (h @ lp["wk"]).reshape(S, Q, nkv, head_dim)
+            v = (h @ lp["wv"]).reshape(S, Q, nkv, head_dim)
+            q = _rope_at(q, sin, cos)
+            k = _rope_at(k, sin, cos)
+            kc_l = kc_l.at[:, page, off].set(
+                k.astype(d).transpose(2, 0, 1, 3))
+            vc_l = vc_l.at[:, page, off].set(
+                v.astype(d).transpose(2, 0, 1, 3))
+            # gather each slot's whole context through its page table —
+            # ONE gather serves all Q queries (the per-token decode path
+            # pays it per token)
+            kf = kc_l[:, page_tables].transpose(1, 0, 2, 3, 4) \
+                .reshape(S, nkv, P * page_size, head_dim)
+            vf = vc_l[:, page_tables].transpose(1, 0, 2, 3, 4) \
+                .reshape(S, nkv, P * page_size, head_dim)
+            rep = nh // nkv
+            if rep > 1:
+                kf = jnp.repeat(kf, rep, axis=1)
+                vf = jnp.repeat(vf, rep, axis=1)
+            s = jnp.einsum("sqhd,shkd->shqk", q.astype(jnp.float32),
+                           kf.astype(jnp.float32)) * scale
+            s = jnp.where(mask[:, None, :, :], s, -jnp.inf)
+            p = jax.nn.softmax(s, axis=-1).astype(xc.dtype)
+            o = jnp.einsum("shqk,shkd->sqhd", p, vf) \
+                .reshape(S, Q, nh * head_dim)
+            xc = xc + o @ lp["wo"]
+            h = rms_norm_ref(xc, lp["ln2"], c.rms_norm_eps)
+            ff = jax.nn.silu(h @ lp["wgate"]) * (h @ lp["wup"])
+            return (xc + ff @ lp["wdown"],), (kc_l, vc_l)
+
+        (x,), (ks, vs) = jax.lax.scan(body, (x,), (bp, pages_k, pages_v))
+        logits = _head(hp, x)                         # [S, Q, V] f32
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return logits[:, 0], greedy, ks, vs
+
+    return init_pages, prefill, prefill_chunk, decode_step, verify_step
 
 
 def _sample_per_request(logits, key, temps, top_ps):

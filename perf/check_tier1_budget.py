@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""Tier-1 time-budget checker (ISSUE 4 satellite).
+
+Parses a pytest log that was run with `--durations=0` (per-test timing
+lines like `12.34s call tests/test_x.py::TestY::test_z`) and FAILS when:
+
+  * cumulative runtime exceeds --fraction of the --budget (the ROADMAP
+    tier-1 budget is 870 s; the default fraction leaves headroom for the
+    ~2x machine-speed variance this host shows run to run), or
+  * any single test's `call` phase exceeds --max-single seconds (the
+    tier-1 lane runs `-m 'not slow'`, so every test in the log is a
+    non-slow test — a 20 s+ test belongs in the slow lane).
+
+Cumulative runtime prefers the pytest summary wall clock (`... in 681.2s`)
+when present — it includes collection and fixture overhead the duration
+lines miss — and falls back to the summed durations otherwise.
+
+Usage (see README §Tests / bench and the Makefile `tier1-budget` target):
+
+    python -m pytest tests/ -q -m 'not slow' --durations=0 ... | tee t1.log
+    python perf/check_tier1_budget.py t1.log
+
+Exit code 0 = within budget, 1 = over budget (with a report of the
+offenders), 2 = the log has no parsable timing information.
+"""
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+
+# `   12.34s call     tests/test_x.py::test_y`  (also setup/teardown)
+_DURATION = re.compile(
+    r"^\s*(\d+(?:\.\d+)?)s\s+(call|setup|teardown)\s+(\S+)")
+# `==== 1200 passed, 3 failed in 681.23s (0:11:21) ====`
+_SUMMARY = re.compile(r"\bin (\d+(?:\.\d+)?)s(?:\s|\b)")
+
+
+def parse_log(text: str):
+    """-> (durations: list[(seconds, phase, test_id)], wall: float | None)"""
+    durations = []
+    wall = None
+    for line in text.splitlines():
+        m = _DURATION.match(line)
+        if m:
+            durations.append((float(m.group(1)), m.group(2), m.group(3)))
+            continue
+        if "passed" in line or "failed" in line or "error" in line:
+            m = _SUMMARY.search(line)
+            if m:
+                wall = float(m.group(1))
+    return durations, wall
+
+
+def check(text: str, budget: float, fraction: float, max_single: float):
+    """-> (ok: bool, report: str). Raises ValueError on an unparsable log."""
+    durations, wall = parse_log(text)
+    if not durations and wall is None:
+        raise ValueError(
+            "no timing information found — run pytest with --durations=0 "
+            "(and without -p no:terminal) so per-test durations are logged")
+    summed = sum(d for d, _, _ in durations)
+    cumulative = wall if wall is not None else summed
+    limit = budget * fraction
+    lines = []
+    ok = True
+    if cumulative > limit:
+        ok = False
+        lines.append(
+            f"FAIL cumulative runtime {cumulative:.1f}s exceeds "
+            f"{fraction:.0%} of the {budget:.0f}s tier-1 budget "
+            f"({limit:.1f}s) — demote heavy tests to @pytest.mark.slow "
+            f"(ROADMAP tier-1 note)")
+    else:
+        lines.append(
+            f"ok   cumulative runtime {cumulative:.1f}s within "
+            f"{fraction:.0%} of the {budget:.0f}s budget ({limit:.1f}s)")
+    slowest = sorted((x for x in durations if x[1] == "call"), reverse=True)
+    offenders = [x for x in slowest if x[0] > max_single]
+    if offenders:
+        ok = False
+        lines.append(
+            f"FAIL {len(offenders)} non-slow test(s) exceed "
+            f"{max_single:.0f}s per test:")
+        for secs, _, tid in offenders[:20]:
+            lines.append(f"       {secs:8.1f}s  {tid}")
+    elif slowest:
+        secs, _, tid = slowest[0]
+        lines.append(f"ok   slowest single test {secs:.1f}s "
+                     f"(< {max_single:.0f}s): {tid}")
+    return ok, "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("log", help="pytest log file (run with --durations=0)")
+    ap.add_argument("--budget", type=float, default=870.0,
+                    help="tier-1 budget in seconds (ROADMAP: 870)")
+    ap.add_argument("--fraction", type=float, default=0.9,
+                    help="fail when cumulative runtime exceeds this "
+                         "fraction of the budget (default 0.9 — headroom "
+                         "for machine-speed variance)")
+    ap.add_argument("--max-single", type=float, default=20.0,
+                    help="fail when any single non-slow test's call phase "
+                         "exceeds this many seconds (default 20)")
+    args = ap.parse_args(argv)
+    try:
+        with open(args.log, "r", errors="replace") as f:
+            text = f.read()
+        ok, report = check(text, args.budget, args.fraction, args.max_single)
+    except (OSError, ValueError) as e:
+        print(f"check_tier1_budget: {e}", file=sys.stderr)
+        return 2
+    print(report)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

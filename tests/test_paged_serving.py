@@ -187,7 +187,7 @@ class TestPagedDecodePath:
         cfg = llama_config_tiny(vocab=64, hidden=32, layers=2, heads=4, seq=32)
         params = _params(cfg)
         ps, NP = 4, 16
-        init_pages, prefill, _prefill_chunk, decode_step = \
+        init_pages, prefill, _prefill_chunk, decode_step, _verify = \
             build_llama_paged_decode(
                 cfg, page_size=ps, num_pages=NP, attention_impl="ref")
         _, dense_prefill, dense_step = build_llama_decode(cfg, max_seq=32)

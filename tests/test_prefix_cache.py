@@ -151,6 +151,41 @@ class TestPrefixCacheIndex:
         assert cache.evict(10) == 2
         assert len(cache) == 0 and pool.num_free == 8
 
+    def test_lookup_prompt_shorter_than_one_block(self):
+        """ISSUE 4 satellite: a prompt shorter than page_size can never
+        match a full block (the match cap at len-1 leaves < page_size
+        tokens), but CAN match a cached partial tail."""
+        pool, cache = self._pool_cache(ps=4)
+        toks = np.array([5, 6, 7], np.int32)         # < one block
+        pages = pool.alloc(1)
+        cache.register(toks, pages, with_partial=True)
+        # shorter-than-block lookups: no full blocks, partial tail only
+        full, partial = cache.lookup(np.array([5, 6], np.int32))
+        assert full == [] and partial == (pages[0], 1)
+        full, partial = cache.lookup(np.array([5, 6, 7, 8], np.int32))
+        assert full == [] and partial == (pages[0], 3)
+        # a 1-token prompt has a 0-token matchable prefix: nothing matches
+        full, partial = cache.lookup(np.array([5], np.int32))
+        assert full == [] and partial is None
+        # divergent first token: no match at all
+        full, partial = cache.lookup(np.array([9, 6], np.int32))
+        assert full == [] and partial is None
+
+    def test_lookup_prompt_exactly_one_block(self):
+        """ISSUE 4 satellite: a prompt of exactly page_size tokens still
+        only matches page_size-1 of them (one suffix token must remain to
+        prefill); one token MORE matches the full block."""
+        pool, cache = self._pool_cache(ps=4)
+        toks = np.arange(1, 5, dtype=np.int32)       # exactly one block
+        pages = pool.alloc(1)
+        cache.register(toks, pages, with_partial=True)
+        # register indexed the full block (no partial: the tail is empty)
+        full, partial = cache.lookup(toks)
+        assert full == []                            # cap at len-1 = 3
+        assert partial is None                       # no partial entries
+        full, partial = cache.lookup(np.arange(1, 6, dtype=np.int32))
+        assert full == pages and partial is None     # one extra -> full hit
+
     def test_referenced_entries_never_evict(self):
         pool, cache = self._pool_cache()
         toks = np.arange(1, 9, dtype=np.int32)

@@ -1,0 +1,74 @@
+"""perf/check_tier1_budget.py parser + verdict tests (ISSUE 4 satellite:
+the budget gate itself must be trustworthy — a checker that silently
+parses nothing would wave every regression through)."""
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+from perf.check_tier1_budget import check, parse_log  # noqa: E402
+
+LOG = """\
+============================= slowest durations ==============================
+12.51s call     tests/test_a.py::TestX::test_big
+2.10s call     tests/test_a.py::test_small
+0.30s setup    tests/test_a.py::test_small
+0.10s teardown tests/test_a.py::test_small
+======= 1200 passed, 14 failed, 3 skipped in 601.23s (0:10:01) =======
+"""
+
+
+def test_parse_durations_and_wall_clock():
+    durations, wall = parse_log(LOG)
+    assert wall == 601.23
+    assert (12.51, "call", "tests/test_a.py::TestX::test_big") in durations
+    assert len(durations) == 4
+
+
+def test_within_budget_passes():
+    ok, report = check(LOG, budget=870, fraction=0.85, max_single=20)
+    assert ok and "ok   cumulative" in report
+
+
+def test_cumulative_over_fraction_fails():
+    ok, report = check(LOG, budget=600, fraction=0.85, max_single=20)
+    assert not ok and "exceeds" in report
+
+
+def test_single_test_over_limit_fails_and_names_it():
+    ok, report = check(LOG, budget=870, fraction=0.85, max_single=10)
+    assert not ok
+    assert "tests/test_a.py::TestX::test_big" in report
+
+
+def test_wall_clock_preferred_over_summed_durations():
+    # summed durations = 15.01s, wall = 601.23s: the wall clock (which
+    # includes collection + fixture overhead) must be the one gated
+    ok, _ = check(LOG, budget=500, fraction=0.9, max_single=20)
+    assert not ok
+
+
+def test_no_timing_info_raises():
+    with pytest.raises(ValueError, match="--durations=0"):
+        check("nothing to see here", 870, 0.85, 20)
+
+
+def test_cli_exit_codes(tmp_path):
+    script = Path(__file__).resolve().parents[1] / "perf" \
+        / "check_tier1_budget.py"
+    log = tmp_path / "t1.log"
+    log.write_text(LOG)
+    r = subprocess.run([sys.executable, str(script), str(log)],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+    r = subprocess.run([sys.executable, str(script), str(log),
+                        "--max-single", "5"],
+                       capture_output=True, text=True)
+    assert r.returncode == 1
+    empty = tmp_path / "empty.log"
+    empty.write_text("no timings")
+    r = subprocess.run([sys.executable, str(script), str(empty)],
+                       capture_output=True, text=True)
+    assert r.returncode == 2

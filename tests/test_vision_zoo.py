@@ -10,7 +10,10 @@ from paddle_tpu import optimizer
 
 # the depthwise/dense-block families each compile ~50 unique conv shapes
 # on CPU (60-270 s apiece) — slow lane, per the ROADMAP 870 s tier-1
-# budget; alexnet/vgg11 stay tier-1 as the cheap conv representatives
+# budget.  alexnet/vgg11 are cheap standalone (~8-10 s) but measure
+# 21-27 s inside the full suite on this host (perf/check_tier1_budget.py
+# flagged both on consecutive runs), so the whole zoo rides the slow
+# lane; tier-1 conv coverage stays via test_nn_layers / test_sparse_nn.
 _HEAVY = pytest.mark.slow
 BUILDERS = [
     pytest.param("mobilenet_v1",
@@ -29,8 +32,9 @@ BUILDERS = [
                  marks=_HEAVY),
     pytest.param("shufflenet_v2_x1_0",
                  lambda: M.shufflenet_v2_x1_0(num_classes=10), marks=_HEAVY),
-    ("alexnet", lambda: M.AlexNet(num_classes=10)),
-    ("vgg11", lambda: M.vgg11(num_classes=10)),
+    pytest.param("alexnet", lambda: M.AlexNet(num_classes=10),
+                 marks=_HEAVY),
+    pytest.param("vgg11", lambda: M.vgg11(num_classes=10), marks=_HEAVY),
 ]
 
 
