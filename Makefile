@@ -22,7 +22,24 @@ PYTEST_T1 = env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
 	--continue-on-collection-errors -p no:cacheprovider -p no:xdist \
 	-p no:randomly
 
-.PHONY: tier1 tier1-budget check-budget bench
+# `lint` runs graftlint (paddle_tpu/analysis — the trace-safety static
+# analyzer, README §Static analysis) over the package against the
+# committed baseline of grandfathered findings: non-zero exit on any NEW
+# finding (traced-value branch in a jitted fn, hot-path host sync, Pallas
+# kernel without a jnp ref/parity test, incomplete OpSpec, ...).
+# `lint-baseline` regenerates graftlint.baseline.json — fill in the
+# one-line justification per entry before committing it.
+
+GRAFTLINT = $(PY) -m paddle_tpu.analysis paddle_tpu \
+	--baseline graftlint.baseline.json
+
+.PHONY: tier1 tier1-budget check-budget bench lint lint-baseline
+
+lint:
+	$(GRAFTLINT)
+
+lint-baseline:
+	$(GRAFTLINT) --write-baseline
 
 tier1:
 	timeout -k 10 870 $(PYTEST_T1)

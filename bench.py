@@ -702,6 +702,7 @@ def bench_serving_shared_prefix(seed=7):
         eng.release_cache()
         base = (eng.cache_hit_tokens, eng.prefill_tokens, eng.cow_copies,
                 eng.cache_evictions)
+        base_misses = dict(eng.jit_cache_misses)
         t0 = time.perf_counter()
         outputs, ttfts, useful = once()
         dt = time.perf_counter() - t0
@@ -714,6 +715,16 @@ def bench_serving_shared_prefix(seed=7):
             "cache_hit_tokens": int(eng.cache_hit_tokens - base[0]),
             "cow_copies": int(eng.cow_copies - base[2]),
             "cache_evictions": int(eng.cache_evictions - base[3]),
+            # full engine counters (cumulative, incl. warm-pass compiles)
+            "engine_stats": eng.stats(),
+            # per-model-fn compile-cache misses DURING THE TIMED PASS only
+            # (the recompile sanitizer's ledger, PERF.md §12) — a warmed
+            # timed pass that recompiled is a bogus number, so this must
+            # be all-zeros
+            "jit_cache_misses_timed_pass": {
+                k: int(v - base_misses.get(k, 0))
+                for k, v in eng.jit_cache_misses.items()
+            },
         }
         return outputs, stats
 

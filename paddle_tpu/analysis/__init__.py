@@ -1,0 +1,29 @@
+"""paddle_tpu.analysis — graftlint (trace-safety static analyzer) + the
+runtime recompile sanitizer.
+
+Static half (import-light — ast/json only, no jax):
+
+    from paddle_tpu.analysis import lint_paths
+    res = lint_paths(["paddle_tpu"], baseline="graftlint.baseline.json")
+    assert res.ok, res.new
+
+    $ python -m paddle_tpu.analysis paddle_tpu --baseline graftlint.baseline.json
+
+Runtime half (jax imported lazily):
+
+    from paddle_tpu.analysis import sanitize
+    with sanitize(budget=0):          # steady state: zero recompiles
+        engine.run()
+
+Rule catalog and suppression syntax: README §Static analysis; engine
+internals: graftlint.py / rules.py docstrings.
+"""
+from .graftlint import (Finding, LintContext, ModuleInfo, Rule, RULES,
+                        lint_paths, lint_sources, main, register_rule)
+from .sanitize import (RecompileBudgetError, instrument, jit_cache_size,
+                       sanitize)
+
+__all__ = ["Finding", "LintContext", "ModuleInfo", "Rule", "RULES",
+           "lint_paths", "lint_sources", "main", "register_rule",
+           "RecompileBudgetError", "instrument", "jit_cache_size",
+           "sanitize"]

@@ -1,0 +1,6 @@
+"""``python -m paddle_tpu.analysis`` — the graftlint CLI (make lint)."""
+import sys
+
+from .graftlint import main
+
+sys.exit(main())

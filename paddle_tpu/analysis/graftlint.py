@@ -1,0 +1,371 @@
+"""graftlint — the trace-safety static analyzer (engine).
+
+The framework's runtime contracts (kernel/jnp parity, lengths-masked paged
+attention, bounded jit-variant counts, no host syncs in the decode horizon)
+were until now enforced only by runtime tests: nothing stopped the next PR
+from introducing a traced-value ``if`` inside a jitted model fn or a Pallas
+kernel without a jnp fallback.  graftlint enforces that class of invariant
+declaratively, the way the reference enforces op completeness through one
+ops.yaml entry per op: an AST pass over the package with a small registry of
+framework-specific rules (see ``rules.py`` for the catalog).
+
+Mechanics:
+
+  * **Suppressions** — ``# graftlint: disable=RULE1,RULE2`` (or
+    ``disable=all``) on the flagged line — or on a pure-comment line
+    directly above it — silences the finding; the comment itself is the
+    required justification marker, so every silenced line is a deliberate,
+    reviewable exception.
+  * **Markers** — ``# graftlint: jit`` on a ``def`` line declares a function
+    jit-traced when the tracer cannot see it syntactically (a builder
+    returning model fns that the serving engine jits later);
+    ``# graftlint: hot`` declares an engine-step hot path (host code that
+    runs every serving step, where SYNC001 polices host syncs).
+  * **Baseline** — ``graftlint.baseline.json`` at the repo root grandfathers
+    pre-existing findings.  Entries match by (rule, file, stripped source
+    line), so unrelated line-number churn never resurrects them, while a
+    NEW identical violation elsewhere still fails.  Each entry carries a
+    one-line ``justification``.  ``--write-baseline`` regenerates the file
+    from the current findings, preserving the justification of every entry
+    that survives (new entries get a TODO placeholder to fill by hand).
+  * **Reporters** — text (``file:line: RULE message``) and ``--format
+    json`` for tooling.
+
+Exit status: 0 clean (baselined findings allowed), 1 new findings, 2 usage
+error.  ``make lint`` runs ``python -m paddle_tpu.analysis paddle_tpu
+--baseline graftlint.baseline.json``.
+
+Adding a rule: subclass :class:`Rule` in ``rules.py``, set ``id`` /
+``description``, implement ``check_module`` (per file) and/or
+``check_project`` (once, cross-file), and decorate with ``@register_rule``.
+"""
+from __future__ import annotations
+
+import argparse
+import ast
+import dataclasses
+import io
+import json
+import re
+import sys
+import tokenize
+from pathlib import Path
+
+__all__ = ["Finding", "ModuleInfo", "LintContext", "Rule", "RULES",
+           "register_rule", "lint_paths", "lint_sources", "main"]
+
+_SUPPRESS_RE = re.compile(r"#\s*graftlint:\s*disable=([A-Za-z0-9_*,\s]+)")
+_MARKER_RE = re.compile(r"#\s*graftlint:\s*(jit|hot)\b")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    file: str
+    line: int
+    message: str
+    snippet: str = ""
+
+    def key(self):
+        # line-number-free identity: baseline entries survive code motion
+        return (self.rule, self.file, self.snippet)
+
+    def render(self) -> str:
+        return f"{self.file}:{self.line}: {self.rule} {self.message}"
+
+
+class ModuleInfo:
+    """One parsed source file + its graftlint comment annotations."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path.replace("\\", "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.suppressions: dict[int, set] = {}
+        self.markers: dict[int, set] = {}
+        # directives live in COMMENT tokens only — a docstring or string
+        # literal that merely *mentions* the syntax must not register a
+        # phantom suppression above real code (flake8 tokenizes for the
+        # same reason)
+        for i, ln, full in self._comments(source):
+            m = _SUPPRESS_RE.search(ln)
+            if m:
+                ids = {t.strip() for t in m.group(1).split(",") if t.strip()}
+                # a suppression on a pure-comment line governs the NEXT
+                # code line (the disable-next-line idiom); inline comments
+                # govern their own line
+                line = i + 1 if full.lstrip().startswith("#") else i
+                self.suppressions.setdefault(line, set()).update(ids)
+            m = _MARKER_RE.search(ln)
+            if m:
+                self.markers.setdefault(i, set()).add(m.group(1))
+
+    @staticmethod
+    def _comments(source):
+        """(lineno, comment_text, full_line) per comment token; falls back
+        to a raw line scan if tokenization fails on an ast-parsable file
+        (shouldn't happen, but a lint tool must not crash on weird input)."""
+        try:
+            toks = list(tokenize.generate_tokens(io.StringIO(source).readline))
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            return [(i, ln, ln)
+                    for i, ln in enumerate(source.splitlines(), 1)]
+        return [(t.start[0], t.string, t.line)
+                for t in toks if t.type == tokenize.COMMENT]
+
+    def line_at(self, lineno: int) -> str:
+        if 0 < lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def suppressed(self, rule: str, lineno: int) -> bool:
+        ids = self.suppressions.get(lineno)
+        return ids is not None and bool({rule, "all", "*"} & ids)
+
+
+class LintContext:
+    """Shared state rules can reach: every parsed module plus the kernel
+    parity-test source (PAR001 checks Pallas modules against it)."""
+
+    def __init__(self, modules, kernel_test_src=None,
+                 kernel_test_path="tests/test_pallas_kernels.py"):
+        self.modules = list(modules)
+        self.kernel_test_src = kernel_test_src
+        self.kernel_test_path = kernel_test_path
+
+
+class Rule:
+    id = ""
+    description = ""
+
+    def check_module(self, mod: ModuleInfo, ctx: LintContext):
+        return ()
+
+    def check_project(self, ctx: LintContext):
+        return ()
+
+
+RULES: dict[str, Rule] = {}
+
+
+def register_rule(cls):
+    inst = cls()
+    RULES[inst.id] = inst
+    return cls
+
+
+# ---------------------------------------------------------------------------
+# Running
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass
+class LintResult:
+    new: list            # findings not covered by the baseline
+    baselined: list      # findings matched (and consumed) by baseline entries
+    stale: list          # baseline entries that matched nothing (fix landed)
+
+    @property
+    def ok(self) -> bool:
+        return not self.new
+
+
+def _iter_py_files(paths):
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            yield from sorted(p.rglob("*.py"))
+        elif p.suffix == ".py":
+            yield p
+
+
+def _load_rules():
+    from . import rules as _rules  # noqa: F401  (registers via decorator)
+    return RULES
+
+
+def _run(modules, parse_errors, ctx, baseline_entries):
+    findings = list(parse_errors)
+    by_path = {m.path: m for m in modules}
+    for mod in modules:
+        for rule in RULES.values():
+            findings.extend(rule.check_module(mod, ctx))
+    for rule in RULES.values():
+        findings.extend(rule.check_project(ctx))
+    kept = []
+    for f in findings:
+        mod = by_path.get(f.file)
+        if mod is not None:
+            if not f.snippet:
+                f = dataclasses.replace(f, snippet=mod.line_at(f.line))
+            if mod.suppressed(f.rule, f.line):
+                continue
+        kept.append(f)
+    kept.sort(key=lambda f: (f.file, f.line, f.rule))
+    # baseline matching: multiset over (rule, file, snippet)
+    remaining: dict[tuple, int] = {}
+    just: dict[tuple, str] = {}
+    for e in baseline_entries:
+        k = (e["rule"], e["file"], e["snippet"])
+        remaining[k] = remaining.get(k, 0) + int(e.get("count", 1))
+        just[k] = e.get("justification", "")
+    new, matched = [], []
+    for f in kept:
+        k = f.key()
+        if remaining.get(k, 0) > 0:
+            remaining[k] -= 1
+            matched.append(f)
+        else:
+            new.append(f)
+    stale = [{"rule": k[0], "file": k[1], "snippet": k[2], "count": c,
+              "justification": just.get(k, "")}
+             for k, c in remaining.items() if c > 0]
+    return LintResult(new=new, baselined=matched, stale=stale)
+
+
+def load_baseline(path) -> list:
+    if path is None or not Path(path).exists():
+        return []
+    data = json.loads(Path(path).read_text())
+    return data.get("entries", [])
+
+
+def write_baseline(path, findings):
+    # regeneration must not wipe the audit trail: entries that survive keep
+    # their hand-written justification; only genuinely new ones get the
+    # TODO placeholder
+    old_just = {(e["rule"], e["file"], e["snippet"]): e.get("justification")
+                for e in load_baseline(path)}
+    entries = {}
+    for f in findings:
+        k = f.key()
+        if k in entries:
+            entries[k]["count"] += 1
+        else:
+            entries[k] = {"rule": f.rule, "file": f.file, "snippet": f.snippet,
+                          "count": 1,
+                          "justification": old_just.get(k)
+                          or "TODO: justify"}
+    doc = {"comment": "graftlint grandfathered findings — every entry needs "
+                      "a one-line justification; new code must be clean",
+           "entries": sorted(entries.values(),
+                             key=lambda e: (e["file"], e["rule"]))}
+    Path(path).write_text(json.dumps(doc, indent=2) + "\n")
+    return doc
+
+
+def lint_paths(paths, baseline=None, kernel_tests=None,
+               root=None) -> LintResult:
+    """Lint .py files under `paths` (dirs or files) against the registered
+    rules; `baseline` is a graftlint.baseline.json path (or None).  File
+    paths in findings are normalized relative to `root` (default: the
+    baseline's directory, else the cwd) so baseline entries match no
+    matter how the lint was invoked."""
+    _load_rules()
+    root = Path(root) if root is not None else \
+        (Path(baseline).resolve().parent if baseline else Path.cwd())
+
+    def rel(p):
+        try:
+            return Path(p).resolve().relative_to(root.resolve()).as_posix()
+        except ValueError:
+            return str(p)
+
+    modules, parse_errors = [], []
+    for f in _iter_py_files(paths):
+        src = f.read_text()
+        try:
+            modules.append(ModuleInfo(rel(f), src))
+        except SyntaxError as e:
+            parse_errors.append(Finding("E999", rel(f), e.lineno or 1,
+                                        f"syntax error: {e.msg}"))
+    kt_src = None
+    kt_path = kernel_tests
+    if kt_path is None:
+        # NB: do not name this loop variable `root` — rel() above closes
+        # over `root` late-bound
+        for base in [Path("."), *(Path(p).resolve().parent
+                                  for p in paths if Path(p).exists())]:
+            cand = base / "tests" / "test_pallas_kernels.py"
+            if cand.exists():
+                kt_path = cand
+                break
+    if kt_path is not None and Path(kt_path).exists():
+        kt_src = Path(kt_path).read_text()
+    ctx = LintContext(modules, kernel_test_src=kt_src,
+                      kernel_test_path=str(kt_path or
+                                           "tests/test_pallas_kernels.py"))
+    return _run(modules, parse_errors, ctx, load_baseline(baseline))
+
+
+def lint_sources(named_sources, baseline_entries=(), kernel_test_src=None):
+    """Test/embedding entry point: lint (path, source) pairs directly."""
+    _load_rules()
+    modules, parse_errors = [], []
+    for path, src in named_sources:
+        try:
+            modules.append(ModuleInfo(path, src))
+        except SyntaxError as e:
+            parse_errors.append(Finding("E999", path, e.lineno or 1,
+                                        f"syntax error: {e.msg}"))
+    ctx = LintContext(modules, kernel_test_src=kernel_test_src)
+    return _run(modules, parse_errors, ctx, list(baseline_entries))
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+def _report_text(res: LintResult, out):
+    for f in res.new:
+        print(f.render(), file=out)
+    for e in res.stale:
+        print(f"note: stale baseline entry (fix landed?): "
+              f"{e['file']}: {e['rule']} {e['snippet']!r}", file=out)
+    print(f"graftlint: {len(res.new)} new finding(s), "
+          f"{len(res.baselined)} baselined, {len(res.stale)} stale "
+          f"baseline entr{'y' if len(res.stale) == 1 else 'ies'}", file=out)
+
+
+def _report_json(res: LintResult, out):
+    print(json.dumps({
+        "new": [dataclasses.asdict(f) for f in res.new],
+        "baselined": [dataclasses.asdict(f) for f in res.baselined],
+        "stale_baseline": res.stale,
+    }, indent=2), file=out)
+
+
+def main(argv=None) -> int:
+    _load_rules()
+    ap = argparse.ArgumentParser(
+        prog="graftlint",
+        description="trace-safety static analyzer (see README §Static "
+                    "analysis for the rule catalog)")
+    ap.add_argument("paths", nargs="*", default=["paddle_tpu"],
+                    help="files or directories to lint (default: paddle_tpu)")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline JSON of grandfathered findings")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="regenerate the baseline from current findings")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--kernel-tests", default=None,
+                    help="path to the Pallas parity test file (PAR001)")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+    if args.list_rules:
+        for rid, rule in sorted(RULES.items()):
+            print(f"{rid}: {rule.description}")
+        return 0
+    paths = args.paths or ["paddle_tpu"]
+    if args.write_baseline:
+        if not args.baseline:
+            ap.error("--write-baseline requires --baseline PATH")
+        res = lint_paths(paths, baseline=None,
+                         kernel_tests=args.kernel_tests,
+                         root=Path(args.baseline).resolve().parent)
+        write_baseline(args.baseline, res.new)
+        print(f"graftlint: wrote {len(res.new)} finding(s) to "
+              f"{args.baseline}")
+        return 0
+    res = lint_paths(paths, baseline=args.baseline,
+                     kernel_tests=args.kernel_tests)
+    (_report_json if args.format == "json" else _report_text)(res, sys.stdout)
+    return 0 if res.ok else 1

@@ -1,0 +1,569 @@
+"""graftlint rule catalog — the framework-specific trace-safety rules.
+
+Shared machinery first: *which functions are jit-traced* (decorated with
+jit, passed to a ``jax.jit(...)`` call, marked ``# graftlint: jit``, nested
+in / called from a traced function) and *which values are traced* (a cheap
+flow-insensitive taint pass seeded from positional parameters — keyword-only
+parameters are the codebase's static-knob convention and stay untainted;
+``.shape``/``.ndim``/``.dtype``/``len()``/``isinstance()`` results are
+static under trace and cut the taint).
+
+Rules:
+
+  TRACE001  python ``if``/``while``/``assert``/ternary on a traced value
+            inside a jit-traced function (TracerBoolConversionError at
+            trace time, or worse: silently baked-in control flow)
+  SYNC001   host syncs (``.item()``, ``jax.device_get``, ``np.asarray``,
+            ``float()/int()/bool()`` of a traced value) inside jit-traced
+            functions or ``# graftlint: hot`` engine-step hot paths
+  PAR001    every kernel module in ``ops/pallas/`` must export a jnp
+            reference (``*_ref``) and be covered by
+            ``tests/test_pallas_kernels.py``
+  OPS001    every ``OpSpec`` carries a non-None ``np_ref`` and ``test``
+            (and a literal ``amp`` ∈ {allow, deny, keep} when given) — the
+            ops.yaml-completeness analog
+  SHAPE001  data-dependent-shape ops (``nonzero``, 1-arg ``where``,
+            boolean-mask indexing, ``unique``) inside jit-traced functions
+  MUT001    mutation of captured python state (``self`` attribute writes,
+            captured list/dict mutation) inside jit-traced function bodies
+            — runs once at trace time, then never again
+"""
+from __future__ import annotations
+
+import ast
+
+from .graftlint import Finding, Rule, register_rule
+
+_JIT_NAMES = {"jit", "pjit"}
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding", "aval"}
+_STATIC_CALLS = {"len", "isinstance", "type", "hasattr", "getattr"}
+
+
+def _callee_is_jit(func) -> bool:
+    if isinstance(func, ast.Name):
+        return func.id in _JIT_NAMES
+    if isinstance(func, ast.Attribute):
+        return func.attr in _JIT_NAMES
+    return False
+
+
+def _dec_is_jit(dec) -> bool:
+    if _callee_is_jit(dec):
+        return True
+    if isinstance(dec, ast.Call):
+        if _callee_is_jit(dec.func):
+            return True                      # @jax.jit(static_argnums=...)
+        f = dec.func
+        if (isinstance(f, ast.Attribute) and f.attr == "partial") or \
+                (isinstance(f, ast.Name) and f.id == "partial"):
+            return any(_callee_is_jit(a) for a in dec.args[:1])
+    return False
+
+
+def _jit_arg_names(call):
+    """Function names a jit(...) call traces: jit(f), jit(partial(f, ...)),
+    jit(lambda *a: f(*a, ...))."""
+    out = []
+    for a in call.args[:1]:
+        if isinstance(a, ast.Name):
+            out.append(a.id)
+        elif isinstance(a, ast.Call):
+            f = a.func
+            is_partial = (isinstance(f, ast.Attribute) and f.attr == "partial") \
+                or (isinstance(f, ast.Name) and f.id == "partial")
+            if is_partial and a.args and isinstance(a.args[0], ast.Name):
+                out.append(a.args[0].id)
+        elif isinstance(a, ast.Lambda):
+            for n in ast.walk(a.body):
+                if isinstance(n, ast.Call) and isinstance(n.func, ast.Name):
+                    out.append(n.func.id)
+    return out
+
+
+_FN_TYPES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _def_markers(mod, d):
+    """Markers attached to a def: any line of the signature counts (a
+    wrapped parameter list puts the trailing comment on a continuation
+    line, not d.lineno)."""
+    end = max(d.lineno + 1, d.body[0].lineno if d.body else d.lineno + 1)
+    out = set()
+    for ln in range(d.lineno, end):
+        out |= mod.markers.get(ln, set())
+    return out
+
+
+def traced_functions(mod):
+    """The set of FunctionDef nodes graftlint considers jit-traced, closed
+    over (a) nesting and (b) the same-module call graph by bare name."""
+    cached = getattr(mod, "_graftlint_traced", None)
+    if cached is not None:
+        return cached
+    defs = [n for n in ast.walk(mod.tree) if isinstance(n, _FN_TYPES)]
+    by_name: dict[str, list] = {}
+    for d in defs:
+        by_name.setdefault(d.name, []).append(d)
+    jit_called = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call) and _callee_is_jit(node.func):
+            jit_called.update(_jit_arg_names(node))
+    traced = set()
+    for d in defs:
+        if any(_dec_is_jit(x) for x in d.decorator_list) \
+                or d.name in jit_called \
+                or "jit" in _def_markers(mod, d):
+            traced.add(d)
+    changed = True
+    while changed:
+        changed = False
+        for d in list(traced):
+            for n in ast.walk(d):
+                if isinstance(n, _FN_TYPES) and n is not d and n not in traced:
+                    traced.add(n)
+                    changed = True
+                if isinstance(n, ast.Call) and isinstance(n.func, ast.Name):
+                    for cand in by_name.get(n.func.id, ()):
+                        if cand not in traced:
+                            traced.add(cand)
+                            changed = True
+    mod._graftlint_traced = traced
+    return traced
+
+
+def hot_functions(mod):
+    return [n for n in ast.walk(mod.tree) if isinstance(n, _FN_TYPES)
+            and "hot" in _def_markers(mod, n)]
+
+
+def _names_skipping_static(node):
+    """Name nodes in `node`, skipping subtrees that are static under trace:
+    `.shape`-like attribute chains, len()/isinstance()-like calls, and
+    `x is None` comparisons."""
+    def walk(n):
+        if isinstance(n, ast.Attribute) and n.attr in _STATIC_ATTRS:
+            return
+        if isinstance(n, ast.Call):
+            f = n.func
+            fname = f.id if isinstance(f, ast.Name) else \
+                (f.attr if isinstance(f, ast.Attribute) else "")
+            if fname in _STATIC_CALLS:
+                return
+        if isinstance(n, ast.Compare) and \
+                all(isinstance(op, (ast.Is, ast.IsNot)) for op in n.ops):
+            return
+        if isinstance(n, ast.Name):
+            yield n
+        for c in ast.iter_child_nodes(n):
+            yield from walk(c)
+    yield from walk(node)
+
+
+def _target_names(t):
+    if isinstance(t, ast.Name):
+        yield t.id
+    elif isinstance(t, (ast.Tuple, ast.List)):
+        for e in t.elts:
+            yield from _target_names(e)
+    elif isinstance(t, ast.Starred):
+        yield from _target_names(t.value)
+
+
+def tainted_names(fndef):
+    """Names derived from the function's positional parameters (the traced
+    arguments).  Keyword-only params are treated as static knobs (the
+    `*, K, greedy` builder convention); shape/dtype/len derivations are
+    static and cut the chain.  Flow-insensitive, two fixpoint passes."""
+    a = fndef.args
+    tainted = {p.arg for p in (*a.posonlyargs, *a.args)
+               if p.arg not in ("self", "cls")}
+    if a.vararg is not None:
+        tainted.add(a.vararg.arg)
+    for _ in range(2):
+        for node in ast.walk(fndef):
+            value = targets = None
+            if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                value = node.value
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+            elif isinstance(node, ast.NamedExpr):
+                value, targets = node.value, [node.target]
+            elif isinstance(node, ast.For):
+                value, targets = node.iter, [node.target]
+            if value is None or targets is None:
+                continue
+            if any(n.id in tainted for n in _names_skipping_static(value)):
+                for t in targets:
+                    tainted.update(_target_names(t))
+    return tainted
+
+
+def local_names(fndef):
+    """Names bound inside the function (params + any Store) — everything
+    else referenced is captured/global state."""
+    a = fndef.args
+    loc = {p.arg for p in (*a.posonlyargs, *a.args, *a.kwonlyargs)}
+    for v in (a.vararg, a.kwarg):
+        if v is not None:
+            loc.add(v.arg)
+    for node in ast.walk(fndef):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            loc.add(node.id)
+        elif isinstance(node, _FN_TYPES) and node is not fndef:
+            loc.add(node.name)
+    return loc
+
+
+def _root_name(node):
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+# ---------------------------------------------------------------------------
+@register_rule
+class TraceBranchRule(Rule):
+    id = "TRACE001"
+    description = ("python if/while/assert/ternary on a value derived from "
+                   "traced arguments inside a jit-traced function — use "
+                   "jnp.where / lax.cond / lax.while_loop")
+
+    def check_module(self, mod, ctx):
+        for fn in traced_functions(mod):
+            tainted = tainted_names(fn)
+            seen = set()
+            for node in ast.walk(fn):
+                kind = {ast.If: "if", ast.While: "while",
+                        ast.Assert: "assert",
+                        ast.IfExp: "conditional expression"}.get(type(node))
+                if kind is None or id(node) in seen:
+                    continue
+                seen.add(id(node))
+                hit = sorted({n.id for n in _names_skipping_static(node.test)
+                              if n.id in tainted})
+                if hit:
+                    yield Finding(
+                        self.id, mod.path, node.lineno,
+                        f"python `{kind}` on traced value(s) "
+                        f"{', '.join(hit)} inside jit-traced "
+                        f"`{fn.name}` — concretizes a tracer; use jnp.where "
+                        f"/ lax.cond / lax.while_loop or make it a "
+                        f"keyword-only static")
+
+
+_NP_MODULES = {"np", "numpy"}
+_SYNC_ATTRS = {"item", "device_get", "block_until_ready"}
+
+
+def _sync_call_kind(node):
+    """None, or a label for a host-sync call expression."""
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        if f.attr in _SYNC_ATTRS:
+            return f".{f.attr}()"
+        if f.attr in ("asarray", "array") and isinstance(f.value, ast.Name) \
+                and f.value.id in _NP_MODULES:
+            return f"np.{f.attr}()"
+    return None
+
+
+@register_rule
+class HostSyncRule(Rule):
+    id = "SYNC001"
+    description = ("host-sync calls (.item(), float()/int()/bool() of a "
+                   "traced value, np.asarray, jax.device_get) inside "
+                   "jit-traced functions or `# graftlint: hot` engine-step "
+                   "hot paths")
+
+    def check_module(self, mod, ctx):
+        traced = traced_functions(mod)
+        for fn in traced:
+            tainted = tainted_names(fn)
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                kind = _sync_call_kind(node)
+                if kind is None and isinstance(node.func, ast.Name) \
+                        and node.func.id in ("float", "int", "bool") \
+                        and len(node.args) == 1 \
+                        and any(n.id in tainted for n in
+                                _names_skipping_static(node.args[0])):
+                    kind = f"{node.func.id}()"
+                if kind:
+                    yield Finding(
+                        self.id, mod.path, node.lineno,
+                        f"host sync {kind} inside jit-traced `{fn.name}` — "
+                        f"fails or silently falls out of the traced graph")
+        for fn in hot_functions(mod):
+            if fn in traced:
+                continue
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call):
+                    kind = _sync_call_kind(node)
+                    # float()/int()/bool() of anything non-static is the
+                    # most common accidental per-step device sync; hot
+                    # paths have no taint info (no traced params), so any
+                    # non-static operand is a candidate — a genuinely
+                    # host-only conversion earns an inline disable
+                    if kind is None and isinstance(node.func, ast.Name) \
+                            and node.func.id in ("float", "int", "bool") \
+                            and len(node.args) == 1 \
+                            and any(True for _ in
+                                    _names_skipping_static(node.args[0])):
+                        kind = f"{node.func.id}()"
+                    if kind:
+                        yield Finding(
+                            self.id, mod.path, node.lineno,
+                            f"host sync {kind} on the `{fn.name}` engine "
+                            f"hot path — each one is a device round-trip; "
+                            f"batch it or justify with a disable comment")
+
+
+@register_rule
+class PallasParityRule(Rule):
+    id = "PAR001"
+    description = ("every kernel module in ops/pallas/ must export a jnp "
+                   "reference implementation (`*_ref`) and be covered by "
+                   "tests/test_pallas_kernels.py")
+
+    def _kernel_modules(self, ctx):
+        for mod in ctx.modules:
+            parts = ("/" + mod.path).rsplit("/", 3)
+            if len(parts) == 4 and parts[1] == "ops" and parts[2] == "pallas":
+                name = parts[3]
+                if name != "__init__.py" and not name.startswith("_"):
+                    yield mod, name[:-3]
+
+    def check_project(self, ctx):
+        mods = list(self._kernel_modules(ctx))
+        if not mods:
+            return
+        for mod, stem in mods:
+            exported = set()
+            for node in mod.tree.body:
+                if isinstance(node, _FN_TYPES):
+                    exported.add(node.name)
+                elif isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        exported.update(_target_names(t))
+                elif isinstance(node, ast.ImportFrom):
+                    exported.update(a.asname or a.name for a in node.names)
+            if not any(n.endswith("_ref") for n in exported):
+                yield Finding(
+                    self.id, mod.path, 1,
+                    f"kernel module `{stem}` exports no jnp reference "
+                    f"implementation (a top-level `*_ref` def/alias) — "
+                    f"every Pallas kernel needs a fallback to pair against",
+                    snippet=f"<module {stem}>")
+            if ctx.kernel_test_src is None:
+                yield Finding(
+                    self.id, mod.path, 1,
+                    f"parity test file {ctx.kernel_test_path} not found — "
+                    f"cannot verify kernel/jnp parity coverage for `{stem}`",
+                    snippet=f"<module {stem}>")
+            elif stem not in ctx.kernel_test_src:
+                yield Finding(
+                    self.id, mod.path, 1,
+                    f"no parity test in {ctx.kernel_test_path} mentions "
+                    f"`{stem}` — register a kernel-vs-reference test there",
+                    snippet=f"<module {stem}>")
+
+
+# positional field order of the OpSpec dataclass (ops/registry.py)
+_OPSPEC_FIELDS = ("name", "impl", "np_ref", "amp", "nondiff", "custom_vjp",
+                  "test", "doc")
+_AMP_VALUES = {"allow", "deny", "keep"}
+
+
+def _bind_call(fndef, call):
+    """Bind a Call's args to `fndef`'s parameters (AST-level, defaults
+    included); returns {param: node} or None when binding fails."""
+    a = fndef.args
+    params = [p.arg for p in (*a.posonlyargs, *a.args)]
+    bound = {}
+    defaults = a.defaults
+    if defaults:
+        for p, dflt in zip(params[-len(defaults):], defaults):
+            bound[p] = dflt
+    for p, kd in zip((k.arg for k in a.kwonlyargs), a.kw_defaults):
+        if kd is not None:
+            bound[p] = kd
+    if len(call.args) > len(params):
+        return None
+    for p, val in zip(params, call.args):
+        bound[p] = val
+    for kw in call.keywords:
+        if kw.arg is not None:
+            bound[kw.arg] = kw.value
+    return bound
+
+
+def _is_none(node):
+    return node is None or (isinstance(node, ast.Constant)
+                            and node.value is None)
+
+
+def _spec_fields(call):
+    """{OpSpec field: expression} for an OpSpec(...) call."""
+    bound = {f: v for f, v in zip(_OPSPEC_FIELDS, call.args)}
+    for kw in call.keywords:
+        if kw.arg:
+            bound[kw.arg] = kw.value
+    return bound
+
+
+@register_rule
+class OpSpecRule(Rule):
+    id = "OPS001"
+    description = ("every OpSpec carries np_ref + an OpTest (and a literal "
+                   "amp in {allow,deny,keep} when given) — the "
+                   "ops.yaml-completeness analog")
+
+    def _check_spec(self, mod, call, fields, via=""):
+        where = f" (via {via})" if via else ""
+        for field in ("np_ref", "test"):
+            if _is_none(fields.get(field)):
+                what = "reference check" if field == "np_ref" \
+                    else "OpTest case"
+                yield Finding(
+                    self.id, mod.path, call.lineno,
+                    f"OpSpec{where} has no {field} — the registry cannot "
+                    f"generate its {what}")
+        amp = fields.get("amp")
+        if amp is not None and (not isinstance(amp, ast.Constant)
+                                or amp.value not in _AMP_VALUES):
+            yield Finding(
+                self.id, mod.path, call.lineno,
+                f"OpSpec{where} amp must be a literal in "
+                f"{sorted(_AMP_VALUES)}")
+
+    def check_module(self, mod, ctx):
+        # helper functions that construct and return an OpSpec (the table's
+        # _u/_b shorthands): each call to one is checked by resolving the
+        # helper's inner OpSpec(...) fields — a field that forwards a helper
+        # parameter resolves to the caller's bound argument (or the
+        # parameter default)
+        helpers = {}
+        for node in mod.tree.body:
+            if isinstance(node, _FN_TYPES):
+                for inner in ast.walk(node):
+                    if isinstance(inner, ast.Return) \
+                            and isinstance(inner.value, ast.Call) \
+                            and isinstance(inner.value.func, ast.Name) \
+                            and inner.value.func.id == "OpSpec":
+                        helpers[node.name] = (node, _spec_fields(inner.value))
+                        break
+        in_helper = {id(c) for h, _ in helpers.values() for c in ast.walk(h)
+                     if isinstance(c, ast.Call)}
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call) \
+                    or not isinstance(node.func, ast.Name):
+                continue
+            if node.func.id == "OpSpec" and id(node) not in in_helper:
+                yield from self._check_spec(mod, node, _spec_fields(node))
+            elif node.func.id in helpers:
+                h, spec = helpers[node.func.id]
+                call_bound = _bind_call(h, node)
+                if call_bound is None:
+                    continue
+                params = {p.arg for p in (*h.args.posonlyargs, *h.args.args,
+                                          *h.args.kwonlyargs)}
+                fields = {}
+                for f, expr in spec.items():
+                    if isinstance(expr, ast.Name) and expr.id in params:
+                        fields[f] = call_bound.get(expr.id)
+                    else:
+                        fields[f] = expr
+                yield from self._check_spec(mod, node, fields,
+                                            via=node.func.id)
+
+
+_DATA_DEP_CALLS = {"nonzero", "flatnonzero", "argwhere", "unique",
+                   "extract", "compress"}
+
+
+@register_rule
+class DataDepShapeRule(Rule):
+    id = "SHAPE001"
+    description = ("data-dependent-shape ops (nonzero, 1-arg where, "
+                   "unique, boolean-mask indexing) inside jit-traced "
+                   "functions — shape depends on VALUES, jit cannot "
+                   "compile it; use a fixed-size jnp.where/mask form")
+
+    def check_module(self, mod, ctx):
+        for fn in traced_functions(mod):
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call):
+                    f = node.func
+                    fname = f.id if isinstance(f, ast.Name) else \
+                        (f.attr if isinstance(f, ast.Attribute) else "")
+                    if fname in _DATA_DEP_CALLS:
+                        yield Finding(
+                            self.id, mod.path, node.lineno,
+                            f"data-dependent-shape `{fname}` inside "
+                            f"jit-traced `{fn.name}`")
+                    elif fname == "where" and len(node.args) == 1 \
+                            and not node.keywords:
+                        yield Finding(
+                            self.id, mod.path, node.lineno,
+                            f"1-arg `where` (nonzero alias) inside "
+                            f"jit-traced `{fn.name}` — pass the full "
+                            f"3-arg select form")
+                elif isinstance(node, ast.Subscript) \
+                        and isinstance(node.ctx, ast.Load) \
+                        and isinstance(node.slice, ast.Compare) \
+                        and not all(isinstance(op, (ast.Is, ast.IsNot))
+                                    for op in node.slice.ops):
+                    yield Finding(
+                        self.id, mod.path, node.lineno,
+                        f"boolean-mask indexing inside jit-traced "
+                        f"`{fn.name}` — result shape is data-dependent; "
+                        f"use jnp.where")
+
+
+_MUTATORS = {"append", "extend", "insert", "remove", "clear", "update",
+             "setdefault", "pop", "popleft", "appendleft", "add", "discard",
+             "write", "__setitem__"}
+
+
+@register_rule
+class CapturedMutationRule(Rule):
+    id = "MUT001"
+    description = ("mutation of captured python state (self attributes, "
+                   "closure lists/dicts) inside a jit-traced function body "
+                   "— runs ONCE at trace time, then never again on cached "
+                   "executions")
+
+    def check_module(self, mod, ctx):
+        for fn in traced_functions(mod):
+            loc = local_names(fn)
+
+            def captured(root):
+                return root is not None and (root == "self"
+                                             or root not in loc)
+
+            for node in ast.walk(fn):
+                if isinstance(node, (ast.Assign, ast.AugAssign,
+                                     ast.AnnAssign)):
+                    targets = node.targets if isinstance(node, ast.Assign) \
+                        else [node.target]
+                    for t in targets:
+                        if isinstance(t, (ast.Attribute, ast.Subscript)) \
+                                and captured(_root_name(t)):
+                            yield Finding(
+                                self.id, mod.path, node.lineno,
+                                f"write to captured state "
+                                f"`{_root_name(t)}` inside jit-traced "
+                                f"`{fn.name}` — happens once at trace "
+                                f"time, silently skipped on cached calls")
+                elif isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in _MUTATORS:
+                    root = _root_name(node.func.value)
+                    if captured(root):
+                        yield Finding(
+                            self.id, mod.path, node.lineno,
+                            f"`{root}.{node.func.attr}()` mutates captured "
+                            f"state inside jit-traced `{fn.name}` — "
+                            f"happens once at trace time, silently skipped "
+                            f"on cached calls")

@@ -1,0 +1,173 @@
+"""Recompile sanitizer — the runtime half of graftlint.
+
+A jitted serving engine earns its latency by compiling a small, documented
+set of executables once and then running them forever.  A stray
+weak-type/shape/dtype wobble (a python float where a jnp scalar was, a
+fresh lambda per call, an unbucketed pad) silently turns that into a
+compile per step — correctness tests stay green while p99 explodes.  The
+sanitizer makes that class of bug a hard failure:
+
+  * :func:`instrument` wraps a jitted callable; every call diffs the
+    executable's compile-cache size (``PjitFunction._cache_size``, ~ns) and
+    charges misses to a per-name counter (``ServingEngine`` instruments all
+    of its model fns this way, exposed as ``stats()["jit_cache_misses"]``).
+  * :func:`sanitize` is a context manager declaring a *recompile budget*:
+    any instrumented callable that misses more than its allowance while the
+    context is active raises :class:`RecompileBudgetError`.  While active
+    it also patches ``jax.jit`` so callables jitted inside the context are
+    auto-instrumented.
+
+Typical steady-state proof (tests/test_recompile_budget.py):
+
+    eng = ServingEngine(params, cfg, prefill_chunk=16, speculative=2)
+    ...warm run covering the traffic's shape buckets...
+    with sanitize(budget=0):        # steady state: ZERO recompiles allowed
+        ...same-shaped traffic...
+"""
+from __future__ import annotations
+
+import contextlib
+
+__all__ = ["RecompileBudgetError", "instrument", "sanitize", "jit_cache_size"]
+
+
+class RecompileBudgetError(RuntimeError):
+    """An instrumented jit callable exceeded its declared recompile budget
+    while a sanitize() context was active.
+
+    A compile-cache miss is only observable AFTER the underlying call has
+    executed, so by the time this raises the call's donated input buffers
+    (if any) are already consumed.  `result` therefore carries the executed
+    call's outputs: a caller that owns donated state can rebind it from
+    here before propagating (the ServingEngine does exactly this for its
+    KV page buffers, keeping the engine usable after a budget failure)."""
+
+    result = None       # outputs of the over-budget call, when available
+
+
+def jit_cache_size(fn):
+    """Compiled-variant count of a jitted callable (None when the backing
+    jax build exposes no cache introspection)."""
+    fn = getattr(fn, "_graft_jit", fn)
+    try:
+        return int(fn._cache_size())
+    except Exception:
+        return None
+
+
+_ACTIVE: list = []          # innermost-last stack of active _Sanitizer
+
+
+class _Sanitizer:
+    def __init__(self, budget=0, budgets=None):
+        self.default_budget = int(budget)
+        self.budgets = dict(budgets or {})
+        self.misses: dict[str, int] = {}
+
+    def allowance(self, name: str) -> int:
+        return int(self.budgets.get(name, self.default_budget))
+
+    def _record(self, name: str, n: int):
+        self.misses[name] = self.misses.get(name, 0) + n
+        if self.misses[name] > self.allowance(name):
+            raise RecompileBudgetError(
+                f"jit recompile budget exceeded for {name!r}: "
+                f"{self.misses[name]} compile-cache miss(es) inside a "
+                f"sanitize() scope allowing {self.allowance(name)} — an "
+                f"input's shape/dtype/weak-type wobbled, or a fresh "
+                f"callable defeated the jit cache")
+
+    @property
+    def total_misses(self) -> int:
+        return sum(self.misses.values())
+
+
+class _InstrumentedJit:
+    """Callable proxy over a jitted function: counts compile-cache misses
+    per call into `counters[name]` and reports them to any active
+    sanitize() scope.  Unknown attributes (lower, trace, ...) pass through
+    to the underlying PjitFunction."""
+
+    __slots__ = ("_graft_jit", "_graft_name", "_graft_counters")
+
+    def __init__(self, fn, name, counters):
+        self._graft_jit = fn
+        self._graft_name = name
+        self._graft_counters = counters
+
+    def __call__(self, *args, **kwargs):
+        fn = self._graft_jit
+        before = jit_cache_size(fn)
+        out = fn(*args, **kwargs)
+        if before is not None:
+            after = jit_cache_size(fn)
+            if after is not None and after > before:
+                n = after - before
+                c = self._graft_counters
+                c[self._graft_name] = c.get(self._graft_name, 0) + n
+                err = None
+                for s in reversed(_ACTIVE):
+                    try:
+                        s._record(self._graft_name, n)
+                    except RecompileBudgetError as e:
+                        # keep recording: an inner scope's raise must not
+                        # leave outer budgets undercounted (innermost
+                        # raise wins — it's the tightest violated budget)
+                        if err is None:
+                            err = e
+                if err is not None:
+                    # the call already ran (see RecompileBudgetError.result)
+                    # — hand its outputs to the raise so donated buffers
+                    # aren't lost with the discarded return value
+                    err.result = out
+                    raise err
+        return out
+
+    def __getattr__(self, attr):
+        return getattr(self._graft_jit, attr)
+
+    def __repr__(self):
+        return f"<instrumented jit {self._graft_name!r} of {self._graft_jit!r}>"
+
+
+def instrument(fn, name=None, counters=None):
+    """Wrap a jitted callable so its compile-cache misses are counted under
+    `name` in `counters` (a dict you own) and policed by active sanitize()
+    scopes.  Idempotent-ish: instrumenting an instrumented fn re-wraps the
+    underlying jit."""
+    if isinstance(fn, _InstrumentedJit):
+        fn = fn._graft_jit
+    if name is None:
+        name = getattr(fn, "__name__", None) or repr(fn)
+    return _InstrumentedJit(fn, name, counters if counters is not None else {})
+
+
+@contextlib.contextmanager
+def sanitize(budget=0, budgets=None, patch_jit=True):
+    """Recompile-budget scope.  `budget` is the per-callable allowance of
+    compile-cache misses inside the scope (0 = proven steady state);
+    `budgets` overrides it per instrumented name.  Yields the sanitizer —
+    inspect `.misses` / `.total_misses` after the block.  With `patch_jit`
+    (default), `jax.jit` calls made inside the scope return instrumented
+    callables automatically, so code that builds its executables inside the
+    scope is covered without explicit instrument() calls."""
+    import jax
+
+    s = _Sanitizer(budget=budget, budgets=budgets)
+    _ACTIVE.append(s)
+    orig_jit = jax.jit if patch_jit else None
+    if patch_jit:
+        # auto-instrumented jits report through whatever scopes are active
+        # at CALL time (including this one); their own counters dict is
+        # private — s.misses is the scope's ledger either way
+        def _scoped_jit(fun, *a, **kw):
+            jf = orig_jit(fun, *a, **kw)
+            return instrument(jf, name=getattr(fun, "__name__", "<jit>"),
+                              counters={})
+        jax.jit = _scoped_jit
+    try:
+        yield s
+    finally:
+        if patch_jit:
+            jax.jit = orig_jit
+        _ACTIVE.remove(s)

@@ -77,6 +77,8 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from ..analysis.sanitize import (RecompileBudgetError, instrument,
+                                 jit_cache_size)
 from ..resilience.faults import fault_point
 
 __all__ = ["PagePool", "PrefixCache", "Request", "ServingEngine",
@@ -544,6 +546,11 @@ class ServingEngine:
         from ..models.llama import (build_llama_paged_decode,
                                     _sample_per_request)
         self._jax, self._jnp = jax, jnp
+        # per-model-fn compile-cache miss counters (analysis.sanitize
+        # instrumentation; stats()["jit_cache_misses"]) + the underlying
+        # jitted fns for jit_variants() accounting
+        self.jit_cache_misses: dict[str, int] = {}
+        self._jit_fns: dict[str, list] = {}
         self.config = config
         self.params = params
         self.num_slots = int(num_slots)
@@ -580,7 +587,7 @@ class ServingEngine:
         # freezing inside the horizon mirrors llama_generate_fused's
         # masking, so greedy outputs are step-exact at any K.
         def _horizon(params, toks, lengths, page_tables, pk, pv, active, key,
-                     temps, top_ps, remaining, eos_ids, *, K, greedy):
+                     temps, top_ps, remaining, eos_ids, *, K, greedy):  # graftlint: jit
             S = toks.shape[0]
             out = jnp.zeros((S, K), jnp.int32)
 
@@ -614,7 +621,7 @@ class ServingEngine:
         # (a separate sample call would double the per-admission round-trips
         # on the remote TPU transport)
         def _prefill_sample(params, ids, true_len, page_row, pk, pv, key,
-                            temp, top_p, *, greedy):
+                            temp, top_p, *, greedy):  # graftlint: jit
             logits, pk, pv = prefill(params, ids, true_len, page_row, pk, pv)
             if greedy:
                 tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -626,7 +633,7 @@ class ServingEngine:
         # single-logits sampler for the final chunk of a chunked / suffix
         # prefill (the chunk executable itself is sampling-agnostic so one
         # executable serves every request)
-        def _sample_logits(logits, key, temp, top_p, *, greedy):
+        def _sample_logits(logits, key, temp, top_p, *, greedy):  # graftlint: jit
             if greedy:
                 return jnp.argmax(logits).astype(jnp.int32)
             return _sample_per_request(logits[None], key, temp[None],
@@ -634,7 +641,7 @@ class ServingEngine:
 
         # copy-on-write page copy (src/dst are traced scalars: ONE
         # executable covers every copy)
-        def _copy_page(pk, pv, src, dst):
+        def _copy_page(pk, pv, src, dst):             # graftlint: jit
             return (pk.at[:, :, dst].set(pk[:, :, src]),
                     pv.at[:, :, dst].set(pv[:, :, src]))
 
@@ -644,14 +651,17 @@ class ServingEngine:
         self._prefill_jit = {}         # (T_bucket, greedy) -> jitted prefill
         # one wrapper: jax.jit already caches per (C_pad, P_slice) shape,
         # and the chunk fn has no Python-level static knobs to key on
-        self._chunk_jit = jax.jit(prefill_chunk_fn, donate_argnums=(5, 6))
+        self._chunk_jit = self._jit("prefill_chunk", prefill_chunk_fn,
+                                    donate_argnums=(5, 6))
         self._sample_fn = _sample_logits
         self._sample_jit = {}          # greedy -> jitted sampler
-        self._copy_jit = jax.jit(_copy_page, donate_argnums=(0, 1))
+        self._copy_jit = self._jit("page_copy", _copy_page,
+                                   donate_argnums=(0, 1))
         # one wrapper: drafts pad to the STATIC K+1 query width, so the
         # verify executable compiles once per engine K (jax.jit caches by
         # shape) even when slots draft fewer tokens or none at all
-        self._verify_jit = jax.jit(verify_step, donate_argnums=(4, 5))
+        self._verify_jit = self._jit("verify_step", verify_step,
+                                     donate_argnums=(4, 5))
 
         # host-side slot state
         S, P = self.num_slots, self.max_pages_per_seq
@@ -733,6 +743,42 @@ class ServingEngine:
         return rid
 
     # -- internals ---------------------------------------------------------
+    def _jit(self, name, fn, **jit_kw):
+        """jax.jit + recompile instrumentation: every compile-cache miss of
+        the returned callable lands in `self.jit_cache_misses[name]` and is
+        reported to any active `analysis.sanitize()` scope (the recompile
+        budget).  All engine executables route through here so steady-state
+        variant counts are observable per model fn."""
+        jf = self._jax.jit(fn, **jit_kw)
+        self._jit_fns.setdefault(name, []).append(jf)
+        return instrument(jf, name=name, counters=self.jit_cache_misses)
+
+    def _call_paged(self, fn, *args):
+        """Call a page-donating executable (its last two outputs are the
+        new K/V page buffers).  A sanitize() budget raise fires only AFTER
+        the underlying call ran — its donated inputs are gone — so rebind
+        the page buffers from the executed call's outputs before
+        propagating: lengths were never advanced for the raising step and
+        K/V above lengths is never attended (the rewind invariant), so the
+        engine stays fully usable."""
+        try:
+            out = fn(*args)
+        except RecompileBudgetError as e:
+            if e.result is not None:
+                self._pages_k, self._pages_v = e.result[-2], e.result[-1]
+            raise
+        return out
+
+    def jit_variants(self) -> dict:
+        """{model fn name: number of compiled executables} — the bounded,
+        documented variant counts PERF.md §12 records (None-valued entries
+        mean the jax build exposes no cache introspection)."""
+        out = {}
+        for name, fns in self._jit_fns.items():
+            sizes = [jit_cache_size(f) for f in fns]
+            out[name] = None if any(s is None for s in sizes) else sum(sizes)
+        return out
+
     def _split_key(self):
         self._key, sub = self._jax.random.split(self._key)
         return sub
@@ -825,22 +871,25 @@ class ServingEngine:
                     keep.append(req)
             self._queue = keep
 
-    def _record_token(self, s: int, tok: int) -> bool:
+    def _record_token(self, s: int, tok: int) -> bool:  # graftlint: hot
         """Append a sampled token; returns True when the request finished."""
         slot = self._slots[s]
         req = slot.req
-        req.generated.append(int(tok))
+        # normalizes an already-fetched host scalar to a python int (the
+        # device sync happened at the annotated np.asarray fetch sites)
+        tok = int(tok)  # graftlint: disable=SYNC001
+        req.generated.append(tok)
         if slot.draft is not None:
-            slot.draft.append(int(tok))
+            slot.draft.append(tok)
         if req.first_token_time == 0.0:
             req.first_token_time = time.perf_counter()
         self.tokens_generated += 1
-        done = (req.eos_token_id is not None and int(tok) == req.eos_token_id) \
+        done = (req.eos_token_id is not None and tok == req.eos_token_id) \
             or len(req.generated) >= req.max_new_tokens
         if done:
             self._finish(s)
         else:
-            slot.pending = int(tok)
+            slot.pending = tok
         return done
 
     def _cow(self, s: int, idx: int, src: int | None = None):
@@ -854,8 +903,8 @@ class ServingEngine:
         if src is None:
             src = dst
             dst = self.pool.alloc(1)[0]
-        self._pages_k, self._pages_v = self._copy_jit(
-            self._pages_k, self._pages_v,
+        self._pages_k, self._pages_v = self._call_paged(
+            self._copy_jit, self._pages_k, self._pages_v,
             jnp.asarray(src, jnp.int32), jnp.asarray(dst, jnp.int32))
         if slot.pages[idx] != dst:
             self.pool.free([slot.pages[idx]])
@@ -863,7 +912,7 @@ class ServingEngine:
         self._page_tables[s, idx] = dst
         self.cow_copies += 1
 
-    def _admit(self):
+    def _admit(self):                                 # graftlint: hot
         jnp = self._jnp
         while self._queue:
             free_slots = [i for i, sl in enumerate(self._slots) if sl is None]
@@ -875,6 +924,7 @@ class ServingEngine:
             # token — exactly the state the victim was evicted in
             resuming = len(req.generated) > 0
             ctx = req.prompt if not resuming else np.concatenate(
+                # host list -> np ids, no device sync  # graftlint: disable=SYNC001
                 [req.prompt, np.asarray(req.generated[:-1], np.int32)])
             T = len(ctx)
             total_pages = max(1, math.ceil(T / self.page_size))
@@ -918,6 +968,7 @@ class ServingEngine:
                 slot.spec_k = self.speculative
                 slot.draft = _NgramDraft(
                     req.prompt if not resuming else np.concatenate(
+                        # host list -> np ids, no device sync  # graftlint: disable=SYNC001
                         [req.prompt, np.asarray(req.generated, np.int32)]),
                     max_n=self.spec_max_ngram)
             row = np.zeros((self.max_pages_per_seq,), np.int32)
@@ -957,26 +1008,33 @@ class ServingEngine:
                 pf = self._prefill_jit.get((Tb, greedy))
                 if pf is None:
                     fn = self._prefill_fn
-                    pf = self._jax.jit(
+                    pf = self._jit(
+                        "prefill",
                         (lambda *a: fn(*a, greedy=True)) if greedy
                         else (lambda *a: fn(*a, greedy=False)),
                         donate_argnums=(4, 5))
                     self._prefill_jit[(Tb, greedy)] = pf
-                tok, self._pages_k, self._pages_v = pf(
-                    self.params, jnp.asarray(ids), jnp.asarray(T, jnp.int32),
-                    jnp.asarray(row), self._pages_k, self._pages_v,
-                    self._split_key(),
-                    jnp.asarray(req.temperature, jnp.float32),
-                    jnp.asarray(req.top_p, jnp.float32))
-                if self.cache is not None:
-                    self.cache.register(ctx, pages)
-                if resuming:
-                    # the re-prefill rebuilt the cache; the last emitted
-                    # token is still the pending one — discard the
-                    # redundant sample
-                    slot.pending = int(req.generated[-1])
-                else:
-                    self._record_token(s, int(np.asarray(tok)))
+                try:
+                    tok, self._pages_k, self._pages_v = self._call_paged(
+                        pf,
+                        self.params, jnp.asarray(ids),
+                        jnp.asarray(T, jnp.int32),
+                        jnp.asarray(row), self._pages_k, self._pages_v,
+                        self._split_key(),
+                        jnp.asarray(req.temperature, jnp.float32),
+                        jnp.asarray(req.top_p, jnp.float32))
+                except RecompileBudgetError as e:
+                    # the prefill DID run (pages already rebound by
+                    # _call_paged) — finish the admission bookkeeping with
+                    # the sampled token the raise carries, so the slot is
+                    # left exactly as the success path leaves it and a
+                    # later run() continues bit-exactly
+                    if e.result is None:
+                        raise
+                    self._finish_admission(s, e.result[0], ctx, pages,
+                                           resuming)
+                    raise
+                self._finish_admission(s, tok, ctx, pages, resuming)
             else:
                 # suffix / chunked prefill: only the un-cached tokens run,
                 # at most prefill_chunk per engine step
@@ -985,7 +1043,25 @@ class ServingEngine:
                 self._lengths[s] = matched
                 self._prefill_advance(s)
 
-    def _prefill_advance(self, s: int):
+    def _finish_admission(self, s, tok, ctx, pages,
+                          resuming):                  # graftlint: hot
+        """Post-dense-prefill bookkeeping, shared by the success path and
+        the RecompileBudgetError recovery path of _admit (the executed
+        call's outputs ride the exception)."""
+        slot = self._slots[s]
+        if self.cache is not None:
+            self.cache.register(ctx, pages)
+        if resuming:
+            # the re-prefill rebuilt the cache; the last emitted token is
+            # still the pending one (a python int — _record_token
+            # normalizes) — discard the redundant sample
+            slot.pending = slot.req.generated[-1]
+        else:
+            # the ONE per-admission sync: the fused prefill+sample's
+            # first token  # graftlint: disable=SYNC001
+            self._record_token(s, int(np.asarray(tok)))
+
+    def _prefill_advance(self, s: int):               # graftlint: hot
         """Run ONE prefill chunk for slot s (suffix prefill after a cache
         hit is the single- or few-chunk case).  On the final chunk: index
         the prompt's full blocks into the cache and sample the first
@@ -1012,7 +1088,8 @@ class ServingEngine:
         Pb = min(self.max_pages_per_seq, math.ceil(ctx_pages / 4) * 4)
         ids = np.zeros((1, Cb), np.int32)
         ids[0, :c] = slot.ctx[pos:pos + c]
-        logits, self._pages_k, self._pages_v = self._chunk_jit(
+        logits, self._pages_k, self._pages_v = self._call_paged(
+            self._chunk_jit,
             self.params, jnp.asarray(ids), jnp.asarray(pos, jnp.int32),
             jnp.asarray(c, jnp.int32),
             jnp.asarray(self._page_tables[s, :Pb]),
@@ -1030,14 +1107,24 @@ class ServingEngine:
             self.cache.register(ctx, slot.pages)
         if slot.resuming:
             # the re-prefill rebuilt the cache; the last emitted token is
-            # still the pending one — no fresh sample needed
-            slot.pending = int(req.generated[-1])
+            # still the pending one (a python int) — no fresh sample needed
+            slot.pending = req.generated[-1]
         else:
-            tok = self._sampler(req.temperature <= 0.0)(
-                logits, self._split_key(),
-                jnp.asarray(req.temperature, jnp.float32),
-                jnp.asarray(req.top_p, jnp.float32))
-            self._record_token(s, int(np.asarray(tok)))
+            try:
+                tok = self._sampler(req.temperature <= 0.0)(
+                    logits, self._split_key(),
+                    jnp.asarray(req.temperature, jnp.float32),
+                    jnp.asarray(req.top_p, jnp.float32))
+            except RecompileBudgetError as e:
+                # the sampler DID run — record the token it produced so
+                # the completed-prefill transition above stays consistent
+                # and a later run() decodes from the right first token
+                if e.result is None:
+                    raise
+                self._record_token(s, int(np.asarray(e.result)))  # graftlint: disable=SYNC001
+                raise
+            # the ONE final-chunk sync: the sampled first token
+            self._record_token(s, int(np.asarray(tok)))  # graftlint: disable=SYNC001
 
     def _sampler(self, greedy: bool):
         """Jitted single-logits sampler, cached per greedy flag (the final
@@ -1046,7 +1133,8 @@ class ServingEngine:
         sf = self._sample_jit.get(greedy)
         if sf is None:
             fn = self._sample_fn
-            sf = self._jax.jit(
+            sf = self._jit(
+                "sample",
                 (lambda *a: fn(*a, greedy=True)) if greedy
                 else (lambda *a: fn(*a, greedy=False)))
             self._sample_jit[greedy] = sf
@@ -1122,7 +1210,7 @@ class ServingEngine:
                 drafts[s] = d
         return drafts
 
-    def _verify(self, run, drafts):
+    def _verify(self, run, drafts):                   # graftlint: hot
         """One speculative verify dispatch over the runnable slots: score
         pending + draft tokens at K+1 positions, accept the longest draft
         prefix whose argmax matches (lossless under greedy sampling), emit
@@ -1146,11 +1234,14 @@ class ServingEngine:
             if d:
                 toks[s, 1:1 + len(d)] = d
             n_q[s] = 1 + len(d)
-        logits0, gtoks, self._pages_k, self._pages_v = self._verify_jit(
+        logits0, gtoks, self._pages_k, self._pages_v = self._call_paged(
+            self._verify_jit,
             self.params, jnp.asarray(toks), jnp.asarray(self._lengths),
             jnp.asarray(self._page_tables), self._pages_k, self._pages_v,
             jnp.asarray(n_q))
-        gtoks = np.asarray(gtoks)
+        # the ONE per-verify-dispatch sync: every slot's K+1 argmaxes land
+        # in one transfer (acceptance is host logic by design)
+        gtoks = np.asarray(gtoks)  # graftlint: disable=SYNC001
         self.steps_run += 1
         self.verify_steps += 1
         for s in run:
@@ -1158,19 +1249,34 @@ class ServingEngine:
             req = slot.req
             d = list(drafts.get(s, ()))
             nd = len(d)
-            old = int(self._lengths[s])
+            # _lengths is the HOST numpy mirror (its device fetch is the
+            # annotated horizon/verify sync), so this read is free
+            old = int(self._lengths[s])  # graftlint: disable=SYNC001
             if req.temperature > 0.0:
-                tok = self._sampler(False)(
-                    logits0[s], self._split_key(),
-                    jnp.asarray(req.temperature, jnp.float32),
-                    jnp.asarray(req.top_p, jnp.float32))
-                emitted = [int(np.asarray(tok))]
+                try:
+                    tok = self._sampler(False)(
+                        logits0[s], self._split_key(),
+                        jnp.asarray(req.temperature, jnp.float32),
+                        jnp.asarray(req.top_p, jnp.float32))
+                except RecompileBudgetError as e:
+                    # same recovery as the final-chunk sampler: the call
+                    # ran and consumed a PRNG key — record its token so
+                    # the resumed engine stays on the seeded key stream
+                    # instead of re-sampling this position with a later key
+                    if e.result is None:
+                        raise
+                    self._lengths[s] = old + 1
+                    self._record_token(s, int(np.asarray(e.result)))  # graftlint: disable=SYNC001
+                    raise
+                # per sampled ride-along lane: one token fetch
+                emitted = [int(np.asarray(tok))]  # graftlint: disable=SYNC001
                 acc = 0
             else:
+                g = gtoks[s].tolist()        # host row -> python ints
                 acc = 0
-                while acc < nd and int(gtoks[s, acc]) == d[acc]:
+                while acc < nd and g[acc] == d[acc]:
                     acc += 1
-                emitted = d[:acc] + [int(gtoks[s, acc])]
+                emitted = d[:acc] + [g[acc]]
             if nd:
                 if acc == nd:          # fully accepted: regrow toward K
                     slot.spec_k = min(self.speculative, slot.spec_k + 1)
@@ -1183,7 +1289,7 @@ class ServingEngine:
                 # plus i-1 accepted drafts past the old length
                 self._lengths[s] = old + i
                 n_emitted = i
-                if self._record_token(s, int(tok)):
+                if self._record_token(s, tok):
                     break
             if nd:
                 # credit only drafts that actually LANDED: an EOS/budget
@@ -1199,7 +1305,8 @@ class ServingEngine:
     def _horizon_exec(self, K: int, greedy: bool):
         fn = self._horizon_jit.get((K, greedy))
         if fn is None:
-            fn = self._jax.jit(
+            fn = self._jit(
+                "decode_step",
                 lambda *a: self._horizon_fn(*a, K=K, greedy=greedy),
                 donate_argnums=(4, 5))
             self._horizon_jit[(K, greedy)] = fn
@@ -1210,7 +1317,7 @@ class ServingEngine:
     def num_active(self) -> int:
         return sum(1 for sl in self._slots if sl is not None)
 
-    def step(self) -> bool:
+    def step(self) -> bool:                           # graftlint: hot
         """One engine step: retire overdue requests, admit queued requests
         into free slots (attaching cached prefixes), advance each
         mid-prefill slot by one chunk, provision pages for the decode
@@ -1299,21 +1406,23 @@ class ServingEngine:
             if slot.req.eos_token_id is not None:
                 eos_ids[s] = slot.req.eos_token_id
         greedy = all(self._temps[s] <= 0.0 for s in run)
-        out, new_lengths, self._pages_k, self._pages_v = self._horizon_exec(
-            K, greedy)(
+        out, new_lengths, self._pages_k, self._pages_v = self._call_paged(
+            self._horizon_exec(K, greedy),
             self.params, jnp.asarray(toks), jnp.asarray(self._lengths),
             jnp.asarray(self._page_tables), self._pages_k, self._pages_v,
             jnp.asarray(active), self._split_key(),
             jnp.asarray(self._temps), jnp.asarray(self._top_ps),
             jnp.asarray(remaining), jnp.asarray(eos_ids))
-        out = np.asarray(out)
+        # the TWO per-horizon syncs: K tokens/slot + lengths in one batch
+        # each — the whole point of the K-step horizon (PERF.md §8)
+        out = np.asarray(out)  # graftlint: disable=SYNC001
         # inactive slots (stalled or mid-prefill) echo their input length
         # through the horizon unchanged, so the wholesale copy is safe
-        self._lengths = np.asarray(new_lengths).astype(np.int32).copy()
+        self._lengths = np.asarray(new_lengths).astype(np.int32).copy()  # graftlint: disable=SYNC001
         self.steps_run += 1
         for s in run:
             for tok in out[s]:
-                if self._record_token(s, int(tok)):
+                if self._record_token(s, tok):
                     break
         return True
 
@@ -1368,6 +1477,10 @@ class ServingEngine:
             "preemptions": self.preemptions,
             "timeouts": self.timeouts,
             "rejections": self.rejections,
+            # per-model-fn compile-cache misses (analysis.sanitize
+            # instrumentation) — a warmed steady state must hold these
+            # flat; bench --json artifacts embed them via engine_stats
+            "jit_cache_misses": dict(self.jit_cache_misses),
         }
 
     def release_cache(self) -> int:

@@ -609,7 +609,7 @@ def build_llama_decode(config: LlamaConfig, max_seq: int = None, dtype=None):
         h = rms_norm_ref(x_last, hp["ln_f"], c.rms_norm_eps)
         return (h @ hp["lm"]).astype(jnp.float32)
 
-    def prefill(params, ids):
+    def prefill(params, ids):                         # graftlint: jit
         """ids [B, T_prompt] -> (logits [B, vocab] for the last token, cache)."""
         ep, bp, hp = params
         B, T = ids.shape
@@ -627,7 +627,7 @@ def build_llama_decode(config: LlamaConfig, max_seq: int = None, dtype=None):
         cache = {"k": ks, "v": vs, "pos": jnp.asarray(T, jnp.int32)}
         return _head(hp, x[:, -1]), cache
 
-    def decode_step(params, tok, cache):
+    def decode_step(params, tok, cache):              # graftlint: jit
         """tok [B] int32 -> (logits [B, vocab], cache advanced by one)."""
         ep, bp, hp = params
         B = tok.shape[0]
@@ -760,7 +760,7 @@ def build_llama_paged_decode(config: LlamaConfig, page_size: int = 16,
         h = rms_norm_ref(h_last, hp["ln_f"], c.rms_norm_eps)
         return (h @ hp["lm"]).astype(jnp.float32)
 
-    def prefill(params, ids, true_len, page_row, pages_k, pages_v):
+    def prefill(params, ids, true_len, page_row, pages_k, pages_v):  # graftlint: jit
         ep, bp, hp = params
         T = ids.shape[1]
         x = ep["tok"][ids[0]].astype(d)               # [T, H]
@@ -803,7 +803,7 @@ def build_llama_paged_decode(config: LlamaConfig, page_size: int = 16,
         return _head(hp, h_last), ks, vs
 
     def prefill_chunk(params, ids, start, chunk_len, page_row, pages_k,
-                      pages_v):
+                      pages_v):                       # graftlint: jit
         ep, bp, hp = params
         C = ids.shape[1]
         P = page_row.shape[0]
@@ -855,7 +855,7 @@ def build_llama_paged_decode(config: LlamaConfig, page_size: int = 16,
         return _head(hp, h_last), ks, vs
 
     def decode_step(params, toks, lengths, page_tables, pages_k, pages_v,
-                    active):
+                    active):                          # graftlint: jit
         ep, bp, hp = params
         S = toks.shape[0]
         x = ep["tok"][toks].astype(d)                 # [S, H]
@@ -887,7 +887,7 @@ def build_llama_paged_decode(config: LlamaConfig, page_size: int = 16,
         return _head(hp, x), ks, vs
 
     def verify_step(params, toks, lengths, page_tables, pages_k, pages_v,
-                    n_q):
+                    n_q):                             # graftlint: jit
         """Multi-token speculative VERIFY (self-speculative decoding):
         score Q = K+1 query positions per slot in ONE dispatch.  Per slot,
         toks[s, 0] is the pending token (the last sampled token, not yet
@@ -1008,8 +1008,13 @@ def functional_params_from_layer(model: "LlamaForCausalLM"):
     return ep, bp, hp
 
 
-def _sample_token(logits, key, temperature=1.0, top_k=0, top_p=1.0):
-    """logits [B, V] -> token ids [B] (greedy when temperature == 0)."""
+def _sample_token(logits, key, *, temperature=1.0, top_k=0, top_p=1.0):
+    """logits [B, V] -> token ids [B] (greedy when temperature == 0).
+
+    The sampling knobs are KEYWORD-ONLY statics (python `if`s below branch
+    on them): callers bind them via functools.partial before jitting, so
+    each (temperature, top_k, top_p) combination is its own executable —
+    graftlint TRACE001 enforces that they can never arrive traced."""
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits / temperature

@@ -8,8 +8,6 @@ kernels via interpret=True).
 """
 from __future__ import annotations
 
-import math
-
 import jax
 import jax.numpy as jnp
 
@@ -25,19 +23,10 @@ flash_attention = fa_mod.flash_attention
 ragged_paged_attention_decode = pa_mod.ragged_paged_attention_decode
 
 
-def _naive_sdpa(q, k, v, causal):
-    d = q.shape[-1]
-    if k.shape[2] != q.shape[2]:  # GQA: up-materialize only in the fallback
-        rep = q.shape[2] // k.shape[2]
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / math.sqrt(d)
-    if causal:
-        sq, sk = s.shape[-2], s.shape[-1]
-        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
-        s = jnp.where(mask, s, fa_mod.NEG_INF)
-    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+# the kernel module owns its jnp reference (graftlint PAR001: every Pallas
+# kernel pairs with a `*_ref` in its own module)
+_naive_sdpa = lambda q, k, v, causal: fa_mod.flash_attention_ref(
+    q, k, v, causal=causal)
 
 
 def _softmax_pallas(x, *, axis=-1, cast_dtype=None):

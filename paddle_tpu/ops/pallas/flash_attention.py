@@ -663,6 +663,26 @@ def _pad_to_tile(q, k, v, segment_ids):
     return qp, kp, vp, segp, s
 
 
+def flash_attention_ref(q, k, v, causal=False):
+    """jnp reference with identical semantics to the kernel's core path
+    ([B, S, H, D] layout, GQA via up-materialized K/V, fp32 softmax) — the
+    parity tests' oracle and the off-TPU dispatch fallback.  Materializes
+    the [B, H, S, S] score tensor; use the kernel for real workloads."""
+    d = q.shape[-1]
+    if k.shape[2] != q.shape[2]:  # GQA: up-materialize only in the fallback
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) \
+        / math.sqrt(d)
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        s = jnp.where(mask, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
 def flash_attention(q, k, v, causal=False, interpret=False, segment_ids=None,
                     dropout_rate=0.0, dropout_seed=None):
     """[B, S, H, D] flash attention; falls back unsupported shapes to the

@@ -20,7 +20,36 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["rms_norm", "adamw_update", "softmax", "layer_norm"]
+__all__ = ["rms_norm", "adamw_update", "softmax", "layer_norm",
+           "rms_norm_ref", "layer_norm_ref", "softmax_ref",
+           "adamw_update_ref"]
+
+# jnp references (graftlint PAR001: the kernel module itself exports the
+# fallback/oracle implementations its parity tests pair against).  The
+# norm refs are shared with the functional API — one source of truth.
+from ...nn.functional.norm import layer_norm_ref, rms_norm_ref  # noqa: F401,E402
+
+
+def softmax_ref(x, axis=-1):
+    """jnp reference for the fused `softmax` kernel (last-axis case)."""
+    return jax.nn.softmax(x, axis=axis)
+
+
+def adamw_update_ref(p, g, m, v, *, lr, beta1=0.9, beta2=0.999, eps=1e-8,
+                     weight_decay=0.01, step=None, bias1=None, bias2=None):
+    """jnp reference for `adamw_update` (same signature sans `interpret`):
+    fp32 moment math, decoupled weight decay, params back in p.dtype."""
+    if bias1 is None:
+        bias1 = 1.0 - beta1 ** step
+        bias2 = 1.0 - beta2 ** step
+    gf = g.astype(jnp.float32)
+    nm = beta1 * m.astype(jnp.float32) + (1.0 - beta1) * gf
+    nv = beta2 * v.astype(jnp.float32) + (1.0 - beta2) * gf * gf
+    mh = nm / bias1
+    vh = nv / bias2
+    pf = p.astype(jnp.float32)
+    np_ = pf - lr * (mh / (jnp.sqrt(vh) + eps) + weight_decay * pf)
+    return np_.astype(p.dtype), nm, nv
 
 
 # ---------------------------------------------------------------------------

@@ -9,6 +9,8 @@ API + grad test for free").
 """
 from __future__ import annotations
 
+import math as _math
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -66,7 +68,7 @@ _SPECS = [
     _u(jnp.sign, np.sign, "t_sign", grad=False),
     _u(jnp.abs, np.abs, "t_abs", low=0.2, high=3.0),  # keep away from 0 kink
     # --- special ----------------------------------------------------------
-    _u(jax.scipy.special.erf, None, "t_erf", amp="deny"),
+    _u(jax.scipy.special.erf, np.vectorize(_math.erf), "t_erf", amp="deny"),
     _u(jax.nn.sigmoid, lambda x: 1 / (1 + np.exp(-x)), "t_sigmoid"),
     _u(jax.nn.softplus, lambda x: np.log1p(np.exp(x)), "t_softplus"),
     _u(jax.nn.silu, lambda x: x / (1 + np.exp(-x)), "t_silu"),
@@ -128,7 +130,7 @@ _SPECS = [
     _u(jnp.trunc, np.trunc, "t_trunc", grad=False),
     _u(jnp.cbrt, np.cbrt, "t_cbrt", low=0.2, high=4.0),
     _u(jnp.exp2, np.exp2, "t_exp2"),
-    _u(jax.scipy.special.erfc, None, "t_erfc"),
+    _u(jax.scipy.special.erfc, np.vectorize(_math.erfc), "t_erfc"),
     _u(jnp.deg2rad, np.deg2rad, "t_deg2rad"),
     _u(jnp.rad2deg, np.rad2deg, "t_rad2deg"),
     _b(jnp.hypot, np.hypot, "t_hypot", low=0.5, high=3.0),

@@ -83,6 +83,12 @@ def test_tensor_op(name):
 
 
 @pytest.mark.parametrize(
-    "name", [n for n, e in FUNCTIONAL.items() if isinstance(e, S)])
+    "name",
+    # ctc_loss compiles a heavy per-step lax.scan: 19s solo / 22-29s
+    # in-suite on this class of host — chronically over the 20s
+    # single-test tier-1 gate, so it runs in the slow lane (the op's
+    # registry spec/coverage checks above stay tier-1)
+    [pytest.param(n, marks=pytest.mark.slow) if n == "ctc_loss" else n
+     for n, e in FUNCTIONAL.items() if isinstance(e, S)])
 def test_functional_op(name):
     run_spec(name, _F_OPS[name], FUNCTIONAL[name])

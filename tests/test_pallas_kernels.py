@@ -161,7 +161,7 @@ def test_fused_rms_norm_untileable_returns_none():
 # Fused AdamW kernel
 # ---------------------------------------------------------------------------
 def test_fused_adamw_matches_reference():
-    from paddle_tpu.ops.pallas.fused import adamw_update
+    from paddle_tpu.ops.pallas.fused import adamw_update, adamw_update_ref
     n = 4 * 4096
     p = jnp.asarray(rng.standard_normal(n).astype(np.float32)).reshape(16, 1024)
     g = jnp.asarray(rng.standard_normal(n).astype(np.float32)).reshape(16, 1024)
@@ -174,11 +174,9 @@ def test_fused_adamw_matches_reference():
     assert res is not None
     np_, nm, nv = res
 
-    m_ref = b1 * m + (1 - b1) * g
-    v_ref = b2 * v + (1 - b2) * g * g
-    mh = m_ref / (1 - b1 ** t)
-    vh = v_ref / (1 - b2 ** t)
-    p_ref = p - lr * (mh / (jnp.sqrt(vh) + eps) + wd * p)
+    p_ref, m_ref, v_ref = adamw_update_ref(
+        p, g, m, v, lr=lr, beta1=b1, beta2=b2, eps=eps, weight_decay=wd,
+        step=t)
     np.testing.assert_allclose(np.asarray(np_), np.asarray(p_ref), rtol=1e-6, atol=1e-6)
     np.testing.assert_allclose(np.asarray(nm), np.asarray(m_ref), rtol=1e-6, atol=1e-6)
     np.testing.assert_allclose(np.asarray(nv), np.asarray(v_ref), rtol=1e-6, atol=1e-6)
@@ -507,3 +505,25 @@ def test_flash_attention_pad_to_tile(S):
     for a, b in zip(gfa, gref):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-4, atol=2e-4)
+
+
+# ---------------------------------------------------------------------------
+# Ragged paged-attention decode kernel vs its jnp reference (graftlint
+# PAR001: every ops/pallas kernel module registers a parity test HERE; the
+# serving-level sweeps live in test_paged_serving.py)
+# ---------------------------------------------------------------------------
+def test_paged_attention_decode_parity_vs_ref():
+    from paddle_tpu.ops.pallas.paged_attention import (
+        ragged_paged_attention_decode, paged_attention_decode_ref)
+    S, Hq, Hkv, D, ps, NP, P = 4, 8, 2, 64, 16, 13, 3
+    q = jnp.asarray(rng.standard_normal((S, Hq, D)).astype(np.float32))
+    kp = jnp.asarray(rng.standard_normal((Hkv, NP, ps, D)).astype(np.float32))
+    vp = jnp.asarray(rng.standard_normal((Hkv, NP, ps, D)).astype(np.float32))
+    pt = jnp.asarray(rng.permutation(NP - 1)[: S * P].reshape(S, P)
+                     .astype(np.int32))
+    # ragged mix: empty, sub-page, page-boundary, full-table lengths
+    lens = jnp.asarray(np.array([0, 5, ps, P * ps], np.int32))
+    out = ragged_paged_attention_decode(q, kp, vp, pt, lens, interpret=True)
+    ref = paged_attention_decode_ref(q, kp, vp, pt, lens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)

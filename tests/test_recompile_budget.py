@@ -1,0 +1,287 @@
+"""Recompile-budget regression (graftlint's runtime half, ISSUE 5): a
+WARMED ServingEngine — prefill_chunk + speculative on, mixed greedy/sampled
+traffic, prefix cache hitting — performs ZERO jit compile-cache misses in
+steady state, and its per-model-fn variant counts equal the documented
+working set (PERF.md §12).  `paddle_tpu.analysis.sanitize(budget=0)` turns
+any steady-state recompile into a hard RecompileBudgetError, so a
+weak-type/shape/bucketing regression fails HERE instead of surfacing as a
+silent p99 explosion.
+
+Round structure: round 1 compiles the cold executables, round 2 the
+cache-hit paths (suffix prefill, copy-on-write), round 3 runs under a
+zero-miss budget.  Replaying IDENTICAL traffic is sound because greedy
+outputs are bit-exact across cache-on replays (the PR 3/4 losslessness
+invariants), so round 3's step structure mirrors round 2's exactly; the
+sampled request's token VALUES differ per round but shapes and timing
+(fixed max_new, no EOS) do not.
+"""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.analysis import (RecompileBudgetError, instrument, sanitize)
+from paddle_tpu.inference.paged import ServingEngine
+from paddle_tpu.models.llama import (llama_config_tiny,
+                                     build_functional_llama, llama_generate)
+
+
+def _echo_params(cfg, seed=0):
+    """Echo-biased params (test_spec_decode's trick): greedy decode settles
+    into repetition, so the n-gram drafter stays busy deterministically."""
+    ep, bp, hp, *_ = build_functional_llama(cfg, key=jax.random.PRNGKey(seed))
+    bp = {k: (v * 0.05 if k.startswith("w") else v) for k, v in bp.items()}
+    hp = dict(hp, lm=(ep["tok"].T * 4.0).astype(hp["lm"].dtype))
+    return ep, bp, hp
+
+
+# ---------------------------------------------------------------------------
+# instrument() / sanitize() unit behavior
+# ---------------------------------------------------------------------------
+class TestSanitizer:
+    def test_instrument_counts_misses_per_shape(self):
+        counters = {}
+        f = instrument(jax.jit(lambda x: x + 1), name="f", counters=counters)
+        f(jnp.zeros((2,)))
+        f(jnp.ones((2,)))                     # same shape: cached
+        assert counters == {"f": 1}
+        f(jnp.zeros((3,)))                    # new shape: one miss
+        assert counters == {"f": 2}
+
+    def test_budget_zero_raises_and_allowance_passes(self):
+        counters = {}
+        f = instrument(jax.jit(lambda x: x * 2), name="g", counters=counters)
+        f(jnp.zeros((2,)))                    # warmed outside the scope
+        with sanitize(budget=0) as s:
+            f(jnp.ones((2,)))                 # cached: fine
+            with pytest.raises(RecompileBudgetError):
+                f(jnp.zeros((4,)))            # recompile: over budget
+        assert s.misses == {"g": 1}
+        with sanitize(budget=1) as s:
+            f(jnp.zeros((5,)))                # within the allowance
+        assert s.total_misses == 1
+
+    def test_patched_jit_auto_instruments(self):
+        with sanitize(budget=0) as s:
+            g = jax.jit(lambda x: x - 1)
+            with pytest.raises(RecompileBudgetError):
+                g(jnp.zeros((2,)))            # first compile inside scope
+        assert s.total_misses == 1
+        # the patch is scoped: jax.jit is restored
+        h = jax.jit(lambda x: x)
+        assert not hasattr(h, "_graft_jit")
+
+    def test_over_budget_error_carries_executed_result(self):
+        # a miss is only observable AFTER the call ran, so the raise must
+        # hand back the executed call's outputs — donated buffers would
+        # otherwise be lost with the discarded return value
+        f = instrument(jax.jit(lambda x: x + 1), name="d", counters={})
+        with sanitize(budget=0):
+            with pytest.raises(RecompileBudgetError) as ei:
+                f(jnp.zeros((2,)))
+        assert np.allclose(np.asarray(ei.value.result), 1.0)
+
+    def test_inner_raise_still_counts_in_outer_scope(self):
+        # an inner scope's raise must not leave outer budgets
+        # undercounted: every active scope records every miss
+        f = instrument(jax.jit(lambda x: x - 1), name="n", counters={})
+        with sanitize(budget=10) as outer:
+            for k in (2, 3, 4):
+                with pytest.raises(RecompileBudgetError):
+                    with sanitize(budget=0):
+                        f(jnp.zeros((k,)))
+        assert outer.misses == {"n": 3}
+
+    def test_per_name_budgets(self):
+        c = {}
+        f = instrument(jax.jit(lambda x: x + 1), name="warm", counters=c)
+        with sanitize(budget=0, budgets={"warm": 2}) as s:
+            f(jnp.zeros((2,)))
+            f(jnp.zeros((3,)))
+        assert s.misses == {"warm": 2}
+
+
+# ---------------------------------------------------------------------------
+# the serving-engine steady-state proof
+# ---------------------------------------------------------------------------
+class TestServingSteadyState:
+    def _engine(self, cfg, params):
+        return ServingEngine(params, cfg, num_slots=3, page_size=16,
+                             num_pages=96, prompt_bucket=16,
+                             decode_horizon=4, prefill_chunk=16,
+                             speculative=2, seed=3)
+
+    def test_warmed_engine_zero_steady_state_misses(self):
+        cfg = llama_config_tiny(vocab=64, hidden=32, layers=2, heads=4,
+                                seq=128)
+        params = _echo_params(cfg, seed=11)
+        eng = self._engine(cfg, params)
+        r = np.random.default_rng(23)
+        # mixed traffic: chunked greedy, chunked SAMPLED, short dense
+        # greedy, repetitive greedy (feeds the n-gram drafter)
+        A = r.integers(1, 64, (40,)).astype(np.int32)
+        B = r.integers(1, 64, (40,)).astype(np.int32)
+        C = r.integers(1, 64, (10,)).astype(np.int32)
+        D = np.tile(np.array([5, 9, 2, 13], np.int32), 6)     # T=24
+
+        def one_round():
+            rids = [eng.submit(A, max_new_tokens=8),
+                    eng.submit(B, max_new_tokens=12, temperature=0.8,
+                               top_p=0.9),
+                    eng.submit(C, max_new_tokens=8),
+                    eng.submit(D, max_new_tokens=8)]
+            done = eng.run()
+            return [list(done[i].generated) for i in rids]
+
+        g1 = one_round()              # cold: compile the working set
+        g2 = one_round()              # cache-hit paths (suffix chunk, COW)
+        warm_misses = dict(eng.jit_cache_misses)
+        warm_variants = dict(eng.jit_variants())
+        with sanitize(budget=0) as s:
+            g3 = one_round()          # steady state: ZERO recompiles
+        assert s.misses == {}
+        assert eng.jit_cache_misses == warm_misses
+        assert eng.jit_variants() == warm_variants
+        # greedy outputs replay bit-exactly (the losslessness invariants
+        # that make identical-traffic warming sound)
+        for i in (0, 2, 3):
+            assert g1[i] == g2[i] == g3[i]
+        # the round actually exercised every subsystem under budget
+        st = eng.stats()
+        assert st["jit_cache_misses"] == warm_misses
+        assert eng.verify_steps > 0, "speculative verify never dispatched"
+        assert eng.cow_copies > 0, "copy-on-write path never ran"
+        assert eng.cache_hits > 0, "prefix cache never hit"
+        # the documented steady-state working set, per model fn
+        # (PERF.md §12 mirrors these numbers):
+        #   prefill       1  dense prefill, (Tb=16, greedy) — C
+        #   prefill_chunk 1  one (C_pad=16, P_slice=4) chunk executable
+        #   decode_step   1  the K=4 horizon for draftless steps (greedy
+        #                    slots ride verify dispatches on this traffic,
+        #                    so only the mixed-batch horizon compiles)
+        #   verify_step   1  static [S, K+1] lanes
+        #   sample        2  greedy + nucleus single-logits samplers
+        #   page_copy     1  traced-src/dst COW copy
+        assert warm_variants == {"prefill": 1, "prefill_chunk": 1,
+                                 "decode_step": 1, "verify_step": 1,
+                                 "sample": 2, "page_copy": 1}, warm_variants
+
+    def test_steady_state_recompile_raises(self):
+        """A decode/verify/prefill variant that recompiles under the
+        steady-state budget is a hard failure: an unwarmed chunk shape
+        (longer prompt -> wider page-table slice) must raise."""
+        cfg = llama_config_tiny(vocab=64, hidden=32, layers=2, heads=4,
+                                seq=128)
+        params = _echo_params(cfg, seed=12)
+        eng = self._engine(cfg, params)
+        r = np.random.default_rng(29)
+        eng.submit(r.integers(1, 64, (20,)).astype(np.int32),
+                   max_new_tokens=4)
+        eng.run()                      # warm: T=20 working set only
+        prompt = r.integers(1, 64, (90,)).astype(np.int32)
+        with sanitize(budget=0):
+            with pytest.raises(RecompileBudgetError):
+                # T=90 crosses into an unwarmed (C_pad, P_slice) bucket
+                eng.submit(prompt, max_new_tokens=4)
+                eng.run()
+        # the raising call's outputs were rebound into the engine
+        # (RecompileBudgetError.result → _call_paged): donated page
+        # buffers stay valid, so the engine survives the budget failure
+        # and finishes the interrupted request with exact greedy outputs
+        done = eng.run()
+        (req,) = [q for q in done.values() if len(q.prompt) == 90]
+        assert len(req.generated) == 4
+        ref = np.asarray(llama_generate(eng.params, cfg, prompt[None],
+                                        max_new_tokens=4))[0]
+        np.testing.assert_array_equal(req.output_ids, ref)
+
+    def test_dense_prefill_budget_failure_recovers_exactly(self):
+        """The fused dense prefill samples the first token INSIDE the
+        raising call: recovery must record it (it rides the exception)
+        or the slot would decode from pending=0."""
+        cfg = llama_config_tiny(vocab=64, hidden=32, layers=2, heads=4,
+                                seq=128)
+        params = _echo_params(cfg, seed=13)
+        eng = ServingEngine(params, cfg, num_slots=2, page_size=16,
+                            num_pages=64, prompt_bucket=16,
+                            decode_horizon=4, seed=5)
+        r = np.random.default_rng(31)
+        eng.submit(r.integers(1, 64, (12,)).astype(np.int32),
+                   max_new_tokens=4)
+        eng.run()                              # warm: Tb=16 greedy only
+        prompt = r.integers(1, 64, (40,)).astype(np.int32)   # Tb=48: cold
+        with sanitize(budget=0):
+            with pytest.raises(RecompileBudgetError):
+                rid = eng.submit(prompt, max_new_tokens=4)
+                eng.run()
+        done = eng.run()
+        ref = np.asarray(llama_generate(eng.params, cfg, prompt[None],
+                                        max_new_tokens=4))[0]
+        np.testing.assert_array_equal(done[rid].output_ids, ref)
+        eng.check_invariants()
+
+    def test_sampled_final_chunk_budget_failure_recovers(self):
+        """A sampler compile miss on the final prefill chunk fires AFTER
+        the slot flipped to decoding: recovery must record the sampled
+        token the exception carries so the slot isn't stranded."""
+        cfg = llama_config_tiny(vocab=64, hidden=32, layers=2, heads=4,
+                                seq=128)
+        params = _echo_params(cfg, seed=14)
+        eng = ServingEngine(params, cfg, num_slots=2, page_size=16,
+                            num_pages=64, prompt_bucket=16,
+                            decode_horizon=4, prefill_chunk=16, seed=6)
+        r = np.random.default_rng(37)
+        eng.submit(r.integers(1, 64, (20,)).astype(np.int32),
+                   max_new_tokens=4)
+        eng.run()                              # warm: greedy sampler only
+        with sanitize(budget=0):
+            with pytest.raises(RecompileBudgetError):
+                rid = eng.submit(r.integers(1, 64, (20,)).astype(np.int32),
+                                 max_new_tokens=4, temperature=0.8,
+                                 top_p=0.9)
+                eng.run()
+        done = eng.run()
+        assert len(done[rid].generated) == 4   # incl. the recovered token
+        eng.check_invariants()
+
+    def test_verify_lane_sampler_budget_failure_recovers(self):
+        """A sampler miss on a speculative verify's sampled ride-along
+        lane consumed a PRNG key: recovery must record the token the
+        exception carries (keeping the seeded key stream) and the greedy
+        co-traveller must stay bit-exact."""
+        cfg = llama_config_tiny(vocab=64, hidden=32, layers=2, heads=4,
+                                seq=128)
+        params = _echo_params(cfg, seed=15)
+        # prefix_cache off: a cache hit on the repeat submissions would
+        # route admission through the (colder) suffix-chunk executable
+        # and the raise would fire there instead of at the verify lane
+        eng = ServingEngine(params, cfg, num_slots=3, page_size=16,
+                            num_pages=96, prompt_bucket=16,
+                            decode_horizon=4, speculative=2, seed=8,
+                            prefix_cache=False)
+        r = np.random.default_rng(43)
+        ps = r.integers(1, 64, (12,)).astype(np.int32)   # sampled traffic
+        pg = np.tile(np.array([5, 9, 2, 13], np.int32), 5)   # drafter food
+        # warm WITHOUT ever touching the nucleus `sample` jit: the lone
+        # sampled request decodes via the non-greedy horizon, the lone
+        # greedy one compiles the verify dispatch
+        eng.submit(ps, max_new_tokens=4, temperature=0.8, top_p=0.9)
+        eng.run()
+        eng.submit(pg, max_new_tokens=6)
+        eng.run()
+        assert eng.jit_cache_misses.get("sample") is None
+        # mixed round: the sampled slot rides a verify dispatch -> the
+        # nucleus sampler compiles inside the budget scope and raises
+        with sanitize(budget=0):
+            with pytest.raises(RecompileBudgetError):
+                rs = eng.submit(ps, max_new_tokens=4, temperature=0.8,
+                                top_p=0.9)
+                rg = eng.submit(pg, max_new_tokens=6)
+                eng.run()
+        assert eng.jit_cache_misses.get("sample") == 1
+        done = eng.run()
+        assert len(done[rs].generated) == 4
+        ref = np.asarray(llama_generate(eng.params, cfg, pg[None],
+                                        max_new_tokens=6))[0]
+        np.testing.assert_array_equal(done[rg].output_ids, ref)
+        eng.check_invariants()
