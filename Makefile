@@ -22,6 +22,14 @@ PYTEST_T1 = env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
 	--continue-on-collection-errors -p no:cacheprovider -p no:xdist \
 	-p no:randomly
 
+# `obs-check` is the observability gate (perf/check_obs.py, README
+# §Observability): runs the serving trace with a --json artifact,
+# schema-validates it (engine counters + metrics snapshot + SLO report
+# with quantile fields), then runs the telemetry-overhead gate —
+# telemetry ON must hold >= 0.97x the telemetry-off tokens/s (medians
+# over interleaved rounds; same quiet-machine caveat as the timing
+# gates above).
+#
 # `lint` runs graftlint (paddle_tpu/analysis — the trace-safety static
 # analyzer, README §Static analysis) over the package against the
 # committed baseline of grandfathered findings: non-zero exit on any NEW
@@ -33,7 +41,16 @@ PYTEST_T1 = env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
 GRAFTLINT = $(PY) -m paddle_tpu.analysis paddle_tpu \
 	--baseline graftlint.baseline.json
 
-.PHONY: tier1 tier1-budget check-budget bench lint lint-baseline
+.PHONY: tier1 tier1-budget check-budget bench lint lint-baseline obs-check
+
+OBS_ARTIFACT ?= /tmp/_obs_serving.json
+
+obs-check:
+	set -o pipefail; \
+	env JAX_PLATFORMS=cpu $(PY) bench.py --trace serving \
+		--json $(OBS_ARTIFACT) && \
+	env JAX_PLATFORMS=cpu $(PY) perf/check_obs.py \
+		--artifact $(OBS_ARTIFACT) --trace serving --gate
 
 lint:
 	$(GRAFTLINT)

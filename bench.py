@@ -28,7 +28,11 @@ config.
 Serving traces run standalone via `--trace {serving,shared-prefix,
 spec-decode}`; `--json PATH` dumps the selected trace's metrics dict as a
 BENCH_r0x-style artifact and `--seed` reproduces/varies the generated
-trace (each trace's default seed reproduces the PERF.md numbers).
+trace (each trace's default seed reproduces the PERF.md numbers).  Trace
+engines run with telemetry ON (overhead gated >= 0.97x by `make
+obs-check`, PERF.md §13); artifacts embed the full observability metrics
+snapshot plus an SLO report (TTFT/TPOT/step-latency quantiles, goodput at
+a TTFT deadline) and are schema-validated by perf/check_obs.py.
 """
 from __future__ import annotations
 
@@ -69,6 +73,26 @@ def _sync(x):
     conv-heavy steps); a device->host read is the reliable barrier."""
     import jax
     return float(np.asarray(jax.device_get(x)))
+
+def _ttft_report(ttfts_s, slo_ttft_s):
+    """Shared TTFT readout for EVERY serving trace — delegates to the one
+    percentile implementation (paddle_tpu.observability.slo) instead of the
+    two hand-rolled np.percentile blocks the traces used to carry:
+    p50/p95/p99 plus goodput at the trace's TTFT deadline (requests whose
+    first token arrived in time; the share of throughput an SLO would
+    actually credit)."""
+    from paddle_tpu.observability import slo_report
+    rep = slo_report([{"ttft_s": float(t), "tokens": 0, "timed_out": False}
+                      for t in ttfts_s], ttft_deadline_s=slo_ttft_s)
+    return {
+        "ttft_p50_ms": rep["ttft"]["p50_ms"],
+        "ttft_p95_ms": rep["ttft"]["p95_ms"],
+        "ttft_p99_ms": rep["ttft"]["p99_ms"],
+        "slo_ttft_ms": rep["ttft_deadline_ms"],
+        "goodput_on_time_requests": rep["on_time_requests"],
+        "goodput_fraction": rep["goodput_fraction"],
+    }
+
 
 def _chip_peak_flops(device):
     kind = device.device_kind.lower()
@@ -492,8 +516,10 @@ def bench_serving(seed=0):
     from paddle_tpu.models.llama import (LlamaConfig, build_functional_llama,
                                          llama_generate_fused)
     from paddle_tpu.inference.paged import ServingEngine
+    from paddle_tpu.observability import Telemetry
 
     on_tpu = any(d.platform == "tpu" for d in jax.devices())
+    slo_ttft = 0.25 if on_tpu else 2.0   # TTFT deadline for goodput readout
     if on_tpu:
         # GQA serving config of the 271M family (4 kv heads — the realistic
         # serving shape, and the ragged kernel's native GQA grid)
@@ -534,7 +560,8 @@ def bench_serving(seed=0):
     eng = ServingEngine(params, cfg, num_slots=slots, page_size=page_size,
                         num_pages=(slots + 2) * worst,
                         max_pages_per_seq=worst, dtype=dtype,
-                        decode_horizon=horizon, prompt_bucket=t_bucket)
+                        decode_horizon=horizon, prompt_bucket=t_bucket,
+                        telemetry=Telemetry())
 
     def drive(base_tok):
         """Submit request i once `arrivals[i]` generated tokens have passed
@@ -561,12 +588,16 @@ def bench_serving(seed=0):
         eng.submit(rng.integers(0, cfg.vocab_size, (Tb,)).astype(np.int32),
                    max_new_tokens=horizon + 1)
     eng.run()
+    # scope the SLO report to the timed window (the warm pass above served
+    # its own requests; their latencies are compile time, not the trace's)
+    eng.telemetry.reset_window()
     t0 = time.perf_counter()
     drive(base_tok=eng.tokens_generated)
     _sync(eng._pages_k[0, 0, 0, 0, 0])
     dt_engine = time.perf_counter() - t0
-    lat = [r.finish_time - r.submit_time
-           for r in list(eng._finished.values())[-n_req:]]
+    measured = list(eng._finished.values())[-n_req:]
+    lat = [r.finish_time - r.submit_time for r in measured]
+    ttfts = [r.ttft for r in measured]
     useful = sum(max_news)
     serving_tps = useful / dt_engine
 
@@ -603,10 +634,16 @@ def bench_serving(seed=0):
         "useful_tokens": int(useful),
         "mean_request_latency_s": round(float(np.mean(lat)), 3),
         "static_mean_completion_s": round(float(np.mean(base_done)), 3),
+        **_ttft_report(ttfts, slo_ttft),
         "decode_horizon": horizon,
         "page_size": page_size,
         "num_slots": slots,
         "engine_stats": eng.stats(),
+        # full telemetry snapshot + SLO report over the timed window
+        # (TTFT/TPOT/step-latency quantiles, goodput at the deadline)
+        "metrics": eng.telemetry.snapshot(eng.stats()),
+        "slo_report": eng.telemetry.slo_report(slo_ttft,
+                                               window_s=dt_engine),
     }
 
 
@@ -629,8 +666,10 @@ def bench_serving_shared_prefix(seed=7):
     import jax.numpy as jnp
     from paddle_tpu.models.llama import LlamaConfig, build_functional_llama
     from paddle_tpu.inference.paged import ServingEngine
+    from paddle_tpu.observability import Telemetry
 
     on_tpu = any(d.platform == "tpu" for d in jax.devices())
+    slo_ttft = 0.2 if on_tpu else 1.0    # TTFT deadline for goodput readout
     if on_tpu:
         cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
                           intermediate_size=2816, num_hidden_layers=16,
@@ -675,7 +714,8 @@ def bench_serving_shared_prefix(seed=7):
                             max_pages_per_seq=worst, dtype=dtype,
                             decode_horizon=horizon, prompt_bucket=t_bucket,
                             prefix_cache=prefix_cache,
-                            prefill_chunk=prefill_chunk)
+                            prefill_chunk=prefill_chunk,
+                            telemetry=Telemetry())
 
         def once():
             convs = [list(system) for _ in range(n_users)]
@@ -703,14 +743,15 @@ def bench_serving_shared_prefix(seed=7):
         base = (eng.cache_hit_tokens, eng.prefill_tokens, eng.cow_copies,
                 eng.cache_evictions)
         base_misses = dict(eng.jit_cache_misses)
+        # scope the SLO report to the timed pass (pass 1 absorbed compiles)
+        eng.telemetry.reset_window()
         t0 = time.perf_counter()
         outputs, ttfts, useful = once()
         dt = time.perf_counter() - t0
         _sync(eng._pages_k[0, 0, 0, 0, 0])
         stats = {
             "tokens_per_sec": round(useful / dt, 1),
-            "ttft_p50_ms": round(float(np.percentile(ttfts, 50)) * 1e3, 1),
-            "ttft_p95_ms": round(float(np.percentile(ttfts, 95)) * 1e3, 1),
+            **_ttft_report(ttfts, slo_ttft),
             "prefill_tokens_executed": int(eng.prefill_tokens - base[1]),
             "cache_hit_tokens": int(eng.cache_hit_tokens - base[0]),
             "cow_copies": int(eng.cow_copies - base[2]),
@@ -725,6 +766,9 @@ def bench_serving_shared_prefix(seed=7):
                 k: int(v - base_misses.get(k, 0))
                 for k, v in eng.jit_cache_misses.items()
             },
+            # full telemetry snapshot + SLO report over the timed pass
+            "metrics": eng.telemetry.snapshot(eng.stats()),
+            "slo_report": eng.telemetry.slo_report(slo_ttft, window_s=dt),
         }
         return outputs, stats
 
@@ -771,8 +815,10 @@ def bench_serving_spec_decode(seed=0):
     import jax.numpy as jnp
     from paddle_tpu.models.llama import LlamaConfig, build_functional_llama
     from paddle_tpu.inference.paged import ServingEngine
+    from paddle_tpu.observability import Telemetry
 
     on_tpu = any(d.platform == "tpu" for d in jax.devices())
+    slo_ttft = 0.25 if on_tpu else 2.0   # TTFT deadline for goodput readout
     if on_tpu:
         cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
                           intermediate_size=2816, num_hidden_layers=16,
@@ -817,12 +863,14 @@ def bench_serving_spec_decode(seed=0):
                             num_pages=(n_req + slots + 2) * worst,
                             max_pages_per_seq=worst, dtype=dtype,
                             decode_horizon=horizon, prompt_bucket=t_bucket,
-                            speculative=spec)
+                            speculative=spec, telemetry=Telemetry())
         # warm every executable (prefill buckets + horizon + verify)
         for w in warm:
             eng.submit(w, max_new_tokens=horizon + spec_k + 2)
         eng.run()
         base_stats = eng.stats()
+        # scope the SLO report to the timed window below
+        eng.telemetry.reset_window()
         t0 = time.perf_counter()
         rids = [eng.submit(p, max_new_tokens=max_new) for p in prompts]
         done = eng.run()
@@ -837,8 +885,7 @@ def bench_serving_spec_decode(seed=0):
             "draft_tokens_accepted"]
         return outs, {
             "tokens_per_sec": round(n_req * max_new / dt, 1),
-            "ttft_p50_ms": round(float(np.percentile(ttfts, 50)) * 1e3, 1),
-            "ttft_p95_ms": round(float(np.percentile(ttfts, 95)) * 1e3, 1),
+            **_ttft_report(ttfts, slo_ttft),
             "draft_tokens_proposed": int(prop),
             "draft_tokens_accepted": int(acc),
             "accept_rate": round(acc / prop, 4) if prop else None,
@@ -847,6 +894,9 @@ def bench_serving_spec_decode(seed=0):
             "decode_steps": stats["decode_steps"]
             - base_stats["decode_steps"],
             "engine_stats": stats,
+            # full telemetry snapshot + SLO report over the timed window
+            "metrics": eng.telemetry.snapshot(stats),
+            "slo_report": eng.telemetry.slo_report(slo_ttft, window_s=dt),
         }
 
     out_off, s_off = run_trace(None)

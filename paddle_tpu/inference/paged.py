@@ -65,6 +65,17 @@ evict cache -> preempt):
 Greedy outputs are bit-exact with the prefix cache on vs off (including
 across preemption + re-prefill) — `tests/test_prefix_cache.py` asserts
 token-for-token equality on every parity scenario.
+
+Observability: `ServingEngine(..., telemetry=True)` threads a
+`paddle_tpu.observability.Telemetry` through the step loop — request-
+lifecycle traces (Chrome/Perfetto-exportable), latency histograms
+(TTFT/TPOT/queue/per-phase host timing), and a crash flight recorder that
+auto-dumps on stalls, recompile-budget failures, preemption storms, and
+injected faults.  Telemetry off (default) is a no-op fast path: one flag
+check per hook site, zero per-token work, outputs bit-identical either
+way.  All timestamps are host clock reads at EXISTING sync boundaries —
+telemetry adds no device round-trips (graftlint SYNC001 stays clean) and
+no jitted code (sanitize(0) variant counts unchanged).
 """
 from __future__ import annotations
 
@@ -79,7 +90,8 @@ import numpy as np
 
 from ..analysis.sanitize import (RecompileBudgetError, instrument,
                                  jit_cache_size)
-from ..resilience.faults import fault_point
+from ..observability.telemetry import Telemetry
+from ..resilience.faults import InjectedFault, fault_point
 
 __all__ = ["PagePool", "PrefixCache", "Request", "ServingEngine",
            "serve_requests", "PoolCapacityError", "AdmissionRejected",
@@ -458,10 +470,16 @@ class Request:
     temperature: float = 0.0
     top_p: float = 1.0
     eos_token_id: int | None = None
-    deadline: float | None = None      # absolute perf_counter() cutoff
+    deadline: float | None = None      # absolute engine-clock cutoff
+                                       #   (time.perf_counter unless a
+                                       #   telemetry clock is injected)
     # filled by the engine
     generated: list = field(default_factory=list)
     submit_time: float = 0.0
+    admit_time: float = 0.0            # FIRST admission into a slot (0.0
+                                       #   until admitted; preserved across
+                                       #   preemption re-admissions so
+                                       #   queue_time keeps its meaning)
     first_token_time: float = 0.0      # TTFT = first_token_time - submit_time
     finish_time: float = 0.0
     timed_out: bool = False            # retired overdue (possibly partial)
@@ -479,6 +497,41 @@ class Request:
         step accepted (0.0 when nothing was ever proposed)."""
         return self.draft_accepted / self.draft_proposed \
             if self.draft_proposed else 0.0
+
+    @property
+    def retire_time(self) -> float:
+        """When the request left the engine (finish, deadline, or queued
+        timeout) — an alias of finish_time that can't drift from it."""
+        return self.finish_time
+
+    @property
+    def queue_time(self) -> float:
+        """Seconds waiting for FIRST admission (0.0 until admitted).
+        first_token_time alone never distinguished this wait from prefill:
+        ttft == queue_time + prefill_time."""
+        return self.admit_time - self.submit_time if self.admit_time else 0.0
+
+    @property
+    def ttft(self) -> float:
+        """Time to first token, seconds (0.0 until the first token)."""
+        return self.first_token_time - self.submit_time \
+            if self.first_token_time else 0.0
+
+    @property
+    def prefill_time(self) -> float:
+        """First-admission prefill latency: ttft minus the queue wait."""
+        if not (self.first_token_time and self.admit_time):
+            return 0.0
+        return self.first_token_time - self.admit_time
+
+    @property
+    def tpot(self) -> float:
+        """Mean seconds per output token AFTER the first (time-per-output-
+        token; 0.0 until retired with >= 2 generated tokens)."""
+        n = len(self.generated) - 1
+        if n <= 0 or not self.first_token_time or not self.finish_time:
+            return 0.0
+        return (self.finish_time - self.first_token_time) / n
 
     @property
     def output_ids(self) -> np.ndarray:
@@ -531,7 +584,10 @@ class ServingEngine:
     all K+1 positions, and the engine accepts the longest draft prefix
     whose argmax matches, emitting up to K+1 tokens per forward pass.
     All three knobs preserve greedy outputs bit-exactly vs the plain
-    engine."""
+    engine.  `telemetry=True` (or a configured
+    `observability.Telemetry`) records request-lifecycle traces, latency
+    histograms, and the crash flight recorder — also without touching
+    outputs."""
 
     def __init__(self, params, config, num_slots: int = 4,
                  page_size: int = 16, num_pages: int | None = None,
@@ -540,7 +596,8 @@ class ServingEngine:
                  prompt_bucket: int = 32, decode_horizon: int = 8,
                  seed: int = 0, max_queue: int | None = None,
                  prefix_cache: bool = True, prefill_chunk: int | None = None,
-                 speculative: int | None = None, spec_max_ngram: int = 3):
+                 speculative: int | None = None, spec_max_ngram: int = 3,
+                 telemetry: "Telemetry | bool | None" = None):
         import jax
         import jax.numpy as jnp
         from ..models.llama import (build_llama_paged_decode,
@@ -570,6 +627,17 @@ class ServingEngine:
         # verified K+1 positions at a time (greedy slots only; 0/None off)
         self.speculative = 0 if not speculative else int(speculative)
         self.spec_max_ngram = max(1, int(spec_max_ngram))
+        # telemetry=True -> default Telemetry(); None/False -> OFF, and off
+        # is a no-op fast path: every hook site below is one `is not None`
+        # flag check, zero per-token Python work (observability/telemetry.py)
+        self.telemetry: Telemetry | None = \
+            Telemetry() if telemetry is True else (telemetry or None)
+        # ONE clock domain: request timestamps (submit/admit/first-token/
+        # retire/deadlines) share the telemetry clock when one is attached,
+        # so an injected fake clock drives EVERY timestamp deterministically
+        # (default Telemetry clock is time.perf_counter — no behavior change)
+        self._clock = self.telemetry.clock if self.telemetry is not None \
+            else time.perf_counter
 
         init_pages, prefill, prefill_chunk_fn, decode_step, verify_step = \
             build_llama_paged_decode(
@@ -728,18 +796,22 @@ class ServingEngine:
                 f"num_pages")
         if self.max_queue is not None and len(self._queue) >= self.max_queue:
             self.rejections += 1
+            if self.telemetry is not None:
+                self.telemetry.rejected(len(self._queue), self.max_queue)
             raise AdmissionRejected(
                 f"admission queue full ({len(self._queue)}/{self.max_queue} "
                 f"waiting, {self.num_active} active) — backpressure, retry "
                 f"later")
         rid = self._next_rid
         self._next_rid += 1
-        now = time.perf_counter()
+        now = self._clock()
         req = Request(rid=rid, prompt=prompt, max_new_tokens=int(max_new_tokens),
                       temperature=float(temperature), top_p=float(top_p),
                       eos_token_id=eos_token_id, submit_time=now,
                       deadline=None if timeout is None else now + float(timeout))
         self._queue.append(req)
+        if self.telemetry is not None:
+            self.telemetry.submitted(req, queue_depth=len(self._queue))
         return rid
 
     # -- internals ---------------------------------------------------------
@@ -766,6 +838,11 @@ class ServingEngine:
         except RecompileBudgetError as e:
             if e.result is not None:
                 self._pages_k, self._pages_v = e.result[-2], e.result[-1]
+            if self.telemetry is not None:
+                # the postmortem the recompile sanitizer never had: the
+                # last N engine events leading up to the budget failure
+                self.telemetry.fault_dump("recompile_budget",
+                                          error=str(e)[:200])
             raise
         return out
 
@@ -795,6 +872,11 @@ class ServingEngine:
             return 0
         freed = self.cache.evict(n)
         self.cache_evictions += freed
+        if self.telemetry is not None:
+            # recorded even at freed == 0: walking this rung is what the
+            # flight-recorder ladder drills assert (admit -> evict ->
+            # preempt), whether or not the cache had anything to give back
+            self.telemetry.evicted(requested=n, freed=freed)
         return freed
 
     def _register_slot(self, s: int, with_partial: bool):
@@ -825,8 +907,10 @@ class ServingEngine:
         # (refcount 1, cache-held) until LRU eviction needs them back
         self._register_slot(s, with_partial=True)
         slot = self._release_slot(s)
-        slot.req.finish_time = time.perf_counter()
+        slot.req.finish_time = self._clock()
         self._finished[slot.req.rid] = slot.req
+        if self.telemetry is not None:
+            self.telemetry.retired(slot.req)
 
     def _preempt(self, s: int):
         """Victim preemption: park the slot's written KV in the prefix
@@ -839,6 +923,10 @@ class ServingEngine:
         slot = self._release_slot(s)
         slot.req.preemptions += 1
         self.preemptions += 1
+        if self.telemetry is not None:
+            # storm detection lives in the telemetry (N preemptions within
+            # a step window auto-dumps the flight recorder once per storm)
+            self.telemetry.preempted(slot.req, step=self._step_seq)
         self._queue.appendleft(slot.req)
 
     def _pick_victim(self) -> int:
@@ -851,7 +939,7 @@ class ServingEngine:
     def _retire_overdue(self):
         """Deadline enforcement: retire overdue requests wherever they live
         (running slot or admission queue), marking them timed_out."""
-        now = time.perf_counter()
+        now = self._clock()
         for s, slot in enumerate(self._slots):
             if slot is not None and slot.req.deadline is not None \
                     and now > slot.req.deadline:
@@ -867,6 +955,8 @@ class ServingEngine:
                     req.finish_time = now
                     self.timeouts += 1
                     self._finished[req.rid] = req
+                    if self.telemetry is not None:
+                        self.telemetry.retired(req)
                 else:
                     keep.append(req)
             self._queue = keep
@@ -882,7 +972,11 @@ class ServingEngine:
         if slot.draft is not None:
             slot.draft.append(tok)
         if req.first_token_time == 0.0:
-            req.first_token_time = time.perf_counter()
+            req.first_token_time = self._clock()
+            if self.telemetry is not None:
+                # once per request, inside the first-token branch — the
+                # per-token fast path stays telemetry-free
+                self.telemetry.first_token(req)
         self.tokens_generated += 1
         done = (req.eos_token_id is not None and tok == req.eos_token_id) \
             or len(req.generated) >= req.max_new_tokens
@@ -911,6 +1005,8 @@ class ServingEngine:
             slot.pages[idx] = dst
         self._page_tables[s, idx] = dst
         self.cow_copies += 1
+        if self.telemetry is not None:
+            self.telemetry.cow_copy(slot.req.rid, src=int(src), dst=int(dst))
 
     def _admit(self):                                 # graftlint: hot
         jnp = self._jnp
@@ -949,9 +1045,14 @@ class ServingEngine:
                 return                 # wait for retirements to free pages
             try:
                 own = self.pool.alloc(need)
-            except BaseException:
+            except BaseException as exc:
                 if pin:                # injected pagepool.alloc fault —
                     self.pool.free(pin)  # roll back so no reference leaks
+                if self.telemetry is not None \
+                        and isinstance(exc, InjectedFault):
+                    self.telemetry.fault_dump("injected_fault",
+                                              point="pagepool.alloc",
+                                              error=str(exc)[:200])
                 raise
             self._queue.popleft()
             s = free_slots[0]
@@ -989,6 +1090,18 @@ class ServingEngine:
                 self.cache_hit_tokens += matched
                 req.cached_prefix_tokens += matched
             self.prefill_tokens += T - matched
+            # admission timestamp at the host boundary we already stand on
+            # (no device sync): first admission only, so queue_time keeps
+            # meaning "wait for a slot" across preemption re-admissions
+            admit_now = self._clock()
+            first_admit = req.admit_time == 0.0
+            if first_admit:
+                req.admit_time = admit_now
+            tel = self.telemetry
+            if tel is not None:
+                tel.admitted(req, slot=s, t=admit_now, resuming=resuming,
+                             first=first_admit, cached_tokens=matched,
+                             prefill_tokens=T - matched)
             chunked = self.prefill_chunk is not None \
                 and (T - matched) > self.prefill_chunk
             if matched == 0 and not chunked:
@@ -1014,6 +1127,9 @@ class ServingEngine:
                         else (lambda *a: fn(*a, greedy=False)),
                         donate_argnums=(4, 5))
                     self._prefill_jit[(Tb, greedy)] = pf
+                if tel is not None:
+                    t_pf0 = tel.clock()
+                    ann = tel.bridge_begin("prefill_dense")
                 try:
                     tok, self._pages_k, self._pages_v = self._call_paged(
                         pf,
@@ -1034,6 +1150,15 @@ class ServingEngine:
                     self._finish_admission(s, e.result[0], ctx, pages,
                                            resuming)
                     raise
+                finally:
+                    if tel is not None:
+                        tel.bridge_end(ann)
+                if tel is not None:
+                    # dispatch span recorded BEFORE the bookkeeping below
+                    # samples the first token, so the request record keeps
+                    # ladder order: admitted -> prefill_dense -> first_token
+                    tel.prefill_dispatch(req.rid, pos=0, tokens=T,
+                                         t0=t_pf0, kind="prefill_dense")
                 self._finish_admission(s, tok, ctx, pages, resuming)
             else:
                 # suffix / chunked prefill: only the un-cached tokens run,
@@ -1088,12 +1213,22 @@ class ServingEngine:
         Pb = min(self.max_pages_per_seq, math.ceil(ctx_pages / 4) * 4)
         ids = np.zeros((1, Cb), np.int32)
         ids[0, :c] = slot.ctx[pos:pos + c]
-        logits, self._pages_k, self._pages_v = self._call_paged(
-            self._chunk_jit,
-            self.params, jnp.asarray(ids), jnp.asarray(pos, jnp.int32),
-            jnp.asarray(c, jnp.int32),
-            jnp.asarray(self._page_tables[s, :Pb]),
-            self._pages_k, self._pages_v)
+        tel = self.telemetry
+        if tel is not None:
+            t_ck0 = tel.clock()
+            ann = tel.bridge_begin("prefill_chunk")
+        try:
+            logits, self._pages_k, self._pages_v = self._call_paged(
+                self._chunk_jit,
+                self.params, jnp.asarray(ids), jnp.asarray(pos, jnp.int32),
+                jnp.asarray(c, jnp.int32),
+                jnp.asarray(self._page_tables[s, :Pb]),
+                self._pages_k, self._pages_v)
+        finally:
+            if tel is not None:
+                tel.bridge_end(ann)
+        if tel is not None:
+            tel.prefill_dispatch(req.rid, pos=pos, tokens=c, t0=t_ck0)
         slot.chunk_step = self._step_seq
         pos += c
         slot.prefill_pos = pos
@@ -1234,16 +1369,32 @@ class ServingEngine:
             if d:
                 toks[s, 1:1 + len(d)] = d
             n_q[s] = 1 + len(d)
-        logits0, gtoks, self._pages_k, self._pages_v = self._call_paged(
-            self._verify_jit,
-            self.params, jnp.asarray(toks), jnp.asarray(self._lengths),
-            jnp.asarray(self._page_tables), self._pages_k, self._pages_v,
-            jnp.asarray(n_q))
+        tel = self.telemetry
+        if tel is not None:
+            t_v0 = tel.clock()
+            ann = tel.bridge_begin("verify_dispatch")
+        try:
+            logits0, gtoks, self._pages_k, self._pages_v = self._call_paged(
+                self._verify_jit,
+                self.params, jnp.asarray(toks), jnp.asarray(self._lengths),
+                jnp.asarray(self._page_tables), self._pages_k,
+                self._pages_v, jnp.asarray(n_q))
+        finally:
+            if tel is not None:
+                tel.bridge_end(ann)
+        t_v1 = tel.clock() if tel is not None else 0.0
         # the ONE per-verify-dispatch sync: every slot's K+1 argmaxes land
         # in one transfer (acceptance is host logic by design)
         gtoks = np.asarray(gtoks)  # graftlint: disable=SYNC001
         self.steps_run += 1
         self.verify_steps += 1
+        if tel is not None:
+            t_v2 = tel.clock()
+            tel.phase("verify_dispatch", t_v0, t_v1, slots=len(run))
+            tel.phase("verify_sync", t_v1, t_v2)
+            for s in run:
+                tel.request_event(self._slots[s].req.rid, "verify_dispatch",
+                                  drafted=len(drafts.get(s, ())))
         for s in run:
             slot = self._slots[s]
             req = slot.req
@@ -1301,6 +1452,8 @@ class ServingEngine:
                 self.draft_tokens_accepted += used
                 req.draft_proposed += nd
                 req.draft_accepted += used
+        if tel is not None:
+            tel.phase("verify_record", t_v2, tel.clock())
 
     def _horizon_exec(self, K: int, greedy: bool):
         fn = self._horizon_jit.get((K, greedy))
@@ -1329,13 +1482,37 @@ class ServingEngine:
         the engine walks the degradation ladder: evict unreferenced cached
         pages, then preempt a victim (pages parked in the cache, request
         requeued for re-prefill); under a fully injected pool-pressure
-        window it parks and reports no progress."""
+        window it parks and reports no progress.
+
+        With telemetry on, the step's host wall time lands in the
+        ``engine.step_host_s`` histogram, a per-step summary lands in the
+        flight recorder, and an active injected pool-pressure window
+        auto-dumps the recorder (postmortem for fault drills)."""
+        tel = self.telemetry
+        if tel is None:
+            return self._step_impl()
+        t0 = tel.clock()
+        pre_tok = self.tokens_generated
+        progressed = self._step_impl()
+        tel.step_done(self, t0, progressed,
+                      self.tokens_generated - pre_tok)
+        return progressed
+
+    def _step_impl(self) -> bool:                     # graftlint: hot
         jnp = self._jnp
+        tel = self.telemetry
+        t_s0 = tel.clock() if tel is not None else 0.0
         self._step_seq += 1
         self._pressure = fault_point("serve.pool_pressure",
                                      step=self.steps_run) is not None
         self._retire_overdue()
         self._admit()
+        if tel is not None:
+            # host scheduling phase: deadline sweep + admissions (incl.
+            # any dense admission prefills, which also get their own
+            # prefill_dense spans) — the host-side cost the host-loop
+            # overlap refactor (ROADMAP item 5) needs on the record
+            tel.phase("sched", t_s0, tel.clock())
         # chunked prefill: each mid-prefill slot advances ONE chunk per
         # step, interleaved with the decode horizon below — a long prompt
         # never head-of-line blocks the running decodes or short arrivals.
@@ -1406,13 +1583,23 @@ class ServingEngine:
             if slot.req.eos_token_id is not None:
                 eos_ids[s] = slot.req.eos_token_id
         greedy = all(self._temps[s] <= 0.0 for s in run)
-        out, new_lengths, self._pages_k, self._pages_v = self._call_paged(
-            self._horizon_exec(K, greedy),
-            self.params, jnp.asarray(toks), jnp.asarray(self._lengths),
-            jnp.asarray(self._page_tables), self._pages_k, self._pages_v,
-            jnp.asarray(active), self._split_key(),
-            jnp.asarray(self._temps), jnp.asarray(self._top_ps),
-            jnp.asarray(remaining), jnp.asarray(eos_ids))
+        if tel is not None:
+            t_d0 = tel.clock()
+            ann = tel.bridge_begin("decode_dispatch")
+        try:
+            out, new_lengths, self._pages_k, self._pages_v = \
+                self._call_paged(
+                    self._horizon_exec(K, greedy),
+                    self.params, jnp.asarray(toks),
+                    jnp.asarray(self._lengths),
+                    jnp.asarray(self._page_tables), self._pages_k,
+                    self._pages_v, jnp.asarray(active), self._split_key(),
+                    jnp.asarray(self._temps), jnp.asarray(self._top_ps),
+                    jnp.asarray(remaining), jnp.asarray(eos_ids))
+        finally:
+            if tel is not None:
+                tel.bridge_end(ann)
+        t_d1 = tel.clock() if tel is not None else 0.0
         # the TWO per-horizon syncs: K tokens/slot + lengths in one batch
         # each — the whole point of the K-step horizon (PERF.md §8)
         out = np.asarray(out)  # graftlint: disable=SYNC001
@@ -1420,10 +1607,21 @@ class ServingEngine:
         # through the horizon unchanged, so the wholesale copy is safe
         self._lengths = np.asarray(new_lengths).astype(np.int32).copy()  # graftlint: disable=SYNC001
         self.steps_run += 1
+        if tel is not None:
+            # per-phase host timing at the EXISTING sync boundaries only
+            # (the justified SYNC001 fetches above) — no telemetry sync
+            t_d2 = tel.clock()
+            tel.phase("decode_dispatch", t_d0, t_d1, slots=len(run), k=K)
+            tel.phase("decode_sync", t_d1, t_d2)
+            for s in run:
+                tel.request_event(self._slots[s].req.rid, "decode_dispatch",
+                                  k=K)
         for s in run:
             for tok in out[s]:
                 if self._record_token(s, tok):
                     break
+        if tel is not None:
+            tel.phase("decode_record", t_d2, tel.clock())
         return True
 
     def run(self, max_steps: int | None = None,
@@ -1442,6 +1640,14 @@ class ServingEngine:
             progressed = self.step()
             stalled = 0 if progressed else stalled + 1
             if stalled >= max_stall_steps:
+                if self.telemetry is not None:
+                    # the flight recorder's reason for existing: dump the
+                    # recent-event window BEFORE the engine dies
+                    self.telemetry.fault_dump(
+                        "engine_stalled", stalled_steps=stalled,
+                        active=self.num_active, queued=len(self._queue),
+                        free_pages=self.pool.num_free,
+                        num_pages=self.pool.num_pages)
                 raise EngineStalledError(
                     f"no engine progress for {stalled} consecutive steps "
                     f"({self.num_active} active, {len(self._queue)} queued, "
@@ -1482,6 +1688,14 @@ class ServingEngine:
             # flat; bench --json artifacts embed them via engine_stats
             "jit_cache_misses": dict(self.jit_cache_misses),
         }
+
+    def stats_snapshot(self):
+        """Immutable flattened :class:`EngineStats` snapshot of `stats()`
+        (nested dicts dotted).  Two snapshots diff exactly:
+        ``later.delta(earlier)`` is the per-window activity — the
+        registry-backed replacement for hand-diffing the stats() dict."""
+        from ..observability.metrics import EngineStats
+        return EngineStats.capture(self.stats(), clock=self._clock)
 
     def release_cache(self) -> int:
         """Drop every evictable cached page back to the free list (tests,

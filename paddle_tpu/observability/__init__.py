@@ -1,0 +1,28 @@
+"""Serving observability: metrics registry, request-lifecycle tracing,
+crash flight recorder, SLO reporting (README §Observability).
+
+Three pieces, one clock:
+
+  * :mod:`.metrics` — counters / gauges / log-bucketed histograms with
+    p50/p95/p99 readout, a named registry with snapshot semantics, and
+    :class:`EngineStats` (flattened ``ServingEngine.stats()`` snapshots
+    with exact per-window ``delta()``).
+  * :mod:`.tracing` — per-request ordered lifecycle event records +
+    engine phase spans, exportable as Chrome-trace/Perfetto JSON and
+    bridgeable into jax device traces via ``paddle_tpu.profiler``.
+  * :mod:`.flight` — a bounded ring of recent engine events that dumps
+    automatically on stalls, recompile-budget failures, preemption
+    storms, and injected faults.
+
+:class:`.telemetry.Telemetry` bundles all three for the serving engine:
+``ServingEngine(..., telemetry=True)``.  Telemetry off (the default) is a
+no-op fast path — one flag check per hook site, zero per-token work."""
+from .flight import FlightRecorder
+from .metrics import Counter, EngineStats, Gauge, Histogram, MetricsRegistry
+from .slo import latency_percentiles, slo_report
+from .telemetry import Telemetry
+from .tracing import RequestTrace, Tracer
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "EngineStats",
+           "Tracer", "RequestTrace", "FlightRecorder", "Telemetry",
+           "latency_percentiles", "slo_report"]

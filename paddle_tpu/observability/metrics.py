@@ -1,0 +1,283 @@
+"""Metrics registry: counters, gauges, log-bucketed histograms.
+
+The serving engine's observability used to be a flat dict of ad-hoc int
+attributes (``ServingEngine.stats()``) plus hand-rolled ``np.percentile``
+blocks scattered through bench.py.  This module is the one shared
+implementation behind all of it:
+
+  * :class:`Counter` / :class:`Gauge` — monotonic count / last-value.
+  * :class:`Histogram` — log-bucketed latency histogram with
+    p50/p95/p99 quantile readout.  Buckets grow geometrically
+    (``growth`` per bucket, default 1.1 → ≤ ~5% relative bucket error,
+    tightened further by linear interpolation inside the bucket and exact
+    min/max clamping), stored sparsely, so observe() is one dict bump —
+    cheap enough for per-request serving paths, never per-token.
+  * :class:`MetricsRegistry` — named metric directory with
+    ``snapshot()``/``delta`` semantics and an injectable ``clock`` so
+    tests are deterministic.
+  * :class:`EngineStats` — an immutable, flattened snapshot of
+    ``ServingEngine.stats()``; ``delta(earlier)`` yields exactly the
+    per-window activity (the counters are monotonic, so a delta is always
+    non-negative — tests/test_observability.py pins both properties).
+"""
+from __future__ import annotations
+
+import math
+import time
+from collections.abc import Mapping
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "EngineStats"]
+
+
+class Counter:
+    """Monotonically increasing counter (dashboards diff it; a decrement is
+    a bug and raises)."""
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0
+
+    def inc(self, n: int = 1):
+        if n < 0:
+            raise ValueError(f"Counter {self.name!r} cannot decrease (n={n})")
+        self.value += n
+
+    def to_value(self):
+        return self.value
+
+
+class Gauge:
+    """Last-written value (queue depth, free pages, acceptance rate...)."""
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0.0
+
+    def set(self, v):
+        self.value = float(v)
+
+    def to_value(self):
+        return self.value
+
+
+class Histogram:
+    """Log-bucketed histogram with quantile readout.
+
+    Bucket 0 holds values ``<= lo``; bucket k (k >= 1) holds
+    ``(lo * growth**(k-1), lo * growth**k]``.  Quantiles interpolate
+    linearly inside the winning bucket and clamp to the exact observed
+    [min, max], so small-sample readouts stay sane (a 1-sample histogram
+    reports that sample for every quantile)."""
+
+    __slots__ = ("name", "unit", "lo", "growth", "_log_g", "count", "total",
+                 "min", "max", "_buckets")
+
+    def __init__(self, name: str, unit: str = "s", lo: float = 1e-6,
+                 growth: float = 1.1):
+        if lo <= 0 or growth <= 1.0:
+            raise ValueError("lo must be > 0 and growth > 1.0")
+        self.name = name
+        self.unit = unit
+        self.lo = float(lo)
+        self.growth = float(growth)
+        self._log_g = math.log(self.growth)
+        self.count = 0
+        self.total = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+        self._buckets: dict[int, int] = {}
+
+    def reset(self):
+        """Drop every observation (a measurement-window boundary — e.g.
+        `Telemetry.reset_window()` between a bench's warm pass and its
+        timed pass, so quantiles describe the window, not the compiles)."""
+        self.count = 0
+        self.total = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+        self._buckets.clear()
+
+    def observe(self, v):
+        v = float(v)
+        self.count += 1
+        self.total += v
+        if v < self.min:
+            self.min = v
+        if v > self.max:
+            self.max = v
+        if v <= self.lo:
+            idx = 0
+        else:
+            idx = max(1, math.ceil(math.log(v / self.lo) / self._log_g))
+        self._buckets[idx] = self._buckets.get(idx, 0) + 1
+
+    def _bounds(self, idx: int) -> tuple[float, float]:
+        if idx == 0:
+            return 0.0, self.lo
+        return self.lo * self.growth ** (idx - 1), self.lo * self.growth ** idx
+
+    def quantile(self, q: float) -> float:
+        """Value at quantile q in [0, 1] (0 when empty)."""
+        if self.count == 0:
+            return 0.0
+        if q <= 0.0:
+            return self.min
+        target = min(self.count, q * self.count)
+        cum = 0
+        for idx in sorted(self._buckets):
+            n = self._buckets[idx]
+            if cum + n >= target:
+                b_lo, b_hi = self._bounds(idx)
+                frac = (target - cum) / n
+                val = b_lo + frac * (b_hi - b_lo)
+                return min(max(val, self.min), self.max)
+            cum += n
+        return self.max
+
+    def percentiles(self, ps=(50, 95, 99)) -> dict:
+        return {p: self.quantile(p / 100.0) for p in ps}
+
+    def fraction_below(self, x) -> float:
+        """Fraction of observations <= x (bucket-interpolated) — the
+        goodput readout for 'how many requests met the deadline'."""
+        if self.count == 0:
+            return 0.0
+        x = float(x)
+        if x >= self.max:
+            return 1.0
+        if x < self.min:
+            return 0.0
+        cum = 0
+        for idx in sorted(self._buckets):
+            b_lo, b_hi = self._bounds(idx)
+            n = self._buckets[idx]
+            if x >= b_hi:
+                cum += n
+                continue
+            if x > b_lo:
+                cum += n * (x - b_lo) / (b_hi - b_lo)
+            break
+        return min(1.0, cum / self.count)
+
+    @property
+    def mean(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
+    def to_value(self) -> dict:
+        p = self.percentiles()
+        return {
+            "count": self.count,
+            "sum": round(self.total, 9),
+            "mean": round(self.mean, 9),
+            "min": round(self.min, 9) if self.count else 0.0,
+            "max": round(self.max, 9) if self.count else 0.0,
+            "p50": round(p[50], 9),
+            "p95": round(p[95], 9),
+            "p99": round(p[99], 9),
+            "unit": self.unit,
+        }
+
+
+class MetricsRegistry:
+    """Named metric directory.  ``clock`` is injectable (tests pass a fake
+    counter and get deterministic timestamps everywhere downstream —
+    Telemetry threads the same clock through tracing and the flight
+    recorder)."""
+
+    def __init__(self, clock=time.perf_counter):
+        self.clock = clock
+        self._metrics: dict[str, object] = {}
+
+    def _get(self, name, cls, **kw):
+        m = self._metrics.get(name)
+        if m is None:
+            m = cls(name, **kw)
+            self._metrics[name] = m
+        elif not isinstance(m, cls):
+            raise TypeError(f"metric {name!r} already registered as "
+                            f"{type(m).__name__}, not {cls.__name__}")
+        return m
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(name, Gauge)
+
+    def histogram(self, name: str, **kw) -> Histogram:
+        return self._get(name, Histogram, **kw)
+
+    def names(self):
+        return sorted(self._metrics)
+
+    def __contains__(self, name):
+        return name in self._metrics
+
+    def snapshot(self) -> dict:
+        """{metric name: value} — ints for counters, floats for gauges,
+        a stats dict (count/sum/min/max/p50/p95/p99) for histograms; plus
+        the snapshot clock under ``"at"``."""
+        out = {name: m.to_value() for name, m in sorted(self._metrics.items())}
+        out["at"] = float(self.clock())
+        return out
+
+
+def _flatten(d: dict, prefix: str = "") -> dict:
+    out = {}
+    for k, v in d.items():
+        key = f"{prefix}{k}"
+        if isinstance(v, dict):
+            out.update(_flatten(v, prefix=f"{key}."))
+        elif isinstance(v, (int, float, bool)):
+            out[key] = v
+    return out
+
+
+class EngineStats(Mapping):
+    """Immutable flattened snapshot of ``ServingEngine.stats()`` (nested
+    dicts dotted: ``jit_cache_misses.prefill``).  ``delta(earlier)``
+    returns per-window activity over the integer counters — ratios
+    (``draft_accept_rate``) are snapshot-only and excluded from deltas."""
+
+    __slots__ = ("_v", "at")
+
+    def __init__(self, values: dict, at: float):
+        self._v = dict(values)
+        self.at = float(at)
+
+    @classmethod
+    def capture(cls, stats: dict, clock=time.perf_counter) -> "EngineStats":
+        return cls(_flatten(stats), clock())
+
+    # Mapping interface ----------------------------------------------------
+    def __getitem__(self, k):
+        return self._v[k]
+
+    def __iter__(self):
+        return iter(self._v)
+
+    def __len__(self):
+        return len(self._v)
+
+    def counters(self) -> dict:
+        """The integer (monotonic) subset."""
+        return {k: v for k, v in self._v.items()
+                if isinstance(v, int) and not isinstance(v, bool)}
+
+    def delta(self, earlier: "EngineStats") -> dict:
+        """Per-window activity: this snapshot's counters minus an earlier
+        snapshot's (missing earlier keys count from 0 — e.g. a jit fn
+        compiled for the first time inside the window).  Includes
+        ``window_s``, the clock span between the snapshots."""
+        mine = self.counters()
+        theirs = earlier.counters()
+        out = {k: v - theirs.get(k, 0) for k, v in mine.items()}
+        out["window_s"] = self.at - earlier.at
+        return out
+
+    def __repr__(self):
+        return f"EngineStats(at={self.at:.6f}, {self._v!r})"

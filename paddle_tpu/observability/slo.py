@@ -1,0 +1,87 @@
+"""SLO reporting: latency quantiles + goodput at a deadline.
+
+One shared implementation for every consumer (bench.py's three serving
+traces, ``make obs-check``, dashboards): given per-request summaries from
+:class:`~paddle_tpu.observability.telemetry.Telemetry` (or raw latency
+lists), produce TTFT/TPOT/E2E quantiles and **goodput** — the share of
+work that met its deadline, the number a latency SLO actually pays on.
+
+Goodput here is TTFT-deadline goodput: a request is "good" when its first
+token arrived within ``ttft_deadline_s`` of submission (and it was not
+retired overdue).  ``goodput_tokens`` counts only good requests' generated
+tokens, so ``goodput_tokens_per_sec`` (when a wall-clock window is given)
+is directly comparable to raw tokens/s — the gap between the two is the
+throughput the SLO would forfeit."""
+from __future__ import annotations
+
+from .metrics import Histogram
+
+__all__ = ["latency_percentiles", "slo_report"]
+
+
+def latency_percentiles(values_s, name: str = "latency",
+                        ps=(50, 95, 99)) -> dict:
+    """{p<q>_ms: ...} readout over a list of second-valued latencies, via
+    the shared log-bucketed :class:`Histogram` (the single percentile
+    implementation bench.py's traces all use)."""
+    h = Histogram(name)
+    for v in values_s:
+        h.observe(v)
+    q = h.percentiles(ps)
+    return {f"p{p}_ms": round(q[p] * 1e3, 2) for p in ps}
+
+
+def slo_report(summaries, ttft_deadline_s: float,
+               window_s: float | None = None) -> dict:
+    """SLO report over request summaries.
+
+    ``summaries``: iterable of dicts with (at least) ``ttft_s``,
+    ``tpot_s``, ``e2e_s``, ``tokens``, ``timed_out`` — exactly what
+    ``Telemetry.request_summaries`` holds.  ``window_s``: the measurement
+    wall-clock, enabling goodput tokens/s."""
+    summaries = list(summaries)
+    h_ttft = Histogram("ttft_s")
+    h_tpot = Histogram("tpot_s")
+    h_e2e = Histogram("e2e_s")
+    good_req = 0
+    good_tokens = 0
+    total_tokens = 0
+    for s in summaries:
+        if s.get("ttft_s") is not None:
+            h_ttft.observe(s["ttft_s"])
+        if s.get("tpot_s") is not None:
+            h_tpot.observe(s["tpot_s"])
+        if s.get("e2e_s") is not None:
+            h_e2e.observe(s["e2e_s"])
+        tokens = int(s.get("tokens", 0))
+        total_tokens += tokens
+        on_time = (not s.get("timed_out")
+                   and s.get("ttft_s") is not None
+                   and s["ttft_s"] <= ttft_deadline_s)
+        if on_time:
+            good_req += 1
+            good_tokens += tokens
+
+    def _q(h: Histogram) -> dict:
+        q = h.percentiles()
+        return {"p50_ms": round(q[50] * 1e3, 2),
+                "p95_ms": round(q[95] * 1e3, 2),
+                "p99_ms": round(q[99] * 1e3, 2),
+                "count": h.count}
+
+    n = len(summaries)
+    rep = {
+        "requests": n,
+        "ttft": _q(h_ttft),
+        "tpot": _q(h_tpot),
+        "e2e": _q(h_e2e),
+        "ttft_deadline_ms": round(ttft_deadline_s * 1e3, 2),
+        "on_time_requests": good_req,
+        "goodput_fraction": round(good_req / n, 4) if n else 0.0,
+        "total_tokens": total_tokens,
+        "goodput_tokens": good_tokens,
+    }
+    if window_s is not None and window_s > 0:
+        rep["tokens_per_sec"] = round(total_tokens / window_s, 1)
+        rep["goodput_tokens_per_sec"] = round(good_tokens / window_s, 1)
+    return rep

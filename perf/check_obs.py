@@ -1,0 +1,240 @@
+#!/usr/bin/env python
+"""Observability CI gate (`make obs-check`): bench artifact schema
+validation + the telemetry-overhead gate.
+
+Two checks, both about keeping the telemetry subsystem honest:
+
+1. **Artifact schema** (`--artifact PATH --trace NAME`): a bench `--json`
+   serving artifact must carry the full telemetry contract — engine
+   counters, the metrics snapshot (with quantile fields on every
+   histogram), and the SLO report (TTFT/TPOT/step-latency quantiles,
+   goodput at a deadline).  A bench refactor that silently drops a field
+   breaks every dashboard downstream; this gate fails it in CI instead.
+
+2. **Overhead gate** (`--gate`): runs the SAME small serving trace twice
+   per round — telemetry off, telemetry fully on (tracing + histograms +
+   flight recorder) — interleaved over `--rounds` rounds, and requires the
+   BEST per-round paired ratio on/off >= `--min-ratio` (default 0.97:
+   telemetry may cost at most ~3%).  The pairing matters on a machine
+   whose throughput wobbles ~2x under load (the same caveat as `make
+   tier1-budget`): the off/on runs of one round share load conditions, so
+   a transient stall poisons individual PAIRS while a real systematic
+   telemetry regression degrades EVERY pair — gating on the best pair
+   rejects the regression and shrugs off the noise (medians are reported
+   for information).  Telemetry-OFF is additionally asserted to do zero
+   telemetry work (engine.telemetry is None — the hook sites are single
+   flag checks).
+
+Exit status: 0 when every requested check passes, 1 otherwise.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+
+# histogram snapshot fields every metrics-snapshot histogram must carry
+HIST_FIELDS = ("count", "sum", "min", "max", "p50", "p95", "p99")
+# quantile blocks inside the SLO report
+SLO_QUANTILE_KEYS = ("p50_ms", "p95_ms", "p99_ms")
+# the shared TTFT report keys every serving trace must publish
+TTFT_KEYS = ("ttft_p50_ms", "ttft_p95_ms", "ttft_p99_ms", "slo_ttft_ms",
+             "goodput_on_time_requests", "goodput_fraction")
+# histograms the engine telemetry always registers
+REQUIRED_METRICS = ("serve.ttft_s", "serve.tpot_s", "serve.queue_s",
+                    "serve.e2e_s", "engine.step_host_s")
+# engine counters that must ride along in the snapshot
+REQUIRED_ENGINE_COUNTERS = ("engine.tokens_generated", "engine.decode_steps",
+                            "engine.prefill_tokens_executed")
+
+# where each trace keeps its telemetry-bearing sections:
+# {trace: [paths to dicts that contain metrics+slo_report+TTFT keys]}
+TRACE_SECTIONS = {
+    "serving": [()],
+    "shared-prefix": [("prefix_cache",), ("pr1_engine",)],
+    "spec-decode": [("speculative",), ("baseline",)],
+}
+
+
+def _dig(d: dict, path):
+    for k in path:
+        if not isinstance(d, dict) or k not in d:
+            return None
+        d = d[k]
+    return d
+
+
+def validate_artifact(art: dict, trace: str) -> list[str]:
+    """Returns a list of problems (empty == valid)."""
+    problems = []
+    if trace not in TRACE_SECTIONS:
+        return [f"unknown trace {trace!r} "
+                f"(expected one of {sorted(TRACE_SECTIONS)})"]
+    if not isinstance(art, dict):
+        return ["artifact is not a JSON object"]
+    if "metric" not in art:
+        problems.append("missing top-level 'metric'")
+    for path in TRACE_SECTIONS[trace]:
+        sec = _dig(art, path)
+        label = "/".join(path) or "<top level>"
+        if not isinstance(sec, dict):
+            problems.append(f"missing section {label}")
+            continue
+        for k in TTFT_KEYS:
+            if k not in sec:
+                problems.append(f"{label}: missing TTFT report key {k!r}")
+        if not isinstance(sec.get("engine_stats"), dict):
+            problems.append(f"{label}: missing engine_stats")
+        metrics = sec.get("metrics")
+        if not isinstance(metrics, dict):
+            problems.append(f"{label}: missing metrics snapshot")
+        else:
+            for name in REQUIRED_METRICS:
+                h = metrics.get(name)
+                if not isinstance(h, dict):
+                    problems.append(f"{label}: metrics missing histogram "
+                                    f"{name!r}")
+                    continue
+                for f in HIST_FIELDS:
+                    if f not in h:
+                        problems.append(f"{label}: metrics[{name!r}] missing "
+                                        f"quantile field {f!r}")
+            for name in REQUIRED_ENGINE_COUNTERS:
+                if name not in metrics:
+                    problems.append(f"{label}: metrics missing engine "
+                                    f"counter {name!r}")
+        slo = sec.get("slo_report")
+        if not isinstance(slo, dict):
+            problems.append(f"{label}: missing slo_report")
+        else:
+            for block in ("ttft", "tpot", "e2e", "step_latency"):
+                b = slo.get(block)
+                if not isinstance(b, dict):
+                    problems.append(f"{label}: slo_report missing {block!r}")
+                    continue
+                for f in SLO_QUANTILE_KEYS:
+                    if f not in b:
+                        problems.append(f"{label}: slo_report[{block!r}] "
+                                        f"missing {f!r}")
+            for f in ("ttft_deadline_ms", "goodput_fraction",
+                      "on_time_requests", "requests", "total_tokens",
+                      "goodput_tokens"):
+                if f not in slo:
+                    problems.append(f"{label}: slo_report missing {f!r}")
+    return problems
+
+
+def _overhead_trace(telemetry_on: bool, seed: int = 0) -> float:
+    """One small serving trace; returns useful tokens/s.  Same model, same
+    prompts, same engine geometry either way — the only variable is the
+    telemetry flag."""
+    import time
+
+    # runnable as `python perf/check_obs.py` from the repo root (sys.path
+    # then starts at perf/)
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import jax
+    import numpy as np
+
+    from paddle_tpu.inference.paged import ServingEngine
+    from paddle_tpu.models.llama import (build_functional_llama,
+                                         llama_config_tiny)
+    from paddle_tpu.observability import Telemetry
+
+    cfg = llama_config_tiny(vocab=256, hidden=64, layers=2, heads=4, seq=256)
+    ep, bp, hp, *_ = build_functional_llama(cfg, key=jax.random.PRNGKey(7))
+    params = (ep, bp, hp)
+    rng = np.random.default_rng(seed)
+    n_req, max_new = 12, 24
+    prompts = [rng.integers(1, 256, (int(t),)).astype(np.int32)
+               for t in rng.integers(8, 48, n_req)]
+    eng = ServingEngine(
+        params, cfg, num_slots=4, page_size=16, num_pages=256,
+        attention_impl="ref", prompt_bucket=16, decode_horizon=8,
+        telemetry=Telemetry() if telemetry_on else None)
+    assert (eng.telemetry is not None) == telemetry_on
+    # warm every prompt bucket + the horizon, then time the real trace
+    for tb in sorted({((len(p) + 15) // 16) * 16 for p in prompts}):
+        eng.submit(rng.integers(1, 256, (tb,)).astype(np.int32),
+                   max_new_tokens=max_new)
+    eng.run()
+    t0 = time.perf_counter()
+    for p in prompts:
+        eng.submit(p, max_new_tokens=max_new)
+    eng.run()
+    dt = time.perf_counter() - t0
+    return n_req * max_new / dt
+
+
+def overhead_gate(min_ratio: float = 0.97, rounds: int = 3,
+                  verbose: bool = True) -> tuple[bool, dict]:
+    """Interleaved on/off rounds; gate on the BEST per-round paired ratio
+    (load transients poison pairs, a real regression poisons them all)."""
+    on, off = [], []
+    for r in range(rounds):
+        off.append(_overhead_trace(False, seed=r))
+        on.append(_overhead_trace(True, seed=r))
+    pair_ratios = [a / b for a, b in zip(on, off)]
+    best = max(pair_ratios)
+    med_on = statistics.median(on)
+    med_off = statistics.median(off)
+    res = {"tokens_per_sec_off": round(med_off, 1),
+           "tokens_per_sec_on": round(med_on, 1),
+           "ratio_on_vs_off": round(best, 4),
+           "pair_ratios": [round(x, 4) for x in pair_ratios],
+           "median_ratio": round(med_on / med_off, 4),
+           "min_ratio": min_ratio, "rounds": rounds,
+           "all_off": [round(x, 1) for x in off],
+           "all_on": [round(x, 1) for x in on]}
+    ok = best >= min_ratio
+    if verbose:
+        print(f"telemetry-overhead gate: on={med_on:.1f} tok/s "
+              f"off={med_off:.1f} tok/s best paired ratio={best:.4f} "
+              f"(min {min_ratio}) -> {'OK' if ok else 'FAIL'}")
+        print(json.dumps(res))
+    return ok, res
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--artifact", metavar="PATH",
+                    help="bench --json artifact to schema-validate")
+    ap.add_argument("--trace", choices=sorted(TRACE_SECTIONS),
+                    default="serving",
+                    help="which trace produced the artifact")
+    ap.add_argument("--gate", action="store_true",
+                    help="run the telemetry-overhead gate")
+    ap.add_argument("--min-ratio", type=float, default=0.97,
+                    help="overhead gate: required on/off tokens/s ratio")
+    ap.add_argument("--rounds", type=int, default=3,
+                    help="overhead gate: interleaved measurement rounds")
+    args = ap.parse_args(argv)
+    if not args.artifact and not args.gate:
+        ap.error("nothing to do: pass --artifact and/or --gate")
+    rc = 0
+    if args.artifact:
+        with open(args.artifact) as f:
+            art = json.load(f)
+        problems = validate_artifact(art, args.trace)
+        if problems:
+            print(f"obs-check: artifact {args.artifact} FAILED "
+                  f"({len(problems)} problem(s)):")
+            for p in problems:
+                print(f"  - {p}")
+            rc = 1
+        else:
+            print(f"obs-check: artifact {args.artifact} "
+                  f"({args.trace}) schema OK")
+    if args.gate:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        ok, _ = overhead_gate(min_ratio=args.min_ratio, rounds=args.rounds)
+        if not ok:
+            rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,578 @@
+"""Observability subsystem tests (ISSUE 6 tentpole): metrics registry +
+log-bucketed histogram quantiles, EngineStats snapshot/delta + stats()
+monotonicity across a serving trace, request-lifecycle tracing with a
+nested Chrome-trace export, the crash flight recorder (stall / injected
+fault / preemption-storm dumps), Request timing fields, the telemetry-off
+no-op guarantee, and the obs-check artifact schema validator."""
+import json
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+import jax
+
+from paddle_tpu.models.llama import (llama_config_tiny,
+                                     build_functional_llama, llama_generate)
+from paddle_tpu.inference.paged import EngineStalledError, ServingEngine
+from paddle_tpu.observability import (Counter, EngineStats, FlightRecorder,
+                                      Gauge, Histogram, MetricsRegistry,
+                                      Telemetry, latency_percentiles,
+                                      slo_report)
+from paddle_tpu.resilience import inject
+
+rng = np.random.default_rng(17)
+
+
+def _llama(seed=1):
+    cfg = llama_config_tiny(vocab=64, hidden=32, layers=2, heads=4, seq=64)
+    ep, bp, hp, *_ = build_functional_llama(cfg, key=jax.random.PRNGKey(seed))
+    return cfg, (ep, bp, hp)
+
+
+def _engine(cfg, params, telemetry=True, **kw):
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("num_pages", 64)
+    kw.setdefault("attention_impl", "ref")
+    kw.setdefault("prompt_bucket", 8)
+    kw.setdefault("decode_horizon", 4)
+    return ServingEngine(params, cfg, telemetry=telemetry, **kw)
+
+
+class _FakeClock:
+    """Deterministic injectable clock: each call advances by `tick`."""
+
+    def __init__(self, start=100.0, tick=0.5):
+        self.t = start
+        self.tick = tick
+
+    def __call__(self):
+        t = self.t
+        self.t += self.tick
+        return t
+
+
+# ---------------------------------------------------------------------------
+# metrics primitives
+# ---------------------------------------------------------------------------
+class TestMetrics:
+    def test_counter_monotonic(self):
+        c = Counter("x")
+        c.inc()
+        c.inc(3)
+        assert c.value == 4
+        with pytest.raises(ValueError, match="cannot decrease"):
+            c.inc(-1)
+        assert c.value == 4
+
+    def test_gauge_last_value(self):
+        g = Gauge("g")
+        g.set(3)
+        g.set(1.5)
+        assert g.to_value() == 1.5
+
+    def test_histogram_quantiles_vs_numpy(self):
+        """Log-bucketed quantiles must track np.percentile within the
+        bucket's relative width (growth=1.1 → ~10% worst case; the
+        interpolation usually does much better)."""
+        h = Histogram("lat")
+        vals = rng.lognormal(mean=-4.0, sigma=1.0, size=2000)
+        for v in vals:
+            h.observe(v)
+        for q in (50, 95, 99):
+            got = h.quantile(q / 100.0)
+            want = float(np.percentile(vals, q))
+            assert abs(got - want) / want < 0.11, (q, got, want)
+        assert h.count == 2000
+        assert h.min == vals.min() and h.max == vals.max()
+        np.testing.assert_allclose(h.total, vals.sum(), rtol=1e-9)
+
+    def test_histogram_single_sample_is_exact(self):
+        h = Histogram("one")
+        h.observe(0.0421)
+        for q in (0.0, 0.5, 0.95, 0.99, 1.0):
+            assert h.quantile(q) == pytest.approx(0.0421)
+        d = h.to_value()
+        assert d["count"] == 1 and d["p50"] == pytest.approx(0.0421)
+
+    def test_histogram_empty_and_fraction_below(self):
+        h = Histogram("e")
+        assert h.quantile(0.5) == 0.0
+        assert h.fraction_below(1.0) == 0.0
+        for v in (0.001, 0.01, 0.1, 1.0):
+            h.observe(v)
+        assert h.fraction_below(10.0) == 1.0
+        assert h.fraction_below(1e-6) == 0.0
+        mid = h.fraction_below(0.02)
+        assert 0.25 <= mid <= 0.75
+
+    def test_registry_get_or_create_and_type_conflict(self):
+        r = MetricsRegistry()
+        c = r.counter("serve.x")
+        assert r.counter("serve.x") is c
+        with pytest.raises(TypeError, match="already registered"):
+            r.gauge("serve.x")
+        assert "serve.x" in r
+
+    def test_registry_snapshot_with_injectable_clock(self):
+        clk = _FakeClock(start=50.0, tick=1.0)
+        r = MetricsRegistry(clock=clk)
+        r.counter("c").inc(7)
+        r.gauge("g").set(2.5)
+        r.histogram("h").observe(0.25)
+        snap = r.snapshot()
+        assert snap["c"] == 7 and snap["g"] == 2.5
+        assert snap["h"]["count"] == 1
+        assert snap["at"] == 50.0           # first clock read, deterministic
+        assert r.snapshot()["at"] == 51.0   # ticks advance
+
+
+# ---------------------------------------------------------------------------
+# EngineStats snapshot/delta + stats() monotonicity (ISSUE 6 satellite)
+# ---------------------------------------------------------------------------
+class TestEngineStats:
+    def test_capture_flattens_nested(self):
+        s = EngineStats.capture({"a": 1, "nested": {"x": 2, "y": 3},
+                                 "rate": 0.5}, clock=lambda: 9.0)
+        assert s["a"] == 1 and s["nested.x"] == 2 and s["rate"] == 0.5
+        assert s.at == 9.0
+        assert "rate" not in s.counters()     # ratios are not counters
+
+    def test_delta_is_per_window_activity(self):
+        cfg, params = _llama()
+        eng = _engine(cfg, params, telemetry=None)
+        p = rng.integers(1, 64, (6,)).astype(np.int32)
+        eng.submit(p, max_new_tokens=5)
+        eng.run()
+        s1 = eng.stats_snapshot()
+        eng.submit(p, max_new_tokens=7)
+        eng.submit(p[:3], max_new_tokens=4)
+        eng.run()
+        s2 = eng.stats_snapshot()
+        d = s2.delta(s1)
+        assert d["tokens_generated"] == 7 + 4      # exactly this window
+        assert d["window_s"] > 0
+        assert all(v >= 0 for k, v in d.items() if k != "window_s")
+        zero = s2.delta(s2)
+        assert all(v == 0 for k, v in zero.items() if k != "window_s")
+
+    def test_stats_monotonic_across_full_serving_trace(self):
+        """Counters never decrease at ANY step boundary of a trace that
+        exercises prefix cache, chunked prefill, and speculation."""
+        cfg, params = _llama(seed=3)
+        eng = _engine(cfg, params, telemetry=None, prefill_chunk=8,
+                      speculative=2)
+        for t, n in ((14, 6), (9, 4), (22, 8), (14, 5)):
+            eng.submit(rng.integers(1, 64, (t,)).astype(np.int32),
+                       max_new_tokens=n)
+        prev = eng.stats_snapshot()
+        while eng.num_active or eng._queue:
+            eng.step()
+            cur = eng.stats_snapshot()
+            pc = prev.counters()
+            for k, v in cur.counters().items():
+                assert v >= pc.get(k, 0), f"counter {k} decreased"
+            prev = cur
+
+
+# ---------------------------------------------------------------------------
+# Request timing fields (ISSUE 6 satellite)
+# ---------------------------------------------------------------------------
+class TestRequestTiming:
+    def test_admit_retire_queue_tpot(self):
+        cfg, params = _llama()
+        eng = _engine(cfg, params, telemetry=None, num_slots=1)
+        p = rng.integers(1, 64, (6,)).astype(np.int32)
+        r1 = eng.submit(p, max_new_tokens=6)
+        r2 = eng.submit(p[:4], max_new_tokens=4)     # waits for the slot
+        done = eng.run()
+        for r in (done[r1], done[r2]):
+            assert 0 < r.submit_time <= r.admit_time
+            assert r.admit_time <= r.first_token_time <= r.finish_time
+            assert r.retire_time == r.finish_time
+            assert r.queue_time == r.admit_time - r.submit_time
+            assert r.ttft == pytest.approx(r.queue_time + r.prefill_time)
+            n = len(r.generated) - 1
+            assert r.tpot == pytest.approx(
+                (r.finish_time - r.first_token_time) / n)
+        # the second request queued behind a full slot set: its wait is
+        # real, and TTFT now decomposes into queue wait vs prefill
+        assert done[r2].queue_time > done[r1].queue_time
+
+    def test_unadmitted_request_reports_zero(self):
+        cfg, params = _llama()
+        eng = _engine(cfg, params, telemetry=None)
+        rid = eng.submit(rng.integers(1, 64, (4,)).astype(np.int32),
+                         max_new_tokens=2)
+        req = eng._queue[0]
+        assert req.rid == rid
+        assert req.queue_time == 0.0 and req.ttft == 0.0 and req.tpot == 0.0
+        eng.run()
+
+
+# ---------------------------------------------------------------------------
+# request-lifecycle tracing
+# ---------------------------------------------------------------------------
+class TestLifecycleTrace:
+    def test_event_order_dense_prefill(self):
+        cfg, params = _llama()
+        tel = Telemetry()
+        eng = _engine(cfg, params, telemetry=tel)
+        rid = eng.submit(rng.integers(1, 64, (6,)).astype(np.int32),
+                         max_new_tokens=6)
+        eng.run()
+        names = tel.tracer.get(rid).names()
+        core = [n for n in names if n in ("submitted", "queued", "admitted",
+                                          "prefill_dense", "first_token",
+                                          "retired")]
+        assert core == ["submitted", "queued", "admitted", "prefill_dense",
+                        "first_token", "retired"]
+        assert "decode_dispatch" in names
+        # timestamps are ordered
+        ts = [t for _, t, _ in tel.tracer.get(rid).events]
+        assert ts == sorted(ts)
+
+    def test_chunked_prefill_and_cache_hit_events(self):
+        cfg, params = _llama(seed=2)
+        tel = Telemetry()
+        eng = _engine(cfg, params, telemetry=tel, prefill_chunk=4,
+                      prompt_bucket=4)
+        p = rng.integers(1, 64, (13,)).astype(np.int32)
+        r1 = eng.submit(p, max_new_tokens=4)
+        eng.run()
+        names1 = tel.tracer.get(r1).names()
+        chunks = [n for n in names1 if n == "prefill_chunk"]
+        assert len(chunks) >= 3          # 13 tokens / 4-token chunks
+        assert names1.index("admitted") < names1.index("prefill_chunk") \
+            < names1.index("first_token")
+        # same prompt again: the retired pages were parked in the prefix
+        # cache, so the second admission records a cache_hit
+        r2 = eng.submit(p, max_new_tokens=4)
+        eng.run()
+        names2 = tel.tracer.get(r2).names()
+        assert "cache_hit" in names2
+
+    def test_profiler_bridge_wraps_dispatches(self, monkeypatch):
+        """profiler_bridge=True must actually enter host annotations
+        around the engine's dispatch calls (the jax-device-timeline
+        bridge), not just hold a flag."""
+        import paddle_tpu.profiler as profiler
+        entered = []
+
+        class _Rec:
+            def __init__(self, name):
+                self.name = name
+
+            def __enter__(self):
+                entered.append(self.name)
+                return self
+
+            def __exit__(self, *exc):
+                return False
+
+        monkeypatch.setattr(profiler, "host_annotation",
+                            lambda name: _Rec(name))
+        cfg, params = _llama()
+        tel = Telemetry(profiler_bridge=True)
+        eng = _engine(cfg, params, telemetry=tel, prefill_chunk=4,
+                      prompt_bucket=4)
+        eng.submit(rng.integers(1, 64, (13,)).astype(np.int32),
+                   max_new_tokens=4)
+        eng.run()
+        assert "serve.prefill_chunk" in entered
+        assert "serve.decode_dispatch" in entered
+        # bridge off: nothing is entered
+        entered.clear()
+        eng2 = _engine(cfg, params, telemetry=Telemetry())
+        eng2.submit(rng.integers(1, 64, (6,)).astype(np.int32),
+                    max_new_tokens=2)
+        eng2.run()
+        assert entered == []
+
+    def test_preemption_events_recorded(self):
+        cfg, params = _llama(seed=5)
+        tel = Telemetry()
+        eng = ServingEngine(params, cfg, num_slots=2, page_size=2,
+                            num_pages=40, max_pages_per_seq=16,
+                            attention_impl="ref", prompt_bucket=8,
+                            decode_horizon=2, telemetry=tel)
+        prompts = [rng.integers(1, 64, (t,)).astype(np.int32)
+                   for t in (5, 7, 3)]
+        with inject({"serve.pool_pressure": dict(action="trigger", after=1,
+                                                 count=3)}):
+            rids = [eng.submit(p, max_new_tokens=8) for p in prompts]
+            done = eng.run()
+        assert eng.preemptions >= 1
+        assert len(done) == 3
+        victim = next(r for r in done.values() if r.preemptions > 0)
+        names = tel.tracer.get(victim.rid).names()
+        i_pre = names.index("preempted")
+        # re-admission follows the preemption in the same record
+        assert "admitted" in names[i_pre:]
+        assert names[-1] == "retired"
+
+
+# ---------------------------------------------------------------------------
+# Chrome-trace export
+# ---------------------------------------------------------------------------
+class TestChromeTrace:
+    def test_export_valid_json_with_nested_spans(self, tmp_path):
+        cfg, params = _llama(seed=2)
+        tel = Telemetry()
+        eng = _engine(cfg, params, telemetry=tel, prefill_chunk=4,
+                      prompt_bucket=4)
+        for t, n in ((13, 4), (6, 5)):
+            eng.submit(rng.integers(1, 64, (t,)).astype(np.int32),
+                       max_new_tokens=n)
+        eng.run()
+        out = tmp_path / "serve_trace.json"
+        tel.tracer.export_chrome(str(out))
+        data = json.loads(out.read_text())     # valid JSON, loadable shape
+        evs = data["traceEvents"]
+        assert data["displayTimeUnit"] == "ms"
+        assert any(e.get("ph") == "M" and e.get("name") == "process_name"
+                   for e in evs)
+        # per-request track: one top-level request span, phases nested
+        # inside it (chrome nesting == containment on one tid)
+        by_tid = {}
+        for e in evs:
+            if e.get("ph") == "X":
+                by_tid.setdefault(e["tid"], []).append(e)
+        req_tids = [tid for tid, es in by_tid.items()
+                    if any(e["name"].startswith("request") for e in es)]
+        assert len(req_tids) == 2
+        eps = 0.01                              # us; rounding slack
+        for tid in req_tids:
+            spans = by_tid[tid]
+            parent = next(e for e in spans
+                          if e["name"].startswith("request"))
+            p0, p1 = parent["ts"], parent["ts"] + parent["dur"]
+            children = [e for e in spans if e is not parent]
+            assert children                     # phases exist
+            for c in children:
+                assert c["ts"] >= p0 - eps, (c["name"], c["ts"], p0)
+                assert c["ts"] + c.get("dur", 0) <= p1 + eps, c["name"]
+            phase_names = {c["name"] for c in children}
+            assert "queued" in phase_names and "decode" in phase_names
+        # engine track carries the step/dispatch phase spans
+        engine_spans = {e["name"] for e in by_tid.get(0, [])}
+        assert "step" in engine_spans and "decode_dispatch" in engine_spans
+        # instant events are well-formed
+        for e in evs:
+            if e.get("ph") == "i":
+                assert "ts" in e and e.get("s") == "t"
+
+    def test_inflight_request_exports_cleanly(self):
+        cfg, params = _llama()
+        tel = Telemetry()
+        eng = _engine(cfg, params, telemetry=tel)
+        eng.submit(rng.integers(1, 64, (6,)).astype(np.int32),
+                   max_new_tokens=8)
+        eng.step()                              # mid-flight
+        data = tel.tracer.to_chrome_trace()
+        assert any(e["name"].startswith("request")
+                   for e in data["traceEvents"] if e.get("ph") == "X")
+        eng.run()
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+class TestFlightRecorder:
+    def test_ring_is_bounded_with_continuous_seq(self):
+        clk = _FakeClock()
+        fr = FlightRecorder(capacity=8, clock=clk)
+        for i in range(20):
+            fr.record("e", i=i)
+        assert len(fr) == 8
+        seqs = [e["seq"] for e in fr.events()]
+        assert seqs == list(range(13, 21))      # the most recent window
+        d = fr.dump("test", note="x")
+        assert d["total_events"] == 20 and len(d["events"]) == 8
+        assert "note" in d["extra"]
+        assert "flight-recorder dump: test" in FlightRecorder.format_dump(d)
+
+    def test_dump_history_bounded(self):
+        fr = FlightRecorder(capacity=4, max_dumps=3)
+        for i in range(6):
+            fr.record("e")
+            fr.dump(f"r{i}")
+        assert len(fr.dumps) == 3
+        assert fr.last_dump()["reason"] == "r5"
+
+    def test_dump_fires_on_engine_stalled(self):
+        """A never-clearing injected pool-pressure window stalls the
+        engine; the EngineStalledError dump must carry the recent-event
+        window showing the no-progress steps."""
+        cfg, params = _llama()
+        tel = Telemetry()
+        eng = _engine(cfg, params, telemetry=tel)
+        with inject({"serve.pool_pressure": dict(action="trigger",
+                                                 count=None)}):
+            eng.submit(rng.integers(1, 64, (5,)).astype(np.int32),
+                       max_new_tokens=4)
+            with pytest.raises(EngineStalledError):
+                eng.run(max_stall_steps=5)
+        dump = tel.flight.last_dump()
+        assert dump["reason"] == "engine_stalled"
+        assert dump["extra"]["stalled_steps"] == 5
+        steps = [e for e in dump["events"] if e["event"] == "step"]
+        assert steps and all(not s["progressed"] for s in steps)
+        # every pressured step also flagged the injected fault
+        assert any(d["reason"] == "injected_fault" for d in tel.flight.dumps)
+        # drain the queue so the refcount leak guard sees a clean pool
+        eng.run()
+
+    def test_dump_fires_on_preemption_storm(self):
+        cfg, params = _llama(seed=5)
+        tel = Telemetry(storm_threshold=2, storm_window=32)
+        eng = ServingEngine(params, cfg, num_slots=2, page_size=2,
+                            num_pages=40, max_pages_per_seq=16,
+                            attention_impl="ref", prompt_bucket=8,
+                            decode_horizon=2, telemetry=tel)
+        prompts = [rng.integers(1, 64, (t,)).astype(np.int32)
+                   for t in (5, 7, 3)]
+        with inject({"serve.pool_pressure": dict(action="trigger", after=1,
+                                                 count=4)}):
+            for p in prompts:
+                eng.submit(p, max_new_tokens=8)
+            eng.run()
+        assert eng.preemptions >= 2
+        storm = [d for d in tel.flight.dumps
+                 if d["reason"] == "preemption_storm"]
+        assert storm and storm[0]["extra"]["preemptions_in_window"] >= 2
+
+
+# ---------------------------------------------------------------------------
+# telemetry-off is a no-op; telemetry-on is bit-exact
+# ---------------------------------------------------------------------------
+class TestTelemetryNoop:
+    def test_off_by_default_and_bit_exact_on_vs_off(self):
+        cfg, params = _llama(seed=4)
+        prompts = [rng.integers(1, 64, (t,)).astype(np.int32)
+                   for t in (5, 9, 3)]
+        eng_off = _engine(cfg, params, telemetry=None)
+        assert eng_off.telemetry is None           # off = no object at all
+        assert _engine(cfg, params, telemetry=False).telemetry is None
+        rids_off = [eng_off.submit(p, max_new_tokens=6) for p in prompts]
+        done_off = eng_off.run()
+        tel = Telemetry()
+        eng_on = _engine(cfg, params, telemetry=tel)
+        rids_on = [eng_on.submit(p, max_new_tokens=6) for p in prompts]
+        done_on = eng_on.run()
+        for a, b, p in zip(rids_off, rids_on, prompts):
+            ref = np.asarray(llama_generate(params, cfg, p[None],
+                                            max_new_tokens=6))[0]
+            np.testing.assert_array_equal(done_off[a].output_ids, ref)
+            np.testing.assert_array_equal(done_on[b].output_ids, ref)
+        # and the on-engine actually recorded the trace
+        assert len(tel.tracer.traces()) == len(prompts)
+        assert tel.registry.snapshot()["serve.requests_retired"] == 3
+
+    def test_telemetry_true_builds_default(self):
+        cfg, params = _llama()
+        eng = _engine(cfg, params, telemetry=True)
+        assert isinstance(eng.telemetry, Telemetry)
+        eng.submit(rng.integers(1, 64, (4,)).astype(np.int32),
+                   max_new_tokens=2)
+        eng.run()
+        assert eng.telemetry.flight.event_names()[0] == "submit"
+
+
+# ---------------------------------------------------------------------------
+# SLO report + shared percentile helper
+# ---------------------------------------------------------------------------
+class TestSLO:
+    def test_goodput_counts_only_on_time_requests(self):
+        summaries = [
+            {"rid": 0, "tokens": 10, "ttft_s": 0.05, "tpot_s": 0.01,
+             "e2e_s": 0.2, "timed_out": False},
+            {"rid": 1, "tokens": 20, "ttft_s": 0.50, "tpot_s": 0.01,
+             "e2e_s": 0.8, "timed_out": False},    # missed the deadline
+            {"rid": 2, "tokens": 5, "ttft_s": 0.01, "tpot_s": 0.02,
+             "e2e_s": 0.1, "timed_out": True},     # overdue: never good
+        ]
+        rep = slo_report(summaries, ttft_deadline_s=0.1, window_s=2.0)
+        assert rep["requests"] == 3
+        assert rep["on_time_requests"] == 1
+        assert rep["goodput_fraction"] == pytest.approx(1 / 3, abs=1e-4)
+        assert rep["total_tokens"] == 35 and rep["goodput_tokens"] == 10
+        assert rep["goodput_tokens_per_sec"] == pytest.approx(5.0)
+        assert rep["ttft"]["count"] == 3
+        for block in ("ttft", "tpot", "e2e"):
+            for f in ("p50_ms", "p95_ms", "p99_ms"):
+                assert f in rep[block]
+
+    def test_latency_percentiles_helper(self):
+        vals = [0.010, 0.020, 0.030, 0.040, 0.100]
+        out = latency_percentiles(vals)
+        assert set(out) == {"p50_ms", "p95_ms", "p99_ms"}
+        assert 15.0 <= out["p50_ms"] <= 35.0
+        assert out["p99_ms"] <= 100.0 + 1e-6
+
+    def test_engine_slo_report_end_to_end(self):
+        cfg, params = _llama()
+        tel = Telemetry()
+        eng = _engine(cfg, params, telemetry=tel)
+        for t, n in ((6, 4), (9, 6)):
+            eng.submit(rng.integers(1, 64, (t,)).astype(np.int32),
+                       max_new_tokens=n)
+        eng.run()
+        rep = tel.slo_report(ttft_deadline_s=60.0, window_s=1.0)
+        assert rep["requests"] == 2 and rep["goodput_fraction"] == 1.0
+        assert rep["total_tokens"] == 10
+        assert rep["step_latency"]["count"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# obs-check artifact schema validator (perf/check_obs.py)
+# ---------------------------------------------------------------------------
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+from perf.check_obs import validate_artifact  # noqa: E402
+
+
+def _section_from_engine(eng):
+    tel = eng.telemetry
+    return {
+        "tokens_per_sec": 100.0,
+        "ttft_p50_ms": 1.0, "ttft_p95_ms": 2.0, "ttft_p99_ms": 3.0,
+        "slo_ttft_ms": 1000.0, "goodput_on_time_requests": 1,
+        "goodput_fraction": 1.0,
+        "engine_stats": eng.stats(),
+        "metrics": tel.snapshot(eng.stats()),
+        "slo_report": tel.slo_report(1.0, window_s=1.0),
+    }
+
+
+class TestObsCheckValidator:
+    def test_real_engine_section_passes(self):
+        cfg, params = _llama()
+        eng = _engine(cfg, params, telemetry=True)
+        eng.submit(rng.integers(1, 64, (5,)).astype(np.int32),
+                   max_new_tokens=3)
+        eng.run()
+        art = {"metric": "trace_serving", **_section_from_engine(eng)}
+        assert validate_artifact(art, "serving") == []
+        sp = {"metric": "trace_shared_prefix",
+              "prefix_cache": _section_from_engine(eng),
+              "pr1_engine": _section_from_engine(eng)}
+        assert validate_artifact(sp, "shared-prefix") == []
+
+    def test_missing_fields_are_reported(self):
+        cfg, params = _llama()
+        eng = _engine(cfg, params, telemetry=True)
+        eng.submit(rng.integers(1, 64, (5,)).astype(np.int32),
+                   max_new_tokens=3)
+        eng.run()
+        art = {"metric": "trace_serving", **_section_from_engine(eng)}
+        art.pop("slo_report")
+        art["metrics"].pop("serve.ttft_s")
+        del art["ttft_p99_ms"]
+        problems = validate_artifact(art, "serving")
+        text = "\n".join(problems)
+        assert "slo_report" in text
+        assert "serve.ttft_s" in text
+        assert "ttft_p99_ms" in text
+        assert validate_artifact({}, "serving")      # empty artifact fails
+        assert validate_artifact(art, "nope")        # unknown trace fails

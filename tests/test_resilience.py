@@ -594,6 +594,50 @@ class TestServingResilience:
         eng.release_cache()   # retired pages park in the prefix cache
         assert eng.pool.num_free == eng.pool.num_pages
 
+    def test_flight_recorder_ladder_order_under_pool_pressure(self):
+        """Flight-recorder drill (ISSUE 6 satellite): inject
+        serve.pool_pressure and assert the auto-dumped ring buffer shows
+        the degradation ladder IN ORDER — admissions first, then the
+        eviction rung, then the preemption — so a postmortem reads the
+        self-healing sequence straight off the dump."""
+        from paddle_tpu.observability import Telemetry
+        cfg, params = _llama(seed=5)
+        tel = Telemetry()
+        eng = ServingEngine(params, cfg, num_slots=2, page_size=2,
+                            num_pages=40, max_pages_per_seq=16,
+                            attention_impl="ref", prompt_bucket=8,
+                            decode_horizon=2, telemetry=tel)
+        prompts = [rng.integers(1, 64, (t,)).astype(np.int32)
+                   for t in (5, 7, 3)]
+        with inject({"serve.pool_pressure": dict(action="trigger", after=1,
+                                                 count=3)}) as plan:
+            rids = [eng.submit(p, max_new_tokens=8) for p in prompts]
+            done = eng.run()
+        assert plan.fired("serve.pool_pressure") == 3
+        assert eng.preemptions >= 1
+        # the injected pressure auto-dumped the recorder (once per
+        # pressured step); the LAST fault dump has the whole ladder
+        fault_dumps = [d for d in tel.flight.dumps
+                       if d["reason"] == "injected_fault"]
+        assert fault_dumps, "pool-pressure window did not auto-dump"
+        names = [e["event"] for e in fault_dumps[-1]["events"]]
+        assert "admit" in names and "evict" in names and "preempt" in names
+        # ladder order: admit -> evict (the rung walked before giving up)
+        # -> preempt, in the recorded event sequence
+        assert names.index("admit") < names.index("evict") \
+            < names.index("preempt")
+        # the fault itself is on the record too
+        assert any(e["event"] == "fault"
+                   and e["point"] == "serve.pool_pressure"
+                   for e in fault_dumps[-1]["events"])
+        # and the self-heal still completed everything bit-exactly
+        for rid, p in zip(rids, prompts):
+            ref = np.asarray(llama_generate(params, cfg, p[None],
+                                            max_new_tokens=8))[0]
+            np.testing.assert_array_equal(done[rid].output_ids, ref)
+        eng.release_cache()
+        assert eng.pool.num_free == eng.pool.num_pages
+
     def test_pagepool_alloc_fault_point(self):
         pool = PagePool(8, 16)
         with inject({"pagepool.alloc": dict(action="trigger", at=1)}):
