@@ -41,7 +41,14 @@ PYTEST_T1 = env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
 GRAFTLINT = $(PY) -m paddle_tpu.analysis paddle_tpu \
 	--baseline graftlint.baseline.json
 
-.PHONY: tier1 tier1-budget check-budget bench lint lint-baseline obs-check
+.PHONY: tier1 tier1-budget check-budget bench bench-trend lint \
+	lint-baseline obs-check
+
+# `bench-trend` reads every BENCH_r*.json driver artifact at the repo root
+# and prints the headline tokens/s + serving TTFT-p95 + goodput trajectory
+# across PRs; it exits non-zero on artifact schema drift (perf/bench_trend.py).
+bench-trend:
+	$(PY) perf/bench_trend.py
 
 OBS_ARTIFACT ?= /tmp/_obs_serving.json
 

@@ -644,6 +644,11 @@ def bench_serving(seed=0):
         "metrics": eng.telemetry.snapshot(eng.stats()),
         "slo_report": eng.telemetry.slo_report(slo_ttft,
                                                window_s=dt_engine),
+        # host/device step decomposition + memory observatory + compile
+        # accounting (ISSUE 7 tentpole; schema-gated by perf/check_obs.py)
+        "utilization": eng.telemetry.utilization_report(window_s=dt_engine),
+        "memory": eng.telemetry.memory_report(eng.stats()),
+        "compile": eng.telemetry.compile_report(),
     }
 
 
@@ -769,6 +774,11 @@ def bench_serving_shared_prefix(seed=7):
             # full telemetry snapshot + SLO report over the timed pass
             "metrics": eng.telemetry.snapshot(eng.stats()),
             "slo_report": eng.telemetry.slo_report(slo_ttft, window_s=dt),
+            # host/device decomposition + memory/compile observatory over
+            # the timed pass (compile counts are engine-cumulative)
+            "utilization": eng.telemetry.utilization_report(window_s=dt),
+            "memory": eng.telemetry.memory_report(eng.stats()),
+            "compile": eng.telemetry.compile_report(),
         }
         return outputs, stats
 
@@ -897,6 +907,11 @@ def bench_serving_spec_decode(seed=0):
             # full telemetry snapshot + SLO report over the timed window
             "metrics": eng.telemetry.snapshot(stats),
             "slo_report": eng.telemetry.slo_report(slo_ttft, window_s=dt),
+            # host/device decomposition + memory/compile observatory over
+            # the timed window (compile counts are engine-cumulative)
+            "utilization": eng.telemetry.utilization_report(window_s=dt),
+            "memory": eng.telemetry.memory_report(stats),
+            "compile": eng.telemetry.compile_report(),
         }
 
     out_off, s_off = run_trace(None)

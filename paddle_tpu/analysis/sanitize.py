@@ -27,6 +27,7 @@ Typical steady-state proof (tests/test_recompile_budget.py):
 from __future__ import annotations
 
 import contextlib
+import time
 
 __all__ = ["RecompileBudgetError", "instrument", "sanitize", "jit_cache_size"]
 
@@ -85,26 +86,40 @@ class _Sanitizer:
 class _InstrumentedJit:
     """Callable proxy over a jitted function: counts compile-cache misses
     per call into `counters[name]` and reports them to any active
-    sanitize() scope.  Unknown attributes (lower, trace, ...) pass through
-    to the underlying PjitFunction."""
+    sanitize() scope.  A call that missed additionally reports its wall
+    duration to `on_miss(name, n, dur_s)` when one is attached — the
+    duration covers compile + first execution (the two are inseparable at
+    this layer), which is exactly the latency a recompile costs the caller
+    and what the telemetry `engine.compile_s` histogram records.  Unknown
+    attributes (lower, trace, ...) pass through to the underlying
+    PjitFunction."""
 
-    __slots__ = ("_graft_jit", "_graft_name", "_graft_counters")
+    __slots__ = ("_graft_jit", "_graft_name", "_graft_counters",
+                 "_graft_on_miss")
 
-    def __init__(self, fn, name, counters):
+    def __init__(self, fn, name, counters, on_miss=None):
         self._graft_jit = fn
         self._graft_name = name
         self._graft_counters = counters
+        self._graft_on_miss = on_miss
 
     def __call__(self, *args, **kwargs):
         fn = self._graft_jit
         before = jit_cache_size(fn)
+        t0 = time.perf_counter()
         out = fn(*args, **kwargs)
         if before is not None:
             after = jit_cache_size(fn)
             if after is not None and after > before:
+                dur = time.perf_counter() - t0
                 n = after - before
                 c = self._graft_counters
                 c[self._graft_name] = c.get(self._graft_name, 0) + n
+                if self._graft_on_miss is not None:
+                    # observability hook (compile accounting) — fires even
+                    # when a sanitize() budget raises below: the compile
+                    # happened and must be on the record
+                    self._graft_on_miss(self._graft_name, n, dur)
                 err = None
                 for s in reversed(_ACTIVE):
                     try:
@@ -130,16 +145,20 @@ class _InstrumentedJit:
         return f"<instrumented jit {self._graft_name!r} of {self._graft_jit!r}>"
 
 
-def instrument(fn, name=None, counters=None):
+def instrument(fn, name=None, counters=None, on_miss=None):
     """Wrap a jitted callable so its compile-cache misses are counted under
     `name` in `counters` (a dict you own) and policed by active sanitize()
-    scopes.  Idempotent-ish: instrumenting an instrumented fn re-wraps the
-    underlying jit."""
+    scopes.  `on_miss(name, n, dur_s)`, when given, is additionally called
+    once per missing call with the call's wall duration (compile
+    accounting for telemetry; it must not raise).  Idempotent-ish:
+    instrumenting an instrumented fn re-wraps the underlying jit."""
     if isinstance(fn, _InstrumentedJit):
         fn = fn._graft_jit
     if name is None:
         name = getattr(fn, "__name__", None) or repr(fn)
-    return _InstrumentedJit(fn, name, counters if counters is not None else {})
+    return _InstrumentedJit(fn, name,
+                            counters if counters is not None else {},
+                            on_miss=on_miss)
 
 
 @contextlib.contextmanager

@@ -28,6 +28,7 @@ import os
 import pickle
 import shutil
 import threading
+import time
 
 import numpy as np
 import jax
@@ -185,7 +186,13 @@ def recover_interrupted_commit(path):
 
 
 def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
-                    unique_id=None, async_save=False):
+                    unique_id=None, async_save=False, on_phase=None):
+    """``on_phase(name, dur_s)``, when given, receives the writer's two
+    sub-phase wall durations — ``ckpt.stage`` (chunked fsync'd writes into
+    the staging dir) and ``ckpt.commit`` (manifest + atomic rename) — as
+    each completes; on the async path it is called from the writer thread.
+    It must not raise; a fault-injected phase reports nothing (the span
+    the caller holds still closes)."""
     path = os.fspath(path)
     staging = path + ".tmp"
     rank = jax.process_index()
@@ -232,15 +239,19 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
         local_meta["tensors"][name] = entry
 
     def _write():
+        t0 = time.perf_counter()
         _write_durable(os.path.join(staging, f"rank{rank}.data"),
                        pickle.dumps(shards, protocol=4))
         _write_durable(os.path.join(staging, f"rank{rank}.meta.json"),
                        json.dumps(local_meta, default=str).encode())
+        if on_phase is not None:
+            on_phase("ckpt.stage", time.perf_counter() - t0)
 
     def _commit():
         """Merge metadata, write the manifest, then the commit point: rename
         staging onto the final path (the previous checkpoint, if any, stays
         intact until after the new one is durable)."""
+        t0 = time.perf_counter()
         _merge_metadata(staging)
         _write_manifest(staging)
         _fsync_dir(staging)
@@ -254,6 +265,8 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
         os.rename(staging, path)
         shutil.rmtree(old, ignore_errors=True)
         _fsync_dir(os.path.dirname(os.path.abspath(path)) or ".")
+        if on_phase is not None:
+            on_phase("ckpt.commit", time.perf_counter() - t0)
 
     if async_save:
         # device_get already happened above; only the host-side serialization

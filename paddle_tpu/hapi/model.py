@@ -9,6 +9,7 @@ import numpy as np
 from ..core.tensor import Tensor
 from ..nn.layer import Layer
 from ..io import DataLoader, Dataset
+from ..observability.train import batch_samples
 from .. import framework
 from .callbacks import CallbackList, ProgBarLogger
 
@@ -140,7 +141,15 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
-            accumulate_grad_batches=1, num_iters=None):
+            accumulate_grad_batches=1, num_iters=None, telemetry=None):
+        """``telemetry``: an ``observability.TrainTelemetry`` (or None =
+        off).  With one attached, every iteration records its host wall
+        time split into data wait (the ``next(loader)`` call) vs compute
+        (``train_batch``, whose ``float(loss)`` sync makes it real device
+        time) into ``train.step_s`` / ``train.data_s`` /
+        ``train.compute_s``, and each ``save_dir`` checkpoint gets a
+        ``ckpt.save`` span.  Pure host timing at boundaries the loop
+        already crosses: losses are bit-exact telemetry on vs off."""
         train_loader = self._to_loader(train_data, batch_size, shuffle)
         eval_loader = self._to_loader(eval_data, batch_size, False)
         cbks = CallbackList(callbacks or [ProgBarLogger(log_freq, verbose=verbose)])
@@ -148,16 +157,31 @@ class Model:
         cbks.on_begin("train", {"epochs": epochs,
                                 "steps": _safe_len(train_loader),
                                 "metrics": self._metric_names()})
+        tel = telemetry
         it = 0
         for epoch in range(epochs):
             for m in self._metrics:
                 m.reset()
             cbks.on_epoch_begin(epoch)
             logs = {}
-            for step, batch in enumerate(train_loader):
+            data_iter = iter(train_loader)
+            step = -1
+            while True:
+                t_d0 = tel.clock() if tel is not None else 0.0
+                try:
+                    batch = next(data_iter)
+                except StopIteration:
+                    break
+                t_d1 = tel.clock() if tel is not None else 0.0
+                step += 1
                 cbks.on_batch_begin("train", step, logs)
                 x, y = self._split_batch(batch)
                 res = self.train_batch(x, y)
+                if tel is not None:
+                    t_c1 = tel.clock()
+                    tel.step(t_c1 - t_d0, data_s=t_d1 - t_d0,
+                             compute_s=t_c1 - t_d1,
+                             samples=batch_samples(x))
                 logs = self._pack_logs(res)
                 cbks.on_batch_end("train", step, logs)
                 it += 1
@@ -167,7 +191,12 @@ class Model:
             if eval_loader is not None and (epoch + 1) % eval_freq == 0:
                 self.evaluate(eval_loader, batch_size=batch_size, verbose=0)
             if save_dir is not None and (epoch + 1) % save_freq == 0:
-                self.save(f"{save_dir}/epoch_{epoch}")
+                if tel is not None:
+                    with tel.span("ckpt.save", epoch=epoch):
+                        self.save(f"{save_dir}/epoch_{epoch}")
+                    tel.saved(epoch, f"{save_dir}/epoch_{epoch}")
+                else:
+                    self.save(f"{save_dir}/epoch_{epoch}")
             if self.stop_training or (num_iters is not None and it >= num_iters):
                 break
         cbks.on_end("train", logs)

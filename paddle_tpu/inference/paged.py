@@ -820,10 +820,20 @@ class ServingEngine:
         the returned callable lands in `self.jit_cache_misses[name]` and is
         reported to any active `analysis.sanitize()` scope (the recompile
         budget).  All engine executables route through here so steady-state
-        variant counts are observable per model fn."""
+        variant counts are observable per model fn — and, with telemetry
+        attached, every miss's wall cost lands in `engine.compile_s` + a
+        flight `compile` event (compile accounting)."""
         jf = self._jax.jit(fn, **jit_kw)
         self._jit_fns.setdefault(name, []).append(jf)
-        return instrument(jf, name=name, counters=self.jit_cache_misses)
+        return instrument(jf, name=name, counters=self.jit_cache_misses,
+                          on_miss=self._on_compile)
+
+    def _on_compile(self, name, n, dur_s):
+        """sanitize-instrumentation miss hook (host-only; telemetry off is
+        one None check)."""
+        tel = self.telemetry
+        if tel is not None:
+            tel.compiled(name, n, dur_s)
 
     def _call_paged(self, fn, *args):
         """Call a page-donating executable (its last two outputs are the
@@ -1501,18 +1511,19 @@ class ServingEngine:
     def _step_impl(self) -> bool:                     # graftlint: hot
         jnp = self._jnp
         tel = self.telemetry
-        t_s0 = tel.clock() if tel is not None else 0.0
+        t_s0 = tel.sched_begin() if tel is not None else 0.0
         self._step_seq += 1
         self._pressure = fault_point("serve.pool_pressure",
                                      step=self.steps_run) is not None
         self._retire_overdue()
         self._admit()
         if tel is not None:
-            # host scheduling phase: deadline sweep + admissions (incl.
-            # any dense admission prefills, which also get their own
-            # prefill_dense spans) — the host-side cost the host-loop
-            # overlap refactor (ROADMAP item 5) needs on the record
-            tel.phase("sched", t_s0, tel.clock())
+            # host scheduling phase: deadline sweep + admissions — the
+            # host-side cost the host-loop overlap refactor (ROADMAP item
+            # 5) needs on the record.  Admission prefill dispatches run
+            # inside this window but record their own spans; sched_done
+            # subtracts them so the utilization buckets stay disjoint
+            tel.sched_done(t_s0, tel.clock())
         # chunked prefill: each mid-prefill slot advances ONE chunk per
         # step, interleaved with the decode horizon below — a long prompt
         # never head-of-line blocks the running decodes or short arrivals.

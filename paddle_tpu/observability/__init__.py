@@ -14,15 +14,27 @@ Three pieces, one clock:
     automatically on stalls, recompile-budget failures, preemption
     storms, and injected faults.
 
-:class:`.telemetry.Telemetry` bundles all three for the serving engine:
-``ServingEngine(..., telemetry=True)``.  Telemetry off (the default) is a
-no-op fast path — one flag check per hook site, zero per-token work."""
+:class:`.telemetry.Telemetry` bundles all three for the serving engine
+(``ServingEngine(..., telemetry=True)``) and adds the ISSUE 7
+observatory: host/device step decomposition
+(:meth:`~.telemetry.Telemetry.utilization_report`), the per-step PagePool
+memory series (``mem.pool`` :class:`~.metrics.GaugeSeries`, ramp-embedded
+in flight dumps, Perfetto counter tracks), and jit-compile accounting
+(``engine.compile_s``).  :class:`.train.TrainTelemetry` is the same bundle
+shaped for the training loop (``TrainStep`` / ``Model.fit`` /
+``CheckpointManager``: step/data/compute timing, checkpoint spans,
+nonfinite + torn-snapshot flight events with FaultPlan context).
+Telemetry off (the default) is a no-op fast path — one flag check per
+hook site, zero per-token work."""
 from .flight import FlightRecorder
-from .metrics import Counter, EngineStats, Gauge, Histogram, MetricsRegistry
+from .metrics import (Counter, EngineStats, Gauge, GaugeSeries, Histogram,
+                      MetricsRegistry)
 from .slo import latency_percentiles, slo_report
 from .telemetry import Telemetry
 from .tracing import RequestTrace, Tracer
+from .train import TrainTelemetry, fault_context
 
-__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "EngineStats",
-           "Tracer", "RequestTrace", "FlightRecorder", "Telemetry",
+__all__ = ["Counter", "Gauge", "GaugeSeries", "Histogram", "MetricsRegistry",
+           "EngineStats", "Tracer", "RequestTrace", "FlightRecorder",
+           "Telemetry", "TrainTelemetry", "fault_context",
            "latency_percentiles", "slo_report"]

@@ -23,10 +23,13 @@ implementation behind all of it:
 from __future__ import annotations
 
 import math
+import numbers
 import time
+from collections import deque
 from collections.abc import Mapping
 
-__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "EngineStats"]
+__all__ = ["Counter", "Gauge", "GaugeSeries", "Histogram", "MetricsRegistry",
+           "EngineStats"]
 
 
 class Counter:
@@ -62,6 +65,81 @@ class Gauge:
 
     def to_value(self):
         return self.value
+
+
+class GaugeSeries:
+    """Bounded time series of gauge rows — the memory observatory appends
+    one row per engine step, so the flight recorder can show the
+    occupancy RAMP that led to a pool-pressure event, not just the final
+    value.  Each row is ``{"seq", "t", **fields}`` with ``seq`` strictly
+    increasing (sample order) and ``t`` from the caller's clock; the ring
+    holds the last ``capacity`` rows.  Values are normalized to plain
+    python ints/floats so rows serialize straight into flight-dump JSON."""
+
+    __slots__ = ("name", "capacity", "_rows", "_seq")
+
+    def __init__(self, name: str, capacity: int = 4096):
+        if capacity <= 0:
+            raise ValueError("capacity must be positive")
+        self.name = name
+        self.capacity = int(capacity)
+        self._rows: deque = deque(maxlen=self.capacity)
+        self._seq = 0
+
+    def __len__(self):
+        return len(self._rows)
+
+    @property
+    def total_samples(self) -> int:
+        """Samples ever taken (>= len(self): the ring drops the oldest)."""
+        return self._seq
+
+    def sample(self, t: float, **fields) -> dict:
+        """Append one row; returns it (already normalized)."""
+        self._seq += 1
+        row = {"seq": self._seq, "t": float(t)}
+        for k, v in fields.items():
+            if isinstance(v, bool) or v is None:
+                row[k] = v
+            elif isinstance(v, numbers.Integral):
+                row[k] = int(v)
+            elif isinstance(v, numbers.Real):
+                row[k] = float(v)
+            else:
+                row[k] = v
+        self._rows.append(row)
+        return row
+
+    def rows(self) -> list[dict]:
+        return list(self._rows)
+
+    def tail(self, n: int) -> list[dict]:
+        """The most recent n rows (the ramp a flight dump embeds)."""
+        if n <= 0:
+            return []
+        return list(self._rows)[-n:]
+
+    @property
+    def last(self) -> dict | None:
+        return self._rows[-1] if self._rows else None
+
+    def reset(self):
+        """Drop the rows (a measurement-window boundary); ``seq`` keeps
+        counting so sample order stays globally monotonic across windows."""
+        self._rows.clear()
+
+    def field_minmax(self, field: str) -> tuple[float, float] | None:
+        """(min, max) of a numeric field over the retained rows."""
+        vals = [r[field] for r in self._rows
+                if isinstance(r.get(field), (int, float))
+                and not isinstance(r.get(field), bool)]
+        if not vals:
+            return None
+        return min(vals), max(vals)
+
+    def to_value(self) -> dict:
+        return {"count": len(self._rows), "total_samples": self._seq,
+                "last": self.last}
 
 
 class Histogram:
@@ -211,6 +289,9 @@ class MetricsRegistry:
     def histogram(self, name: str, **kw) -> Histogram:
         return self._get(name, Histogram, **kw)
 
+    def series(self, name: str, **kw) -> GaugeSeries:
+        return self._get(name, GaugeSeries, **kw)
+
     def names(self):
         return sorted(self._metrics)
 
@@ -220,8 +301,11 @@ class MetricsRegistry:
     def snapshot(self) -> dict:
         """{metric name: value} — ints for counters, floats for gauges,
         a stats dict (count/sum/min/max/p50/p95/p99) for histograms; plus
-        the snapshot clock under ``"at"``."""
-        out = {name: m.to_value() for name, m in sorted(self._metrics.items())}
+        the snapshot clock under ``"at"``.  The items are copied before
+        sorting so a metric registered concurrently (e.g. an async
+        checkpoint writer's phase report) cannot tear the iteration."""
+        out = {name: m.to_value()
+               for name, m in sorted(list(self._metrics.items()))}
         out["at"] = float(self.clock())
         return out
 

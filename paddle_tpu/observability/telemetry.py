@@ -13,14 +13,23 @@ Metric catalog (README §Observability):
 
   histograms (seconds): ``serve.ttft_s``, ``serve.tpot_s``,
     ``serve.queue_s``, ``serve.prefill_s``, ``serve.e2e_s``,
-    ``engine.step_host_s``, ``engine.phase.<name>_s`` for phases
+    ``engine.step_host_s``, ``engine.compile_s`` (per compile-cache miss:
+    compile + first run), ``engine.phase.<name>_s`` for phases
     ``sched`` (retire+admit host work), ``prefill_chunk``,
     ``decode_dispatch`` / ``decode_sync`` / ``decode_record``,
     ``verify_dispatch`` / ``verify_sync`` / ``verify_record``
   counters: ``serve.requests_submitted``, ``serve.requests_retired``,
     ``serve.requests_timed_out``, ``serve.rejections``,
     ``serve.preemptions``, ``serve.cache_evictions``, ``serve.cow_copies``,
-    ``serve.flight_dumps``
+    ``serve.flight_dumps``, ``engine.compiles``
+  gauges + series: ``mem.pool_free_pages``, ``mem.pool_occupancy_frac``,
+    ``mem.fragmentation_frac``, ``mem.cache_page_refs``,
+    ``mem.queue_depth`` (last value), and ``mem.pool`` — the per-step
+    memory-observatory :class:`~.metrics.GaugeSeries` whose tail rides
+    every flight dump as the occupancy ramp
+  derived reports: :meth:`Telemetry.utilization_report` (host / dispatch /
+    device-wait / gap step decomposition), :meth:`Telemetry.memory_report`,
+    :meth:`Telemetry.compile_report`
 
 Flight-recorder event ladder (the degradation-ladder events land in the
 ring in the order the engine walks the rungs): ``submit`` -> ``admit`` ->
@@ -53,7 +62,8 @@ class Telemetry:
     def __init__(self, clock=time.perf_counter, flight_capacity: int = 256,
                  flight_dump_path: str | None = None,
                  storm_threshold: int = 4, storm_window: int = 32,
-                 profiler_bridge: bool = False, max_completed: int = 4096):
+                 profiler_bridge: bool = False, max_completed: int = 4096,
+                 mem_series_capacity: int = 4096, mem_ramp_events: int = 64):
         self.clock = clock
         self.registry = MetricsRegistry(clock=clock)
         self.tracer = Tracer(clock=clock, bridge=profiler_bridge,
@@ -82,6 +92,27 @@ class Telemetry:
         self._c_evictions = r.counter("serve.cache_evictions")
         self._c_cow = r.counter("serve.cow_copies")
         self._c_dumps = r.counter("serve.flight_dumps")
+        # compile accounting: fed by the analysis.sanitize instrumentation
+        # that already wraps every engine executable (engine `_jit()` passes
+        # `on_miss=` through to `instrument`) — a compile-cache miss lands
+        # here with its wall cost, so the artifact shows WHERE warm-up time
+        # went and a steady-state miss is visible in the flight record
+        self._h_compile = r.histogram("engine.compile_s")
+        self._c_compiles = r.counter("engine.compiles")
+        self._compiles: dict[str, dict] = {}
+        # memory observatory: one GaugeSeries row per engine step (pool
+        # occupancy / fragmentation / cache / queue), sampled at the step's
+        # END — an existing host boundary, no device sync; flight dumps
+        # embed the tail of this series as the occupancy RAMP
+        self.memory = r.series("mem.pool", capacity=mem_series_capacity)
+        self.mem_ramp_events = int(mem_ramp_events)
+        self._g_free = r.gauge("mem.pool_free_pages")
+        self._g_occ = r.gauge("mem.pool_occupancy_frac")
+        self._g_frag = r.gauge("mem.fragmentation_frac")
+        self._g_cache = r.gauge("mem.cache_page_refs")
+        self._g_queue = r.gauge("mem.queue_depth")
+        self._device = None      # lazy jax device handle; False = no stats
+        self._nested_dispatch_s = 0.0   # dispatch time inside a sched span
 
     # -- low-level ---------------------------------------------------------
     def phase(self, name: str, t0: float, t1: float, **attrs):
@@ -91,6 +122,30 @@ class Telemetry:
             self._phase_h[name] = h
         h.observe(t1 - t0)
         self.tracer.engine_span(name, t0, t1, **attrs)
+
+    def sched_begin(self) -> float:
+        """Start of a step's scheduling window (deadline sweep +
+        admissions); returns the start timestamp.  Admission can run
+        prefill DISPATCHES inside this window — they record their own
+        phase spans and accumulate into ``_nested_dispatch_s``, which
+        :meth:`sched_done` subtracts so the ``sched`` histogram holds pure
+        host scheduling time and the utilization buckets stay DISJOINT
+        (no second-counted seconds)."""
+        self._nested_dispatch_s = 0.0
+        return self.clock()
+
+    def sched_done(self, t0: float, t1: float):
+        nested = self._nested_dispatch_s
+        self._nested_dispatch_s = 0.0
+        h = self._phase_h.get("sched")
+        if h is None:
+            h = self.registry.histogram("engine.phase.sched_s")
+            self._phase_h["sched"] = h
+        h.observe(max(0.0, (t1 - t0) - nested))
+        # the trace span keeps the full wall extent (visual truth: nested
+        # prefill spans draw inside it on the engine track)
+        self.tracer.engine_span("sched", t0, t1,
+                                nested_dispatch_s=round(nested, 6))
 
     def bridge_begin(self, name: str):
         """Enter a ``paddle_tpu.profiler.host_annotation`` span (bridge on
@@ -116,7 +171,133 @@ class Telemetry:
 
     def _dump(self, reason: str, **extra) -> dict:
         self._c_dumps.inc()
+        ramp = self.memory.tail(self.mem_ramp_events)
+        if ramp:
+            # the occupancy ramp that led here — a pool-pressure postmortem
+            # needs the trajectory, not just the final free-page count
+            extra = dict(extra)
+            extra["memory_ramp"] = ramp
         return self.flight.dump(reason, **extra)
+
+    # -- compile accounting ------------------------------------------------
+    def compiled(self, name: str, n: int, dur_s: float):
+        """One jit compile-cache miss (from the `analysis.sanitize`
+        instrumentation wrapping the engine's `_jit()` executables):
+        `n` new variants for model fn `name`, costing `dur_s` wall seconds
+        (compile + first execution — what the miss cost the caller)."""
+        self._c_compiles.inc(n)
+        self._h_compile.observe(dur_s)
+        e = self._compiles.setdefault(name, {"count": 0, "total_s": 0.0})
+        e["count"] += n
+        e["total_s"] += dur_s
+        self.flight.record("compile", fn=name, variants=n,
+                           dur_s=round(dur_s, 6))
+
+    def compile_report(self) -> dict:
+        """Cumulative per-fn compile counts/durations (engine lifetime —
+        deliberately NOT window-scoped: warm-up compiles are the bulk and
+        a timed-window miss shows up in `jit_cache_misses` deltas)."""
+        return {
+            "total_compiles": self._c_compiles.value,
+            "compile_s_total": round(self._h_compile.total, 6),
+            "compile_s_max": round(self._h_compile.max, 6)
+            if self._h_compile.count else 0.0,
+            "per_fn": {k: {"count": v["count"],
+                           "total_s": round(v["total_s"], 6)}
+                       for k, v in sorted(self._compiles.items())},
+        }
+
+    # -- memory observatory ------------------------------------------------
+    def _device_bytes(self):
+        """Live device-buffer bytes via jax device memory stats, or None
+        where the backend exposes none (CPU).  The device handle resolves
+        once; an unsupported backend short-circuits forever after."""
+        if self._device is False:
+            return None
+        if self._device is None:
+            try:
+                import jax
+                self._device = jax.local_devices()[0]
+            except Exception:
+                self._device = False
+                return None
+        try:
+            st = self._device.memory_stats()
+        except Exception:
+            st = None
+        if not st:
+            self._device = False
+            return None
+        return int(st.get("bytes_in_use", 0))
+
+    def sample_memory(self, engine):
+        """One memory-observatory row at an engine-step end (host state
+        reads only — the pool/cache/queue live on the host, and the jax
+        memory-stats call is a runtime query, not a device sync)."""
+        t = self.clock()
+        pool = engine.pool
+        total = pool.num_pages
+        free = pool.num_free
+        cache = engine.cache
+        cache_refs = len(cache) if cache is not None else 0
+        slot_pages = 0
+        slot_tokens = 0
+        for s, slot in enumerate(engine._slots):
+            if slot is not None:
+                slot_pages += len(slot.pages)
+                slot_tokens += int(engine._lengths[s])
+        # internal fragmentation: token capacity the live page tables hold
+        # but no sequence fills (tail-of-page waste) — pages are fixed-size
+        # so this, not external fragmentation, is the waste axis
+        frag = 1.0 - slot_tokens / (slot_pages * pool.page_size) \
+            if slot_pages else 0.0
+        occ = (total - free) / total
+        fields = dict(
+            step=engine._step_seq, total_pages=total, free_pages=free,
+            allocated_pages=pool.num_allocated,
+            referenced=pool.num_referenced, cache_page_refs=cache_refs,
+            occupancy_frac=round(occ, 4),
+            fragmentation_frac=round(frag, 4), slot_tokens=slot_tokens,
+            queue_depth=len(engine._queue), active=engine.num_active)
+        dev = self._device_bytes()
+        if dev is not None:
+            fields["device_bytes_in_use"] = dev
+        self.memory.sample(t, **fields)
+        self._g_free.set(free)
+        self._g_occ.set(occ)
+        self._g_frag.set(frag)
+        self._g_cache.set(cache_refs)
+        self._g_queue.set(len(engine._queue))
+        # Perfetto counter tracks next to the PR 6 request spans
+        self.tracer.counter("pagepool.pages", t, used=total - free,
+                            free=free, cached=cache_refs)
+        self.tracer.counter("engine.load", t, queue_depth=len(engine._queue),
+                            active=engine.num_active)
+
+    def memory_report(self, engine_stats: dict | None = None) -> dict:
+        """Memory-observatory summary over the retained series (the
+        current measurement window after `reset_window()`): last sample,
+        occupancy/fragmentation peaks, free-page floor — plus prefix-cache
+        hit accounting when the engine's `stats()` dict is passed."""
+        rows = self.memory.rows()
+        rep = {"samples": len(rows),
+               "total_samples": self.memory.total_samples,
+               "last": rows[-1] if rows else None}
+        for key, field, fn in (("peak_occupancy_frac", "occupancy_frac", max),
+                               ("peak_fragmentation_frac",
+                                "fragmentation_frac", max),
+                               ("min_free_pages", "free_pages", min)):
+            mm = self.memory.field_minmax(field)
+            rep[key] = (mm[1] if fn is max else mm[0]) if mm else None
+        if engine_stats is not None:
+            hit = int(engine_stats.get("cached_prefix_tokens", 0))
+            run = int(engine_stats.get("prefill_tokens_executed", 0))
+            rep["prefix_cache"] = {
+                "hit_tokens": hit, "executed_tokens": run,
+                "hit_rate": round(hit / (hit + run), 4) if hit + run else 0.0,
+                "evictions": int(engine_stats.get("cache_evictions", 0)),
+            }
+        return rep
 
     # -- engine lifecycle hooks --------------------------------------------
     def submitted(self, req, queue_depth: int):
@@ -162,6 +343,7 @@ class Telemetry:
         the chunked/suffix path, ``prefill_dense`` for the fused
         whole-prompt prefill+sample)."""
         t1 = self.clock()
+        self._nested_dispatch_s += t1 - t0
         self.phase(kind, t0, t1, rid=rid, tokens=tokens)
         self.tracer.request_event(rid, kind, t=t1, pos=pos,
                                   tokens=tokens, dur=t1 - t0)
@@ -244,6 +426,9 @@ class Telemetry:
         self.tracer.engine_span("step", t0, t1,
                                 step=engine._step_seq,
                                 progressed=progressed, tokens=tokens)
+        # memory observatory sample BEFORE the step/fault records, so a
+        # pool-pressure dump's ramp already includes this step's occupancy
+        self.sample_memory(engine)
         self.flight.record("step", step=engine._step_seq,
                            progressed=progressed, tokens=tokens,
                            active=engine.num_active,
@@ -260,18 +445,75 @@ class Telemetry:
 
     def reset_window(self):
         """Start a fresh measurement window: clear the per-request SLO
-        summaries and reset the latency histograms (step/phase/request),
-        so `slo_report` and the histogram snapshots describe the window —
-        not the warm-up compiles that preceded it.  Counters and the
-        tracer/flight record stay cumulative (they are event history, not
-        window statistics)."""
+        summaries and reset the latency histograms (step/phase/request)
+        and the memory series, so `slo_report`, `utilization_report`,
+        `memory_report`, and the histogram snapshots describe the window —
+        not the warm-up compiles that preceded it.  Counters, the compile
+        record, and the tracer/flight record stay cumulative (they are
+        event history, not window statistics)."""
         self.request_summaries.clear()
         for h in (self._h_ttft, self._h_tpot, self._h_queue,
                   self._h_prefill, self._h_e2e, self._h_step,
                   *self._phase_h.values()):
             h.reset()
+        self.memory.reset()
 
     # -- readouts ----------------------------------------------------------
+    def utilization_report(self, window_s: float | None = None) -> dict:
+        """Host/device step decomposition over the current measurement
+        window — the overlap-headroom readout ROADMAP item 5 is gated on.
+
+        Every engine phase histogram (host timestamps at the EXISTING
+        sync boundaries only) lands in one of three buckets:
+
+          * ``host_busy_s`` — pure host scheduling/bookkeeping (``sched``,
+            ``*_record``): the device has nothing to run that this engine
+            dispatched;
+          * ``dispatch_s`` — time inside dispatch calls (``*_dispatch``,
+            ``prefill_*``): enqueue cost on an async backend, enqueue +
+            execution where dispatch blocks (CPU jax) — the fused
+            prefills' execution is inseparable from their dispatch at
+            this layer, so it is counted here, honestly over- rather
+            than under-stating device busyness;
+          * ``device_wait_s`` — host blocked fetching results at the
+            annotated sync points (``*_sync``): the only bucket where the
+            device is PROVABLY the bottleneck.
+
+        With ``window_s`` (the measured wall clock), ``gap_s`` is the
+        unaccounted remainder (inter-step host work, bench bookkeeping)
+        and ``device_idle_frac_est`` = (host_busy + gap) / window — the
+        fraction of the window the device provably had nothing dispatched
+        to run, i.e. the headroom a double-buffered host loop (ROADMAP
+        item 5) could reclaim."""
+        host = disp = wait = 0.0
+        per_phase = {}
+        for name in sorted(self._phase_h):
+            h = self._phase_h[name]
+            per_phase[name] = {"total_s": round(h.total, 6),
+                               "count": h.count}
+            if name.endswith("_sync"):
+                wait += h.total
+            elif name.endswith("_dispatch") or name.startswith("prefill"):
+                disp += h.total
+            else:
+                host += h.total
+        rep = {"steps": self._h_step.count,
+               "step_host_s_total": round(self._h_step.total, 6),
+               "host_busy_s": round(host, 6),
+               "dispatch_s": round(disp, 6),
+               "device_wait_s": round(wait, 6),
+               "per_phase": per_phase}
+        if window_s is not None and window_s > 0:
+            gap = max(0.0, window_s - (host + disp + wait))
+            rep["window_s"] = round(float(window_s), 6)
+            rep["gap_s"] = round(gap, 6)
+            rep["host_busy_frac"] = round(host / window_s, 4)
+            rep["dispatch_frac"] = round(disp / window_s, 4)
+            rep["device_wait_frac"] = round(wait / window_s, 4)
+            rep["gap_frac"] = round(gap / window_s, 4)
+            rep["device_idle_frac_est"] = round((host + gap) / window_s, 4)
+        return rep
+
     def snapshot(self, engine_stats: dict | None = None) -> dict:
         """Full metrics snapshot; when the engine's ``stats()`` dict is
         passed, its counters fold in under ``engine.*`` so one artifact

@@ -78,13 +78,18 @@ class Tracer:
     ``engine`` track."""
 
     def __init__(self, clock=time.perf_counter, bridge: bool = False,
-                 max_completed: int = 1024, max_engine_events: int = 8192):
+                 max_completed: int = 1024, max_engine_events: int = 8192,
+                 max_counter_events: int = 8192):
         self.clock = clock
         self.bridge = bool(bridge)
         self._live: dict[int, RequestTrace] = {}
         self._done: deque[RequestTrace] = deque(maxlen=max_completed)
         # (name, t0, t1 | None for instants, attrs)
         self._engine: deque[tuple] = deque(maxlen=max_engine_events)
+        # (track name, t, {series: value}) — exported as Chrome counter
+        # events ("ph": "C"), which Perfetto renders as counter TRACKS
+        # (PagePool occupancy, queue depth) alongside the request spans
+        self._counters: deque[tuple] = deque(maxlen=max_counter_events)
 
     # -- recording ---------------------------------------------------------
     def request_event(self, rid: int, name: str, t: float | None = None,
@@ -103,6 +108,16 @@ class Tracer:
     def engine_event(self, name: str, t: float | None = None, **attrs):
         self._engine.append((name, self.clock() if t is None else t, None,
                              attrs or None))
+
+    def counter(self, track: str, t: float | None = None, **values):
+        """One sample on a named counter track: every keyword becomes a
+        series inside the track (Perfetto stacks them).  Values must be
+        numbers."""
+        self._counters.append((track, self.clock() if t is None else t,
+                               {k: float(v) for k, v in values.items()}))
+
+    def counter_events(self) -> list[tuple]:
+        return list(self._counters)
 
     def annotation(self, name: str):
         """Context manager for the profiler bridge: when ``bridge`` is on,
@@ -209,6 +224,10 @@ class Tracer:
             if attrs:
                 ev["args"] = dict(attrs)
             events.append(ev)
+        for track, t, values in self._counters:
+            events.append({"name": track, "cat": "gauge", "ph": "C",
+                           "pid": 0, "ts": round(t * us, 3),
+                           "args": dict(values)})
         for tr in self.traces():
             events.append({"name": "thread_name", "ph": "M", "pid": 0,
                            "tid": tr.rid + 1,

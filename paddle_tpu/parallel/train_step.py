@@ -16,6 +16,7 @@ import jax.numpy as jnp
 from ..core.tensor import Tensor
 from ..core import random as random_mod
 from ..nn.layer import Layer, functional_state
+from ..observability.train import batch_samples
 from ..optimizer.optimizer import Optimizer
 from ..optimizer.lr import LRScheduler
 
@@ -42,10 +43,18 @@ class TrainStep:
     def __init__(self, model: Layer, opt: Optimizer, loss_fn: Callable,
                  donate: bool = True, in_shardings=None, with_amp=False,
                  amp_dtype="bfloat16", grad_accum: int = 1,
-                 nonfinite_guard: Optional[int] = None, scaler=None):
+                 nonfinite_guard: Optional[int] = None, scaler=None,
+                 telemetry=None):
         self.model = model
         self.opt = opt
         self.loss_fn = loss_fn
+        # observability.TrainTelemetry (or None = off): host-side step
+        # timing + nonfinite/backoff counters + flight events.  Hooks fire
+        # only at points the loop already stands on the host (after the
+        # guard's flag fetch); without the guard the recorded step time is
+        # dispatch wall time (the call is async).  Numerics are untouched
+        # either way.
+        self.telemetry = telemetry
         self.with_amp = with_amp
         self.amp_dtype = amp_dtype
         if grad_accum < 1:
@@ -164,6 +173,8 @@ class TrainStep:
 
     def __call__(self, *batch):
         from ..resilience.faults import fault_point
+        tel = self.telemetry
+        t0 = tel.clock() if tel is not None else 0.0
         vals = [b._value if isinstance(b, Tensor) else jnp.asarray(b) for b in batch]
         self._rng, sub = jax.random.split(self._rng)
         lr = jnp.asarray(self.opt.get_lr(), jnp.float32)
@@ -176,8 +187,15 @@ class TrainStep:
         self.step_count += 1
         if self.nonfinite_guard is None:
             self.opt.finish_step()
+            if tel is not None:
+                tel.step(tel.clock() - t0, samples=batch_samples(vals))
         else:
             self.last_step_good = bool(good)
+            if tel is not None:
+                # the guard's flag fetch above IS a device sync, so this
+                # step time is real device latency, not dispatch time
+                tel.step(tel.clock() - t0, samples=batch_samples(vals),
+                         good=self.last_step_good)
             if self.last_step_good:
                 # finish_step (LR-schedule tick / global step) only on REAL
                 # progress — a skipped step must leave schedule state
@@ -189,9 +207,28 @@ class TrainStep:
             else:
                 self.skipped_steps += 1
                 self.consecutive_bad += 1
+                if tel is not None:
+                    # resilience on the record: the skip + the fault plan
+                    # that (possibly) injected it, for chaos postmortems
+                    tel.nonfinite_skip(self.step_count - 1,
+                                       self.consecutive_bad)
                 if self.scaler is not None:
+                    # count only ACTUAL backoffs: notify_nonfinite tallies
+                    # the bad step but only decays the scale every
+                    # decr_every_n_nan_or_inf-th one (_scale is a host
+                    # float — the compare costs nothing)
+                    scale_before = self.scaler._scale
                     self.scaler.notify_nonfinite()
+                    if tel is not None \
+                            and self.scaler._scale != scale_before:
+                        tel.scaler_backoff(self.step_count - 1)
                 if self.consecutive_bad >= self.nonfinite_guard:
+                    if tel is not None:
+                        # auto-dump the flight ring BEFORE the raise — the
+                        # diverged-run postmortem artifact
+                        tel.nonfinite_raise(self.step_count - 1,
+                                            self.consecutive_bad,
+                                            self.skipped_steps)
                     raise FloatingPointError(
                         f"non-finite loss/gradients for "
                         f"{self.consecutive_bad} consecutive steps (step "

@@ -67,7 +67,8 @@ class CheckpointManager:
     """
 
     def __init__(self, root, model=None, optimizer=None, lr_scheduler=None,
-                 scaler=None, save_interval: int = 1, keep_last: int | None = 3):
+                 scaler=None, save_interval: int = 1, keep_last: int | None = 3,
+                 telemetry=None):
         if save_interval < 1:
             raise ValueError("save_interval must be >= 1")
         if keep_last is not None and keep_last < 1:
@@ -80,6 +81,11 @@ class CheckpointManager:
         self.save_interval = int(save_interval)
         self.keep_last = keep_last
         self.last_extra = None
+        # observability.TrainTelemetry (or None = off): ckpt.save /
+        # ckpt.stage / ckpt.commit / ckpt.restore spans + flight events,
+        # and torn-snapshot rejections recorded with the active FaultPlan
+        # context (the chaos-sweep postmortem trail)
+        self.telemetry = telemetry
         os.makedirs(self.root, exist_ok=True)
 
     # -- discovery ---------------------------------------------------------
@@ -105,14 +111,19 @@ class CheckpointManager:
     def find_latest_complete(self):
         """Newest snapshot passing manifest verification, or None.  Torn or
         corrupt snapshots (killed mid-write, bit-flipped files) are skipped —
-        resume always lands on the previous intact checkpoint."""
+        resume always lands on the previous intact checkpoint.  Each
+        rejection is a telemetry flight event (with the active fault-plan
+        context), so a resume that silently skipped a snapshot leaves a
+        postmortem trail saying which one and why."""
         from ..distributed.checkpoint import (verify_checkpoint,
                                               CheckpointCorruptError)
         for _, path in reversed(self._step_dirs()):
             try:
                 verify_checkpoint(path)
                 return path
-            except CheckpointCorruptError:
+            except CheckpointCorruptError as e:
+                if self.telemetry is not None:
+                    self.telemetry.torn_snapshot(path, e)
                 continue
         return None
 
@@ -147,9 +158,17 @@ class CheckpointManager:
 
     def wait(self):
         """Drain pending async saves, re-raising the first writer/commit
-        failure — call at job milestones and before relying on a snapshot."""
+        failure — call at job milestones and before relying on a snapshot.
+        A surfaced background failure is recorded to telemetry first (the
+        launching ``ckpt.save`` span already closed ok=True — async spans
+        measure launch, durability is confirmed here)."""
         from ..distributed.checkpoint import wait_async_save
-        wait_async_save()
+        try:
+            wait_async_save()
+        except BaseException as e:
+            if self.telemetry is not None:
+                self.telemetry.async_save_failed(e)
+            raise
 
     def save(self, step: int, extra_state=None, async_save=False):
         """Write one crash-consistent snapshot for ``step`` and rotate.
@@ -157,7 +176,27 @@ class CheckpointManager:
         Entry first drains any pending async save (pipelined: at most one in
         flight), so a failed background write surfaces HERE instead of
         rotting silently in a thread — training must not believe a
-        checkpoint exists when its writer died."""
+        checkpoint exists when its writer died.
+
+        With telemetry attached, the whole save gets a ``ckpt.save`` span
+        and the writer reports its stage/commit sub-phase durations
+        (``ckpt.stage_s`` / ``ckpt.commit_s``) via
+        ``save_state_dict(on_phase=...)``.  Async caveat: with
+        ``async_save=True`` the span (and the ``ckpt.saves`` count) covers
+        launch + snapshot capture only — durability is confirmed at the
+        next :meth:`wait`/:meth:`save` entry, where a background failure
+        records a ``ckpt.async_save_failed`` flight event before
+        re-raising."""
+        tel = self.telemetry
+        if tel is None:
+            return self._save_impl(step, extra_state, async_save, None)
+        with tel.span("ckpt.save", step=int(step), async_save=async_save):
+            path = self._save_impl(step, extra_state, async_save,
+                                   tel.phase_event)
+        tel.saved(int(step), path)
+        return path
+
+    def _save_impl(self, step, extra_state, async_save, on_phase):
         from ..distributed.checkpoint import save_state_dict
         from ..core.random import get_rng_state
         from ..optimizer.lr import LRScheduler
@@ -180,7 +219,8 @@ class CheckpointManager:
         if extra_state is not None:
             state["extra"] = extra_state
         path = os.path.join(self.root, f"step_{step:08d}")
-        save_state_dict(state, path, async_save=async_save)
+        save_state_dict(state, path, async_save=async_save,
+                        on_phase=on_phase)
         self._rotate()
         return path
 
@@ -211,6 +251,16 @@ class CheckpointManager:
         """Load ``path`` (default: :meth:`find_latest_complete`) back into the
         attached objects; returns the restored step, or None when no intact
         snapshot exists (fresh start)."""
+        tel = self.telemetry
+        if tel is None:
+            return self._restore_impl(path)
+        with tel.span("ckpt.restore"):
+            step = self._restore_impl(path)
+        if step is not None:
+            tel.restored(step, str(path) if path is not None else "")
+        return step
+
+    def _restore_impl(self, path=None) -> int | None:
         from ..distributed.checkpoint import load_state_dict, verify_checkpoint
         from ..core.random import get_rng_state, set_rng_state
         self.wait()  # never restore around an in-flight async save

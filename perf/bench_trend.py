@@ -1,0 +1,175 @@
+#!/usr/bin/env python
+"""Cross-PR bench trajectory (`make bench-trend`): read every
+``BENCH_r*.json`` artifact the driver stores at the repo root, print the
+headline tokens/s + serving TTFT-p95 + goodput trajectory across PRs, and
+FAIL on artifact schema drift.
+
+Each round's artifact wraps one TPU `python bench.py` run as
+``{"n": round, "cmd": ..., "rc": exit status, "tail": ..., "parsed":
+<bench JSON>}``; ``parsed`` grows keys as PRs add benchmarks but must
+always carry the headline ``metric``/``value``/``unit`` triple.  Serving
+numbers (TTFT p95, goodput fraction, serving tokens/s) appear once a
+round's artifact embeds a serving-trace section — earlier rounds print
+``-`` for those columns; a LATER round silently losing them is drift and
+fails the gate, as does any artifact missing the base schema or recording
+a non-zero bench exit.
+
+Exit status: 0 when every artifact passes, 1 on any drift."""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+_ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+# the wrapper keys every round's artifact must carry
+BASE_KEYS = ("n", "cmd", "rc", "parsed")
+# the headline triple every parsed bench payload must carry
+PARSED_KEYS = ("metric", "value", "unit")
+# a serving-trace section is recognized by carrying ALL of these
+SERVING_KEYS = ("ttft_p95_ms", "goodput_fraction")
+
+
+def find_artifacts(root: str) -> list[tuple[int, str]]:
+    out = []
+    for p in glob.glob(os.path.join(root, "BENCH_r*.json")):
+        m = _ROUND_RE.search(os.path.basename(p))
+        if m:
+            out.append((int(m.group(1)), p))
+    return sorted(out)
+
+
+def validate(art, path: str) -> list[str]:
+    """Schema-drift problems for one artifact (empty == OK)."""
+    problems = []
+    if not isinstance(art, dict):
+        return [f"{path}: artifact is not a JSON object"]
+    for k in BASE_KEYS:
+        if k not in art:
+            problems.append(f"{path}: missing wrapper key {k!r}")
+    if not isinstance(art.get("parsed"), dict):
+        problems.append(f"{path}: 'parsed' is not the bench JSON object")
+        return problems
+    if art.get("rc", 0) != 0:
+        problems.append(f"{path}: bench run recorded rc={art['rc']}")
+    parsed = art["parsed"]
+    for k in PARSED_KEYS:
+        if k not in parsed:
+            problems.append(f"{path}: parsed missing headline key {k!r}")
+    v = parsed.get("value")
+    if v is not None and not isinstance(v, (int, float)):
+        problems.append(f"{path}: parsed 'value' is not a number ({v!r})")
+    return problems
+
+
+def find_serving_section(d) -> dict | None:
+    """First (depth-first) dict carrying the serving TTFT/goodput keys —
+    wherever a round's artifact nests its serving-trace section."""
+    if isinstance(d, dict):
+        if all(k in d for k in SERVING_KEYS):
+            return d
+        for v in d.values():
+            hit = find_serving_section(v)
+            if hit is not None:
+                return hit
+    elif isinstance(d, list):
+        for v in d:
+            hit = find_serving_section(v)
+            if hit is not None:
+                return hit
+    return None
+
+
+def _fmt(v, nd=1):
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.{nd}f}"
+    return str(v)
+
+
+def trend(root: str = ".", verbose: bool = True) -> int:
+    arts = find_artifacts(root)
+    if not arts:
+        print(f"bench-trend: no BENCH_r*.json artifacts under {root!r}")
+        return 1
+    problems: list[str] = []
+    rows = []
+    prev_serving = False
+    for rnd, path in arts:
+        try:
+            with open(path) as f:
+                art = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            problems.append(f"{path}: unreadable ({e})")
+            continue
+        problems.extend(validate(art, path))
+        parsed = art.get("parsed") if isinstance(art, dict) else None
+        parsed = parsed if isinstance(parsed, dict) else {}
+        serving = find_serving_section(parsed)
+        if serving is None and prev_serving:
+            # a later artifact LOSING its serving section is schema drift,
+            # not an "older layout" — the trajectory must not silently
+            # truncate
+            problems.append(f"{path}: serving section (ttft_p95_ms + "
+                            f"goodput_fraction) present in an earlier round "
+                            f"but missing here")
+        prev_serving = prev_serving or serving is not None
+        rows.append({
+            "round": rnd,
+            "metric": parsed.get("metric"),
+            "value": parsed.get("value"),
+            "unit": parsed.get("unit"),
+            "vs_baseline": parsed.get("vs_baseline"),
+            # explicit None-chaining: a recorded 0.0 tokens/s is a real
+            # (alarming) data point, not a missing field
+            "serving_tps": next(
+                (v for v in ((serving or {}).get("tokens_per_sec"),
+                             (serving or {}).get("serving_tokens_per_sec"))
+                 if v is not None), None),
+            "ttft_p95_ms": (serving or {}).get("ttft_p95_ms"),
+            "goodput": (serving or {}).get("goodput_fraction"),
+        })
+    if verbose:
+        hdr = (f"{'round':>5}  {'tokens/s':>10}  {'vs_base':>8}  "
+               f"{'serve tok/s':>11}  {'ttft_p95_ms':>11}  {'goodput':>7}")
+        print(hdr)
+        print("-" * len(hdr))
+        for r in rows:
+            print(f"{r['round']:>5}  {_fmt(r['value']):>10}  "
+                  f"{_fmt(r['vs_baseline'], 3):>8}  "
+                  f"{_fmt(r['serving_tps']):>11}  "
+                  f"{_fmt(r['ttft_p95_ms'], 2):>11}  "
+                  f"{_fmt(r['goodput'], 3):>7}")
+        v0, v1 = rows[0]["value"], rows[-1]["value"]
+        if len(rows) >= 2 \
+                and all(isinstance(v, (int, float))
+                        and not isinstance(v, bool) and v for v in (v0, v1)):
+            # numeric-only: a drifted string 'value' must reach the
+            # problem report below, not die here in a TypeError
+            print(f"headline trajectory: {v0} -> {v1} "
+                  f"({v1 / v0:.2f}x over {len(rows)} rounds, "
+                  f"{rows[-1]['metric']})")
+    if problems:
+        print(f"bench-trend: FAILED ({len(problems)} schema problem(s)):")
+        for p in problems:
+            print(f"  - {p}")
+        return 1
+    print(f"bench-trend: {len(rows)} artifact(s) OK")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--dir", default=".",
+                    help="directory holding BENCH_r*.json (default: .)")
+    args = ap.parse_args(argv)
+    return trend(args.dir)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

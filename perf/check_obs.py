@@ -7,8 +7,13 @@ Two checks, both about keeping the telemetry subsystem honest:
 1. **Artifact schema** (`--artifact PATH --trace NAME`): a bench `--json`
    serving artifact must carry the full telemetry contract — engine
    counters, the metrics snapshot (with quantile fields on every
-   histogram), and the SLO report (TTFT/TPOT/step-latency quantiles,
-   goodput at a deadline).  A bench refactor that silently drops a field
+   histogram), the SLO report (TTFT/TPOT/step-latency quantiles, goodput
+   at a deadline), and the ISSUE 7 observatory sections: `utilization`
+   (host/dispatch/device-wait/gap step decomposition whose fractions must
+   sum to ~1 — a disjointness regression is a gate failure, not a
+   rounding note), `memory` (pool occupancy/fragmentation/cache series
+   summary with at least one sample), and `compile` (per-fn compile
+   counts + durations).  A bench refactor that silently drops a field
    breaks every dashboard downstream; this gate fails it in CI instead.
 
 2. **Overhead gate** (`--gate`): runs the SAME small serving trace twice
@@ -48,6 +53,18 @@ REQUIRED_METRICS = ("serve.ttft_s", "serve.tpot_s", "serve.queue_s",
 # engine counters that must ride along in the snapshot
 REQUIRED_ENGINE_COUNTERS = ("engine.tokens_generated", "engine.decode_steps",
                             "engine.prefill_tokens_executed")
+# ISSUE 7 sections: host/device step decomposition, memory observatory,
+# compile accounting — every serving trace section must carry all three
+UTILIZATION_KEYS = ("steps", "host_busy_s", "dispatch_s", "device_wait_s",
+                    "window_s", "gap_s", "host_busy_frac", "dispatch_frac",
+                    "device_wait_frac", "gap_frac", "device_idle_frac_est",
+                    "per_phase")
+MEMORY_KEYS = ("samples", "last", "peak_occupancy_frac",
+               "peak_fragmentation_frac", "min_free_pages", "prefix_cache")
+MEMORY_LAST_KEYS = ("step", "total_pages", "free_pages", "allocated_pages",
+                    "referenced", "cache_page_refs", "occupancy_frac",
+                    "fragmentation_frac", "queue_depth", "active")
+COMPILE_KEYS = ("total_compiles", "compile_s_total", "per_fn")
 
 # where each trace keeps its telemetry-bearing sections:
 # {trace: [paths to dicts that contain metrics+slo_report+TTFT keys]}
@@ -123,6 +140,49 @@ def validate_artifact(art: dict, trace: str) -> list[str]:
                       "goodput_tokens"):
                 if f not in slo:
                     problems.append(f"{label}: slo_report missing {f!r}")
+        for block, keys in (("utilization", UTILIZATION_KEYS),
+                            ("memory", MEMORY_KEYS),
+                            ("compile", COMPILE_KEYS)):
+            b = sec.get(block)
+            if not isinstance(b, dict):
+                problems.append(f"{label}: missing section {block!r}")
+                continue
+            for f in keys:
+                if f not in b:
+                    problems.append(f"{label}: {block} missing {f!r}")
+        util = sec.get("utilization")
+        if isinstance(util, dict):
+            fracs = [util.get(f) for f in ("host_busy_frac", "dispatch_frac",
+                                           "device_wait_frac", "gap_frac")]
+            if all(isinstance(f, (int, float)) for f in fracs) \
+                    and not 0.99 <= sum(fracs) <= 1.01:
+                problems.append(f"{label}: utilization fractions sum to "
+                                f"{sum(fracs):.4f}, not ~1.0 (the buckets "
+                                f"must be a disjoint decomposition)")
+            if not isinstance(util.get("per_phase"), dict) \
+                    or "sched" not in util.get("per_phase", {}):
+                problems.append(f"{label}: utilization per_phase missing "
+                                f"'sched'")
+        mem = sec.get("memory")
+        if isinstance(mem, dict):
+            if not mem.get("samples"):
+                problems.append(f"{label}: memory observatory recorded no "
+                                f"samples")
+            last = mem.get("last")
+            if isinstance(last, dict):
+                for f in MEMORY_LAST_KEYS:
+                    if f not in last:
+                        problems.append(f"{label}: memory.last missing "
+                                        f"{f!r}")
+            elif "last" in mem:
+                problems.append(f"{label}: memory.last is not a sample row")
+        comp = sec.get("compile")
+        if isinstance(comp, dict) and isinstance(comp.get("per_fn"), dict):
+            for fn, e in comp["per_fn"].items():
+                if not isinstance(e, dict) or "count" not in e \
+                        or "total_s" not in e:
+                    problems.append(f"{label}: compile.per_fn[{fn!r}] "
+                                    f"missing count/total_s")
     return problems
 
 

@@ -16,8 +16,9 @@ from paddle_tpu.models.llama import (llama_config_tiny,
                                      build_functional_llama, llama_generate)
 from paddle_tpu.inference.paged import EngineStalledError, ServingEngine
 from paddle_tpu.observability import (Counter, EngineStats, FlightRecorder,
-                                      Gauge, Histogram, MetricsRegistry,
-                                      Telemetry, latency_percentiles,
+                                      Gauge, GaugeSeries, Histogram,
+                                      MetricsRegistry, Telemetry,
+                                      TrainTelemetry, latency_percentiles,
                                       slo_report)
 from paddle_tpu.resilience import inject
 
@@ -542,7 +543,20 @@ def _section_from_engine(eng):
         "engine_stats": eng.stats(),
         "metrics": tel.snapshot(eng.stats()),
         "slo_report": tel.slo_report(1.0, window_s=1.0),
+        # ISSUE 7 observatory sections (schema-gated like the rest).
+        # The window must COVER the accounted phase time (a fixed 1.0 s
+        # under-covers when the run absorbed compiles on a loaded host,
+        # and the validator rightly rejects fractions summing past 1).
+        "utilization": tel.utilization_report(window_s=_window_for(tel)),
+        "memory": tel.memory_report(eng.stats()),
+        "compile": tel.compile_report(),
     }
+
+
+def _window_for(tel):
+    u = tel.utilization_report()
+    accounted = u["host_busy_s"] + u["dispatch_s"] + u["device_wait_s"]
+    return max(1.0, accounted * 1.25)
 
 
 class TestObsCheckValidator:
@@ -569,10 +583,712 @@ class TestObsCheckValidator:
         art.pop("slo_report")
         art["metrics"].pop("serve.ttft_s")
         del art["ttft_p99_ms"]
+        art["utilization"].pop("device_idle_frac_est")
+        art.pop("memory")
+        art["compile"]["per_fn"]["prefill"] = {"count": 1}   # no total_s
         problems = validate_artifact(art, "serving")
         text = "\n".join(problems)
         assert "slo_report" in text
         assert "serve.ttft_s" in text
         assert "ttft_p99_ms" in text
+        assert "device_idle_frac_est" in text
+        assert "memory" in text
+        assert "per_fn['prefill']" in text
         assert validate_artifact({}, "serving")      # empty artifact fails
         assert validate_artifact(art, "nope")        # unknown trace fails
+
+    def test_overlapping_utilization_fractions_fail(self):
+        """The decomposition must be DISJOINT: buckets summing well past
+        1.0 (the pre-fix sched/prefill double count) are a gate failure."""
+        cfg, params = _llama()
+        eng = _engine(cfg, params, telemetry=True)
+        eng.submit(rng.integers(1, 64, (5,)).astype(np.int32),
+                   max_new_tokens=3)
+        eng.run()
+        art = {"metric": "trace_serving", **_section_from_engine(eng)}
+        art["utilization"]["host_busy_frac"] = 0.6
+        art["utilization"]["dispatch_frac"] = 0.8      # sums to > 1.4
+        problems = validate_artifact(art, "serving")
+        assert any("disjoint" in p for p in problems), problems
+
+
+# ---------------------------------------------------------------------------
+# gauge time series (ISSUE 7 memory observatory primitive)
+# ---------------------------------------------------------------------------
+class TestGaugeSeries:
+    def test_sampling_monotonic_under_injectable_clock(self):
+        clk = _FakeClock(start=10.0, tick=0.25)
+        r = MetricsRegistry(clock=clk)
+        s = r.series("mem.pool", capacity=8)
+        assert r.series("mem.pool") is s          # get-or-create
+        for i in range(20):
+            s.sample(clk(), free=64 - i, occupancy_frac=i / 64)
+        rows = s.rows()
+        assert len(rows) == 8                     # bounded ring
+        assert s.total_samples == 20
+        seqs = [row["seq"] for row in rows]
+        assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+        assert seqs == list(range(13, 21))        # the most recent window
+        ts = [row["t"] for row in rows]
+        assert ts == sorted(ts)                   # clock-monotonic
+        # reset drops rows but seq keeps counting (global sample order)
+        s.reset()
+        assert len(s) == 0
+        row = s.sample(clk(), free=1)
+        assert row["seq"] == 21
+        assert s.to_value()["count"] == 1
+
+    def test_value_normalization_and_minmax(self):
+        s = GaugeSeries("m")
+        s.sample(1.0, free=np.int32(7), occ=np.float64(0.5), flag=True,
+                 label="x", none=None)
+        row = s.last
+        assert row["free"] == 7 and type(row["free"]) is int
+        assert row["occ"] == 0.5 and type(row["occ"]) is float
+        assert row["flag"] is True and row["label"] == "x"
+        assert row["none"] is None
+        json.dumps(row)                           # flight-dump JSON-safe
+        s.sample(2.0, free=3, occ=0.9)
+        assert s.field_minmax("free") == (3, 7)
+        assert s.field_minmax("occ") == (0.5, 0.9)
+        assert s.field_minmax("label") is None    # non-numeric
+        assert s.tail(1) == [s.last] and s.tail(0) == []
+
+    def test_registry_type_conflict(self):
+        r = MetricsRegistry()
+        r.series("x")
+        with pytest.raises(TypeError, match="already registered"):
+            r.histogram("x")
+
+
+# ---------------------------------------------------------------------------
+# utilization: host/device step decomposition (ISSUE 7 tentpole a)
+# ---------------------------------------------------------------------------
+class TestUtilization:
+    def test_decomposition_is_disjoint_and_complete(self):
+        cfg, params = _llama(seed=3)
+        tel = Telemetry()
+        eng = _engine(cfg, params, telemetry=tel, prefill_chunk=4,
+                      prompt_bucket=4)
+        # warm, then measure a window (mirrors the bench protocol)
+        eng.submit(rng.integers(1, 64, (13,)).astype(np.int32),
+                   max_new_tokens=4)
+        eng.run()
+        tel.reset_window()
+        import time
+        t0 = time.perf_counter()
+        for t, n in ((13, 5), (6, 4), (9, 6)):
+            eng.submit(rng.integers(1, 64, (t,)).astype(np.int32),
+                       max_new_tokens=n)
+        eng.run()
+        dt = time.perf_counter() - t0
+        u = tel.utilization_report(window_s=dt)
+        assert u["steps"] >= 1
+        # the three buckets + gap tile the window exactly (no phase is
+        # counted twice — the sched span subtracts nested prefill
+        # dispatches)
+        total = (u["host_busy_s"] + u["dispatch_s"] + u["device_wait_s"]
+                 + u["gap_s"])
+        assert total == pytest.approx(dt, rel=0.02)
+        fsum = (u["host_busy_frac"] + u["dispatch_frac"]
+                + u["device_wait_frac"] + u["gap_frac"])
+        assert fsum == pytest.approx(1.0, abs=0.01)
+        assert 0.0 <= u["device_idle_frac_est"] <= 1.0
+        # the phases that actually ran are in the per-phase table
+        assert "sched" in u["per_phase"]
+        assert "decode_dispatch" in u["per_phase"]
+        assert "prefill_chunk" in u["per_phase"]
+        assert u["per_phase"]["sched"]["count"] == u["steps"]
+        # every accounted second is attributed to a listed phase
+        phase_sum = sum(p["total_s"] for p in u["per_phase"].values())
+        assert phase_sum == pytest.approx(
+            u["host_busy_s"] + u["dispatch_s"] + u["device_wait_s"],
+            abs=1e-4)
+
+    def test_sched_subtracts_nested_prefill_dispatch(self):
+        """An admission-heavy window must not count its prefill dispatch
+        seconds twice (once in sched, once in prefill_*)."""
+        cfg, params = _llama()
+        tel = Telemetry()
+        eng = _engine(cfg, params, telemetry=tel)
+        for _ in range(4):
+            eng.submit(rng.integers(1, 64, (9,)).astype(np.int32),
+                       max_new_tokens=2)
+        eng.run()
+        u = tel.utilization_report()
+        sched = u["per_phase"]["sched"]["total_s"]
+        dense = u["per_phase"]["prefill_dense"]["total_s"]
+        # the dense prefills ran INSIDE admission; had sched kept them its
+        # total would dominate dense — subtracted, it must be well below
+        assert sched < dense
+
+    def test_window_report_resets(self):
+        cfg, params = _llama()
+        tel = Telemetry()
+        eng = _engine(cfg, params, telemetry=tel)
+        eng.submit(rng.integers(1, 64, (5,)).astype(np.int32),
+                   max_new_tokens=3)
+        eng.run()
+        assert tel.utilization_report()["steps"] >= 1
+        tel.reset_window()
+        u = tel.utilization_report(window_s=1.0)
+        assert u["steps"] == 0 and u["host_busy_s"] == 0.0
+        assert u["gap_frac"] == pytest.approx(1.0)
+
+
+# ---------------------------------------------------------------------------
+# memory observatory (ISSUE 7 tentpole b)
+# ---------------------------------------------------------------------------
+class TestMemoryObservatory:
+    def test_per_step_series_and_report(self):
+        cfg, params = _llama(seed=2)
+        tel = Telemetry()
+        eng = _engine(cfg, params, telemetry=tel, prefill_chunk=4,
+                      prompt_bucket=4)
+        p = rng.integers(1, 64, (13,)).astype(np.int32)
+        eng.submit(p, max_new_tokens=4)
+        eng.run()
+        rows = tel.memory.rows()
+        assert len(rows) == eng._step_seq         # one sample per step
+        for row in rows:
+            assert 0.0 <= row["occupancy_frac"] <= 1.0
+            assert 0.0 <= row["fragmentation_frac"] <= 1.0
+            assert row["free_pages"] + row["allocated_pages"] \
+                == row["total_pages"]
+            assert row["referenced"] >= row["allocated_pages"]
+        # retire parked pages in the cache: the last sample shows them
+        assert rows[-1]["cache_page_refs"] > 0
+        assert rows[-1]["active"] == 0
+        rep = tel.memory_report(eng.stats())
+        assert rep["samples"] == len(rows)
+        assert rep["last"] == rows[-1]
+        assert rep["peak_occupancy_frac"] >= rows[-1]["occupancy_frac"]
+        assert rep["min_free_pages"] <= rows[-1]["free_pages"]
+        assert rep["prefix_cache"]["executed_tokens"] > 0
+        # gauges carry the last values into the metrics snapshot
+        snap = tel.registry.snapshot()
+        assert snap["mem.pool_free_pages"] == rows[-1]["free_pages"]
+        assert snap["mem.pool"]["count"] == len(rows)
+
+    def test_pool_pressure_dump_includes_occupancy_ramp(self):
+        """The acceptance drill: a pool-pressure flight dump must show the
+        occupancy ramp that caused it, not just the moment of failure."""
+        cfg, params = _llama(seed=5)
+        tel = Telemetry()
+        eng = _engine(cfg, params, telemetry=tel)
+        eng.submit(rng.integers(1, 64, (9,)).astype(np.int32),
+                   max_new_tokens=6)
+        with inject({"serve.pool_pressure": dict(action="trigger",
+                                                 count=1)}):
+            eng.submit(rng.integers(1, 64, (5,)).astype(np.int32),
+                       max_new_tokens=4)
+            eng.run()
+        dump = next(d for d in tel.flight.dumps
+                    if d["reason"] == "injected_fault")
+        ramp = dump["extra"]["memory_ramp"]
+        assert ramp, "pressure dump carries no occupancy ramp"
+        assert all("occupancy_frac" in row and "free_pages" in row
+                   for row in ramp)
+        seqs = [row["seq"] for row in ramp]
+        assert seqs == sorted(seqs)
+        json.dumps(dump)                          # JSONL-able postmortem
+
+    def test_chrome_export_has_counter_tracks(self):
+        cfg, params = _llama()
+        tel = Telemetry()
+        eng = _engine(cfg, params, telemetry=tel)
+        eng.submit(rng.integers(1, 64, (6,)).astype(np.int32),
+                   max_new_tokens=4)
+        eng.run()
+        data = tel.tracer.to_chrome_trace()
+        cevs = [e for e in data["traceEvents"] if e.get("ph") == "C"]
+        assert cevs, "no counter events exported"
+        tracks = {e["name"] for e in cevs}
+        assert "pagepool.pages" in tracks and "engine.load" in tracks
+        pool = [e for e in cevs if e["name"] == "pagepool.pages"]
+        assert len(pool) == eng._step_seq         # one sample per step
+        for e in pool:
+            assert set(e["args"]) == {"used", "free", "cached"}
+            assert "ts" in e
+        json.dumps(data)
+
+    def test_reset_window_drops_series(self):
+        cfg, params = _llama()
+        tel = Telemetry()
+        eng = _engine(cfg, params, telemetry=tel)
+        eng.submit(rng.integers(1, 64, (5,)).astype(np.int32),
+                   max_new_tokens=3)
+        eng.run()
+        assert tel.memory_report()["samples"] > 0
+        tel.reset_window()
+        rep = tel.memory_report()
+        assert rep["samples"] == 0 and rep["last"] is None
+        assert rep["peak_occupancy_frac"] is None
+
+
+# ---------------------------------------------------------------------------
+# compile accounting (ISSUE 7 tentpole a: engine.compile_s)
+# ---------------------------------------------------------------------------
+class TestCompileAccounting:
+    def test_compiles_recorded_then_steady_state_adds_none(self):
+        cfg, params = _llama(seed=4)
+        tel = Telemetry()
+        eng = _engine(cfg, params, telemetry=tel)
+        p = rng.integers(1, 64, (6,)).astype(np.int32)
+        eng.submit(p, max_new_tokens=5)
+        eng.run()
+        rep = tel.compile_report()
+        assert rep["total_compiles"] > 0
+        assert rep["compile_s_total"] > 0.0
+        assert "prefill" in rep["per_fn"] and "decode_step" in rep["per_fn"]
+        for e in rep["per_fn"].values():
+            assert e["count"] >= 1 and e["total_s"] > 0.0
+        # the compile ledger agrees with the sanitizer's miss counters
+        assert rep["total_compiles"] == sum(eng.jit_cache_misses.values())
+        # flight record carries one compile event per miss
+        compiles = [e for e in tel.flight.events()
+                    if e["event"] == "compile"]
+        assert len(compiles) == rep["total_compiles"]
+        assert all(e["dur_s"] > 0 for e in compiles)
+        # metrics snapshot: histogram + counter
+        snap = tel.registry.snapshot()
+        assert snap["engine.compiles"] == rep["total_compiles"]
+        assert snap["engine.compile_s"]["count"] == rep["total_compiles"]
+        # warmed steady state: identical traffic adds ZERO compiles
+        before = rep["total_compiles"]
+        eng.submit(p, max_new_tokens=5)
+        eng.run()
+        assert tel.compile_report()["total_compiles"] == before
+
+    def test_off_engine_pays_nothing(self):
+        cfg, params = _llama()
+        eng = _engine(cfg, params, telemetry=None)
+        eng.submit(rng.integers(1, 64, (5,)).astype(np.int32),
+                   max_new_tokens=3)
+        eng.run()                                 # on_miss hook is inert
+        assert eng.jit_cache_misses               # misses still counted
+
+
+# ---------------------------------------------------------------------------
+# EngineStats.delta across a preemption + re-prefill window (satellite)
+# ---------------------------------------------------------------------------
+class TestEngineStatsPreemptionWindow:
+    def test_delta_window_containing_preemption_and_reprefill(self):
+        cfg, params = _llama(seed=5)
+        eng = ServingEngine(params, cfg, num_slots=2, page_size=2,
+                            num_pages=40, max_pages_per_seq=16,
+                            attention_impl="ref", prompt_bucket=8,
+                            decode_horizon=2, telemetry=None)
+        prompts = [rng.integers(1, 64, (t,)).astype(np.int32)
+                   for t in (5, 7, 3)]
+        s0 = eng.stats_snapshot()
+        with inject({"serve.pool_pressure": dict(action="trigger", after=1,
+                                                 count=3)}):
+            for p in prompts:
+                eng.submit(p, max_new_tokens=8)
+            done = eng.run()
+        s1 = eng.stats_snapshot()
+        assert len(done) == 3
+        assert any(r.preemptions > 0 for r in done.values())
+        d = s1.delta(s0)
+        # the window saw the preemption AND the victim's re-prefill: the
+        # executed prefill tokens exceed the three prompts' fresh tokens
+        assert d["preemptions"] >= 1
+        assert d["preemptions"] == eng.preemptions
+        fresh = sum(len(p) for p in prompts)
+        assert d["prefill_tokens_executed"] + d["cached_prefix_tokens"] \
+            > fresh
+        assert d["tokens_generated"] == 8 * 3
+        assert all(v >= 0 for k, v in d.items() if k != "window_s")
+        # a second, quiet window diffs back to zero activity
+        s2 = eng.stats_snapshot()
+        z = s2.delta(s1)
+        assert all(v == 0 for k, v in z.items() if k != "window_s")
+
+
+# ---------------------------------------------------------------------------
+# training telemetry (ISSUE 7 tentpole c)
+# ---------------------------------------------------------------------------
+import paddle_tpu as paddle                                   # noqa: E402
+from paddle_tpu import nn, optimizer as optim                 # noqa: E402
+
+
+class TestTrainTelemetry:
+    def _ts(self, tel, guard=2, scaler=None):
+        from paddle_tpu.parallel.train_step import compile_train_step
+        paddle.seed(13)
+        net = nn.Linear(8, 4)
+        opt = optim.Adam(learning_rate=0.01, parameters=net.parameters())
+        ts = compile_train_step(net, opt, lambda m, x: m(x).mean(),
+                                nonfinite_guard=guard, scaler=scaler,
+                                telemetry=tel)
+        x = np.random.default_rng(0).standard_normal((4, 8)).astype(
+            np.float32)
+        return ts, x
+
+    def test_step_timing_and_counters(self):
+        tel = TrainTelemetry()
+        ts, x = self._ts(tel)
+        for _ in range(4):
+            ts(x)
+        rep = tel.report(window_s=2.0)
+        assert rep["steps"] == 4
+        assert rep["samples"] == 16               # 4 steps x batch 4
+        assert rep["step_s"]["count"] == 4
+        assert rep["step_s"]["p50_ms"] > 0
+        assert rep["steps_per_sec"] == pytest.approx(2.0)
+        assert rep["nonfinite_skips"] == 0
+
+    def test_nonfinite_skip_records_flight_event_with_fault_plan(self):
+        """Satellite: TrainStep resilience events reach the flight
+        recorder WITH the active FaultPlan context (the existing
+        train.nonfinite fault point drives the drill)."""
+        tel = TrainTelemetry()
+        ts, x = self._ts(tel, guard=3)
+        with inject({"train.nonfinite": dict(action="trigger", at=1)},
+                    seed=7):
+            for _ in range(3):
+                ts(x)
+        assert ts.skipped_steps == 1
+        skips = [e for e in tel.flight.events()
+                 if e["event"] == "nonfinite_skip"]
+        assert len(skips) == 1
+        ev = skips[0]
+        assert ev["step"] == 1 and ev["consecutive"] == 1
+        fp = ev["fault_plan"]
+        assert fp is not None
+        assert fp["seed"] == 7 and fp["fired"] == 1
+        assert "train.nonfinite:trigger" in fp["specs"]
+        assert tel.registry.snapshot()["train.nonfinite_skips"] == 1
+        # outside an inject scope the context is None, not invented
+        from paddle_tpu.observability import fault_context
+        assert fault_context() is None
+
+    def test_nonfinite_raise_auto_dumps(self):
+        tel = TrainTelemetry()
+        ts, x = self._ts(tel, guard=2)
+        with inject({"train.nonfinite": dict(action="trigger", after=0,
+                                             count=None)}):
+            with pytest.raises(FloatingPointError, match="2 consecutive"):
+                for _ in range(5):
+                    ts(x)
+        d = tel.flight.last_dump()
+        assert d["reason"] == "nonfinite_raise"
+        assert d["extra"]["consecutive"] == 2
+        names = [e["event"] for e in d["events"]]
+        assert names.count("nonfinite_skip") == 2
+        assert "nonfinite_raise" in names
+        assert tel.registry.snapshot()["train.nonfinite_raises"] == 1
+
+    def test_scaler_backoff_counted(self):
+        scaler = paddle.amp.GradScaler(enable=True,
+                                       init_loss_scaling=1024.0,
+                                       decr_every_n_nan_or_inf=1)
+        tel = TrainTelemetry()
+        ts, x = self._ts(tel, scaler=scaler)
+        with inject({"train.nonfinite": dict(action="trigger", at=1)}):
+            for _ in range(3):
+                ts(x)
+        assert scaler._scale == 512.0
+        assert tel.registry.snapshot()["train.scaler_backoffs"] == 1
+        assert "scaler_backoff" in tel.flight.event_names()
+
+    def test_telemetry_off_is_default_and_steps_match(self):
+        ts_off, x = self._ts(None)
+        assert ts_off.telemetry is None
+        tel = TrainTelemetry()
+        ts_on, _ = self._ts(tel)
+        for _ in range(3):
+            a = float(ts_off(x).numpy())
+            b = float(ts_on(x).numpy())
+            assert a == b                         # bit-exact on vs off
+
+
+class TestModelFitTelemetry:
+    def _fit(self, tel, save_dir=None):
+        paddle.seed(7)
+        net = nn.Linear(4, 2)
+        from paddle_tpu.hapi import Model
+        m = Model(net)
+        m.prepare(optimizer=optim.SGD(learning_rate=0.1,
+                                      parameters=net.parameters()),
+                  loss=lambda out, y: ((out - y) ** 2).mean())
+        g = np.random.default_rng(1)
+        xs = g.standard_normal((8, 4)).astype(np.float32)
+        ys = g.standard_normal((8, 2)).astype(np.float32)
+        data = [(xs[i * 2:(i + 1) * 2], ys[i * 2:(i + 1) * 2])
+                for i in range(4)]
+        losses = []
+        from paddle_tpu.hapi.callbacks import Callback
+
+        class Rec(Callback):
+            def on_batch_end(self, mode, step, logs=None):
+                if mode == "train" and logs and "loss" in logs:
+                    losses.append(logs["loss"])
+
+        m.fit(data, epochs=2, verbose=0, callbacks=[Rec()],
+              telemetry=tel, save_dir=save_dir)
+        return losses
+
+    def test_fit_bit_exact_and_step_quantiles(self, tmp_path):
+        """Acceptance: a Model.fit run with telemetry on produces
+        train.step_s quantiles and checkpoint spans, bit-exact vs off."""
+        tel = TrainTelemetry()
+        l_on = self._fit(tel, save_dir=str(tmp_path / "ck"))
+        l_off = self._fit(None)
+        assert l_on == l_off                      # bit-exact on vs off
+        rep = tel.report(window_s=1.0)
+        assert rep["steps"] == 8                  # 2 epochs x 4 batches
+        assert rep["samples"] == 16
+        snap = tel.snapshot()
+        h = snap["train.step_s"]
+        for f in ("count", "p50", "p95", "p99"):
+            assert f in h
+        assert h["count"] == 8
+        # the data-wait vs compute split is recorded per step
+        assert snap["train.data_s"]["count"] == 8
+        assert snap["train.compute_s"]["count"] == 8
+        assert 0.0 <= rep["data_wait_frac"] <= 1.0
+        # save_dir checkpoints got ckpt.save spans (one per epoch)
+        assert snap["ckpt.save_s"]["count"] == 2
+        assert tel.registry.snapshot()["ckpt.saves"] == 2
+        saves = [e for e in tel.flight.events()
+                 if e["event"] == "ckpt.save"]
+        assert len(saves) == 2 and all(e["ok"] for e in saves)
+
+
+class TestCheckpointTelemetry:
+    def _mgr(self, root, tel, keep_last=None):
+        from paddle_tpu.resilience import CheckpointManager
+        paddle.seed(3)
+        net = nn.Linear(6, 3)
+        opt = optim.Adam(learning_rate=0.01, parameters=net.parameters())
+        return CheckpointManager(str(root), model=net, optimizer=opt,
+                                 keep_last=keep_last, telemetry=tel), net
+
+    def test_save_restore_spans_and_phases(self, tmp_path):
+        tel = TrainTelemetry()
+        mgr, _ = self._mgr(tmp_path, tel)
+        mgr.save(1)
+        snap = tel.snapshot()
+        # whole-save span + the writer's stage/commit sub-phases
+        assert snap["ckpt.save_s"]["count"] == 1
+        assert snap["ckpt.stage_s"]["count"] == 1
+        assert snap["ckpt.commit_s"]["count"] == 1
+        assert snap["ckpt.saves"] == 1
+        names = tel.flight.event_names()
+        assert names.index("ckpt.stage") < names.index("ckpt.commit") \
+            < names.index("ckpt.save")
+        assert mgr.restore() == 1
+        snap = tel.snapshot()
+        assert snap["ckpt.restore_s"]["count"] == 1
+        assert snap["ckpt.restores"] == 1
+        # the flight record says WHICH snapshot was loaded
+        restored = [e for e in tel.flight.events()
+                    if e["event"] == "ckpt.restored"]
+        assert len(restored) == 1 and restored[0]["step"] == 1
+
+    def test_torn_snapshot_rejection_records_flight_event(self, tmp_path):
+        """Satellite: a snapshot that fails manifest verification during
+        discovery leaves a torn_snapshot flight event (with fault
+        context), and an injected ckpt.write crash closes the save span
+        with ok=False."""
+        from paddle_tpu.resilience import InjectedFault
+        tel = TrainTelemetry()
+        mgr, _ = self._mgr(tmp_path, tel)
+        mgr.save(1)
+        mgr.save(2)
+        # bit-flip the newest snapshot's payload: committed but corrupt
+        data = next((tmp_path / "step_00000002").glob("*.data"))
+        with open(data, "r+b") as f:
+            b = f.read(1)
+            f.seek(0)
+            f.write(bytes([b[0] ^ 0xFF]))
+        best = mgr.find_latest_complete()
+        assert best.endswith("step_00000001")
+        torn = [e for e in tel.flight.events()
+                if e["event"] == "torn_snapshot"]
+        assert len(torn) == 1
+        assert "step_00000002" in torn[0]["path"]
+        assert torn[0]["fault_plan"] is None      # no plan active here
+        assert tel.registry.snapshot()["ckpt.torn_snapshots"] == 1
+        # injected writer crash (the existing ckpt.write fault point):
+        # the save span still closes, marked not-ok, and no save counts
+        with inject({"ckpt.write": dict(action="raise")}):
+            with pytest.raises(InjectedFault):
+                mgr.save(3)
+        bad = [e for e in tel.flight.events()
+               if e["event"] == "ckpt.save" and not e["ok"]]
+        assert len(bad) == 1 and bad[0]["step"] == 3
+        assert tel.registry.snapshot()["ckpt.saves"] == 2   # unchanged
+        # discovery with a fault plan active stamps it on the rejection
+        with inject({"ckpt.commit": dict(action="raise", at=99)}, seed=11):
+            mgr.find_latest_complete()
+        torn2 = [e for e in tel.flight.events()
+                 if e["event"] == "torn_snapshot"][-1]
+        assert torn2["fault_plan"] is not None
+        assert torn2["fault_plan"]["seed"] == 11
+
+
+# ---------------------------------------------------------------------------
+# bench-trend gate (perf/bench_trend.py satellite)
+# ---------------------------------------------------------------------------
+from perf.bench_trend import (find_serving_section, trend,  # noqa: E402
+                              validate as validate_trend)
+
+
+class TestBenchTrend:
+    def _write(self, d, rnd, parsed, rc=0):
+        art = {"n": rnd, "cmd": "python bench.py", "rc": rc,
+               "tail": "...", "parsed": parsed}
+        (d / f"BENCH_r{rnd:02d}.json").write_text(json.dumps(art))
+
+    def test_trajectory_over_valid_artifacts(self, tmp_path, capsys):
+        self._write(tmp_path, 1, {"metric": "m", "value": 100.0,
+                                  "unit": "tok/s"})
+        self._write(tmp_path, 2, {"metric": "m", "value": 150.0,
+                                  "unit": "tok/s", "vs_baseline": 1.5,
+                                  "serving": {"tokens_per_sec": 800.0,
+                                              "ttft_p95_ms": 70.0,
+                                              "goodput_fraction": 1.0}})
+        assert trend(str(tmp_path)) == 0
+        out = capsys.readouterr().out
+        assert "2 artifact(s) OK" in out
+        assert "70.00" in out and "800.0" in out
+        assert "1.50x" in out
+
+    def test_schema_drift_fails(self, tmp_path, capsys):
+        self._write(tmp_path, 1, {"metric": "m", "unit": "x"})  # no value
+        assert trend(str(tmp_path)) == 1
+        assert "headline key 'value'" in capsys.readouterr().out
+
+    def test_nonzero_rc_fails(self, tmp_path, capsys):
+        self._write(tmp_path, 1, {"metric": "m", "value": 1, "unit": "x"},
+                    rc=2)
+        assert trend(str(tmp_path)) == 1
+        assert "rc=2" in capsys.readouterr().out
+
+    def test_losing_serving_section_is_drift(self, tmp_path, capsys):
+        serving = {"ttft_p95_ms": 1.0, "goodput_fraction": 1.0}
+        self._write(tmp_path, 1, {"metric": "m", "value": 1, "unit": "x",
+                                  "deep": {"nest": serving}})
+        self._write(tmp_path, 2, {"metric": "m", "value": 2, "unit": "x"})
+        assert find_serving_section({"deep": {"nest": serving}}) == serving
+        assert trend(str(tmp_path)) == 1
+        assert "missing here" in capsys.readouterr().out
+
+    def test_repo_artifacts_pass(self):
+        """The committed BENCH_r*.json history must satisfy the gate."""
+        root = Path(__file__).resolve().parents[1]
+        for p in sorted(root.glob("BENCH_r*.json")):
+            with open(p) as f:
+                art = json.load(f)
+            assert validate_trend(art, str(p)) == [], p
+
+
+class TestReviewHardening:
+    def test_batch_samples_handles_0d_and_unknowable(self):
+        from paddle_tpu.observability.train import batch_samples
+        assert batch_samples([np.zeros((4, 8))]) == 4
+        assert batch_samples(np.zeros((3, 2))) == 3
+        assert batch_samples([np.float32(1.0)]) == 0     # 0-d: no crash
+        assert batch_samples([]) == 0
+        assert batch_samples("notanarray") == 0
+        # TrainStep telemetry-on must survive a 0-d batch arg exactly like
+        # telemetry-off does (numerics/behavior untouched either way)
+        tel = TrainTelemetry()
+        from paddle_tpu.parallel.train_step import compile_train_step
+        paddle.seed(13)
+        net = nn.Linear(8, 4)
+        opt = optim.Adam(learning_rate=0.01, parameters=net.parameters())
+        ts = compile_train_step(
+            net, opt, lambda m, s, x: (m(x) * s).mean(), telemetry=tel)
+        x = np.random.default_rng(0).standard_normal((4, 8)).astype(
+            np.float32)
+        ts(np.float32(2.0), x)                           # 0-d first arg
+        assert tel.report()["steps"] == 1
+
+    def test_report_is_window_scoped_after_reset(self):
+        """steps/samples/throughput must describe the window the
+        histograms hold, not the cumulative counters (an 11x-wrong
+        tokens/s otherwise); lifetime totals ride along separately."""
+        tel = TrainTelemetry()
+        for _ in range(100):
+            tel.step(0.01, samples=4)
+        tel.reset_window()
+        for _ in range(10):
+            tel.step(0.02, samples=4)
+        rep = tel.report(window_s=1.0)
+        assert rep["steps"] == 10 and rep["samples"] == 40
+        assert rep["total_steps"] == 110 and rep["total_samples"] == 440
+        assert rep["steps_per_sec"] == pytest.approx(10.0)
+        assert rep["samples_per_sec"] == pytest.approx(40.0)
+        assert rep["step_s"]["count"] == 10              # internally agrees
+
+    def test_scaler_backoff_counts_decays_not_notifications(self):
+        """decr_every_n_nan_or_inf=2: one bad step notifies the scaler but
+        does NOT decay the scale — the backoff counter must stay 0."""
+        from paddle_tpu.parallel.train_step import compile_train_step
+        scaler = paddle.amp.GradScaler(enable=True,
+                                       init_loss_scaling=1024.0,
+                                       decr_every_n_nan_or_inf=2)
+        tel = TrainTelemetry()
+        paddle.seed(13)
+        net = nn.Linear(8, 4)
+        opt = optim.Adam(learning_rate=0.01, parameters=net.parameters())
+        ts = compile_train_step(net, opt, lambda m, x: m(x).mean(),
+                                nonfinite_guard=5, scaler=scaler,
+                                telemetry=tel)
+        x = np.random.default_rng(0).standard_normal((4, 8)).astype(
+            np.float32)
+        with inject({"train.nonfinite": dict(action="trigger", at=1)}):
+            for _ in range(3):
+                ts(x)
+        assert scaler._scale == 1024.0            # no decay happened
+        assert tel.registry.snapshot()["train.scaler_backoffs"] == 0
+        # two consecutive bad steps DO decay once -> one backoff counted
+        with inject({"train.nonfinite": dict(action="trigger", after=0,
+                                             count=2)}):
+            for _ in range(2):
+                ts(x)
+        assert scaler._scale == 512.0
+        assert tel.registry.snapshot()["train.scaler_backoffs"] == 1
+
+    def test_async_save_failure_is_on_the_record(self, tmp_path):
+        """An async writer that dies must not remain a 'clean save': the
+        next wait() records ckpt.async_save_failed before re-raising."""
+        from paddle_tpu.resilience import CheckpointManager, InjectedFault
+        tel = TrainTelemetry()
+        paddle.seed(3)
+        net = nn.Linear(6, 3)
+        mgr = CheckpointManager(str(tmp_path), model=net, telemetry=tel)
+        with inject({"ckpt.write": dict(match={"file": "rank0.data"},
+                                        at=0)}):
+            mgr.save(1, async_save=True)    # launches; writer dies in bg
+            with pytest.raises(InjectedFault):
+                mgr.wait()
+        names = tel.flight.event_names()
+        assert "ckpt.async_save_failed" in names
+        assert tel.registry.snapshot()["ckpt.async_save_failures"] == 1
+        # the launching span closed ok=True by design (documented): the
+        # failure record is the wait-time event, not a rewritten span
+        launch = [e for e in tel.flight.events()
+                  if e["event"] == "ckpt.save"]
+        assert launch and launch[0]["async_save"] is True
+
+    def test_bench_trend_zero_tps_is_reported_not_dropped(self, tmp_path,
+                                                          capsys):
+        art = {"n": 1, "cmd": "x", "rc": 0, "tail": "",
+               "parsed": {"metric": "m", "value": 1.0, "unit": "x",
+                          "serving": {"tokens_per_sec": 0.0,
+                                      "ttft_p95_ms": 5.0,
+                                      "goodput_fraction": 0.0}}}
+        (tmp_path / "BENCH_r01.json").write_text(json.dumps(art))
+        assert trend(str(tmp_path)) == 0
+        out = capsys.readouterr().out
+        row = next(line for line in out.splitlines() if line.strip()
+                   .startswith("1 "))
+        cols = row.split()
+        # round value vs_base serve_tps ttft goodput — the 0.0 tokens/s is
+        # REPORTED (alarming data point), not rendered as missing "-"
+        assert cols[3] == "0.0" and cols[4] == "5.00" and cols[5] == "0.000"
