@@ -30,19 +30,30 @@ PYTEST_T1 = env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
 # over interleaved rounds; same quiet-machine caveat as the timing
 # gates above).
 #
-# `lint` runs graftlint (paddle_tpu/analysis — the trace-safety static
-# analyzer, README §Static analysis) over the package against the
-# committed baseline of grandfathered findings: non-zero exit on any NEW
-# finding (traced-value branch in a jitted fn, hot-path host sync, Pallas
-# kernel without a jnp ref/parity test, incomplete OpSpec, ...).
-# `lint-baseline` regenerates graftlint.baseline.json — fill in the
-# one-line justification per entry before committing it.
+# `lint` runs graftlint (paddle_tpu/analysis — the trace-safety +
+# distributed/dataflow static analyzer, README §Static analysis) over the
+# package against the committed baseline of grandfathered findings:
+# non-zero exit on any NEW finding (traced-value branch in a jitted fn,
+# hot-path host sync, unbound collective axis, rank-dependent collective
+# branch, use-after-donate, implicit dtype promotion, ...) AND on any
+# STALE baseline entry (the fix landed — delete the entry).
+# `make lint DIFF=BASE_REF` reports only findings in .py files changed
+# (or untracked) vs the git ref — the full project is still parsed so
+# the interprocedural rules keep their cross-module context.
+# `lint-baseline` regenerates
+# graftlint.baseline.json — fill in the one-line justification per entry
+# before committing it.
+#
+# `check` is the aggregate local gate: lint (writing the JSON report
+# artifact next to the BENCH jsons) -> tier1-budget -> obs-check.
 
 GRAFTLINT = $(PY) -m paddle_tpu.analysis paddle_tpu \
 	--baseline graftlint.baseline.json
 
+LINT_ARTIFACT ?= GRAFTLINT_report.json
+
 .PHONY: tier1 tier1-budget check-budget bench bench-trend lint \
-	lint-baseline obs-check
+	lint-baseline obs-check check
 
 # `bench-trend` reads every BENCH_r*.json driver artifact at the repo root
 # and prints the headline tokens/s + serving TTFT-p95 + goodput trajectory
@@ -60,10 +71,15 @@ obs-check:
 		--artifact $(OBS_ARTIFACT) --trace serving --gate
 
 lint:
-	$(GRAFTLINT)
+	$(GRAFTLINT) --fail-on-stale $(if $(DIFF),--diff $(DIFF))
 
 lint-baseline:
 	$(GRAFTLINT) --write-baseline
+
+check:
+	$(GRAFTLINT) --fail-on-stale --json-artifact $(LINT_ARTIFACT)
+	$(MAKE) tier1-budget
+	$(MAKE) obs-check
 
 tier1:
 	timeout -k 10 870 $(PYTEST_T1)

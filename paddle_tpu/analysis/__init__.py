@@ -11,9 +11,13 @@ Static half (import-light — ast/json only, no jax):
 
 Runtime half (jax imported lazily):
 
-    from paddle_tpu.analysis import sanitize
+    from paddle_tpu.analysis import sanitize, spmd_sanitize
     with sanitize(budget=0):          # steady state: zero recompiles
         engine.run()
+    with spmd_sanitize(n_ranks=8) as san:   # first (tracing) call only
+        step(batch)
+    san.verify()                      # all ranks agree on the collective
+                                      # schedule, or flight-dump + raise
 
 Rule catalog and suppression syntax: README §Static analysis; engine
 internals: graftlint.py / rules.py docstrings.
@@ -22,8 +26,11 @@ from .graftlint import (Finding, LintContext, ModuleInfo, Rule, RULES,
                         lint_paths, lint_sources, main, register_rule)
 from .sanitize import (RecompileBudgetError, instrument, jit_cache_size,
                        sanitize)
+from .spmd_sanitize import (CollectiveScheduleMismatch, SpmdSanitizer,
+                            spmd_sanitize)
 
 __all__ = ["Finding", "LintContext", "ModuleInfo", "Rule", "RULES",
            "lint_paths", "lint_sources", "main", "register_rule",
            "RecompileBudgetError", "instrument", "jit_cache_size",
-           "sanitize"]
+           "sanitize", "CollectiveScheduleMismatch", "SpmdSanitizer",
+           "spmd_sanitize"]

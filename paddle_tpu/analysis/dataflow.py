@@ -1,0 +1,551 @@
+"""Cross-module interprocedural machinery shared by the graftlint rules.
+
+graftlint v1 resolved calls by bare name *within one module*: a traced
+function calling a helper in another file left the helper unchecked, and
+none of the distributed rules (DIST001/DIST002/DONATE001) can even be
+stated without knowing which functions execute inside which SPMD region.
+This module builds ONE :class:`ProjectGraph` per lint run (cached on the
+:class:`~.graftlint.LintContext`) with:
+
+  * **defs + imports** — every function def in the project, plus a
+    per-module import map (``from x import f`` / ``import x.y as z``)
+    resolved against the other linted modules, so a call in ``a.py`` to a
+    name imported from ``b.py`` yields a real cross-module edge.
+  * **call edges** — ``callees(mod, fn)``: resolved targets of the calls
+    inside ``fn`` (same-module bare names, imported names, module-alias
+    attributes, ``self.method`` within the enclosing class).
+  * **traced closure** — the v1 jit-tracedness fixpoint (decorators,
+    ``jax.jit(f)`` call sites, ``# graftlint: jit`` markers, nesting)
+    closed over the *cross-module* call graph.
+  * **SPMD axis environments** — for every function reachable from a
+    ``shard_map``/``pmap`` call site (or marked ``# graftlint:
+    spmd=axis,...``), the set of mesh axis names bound while it runs.
+    Mesh axes are recovered from ``Mesh(..., ("dp", "mp"))`` /
+    ``build_mesh({"dp": ..})`` literals reached through local/module
+    assignments; an unresolvable mesh yields an UNKNOWN (``None``) env,
+    which downstream rules must treat as "don't check", never as empty.
+
+Everything is flow-insensitive and resolution failures always degrade to
+"unknown" — a lint pass must under-approximate, not guess.
+"""
+from __future__ import annotations
+
+import ast
+
+_FN_TYPES = (ast.FunctionDef, ast.AsyncFunctionDef)
+_JIT_NAMES = {"jit", "pjit"}
+
+# the SYNCHRONIZING collectives — one catalog shared by DIST002 (rules.py)
+# and the runtime schedule sanitizer (spmd_sanitize.py), so the static rule
+# and the recorder can never silently disagree about what stalls a gang.
+# axis_index/axis_size/pcast are per-rank reads and deliberately NOT here.
+SYNC_COLLECTIVES = ("psum", "pmean", "pmax", "pmin", "psum_scatter",
+                    "all_gather", "all_to_all", "ppermute", "pshuffle",
+                    "pbroadcast")
+
+# collective primitives and the index of their axis-name argument
+# (positional index; the axis may also arrive as the axis_name= kwarg) —
+# DIST001 additionally covers the non-synchronizing axis readers
+COLLECTIVE_AXIS_ARG = {**{name: 1 for name in SYNC_COLLECTIVES},
+                       "pcast": 1, "axis_index": 0, "axis_size": 0}
+
+# distributed/communication wrapper collectives (eager OR traced — both
+# synchronize the gang, so a rank-dependent branch around one deadlocks)
+COMM_WRAPPERS = {
+    "all_reduce", "all_gather", "reduce", "reduce_scatter", "broadcast",
+    "all_to_all", "all_to_all_single", "send", "recv", "isend", "irecv",
+    "batch_isend_irecv", "barrier",
+}
+
+SPMD_ENTRY_NAMES = {"shard_map", "pmap"}
+
+
+def callee_name(func) -> str:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return ""
+
+
+def _module_key(path: str):
+    """'a/b/c.py' -> ('a','b','c'); package __init__ collapses to the pkg."""
+    parts = path.replace("\\", "/").split("/")
+    if parts and parts[-1].endswith(".py"):
+        parts[-1] = parts[-1][:-3]
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return tuple(parts)
+
+
+def axis_literals(node):
+    """Axis names in a collective's axis argument: 'dp' -> {'dp'};
+    ('dp', 'mp') -> both; anything non-literal -> None (unknown)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = set()
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                out.add(e.value)
+            else:
+                return None
+        return out
+    return None
+
+
+def collective_axis_arg(call: ast.Call):
+    """(axis_expr or None) for a recognized lax collective call."""
+    name = callee_name(call.func)
+    pos = COLLECTIVE_AXIS_ARG.get(name)
+    if pos is None:
+        return None
+    for kw in call.keywords:
+        if kw.arg == "axis_name":
+            return kw.value
+    if len(call.args) > pos:
+        return call.args[pos]
+    return None
+
+
+def _dec_is_jit(dec) -> bool:
+    if callee_name(dec) in _JIT_NAMES:
+        return True
+    if isinstance(dec, ast.Call):
+        if callee_name(dec.func) in _JIT_NAMES:
+            return True
+        if callee_name(dec.func) == "partial":
+            return any(callee_name(a) in _JIT_NAMES for a in dec.args[:1])
+    return False
+
+
+def _jit_arg_names(call):
+    """Function names a jit(...) call traces: jit(f), jit(partial(f, ...)),
+    jit(lambda *a: f(*a, ...))."""
+    out = []
+    for a in call.args[:1]:
+        if isinstance(a, ast.Name):
+            out.append(a.id)
+        elif isinstance(a, ast.Call):
+            if callee_name(a.func) == "partial" and a.args \
+                    and isinstance(a.args[0], ast.Name):
+                out.append(a.args[0].id)
+        elif isinstance(a, ast.Lambda):
+            for n in ast.walk(a.body):
+                if isinstance(n, ast.Call) and isinstance(n.func, ast.Name):
+                    out.append(n.func.id)
+    return out
+
+
+def def_markers(mod, d):
+    """Markers attached to a def: any line of the signature counts (a
+    wrapped parameter list puts the trailing comment on a continuation
+    line, not d.lineno)."""
+    end = max(d.lineno + 1, d.body[0].lineno if d.body else d.lineno + 1)
+    out = set()
+    for ln in range(d.lineno, end):
+        out |= mod.markers.get(ln, set())
+    return out
+
+
+def marker_spmd_axes(markers):
+    """Axes declared by a `# graftlint: spmd=dp,mp` marker, or None."""
+    for m in markers:
+        if m.startswith("spmd="):
+            return {a.strip() for a in m[len("spmd="):].split(",")
+                    if a.strip()}
+    return None
+
+
+class ProjectGraph:
+    """The shared interprocedural view of one lint run (see module doc)."""
+
+    def __init__(self, ctx):
+        self.ctx = ctx
+        self.modules = list(ctx.modules)
+        self._mods_by_key = {_module_key(m.path): m for m in self.modules}
+        # per module: every def, bare-name index, enclosing class, parents
+        self.defs = {}            # mod -> [def, ...]
+        self.by_name = {}         # mod -> {name: [def, ...]}
+        self.enclosing_class = {}  # (id(mod), id(def)) -> ClassDef | None
+        self.parent = {}          # id(mod) -> {id(node): parent node}
+        self.imports = {}         # mod -> {local: (target_key, remote_name)}
+        self.mod_aliases = {}     # mod -> {alias: target_key}
+        self._fn_of_node = {}     # id(mod) -> {id(node): innermost def}
+        for mod in self.modules:
+            self._index_module(mod)
+        self._callees_cache = {}
+        self._traced = self._compute_traced()
+        self._spmd_envs = None
+
+    # -- indexing -----------------------------------------------------------
+    def _index_module(self, mod):
+        defs, by_name, parents = [], {}, {}
+        enclosing = {}
+        stack = [(mod.tree, None, None)]
+        while stack:
+            node, parent, cls = stack.pop()
+            if parent is not None:
+                parents[id(node)] = parent
+            if isinstance(node, _FN_TYPES):
+                defs.append(node)
+                by_name.setdefault(node.name, []).append(node)
+                enclosing[(id(mod), id(node))] = cls
+            nxt_cls = node if isinstance(node, ast.ClassDef) else \
+                (None if isinstance(node, _FN_TYPES) else cls)
+            for c in ast.iter_child_nodes(node):
+                stack.append((c, node, nxt_cls))
+        self.defs[mod] = defs
+        self.by_name[mod] = by_name
+        self.parent[id(mod)] = parents
+        self.enclosing_class.update(enclosing)
+
+        imports, aliases = {}, {}
+        key = _module_key(mod.path)
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ImportFrom):
+                if node.level:
+                    # relative: strip the module's own name + extra levels
+                    base = key[:len(key) - node.level] if node.level <= \
+                        len(key) else ()
+                else:
+                    base = ()
+                tgt = base + tuple((node.module or "").split(".")) \
+                    if (node.module or base) else base
+                tgt = tuple(p for p in tgt if p)
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    imports[a.asname or a.name] = (tgt, a.name)
+            elif isinstance(node, ast.Import):
+                for a in node.names:
+                    tgt = tuple(a.name.split("."))
+                    aliases[a.asname or a.name.split(".")[0]] = \
+                        tgt if a.asname else tgt[:1]
+        self.imports[mod] = imports
+        self.mod_aliases[mod] = aliases
+
+    def _fn_map(self, mod):
+        """{id(node): innermost enclosing def} for every node in `mod`."""
+        m = self._fn_of_node.get(id(mod))
+        if m is None:
+            m = {}
+            # walk outer defs first so nested defs overwrite their parent's
+            # claim on shared nodes — innermost wins
+            for d in sorted(self.defs[mod],
+                            key=lambda x: (x.lineno, -(x.end_lineno or 0))):
+                for n in ast.walk(d):
+                    if n is not d:
+                        m[id(n)] = d
+            self._fn_of_node[id(mod)] = m
+        return m
+
+    def enclosing_fn(self, mod, node):
+        return self._fn_map(mod).get(id(node))
+
+    # -- resolution ---------------------------------------------------------
+    def _resolve_in_module(self, mod, name, depth=0):
+        """Resolve `name` to (mod2, def) following re-export chains."""
+        if mod is None or depth > 4:
+            return []
+        cands = self.by_name.get(mod, {}).get(name)
+        if cands:
+            return [(mod, d) for d in cands]
+        imp = self.imports.get(mod, {}).get(name)
+        if imp is not None:
+            tgt = self._mods_by_key.get(imp[0])
+            return self._resolve_in_module(tgt, imp[1], depth + 1)
+        return []
+
+    def resolve_call(self, mod, call: ast.Call):
+        """Resolved (mod2, def2) targets of one Call (possibly several for
+        same-named defs; empty when unknown)."""
+        f = call.func
+        if isinstance(f, ast.Name):
+            return self._resolve_in_module(mod, f.id)
+        if isinstance(f, ast.Attribute):
+            v = f.value
+            if isinstance(v, ast.Name):
+                if v.id in ("self", "cls"):
+                    fn = self.enclosing_fn(mod, call)
+                    cls = self.enclosing_class.get((id(mod), id(fn))) \
+                        if fn is not None else None
+                    if cls is not None:
+                        return [(mod, d) for d in cls.body
+                                if isinstance(d, _FN_TYPES)
+                                and d.name == f.attr]
+                    return []
+                tgt_key = self.mod_aliases.get(mod, {}).get(v.id)
+                if tgt_key is None:
+                    imp = self.imports.get(mod, {}).get(v.id)
+                    if imp is not None:
+                        tgt_key = imp[0] + (imp[1],)
+                if tgt_key is not None:
+                    return self._resolve_in_module(
+                        self._mods_by_key.get(tgt_key), f.attr)
+        return []
+
+    def callees(self, mod, fndef):
+        """[(call, [(mod2, def2), ...]), ...] for the calls inside fndef
+        (nested defs excluded — they get their own entry)."""
+        k = (id(mod), id(fndef))
+        out = self._callees_cache.get(k)
+        if out is None:
+            out = []
+            for node in ast.walk(fndef):
+                if isinstance(node, ast.Call):
+                    inner = self.enclosing_fn(mod, node)
+                    if inner is not fndef:
+                        continue
+                    tgts = self.resolve_call(mod, node)
+                    if tgts:
+                        out.append((node, tgts))
+            self._callees_cache[k] = out
+        return out
+
+    # -- traced closure -----------------------------------------------------
+    def _compute_traced(self):
+        traced = set()                      # (id(mod), id(def))
+        index = {}                          # key -> (mod, def)
+        for mod in self.modules:
+            jit_called = set()
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Call) \
+                        and callee_name(node.func) in _JIT_NAMES:
+                    jit_called.update(_jit_arg_names(node))
+            for d in self.defs[mod]:
+                index[(id(mod), id(d))] = (mod, d)
+                if any(_dec_is_jit(x) for x in d.decorator_list) \
+                        or d.name in jit_called \
+                        or "jit" in def_markers(mod, d):
+                    traced.add((id(mod), id(d)))
+        work = list(traced)
+        while work:
+            key = work.pop()
+            mod, d = index[key]
+            new = []
+            for n in ast.walk(d):
+                # nesting: inner defs trace with their parent
+                if isinstance(n, _FN_TYPES) and n is not d:
+                    new.append((mod, n))
+                elif isinstance(n, ast.Call):
+                    # v1 same-module bare-name fallback + resolved edges
+                    if isinstance(n.func, ast.Name):
+                        new.extend((mod, c) for c in
+                                   self.by_name[mod].get(n.func.id, ()))
+                    new.extend(self.resolve_call(mod, n))
+            for mod2, d2 in new:
+                k2 = (id(mod2), id(d2))
+                if k2 not in traced:
+                    traced.add(k2)
+                    index[k2] = (mod2, d2)
+                    work.append(k2)
+        return traced
+
+    def is_traced(self, mod, fndef) -> bool:
+        return (id(mod), id(fndef)) in self._traced
+
+    def traced_defs(self, mod):
+        return [d for d in self.defs[mod] if self.is_traced(mod, d)]
+
+    def hot_defs(self, mod):
+        return [d for d in self.defs[mod]
+                if "hot" in def_markers(mod, d)]
+
+    # -- SPMD axis environments --------------------------------------------
+    def _resolve_name_value(self, mod, fndef, name, depth=0):
+        """Best-effort value expression for `name`: last assignment in the
+        enclosing function, else at module level."""
+        if depth > 3:
+            return None
+        scopes = ([fndef] if fndef is not None else []) + [mod.tree]
+        for scope in scopes:
+            found = None
+            body = ast.walk(scope) if scope is fndef else \
+                iter(scope.body if hasattr(scope, "body") else [])
+            for node in body:
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name) and t.id == name:
+                            found = node.value
+                elif isinstance(node, ast.AnnAssign) \
+                        and isinstance(node.target, ast.Name) \
+                        and node.target.id == name and node.value is not None:
+                    found = node.value
+            if found is not None:
+                return found
+        return None
+
+    def _mesh_axes(self, mod, fndef, expr, depth=0):
+        """Axis names of a mesh expression, or None when unresolvable."""
+        if expr is None or depth > 3:
+            return None
+        if isinstance(expr, ast.Name):
+            val = self._resolve_name_value(mod, fndef, expr.id)
+            return self._mesh_axes(mod, fndef, val, depth + 1)
+        if isinstance(expr, ast.Call):
+            name = callee_name(expr.func)
+            if name == "Mesh":
+                for kw in expr.keywords:
+                    if kw.arg == "axis_names":
+                        return axis_literals(kw.value)
+                if len(expr.args) > 1:
+                    return axis_literals(expr.args[1])
+                return None
+            if name == "build_mesh":
+                arg = expr.args[0] if expr.args else None
+                for kw in expr.keywords:
+                    if kw.arg in ("axes", "axis_sizes"):
+                        arg = kw.value
+                if isinstance(arg, ast.Dict):
+                    keys = set()
+                    for k in arg.keys:
+                        if isinstance(k, ast.Constant) \
+                                and isinstance(k.value, str):
+                            keys.add(k.value)
+                        else:
+                            return None
+                    return keys
+                if isinstance(arg, ast.Name):
+                    val = self._resolve_name_value(mod, fndef, arg.id)
+                    return self._mesh_axes(mod, fndef, val, depth + 1) \
+                        if isinstance(val, (ast.Dict, ast.Call)) else None
+                return None
+        return None
+
+    def _spmd_call_axes(self, mod, fndef, call):
+        """Bound axes of one shard_map/pmap call site, or None (unknown)."""
+        name = callee_name(call.func)
+        if name == "pmap":
+            for kw in call.keywords:
+                if kw.arg == "axis_name":
+                    return axis_literals(kw.value)
+            # pmap without axis_name binds no NAMED axis
+            return set()
+        mesh_expr = None
+        for kw in call.keywords:
+            if kw.arg == "mesh":
+                mesh_expr = kw.value
+        if mesh_expr is None and len(call.args) > 1:
+            mesh_expr = call.args[1]
+        return self._mesh_axes(mod, fndef, mesh_expr)
+
+    def _spmd_body_targets(self, mod, call):
+        """Defs traced by a shard_map/pmap call's body argument."""
+        out = []
+        for a in call.args[:1]:
+            if isinstance(a, ast.Name):
+                out.extend(self._resolve_in_module(mod, a.id))
+            elif isinstance(a, ast.Call) and callee_name(a.func) == "partial" \
+                    and a.args and isinstance(a.args[0], ast.Name):
+                out.extend(self._resolve_in_module(mod, a.args[0].id))
+            elif isinstance(a, ast.Lambda):
+                for n in ast.walk(a.body):
+                    if isinstance(n, ast.Call):
+                        out.extend(self.resolve_call(mod, n))
+        return out
+
+    def spmd_envs(self):
+        """{(id(mod), id(def)): axes-set | None} for every function
+        reachable from an SPMD entry (shard_map/pmap call site or a
+        `# graftlint: spmd=` marker).  ``None`` = reachable but the axis
+        set could not be resolved (rules must skip, not assume empty).
+        Functions NOT in the map are not known to run under SPMD."""
+        if self._spmd_envs is not None:
+            return self._spmd_envs
+        env = {}
+        index = {}
+
+        def add(mod, d, axes):
+            k = (id(mod), id(d))
+            index[k] = (mod, d)
+            if k in env:
+                old = env[k]
+                merged = None if (old is None or axes is None) \
+                    else (old | axes)
+                if merged != old:
+                    env[k] = merged
+                    return True
+                return False
+            env[k] = axes
+            return True
+
+        work = []
+        for mod in self.modules:
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Call) \
+                        and callee_name(node.func) in SPMD_ENTRY_NAMES:
+                    fn = self.enclosing_fn(mod, node)
+                    axes = self._spmd_call_axes(mod, fn, node)
+                    for mod2, d2 in self._spmd_body_targets(mod, node):
+                        if add(mod2, d2, axes):
+                            work.append((id(mod2), id(d2)))
+            for d in self.defs[mod]:
+                axes = marker_spmd_axes(def_markers(mod, d))
+                if axes is not None and add(mod, d, axes):
+                    work.append((id(mod), id(d)))
+        while work:
+            k = work.pop()
+            mod, d = index[k]
+            axes = env[k]
+            targets = []
+            for n in ast.walk(d):
+                if isinstance(n, _FN_TYPES) and n is not d:
+                    targets.append((mod, n))
+            for call, tgts in self.callees(mod, d):
+                targets.extend(tgts)
+            for mod2, d2 in targets:
+                # a callee's own spmd= marker is authoritative for it
+                if marker_spmd_axes(def_markers(mod2, d2)) is not None:
+                    continue
+                if add(mod2, d2, axes):
+                    work.append((id(mod2), id(d2)))
+        self._spmd_envs = env
+        return env
+
+    def spmd_env(self, mod, fndef, default="absent"):
+        """Axes bound while `fndef` runs: a set, None (reachable, unknown
+        axes), or `default` when the fn is not in any known SPMD region."""
+        return self.spmd_envs().get((id(mod), id(fndef)), default)
+
+    # -- misc helpers -------------------------------------------------------
+    def call_bindings(self, mod, fndef, param):
+        """String literals bound to `param` at resolved call sites of
+        `fndef`, paired with the calling function's SPMD env:
+        [(literal, caller_env), ...]."""
+        a = fndef.args
+        params = [p.arg for p in (*a.posonlyargs, *a.args)]
+        if params and params[0] in ("self", "cls"):
+            params = params[1:]
+        try:
+            pos = params.index(param)
+        except ValueError:
+            pos = None
+        kwonly = {p.arg for p in a.kwonlyargs}
+        out = []
+        for mod2 in self.modules:
+            for d2 in self.defs[mod2]:
+                for call, tgts in self.callees(mod2, d2):
+                    if not any(t[1] is fndef for t in tgts):
+                        continue
+                    bound = None
+                    if pos is not None and len(call.args) > pos:
+                        bound = call.args[pos]
+                    for kw in call.keywords:
+                        if kw.arg == param and (pos is not None
+                                                or param in kwonly):
+                            bound = kw.value
+                    if isinstance(bound, ast.Constant) \
+                            and isinstance(bound.value, str):
+                        out.append((bound.value,
+                                    self.spmd_env(mod2, d2)))
+        return out
+
+
+def project_graph(ctx) -> ProjectGraph:
+    """The per-run shared graph, built lazily and cached on the context."""
+    g = getattr(ctx, "_graftlint_graph", None)
+    if g is None:
+        g = ProjectGraph(ctx)
+        ctx._graftlint_graph = g
+    return g

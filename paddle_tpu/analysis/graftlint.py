@@ -20,7 +20,10 @@ Mechanics:
     jit-traced when the tracer cannot see it syntactically (a builder
     returning model fns that the serving engine jits later);
     ``# graftlint: hot`` declares an engine-step hot path (host code that
-    runs every serving step, where SYNC001 polices host syncs).
+    runs every serving step, where SYNC001 polices host syncs);
+    ``# graftlint: spmd=dp,mp`` declares the axis names bound while the
+    function runs, for SPMD regions the analyzer cannot see (a builder
+    whose product is shard_map'ped by the caller) — DIST001/DIST002 use it.
   * **Baseline** — ``graftlint.baseline.json`` at the repo root grandfathers
     pre-existing findings.  Entries match by (rule, file, stripped source
     line), so unrelated line-number churn never resurrects them, while a
@@ -55,7 +58,7 @@ __all__ = ["Finding", "ModuleInfo", "LintContext", "Rule", "RULES",
            "register_rule", "lint_paths", "lint_sources", "main"]
 
 _SUPPRESS_RE = re.compile(r"#\s*graftlint:\s*disable=([A-Za-z0-9_*,\s]+)")
-_MARKER_RE = re.compile(r"#\s*graftlint:\s*(jit|hot)\b")
+_MARKER_RE = re.compile(r"#\s*graftlint:\s*(jit|hot|spmd=[A-Za-z0-9_,]+)\b")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -333,12 +336,49 @@ def _report_json(res: LintResult, out):
     }, indent=2), file=out)
 
 
+def _changed_files(base_ref, paths, root):
+    """.py files changed vs `base_ref` (git), restricted to `paths`.
+    git prints paths relative to the repo TOPLEVEL, which is not
+    necessarily `root` (graftlint may run from a subdirectory, or with a
+    baseline below the toplevel) — resolve against the toplevel."""
+    import subprocess
+
+    def _git(cwd, *args):
+        proc = subprocess.run(["git", *args], cwd=str(cwd),
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.PIPE, text=True)
+        if proc.returncode != 0:
+            raise SystemExit(f"graftlint: git {' '.join(args)} failed: "
+                             f"{proc.stderr.strip()}")
+        return proc.stdout
+
+    top = Path(_git(root, "rev-parse", "--show-toplevel").strip())
+    # run the diff FROM the toplevel: both the printed names and the
+    # '*.py' pathspec are cwd-relative in git.  Untracked files are not
+    # in the diff but ARE new code — union them in, or a brand-new file
+    # with a violation would lint clean pre-commit.
+    names = _git(top, "diff", "--name-only", base_ref,
+                 "--", "*.py").splitlines()
+    names += _git(top, "ls-files", "--others", "--exclude-standard",
+                  "--", "*.py").splitlines()
+    changed = [top / ln for ln in names if ln.strip()]
+    scopes = [Path(p).resolve() for p in paths]
+    out = []
+    for f in changed:
+        fr = f.resolve()
+        if not fr.exists():
+            continue                      # deleted files have nothing to lint
+        if any(fr == s or s in fr.parents for s in scopes):
+            out.append(str(f))
+    return out
+
+
 def main(argv=None) -> int:
     _load_rules()
     ap = argparse.ArgumentParser(
         prog="graftlint",
-        description="trace-safety static analyzer (see README §Static "
-                    "analysis for the rule catalog)")
+        description="trace-safety + distributed/dataflow static analyzer "
+                    "(see README §Static analysis for the rule catalog)")
     ap.add_argument("paths", nargs="*", default=["paddle_tpu"],
                     help="files or directories to lint (default: paddle_tpu)")
     ap.add_argument("--baseline", default=None,
@@ -348,6 +388,17 @@ def main(argv=None) -> int:
     ap.add_argument("--format", choices=("text", "json"), default="text")
     ap.add_argument("--kernel-tests", default=None,
                     help="path to the Pallas parity test file (PAR001)")
+    ap.add_argument("--fail-on-stale", action="store_true",
+                    help="exit non-zero when baseline entries match nothing "
+                         "(the fix landed — delete the entry)")
+    ap.add_argument("--diff", metavar="BASE_REF", default=None,
+                    help="report only findings in .py files changed (or "
+                         "untracked) vs this git ref — pre-commit mode; "
+                         "the full path set is still parsed so "
+                         "interprocedural context is kept")
+    ap.add_argument("--json-artifact", metavar="PATH", default=None,
+                    help="additionally write the JSON report to PATH "
+                         "(the make-check artifact next to the BENCH jsons)")
     ap.add_argument("--list-rules", action="store_true")
     args = ap.parse_args(argv)
     if args.list_rules:
@@ -365,7 +416,42 @@ def main(argv=None) -> int:
         print(f"graftlint: wrote {len(res.new)} finding(s) to "
               f"{args.baseline}")
         return 0
+    diff_root = Path(args.baseline).resolve().parent if args.baseline \
+        else Path.cwd()
     res = lint_paths(paths, baseline=args.baseline,
-                     kernel_tests=args.kernel_tests)
+                     kernel_tests=args.kernel_tests,
+                     root=diff_root if args.diff is not None else None)
+    if args.diff is not None:
+        # diff mode lints the FULL path set (the interprocedural rules
+        # need the unchanged callers/shard_map sites/donor assignments for
+        # context, and staleness is only meaningful globally) but REPORTS
+        # only findings in the files changed vs the ref — the fast
+        # pre-commit contract
+        changed = {Path(f).resolve()
+                   for f in _changed_files(args.diff, paths, diff_root)}
+        res.new = [f for f in res.new
+                   if (diff_root / f.file).resolve() in changed]
     (_report_json if args.format == "json" else _report_text)(res, sys.stdout)
+    _write_artifact(args.json_artifact, res)
+    if res.stale and args.fail_on_stale:
+        # stderr: the stdout report may be machine-read (--format json)
+        print(f"graftlint: FAIL — {len(res.stale)} stale baseline "
+              f"entr{'y' if len(res.stale) == 1 else 'ies'} (the fix "
+              f"landed; delete them from the baseline)", file=sys.stderr)
+        return 1
     return 0 if res.ok else 1
+
+
+def _write_artifact(path, res: LintResult):
+    if not path:
+        return
+    doc = {
+        "schema": "graftlint-report-v1",
+        "summary": {"new": len(res.new), "baselined": len(res.baselined),
+                    "stale_baseline": len(res.stale), "ok": res.ok},
+        "rules": {rid: r.description for rid, r in sorted(RULES.items())},
+        "new": [dataclasses.asdict(f) for f in res.new],
+        "baselined": [dataclasses.asdict(f) for f in res.baselined],
+        "stale_baseline": res.stale,
+    }
+    Path(path).write_text(json.dumps(doc, indent=2) + "\n")

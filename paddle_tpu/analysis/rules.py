@@ -1,12 +1,16 @@
-"""graftlint rule catalog — the framework-specific trace-safety rules.
+"""graftlint rule catalog — trace-safety + distributed/dataflow correctness.
 
-Shared machinery first: *which functions are jit-traced* (decorated with
-jit, passed to a ``jax.jit(...)`` call, marked ``# graftlint: jit``, nested
-in / called from a traced function) and *which values are traced* (a cheap
-flow-insensitive taint pass seeded from positional parameters — keyword-only
-parameters are the codebase's static-knob convention and stay untainted;
-``.shape``/``.ndim``/``.dtype``/``len()``/``isinstance()`` results are
-static under trace and cut the taint).
+Shared machinery (``dataflow.ProjectGraph``, cached per lint run): *which
+functions are jit-traced* (decorated with jit, passed to a ``jax.jit(...)``
+call, marked ``# graftlint: jit``, nested in / called from a traced
+function — resolved across module boundaries through imports), *which
+values are traced* (a cheap flow-insensitive taint pass seeded from
+positional parameters — keyword-only parameters are the codebase's
+static-knob convention and stay untainted; ``.shape``/``.ndim``/
+``.dtype``/``len()``/``isinstance()`` results are static under trace and
+cut the taint), and *which functions run inside which SPMD region* (axis
+environments propagated from ``shard_map``/``pmap`` call sites or a
+``# graftlint: spmd=axis,...`` marker).
 
 Rules:
 
@@ -27,113 +31,35 @@ Rules:
   MUT001    mutation of captured python state (``self`` attribute writes,
             captured list/dict mutation) inside jit-traced function bodies
             — runs once at trace time, then never again
+  DIST001   a collective (``psum``/``all_gather``/``ppermute``/...)
+            referencing an axis name not bound by the enclosing
+            ``shard_map``/``pmap`` mesh, resolved interprocedurally
+            (literal axes checked against the propagated axis env;
+            parameter-passed axes resolved through literal call bindings)
+  DIST002   a collective reachable only under a rank-dependent python
+            branch (``if rank == 0: dist.broadcast(...)``) or inside a
+            ``lax.cond``/``lax.switch`` branch in an SPMD region — the
+            classic not-all-ranks-execute deadlock
+  DONATE001 use-after-donate: an array passed at a ``donate_argnums``
+            position of a donating jit and read again afterwards without
+            being rebound from the call's outputs (the engine's
+            ``_call_paged`` K/V-rebinding convention, checked)
+  DTYPE001  implicit dtype promotion in jit-traced / ``# graftlint: hot``
+            fns: mixed-precision binops (bf16 × f32) and float literals
+            that silently upcast int8/int4 operands to f32
 """
 from __future__ import annotations
 
 import ast
 
+from .dataflow import (COMM_WRAPPERS, SYNC_COLLECTIVES, axis_literals,
+                       callee_name, collective_axis_arg, project_graph)
 from .graftlint import Finding, Rule, register_rule
 
-_JIT_NAMES = {"jit", "pjit"}
 _STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding", "aval"}
 _STATIC_CALLS = {"len", "isinstance", "type", "hasattr", "getattr"}
 
-
-def _callee_is_jit(func) -> bool:
-    if isinstance(func, ast.Name):
-        return func.id in _JIT_NAMES
-    if isinstance(func, ast.Attribute):
-        return func.attr in _JIT_NAMES
-    return False
-
-
-def _dec_is_jit(dec) -> bool:
-    if _callee_is_jit(dec):
-        return True
-    if isinstance(dec, ast.Call):
-        if _callee_is_jit(dec.func):
-            return True                      # @jax.jit(static_argnums=...)
-        f = dec.func
-        if (isinstance(f, ast.Attribute) and f.attr == "partial") or \
-                (isinstance(f, ast.Name) and f.id == "partial"):
-            return any(_callee_is_jit(a) for a in dec.args[:1])
-    return False
-
-
-def _jit_arg_names(call):
-    """Function names a jit(...) call traces: jit(f), jit(partial(f, ...)),
-    jit(lambda *a: f(*a, ...))."""
-    out = []
-    for a in call.args[:1]:
-        if isinstance(a, ast.Name):
-            out.append(a.id)
-        elif isinstance(a, ast.Call):
-            f = a.func
-            is_partial = (isinstance(f, ast.Attribute) and f.attr == "partial") \
-                or (isinstance(f, ast.Name) and f.id == "partial")
-            if is_partial and a.args and isinstance(a.args[0], ast.Name):
-                out.append(a.args[0].id)
-        elif isinstance(a, ast.Lambda):
-            for n in ast.walk(a.body):
-                if isinstance(n, ast.Call) and isinstance(n.func, ast.Name):
-                    out.append(n.func.id)
-    return out
-
-
 _FN_TYPES = (ast.FunctionDef, ast.AsyncFunctionDef)
-
-
-def _def_markers(mod, d):
-    """Markers attached to a def: any line of the signature counts (a
-    wrapped parameter list puts the trailing comment on a continuation
-    line, not d.lineno)."""
-    end = max(d.lineno + 1, d.body[0].lineno if d.body else d.lineno + 1)
-    out = set()
-    for ln in range(d.lineno, end):
-        out |= mod.markers.get(ln, set())
-    return out
-
-
-def traced_functions(mod):
-    """The set of FunctionDef nodes graftlint considers jit-traced, closed
-    over (a) nesting and (b) the same-module call graph by bare name."""
-    cached = getattr(mod, "_graftlint_traced", None)
-    if cached is not None:
-        return cached
-    defs = [n for n in ast.walk(mod.tree) if isinstance(n, _FN_TYPES)]
-    by_name: dict[str, list] = {}
-    for d in defs:
-        by_name.setdefault(d.name, []).append(d)
-    jit_called = set()
-    for node in ast.walk(mod.tree):
-        if isinstance(node, ast.Call) and _callee_is_jit(node.func):
-            jit_called.update(_jit_arg_names(node))
-    traced = set()
-    for d in defs:
-        if any(_dec_is_jit(x) for x in d.decorator_list) \
-                or d.name in jit_called \
-                or "jit" in _def_markers(mod, d):
-            traced.add(d)
-    changed = True
-    while changed:
-        changed = False
-        for d in list(traced):
-            for n in ast.walk(d):
-                if isinstance(n, _FN_TYPES) and n is not d and n not in traced:
-                    traced.add(n)
-                    changed = True
-                if isinstance(n, ast.Call) and isinstance(n.func, ast.Name):
-                    for cand in by_name.get(n.func.id, ()):
-                        if cand not in traced:
-                            traced.add(cand)
-                            changed = True
-    mod._graftlint_traced = traced
-    return traced
-
-
-def hot_functions(mod):
-    return [n for n in ast.walk(mod.tree) if isinstance(n, _FN_TYPES)
-            and "hot" in _def_markers(mod, n)]
 
 
 def _names_skipping_static(node):
@@ -229,7 +155,7 @@ class TraceBranchRule(Rule):
                    "jnp.where / lax.cond / lax.while_loop")
 
     def check_module(self, mod, ctx):
-        for fn in traced_functions(mod):
+        for fn in project_graph(ctx).traced_defs(mod):
             tainted = tainted_names(fn)
             seen = set()
             for node in ast.walk(fn):
@@ -276,7 +202,8 @@ class HostSyncRule(Rule):
                    "hot paths")
 
     def check_module(self, mod, ctx):
-        traced = traced_functions(mod)
+        graph = project_graph(ctx)
+        traced = graph.traced_defs(mod)
         for fn in traced:
             tainted = tainted_names(fn)
             for node in ast.walk(fn):
@@ -294,7 +221,7 @@ class HostSyncRule(Rule):
                         self.id, mod.path, node.lineno,
                         f"host sync {kind} inside jit-traced `{fn.name}` — "
                         f"fails or silently falls out of the traced graph")
-        for fn in hot_functions(mod):
+        for fn in graph.hot_defs(mod):
             if fn in traced:
                 continue
             for node in ast.walk(fn):
@@ -491,7 +418,7 @@ class DataDepShapeRule(Rule):
                    "compile it; use a fixed-size jnp.where/mask form")
 
     def check_module(self, mod, ctx):
-        for fn in traced_functions(mod):
+        for fn in project_graph(ctx).traced_defs(mod):
             for node in ast.walk(fn):
                 if isinstance(node, ast.Call):
                     f = node.func
@@ -535,7 +462,7 @@ class CapturedMutationRule(Rule):
                    "executions")
 
     def check_module(self, mod, ctx):
-        for fn in traced_functions(mod):
+        for fn in project_graph(ctx).traced_defs(mod):
             loc = local_names(fn)
 
             def captured(root):
@@ -567,3 +494,609 @@ class CapturedMutationRule(Rule):
                             f"state inside jit-traced `{fn.name}` — "
                             f"happens once at trace time, silently skipped "
                             f"on cached calls")
+
+
+# ---------------------------------------------------------------------------
+# DIST001 — collective over an axis the enclosing mesh does not bind
+# ---------------------------------------------------------------------------
+@register_rule
+class CollectiveAxisRule(Rule):
+    id = "DIST001"
+    description = ("collective op (psum/all_gather/ppermute/axis_index/...) "
+                   "referencing an axis name not bound by the enclosing "
+                   "shard_map/pmap mesh — resolved interprocedurally; "
+                   "declare builder-time axes with `# graftlint: spmd=...`")
+
+    def check_module(self, mod, ctx):
+        graph = project_graph(ctx)
+        for fn in graph.defs[mod]:
+            env = graph.spmd_env(mod, fn)
+            if env == "absent" or env is None:
+                # not a known SPMD region / axes unresolvable: cannot
+                # under-approximate a violation, skip
+                continue
+            param_names = {p.arg for p in (*fn.args.posonlyargs,
+                                           *fn.args.args,
+                                           *fn.args.kwonlyargs)}
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call) \
+                        or graph.enclosing_fn(mod, node) is not fn:
+                    continue
+                axis_expr = collective_axis_arg(node)
+                if axis_expr is None:
+                    continue
+                cname = callee_name(node.func)
+                lits = axis_literals(axis_expr)
+                if lits is not None:
+                    missing = sorted(lits - env)
+                    if missing:
+                        yield Finding(
+                            self.id, mod.path, node.lineno,
+                            f"`{cname}` over axis "
+                            f"{', '.join(repr(a) for a in missing)} inside "
+                            f"`{fn.name}`, but the enclosing SPMD region "
+                            f"only binds {sorted(env)} — unbound axis "
+                            f"names fail at trace time (or hit the wrong "
+                            f"mesh axis)")
+                elif isinstance(axis_expr, ast.Name) \
+                        and axis_expr.id in param_names:
+                    # axis forwarded as a parameter: check the literal
+                    # bindings at resolved call sites against THIS fn's
+                    # propagated env (the union of every reaching region)
+                    for lit, _caller_env in graph.call_bindings(
+                            mod, fn, axis_expr.id):
+                        if lit not in env:
+                            yield Finding(
+                                self.id, mod.path, node.lineno,
+                                f"`{cname}` over axis parameter "
+                                f"`{axis_expr.id}` in `{fn.name}` is bound "
+                                f"to {lit!r} at a call site, but the "
+                                f"enclosing SPMD region only binds "
+                                f"{sorted(env)}")
+                            break
+
+
+# ---------------------------------------------------------------------------
+# DIST002 — collective under a rank-dependent / traced-conditional branch
+# ---------------------------------------------------------------------------
+_DIST002_COLLECTIVES = set(SYNC_COLLECTIVES)
+_RANK_SOURCES = {"axis_index", "process_index", "get_rank", "get_world_rank"}
+_RANK_NAMES = {"rank", "local_rank", "global_rank", "world_rank",
+               "trainer_id"}
+_RANK_ATTRS = {"rank", "local_rank", "process_index", "trainer_id"}
+_COND_NAMES = {"cond", "switch"}
+
+
+def _rank_names_in(fndef):
+    """Names in `fndef` holding rank-dependent values: the conventional
+    rank spellings plus anything assigned from axis_index()/process_index()
+    (one fixpoint pass)."""
+    ranky = set(_RANK_NAMES)
+    for _ in range(2):
+        for node in ast.walk(fndef):
+            if isinstance(node, ast.Assign) \
+                    and _expr_is_rank_dependent(node.value, ranky):
+                for t in node.targets:
+                    ranky.update(_target_names(t))
+    return ranky
+
+
+def _expr_is_rank_dependent(expr, ranky) -> bool:
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Call) and callee_name(n.func) in _RANK_SOURCES:
+            return True
+        if isinstance(n, ast.Name) and n.id in ranky:
+            return True
+        if isinstance(n, ast.Attribute) and n.attr in _RANK_ATTRS:
+            return True
+    return False
+
+
+def _is_comm_wrapper_call(mod, graph, node) -> bool:
+    """Call to a distributed/communication collective wrapper: the
+    `dist.all_reduce(...)` attribute idiom, or a bare name resolving to a
+    def in a distributed/communication module."""
+    name = callee_name(node.func)
+    if name not in COMM_WRAPPERS:
+        return False
+    if isinstance(node.func, ast.Attribute):
+        v = node.func.value
+        return isinstance(v, ast.Name) and v.id in ("dist", "distributed",
+                                                    "collectives", "comm")
+    for mod2, _d in graph.resolve_call(mod, node):
+        p = mod2.path
+        if "communication" in p or "distributed" in p:
+            return True
+    return False
+
+
+@register_rule
+class CollectiveBranchRule(Rule):
+    id = "DIST002"
+    description = ("collective reachable only under a rank-dependent "
+                   "python branch, or inside a lax.cond/lax.switch branch "
+                   "in an SPMD region — ranks that skip it deadlock the "
+                   "gang (not-all-ranks-execute)")
+
+    def _branch_guard(self, graph, mod, fn, node, ranky):
+        """Innermost If/While/IfExp ancestor whose TEST is rank-dependent
+        and whose body (not test) holds `node`."""
+        parents = graph.parent[id(mod)]
+        child, cur = node, parents.get(id(node))
+        while cur is not None and cur is not fn:
+            if isinstance(cur, (ast.If, ast.While, ast.IfExp)):
+                in_test = any(c is child for c in ast.walk(cur.test)) \
+                    or child is cur.test
+                if not in_test and _expr_is_rank_dependent(cur.test, ranky):
+                    return cur
+            child, cur = cur, parents.get(id(cur))
+        return None
+
+    def _in_cond_branch(self, graph, mod, fn, node):
+        """True when `node` sits inside a branch callable of a
+        lax.cond/lax.switch call (lambda lexically, or a local def passed
+        by name)."""
+        parents = graph.parent[id(mod)]
+        cur = parents.get(id(node))
+        lam = None
+        while cur is not None:
+            if isinstance(cur, ast.Lambda):
+                lam = cur
+            if isinstance(cur, ast.Call) \
+                    and callee_name(cur.func) in _COND_NAMES \
+                    and lam is not None and lam in cur.args[1:]:
+                return True
+            cur = parents.get(id(cur))
+        # named branch fns: is `fn` itself passed to a cond/switch?
+        for node2 in ast.walk(mod.tree):
+            if isinstance(node2, ast.Call) \
+                    and callee_name(node2.func) in _COND_NAMES:
+                for a in node2.args[1:]:
+                    if isinstance(a, ast.Name) and a.id == fn.name:
+                        return True
+        return False
+
+    def check_module(self, mod, ctx):
+        graph = project_graph(ctx)
+        for fn in graph.defs[mod]:
+            env = graph.spmd_env(mod, fn)
+            in_spmd = env != "absent"
+            ranky = None
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call) \
+                        or graph.enclosing_fn(mod, node) is not fn:
+                    continue
+                cname = callee_name(node.func)
+                is_wrap = _is_comm_wrapper_call(mod, graph, node)
+                is_lax = not is_wrap and cname in _DIST002_COLLECTIVES
+                if not (is_lax or is_wrap):
+                    continue
+                if ranky is None:
+                    ranky = _rank_names_in(fn)
+                guard = self._branch_guard(graph, mod, fn, node, ranky)
+                if guard is not None:
+                    yield Finding(
+                        self.id, mod.path, node.lineno,
+                        f"collective `{cname}` in `{fn.name}` executes "
+                        f"only under a rank-dependent branch (line "
+                        f"{guard.lineno}) — ranks that skip it leave the "
+                        f"gang waiting forever; run it unconditionally or "
+                        f"restructure with a uniform predicate")
+                elif in_spmd and is_lax \
+                        and self._in_cond_branch(graph, mod, fn, node):
+                    yield Finding(
+                        self.id, mod.path, node.lineno,
+                        f"collective `{cname}` inside a lax.cond/switch "
+                        f"branch in SPMD `{fn.name}` — ranks disagreeing "
+                        f"on the predicate deadlock; hoist the collective "
+                        f"out of the branch or prove the predicate "
+                        f"uniform with a disable comment")
+
+
+# ---------------------------------------------------------------------------
+# DONATE001 — use-after-donate
+# ---------------------------------------------------------------------------
+def _chain_text(node):
+    """'self._pages_k' for a Name/Attribute chain rooted at a Name,
+    else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _donate_positions(graph, mod, fn, expr, depth=0):
+    """Resolve a donate_argnums expression to a set of positions, or None
+    when unresolvable (the rule then skips that callable)."""
+    if expr is None or depth > 3:
+        return None
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, int):
+        return {expr.value}
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        out = set()
+        for e in expr.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                out.add(e.value)
+            else:
+                return None
+        return out
+    if isinstance(expr, ast.Call) and callee_name(expr.func) == "tuple" \
+            and expr.args and isinstance(expr.args[0], ast.Call) \
+            and callee_name(expr.args[0].func) == "range":
+        rargs = expr.args[0].args
+        if all(isinstance(a, ast.Constant) and isinstance(a.value, int)
+               for a in rargs):
+            vals = [a.value for a in rargs]
+            return set(range(*vals))
+        return None
+    if isinstance(expr, ast.IfExp):
+        a = _donate_positions(graph, mod, fn, expr.body, depth + 1)
+        b = _donate_positions(graph, mod, fn, expr.orelse, depth + 1)
+        if a is None or b is None:
+            return None
+        return a | b
+    if isinstance(expr, ast.Name):
+        val = graph._resolve_name_value(mod, fn, expr.id)
+        return _donate_positions(graph, mod, fn, val, depth + 1)
+    return None
+
+
+@register_rule
+class UseAfterDonateRule(Rule):
+    id = "DONATE001"
+    description = ("an array read again after being passed at a "
+                   "donate_argnums position — donation invalidates the "
+                   "buffer; rebind it from the call's outputs first (the "
+                   "engine's _call_paged K/V-rebinding convention)")
+
+    def _returned_donation(self, graph, mod, call):
+        """Positions donated by a builder the Assign calls: the
+        `self._step = self._build(...)` idiom, where _build RETURNS
+        `jax.jit(fn, donate_argnums=...)`."""
+        for mod2, d2 in graph.resolve_call(mod, call):
+            for node in ast.walk(d2):
+                if isinstance(node, ast.Return) \
+                        and isinstance(node.value, ast.Call):
+                    dkw = next((kw.value for kw in node.value.keywords
+                                if kw.arg == "donate_argnums"), None)
+                    if dkw is not None:
+                        return _donate_positions(graph, mod2, d2, dkw)
+        return None
+
+    def _donors(self, graph, mod):
+        """{key: (positions, label)} where key is ('local', id(fn), name)
+        or ('attr', id(class), attr) for callables built with
+        donate_argnums — assigned directly, or through a builder method
+        that returns the donating jit."""
+        donors = {}
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Assign) \
+                    or not isinstance(node.value, ast.Call):
+                continue
+            dkw = next((kw.value for kw in node.value.keywords
+                        if kw.arg == "donate_argnums"), None)
+            fn = graph.enclosing_fn(mod, node)
+            if dkw is not None:
+                pos = _donate_positions(graph, mod, fn, dkw)
+            else:
+                pos = self._returned_donation(graph, mod, node.value)
+            if not pos:
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    donors[("local", id(fn), t.id)] = (pos, t.id)
+                else:
+                    chain = _chain_text(t)
+                    if chain is not None and chain.startswith("self."):
+                        cls = graph.enclosing_class.get((id(mod), id(fn)))
+                        if cls is not None:
+                            donors[("attr", id(cls), chain)] = (pos, chain)
+        return donors
+
+    def _donor_of(self, graph, mod, donors, fn, func_expr):
+        """The donor record a call-target expression refers to, if any —
+        innermost binding wins, walking the lexical scope chain out to
+        module level (closures see enclosing-fn donors)."""
+        if isinstance(func_expr, ast.Name):
+            scope = fn
+            while True:
+                rec = donors.get(("local", id(scope), func_expr.id))
+                if rec is not None:
+                    return rec
+                if scope is None:
+                    return None
+                scope = graph.enclosing_fn(mod, scope)
+        chain = _chain_text(func_expr)
+        if chain is not None and chain.startswith("self."):
+            cls = graph.enclosing_class.get((id(mod), id(fn)))
+            if cls is not None:
+                return donors.get(("attr", id(cls), chain))
+        return None
+
+    def _enclosing_stmt(self, graph, mod, fn, node):
+        parents = graph.parent[id(mod)]
+        cur = node
+        while cur is not None and cur is not fn:
+            if isinstance(cur, ast.stmt):
+                return cur
+            cur = parents.get(id(cur))
+        return None
+
+    def _enclosing_loop(self, graph, mod, fn, stmt):
+        parents = graph.parent[id(mod)]
+        cur = parents.get(id(stmt))
+        while cur is not None and cur is not fn:
+            if isinstance(cur, (ast.For, ast.While)):
+                return cur
+            cur = parents.get(id(cur))
+        return None
+
+    def check_module(self, mod, ctx):
+        graph = project_graph(ctx)
+        donors = self._donors(graph, mod)
+        if not donors:
+            return
+        for fn in graph.defs[mod]:
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call) \
+                        or graph.enclosing_fn(mod, node) is not fn:
+                    continue
+                rec, shift = None, 0
+                if callee_name(node.func) == "_call_paged" and node.args:
+                    rec = self._donor_of(graph, mod, donors, fn,
+                                         node.args[0])
+                    shift = 1
+                if rec is None:
+                    rec = self._donor_of(graph, mod, donors, fn, node.func)
+                    shift = 0
+                if rec is None:
+                    continue
+                positions, label = rec
+                yield from self._check_call(graph, mod, fn, node,
+                                            positions, shift, label)
+
+    def _check_call(self, graph, mod, fn, call, positions, shift, label):
+        stmt = self._enclosing_stmt(graph, mod, fn, call)
+        if stmt is None:
+            return
+        for pos in sorted(positions):
+            i = pos + shift
+            if i >= len(call.args) or any(isinstance(a, ast.Starred)
+                                          for a in call.args[:i + 1]):
+                continue
+            chain = _chain_text(call.args[i])
+            if chain is None:
+                continue
+            # rebinding in the SAME statement (the _call_paged convention:
+            # `self._pages_k, ... = self._call_paged(...)`) is the fix
+            if isinstance(stmt, ast.Assign):
+                tgt_chains = set()
+                for t in stmt.targets:
+                    elts = t.elts if isinstance(t, (ast.Tuple, ast.List)) \
+                        else [t]
+                    for e in elts:
+                        c = _chain_text(e)
+                        if c is not None:
+                            tgt_chains.add(c)
+                if chain in tgt_chains:
+                    continue
+            in_call = {id(n) for n in ast.walk(call)}
+            call_end = (call.end_lineno or call.lineno,
+                        call.end_col_offset or 0)
+
+            def pos_after_call(n):
+                # evaluated AFTER the donating call: later line, or same
+                # line past the call's closing paren (`step(buf) + buf`)
+                return (n.lineno, n.col_offset) >= call_end
+
+            loads, all_loads, stores = [], [], []
+            for n in ast.walk(fn):
+                c = _chain_text(n) if isinstance(n, (ast.Name,
+                                                     ast.Attribute)) else None
+                if c != chain:
+                    continue
+                if isinstance(n.ctx, ast.Store):
+                    stores.append(n)
+                elif isinstance(n.ctx, ast.Load):
+                    all_loads.append(n)
+                    if id(n) not in in_call:
+                        loads.append(n)
+            offender = None
+            key = lambda n: (n.lineno, n.col_offset)
+            after_loads = [n for n in loads if pos_after_call(n)]
+            after_stores = [n for n in stores if pos_after_call(n)]
+            if after_loads:
+                first_load = min(after_loads, key=key)
+                first_store = min(after_stores, key=key) \
+                    if after_stores else None
+                if first_store is None or key(first_load) <= key(first_store):
+                    offender = first_load
+            if offender is None:
+                # a donating call inside a loop with NO rebinding of the
+                # chain anywhere in the loop body reads the dead buffer on
+                # the next iteration — the donated arg itself is the read
+                loop = self._enclosing_loop(graph, mod, fn, stmt)
+                if loop is not None:
+                    loop_end = loop.end_lineno or loop.lineno
+                    in_loop = lambda n: loop.lineno <= n.lineno <= loop_end
+                    if not any(in_loop(n) for n in stores):
+                        # the donated arg ITSELF is the next-iteration read
+                        wrap = [n for n in all_loads if in_loop(n)]
+                        if wrap:
+                            offender = min(wrap, key=key)
+            if offender is not None:
+                yield Finding(
+                    self.id, mod.path, offender.lineno,
+                    f"`{chain}` is read here but was donated to "
+                    f"`{label}` (donate_argnums position {pos}, line "
+                    f"{call.lineno}) — the buffer is invalidated by the "
+                    f"call; rebind it from the call's outputs before any "
+                    f"further use")
+
+
+# ---------------------------------------------------------------------------
+# DTYPE001 — implicit dtype promotion in jit/hot functions
+# ---------------------------------------------------------------------------
+_LOW_FLOATS = {"bfloat16", "float16", "float8_e4m3fn", "float8_e5m2"}
+_LOW_INTS = {"int8", "uint8", "int4", "uint4"}
+_WIDE_FLOATS = {"float32", "float64"}
+_DTYPE_WORDS = (_LOW_FLOATS | _LOW_INTS | _WIDE_FLOATS
+                | {"int16", "int32", "int64", "uint16", "uint32", "uint64"})
+_CREATION_FNS = {"zeros", "ones", "full", "empty", "asarray", "array",
+                 "arange"}
+_BINOPS = (ast.Add, ast.Sub, ast.Mult, ast.Div, ast.MatMult, ast.Pow,
+           ast.Mod, ast.FloorDiv)
+
+
+def _dtype_literal(node):
+    """'bfloat16' for jnp.bfloat16 / np.float32 / "bfloat16" / bare
+    bfloat16 — else None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+            and node.value in _DTYPE_WORDS:
+        return node.value
+    if isinstance(node, ast.Attribute) and node.attr in _DTYPE_WORDS:
+        return node.attr
+    if isinstance(node, ast.Name) and node.id in _DTYPE_WORDS:
+        return node.id
+    return None
+
+
+def _infer_dtype(node, env, depth=0):
+    """Best-effort dtype of an expression: a dtype word, 'weak_float' /
+    'weak_int' for python literals (jax weak types), or None."""
+    if depth > 8 or node is None:
+        return None
+    if isinstance(node, ast.Constant):
+        if isinstance(node.value, bool):
+            return None
+        if isinstance(node.value, float):
+            return "weak_float"
+        if isinstance(node.value, int):
+            return "weak_int"
+        return None
+    if isinstance(node, ast.Name):
+        return env.get(node.id)
+    if isinstance(node, ast.UnaryOp):
+        return _infer_dtype(node.operand, env, depth + 1)
+    if isinstance(node, ast.BinOp) and isinstance(node.op, _BINOPS):
+        return _promote(_infer_dtype(node.left, env, depth + 1),
+                        _infer_dtype(node.right, env, depth + 1))
+    if isinstance(node, ast.Call):
+        name = callee_name(node.func)
+        if name == "astype" and node.args:
+            return _dtype_literal(node.args[0])
+        if name in _DTYPE_WORDS:
+            return name                      # jnp.bfloat16(x) constructor
+        if name in _CREATION_FNS:
+            for kw in node.keywords:
+                if kw.arg == "dtype":
+                    return _dtype_literal(kw.value)
+            if name in ("asarray", "array") and len(node.args) > 1:
+                lit = _dtype_literal(node.args[1])
+                if lit is not None:
+                    return lit
+            # unparameterized creation: jnp default is STRONG float32 for
+            # float payloads (jnp.asarray(0.5) * bf16 silently upcasts)
+            if name in ("zeros", "ones", "empty"):
+                return "float32"
+            if name == "full":
+                # full's default dtype follows the FILL VALUE, not f32
+                if len(node.args) > 1 \
+                        and isinstance(node.args[1], ast.Constant) \
+                        and isinstance(node.args[1].value, float):
+                    return "float32"
+                return None
+            if name in ("asarray", "array") and node.args:
+                payload = node.args[0]
+                elts = payload.elts if isinstance(payload,
+                                                  (ast.List, ast.Tuple)) \
+                    else [payload]
+                if all(isinstance(e, ast.Constant)
+                       and isinstance(e.value, float) for e in elts):
+                    return "float32"
+            return None
+    return None
+
+
+def _promote(a, b):
+    if a is None or b is None:
+        return None
+    if a == b:
+        return a
+    for weak, other in ((a, b), (b, a)):
+        if weak == "weak_float":
+            return other if other not in _LOW_INTS | {"weak_int"} \
+                else "float32"
+        if weak == "weak_int":
+            return other
+    if {a, b} & _LOW_FLOATS and {a, b} & _WIDE_FLOATS:
+        return "float64" if "float64" in (a, b) else "float32"
+    if {a, b} & _LOW_INTS and {a, b} & _WIDE_FLOATS:
+        return "float64" if "float64" in (a, b) else "float32"
+    return None
+
+
+def _dtype_env(fndef):
+    """{name: dtype} from assignments, two fixpoint passes (mirrors the
+    taint pass)."""
+    env = {}
+    for _ in range(2):
+        for node in ast.walk(fndef):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                d = _infer_dtype(node.value, env)
+                if d is not None:
+                    env[node.targets[0].id] = d
+    return env
+
+
+@register_rule
+class DtypePromotionRule(Rule):
+    id = "DTYPE001"
+    description = ("implicit dtype promotion inside jit-traced / "
+                   "`# graftlint: hot` fns: a bf16/f16 × f32 binop, or a "
+                   "float literal / unparameterized float array mixed with "
+                   "an int8/int4 operand — silently upcasts and erases the "
+                   "low-precision win")
+
+    def check_module(self, mod, ctx):
+        graph = project_graph(ctx)
+        fns = list(graph.traced_defs(mod))
+        fns += [f for f in graph.hot_defs(mod) if f not in fns]
+        for fn in fns:
+            env = _dtype_env(fn)
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.BinOp) \
+                        or not isinstance(node.op, _BINOPS):
+                    continue
+                a = _infer_dtype(node.left, env)
+                b = _infer_dtype(node.right, env)
+                if a is None or b is None:
+                    continue
+                pair = {a, b}
+                low_f = pair & _LOW_FLOATS
+                low_i = pair & _LOW_INTS
+                if low_f and pair & _WIDE_FLOATS:
+                    lo, hi = next(iter(low_f)), next(iter(pair
+                                                         & _WIDE_FLOATS))
+                    yield Finding(
+                        self.id, mod.path, node.lineno,
+                        f"implicit promotion: {lo} × {hi} binop inside "
+                        f"jit `{fn.name}` silently upcasts to {hi} — cast "
+                        f"explicitly (or keep both operands {lo})")
+                elif low_i and (pair & _WIDE_FLOATS
+                                or "weak_float" in pair):
+                    lo = next(iter(low_i))
+                    other = next(iter(pair - low_i))
+                    what = "a float literal" if other == "weak_float" \
+                        else other
+                    yield Finding(
+                        self.id, mod.path, node.lineno,
+                        f"implicit promotion: {lo} operand mixed with "
+                        f"{what} inside jit `{fn.name}` upcasts to f32 — "
+                        f"the quantization win is silently erased; scale "
+                        f"in integer domain or cast deliberately")

@@ -1,0 +1,211 @@
+"""SPMD collective-schedule sanitizer — the runtime half of the
+distributed graftlint rules (DIST001/DIST002 are the static gate; this is
+the drillable detector).
+
+A multichip SPMD program deadlocks, silently corrupts, or hangs the whole
+gang when ranks disagree about the *sequence of collectives* they are
+about to run — one rank skipping a ``psum`` under a rank-dependent branch,
+or issuing it with a different shape/dtype, stalls every other rank
+forever with no error anywhere.  The sanitizer makes that class of bug a
+hard, attributable failure, the same way ``sanitize(0)`` made silent
+recompiles one:
+
+  * :func:`spmd_sanitize` is a context manager that patches the
+    ``jax.lax`` collectives (``psum``/``pmean``/``pmax``/``pmin``/
+    ``psum_scatter``/``all_gather``/``all_to_all``/``ppermute``/...) so
+    every call issued while the context is active — i.e. at **trace
+    time** of the program under test — records a ``(collective kind,
+    axis, shape, dtype)`` event, in issue order.  Wrap the *first* (cold,
+    tracing) call of the jitted step; warm calls never re-enter Python
+    and record nothing.
+  * :meth:`SpmdSanitizer.verify` materializes one schedule per rank and
+    asserts all ranks agree in order and signature.  Under a
+    single-controller virtual mesh (the 8-device multichip dryruns) every
+    rank runs the single recorded trace by construction, so a clean
+    program always passes; per-rank divergence — the multi-controller
+    failure mode — is drilled through the ``spmd.collective`` fault
+    point: a seeded ``FaultSpec(point="spmd.collective", action="trigger",
+    match={"rank": r}, at=k)`` drops rank *r*'s *k*-th collective from its
+    schedule exactly as a skipped branch would, and verify() must catch
+    it.
+  * A mismatch records a ``spmd_schedule_mismatch`` flight event (with
+    the active fault-plan context) and dumps the flight recorder BEFORE
+    raising :class:`CollectiveScheduleMismatch` — the PR 7
+    resilience→flight convention.
+
+The sanitizer performs no jit calls and adds no executables: recompile
+budgets and variant counts are untouched.
+"""
+from __future__ import annotations
+
+import contextlib
+
+from .dataflow import SYNC_COLLECTIVES
+
+__all__ = ["CollectiveScheduleMismatch", "SpmdSanitizer", "spmd_sanitize",
+           "COLLECTIVES"]
+
+# synchronizing collectives only (axis_index/axis_size/pcast are per-rank
+# reads and never stall the gang) — the ONE catalog shared with DIST002
+COLLECTIVES = SYNC_COLLECTIVES
+
+
+class CollectiveScheduleMismatch(RuntimeError):
+    """Ranks disagree on the collective schedule (order or signature) —
+    the program would deadlock on real hardware.  Carries the diverging
+    `rank`, event `index`, and the `expected`/`got` signatures."""
+
+    def __init__(self, msg, rank=None, index=None, expected=None, got=None):
+        super().__init__(msg)
+        self.rank = rank
+        self.index = index
+        self.expected = expected
+        self.got = got
+
+
+def _axis_of(kind, args, kwargs):
+    if "axis_name" in kwargs:
+        ax = kwargs["axis_name"]
+    elif len(args) > 1:
+        ax = args[1]
+    else:
+        ax = None
+    if isinstance(ax, (tuple, list)):
+        return ",".join(str(a) for a in ax)
+    return str(ax)
+
+
+def _sig_of(x):
+    """(shape, dtype) of a collective operand — works on tracers, arrays,
+    python scalars, and (first leaf of) pytrees."""
+    if isinstance(x, dict) and x:
+        x = next(iter(x.values()))
+    elif isinstance(x, (tuple, list)) and x:
+        x = x[0]
+    shape = getattr(x, "shape", None)
+    if shape is None:
+        shape = ()
+    dtype = getattr(x, "dtype", None)
+    if dtype is None:
+        dtype = type(x).__name__
+    return tuple(shape), str(dtype)
+
+
+class SpmdSanitizer:
+    """Recorded trace-order collective schedule + the per-rank verifier."""
+
+    def __init__(self, n_ranks=1, flight=None):
+        self.n_ranks = int(n_ranks)
+        self.flight = flight
+        self.events: list[tuple] = []     # (kind, axis, shape, dtype)
+
+    def _record(self, kind, args, kwargs):
+        op = args[0] if args else None
+        shape, dtype = _sig_of(op)
+        self.events.append((kind, _axis_of(kind, args, kwargs), shape,
+                            dtype))
+
+    # -- per-rank schedules -------------------------------------------------
+    def schedule_for_rank(self, rank: int) -> list:
+        """This rank's schedule: the recorded trace, minus any events a
+        seeded `spmd.collective` fault drops (emulating the rank skipping
+        the collective — the multi-controller divergence drill)."""
+        from paddle_tpu.resilience.faults import fault_point
+        out = []
+        for i, ev in enumerate(self.events):
+            spec = fault_point("spmd.collective", rank=int(rank), index=i,
+                               kind=ev[0])
+            if spec is not None:
+                continue                  # this rank skipped the collective
+            out.append(ev)
+        return out
+
+    def schedules(self) -> dict:
+        return {r: self.schedule_for_rank(r) for r in range(self.n_ranks)}
+
+    # -- verification -------------------------------------------------------
+    def verify(self):
+        """Assert every rank agrees on the collective schedule, in order
+        and signature.  Flight-records + dumps, then raises
+        :class:`CollectiveScheduleMismatch` on the first divergence."""
+        scheds = self.schedules()
+        ref = scheds.get(0, [])
+        for r in range(1, self.n_ranks):
+            s = scheds[r]
+            for i in range(max(len(ref), len(s))):
+                a = ref[i] if i < len(ref) else None
+                b = s[i] if i < len(s) else None
+                if a != b:
+                    self._mismatch(r, i, a, b)
+        return scheds
+
+    def _mismatch(self, rank, index, expected, got):
+        from paddle_tpu.resilience.faults import active_plan
+        plan = active_plan()
+        plan_ctx = None
+        if plan is not None:
+            plan_ctx = [{"point": s.point, "action": s.action,
+                         "match": dict(s.match), "at": s.at,
+                         "fired": s.fired} for s in plan.specs]
+        if self.flight is not None:
+            # the resilience→flight convention: the postmortem event (and
+            # the dump carrying the recent-event window) land BEFORE the
+            # raise, so a crashed run still has the evidence on disk
+            self.flight.record("spmd_schedule_mismatch", rank=int(rank),
+                               index=int(index),
+                               expected=repr(expected), got=repr(got),
+                               fault_plan=plan_ctx)
+            self.flight.dump("spmd_schedule_mismatch")
+        raise CollectiveScheduleMismatch(
+            f"SPMD collective-schedule mismatch at event {index}: rank "
+            f"{rank} ran {got!r} where rank 0 ran {expected!r} (schedule "
+            f"length {len(self.events)}) — on real hardware the gang "
+            f"deadlocks here; find the rank-dependent branch or "
+            f"shape/dtype skew"
+            + (f" [active fault plan: {plan_ctx}]" if plan_ctx else ""),
+            rank=rank, index=index, expected=expected, got=got)
+
+
+_ACTIVE: list[SpmdSanitizer] = []
+_PATCHED: dict = {}                 # name -> original, while depth > 0
+_DEPTH = 0
+
+
+def _wrap(kind, orig):
+    def wrapper(*args, **kwargs):
+        for s in _ACTIVE:
+            s._record(kind, args, kwargs)
+        return orig(*args, **kwargs)
+    wrapper.__name__ = f"spmd_sanitized_{kind}"
+    wrapper.__wrapped__ = orig
+    return wrapper
+
+
+@contextlib.contextmanager
+def spmd_sanitize(n_ranks=1, flight=None):
+    """Record the collective schedule issued (at trace time) inside the
+    context.  Yields the :class:`SpmdSanitizer`; call ``.verify()`` after
+    the block (or inspect ``.events``).  Nestable; patches ``jax.lax``
+    once for the outermost context."""
+    global _DEPTH
+    import jax
+
+    san = SpmdSanitizer(n_ranks=n_ranks, flight=flight)
+    if _DEPTH == 0:
+        for kind in COLLECTIVES:
+            orig = getattr(jax.lax, kind, None)
+            if orig is None or getattr(orig, "__wrapped__", None) is not None:
+                continue
+            _PATCHED[kind] = orig
+            setattr(jax.lax, kind, _wrap(kind, orig))
+    _DEPTH += 1
+    _ACTIVE.append(san)
+    try:
+        yield san
+    finally:
+        _ACTIVE.remove(san)
+        _DEPTH -= 1
+        if _DEPTH == 0:
+            while _PATCHED:
+                kind, orig = _PATCHED.popitem()
+                setattr(jax.lax, kind, orig)

@@ -572,19 +572,28 @@ def build_llama_decode(config: LlamaConfig, max_seq: int = None, dtype=None):
             "pos": jnp.zeros((), jnp.int32),
         }
 
-    def _block_step(lp, x, k_cache, v_cache, pos, n_valid):
+    def _rope_at(pos, T):
+        """Rope table slice [pos:pos+T] — static fast path for a host-int
+        pos (the dense-prefill pos=0 case), dynamic_slice for a traced
+        pos.  The isinstance dispatch is static under trace (a tracer is
+        an ndarray, a python int is not — no tracer bool conversion), and
+        the callers hoist it out of the layer scan: one slice per step,
+        not one per layer."""
+        if isinstance(pos, jnp.ndarray):
+            return (jax.lax.dynamic_slice_in_dim(sin_t, pos, T, 0),
+                    jax.lax.dynamic_slice_in_dim(cos_t, pos, T, 0))
+        return sin_t[pos:pos + T], cos_t[pos:pos + T]
+
+    def _block_step(lp, x, k_cache, v_cache, pos, n_valid, sin, cos):
         """One decoder block on x [B, T, H] with cache write at pos and
-        attention over cache[:, :n_valid]. Returns (x_out, k_cache, v_cache)."""
+        attention over cache[:, :n_valid]; sin/cos are the caller's rope
+        slice for [pos, pos+T). Returns (x_out, k_cache, v_cache)."""
         B, T, H = x.shape
         nh = c.num_attention_heads
         h = rms_norm_ref(x, lp["ln1"], c.rms_norm_eps)
         q = (h @ lp["wq"]).reshape(B, T, nh, head_dim)
         k = (h @ lp["wk"]).reshape(B, T, nkv, head_dim)
         v = (h @ lp["wv"]).reshape(B, T, nkv, head_dim)
-        sin = jax.lax.dynamic_slice_in_dim(sin_t, pos, T, 0) \
-            if isinstance(pos, jnp.ndarray) or pos != 0 else sin_t[:T]
-        cos = jax.lax.dynamic_slice_in_dim(cos_t, pos, T, 0) \
-            if isinstance(pos, jnp.ndarray) or pos != 0 else cos_t[:T]
         q = _apply_rope(q, sin, cos)
         k = _apply_rope(k, sin, cos)
         k_cache = jax.lax.dynamic_update_slice_in_dim(k_cache, k, pos, 1)
@@ -615,11 +624,12 @@ def build_llama_decode(config: LlamaConfig, max_seq: int = None, dtype=None):
         B, T = ids.shape
         cache = init_cache(B)
         x = ep["tok"][ids].astype(d)
+        sin, cos = _rope_at(0, T)
 
         def body(carry, layer_in):
             xc, = carry
             lp, kc, vc = layer_in
-            x_out, kc, vc = _block_step(lp, xc, kc, vc, 0, T)
+            x_out, kc, vc = _block_step(lp, xc, kc, vc, 0, T, sin, cos)
             return (x_out,), (kc, vc)
 
         (x,), (ks, vs) = jax.lax.scan(
@@ -633,11 +643,13 @@ def build_llama_decode(config: LlamaConfig, max_seq: int = None, dtype=None):
         B = tok.shape[0]
         pos = cache["pos"]
         x = ep["tok"][tok][:, None, :].astype(d)       # [B, 1, H]
+        sin, cos = _rope_at(pos, 1)
 
         def body(carry, layer_in):
             xc, = carry
             lp, kc, vc = layer_in
-            x_out, kc, vc = _block_step(lp, xc, kc, vc, pos, pos + 1)
+            x_out, kc, vc = _block_step(lp, xc, kc, vc, pos, pos + 1,
+                                        sin, cos)
             return (x_out,), (kc, vc)
 
         (x,), (ks, vs) = jax.lax.scan(

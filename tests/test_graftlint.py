@@ -558,3 +558,787 @@ class TestCliAndRepoGate:
                                           "test_pallas_kernels.py"))
         assert res.new == [], "\n".join(f.render() for f in res.new)
         assert res.stale == [], res.stale
+
+
+# ---------------------------------------------------------------------------
+# interprocedural engine (dataflow.ProjectGraph): cross-module resolution
+# ---------------------------------------------------------------------------
+class TestInterprocedural:
+    def test_traced_closure_crosses_modules(self):
+        # a helper imported from another file is traced when its caller is
+        res = lint_sources([
+            ("pkg/a.py", textwrap.dedent("""
+                import jax
+                from pkg.b import helper
+
+                @jax.jit
+                def f(x):
+                    return helper(x)
+            """)),
+            ("pkg/b.py", textwrap.dedent("""
+                def helper(v):
+                    if v > 1:
+                        return v
+                    return -v
+            """)),
+        ])
+        assert [(f.rule, f.file) for f in res.new] \
+            == [("TRACE001", "pkg/b.py")]
+
+    def test_relative_import_resolves(self):
+        res = lint_sources([
+            ("pkg/sub/a.py", textwrap.dedent("""
+                import jax
+                from ..b import helper
+
+                @jax.jit
+                def f(x):
+                    return helper(x)
+            """)),
+            ("pkg/b.py", textwrap.dedent("""
+                def helper(v):
+                    return v.item()
+            """)),
+        ])
+        assert [(f.rule, f.file) for f in res.new] \
+            == [("SYNC001", "pkg/b.py")]
+
+    def test_unresolvable_import_stays_quiet(self):
+        # a helper living OUTSIDE the linted set must not explode or flag
+        res = lint_sources([
+            ("pkg/a.py", textwrap.dedent("""
+                import jax
+                from somewhere_else import helper
+
+                @jax.jit
+                def f(x):
+                    return helper(x)
+            """)),
+        ])
+        assert res.new == []
+
+
+# ---------------------------------------------------------------------------
+# DIST001 — collective over an unbound mesh axis
+# ---------------------------------------------------------------------------
+DIST_PRELUDE = ("import jax\n"
+                "import numpy as np\n"
+                "from jax.sharding import Mesh, PartitionSpec as P\n"
+                "from jax import shard_map\n")
+
+
+def _lint_dist(src, **kw):
+    return lint_sources(
+        [("pkg/mod.py", DIST_PRELUDE + textwrap.dedent(src))], **kw)
+
+
+class TestDist001:
+    def test_positive_literal_axis_not_in_mesh(self):
+        res = _lint_dist("""
+            def run(x, devs):
+                mesh = Mesh(np.array(devs), ("dp", "mp"))
+
+                def body(x):
+                    return jax.lax.psum(x, "tp")
+
+                return shard_map(body, mesh=mesh, in_specs=(P("dp"),),
+                                 out_specs=P("dp"))(x)
+        """)
+        assert _rules(res) == ["DIST001"]
+        assert "'tp'" in res.new[0].message
+
+    def test_positive_interprocedural_helper(self):
+        # the collective lives in a helper CALLED from the shard_map body;
+        # the axis env propagates through the call edge
+        res = _lint_dist("""
+            def reduce_part(v):
+                return jax.lax.psum(v, "model")
+
+            def run(x, devs):
+                mesh = Mesh(np.array(devs), ("dp",))
+
+                def body(x):
+                    return reduce_part(x)
+
+                return shard_map(body, mesh=mesh, in_specs=(P("dp"),),
+                                 out_specs=P("dp"))(x)
+        """)
+        assert _rules(res) == ["DIST001"]
+
+    def test_positive_axis_param_bound_to_bad_literal(self):
+        res = _lint_dist("""
+            def reduce_over(v, axis):
+                return jax.lax.psum(v, axis)
+
+            def run(x, devs):
+                mesh = Mesh(np.array(devs), ("dp",))
+
+                def body(x):
+                    return reduce_over(x, "model")
+
+                return shard_map(body, mesh=mesh, in_specs=(P("dp"),),
+                                 out_specs=P("dp"))(x)
+        """)
+        assert _rules(res) == ["DIST001"]
+        assert "'model'" in res.new[0].message
+
+    def test_positive_spmd_marker_binds_axes(self):
+        res = _lint("""
+            import jax
+
+            def body(x):  # graftlint: spmd=dp
+                return jax.lax.all_gather(x, "mp")
+        """)
+        assert _rules(res) == ["DIST001"]
+
+    def test_negative_bound_axis_and_build_mesh_dict(self):
+        res = _lint_dist("""
+            def run(x, devs, build_mesh):
+                mesh = build_mesh({"dp": 2, "mp": 4})
+
+                def body(x):
+                    y = jax.lax.psum(x, "dp")
+                    return jax.lax.ppermute(y, "mp",
+                                            [(0, 1), (1, 0)])
+
+                return shard_map(body, mesh=mesh, in_specs=(P("dp"),),
+                                 out_specs=P("dp"))(x)
+        """)
+        assert res.new == []
+
+    def test_negative_unknown_mesh_skips(self):
+        # the mesh is a runtime parameter — env unknown, never guess
+        res = _lint_dist("""
+            def run(x, mesh):
+                def body(x):
+                    return jax.lax.psum(x, "anything")
+
+                return shard_map(body, mesh=mesh, in_specs=(P("dp"),),
+                                 out_specs=P("dp"))(x)
+        """)
+        assert res.new == []
+
+    def test_negative_outside_spmd_region(self):
+        res = _lint("""
+            import jax
+
+            def helper(x):
+                return jax.lax.psum(x, "dp")     # caller context unknown
+        """)
+        assert res.new == []
+
+    def test_suppressed(self):
+        res = _lint("""
+            import jax
+
+            def body(x):  # graftlint: spmd=dp
+                return jax.lax.psum(x, "mp")  # graftlint: disable=DIST001
+        """)
+        assert res.new == []
+
+    def test_baseline_matched(self):
+        src = textwrap.dedent("""
+            import jax
+
+            def body(x):  # graftlint: spmd=dp
+                return jax.lax.psum(x, "mp")
+        """)
+        entries = [{"rule": "DIST001", "file": "pkg/mod.py",
+                    "snippet": 'return jax.lax.psum(x, "mp")',
+                    "justification": "grandfathered"}]
+        res = lint_sources([("pkg/mod.py", src)], baseline_entries=entries)
+        assert res.new == [] and len(res.baselined) == 1
+
+
+# ---------------------------------------------------------------------------
+# DIST002 — collective under a rank-dependent / cond branch
+# ---------------------------------------------------------------------------
+class TestDist002:
+    def test_positive_axis_index_branch(self):
+        res = _lint("""
+            import jax
+
+            def body(x):  # graftlint: spmd=dp
+                r = jax.lax.axis_index("dp")
+                if r == 0:
+                    x = jax.lax.psum(x, "dp")
+                return x
+        """)
+        assert _rules(res) == ["DIST002"]
+
+    def test_positive_host_rank_branch_around_wrapper(self):
+        # the classic multi-controller deadlock: only rank 0 calls the
+        # eager collective wrapper — every other rank waits forever
+        res = _lint("""
+            def sync(t, rank):
+                if rank == 0:
+                    dist.all_reduce(t)
+                return t
+        """)
+        assert _rules(res) == ["DIST002"]
+
+    def test_positive_collective_in_cond_branch(self):
+        res = _lint("""
+            import jax
+
+            def body(x, flag):  # graftlint: spmd=dp
+                return jax.lax.cond(
+                    flag,
+                    lambda v: jax.lax.psum(v, "dp"),
+                    lambda v: v,
+                    x)
+        """)
+        assert _rules(res) == ["DIST002"]
+
+    def test_negative_unconditional_and_static_knob(self):
+        res = _lint("""
+            import jax
+
+            def body(x, *, causal=True):  # graftlint: spmd=dp
+                y = jax.lax.psum(x, "dp")         # unconditional: fine
+                if causal:                         # static knob branch
+                    y = y * 2
+                return y
+        """)
+        assert res.new == []
+
+    def test_negative_cond_outside_spmd_region(self):
+        res = _lint("""
+            import jax
+
+            def host(x, flag):
+                return jax.lax.cond(
+                    flag, lambda v: jax.lax.psum(v, "dp"),
+                    lambda v: v, x)
+        """)
+        assert res.new == []
+
+    def test_suppressed(self):
+        res = _lint("""
+            import jax
+
+            def body(x):  # graftlint: spmd=dp
+                r = jax.lax.axis_index("dp")
+                if r == 0:
+                    # uniform by construction in this drill
+                    x = jax.lax.psum(x, "dp")  # graftlint: disable=DIST002
+                return x
+        """)
+        assert res.new == []
+
+
+# ---------------------------------------------------------------------------
+# DONATE001 — use-after-donate
+# ---------------------------------------------------------------------------
+class TestDonate001:
+    def test_positive_read_after_donating_call(self):
+        res = _lint("""
+            import jax
+
+            def build(f):
+                step = jax.jit(f, donate_argnums=(0,))
+
+                def run(buf, y):
+                    out = step(buf, y)
+                    return out + buf
+                return run
+        """)
+        assert _rules(res) == ["DONATE001"]
+        assert "`buf`" in res.new[0].message
+
+    def test_positive_engine_attr_without_rebind(self):
+        res = _lint("""
+            import jax
+
+            class Engine:
+                def __init__(self, fn):
+                    self._chunk = jax.jit(fn, donate_argnums=(1, 2))
+
+                def step(self, x):
+                    out = self._chunk(x, self._pk, self._pv)
+                    return out + self._pk.sum() + self._pv.sum()
+        """)
+        assert _rules(res) == ["DONATE001", "DONATE001"]
+        assert "`self._pk`" in res.new[0].message
+        assert "`self._pv`" in res.new[1].message
+
+    def test_positive_donating_call_in_loop_without_rebind(self):
+        res = _lint("""
+            import jax
+
+            def drive(f, buf, xs):
+                step = jax.jit(f, donate_argnums=(0,))
+                for x in xs:
+                    y = step(buf, x)
+                return y
+        """)
+        assert _rules(res) == ["DONATE001"]
+
+    def test_negative_call_paged_same_statement_rebind(self):
+        # the engine's _call_paged convention: donated K/V page buffers
+        # rebound from the call's outputs IN the call statement
+        res = _lint("""
+            import jax
+
+            class Engine:
+                def __init__(self, fn):
+                    self._chunk = jax.jit(fn, donate_argnums=(1, 2))
+
+                def _call_paged(self, fn, *args):
+                    return fn(*args)
+
+                def step(self, x):
+                    out, self._pk, self._pv = self._call_paged(
+                        self._chunk, x, self._pk, self._pv)
+                    return out + self._pk.sum()
+        """)
+        assert res.new == []
+
+    def test_negative_rebound_before_read_and_loop_rebind(self):
+        res = _lint("""
+            import jax
+
+            def drive(f, buf, xs):
+                step = jax.jit(f, donate_argnums=(0,))
+                for x in xs:
+                    buf = step(buf, x)
+                out = step(buf, xs[0])
+                buf = out
+                return buf
+        """)
+        assert res.new == []
+
+    def test_positive_builder_returned_jit(self):
+        # the ShardedTrainStep idiom: self._step = self._build(donate)
+        # where _build RETURNS jax.jit(stepper, donate_argnums=...)
+        res = _lint("""
+            import jax
+
+            class Step:
+                def __init__(self, fn, donate):
+                    self._fn = fn
+                    self._step = self._build(donate)
+
+                def _build(self, donate):
+                    return jax.jit(self._fn,
+                                   donate_argnums=(0, 1) if donate else ())
+
+                def run(self, params, opt, batch):
+                    loss = self._step(params, opt, batch)
+                    return loss, params
+        """)
+        assert _rules(res) == ["DONATE001"]
+        assert "`params`" in res.new[0].message
+
+    def test_negative_builder_returned_jit_rebinds(self):
+        res = _lint("""
+            import jax
+
+            class Step:
+                def __init__(self, fn, donate):
+                    self._fn = fn
+                    self._step = self._build(donate)
+
+                def _build(self, donate):
+                    return jax.jit(self._fn,
+                                   donate_argnums=(0, 1) if donate else ())
+
+                def run(self, batch):
+                    self.params, self.opt_state, loss = self._step(
+                        self.params, self.opt_state, batch)
+                    return loss
+        """)
+        assert res.new == []
+
+    def test_negative_unresolvable_donate_positions_skip(self):
+        res = _lint("""
+            import jax
+
+            def build(f, positions):
+                step = jax.jit(f, donate_argnums=positions)
+
+                def run(buf, y):
+                    out = step(buf, y)
+                    return out + buf
+                return run
+        """)
+        assert res.new == []
+
+    def test_positive_ternary_donate_args_resolve(self):
+        # the pipeline idiom: donate_args = tuple(range(6)) if donate
+        # else () — the union of the arms is checked
+        res = _lint("""
+            import jax
+
+            def build(f, donate):
+                donate_args = tuple(range(2)) if donate else ()
+                step = jax.jit(f, donate_argnums=donate_args)
+
+                def run(a, b):
+                    out = step(a, b)
+                    return out + b
+                return run
+        """)
+        assert _rules(res) == ["DONATE001"]
+
+    def test_positive_same_statement_read(self):
+        # the one-liner shape: the donated buffer is an operand of the
+        # SAME statement as the donating call — still a read of a dead
+        # buffer (evaluated after the call returns)
+        res = _lint("""
+            import jax
+
+            def build(f):
+                step = jax.jit(f, donate_argnums=(0,))
+
+                def run(buf, y):
+                    return step(buf, y) + buf
+                return run
+        """)
+        assert _rules(res) == ["DONATE001"]
+
+    def test_negative_read_before_call_same_statement(self):
+        # evaluated BEFORE the call: python evaluates left-to-right
+        res = _lint("""
+            import jax
+
+            def build(f):
+                step = jax.jit(f, donate_argnums=(0,))
+
+                def run(buf, y):
+                    return buf + step(buf, y)
+                return run
+        """)
+        assert res.new == []
+
+    def test_suppressed(self):
+        res = _lint("""
+            import jax
+
+            def build(f):
+                step = jax.jit(f, donate_argnums=(0,))
+
+                def run(buf, y):
+                    out = step(buf, y)
+                    # aliasing is safe on this backend, measured
+                    return out + buf  # graftlint: disable=DONATE001
+                return run
+        """)
+        assert res.new == []
+
+
+# ---------------------------------------------------------------------------
+# DTYPE001 — implicit dtype promotion under jit
+# ---------------------------------------------------------------------------
+class TestDtype001:
+    def test_positive_mixed_precision_binop(self):
+        res = _lint("""
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def f(x, w):
+                a = x.astype(jnp.bfloat16)
+                b = w.astype(jnp.float32)
+                return a * b
+        """)
+        assert _rules(res) == ["DTYPE001"]
+        assert "bfloat16" in res.new[0].message
+
+    def test_positive_int8_times_float_literal(self):
+        res = _lint("""
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def f(q):
+                z = q.astype(jnp.int8)
+                return z * 0.5
+        """)
+        assert _rules(res) == ["DTYPE001"]
+        assert "quantization" in res.new[0].message
+
+    def test_positive_unparameterized_float_array(self):
+        # jnp.asarray(0.5) is STRONG float32 — mixing it with bf16 upcasts
+        res = _lint("""
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def f(x):
+                a = x.astype(jnp.bfloat16)
+                scale = jnp.asarray(0.5)
+                return a * scale
+        """)
+        assert _rules(res) == ["DTYPE001"]
+
+    def test_full_dtype_follows_fill_value(self):
+        # jnp.full's default dtype comes from the FILL VALUE: an int fill
+        # is int32 (no promotion vs bf16 to flag); a float fill is f32
+        res = _lint("""
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def f(x):
+                a = x.astype(jnp.bfloat16)
+                ok = a * jnp.full((4,), 2)
+                bad = a * jnp.full((4,), 2.0)
+                return ok, bad
+        """)
+        assert [(f.rule, f.line) for f in res.new] == [("DTYPE001", 9)]
+
+    def test_negative_weak_literal_and_aligned_dtypes(self):
+        res = _lint("""
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def f(x, w):
+                a = x.astype(jnp.bfloat16)
+                ok1 = a * 2.0                     # weak literal: stays bf16
+                b = w.astype(jnp.bfloat16)
+                ok2 = a + b                       # aligned
+                c = w.astype(jnp.float32)
+                ok3 = c / jnp.asarray(3.0)        # f32 x f32
+                return ok1, ok2, ok3
+        """)
+        assert res.new == []
+
+    def test_negative_outside_jit(self):
+        res = _lint("""
+            import jax.numpy as jnp
+
+            def host(x):
+                return x.astype(jnp.bfloat16) * jnp.asarray(0.5)
+        """)
+        assert res.new == []
+
+    def test_positive_on_hot_path(self):
+        res = _lint("""
+            import jax.numpy as jnp
+
+            class Engine:
+                def step(self, x):  # graftlint: hot
+                    q = x.astype(jnp.int8)
+                    return q * jnp.asarray(0.125)
+        """)
+        assert _rules(res) == ["DTYPE001"]
+
+    def test_suppressed(self):
+        res = _lint("""
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def f(x, w):
+                a = x.astype(jnp.bfloat16)
+                b = w.astype(jnp.float32)
+                # deliberate accumulation in f32
+                return a * b  # graftlint: disable=DTYPE001
+        """)
+        assert res.new == []
+
+
+# ---------------------------------------------------------------------------
+# CLI v2: stale-entry failure, --diff mode, JSON artifact
+# ---------------------------------------------------------------------------
+class TestCliV2:
+    BAD = ("import jax\n\n@jax.jit\ndef f(x):\n"
+           "    if x > 0:\n        return x\n    return -x\n")
+
+    def test_fail_on_stale_exits_nonzero(self, tmp_path, capsys):
+        bad = tmp_path / "bad.py"
+        bad.write_text(self.BAD)
+        base = tmp_path / "base.json"
+        assert lint_main([str(bad), "--baseline", str(base),
+                          "--write-baseline"]) == 0
+        bad.write_text("x = 1\n")                       # fix lands
+        assert lint_main([str(bad), "--baseline", str(base)]) == 0
+        assert lint_main([str(bad), "--baseline", str(base),
+                          "--fail-on-stale"]) == 1      # stale must fail
+        capsys.readouterr()
+
+    def test_json_artifact_written(self, tmp_path, capsys):
+        bad = tmp_path / "bad.py"
+        bad.write_text(self.BAD)
+        art = tmp_path / "report.json"
+        assert lint_main([str(bad), "--json-artifact", str(art)]) == 1
+        doc = json.loads(art.read_text())
+        assert doc["schema"] == "graftlint-report-v1"
+        assert doc["summary"]["new"] == 1 and not doc["summary"]["ok"]
+        assert doc["new"][0]["rule"] == "TRACE001"
+        assert "DIST001" in doc["rules"] and "DONATE001" in doc["rules"]
+        capsys.readouterr()
+
+    def test_diff_mode_lints_only_changed_files(self, tmp_path, capsys):
+        import subprocess
+
+        def git(*args):
+            r = subprocess.run(["git", *args], cwd=tmp_path,
+                               stdout=subprocess.PIPE,
+                               stderr=subprocess.STDOUT, text=True)
+            assert r.returncode == 0, r.stdout
+
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        clean = pkg / "clean.py"
+        dirty = pkg / "dirty.py"
+        clean.write_text(self.BAD)          # pre-existing violation...
+        dirty.write_text("x = 1\n")
+        git("init", "-q")
+        git("config", "user.email", "t@t")
+        git("config", "user.name", "t")
+        git("add", "-A")
+        git("commit", "-qm", "seed")
+        dirty.write_text(self.BAD)          # ...and a NEW one in the diff
+        import os
+        cwd = os.getcwd()
+        os.chdir(tmp_path)
+        try:
+            assert lint_main(["pkg", "--diff", "HEAD"]) == 1
+            out = capsys.readouterr().out
+            assert "dirty.py" in out and "clean.py" not in out
+            # an untouched tree lints clean in diff mode
+            git("add", "-A")
+            git("commit", "-qm", "second")
+            assert lint_main(["pkg", "--diff", "HEAD"]) == 0
+            capsys.readouterr()
+            # an UNTRACKED new file with a violation must still fail —
+            # pre-commit runs before `git add`
+            (pkg / "brand_new.py").write_text(self.BAD)
+            assert lint_main(["pkg", "--diff", "HEAD"]) == 1
+            assert "brand_new.py" in capsys.readouterr().out
+        finally:
+            os.chdir(cwd)
+        capsys.readouterr()
+
+    def test_diff_mode_keeps_cross_module_context(self, tmp_path, capsys):
+        # the changed file's violation is only visible THROUGH the
+        # unchanged caller (jit + import edge): diff mode must lint with
+        # the full project graph and only FILTER the report
+        import os
+        import subprocess
+
+        def git(*args):
+            r = subprocess.run(["git", *args], cwd=tmp_path,
+                               stdout=subprocess.PIPE,
+                               stderr=subprocess.STDOUT, text=True)
+            assert r.returncode == 0, r.stdout
+
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "caller.py").write_text(textwrap.dedent("""
+            import jax
+            from pkg.helper import helper
+
+            @jax.jit
+            def f(x):
+                return helper(x)
+        """))
+        helper = pkg / "helper.py"
+        helper.write_text("def helper(v):\n    return v\n")
+        cwd = os.getcwd()
+        os.chdir(tmp_path)
+        try:
+            git("init", "-q")
+            git("config", "user.email", "t@t")
+            git("config", "user.name", "t")
+            git("add", "-A")
+            git("commit", "-qm", "seed")
+            helper.write_text(textwrap.dedent("""
+                def helper(v):
+                    if v > 1:
+                        return v
+                    return -v
+            """))
+            assert lint_main(["pkg", "--diff", "HEAD"]) == 1
+            out = capsys.readouterr().out
+            assert "helper.py" in out and "TRACE001" in out
+        finally:
+            os.chdir(cwd)
+        capsys.readouterr()
+
+    def test_diff_mode_from_subdirectory(self, tmp_path, capsys):
+        # git prints toplevel-relative paths; linting from a SUBDIRECTORY
+        # must still resolve them (a silent 'no files changed' here would
+        # green-light a real violation)
+        import os
+        import subprocess
+
+        def git(*args):
+            r = subprocess.run(["git", *args], cwd=tmp_path,
+                               stdout=subprocess.PIPE,
+                               stderr=subprocess.STDOUT, text=True)
+            assert r.returncode == 0, r.stdout
+
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (tmp_path / "sub").mkdir()
+        f = pkg / "dirty.py"
+        f.write_text("x = 1\n")
+        git("init", "-q")
+        git("config", "user.email", "t@t")
+        git("config", "user.name", "t")
+        git("add", "-A")
+        git("commit", "-qm", "seed")
+        f.write_text(self.BAD)
+        cwd = os.getcwd()
+        os.chdir(tmp_path / "sub")
+        try:
+            assert lint_main(["../pkg", "--diff", "HEAD"]) == 1
+            assert "dirty.py" in capsys.readouterr().out
+        finally:
+            os.chdir(cwd)
+
+    def test_fail_on_stale_keeps_json_stdout_clean(self, tmp_path, capsys):
+        bad = tmp_path / "bad.py"
+        bad.write_text(self.BAD)
+        base = tmp_path / "base.json"
+        assert lint_main([str(bad), "--baseline", str(base),
+                          "--write-baseline"]) == 0
+        capsys.readouterr()
+        bad.write_text("x = 1\n")
+        assert lint_main([str(bad), "--baseline", str(base),
+                          "--fail-on-stale", "--format", "json"]) == 1
+        cap = capsys.readouterr()
+        doc = json.loads(cap.out)               # stdout stays pure JSON
+        assert doc["stale_baseline"]
+        assert "FAIL" in cap.err
+
+    def test_diff_mode_restricts_stale_check_to_linted_files(self,
+                                                             tmp_path,
+                                                             capsys):
+        import os
+        import subprocess
+
+        def git(*args):
+            r = subprocess.run(["git", *args], cwd=tmp_path,
+                               stdout=subprocess.PIPE,
+                               stderr=subprocess.STDOUT, text=True)
+            assert r.returncode == 0, r.stdout
+
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "a.py").write_text(self.BAD)
+        (pkg / "b.py").write_text("x = 1\n")
+        base = tmp_path / "base.json"
+        cwd = os.getcwd()
+        os.chdir(tmp_path)
+        try:
+            assert lint_main(["pkg", "--baseline", str(base),
+                              "--write-baseline"]) == 0
+            git("init", "-q")
+            git("config", "user.email", "t@t")
+            git("config", "user.name", "t")
+            git("add", "-A")
+            git("commit", "-qm", "seed")
+            (pkg / "b.py").write_text("y = 2\n")
+            # a.py (holding the baselined finding) is NOT in the diff: its
+            # baseline entry must not read as stale (the full project is
+            # linted for context; only the REPORT is diff-filtered)
+            assert lint_main(["pkg", "--diff", "HEAD", "--baseline",
+                              str(base), "--fail-on-stale"]) == 0
+        finally:
+            os.chdir(cwd)
+        capsys.readouterr()

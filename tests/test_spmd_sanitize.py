@@ -1,0 +1,145 @@
+"""SPMD collective-schedule sanitizer drills (paddle_tpu.analysis
+.spmd_sanitize) on the 8-device virtual multichip mesh.
+
+Mirrors the recompile-budget drill pattern: a CLEAN schedule must pass on
+real multichip programs unmodified (the dryrun wiring in
+__graft_entry__._spmd_verified is exercised here through the same
+ring-attention path), and a SEEDED mismatched collective — the
+`spmd.collective` fault point dropping one rank's k-th collective, exactly
+what a rank-dependent branch does on real hardware — must be caught, with
+the flight event (carrying the active fault-plan context) recorded before
+the raise.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from paddle_tpu.analysis import CollectiveScheduleMismatch, spmd_sanitize
+from paddle_tpu.observability.flight import FlightRecorder
+from paddle_tpu.resilience import faults
+
+
+def _mesh(n=8):
+    devs = jax.devices()
+    assert len(devs) >= n, f"need {n} virtual devices, have {len(devs)}"
+    return Mesh(np.array(devs[:n]), ("dp",))
+
+
+def _collective_program(mesh):
+    """A small shard_map program issuing a deterministic collective
+    sequence: psum -> all_gather -> ppermute."""
+    n = mesh.shape["dp"]
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(x):
+        s = jax.lax.psum(x, "dp")
+        g = jax.lax.all_gather(x, "dp")
+        r = jax.lax.ppermute(x, "dp", perm)
+        return s + g.sum(0) + r
+
+    return jax.jit(shard_map(body, mesh=mesh, in_specs=(P("dp"),),
+                             out_specs=P("dp")))
+
+
+def test_clean_schedule_passes_and_records_signatures():
+    mesh = _mesh()
+    f = _collective_program(mesh)
+    x = jnp.arange(16, dtype=jnp.float32)
+    with spmd_sanitize(n_ranks=8) as san:
+        f(x)
+    scheds = san.verify()                       # clean: no raise
+    kinds = [e[0] for e in san.events]
+    assert {"psum", "all_gather", "ppermute"} <= set(kinds)
+    # every event carries the (kind, axis, shape, dtype) signature
+    for kind, axis, shape, dtype in san.events:
+        assert axis == "dp" and isinstance(shape, tuple) and dtype
+    # all 8 ranks agree — single-controller SPMD guarantee
+    assert len(scheds) == 8
+    assert all(s == scheds[0] for s in scheds.values())
+
+
+def test_warm_call_records_nothing():
+    # trace-time recording only: a warm (cached) call never re-enters
+    # python, so the schedule is captured on the FIRST call by design
+    mesh = _mesh()
+    f = _collective_program(mesh)
+    x = jnp.arange(16, dtype=jnp.float32)
+    f(x)                                        # warm outside the scope
+    with spmd_sanitize(n_ranks=8) as san:
+        f(x)
+    assert san.events == []
+    san.verify()                                # empty schedule is uniform
+
+
+def test_seeded_mismatched_collective_is_caught():
+    mesh = _mesh()
+    f = _collective_program(mesh)
+    x = jnp.arange(32, dtype=jnp.float32)       # fresh shape: fresh trace
+    fr = FlightRecorder(capacity=32)
+    with faults.inject({"spmd.collective": dict(
+            action="trigger", match={"rank": 3}, at=1)}) as plan:
+        with spmd_sanitize(n_ranks=8, flight=fr) as san:
+            f(x)
+        assert len(san.events) >= 3
+        with pytest.raises(CollectiveScheduleMismatch) as ei:
+            san.verify()
+        assert plan.fired("spmd.collective") == 1
+    # the mismatch names the diverging rank + event index
+    assert ei.value.rank == 3 and ei.value.index == 1
+    assert ei.value.expected is not None
+    # resilience -> flight convention: the event (with the active
+    # fault-plan context) and the dump land BEFORE the raise
+    assert "spmd_schedule_mismatch" in fr.event_names()
+    ev = [e for e in fr.events() if e["event"] == "spmd_schedule_mismatch"][0]
+    assert ev["rank"] == 3 and ev["index"] == 1
+    assert ev["fault_plan"] and \
+        ev["fault_plan"][0]["point"] == "spmd.collective"
+    assert fr.last_dump()["reason"] == "spmd_schedule_mismatch"
+
+
+def test_unrelated_fault_plan_leaves_schedule_clean():
+    mesh = _mesh()
+    f = _collective_program(mesh)
+    x = jnp.arange(64, dtype=jnp.float32)
+    with faults.inject({"ckpt.write": dict(action="raise")}):
+        with spmd_sanitize(n_ranks=8) as san:
+            f(x)
+        san.verify()                            # no spmd fault: uniform
+
+
+def test_ring_attention_dryrun_program_is_uniform():
+    """The real multichip dryrun path (ring attention over sp=8, the
+    ppermute-pipelined KV rotation) passes the sanitizer unmodified."""
+    from paddle_tpu.parallel.ring_attention import ring_attention
+
+    devs = jax.devices()
+    W = 8
+    mesh = Mesh(np.array(devs[:W]), ("sp",))
+    B, S, H, D = 1, 8 * W, 2, 8
+    rng = np.random.default_rng(0)
+    q, k, v = (jnp.asarray(rng.normal(0, 1, (B, S, H, D)), jnp.float32)
+               for _ in range(3))
+
+    def body(q, k, v):
+        return ring_attention(q, k, v, axis="sp", causal=True)
+
+    f = jax.jit(shard_map(body, mesh=mesh, in_specs=(P(None, "sp"),) * 3,
+                          out_specs=P(None, "sp")))
+    with spmd_sanitize(n_ranks=W) as san:
+        out = f(q, k, v)
+    assert np.all(np.isfinite(np.asarray(out)))
+    scheds = san.verify()
+    assert "ppermute" in [e[0] for e in san.events]
+    assert all(s == scheds[0] for s in scheds.values())
+
+
+def test_patching_is_scoped():
+    orig = jax.lax.psum
+    with spmd_sanitize(n_ranks=2):
+        assert jax.lax.psum is not orig
+        with spmd_sanitize(n_ranks=2):          # nested: still one patch
+            assert getattr(jax.lax.psum, "__wrapped__", None) is orig
+    assert jax.lax.psum is orig                 # fully restored
