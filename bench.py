@@ -26,7 +26,7 @@ tokens/sec, SD-1.5-scale UNet images/sec, and the S=8192 long-context LLaMA
 config.
 
 Serving traces run standalone via `--trace {serving,shared-prefix,
-spec-decode}`; `--json PATH` dumps the selected trace's metrics dict as a
+spec-decode,failover}`; `--json PATH` dumps the selected trace's metrics dict as a
 BENCH_r0x-style artifact and `--seed` reproduces/varies the generated
 trace (each trace's default seed reproduces the PERF.md numbers).  Trace
 engines run with telemetry ON (overhead gated >= 0.97x by `make
@@ -934,6 +934,104 @@ def bench_serving_spec_decode(seed=0):
     }
 
 
+def bench_serving_failover(seed=0):
+    """Replica-failover drill trace (ISSUE 9; PERF.md §16): a 2-replica
+    ``serving.ReplicaFleet`` with periodic full-KV engine snapshots serves
+    a mixed-length greedy trace while a seeded ``serve.crash`` kills
+    replica r0 mid-trace.  The fleet revives r0 from its newest intact
+    snapshot and migrates whatever the snapshot misses by re-prefill of
+    prompt + streamed tokens.
+
+    ZERO lost requests and bit-equal outputs vs the uninterrupted
+    single-engine run are ASSERTED before anything is reported; the
+    artifact then carries the measured recovery time (the failover
+    handler's wall clock: detect -> restore -> migrate) and
+    goodput-at-deadline through the shared ``slo_report`` schema
+    (validated by ``perf/check_obs.py --trace failover``)."""
+    import tempfile
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.models.llama import LlamaConfig, build_functional_llama
+    from paddle_tpu.inference.paged import ServingEngine
+    from paddle_tpu.serving import ReplicaFleet
+    from paddle_tpu.resilience import inject
+
+    on_tpu = any(d.platform == "tpu" for d in jax.devices())
+    slo_ttft = 0.25 if on_tpu else 2.0
+    cfg = LlamaConfig(vocab_size=512, hidden_size=128,
+                      intermediate_size=384, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=2,
+                      max_position_embeddings=256)
+    dtype = jnp.bfloat16 if on_tpu else jnp.float32
+    n_req, slots, page_size, horizon = 10, 2, 8, 4
+    ep, bp, hp, *_ = build_functional_llama(cfg, dtype=dtype, n_micro=1)
+    params = (ep, bp, hp)
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(0, cfg.vocab_size, (int(t),)).astype(np.int32)
+               for t in rng.integers(8, 48, n_req)]
+    max_news = [int(m) for m in rng.integers(8, 24, n_req)]
+
+    def factory():
+        return ServingEngine(params, cfg, num_slots=slots,
+                             page_size=page_size, num_pages=96,
+                             max_pages_per_seq=16, dtype=dtype,
+                             attention_impl="auto" if on_tpu else "ref",
+                             prompt_bucket=16, decode_horizon=horizon)
+
+    # the uninterrupted single-engine reference (the bit-exactness bar)
+    eng = factory()
+    ref_rids = [eng.submit(p, max_new_tokens=m)
+                for p, m in zip(prompts, max_news)]
+    ref_done = eng.run()
+    refs = [np.asarray(ref_done[r].output_ids) for r in ref_rids]
+
+    crash_at = int(rng.integers(6, 14))   # serve.crash consult index
+    with tempfile.TemporaryDirectory() as snap_root:
+        fleet = ReplicaFleet(factory, num_replicas=2,
+                             snapshot_root=snap_root, snapshot_every=4,
+                             snapshot_mode="full_kv")
+        t0 = time.perf_counter()
+        with inject({"serve.crash": dict(match={"engine": "r0"},
+                                         at=crash_at)}, seed=seed) as plan:
+            # two arrival waves: the second lands AFTER the last periodic
+            # snapshot, so the failover exercises both recovery paths —
+            # snapshot restore for wave 1, re-prefill migration for
+            # whatever the snapshot misses
+            wave1 = n_req * 2 // 3
+            frids = [fleet.submit(p, max_new_tokens=m)
+                     for p, m in zip(prompts[:wave1], max_news[:wave1])]
+            fleet.run(max_rounds=5)
+            frids += [fleet.submit(p, max_new_tokens=m)
+                      for p, m in zip(prompts[wave1:], max_news[wave1:])]
+            done = fleet.run()
+        dt = time.perf_counter() - t0
+    assert plan.fired("serve.crash") == 1, "the crash drill did not fire"
+    lost = len(frids) - len(done)
+    assert lost == 0, f"failover lost {lost} requests"
+    # zero lost AND bit-equal asserted BEFORE reporting
+    for frid, ref in zip(frids, refs):
+        np.testing.assert_array_equal(np.asarray(done[frid].output_ids),
+                                      ref)
+    st = fleet.stats()
+    useful = sum(max_news)
+    ev = [e["event"] for e in fleet.flight.events()]
+    return {
+        "trace": {"n_requests": n_req, "num_replicas": 2,
+                  "snapshot_every": 4, "crash_at_consult": crash_at,
+                  "decode_horizon": horizon, "num_slots": slots,
+                  "page_size": page_size, "seed": int(seed)},
+        "lost_requests": 0,
+        "outputs_bitexact": True,
+        "useful_tokens": int(useful),
+        "tokens_per_sec": round(useful / dt, 1),
+        "recovery_ms_p50": st["recovery"]["p50_ms"],
+        "recovered_from_snapshot": "restore" in ev,
+        "fleet": st,
+        "slo_report": fleet.slo_report(slo_ttft, window_s=dt),
+        "metrics": fleet.metrics_snapshot(),
+    }
+
+
 def main():
     import jax
     _setup_compile_cache()
@@ -1014,13 +1112,17 @@ if __name__ == "__main__":
     import argparse
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--trace",
-                    choices=["shared-prefix", "serving", "spec-decode"],
+                    choices=["shared-prefix", "serving", "spec-decode",
+                             "failover"],
                     default=None,
                     help="run ONE serving trace and print its JSON line "
                          "(shared-prefix: prefix-cache hit-rate / "
                          "prefill-tokens-saved / TTFT; serving: the mixed-"
                          "length continuous-batching trace; spec-decode: "
-                         "self-speculative decoding vs speculation off)")
+                         "self-speculative decoding vs speculation off; "
+                         "failover: replica fleet with an injected "
+                         "mid-trace crash — zero lost requests + bit-equal "
+                         "outputs asserted, recovery time reported)")
     ap.add_argument("--json", metavar="PATH", default=None,
                     help="also dump the metrics dict to PATH as a JSON "
                          "artifact (BENCH_r0x-style)")
@@ -1031,12 +1133,14 @@ if __name__ == "__main__":
     args = ap.parse_args()
     if args.trace is None and (args.json or args.seed is not None):
         ap.error("--json/--seed only apply to a serving trace; "
-                 "pass --trace {shared-prefix,serving,spec-decode}")
+                 "pass --trace "
+                 "{shared-prefix,serving,spec-decode,failover}")
     if args.trace is not None:
         _setup_compile_cache()
         fn = {"shared-prefix": bench_serving_shared_prefix,
               "serving": bench_serving,
-              "spec-decode": bench_serving_spec_decode}[args.trace]
+              "spec-decode": bench_serving_spec_decode,
+              "failover": bench_serving_failover}[args.trace]
         res = fn() if args.seed is None else fn(seed=args.seed)
         out = {"metric": f"trace_{args.trace.replace('-', '_')}", **res}
         print(json.dumps(out))

@@ -4,6 +4,7 @@ from __future__ import annotations
 import time
 
 __all__ = ["Callback", "CallbackList", "ProgBarLogger", "ModelCheckpoint",
+           "ElasticRestart",
            "EarlyStopping", "LRScheduler"]
 
 
@@ -139,3 +140,37 @@ class LRScheduler(Callback):
             s = self._sched()
             if s:
                 s.step()
+
+
+class ElasticRestart(Callback):
+    """The elastic gang-resume glue (ROADMAP smaller item): watch a
+    ``distributed.fleet.elastic.ElasticManager`` during ``fit`` and stop
+    training at the next batch boundary when gang membership CHANGEs (a
+    node joined or left) or drops below ``np_min`` (EXIT).
+
+    The relauncher — ``launch_gang``'s restart hook, or any loop around
+    ``fit`` — then re-invokes ``fit(..., ckpt=manager)`` with the SAME
+    :class:`~paddle_tpu.resilience.CheckpointManager`: every surviving
+    rank auto-resumes from the same ``find_latest_complete()`` snapshot
+    (torn snapshots from the preemption are skipped), so the regrouped
+    gang continues the loss trajectory bit-exactly from the last durable
+    step instead of restarting from zero.  ``status`` holds the
+    membership verdict that stopped training (None while stable)."""
+
+    def __init__(self, manager, check_every: int = 1):
+        self.manager = manager
+        self.check_every = max(1, int(check_every))
+        self.status = None
+        self._n = 0
+
+    def on_batch_end(self, mode, step, logs=None):
+        if mode != "train":
+            return
+        self._n += 1
+        if self._n % self.check_every:
+            return
+        from ..distributed.fleet.elastic import ElasticStatus
+        st = self.manager.watch()
+        if st != ElasticStatus.HOLD:
+            self.status = st
+            self.model.stop_training = True

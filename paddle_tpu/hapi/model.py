@@ -141,7 +141,8 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
-            accumulate_grad_batches=1, num_iters=None, telemetry=None):
+            accumulate_grad_batches=1, num_iters=None, telemetry=None,
+            ckpt=None):
         """``telemetry``: an ``observability.TrainTelemetry`` (or None =
         off).  With one attached, every iteration records its host wall
         time split into data wait (the ``next(loader)`` call) vs compute
@@ -149,7 +150,22 @@ class Model:
         time) into ``train.step_s`` / ``train.data_s`` /
         ``train.compute_s``, and each ``save_dir`` checkpoint gets a
         ``ckpt.save`` span.  Pure host timing at boundaries the loop
-        already crosses: losses are bit-exact telemetry on vs off."""
+        already crosses: losses are bit-exact telemetry on vs off.
+
+        ``ckpt``: a ``resilience.CheckpointManager`` (or None = off).
+        fit() first AUTO-RESUMES from the newest intact snapshot
+        (``find_latest_complete()`` — torn snapshots from a crash mid-save
+        are skipped), restoring model/optimizer/LR-schedule/scaler/RNG
+        bit-exactly and fast-forwarding the data pipeline past the
+        restored iteration; then saves a crash-consistent snapshot every
+        ``ckpt.save_interval`` iterations.  A preempted fit relaunched
+        with the same arguments (and a deterministic batch order —
+        ``shuffle=False`` or a seeded loader) continues the loss
+        trajectory bit-for-bit; the elastic gang-resume path
+        (``hapi.callbacks.ElasticRestart``) stops training on a
+        membership change so every surviving rank relaunches from the
+        SAME snapshot.  Manager slots left as None (model / optimizer /
+        scaler) are attached from this Model."""
         train_loader = self._to_loader(train_data, batch_size, shuffle)
         eval_loader = self._to_loader(eval_data, batch_size, False)
         cbks = CallbackList(callbacks or [ProgBarLogger(log_freq, verbose=verbose)])
@@ -157,7 +173,31 @@ class Model:
         cbks.on_begin("train", {"epochs": epochs,
                                 "steps": _safe_len(train_loader),
                                 "metrics": self._metric_names()})
+        # a previous fit() stopped by a callback (EarlyStopping,
+        # ElasticRestart) must not poison this invocation — the elastic
+        # relauncher re-invokes fit() on the same Model
+        self.stop_training = False
         tel = telemetry
+        start_it = 0
+        if ckpt is not None:
+            if isinstance(train_data, Dataset) and shuffle:
+                # the resume fast-forward replays the loader to start_it;
+                # an unseeded reshuffle on relaunch would train some
+                # samples twice and skip others, silently breaking the
+                # bit-exact-trajectory guarantee
+                import warnings
+                warnings.warn(
+                    "fit(ckpt=...) with shuffle=True: auto-resume needs a "
+                    "DETERMINISTIC batch order to reproduce the "
+                    "uninterrupted trajectory — pass shuffle=False or a "
+                    "seeded DataLoader", RuntimeWarning, stacklevel=2)
+            if ckpt.model is None:
+                ckpt.model = self.network
+            if ckpt.optimizer is None:
+                ckpt.optimizer = self._optimizer
+            if ckpt.scaler is None:
+                ckpt.scaler = self._scaler
+            start_it = ckpt.restore() or 0
         it = 0
         for epoch in range(epochs):
             for m in self._metrics:
@@ -172,6 +212,18 @@ class Model:
                     batch = next(data_iter)
                 except StopIteration:
                     break
+                if it < start_it:
+                    # resume fast-forward: this batch was already trained
+                    # (and checkpointed past) before the restart — consume
+                    # it from the loader so the data pipeline lines up,
+                    # train nothing (the restored RNG/step carry the state)
+                    it += 1
+                    if num_iters is not None and it >= num_iters:
+                        # the snapshot already covers the whole num_iters
+                        # budget — training a bonus step here would push
+                        # the resumed run PAST the uninterrupted one
+                        break
+                    continue
                 t_d1 = tel.clock() if tel is not None else 0.0
                 step += 1
                 cbks.on_batch_begin("train", step, logs)
@@ -185,6 +237,10 @@ class Model:
                 logs = self._pack_logs(res)
                 cbks.on_batch_end("train", step, logs)
                 it += 1
+                if ckpt is not None:
+                    ckpt.maybe_save(it)
+                if self.stop_training:
+                    break
                 if num_iters is not None and it >= num_iters:
                     break
             cbks.on_epoch_end(epoch, logs)

@@ -80,6 +80,7 @@ no jitted code (sanitize(0) variant counts unchanged).
 from __future__ import annotations
 
 import hashlib
+import json
 import math
 import time
 import weakref
@@ -597,12 +598,16 @@ class ServingEngine:
                  seed: int = 0, max_queue: int | None = None,
                  prefix_cache: bool = True, prefill_chunk: int | None = None,
                  speculative: int | None = None, spec_max_ngram: int = 3,
-                 telemetry: "Telemetry | bool | None" = None):
+                 telemetry: "Telemetry | bool | None" = None,
+                 name: str = "engine"):
         import jax
         import jax.numpy as jnp
         from ..models.llama import (build_llama_paged_decode,
                                     _sample_per_request)
         self._jax, self._jnp = jax, jnp
+        # replica identity: rides the serve.crash / serve.wedge fault-point
+        # ctx so a fleet drill can target one replica (match={"engine": ...})
+        self.name = str(name)
         # per-model-fn compile-cache miss counters (analysis.sanitize
         # instrumentation; stats()["jit_cache_misses"]) + the underlying
         # jitted fns for jit_variants() accounting
@@ -770,6 +775,43 @@ class ServingEngine:
         queue is full (backpressure), plain ValueError for malformed input.
         `timeout` (seconds from now) retires the request — wherever it is —
         once overdue, with `Request.timed_out` set."""
+        now = self._clock()
+        return self._enqueue(
+            prompt, [], max_new_tokens, temperature, top_p, eos_token_id,
+            None if timeout is None else now + float(timeout), now)
+
+    def adopt(self, prompt, generated=(), max_new_tokens: int = 32,
+              temperature: float = 0.0, top_p: float = 1.0,
+              eos_token_id: int | None = None,
+              deadline: float | None = None) -> int:
+        """Adopt a request MID-FLIGHT: queue `prompt` with `generated`
+        tokens already emitted elsewhere (a crashed replica, a snapshot),
+        to be continued from exactly that point.  Admission takes the
+        preemption-resume path — re-prefill of prompt + generated[:-1]
+        with generated[-1] as the pending token — so greedy continuation
+        is bit-exact vs the engine that emitted those tokens.  Same
+        validation + backpressure as :meth:`submit`; `deadline` is an
+        absolute engine-clock cutoff (the migrating router's clock domain
+        must match — in-process fleets share one clock)."""
+        generated = [int(t) for t in generated]
+        if max_new_tokens >= 1 and len(generated) >= max_new_tokens:
+            raise ValueError(
+                f"adopt: {len(generated)} tokens already emitted >= "
+                f"max_new_tokens={max_new_tokens} — the request is complete, "
+                f"nothing to continue (report it finished instead)")
+        if eos_token_id is not None and eos_token_id in generated:
+            raise ValueError(
+                "adopt: generated already contains eos_token_id — the "
+                "request is complete, nothing to continue")
+        return self._enqueue(prompt, generated, max_new_tokens, temperature,
+                             top_p, eos_token_id, deadline, self._clock())
+
+    def _enqueue(self, prompt, generated, max_new_tokens, temperature,
+                 top_p, eos_token_id, deadline, now) -> int:
+        """Shared admission-queue entry for submit (fresh request, relative
+        timeout already resolved to an absolute deadline) and adopt
+        (mid-flight resume): validation, capacity check, backpressure, and
+        Request construction live HERE, once."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if len(prompt) < 1:
             raise ValueError("prompt must hold at least one token")
@@ -804,15 +846,49 @@ class ServingEngine:
                 f"later")
         rid = self._next_rid
         self._next_rid += 1
-        now = self._clock()
-        req = Request(rid=rid, prompt=prompt, max_new_tokens=int(max_new_tokens),
+        req = Request(rid=rid, prompt=prompt,
+                      max_new_tokens=int(max_new_tokens),
                       temperature=float(temperature), top_p=float(top_p),
                       eos_token_id=eos_token_id, submit_time=now,
-                      deadline=None if timeout is None else now + float(timeout))
+                      deadline=deadline, generated=list(generated))
         self._queue.append(req)
         if self.telemetry is not None:
             self.telemetry.submitted(req, queue_depth=len(self._queue))
         return rid
+
+    def lookup(self, rid: int) -> Request | None:
+        """The Request for `rid` wherever it lives (slot, queue, finished);
+        None for an unknown rid.  The returned object is live — a router
+        streams tokens by watching its `generated` list grow."""
+        r = self._finished.get(rid)
+        if r is not None:
+            return r
+        for slot in self._slots:
+            if slot is not None and slot.req.rid == rid:
+                return slot.req
+        for r in self._queue:
+            if r.rid == rid:
+                return r
+        return None
+
+    def cancel(self, rid: int) -> bool:
+        """Drop a request wherever it lives, recording no result: a queued
+        request leaves the queue, a running slot releases (its written KV
+        parks in the prefix cache first — the blocks are valid and future
+        admissions may hit them), a finished record is forgotten.  Routers
+        use this to prune snapshot-restored requests they already resolved
+        elsewhere, so a revived replica does not decode zombies.  Returns
+        True when the rid was found."""
+        for s, slot in enumerate(self._slots):
+            if slot is not None and slot.req.rid == rid:
+                self._register_slot(s, with_partial=True)
+                self._release_slot(s)
+                return True
+        for r in self._queue:
+            if r.rid == rid:
+                self._queue.remove(r)
+                return True
+        return self._finished.pop(rid, None) is not None
 
     # -- internals ---------------------------------------------------------
     def _jit(self, name, fn, **jit_kw):
@@ -1513,10 +1589,27 @@ class ServingEngine:
         tel = self.telemetry
         t_s0 = tel.sched_begin() if tel is not None else 0.0
         self._step_seq += 1
+        # serve.wedge: the engine "hangs" — the step returns without doing
+        # ANY work (no admissions, no dispatch), the deterministic stand-in
+        # for a replica that stopped responding.  A fleet watchdog sees
+        # consecutive no-progress steps and declares the replica wedged.
+        if fault_point("serve.wedge", engine=self.name,
+                       step=self._step_seq) is not None:
+            if tel is not None:
+                tel.flight.record("fault", point="serve.wedge",
+                                  step=self._step_seq)
+            return False
         self._pressure = fault_point("serve.pool_pressure",
                                      step=self.steps_run) is not None
         self._retire_overdue()
         self._admit()
+        # serve.crash phase="sched": die mid-step AFTER admissions mutated
+        # slot/pool state but BEFORE any token was produced this step — the
+        # raising InjectedFault models the process dying; host state is
+        # consistent (a step boundary for page accounting) but every
+        # in-flight request is stranded until a fleet migrates it.
+        fault_point("serve.crash", engine=self.name, step=self._step_seq,
+                    phase="sched")
         if tel is not None:
             # host scheduling phase: deadline sweep + admissions — the
             # host-side cost the host-loop overlap refactor (ROADMAP item
@@ -1554,6 +1647,11 @@ class ServingEngine:
                     {s: 1 + len(d) for s, d in drafts.items()})
                 if run:
                     self._verify(run, drafts)
+                    # serve.crash phase="record": die after this step's
+                    # tokens were recorded but before anyone outside the
+                    # engine observed them (mid-speculation intersection)
+                    fault_point("serve.crash", engine=self.name,
+                                step=self._step_seq, phase="record")
                     return True
         K = self.decode_horizon
         run = self._provision(K)
@@ -1633,6 +1731,12 @@ class ServingEngine:
                     break
         if tel is not None:
             tel.phase("decode_record", t_d2, tel.clock())
+        # serve.crash phase="record": die after this horizon's tokens were
+        # recorded (and finished requests retired) but before any caller
+        # observed them — a router that re-prefills from what it last
+        # STREAMED must regenerate these tokens bit-identically (greedy)
+        fault_point("serve.crash", engine=self.name, step=self._step_seq,
+                    phase="record")
         return True
 
     def run(self, max_steps: int | None = None,
@@ -1669,6 +1773,291 @@ class ServingEngine:
             if max_steps is not None and steps >= max_steps:
                 break
         return dict(self._finished)
+
+    # -- snapshot / restore ------------------------------------------------
+    # The engine's own durability (ROADMAP item 4): everything a process
+    # restart would otherwise kill — in-flight Requests with emitted tokens,
+    # the seeded RNG key stream, deadlines, slot table, page tables, PagePool
+    # refcounts, prefix-cache index, adaptive spec state — serializes into a
+    # versioned state dict and comes back bit-exactly.  Two modes:
+    #
+    #   * "full_kv": the referenced KV pages ride along raw — restore is a
+    #     scatter back into the pool and decode CONTINUES without any
+    #     re-prefill (fast restore; requires identical pool geometry);
+    #   * "compact": token prefixes only — restore requeues every in-flight
+    #     request through the preemption-resume path (re-prefill of prompt +
+    #     emitted), so the snapshot is cheap and the restored pool may have
+    #     a different size/geometry entirely.
+    #
+    # Greedy outputs are bit-exact across snapshot/restore in BOTH modes
+    # (tests/test_fleet.py) — full_kv by construction, compact by the PR 2/3
+    # preemption + re-prefill guarantee.  Snapshots are taken BETWEEN steps
+    # (any step boundary is a consistent point for page accounting).
+
+    SNAPSHOT_VERSION = 1
+
+    def _req_state(self, r: Request) -> dict:
+        eos = r.eos_token_id
+        return {
+            "rid": int(r.rid), "prompt": np.asarray(r.prompt).tolist(),
+            "max_new_tokens": int(r.max_new_tokens),
+            "temperature": float(r.temperature), "top_p": float(r.top_p),
+            "eos_token_id": None if eos is None else int(eos),
+            "deadline": None if r.deadline is None else float(r.deadline),
+            "generated": [int(t) for t in r.generated],
+            "submit_time": float(r.submit_time),
+            "admit_time": float(r.admit_time),
+            "first_token_time": float(r.first_token_time),
+            "finish_time": float(r.finish_time),
+            "timed_out": bool(r.timed_out),
+            "preemptions": int(r.preemptions),
+            "cached_prefix_tokens": int(r.cached_prefix_tokens),
+            "draft_proposed": int(r.draft_proposed),
+            "draft_accepted": int(r.draft_accepted),
+        }
+
+    @staticmethod
+    def _req_from_state(d: dict) -> Request:
+        return Request(
+            rid=int(d["rid"]),
+            prompt=np.asarray(d["prompt"], np.int32),
+            max_new_tokens=int(d["max_new_tokens"]),
+            temperature=float(d["temperature"]), top_p=float(d["top_p"]),
+            eos_token_id=d["eos_token_id"], deadline=d["deadline"],
+            generated=[int(t) for t in d["generated"]],
+            submit_time=d["submit_time"], admit_time=d["admit_time"],
+            first_token_time=d["first_token_time"],
+            finish_time=d["finish_time"], timed_out=bool(d["timed_out"]),
+            preemptions=int(d["preemptions"]),
+            cached_prefix_tokens=int(d["cached_prefix_tokens"]),
+            draft_proposed=int(d["draft_proposed"]),
+            draft_accepted=int(d["draft_accepted"]))
+
+    _COUNTER_ATTRS = ("steps_run", "tokens_generated", "preemptions",
+                      "timeouts", "rejections", "cache_hits",
+                      "cache_hit_tokens", "prefill_tokens",
+                      "cache_evictions", "cow_copies", "verify_steps",
+                      "draft_tokens_proposed", "draft_tokens_accepted")
+
+    def snapshot(self, mode: str = "full_kv",
+                 include_finished: bool = True) -> dict:
+        """Serialize the complete engine state at a step boundary.
+
+        Returns a flat state dict ready for the crash-consistent
+        ``distributed.checkpoint.save_state_dict`` writer (see
+        ``serving.EngineSnapshotManager``): ``meta`` is one JSON string of
+        host state, ``rng`` the engine PRNG key, and in ``full_kv`` mode
+        ``kv_pages``/``kv_k``/``kv_v`` carry the referenced KV pages raw.
+        ``include_finished`` keeps already-retired requests in the snapshot
+        so a restored engine's ``run()`` still returns them."""
+        if mode not in ("full_kv", "compact"):
+            raise ValueError(f"unknown snapshot mode {mode!r}")
+        requests: dict[str, dict] = {}
+
+        def _ref(r: Request) -> int:
+            requests.setdefault(str(r.rid), self._req_state(r))
+            return int(r.rid)
+
+        slots = []
+        for s, slot in enumerate(self._slots):
+            if slot is None:
+                slots.append(None)
+                continue
+            slots.append({
+                "rid": _ref(slot.req),
+                "pages": [int(p) for p in slot.pages],
+                "pending": int(slot.pending),
+                "admit_seq": int(slot.admit_seq),
+                "prefill_pos": None if slot.prefill_pos is None
+                else int(slot.prefill_pos),
+                "ctx": None if slot.ctx is None
+                else np.asarray(slot.ctx).tolist(),
+                "resuming": bool(slot.resuming),
+                "chunk_step": int(slot.chunk_step),
+                "spec_k": int(slot.spec_k),
+                "length": int(self._lengths[s]),
+            })
+        meta = {
+            "version": self.SNAPSHOT_VERSION,
+            "mode": mode,
+            "geometry": {
+                "num_slots": self.num_slots, "page_size": self.page_size,
+                "num_pages": self.pool.num_pages,
+                "max_pages_per_seq": self.max_pages_per_seq,
+                "prefix_cache": self.cache is not None,
+            },
+            "requests": requests,
+            "slots": slots,
+            "queue": [_ref(r) for r in self._queue],
+            "finished": [_ref(r) for r in self._finished.values()]
+            if include_finished else [],
+            "next_rid": int(self._next_rid),
+            "admit_seq": int(self._admit_seq),
+            "step_seq": int(self._step_seq),
+            "counters": {k: int(getattr(self, k))
+                         for k in self._COUNTER_ATTRS},
+            "pool": {"free": [int(p) for p in self.pool._free],
+                     "refs": [[int(p), int(c)]
+                              for p, c in sorted(self.pool._refs.items())]},
+        }
+        state: dict = {"rng": np.asarray(self._key)}
+        if mode == "full_kv":
+            if self.cache is not None:
+                c = self.cache
+                meta["cache"] = {
+                    "tick": int(c._tick), "insertions": int(c.insertions),
+                    "evictions": int(c.evictions),
+                    "full": [[e.key.hex(), e.parent.hex(), int(e.page),
+                              int(e.tick)] for e in c._full.values()],
+                    "partial": [[e.parent.hex(),
+                                 np.frombuffer(e.tokens, np.int32).tolist(),
+                                 int(e.page), int(e.tick)]
+                                for d in c._partial.values()
+                                for e in d.values()],
+                }
+            else:
+                meta["cache"] = None
+            ids = sorted(self.pool._refs)
+            state["kv_pages"] = np.asarray(ids, np.int32)
+            # the page axis is axis 2 of [L, Hkv, NP+1, ps, D]; only pages
+            # holding a reference carry information (free pages are dead
+            # state, the trash page is garbage by contract).  Gather ON
+            # DEVICE first so the host transfer (snapshot IS a sync point)
+            # is proportional to live context, not pool capacity.
+            idx = self._jnp.asarray(ids, self._jnp.int32)
+            state["kv_k"] = np.asarray(self._pages_k[:, :, idx])
+            state["kv_v"] = np.asarray(self._pages_v[:, :, idx])
+        state["meta"] = json.dumps(meta)
+        return state
+
+    def restore(self, state: dict) -> str:
+        """Load a :meth:`snapshot` state dict into this FRESH engine
+        (construct with the same params/config first; raises if this engine
+        already ran work).  Returns the restore path taken:
+
+          * ``"full_kv"`` — pool geometry matched a full-KV snapshot: KV
+            pages scattered back, slots/page tables/cache rebuilt in place,
+            decode continues with zero re-prefill;
+          * ``"reprefill"`` — compact snapshot, OR a full-KV snapshot whose
+            geometry no longer fits (e.g. restored into a smaller pool):
+            every in-flight request requeues through the preemption-resume
+            path and re-prefills prompt + emitted tokens, walking the
+            normal admission ladder of THIS engine's pool.
+
+        Greedy outputs are bit-exact vs the uninterrupted engine either
+        way."""
+        meta = state["meta"]
+        if isinstance(meta, (bytes, np.ndarray)):
+            meta = bytes(meta).decode()
+        if isinstance(meta, str):
+            meta = json.loads(meta)
+        if meta.get("version") != self.SNAPSHOT_VERSION:
+            raise ValueError(
+                f"engine snapshot version {meta.get('version')!r} != "
+                f"{self.SNAPSHOT_VERSION}")
+        if self.num_active or self._queue or self._finished or self.steps_run:
+            raise RuntimeError(
+                "ServingEngine.restore: target engine already holds state — "
+                "restore into a freshly constructed engine")
+        jnp = self._jnp
+        self._key = jnp.asarray(np.asarray(state["rng"]))
+        reqs = {int(r): self._req_from_state(d)
+                for r, d in meta["requests"].items()}
+        for rid in meta["finished"]:
+            self._finished[rid] = reqs[rid]
+        self._next_rid = max(int(meta["next_rid"]), self._next_rid)
+        for k, v in meta["counters"].items():
+            setattr(self, k, int(v))
+        self._admit_seq = int(meta["admit_seq"])
+        g = meta["geometry"]
+        fast = (meta["mode"] == "full_kv"
+                and g["num_slots"] == self.num_slots
+                and g["page_size"] == self.page_size
+                and g["num_pages"] == self.pool.num_pages
+                and g["max_pages_per_seq"] == self.max_pages_per_seq
+                and bool(g["prefix_cache"]) == (self.cache is not None))
+        if fast:
+            self._restore_full(meta, state, reqs)
+            return "full_kv"
+        self._restore_reprefill(meta, reqs)
+        return "reprefill"
+
+    def _restore_full(self, meta, state, reqs):
+        jnp = self._jnp
+        self._step_seq = int(meta["step_seq"])
+        pool = self.pool
+        pool._free = [int(p) for p in meta["pool"]["free"]]
+        pool._refs = {int(p): int(c) for p, c in meta["pool"]["refs"]}
+        ids = np.asarray(state["kv_pages"], np.int32)
+        if len(ids):
+            self._pages_k = self._pages_k.at[:, :, ids].set(
+                jnp.asarray(state["kv_k"], self._pages_k.dtype))
+            self._pages_v = self._pages_v.at[:, :, ids].set(
+                jnp.asarray(state["kv_v"], self._pages_v.dtype))
+        for s, sd in enumerate(meta["slots"]):
+            if sd is None:
+                continue
+            req = reqs[sd["rid"]]
+            slot = _Slot(req, [int(p) for p in sd["pages"]],
+                         int(sd["pending"]), admit_seq=int(sd["admit_seq"]))
+            slot.prefill_pos = sd["prefill_pos"]
+            slot.ctx = None if sd["ctx"] is None \
+                else np.asarray(sd["ctx"], np.int32)
+            slot.resuming = bool(sd["resuming"])
+            slot.chunk_step = int(sd["chunk_step"])
+            slot.spec_k = int(sd["spec_k"])
+            if self.speculative and req.temperature <= 0.0:
+                # the n-gram index is a pure function of the token stream —
+                # rebuild instead of serializing (identical by construction:
+                # admission + per-token appends == one pass over the stream)
+                slot.draft = _NgramDraft(
+                    np.concatenate([req.prompt,
+                                    np.asarray(req.generated, np.int32)]),
+                    max_n=self.spec_max_ngram)
+            self._slots[s] = slot
+            row = np.zeros((self.max_pages_per_seq,), np.int32)
+            row[:len(slot.pages)] = slot.pages
+            self._page_tables[s] = row
+            self._lengths[s] = int(sd["length"])
+            self._temps[s] = req.temperature
+            self._top_ps[s] = req.top_p
+        for rid in meta["queue"]:
+            self._queue.append(reqs[rid])
+        if self.cache is not None and meta.get("cache"):
+            c = self.cache
+            cm = meta["cache"]
+            c._tick = int(cm["tick"])
+            c.insertions = int(cm["insertions"])
+            c.evictions = int(cm["evictions"])
+            for key_hex, parent_hex, page, tick in cm["full"]:
+                e = _CacheEntry(bytes.fromhex(key_hex),
+                                bytes.fromhex(parent_hex), int(page))
+                e.tick = int(tick)
+                c._full[e.key] = e
+            for parent_hex, toks, page, tick in cm["partial"]:
+                parent = bytes.fromhex(parent_hex)
+                tb = np.asarray(toks, np.int32).tobytes()
+                e = _CacheEntry(None, parent, int(page), tokens=tb)
+                e.tick = int(tick)
+                c._partial.setdefault(parent, {})[tb] = e
+            for e in list(c._full.values()) + [
+                    e for d in c._partial.values() for e in d.values()]:
+                if e.parent in c._full:
+                    c._full[e.parent].children += 1
+
+    def _restore_reprefill(self, meta, reqs):
+        """Compact-mode (or geometry-mismatch) restore: requeue every
+        in-flight request through the preemption-resume machinery, slots
+        first in admission order (they were running; they get slots back
+        first), then the parked queue in its order.  The prefix cache
+        starts empty — its pages' CONTENT did not ride a compact snapshot —
+        and refills as re-prefills register blocks."""
+        inflight = sorted((sd for sd in meta["slots"] if sd is not None),
+                          key=lambda sd: sd["admit_seq"])
+        for sd in inflight:
+            self._queue.append(reqs[sd["rid"]])
+        for rid in meta["queue"]:
+            self._queue.append(reqs[rid])
 
     # -- accounting / invariants -------------------------------------------
     def stats(self) -> dict:

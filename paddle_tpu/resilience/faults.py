@@ -26,6 +26,25 @@ Fault-point catalog (the consulting subsystem documents exact ctx keys):
 ``serve.pool_pressure``     once per ServingEngine.step (ctx: ``step``) —
                             ``trigger`` makes the engine see zero free pages
                             that step (exhaustion without shrinking the pool)
+``serve.crash``             twice per ServingEngine.step (ctx: ``engine``,
+                            ``step``, ``phase`` in {"sched", "record"}) —
+                            ``raise`` kills the replica mid-step (after
+                            admissions / after token record), stranding its
+                            in-flight requests for a fleet to migrate
+``serve.wedge``             once per ServingEngine.step (ctx: ``engine``,
+                            ``step``) — ``trigger`` makes the step return
+                            without doing ANY work (an unresponsive replica;
+                            fleet watchdogs see consecutive no-progress
+                            heartbeats)
+``serve.snapshot``          once per EngineSnapshotManager.save_engine (ctx:
+                            ``engine``, ``step``, ``mode``) — ``raise`` dies
+                            before anything stages; ``trigger`` TEARS the
+                            committed snapshot after the fact (bit-rot),
+                            which manifest verification must reject
+``spmd.collective``         once per recorded collective in a spmd_sanitize
+                            scope (ctx: ``rank``, ``index``, ``kind``) —
+                            ``trigger`` drops that rank's event (the
+                            skipped-branch divergence drill)
 ``comm.ready``              wait_with_timeout readiness check (ctx: ``op``) —
                             ``trigger`` simulates a collective that never
                             becomes ready (CommTimeoutError)

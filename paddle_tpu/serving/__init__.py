@@ -1,0 +1,18 @@
+"""Serving fleet layer (ROADMAP item 4): durable engine snapshots +
+replica-fleet router with live request migration.
+
+* :class:`EngineSnapshotManager` — crash-consistent
+  ``ServingEngine.snapshot()`` persistence through the checkpoint commit
+  protocol (staged tmp + fsync + SHA-256 manifest + atomic rename), with
+  keep-last-N rotation and torn-snapshot-skipping discovery.
+* :class:`ReplicaFleet` — N engine replicas behind one ``submit()``:
+  least-loaded routing, health watchdog (crash + wedge detection),
+  snapshot-restore / re-prefill failover with zero request loss and
+  greedy-bit-exact outputs, fleet-wide degradation ladder
+  (route -> queue -> reject).
+"""
+from .fleet import FleetFailedError, ReplicaFleet
+from .snapshot import EngineSnapshotManager, load_engine_snapshot
+
+__all__ = ["ReplicaFleet", "FleetFailedError", "EngineSnapshotManager",
+           "load_engine_snapshot"]

@@ -1,0 +1,544 @@
+"""Replica-fleet router: N ServingEngine replicas behind one ``submit()``.
+
+One engine is one process is one failure domain — ROADMAP item 4's
+"millions of users" needs a front end where a replica can die and its
+in-flight requests MIGRATE instead of dying with it.  The fleet keeps the
+authoritative request log (prompt + sampling params + every token STREAMED
+out of the engines so far), drives its replicas step by step, and
+self-heals:
+
+  * **routing** — each submit lands on the least-loaded live replica
+    (deterministic tie-break), falling through the fleet-wide degradation
+    ladder *route -> queue -> reject*: replicas full -> the bounded fleet
+    queue (placement retried with exponential backoff), fleet queue
+    full -> typed ``AdmissionRejected`` backpressure;
+  * **health watchdog** — a replica whose ``step()`` raises is CRASHED; a
+    replica that keeps reporting no progress while holding work is WEDGED
+    (``EngineStalledError`` after ``stall_threshold`` heartbeats).  Both
+    are drilled deterministically via the seeded ``serve.crash`` /
+    ``serve.wedge`` fault points (resilience/faults.py);
+  * **failover** — a failed replica is revived from its newest INTACT
+    engine snapshot (``EngineSnapshotManager``; torn snapshots are
+    rejected via manifest and flight-recorded), and every outstanding
+    request the snapshot does not cover migrates to a surviving replica by
+    re-prefill of prompt + streamed tokens (``ServingEngine.adopt``).
+    Greedy outputs stay bit-exact either way: a full-KV restore resumes
+    the identical computation, and a re-prefill resume regenerates the
+    identical greedy continuation (the PR 2/3 preemption guarantee) — any
+    tokens re-decoded past an old snapshot are bit-identical to the ones
+    already streamed, so nothing is lost and nothing diverges.
+
+Failovers, migrations, and torn-snapshot rejections land in the fleet's
+flight recorder stamped with the active fault-plan context
+(``observability.fault_context``); ``fleet.migrations`` /
+``fleet.failovers`` counters and the ``fleet.recovery_s`` histogram feed
+the failover bench trace (``bench.py --trace failover``).
+"""
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..inference.paged import (AdmissionRejected, EngineStalledError,
+                               Request, ServingEngine)
+from ..observability.flight import FlightRecorder
+from ..observability.metrics import MetricsRegistry
+from ..observability.slo import slo_report
+from ..observability.train import fault_context
+from .snapshot import EngineSnapshotManager
+
+__all__ = ["ReplicaFleet", "FleetFailedError"]
+
+
+class FleetFailedError(RuntimeError):
+    """No replica could be kept alive (engine factory kept failing or the
+    per-replica failover budget is exhausted) while requests were still
+    outstanding — the fleet cannot make progress."""
+
+
+@dataclass
+class _FleetRequest:
+    """The router's authoritative record of one request: enough to place
+    it, re-place it after a crash (prompt + streamed tokens), and report
+    it (fleet-level latency timestamps)."""
+    frid: int
+    prompt: np.ndarray
+    kw: dict                       # max_new_tokens/temperature/top_p/eos
+    deadline: float | None
+    submit_t: float
+    replica: str | None = None
+    handle: Request | None = None  # live engine-side Request object
+    streamed: list = field(default_factory=list)
+    result: Request | None = None
+    first_token_t: float = 0.0
+    finish_t: float = 0.0
+    retries: int = 0
+    next_try_round: int = 0
+    migrations: int = 0
+
+
+class _Replica:
+    __slots__ = ("name", "engine", "alive", "stall", "failures", "snapshots")
+
+    def __init__(self, name, engine, snapshots):
+        self.name = name
+        self.engine = engine
+        self.alive = True
+        self.stall = 0            # consecutive no-progress steps w/ work
+        self.failures = 0         # failovers consumed
+        self.snapshots = snapshots
+
+
+class _SnapTel:
+    """CheckpointManager-telemetry duck for the snapshot managers: torn-
+    snapshot rejections land in the FLEET flight record (with fault-plan
+    context) and the fleet.torn_snapshots counter."""
+
+    def __init__(self, fleet: "ReplicaFleet", name: str):
+        self._fleet = fleet
+        self._name = name
+
+    def torn_snapshot(self, path, error):
+        self._fleet._c_torn.inc()
+        self._fleet.flight.record(
+            "torn_snapshot", replica=self._name,
+            path=os.path.basename(str(path)), error=str(error)[:200],
+            fault_plan=fault_context())
+
+
+class ReplicaFleet:
+    """``engine_factory`` builds one fresh :class:`ServingEngine` per call
+    (same params/config each time — replicas are interchangeable);
+    the fleet names them ``r0..rN-1`` (the ``serve.crash`` /
+    ``serve.wedge`` fault-point ``engine=`` ctx, so drills target one
+    replica via ``match={"engine": "r0"}``).
+
+    ``snapshot_root`` + ``snapshot_every`` turn on periodic engine
+    snapshots (one ``EngineSnapshotManager`` per replica under
+    ``snapshot_root/<name>``, mode ``snapshot_mode``); without them
+    failover falls back to pure re-prefill migration — still zero-loss and
+    greedy-bit-exact, just a cold KV start for the migrated requests."""
+
+    def __init__(self, engine_factory, num_replicas: int = 2, *,
+                 snapshot_root: str | None = None,
+                 snapshot_every: int | None = None,
+                 snapshot_mode: str = "full_kv",
+                 snapshot_keep_last: int = 2,
+                 max_queue: int | None = None,
+                 stall_threshold: int = 8,
+                 retry_backoff_rounds: int = 1,
+                 max_backoff_rounds: int = 32,
+                 max_failovers_per_replica: int = 4,
+                 clock=time.perf_counter,
+                 flight_capacity: int = 256):
+        if num_replicas < 1:
+            raise ValueError("num_replicas must be >= 1")
+        self._factory = engine_factory
+        self._clock = clock
+        self.snapshot_root = snapshot_root
+        self.snapshot_every = snapshot_every
+        self.snapshot_mode = snapshot_mode
+        self.snapshot_keep_last = int(snapshot_keep_last)
+        self.max_queue = None if max_queue is None else int(max_queue)
+        self.stall_threshold = int(stall_threshold)
+        self.retry_backoff_rounds = int(retry_backoff_rounds)
+        self.max_backoff_rounds = int(max_backoff_rounds)
+        self.max_failovers_per_replica = int(max_failovers_per_replica)
+        self.metrics = MetricsRegistry(clock=clock)
+        self._c_failovers = self.metrics.counter("fleet.failovers")
+        self._c_migrations = self.metrics.counter("fleet.migrations")
+        self._c_rejections = self.metrics.counter("fleet.rejections")
+        self._c_submitted = self.metrics.counter("fleet.requests_submitted")
+        self._c_resolved = self.metrics.counter("fleet.requests_resolved")
+        self._c_torn = self.metrics.counter("fleet.torn_snapshots")
+        self._h_recovery = self.metrics.histogram("fleet.recovery_s")
+        self.flight = FlightRecorder(capacity=flight_capacity, clock=clock)
+        self._requests: dict[int, _FleetRequest] = {}
+        self._assigned: dict[str, set[int]] = {}
+        self._waiting: list[_FleetRequest] = []
+        self._summaries: list[dict] = []
+        self._next_frid = 0
+        self._round = 0
+        self._replicas: list[_Replica] = []
+        for i in range(int(num_replicas)):
+            name = f"r{i}"
+            self._replicas.append(
+                _Replica(name, self._new_engine(name),
+                         self._snapshot_manager(name)))
+            self._assigned[name] = set()
+
+    # -- construction helpers ----------------------------------------------
+    def _new_engine(self, name: str) -> ServingEngine:
+        eng = self._factory()
+        if not isinstance(eng, ServingEngine):
+            raise TypeError("engine_factory must return a ServingEngine")
+        eng.name = name
+        return eng
+
+    def _snapshot_manager(self, name: str):
+        if self.snapshot_root is None:
+            return None
+        return EngineSnapshotManager(
+            os.path.join(self.snapshot_root, name),
+            keep_last=self.snapshot_keep_last,
+            telemetry=_SnapTel(self, name))
+
+    # -- submission (fleet ladder: route -> queue -> reject) ---------------
+    def submit(self, prompt, max_new_tokens: int = 32,
+               temperature: float = 0.0, top_p: float = 1.0,
+               eos_token_id: int | None = None,
+               timeout: float | None = None) -> int:
+        """Queue one request with the fleet; returns the fleet request id.
+        Routing tries every live replica least-loaded-first; when all
+        reject (their admission queues are full), the request waits in the
+        bounded fleet queue; when THAT is full, typed
+        ``AdmissionRejected`` backpressure."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        now = self._clock()
+        fr = _FleetRequest(
+            frid=self._next_frid, prompt=prompt,
+            kw=dict(max_new_tokens=int(max_new_tokens),
+                    temperature=float(temperature), top_p=float(top_p),
+                    eos_token_id=eos_token_id),
+            deadline=None if timeout is None else now + float(timeout),
+            submit_t=now)
+        self._next_frid += 1
+        self.flight.record("submit", frid=fr.frid,
+                           prompt_tokens=len(prompt))
+        # place BEFORE registering: a placement-time PoolCapacityError /
+        # ValueError (a request that can never fit) must propagate without
+        # leaving an unresolvable ghost in self._requests (which would
+        # wedge every later run())
+        if not self._place(fr):
+            if self.max_queue is not None \
+                    and len(self._waiting) >= self.max_queue:
+                self._c_rejections.inc()
+                self.flight.record("reject", frid=fr.frid,
+                                   waiting=len(self._waiting))
+                raise AdmissionRejected(
+                    f"fleet queue full ({len(self._waiting)}/"
+                    f"{self.max_queue} waiting) — backpressure, retry later")
+            fr.next_try_round = self._round + 1
+            self._waiting.append(fr)
+            self.flight.record("queue", frid=fr.frid,
+                               waiting=len(self._waiting))
+        self._requests[fr.frid] = fr
+        self._c_submitted.inc()
+        return fr.frid
+
+    def _alive(self):
+        return [rep for rep in self._replicas if rep.alive]
+
+    def _backoff(self, fr: _FleetRequest):
+        """One failed placement attempt: exponential backoff (capped) until
+        the next retry round."""
+        fr.retries += 1
+        fr.next_try_round = self._round + min(
+            self.max_backoff_rounds,
+            self.retry_backoff_rounds * (2 ** min(fr.retries, 10)))
+
+    def _place(self, fr: _FleetRequest) -> bool:
+        """Route rung: try each live replica least-loaded-first.  Placement
+        always goes through ``adopt`` so the fleet-anchored absolute
+        deadline is preserved and a migrated request resumes from its
+        streamed tokens (empty stream == fresh submission).  Typed
+        ``PoolCapacityError`` (can NEVER fit) propagates to the caller."""
+        order = sorted(
+            self._alive(),
+            key=lambda rep: (rep.engine.num_active + len(rep.engine._queue),
+                             rep.name))
+        for rep in order:
+            try:
+                rid = rep.engine.adopt(fr.prompt, fr.streamed,
+                                       deadline=fr.deadline, **fr.kw)
+            except AdmissionRejected:
+                continue
+            fr.replica = rep.name
+            fr.handle = rep.engine.lookup(rid)
+            self._assigned[rep.name].add(fr.frid)
+            self.flight.record("route", frid=fr.frid, replica=rep.name,
+                               resumed_tokens=len(fr.streamed))
+            return True
+        return False
+
+    # -- the fleet loop ----------------------------------------------------
+    def step(self) -> bool:
+        """One fleet round: retry queued placements whose backoff expired,
+        heartbeat-step every live replica (catching crashes, counting
+        wedge stalls), stream newly emitted tokens into the router record,
+        fail over dead replicas, and take periodic snapshots.  Returns
+        True when anything progressed."""
+        self._round += 1
+        progressed = False
+        for fr in list(self._waiting):
+            if fr.next_try_round > self._round:
+                continue
+            if self._place(fr):
+                self._waiting.remove(fr)
+                progressed = True
+            else:
+                self._backoff(fr)
+        for rep in self._replicas:
+            if not rep.alive:
+                continue
+            eng = rep.engine
+            if not (eng.num_active or eng._queue):
+                rep.stall = 0
+                continue
+            try:
+                ok = eng.step()
+            except Exception as exc:  # noqa: BLE001 — ANY escaped exception
+                # is a dead replica (the drills raise InjectedFault; a real
+                # deployment segfaults); the corpse's host state is not
+                # trusted — recovery uses snapshots + the router record
+                self._fail(rep, "crash", exc)
+                progressed = True
+                continue
+            self._stream(rep)
+            if ok:
+                rep.stall = 0
+                progressed = True
+            else:
+                rep.stall += 1
+                if rep.stall >= self.stall_threshold:
+                    self._fail(rep, "wedge", EngineStalledError(
+                        f"replica {rep.name}: no progress for {rep.stall} "
+                        f"consecutive heartbeats with work pending"))
+                    progressed = True
+        if self.snapshot_root is not None and self.snapshot_every \
+                and self._round % self.snapshot_every == 0:
+            for rep in self._replicas:
+                if not rep.alive:
+                    continue
+                try:
+                    path = rep.snapshots.save_engine(
+                        rep.engine, mode=self.snapshot_mode)
+                    self.flight.record("snapshot", replica=rep.name,
+                                       path=os.path.basename(path))
+                except Exception as exc:  # noqa: BLE001 — died mid-snapshot
+                    self._fail(rep, "crash", exc)
+                    progressed = True   # the failover IS progress (same as
+                    # the heartbeat crash path — the stall watchdog must
+                    # not starve on rounds that spent their time recovering)
+        return progressed
+
+    def _stream(self, rep: _Replica):
+        """Drain newly emitted tokens from the replica into the router's
+        per-request record (the token-streaming path), and capture results
+        for retired requests.  After a migration or snapshot restore the
+        engine may RE-emit tokens the router already streamed — greedy
+        regeneration is bit-identical, so the record only ever extends."""
+        now = self._clock()
+        for frid in sorted(self._assigned[rep.name]):
+            fr = self._requests[frid]
+            req = fr.handle
+            gen = req.generated
+            if len(gen) > len(fr.streamed):
+                if fr.first_token_t == 0.0:
+                    fr.first_token_t = now
+                fr.streamed.extend(int(t) for t in gen[len(fr.streamed):])
+            if req.finish_time:
+                self._resolve(fr, req, now)
+
+    def _resolve(self, fr: _FleetRequest, req: Request, now: float):
+        fr.result = req
+        fr.finish_t = now
+        self._c_resolved.inc()
+        if fr.replica is not None:
+            self._assigned[fr.replica].discard(fr.frid)
+        n = len(req.generated)
+        ttft = fr.first_token_t - fr.submit_t if fr.first_token_t else None
+        tpot = (fr.finish_t - fr.first_token_t) / (n - 1) \
+            if n > 1 and fr.first_token_t else None
+        self._summaries.append({
+            "rid": fr.frid, "tokens": n, "ttft_s": ttft, "tpot_s": tpot,
+            "e2e_s": now - fr.submit_t, "timed_out": req.timed_out,
+            "migrations": fr.migrations,
+        })
+        self.flight.record("resolve", frid=fr.frid, tokens=n,
+                           timed_out=req.timed_out,
+                           migrations=fr.migrations)
+
+    # -- failover ----------------------------------------------------------
+    def _fail(self, rep: _Replica, kind: str, exc: BaseException):
+        """Replica death: flight-record the failover (with any active
+        fault-plan context), revive the replica — from its newest intact
+        snapshot when one exists, blank otherwise — and migrate every
+        outstanding request the revived engine does not already carry."""
+        t0 = self._clock()
+        self._c_failovers.inc()
+        rep.failures += 1
+        rep.alive = False
+        rep.engine = None          # the corpse's state is not trusted
+        rep.stall = 0
+        self.flight.record("failover", replica=rep.name, kind=kind,
+                           failures=rep.failures, error=str(exc)[:200],
+                           fault_plan=fault_context())
+        outstanding = [self._requests[f]
+                       for f in sorted(self._assigned[rep.name])]
+        self._assigned[rep.name] = set()
+        restored_rids = None
+        if rep.failures <= self.max_failovers_per_replica:
+            restored_rids = self._revive(rep)
+        still = outstanding
+        if rep.alive and restored_rids is not None:
+            still = []
+            kept: set[int] = set()
+            for fr in outstanding:
+                rid = fr.handle.rid if fr.handle is not None else None
+                if rid is not None and rid in restored_rids \
+                        and fr.kw["temperature"] <= 0.0:
+                    # the snapshot carries this GREEDY request — it
+                    # continues on the revived replica from the snapshot
+                    # state (any re-decoded tokens are greedy-identical to
+                    # the ones already streamed).  Sampled requests must
+                    # NOT resume from a stale snapshot: re-sampling past
+                    # the snapshot point diverges from tokens the router
+                    # already streamed — they migrate via adopt() below,
+                    # which continues from the streamed tokens exactly
+                    # (their snapshot copy is pruned as a zombie).
+                    fr.handle = rep.engine.lookup(rid)
+                    self._assigned[rep.name].add(fr.frid)
+                    kept.add(rid)
+                else:
+                    still.append(fr)
+            # prune ZOMBIES: snapshot-restored requests the router already
+            # resolved before the crash would otherwise occupy slots/pages
+            # on the revived replica and decode to completion unobserved
+            for rid in sorted(restored_rids - kept):
+                rep.engine.cancel(rid)
+        for fr in still:
+            fr.replica = None
+            fr.handle = None
+            self._migrate(fr)
+        if not self._alive() and any(fr.result is None
+                                     for fr in self._requests.values()):
+            raise FleetFailedError(
+                f"no live replicas left ({len(self._requests)} requests "
+                f"tracked, failover budget "
+                f"{self.max_failovers_per_replica}/replica exhausted)")
+        self._h_recovery.observe(self._clock() - t0)
+
+    def _revive(self, rep: _Replica):
+        """Build a replacement engine for a dead replica; restore it from
+        the newest intact snapshot when one exists.  Returns the set of
+        engine-side rids the restored engine carries (empty for a blank
+        replacement), or None when the replacement could not be built
+        (the replica stays dead)."""
+        try:
+            eng = self._new_engine(rep.name)
+        except Exception as exc:  # noqa: BLE001 — factory failure
+            self.flight.record("revive_failed", replica=rep.name,
+                               error=str(exc)[:200])
+            return None
+        restored: set[int] = set()
+        if rep.snapshots is not None:
+            try:
+                res = rep.snapshots.restore_engine(eng)
+            except Exception as exc:  # noqa: BLE001 — unreadable snapshot
+                self.flight.record("restore_failed", replica=rep.name,
+                                   error=str(exc)[:200])
+                res = None
+            if res is not None:
+                path, applied = res
+                restored = set(eng._finished) \
+                    | {sl.req.rid for sl in eng._slots if sl is not None} \
+                    | {r.rid for r in eng._queue}
+                self.flight.record("restore", replica=rep.name,
+                                   path=os.path.basename(path),
+                                   mode=applied, requests=len(restored))
+        rep.engine = eng
+        rep.alive = True
+        return restored
+
+    def _migrate(self, fr: _FleetRequest):
+        """Move one orphaned request to a live replica by re-prefill of
+        prompt + streamed tokens; unplaceable requests wait in the fleet
+        queue with backoff (migrated requests are never dropped — the
+        reject rung applies to NEW submissions only)."""
+        self._c_migrations.inc()
+        fr.migrations += 1
+        self.flight.record("migrate", frid=fr.frid,
+                           tokens=len(fr.streamed),
+                           fault_plan=fault_context())
+        kw = fr.kw
+        eos = kw["eos_token_id"]
+        if fr.streamed and (len(fr.streamed) >= kw["max_new_tokens"]
+                            or (eos is not None and eos in fr.streamed)):
+            # completion edge: every token was streamed before the crash
+            # but the retirement was never observed — nothing to continue,
+            # synthesize the result from the router record
+            req = Request(rid=-1, prompt=fr.prompt,
+                          max_new_tokens=kw["max_new_tokens"],
+                          temperature=kw["temperature"], top_p=kw["top_p"],
+                          eos_token_id=eos, generated=list(fr.streamed),
+                          submit_time=fr.submit_t)
+            req.finish_time = self._clock()
+            self._resolve(fr, req, req.finish_time)
+            return
+        if not self._place(fr):
+            self._backoff(fr)
+            self._waiting.append(fr)
+
+    # -- driving -----------------------------------------------------------
+    def run(self, max_rounds: int | None = None,
+            max_stall_rounds: int = 1000) -> dict:
+        """Drive the fleet until every submitted request resolved; returns
+        ``{frid: Request}``.  ``max_stall_rounds`` consecutive no-progress
+        rounds raise :class:`EngineStalledError` (only reachable under a
+        never-clearing injected fault window)."""
+        stalled = 0
+        rounds = 0
+        while any(fr.result is None for fr in self._requests.values()):
+            progressed = self.step()
+            stalled = 0 if progressed else stalled + 1
+            if stalled >= max_stall_rounds:
+                raise EngineStalledError(
+                    f"fleet made no progress for {stalled} consecutive "
+                    f"rounds ({sum(fr.result is None for fr in self._requests.values())} "
+                    f"unresolved, {len(self._waiting)} waiting)")
+            rounds += 1
+            if max_rounds is not None and rounds >= max_rounds:
+                break
+        return self.results()
+
+    def results(self) -> dict:
+        return {frid: fr.result for frid, fr in self._requests.items()
+                if fr.result is not None}
+
+    # -- readouts ----------------------------------------------------------
+    def stats(self) -> dict:
+        q = self._h_recovery.percentiles()
+        return {
+            "replicas": len(self._replicas),
+            "replicas_alive": len(self._alive()),
+            "failovers": self._c_failovers.value,
+            "migrations": self._c_migrations.value,
+            "rejections": self._c_rejections.value,
+            "torn_snapshots": self._c_torn.value,
+            "requests_submitted": self._c_submitted.value,
+            "requests_resolved": self._c_resolved.value,
+            "waiting": len(self._waiting),
+            "recovery": {"count": self._h_recovery.count,
+                         "p50_ms": round(q[50] * 1e3, 3),
+                         "p95_ms": round(q[95] * 1e3, 3),
+                         "p99_ms": round(q[99] * 1e3, 3),
+                         "max_ms": round(self._h_recovery.max * 1e3, 3)
+                         if self._h_recovery.count else 0.0},
+            "per_replica": {rep.name: (rep.engine.stats() if rep.alive
+                                       else None)
+                            for rep in self._replicas},
+        }
+
+    def slo_report(self, ttft_deadline_s: float,
+                   window_s: float | None = None) -> dict:
+        """Fleet-level SLO report (TTFT measured at the ROUTER — token
+        observed leaving a replica — which is what a user would see)."""
+        return slo_report(self._summaries, ttft_deadline_s,
+                          window_s=window_s)
+
+    def metrics_snapshot(self) -> dict:
+        return self.metrics.snapshot()

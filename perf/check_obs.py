@@ -72,7 +72,66 @@ TRACE_SECTIONS = {
     "serving": [()],
     "shared-prefix": [("prefix_cache",), ("pr1_engine",)],
     "spec-decode": [("speculative",), ("baseline",)],
+    # failover is fleet-shaped, not engine-telemetry-shaped: validated by
+    # _validate_failover below (ISSUE 9 — zero lost requests, bit-equal
+    # outputs, recovery time + goodput through the shared slo_report keys)
+    "failover": [],
 }
+
+# the failover artifact's fleet-stats block must carry these
+FLEET_KEYS = ("failovers", "migrations", "torn_snapshots",
+              "requests_submitted", "requests_resolved", "recovery")
+RECOVERY_KEYS = ("count", "p50_ms", "p95_ms", "p99_ms")
+
+
+def _validate_failover(art: dict) -> list[str]:
+    problems = []
+    if "metric" not in art:
+        problems.append("missing top-level 'metric'")
+    if art.get("lost_requests") != 0:
+        problems.append(f"lost_requests is {art.get('lost_requests')!r} — "
+                        f"the failover drill must lose ZERO requests")
+    if art.get("outputs_bitexact") is not True:
+        problems.append("outputs_bitexact is not True — greedy outputs "
+                        "must match the uninterrupted engine bit-for-bit")
+    fleet = art.get("fleet")
+    if not isinstance(fleet, dict):
+        problems.append("missing fleet stats block")
+    else:
+        for k in FLEET_KEYS:
+            if k not in fleet:
+                problems.append(f"fleet: missing {k!r}")
+        if not fleet.get("failovers"):
+            problems.append("fleet.failovers is 0 — the drill's injected "
+                            "crash never fired")
+        rec = fleet.get("recovery")
+        if not isinstance(rec, dict):
+            problems.append("fleet.recovery missing")
+        else:
+            for k in RECOVERY_KEYS:
+                if k not in rec:
+                    problems.append(f"fleet.recovery: missing {k!r}")
+            if not rec.get("count"):
+                problems.append("fleet.recovery.count is 0 — no recovery "
+                                "time was measured")
+    slo = art.get("slo_report")
+    if not isinstance(slo, dict):
+        problems.append("missing slo_report")
+    else:
+        for block in ("ttft", "tpot", "e2e"):
+            b = slo.get(block)
+            if not isinstance(b, dict):
+                problems.append(f"slo_report missing {block!r}")
+                continue
+            for f in SLO_QUANTILE_KEYS:
+                if f not in b:
+                    problems.append(f"slo_report[{block!r}] missing {f!r}")
+        for f in ("ttft_deadline_ms", "goodput_fraction",
+                  "on_time_requests", "requests", "total_tokens",
+                  "goodput_tokens"):
+            if f not in slo:
+                problems.append(f"slo_report missing {f!r}")
+    return problems
 
 
 def _dig(d: dict, path):
@@ -91,6 +150,8 @@ def validate_artifact(art: dict, trace: str) -> list[str]:
                 f"(expected one of {sorted(TRACE_SECTIONS)})"]
     if not isinstance(art, dict):
         return ["artifact is not a JSON object"]
+    if trace == "failover":
+        return _validate_failover(art)
     if "metric" not in art:
         problems.append("missing top-level 'metric'")
     for path in TRACE_SECTIONS[trace]:

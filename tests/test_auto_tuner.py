@@ -84,6 +84,7 @@ def test_toy_sweep_picks_best(tmp_path):
     assert best.config["mp"] == 1 and not best.config["remat"]
 
 
+@pytest.mark.slow   # 6-12 s compile-heavy on CPU — tier-1 budget (r14 demotion, same class as the r8/r9 ones; ROADMAP tier-1 note)
 @requires_8
 def test_real_trials_on_virtual_mesh(tmp_path):
     """Two real candidates actually build + time their train steps."""

@@ -29,6 +29,7 @@ def test_mlp_classification_converges():
     assert losses[-1] < losses[0] * 0.3, losses[::10]
 
 
+@pytest.mark.slow   # 6-12 s compile-heavy on CPU — tier-1 budget (r14 demotion, same class as the r8/r9 ones; ROADMAP tier-1 note)
 def test_tiny_resnet_step_runs():
     from paddle_tpu.vision.models import ResNet, BasicBlock
     model = ResNet(BasicBlock, 18, num_classes=4)

@@ -141,6 +141,7 @@ def test_unet_timestep_embedding():
     assert not np.allclose(e[1], e[2])
 
 
+@pytest.mark.slow   # 6-12 s compile-heavy on CPU — tier-1 budget (r14 demotion, same class as the r8/r9 ones; ROADMAP tier-1 note)
 def test_unet_jit_compiled_step():
     """The UNet traces under jit via functional_state (the compiled
     diffusion train step)."""
@@ -169,6 +170,7 @@ def test_unet_jit_compiled_step():
 # ---------------------------------------------------------------------------
 # LLaMA-MoE variant (EP-ready sparse MLP in a model family)
 # ---------------------------------------------------------------------------
+@pytest.mark.slow   # 6-12 s compile-heavy on CPU — tier-1 budget (r14 demotion, same class as the r8/r9 ones; ROADMAP tier-1 note)
 def test_llama_moe_trains():
     from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
     cfg = LlamaConfig(vocab_size=128, hidden_size=32, intermediate_size=64,

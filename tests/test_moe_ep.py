@@ -164,6 +164,7 @@ def test_moe_ffn_ep_matches_single_device():
     assert "all-to-all" in hlo
 
 
+@pytest.mark.slow   # 6-12 s compile-heavy on CPU — tier-1 budget (r14 demotion, same class as the r8/r9 ones; ROADMAP tier-1 note)
 @requires_8
 def test_moe_ffn_ep_grads_match_single_device():
     W = 4

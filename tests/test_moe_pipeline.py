@@ -78,6 +78,7 @@ def test_moe_pipeline_all_to_all_in_hlo():
     assert "all_to_all" in hlo or "all-to-all" in hlo
 
 
+@pytest.mark.slow   # 6-12 s compile-heavy on CPU — tier-1 budget (r14 demotion, same class as the r8/r9 ones; ROADMAP tier-1 note)
 @requires_8
 def test_moe_pipeline_dp_pp_ep_trains():
     """Full three-axis dp×pp×ep hybrid: loss decreases, grads finite."""

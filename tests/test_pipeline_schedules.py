@@ -50,6 +50,7 @@ def lm_setup():
                 fns=(embed_mb, ba, head_mb), batch=batch, gpipe=gpipe)
 
 
+@pytest.mark.slow   # 6-12 s compile-heavy on CPU — tier-1 budget (r14 demotion, same class as the r8/r9 ones; ROADMAP tier-1 note)
 def test_1f1b_matches_gpipe(lm_setup):
     ep, bp, hp = lm_setup["params"]
     embed_mb, ba, head_mb = lm_setup["fns"]

@@ -43,6 +43,7 @@ def test_profiler_summary_has_named_ops_with_nonzero_times():
     assert all(tot > 0 for _, tot, _, _ in stats.values())
 
 
+@pytest.mark.slow   # 6-12 s compile-heavy on CPU — tier-1 budget (r14 demotion, same class as the r8/r9 ones; ROADMAP tier-1 note)
 def test_profiler_detaches_on_stop():
     from paddle_tpu.core.dispatch import _op_timer
     prof = profiler.Profiler()

@@ -711,3 +711,310 @@ class TestChaosSweeps:
                 np.testing.assert_array_equal(done[rid].output_ids, ref)
             eng.release_cache()   # retired pages park in the prefix cache
             assert eng.pool.num_free == eng.pool.num_pages
+
+
+# ---------------------------------------------------------------------------
+# hapi.Model.fit checkpoint wiring + elastic gang resume (ISSUE 9 satellite)
+# ---------------------------------------------------------------------------
+def _fit_job(seed=5):
+    paddle.seed(seed)
+    net = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 2))
+    m = paddle.Model(net)
+    m.prepare(optimizer.Adam(learning_rate=0.01,
+                             parameters=net.parameters()),
+              nn.CrossEntropyLoss())
+    return m
+
+
+def _fit_batches(n=8, bs=8):
+    out = []
+    for i in range(n):
+        r = np.random.default_rng(500 + i)
+        out.append((r.standard_normal((bs, 8)).astype(np.float32),
+                    r.integers(0, 2, (bs,)).astype(np.int64)))
+    return out
+
+
+class _LossLog:
+    def __init__(self):
+        from paddle_tpu.hapi.callbacks import Callback
+
+        class _C(Callback):
+            def __init__(s):
+                s.losses = []
+
+            def on_batch_end(s, mode, step, logs=None):
+                if mode == "train" and logs and "loss" in logs:
+                    s.losses.append(logs["loss"])
+        self.cb = _C()
+
+    @property
+    def losses(self):
+        return self.cb.losses
+
+
+class TestFitCheckpointResume:
+    def test_fit_auto_resume_bit_identical(self, tmp_path):
+        """The long-open ROADMAP smaller item: fit(ckpt=CheckpointManager)
+        saves every save_interval iterations and a relaunched fit
+        auto-resumes from find_latest_complete() — the combined loss
+        trajectory is bit-equal to the uninterrupted run, even though the
+        relaunch starts from a DIFFERENT seed (restore overwrites model +
+        optimizer accumulators + RNG)."""
+        data = _fit_batches()
+        ref = _LossLog()
+        _fit_job().fit(data, epochs=2, shuffle=False, verbose=0,
+                       callbacks=[ref.cb])
+        # run 1: dies after 5 of 16 iterations (snapshot every 2)
+        log1 = _LossLog()
+        m1 = _fit_job()
+        mgr1 = CheckpointManager(str(tmp_path), save_interval=2)
+        m1.fit(data, epochs=2, shuffle=False, verbose=0,
+               callbacks=[log1.cb], num_iters=5, ckpt=mgr1)
+        assert mgr1.model is m1.network          # attached automatically
+        # relaunch: fresh process sim, different init seed — restore wins
+        log2 = _LossLog()
+        mgr2 = CheckpointManager(str(tmp_path), save_interval=2)
+        _fit_job(seed=99).fit(data, epochs=2, shuffle=False, verbose=0,
+                              callbacks=[log2.cb], ckpt=mgr2)
+        got = log1.losses[:4] + log2.losses      # resumed at iteration 4
+        assert len(got) == len(ref.losses)
+        np.testing.assert_array_equal(np.asarray(got),
+                                      np.asarray(ref.losses))
+
+    def test_fit_ckpt_with_shuffle_warns(self, tmp_path):
+        """ckpt auto-resume needs deterministic batch order; combining it
+        with a fit-built shuffling loader gets a RuntimeWarning."""
+        from paddle_tpu.io import Dataset
+
+        class D(Dataset):
+            def __len__(self):
+                return 8
+
+            def __getitem__(self, i):
+                r = np.random.default_rng(i)
+                return (r.standard_normal(8).astype(np.float32),
+                        np.int64(0))
+
+        mgr = CheckpointManager(str(tmp_path), save_interval=2)
+        with pytest.warns(RuntimeWarning, match="DETERMINISTIC"):
+            _fit_job().fit(D(), batch_size=4, epochs=1, verbose=0,
+                           num_iters=2, ckpt=mgr)
+
+    def test_fit_resume_respects_num_iters_bound(self, tmp_path):
+        """A relaunch whose snapshot already covers the whole num_iters
+        budget must train ZERO extra steps — the resumed run must never
+        take an optimizer step the uninterrupted run did not."""
+        data = _fit_batches()
+        log1 = _LossLog()
+        mgr1 = CheckpointManager(str(tmp_path), save_interval=2)
+        _fit_job().fit(data, epochs=1, shuffle=False, verbose=0,
+                       callbacks=[log1.cb], num_iters=4, ckpt=mgr1)
+        assert len(log1.losses) == 4          # snapshot landed at it=4
+        log2 = _LossLog()
+        mgr2 = CheckpointManager(str(tmp_path), save_interval=2)
+        _fit_job(seed=13).fit(data, epochs=1, shuffle=False, verbose=0,
+                              callbacks=[log2.cb], num_iters=4, ckpt=mgr2)
+        assert log2.losses == []              # nothing left to train
+
+    def test_fit_resume_skips_torn_snapshot(self, tmp_path, monkeypatch):
+        """A fit checkpoint killed mid-write must never be resumed from:
+        the relaunch lands on the previous intact snapshot and still
+        reproduces the uninterrupted trajectory."""
+        _small_chunks(monkeypatch)
+        data = _fit_batches()
+        ref = _LossLog()
+        _fit_job().fit(data, epochs=1, shuffle=False, verbose=0,
+                       callbacks=[ref.cb])
+        # probe how many rank0.data write chunks ONE save costs, so the
+        # kill below deterministically lands inside the SECOND save
+        with inject({"ckpt.write": dict(match={"file": "rank0.data"},
+                                        after=1 << 30)}) as probe:
+            pm = _fit_job()
+            probe_mgr = CheckpointManager(str(tmp_path / "probe"),
+                                          model=pm.network,
+                                          optimizer=pm._optimizer)
+            probe_mgr.save(0)
+        chunks_per_save = probe.hits("ckpt.write")
+        assert chunks_per_save >= 2
+        log1 = _LossLog()
+        mgr1 = CheckpointManager(str(tmp_path), save_interval=2,
+                                 keep_last=None)
+        with inject({"ckpt.write": dict(match={"file": "rank0.data"},
+                                        after=chunks_per_save + 1)}):
+            with pytest.raises(InjectedFault):
+                _fit_job().fit(data, epochs=1, shuffle=False, verbose=0,
+                               callbacks=[log1.cb], ckpt=mgr1)
+        mgr2 = CheckpointManager(str(tmp_path), save_interval=2)
+        latest = mgr2.find_latest_complete()
+        assert latest is not None
+        resumed_at = CheckpointManager.step_of(latest)
+        log2 = _LossLog()
+        _fit_job(seed=31).fit(data, epochs=1, shuffle=False, verbose=0,
+                              callbacks=[log2.cb], ckpt=mgr2)
+        got = log1.losses[:resumed_at] + log2.losses
+        np.testing.assert_array_equal(np.asarray(got),
+                                      np.asarray(ref.losses))
+
+    def test_elastic_change_triggers_gang_resume(self, tmp_path):
+        """The elastic gang-resume path: an ElasticRestart callback stops
+        fit at the batch boundary where gang membership changes; the
+        relaunched fit (same CheckpointManager) resumes from the shared
+        latest-complete snapshot, bit-equal to the uninterrupted run."""
+        from paddle_tpu.distributed.fleet.elastic import (ElasticManager,
+                                                          ElasticStatus,
+                                                          MemoryStore)
+        from paddle_tpu.hapi.callbacks import Callback, ElasticRestart
+        data = _fit_batches()
+        ref = _LossLog()
+        _fit_job().fit(data, epochs=1, shuffle=False, verbose=0,
+                       callbacks=[ref.cb])
+        store = MemoryStore()
+        emgr = ElasticManager(store, np_min=1, np_max=4,
+                              heartbeat_timeout=60.0)
+        emgr.register("n0:1")
+        emgr.watch()                              # first observation: HOLD
+        watcher = ElasticRestart(emgr)
+
+        class _Join(Callback):
+            def on_batch_end(self, mode, step, logs=None):
+                if mode == "train" and step == 3:
+                    emgr.register("n1:1")         # scale-out mid-epoch
+
+        log1 = _LossLog()
+        mgr1 = CheckpointManager(str(tmp_path), save_interval=2)
+        m1 = _fit_job()
+        m1.fit(data, epochs=1, shuffle=False, verbose=0,
+               callbacks=[log1.cb, _Join(), watcher], ckpt=mgr1)
+        assert watcher.status == ElasticStatus.CHANGE
+        assert len(log1.losses) == 4              # stopped at the change
+        # "relaunch" with the regrouped gang: same root, and the SAME
+        # Model instance (the in-process relauncher) — fit() must reset
+        # stop_training or the relaunch would quit after one batch
+        log2 = _LossLog()
+        mgr2 = CheckpointManager(str(tmp_path), save_interval=2)
+        m1.fit(data, epochs=1, shuffle=False, verbose=0,
+               callbacks=[log2.cb], ckpt=mgr2)
+        assert len(log2.losses) == len(ref.losses) - 4   # full remainder
+        got = log1.losses[:4] + log2.losses
+        np.testing.assert_array_equal(np.asarray(got),
+                                      np.asarray(ref.losses))
+
+
+# ---------------------------------------------------------------------------
+# multi-host chaos: per-rank faults on the distributed save path (satellite)
+# ---------------------------------------------------------------------------
+def _gang_save(state, path, world=2, timeout=30.0):
+    """Emulate a `world`-rank gang save on one host: each rank runs
+    save_state_dict in its own thread with a thread-local process_index
+    and a REAL barrier.  A rank killed by an injected fault breaks the
+    barrier, killing the whole gang (preemption takes the gang, not one
+    process) — exactly the crash shape a multi-host TPU job sees."""
+    import threading
+    import sys
+    ssd = sys.modules["paddle_tpu.distributed.checkpoint.save_state_dict"]
+    bar = threading.Barrier(world)
+    tl = threading.local()
+    real_idx, real_cnt = jax.process_index, jax.process_count
+    real_bar = ssd._barrier
+
+    def fake_barrier():
+        try:
+            bar.wait(timeout=timeout)
+        except threading.BrokenBarrierError:
+            raise InjectedFault("gang barrier broken — a rank died")
+
+    errors = {}
+
+    def run_rank(r):
+        tl.rank = r
+        try:
+            save_state_dict(state, path)
+        except BaseException as e:  # noqa: BLE001 — re-raised below
+            errors[r] = e
+            bar.abort()
+
+    ssd._barrier = fake_barrier
+    jax.process_index = lambda: getattr(tl, "rank", 0)
+    jax.process_count = lambda: world
+    try:
+        threads = [__import__("threading").Thread(target=run_rank,
+                                                  args=(r,))
+                   for r in range(world)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout + 10)
+        if errors:
+            raise errors[min(errors)]
+    finally:
+        ssd._barrier = real_bar
+        jax.process_index = real_idx
+        jax.process_count = real_cnt
+
+
+@pytest.mark.slow
+class TestMultiHostChaosSweeps:
+    def test_multihost_save_chaos(self, tmp_path, monkeypatch):
+        """Per-rank ckpt.write / ckpt.commit faults on the emulated 2-rank
+        distributed save path: whatever rank dies at whatever byte,
+        find_latest_complete() must land exactly on the last COMMITTED
+        step — never on a torn multi-host snapshot — and its payload must
+        verify and load with that step's content."""
+        _small_chunks(monkeypatch)
+        targets = ["rank0.data", "rank1.data", "rank0.meta.json",
+                   "rank1.meta.json", "metadata.json", "manifest.json"]
+        for seed in range(6):
+            r = np.random.default_rng(900 + seed)
+            root = tmp_path / f"mh{seed}"
+            os.makedirs(root)
+            if seed % 3 == 2:
+                spec = {"ckpt.commit": dict(at=int(r.integers(0, 3)))}
+            else:
+                spec = {"ckpt.write": dict(
+                    match={"file": targets[int(r.integers(len(targets)))]},
+                    after=int(r.integers(0, 10)))}
+            committed = -1
+            with inject(spec, seed=seed):
+                for step in range(4):
+                    st = {"w": paddle.to_tensor(
+                        np.full((6, 6), float(step), np.float32)),
+                        "step": step}
+                    try:
+                        _gang_save(st, str(root / f"step_{step:08d}"))
+                    except InjectedFault:
+                        break
+                    committed = step
+            mgr = CheckpointManager(str(root))
+            latest = mgr.find_latest_complete()
+            if committed < 0:
+                assert latest is None, f"seed {seed}: torn snapshot passed"
+                continue
+            assert latest is not None, f"seed {seed}: lost a committed step"
+            assert CheckpointManager.step_of(latest) == committed, \
+                f"seed {seed}: landed on {latest}, expected {committed}"
+            verify_checkpoint(latest)
+            t = paddle.to_tensor(np.zeros((6, 6), np.float32))
+            load_state_dict({"w": t}, latest)
+            np.testing.assert_array_equal(
+                t.numpy(), np.full((6, 6), float(committed)))
+
+    def test_multihost_commit_swap_window_recovers(self, tmp_path):
+        """Gang dies in the commit's rename-swap window while OVERWRITING
+        an existing snapshot: the previous checkpoint is stranded at .old
+        and must be healed back by the next discovery."""
+        root = str(tmp_path / "swap")
+        os.makedirs(root)
+        path = os.path.join(root, "step_00000001")
+        _gang_save({"w": paddle.to_tensor(np.full((4,), 1.0, np.float32))},
+                   path)
+        with inject({"ckpt.commit": dict(match={"phase": "swap"}, at=0)}):
+            with pytest.raises(InjectedFault):
+                _gang_save({"w": paddle.to_tensor(
+                    np.full((4,), 2.0, np.float32))}, path)
+        mgr = CheckpointManager(root)
+        latest = mgr.find_latest_complete()   # heals step_1 back from .old
+        assert latest == path
+        t = paddle.to_tensor(np.zeros((4,), np.float32))
+        load_state_dict({"w": t}, latest)
+        np.testing.assert_array_equal(t.numpy(), np.full((4,), 1.0))

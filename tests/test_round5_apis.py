@@ -48,6 +48,7 @@ def test_grid_sample_3d_vs_torch():
                                rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow   # 6-12 s compile-heavy on CPU — tier-1 budget (r14 demotion, same class as the r8/r9 ones; ROADMAP tier-1 note)
 def test_grid_sample_grads():
     """Differentiable w.r.t. both input and grid (the reference ships
     dedicated CUDA bwd kernels; jax.vjp must produce matching numerics)."""

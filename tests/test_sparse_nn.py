@@ -59,6 +59,7 @@ def test_sparse_conv3d_matches_dense(stride, padding):
     np.testing.assert_allclose(inactive_expect, 0.0, atol=1e-5)
 
 
+@pytest.mark.slow   # 6-12 s compile-heavy on CPU — tier-1 budget (r14 demotion, same class as the r8/r9 ones; ROADMAP tier-1 note)
 def test_subm_conv3d_site_preservation_and_values():
     st, dense = _random_sparse_volume(density=0.3)
     conv = sparse.nn.SubmConv3D(2, 3, kernel_size=3, padding=1,
@@ -77,6 +78,7 @@ def test_subm_conv3d_site_preservation_and_values():
         sparse.nn.SubmConv3D(2, 3, 3, stride=2)
 
 
+@pytest.mark.slow   # 6-12 s compile-heavy on CPU — tier-1 budget (r14 demotion, same class as the r8/r9 ones; ROADMAP tier-1 note)
 def test_sparse_conv2d_matches_dense():
     dense = np.where(rng.uniform(size=(1, 6, 6, 2)) < 0.3,
                      rng.normal(0, 1, (1, 6, 6, 2)), 0.0).astype(np.float32)

@@ -59,6 +59,7 @@ def test_box_coder_decode_roundtrip():
                                rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.slow   # 6-12 s compile-heavy on CPU — tier-1 budget (r14 demotion, same class as the r8/r9 ones; ROADMAP tier-1 note)
 def test_roi_align_constant_map():
     """Constant feature map -> every pooled value equals the constant."""
     from paddle_tpu.vision.ops import roi_align
@@ -71,6 +72,7 @@ def test_roi_align_constant_map():
     np.testing.assert_allclose(np.asarray(out.numpy()), 7.0, rtol=1e-5)
 
 
+@pytest.mark.slow   # 6-12 s compile-heavy on CPU — tier-1 budget (r14 demotion, same class as the r8/r9 ones; ROADMAP tier-1 note)
 def test_roi_align_matches_center_sampling():
     """1x1 output with sampling_ratio=1 samples the roi center bilinearly."""
     from paddle_tpu.vision.ops import roi_align
@@ -90,6 +92,7 @@ def test_roi_align_matches_center_sampling():
     np.testing.assert_allclose(float(out.numpy()[0, 0, 0, 0]), ref, rtol=1e-5)
 
 
+@pytest.mark.slow   # 6-12 s compile-heavy on CPU — tier-1 budget (r14 demotion, same class as the r8/r9 ones; ROADMAP tier-1 note)
 def test_deform_conv2d_zero_offset_equals_conv():
     """Zero offsets + no mask == plain convolution."""
     from paddle_tpu.vision.ops import deform_conv2d
