@@ -66,6 +66,34 @@ Greedy outputs are bit-exact with the prefix cache on vs off (including
 across preemption + re-prefill) — `tests/test_prefix_cache.py` asserts
 token-for-token equality on every parity scenario.
 
+Double-buffered async host loop (`overlap=True`, ROADMAP item 5): the
+engine pipelines host scheduling against device execution at depth 1 —
+dispatch N's sampled token / cache-length / budget / done state stays ON
+DEVICE (`models/llama.make_paged_decode_horizon`) and feeds dispatch N+1
+directly, then dispatch N's emitted tokens drain through ONE batched
+fetch while N+1 runs.  EOS / budget / deadline / preemption decisions act
+on the drained step with a BOUNDED LAG of one dispatch; budget-predicted
+retirements hand their slot to the next admission before their final
+tokens even land (`_detach_predicted`), so the lag costs no lane
+idleness on budget-bound traffic.  `quiesce()` drains the pipeline to an
+exact host-visible step boundary — `snapshot()`, `adopt`-driven routers,
+`cancel()`, deadline sweeps of in-flight work, speculative verify
+dispatches, and the degradation ladder all quiesce first, so every
+existing exactness guarantee (greedy bit-exactness across the prefix
+cache / chunked prefill / speculative decoding / preemption /
+snapshot-restore / fleet-failover matrix) holds with overlap on.  On the
+XLA CPU backend, buffer DONATION pins each dispatch to synchronous
+execution (PERF.md §14's caveat, root-caused), so overlap mode trades
+the in-place page update for async dispatch there; TPU keeps donation —
+its transport is async regardless.
+
+Async streaming (the ROADMAP item-4 front-end seed): `submit(...,
+on_token=cb)` fires `cb(tok)` for every emitted token in order — at the
+sync boundary in a synchronous engine, at the drain in an overlapped one
+— and `Request.stream()` iterates tokens as they drain, driving the
+engine until retirement; streamed tokens are exactly the final
+`Request.generated` record.
+
 Observability: `ServingEngine(..., telemetry=True)` threads a
 `paddle_tpu.observability.Telemetry` through the step loop — request-
 lifecycle traces (Chrome/Perfetto-exportable), latency histograms
@@ -491,6 +519,10 @@ class Request:
     draft_accepted: int = 0            #   ... greedy-verified AND emitted
                                        #   (an EOS/budget freeze mid-run
                                        #   discards the tail uncounted)
+    # async-streaming front end (not serialized; a restored Request
+    # streams through a fresh subscription)
+    on_token: object | None = field(default=None, repr=False, compare=False)
+    _engine: object | None = field(default=None, repr=False, compare=False)
 
     @property
     def draft_accept_rate(self) -> float:
@@ -539,16 +571,54 @@ class Request:
         return np.concatenate([self.prompt,
                                np.asarray(self.generated, np.int32)])
 
+    def stream(self, max_stall_steps: int = 1000):
+        """Iterate this request's tokens in emission order, DRIVING the
+        owning engine between yields until the request retires (the
+        single-threaded analog of an async token stream; fed from the
+        overlap drain when the engine is double-buffered).  The streamed
+        sequence is exactly the final ``generated`` record — a token is
+        yielded once it is host-visible, never re-ordered, never skipped.
+        Safe to call after retirement (yields the recorded tokens and
+        returns).  Raises :class:`EngineStalledError` after
+        ``max_stall_steps`` consecutive no-progress engine steps (only
+        reachable under a never-clearing injected fault window)."""
+        i = 0
+        stalled = 0
+        while True:
+            while i < len(self.generated):
+                yield self.generated[i]
+                i += 1
+            if self.finish_time:
+                return
+            eng = self._engine() if self._engine is not None else None
+            if eng is None:
+                raise RuntimeError(
+                    "Request.stream: the owning engine is gone and the "
+                    "request never retired")
+            # consecutive ENGINE no-progress steps, same as run(): a step
+            # that progressed other requests resets the counter even if
+            # this request yielded nothing yet
+            stalled = 0 if eng.step() else stalled + 1
+            if stalled >= max_stall_steps:
+                raise EngineStalledError(
+                    f"Request.stream: no engine progress for {stalled} "
+                    f"consecutive steps waiting on rid={self.rid}")
+
 
 class _Slot:
-    __slots__ = ("req", "pages", "pending", "stalled", "admit_seq",
-                 "prefill_pos", "ctx", "resuming", "chunk_step",
+    __slots__ = ("req", "pages", "pending", "pending_dev", "stalled",
+                 "admit_seq", "prefill_pos", "ctx", "resuming", "chunk_step",
                  "draft", "spec_k")
 
     def __init__(self, req, pages, pending, admit_seq=0):
         self.req = req
         self.pages = pages             # list of physical page ids, in order
         self.pending = pending         # last sampled token, not yet in cache
+        self.pending_dev = None        # overlap mode: the admission-sampled
+                                       #   first token, still ON DEVICE and
+                                       #   unrecorded (drained later); while
+                                       #   a lane rides the device carry,
+                                       #   both pending fields are None
         self.stalled = False
         self.admit_seq = admit_seq     # monotonically increasing admit order
         self.prefill_pos = None        # tokens prefilled so far; None once
@@ -558,6 +628,51 @@ class _Slot:
                                        #   (one chunk per slot per step)
         self.draft = None              # _NgramDraft (speculative mode only)
         self.spec_k = 0                # adaptive per-slot draft length
+
+
+class _LaneRec:
+    """One lane of an in-flight decode dispatch: which slot it was
+    dispatched for, whether the drain must also record the slot's
+    admission-deferred first token, and — for budget-predicted
+    retirements whose slot was already handed to a successor — the
+    detached retirement state (`retiring` + the cache length the
+    predecessor had when it was detached)."""
+    __slots__ = ("s", "slot", "take_first", "retiring", "base_len")
+
+    def __init__(self, s, slot, take_first):
+        self.s = s
+        self.slot = slot
+        self.take_first = take_first
+        self.retiring = False
+        self.base_len = 0
+
+
+class _Inflight:
+    """One double-buffered decode dispatch in flight: the un-fetched
+    device outputs (``out`` plus the carried token/length/budget/done
+    state the NEXT dispatch consumes directly), the lane records the
+    drain will replay, and ``srcs`` — slot identity per lane at dispatch
+    time, so the next dispatch only carries lanes whose slot is unchanged
+    (a retired/preempted/re-admitted lane falls back to host state).
+    In overlap mode the dispatch itself runs on the engine's one-worker
+    thread and ``fut`` holds its pending result; ``ServingEngine._resolve``
+    fills the output fields (and optionally rebinds the engine's page
+    buffers) when someone needs them."""
+    __slots__ = ("fut", "out", "toks", "lengths", "rem", "done", "K",
+                 "greedy", "lanes", "srcs", "overlapped")
+
+    def __init__(self, K, greedy, lanes, srcs, overlapped):
+        self.fut = None
+        self.out = None
+        self.toks = None
+        self.lengths = None
+        self.rem = None
+        self.done = None
+        self.K = K
+        self.greedy = greedy
+        self.lanes = lanes
+        self.srcs = srcs
+        self.overlapped = overlapped
 
 
 # every live engine, for the tests' refcount-invariant leak guard
@@ -585,7 +700,13 @@ class ServingEngine:
     all K+1 positions, and the engine accepts the longest draft prefix
     whose argmax matches, emitting up to K+1 tokens per forward pass.
     All three knobs preserve greedy outputs bit-exactly vs the plain
-    engine.  `telemetry=True` (or a configured
+    engine.  `overlap=True` double-buffers the host loop: step N+1 is
+    scheduled and dispatched while step N's decode is still in flight,
+    with the sampled-token/length/budget/done state carried ON DEVICE
+    between dispatches and emitted tokens drained one batched fetch
+    behind (bounded-lag retirement; `quiesce()` forces an exact
+    boundary) — greedy outputs stay bit-exact vs `overlap=False` across
+    the whole feature matrix.  `telemetry=True` (or a configured
     `observability.Telemetry`) records request-lifecycle traces, latency
     histograms, and the crash flight recorder — also without touching
     outputs."""
@@ -598,11 +719,13 @@ class ServingEngine:
                  seed: int = 0, max_queue: int | None = None,
                  prefix_cache: bool = True, prefill_chunk: int | None = None,
                  speculative: int | None = None, spec_max_ngram: int = 3,
+                 overlap: bool = False,
                  telemetry: "Telemetry | bool | None" = None,
                  name: str = "engine"):
         import jax
         import jax.numpy as jnp
         from ..models.llama import (build_llama_paged_decode,
+                                    make_paged_decode_horizon,
                                     _sample_per_request)
         self._jax, self._jnp = jax, jnp
         # replica identity: rides the serve.crash / serve.wedge fault-point
@@ -632,6 +755,24 @@ class ServingEngine:
         # verified K+1 positions at a time (greedy slots only; 0/None off)
         self.speculative = 0 if not speculative else int(speculative)
         self.spec_max_ngram = max(1, int(spec_max_ngram))
+        # overlap=True: double-buffered async host loop (pipeline depth 1).
+        # Buffer donation pins a dispatch to SYNCHRONOUS execution on the
+        # XLA CPU backend (the PERF.md §14 "dispatch blocks" caveat,
+        # root-caused) — but dropping donation would copy the whole page
+        # pool every step.  Overlap mode therefore issues its decode
+        # dispatches from a ONE-WORKER thread: donation (and the in-place
+        # page update) is kept on every backend, the worker chains each
+        # dispatch on the previous one's future, and the main thread only
+        # blocks at the drain — true async on CPU, a no-op wrapper on a
+        # backend whose dispatch is already async.
+        self.overlap = bool(overlap)
+        self._inflight: _Inflight | None = None
+        self._executor = None
+        if self.overlap:
+            from concurrent.futures import ThreadPoolExecutor
+            self._executor = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix=f"{self.name}-dispatch")
+            weakref.finalize(self, self._executor.shutdown, wait=False)
         # telemetry=True -> default Telemetry(); None/False -> OFF, and off
         # is a no-op fast path: every hook site below is one `is not None`
         # flag check, zero per-token Python work (observability/telemetry.py)
@@ -656,39 +797,14 @@ class ServingEngine:
         # per-token python loop costs ~20 ms of dispatch round-trip on the
         # remote TPU transport (PERF.md §:llama_generate_fused) — K
         # amortizes it K-fold, which is what lets continuous batching beat
-        # the single-dispatch static fused baseline.  Per-slot eos/budget
-        # freezing inside the horizon mirrors llama_generate_fused's
-        # masking, so greedy outputs are step-exact at any K.
-        def _horizon(params, toks, lengths, page_tables, pk, pv, active, key,
-                     temps, top_ps, remaining, eos_ids, *, K, greedy):  # graftlint: jit
-            S = toks.shape[0]
-            out = jnp.zeros((S, K), jnp.int32)
-
-            def body(t, carry):
-                toks, lengths, pk, pv, done, key, out = carry
-                live = ~done
-                logits, pk, pv = decode_step(params, toks, lengths,
-                                             page_tables, pk, pv, live)
-                if greedy:
-                    # static fast path when every running request decodes
-                    # greedily (the common serving default): skips the
-                    # sort/cumsum of the nucleus mask — the same shortcut
-                    # _sample_token takes for temperature == 0.0
-                    tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                else:
-                    key, sub = jax.random.split(key)
-                    tok = _sample_per_request(logits, sub, temps, top_ps)
-                tok = jnp.where(done, eos_ids, tok)
-                out = out.at[:, t].set(tok)
-                lengths = lengths + live.astype(lengths.dtype)
-                done = done | ((eos_ids >= 0) & (tok == eos_ids)) \
-                    | ((t + 1) >= remaining)
-                return (tok, lengths, pk, pv, done, key, out)
-
-            carry = (toks, lengths, pk, pv, ~active, key, out)
-            toks, lengths, pk, pv, done, key, out = jax.lax.fori_loop(
-                0, K, body, carry)
-            return out, lengths, pk, pv
+        # the single-dispatch static fused baseline.  The loop body lives
+        # with the model math (models/llama.make_paged_decode_horizon);
+        # it returns the sampled-token/length/budget/done carry as DEVICE
+        # values so the overlapped engine feeds dispatch N+1 straight from
+        # dispatch N's outputs — the synchronous engine passes host values
+        # and done0=False, and the math is bit-identical either way.
+        _horizon = make_paged_decode_horizon(decode_step,
+                                             sample_fn=_sample_per_request)
 
         # prefill + first-token sample fused into ONE dispatch per admission
         # (a separate sample call would double the per-admission round-trips
@@ -764,21 +880,31 @@ class ServingEngine:
         self.verify_steps = 0          # speculative verify dispatches
         self.draft_tokens_proposed = 0  # draft tokens sent to verify
         self.draft_tokens_accepted = 0  # ... whose argmax matched
+        self.overlap_steps = 0         # dispatches issued double-buffered
+                                       #   (a previous step still in flight)
+        self.quiesces = 0              # pipeline drains forced by a
+                                       #   host-exactness point (snapshot/
+                                       #   cancel/deadline/ladder/verify)
         _LIVE_ENGINES.add(self)
 
     # -- submission --------------------------------------------------------
     def submit(self, prompt, max_new_tokens: int = 32, temperature: float = 0.0,
                top_p: float = 1.0, eos_token_id: int | None = None,
-               timeout: float | None = None) -> int:
+               timeout: float | None = None, on_token=None) -> int:
         """Queue one request.  Raises `PoolCapacityError` for requests that
         can NEVER fit the pool geometry, `AdmissionRejected` when the bounded
         queue is full (backpressure), plain ValueError for malformed input.
         `timeout` (seconds from now) retires the request — wherever it is —
-        once overdue, with `Request.timed_out` set."""
+        once overdue, with `Request.timed_out` set.  `on_token` is the
+        streaming hook: called as ``on_token(tok)`` for every emitted token
+        in emission order, at the step's host-sync boundary (or the overlap
+        drain — bounded lag, same order); `Request.stream()` is the
+        pull-style equivalent."""
         now = self._clock()
         return self._enqueue(
             prompt, [], max_new_tokens, temperature, top_p, eos_token_id,
-            None if timeout is None else now + float(timeout), now)
+            None if timeout is None else now + float(timeout), now,
+            on_token=on_token)
 
     def adopt(self, prompt, generated=(), max_new_tokens: int = 32,
               temperature: float = 0.0, top_p: float = 1.0,
@@ -807,7 +933,7 @@ class ServingEngine:
                              top_p, eos_token_id, deadline, self._clock())
 
     def _enqueue(self, prompt, generated, max_new_tokens, temperature,
-                 top_p, eos_token_id, deadline, now) -> int:
+                 top_p, eos_token_id, deadline, now, on_token=None) -> int:
         """Shared admission-queue entry for submit (fresh request, relative
         timeout already resolved to an absolute deadline) and adopt
         (mid-flight resume): validation, capacity check, backpressure, and
@@ -850,7 +976,8 @@ class ServingEngine:
                       max_new_tokens=int(max_new_tokens),
                       temperature=float(temperature), top_p=float(top_p),
                       eos_token_id=eos_token_id, submit_time=now,
-                      deadline=deadline, generated=list(generated))
+                      deadline=deadline, generated=list(generated),
+                      on_token=on_token, _engine=weakref.ref(self))
         self._queue.append(req)
         if self.telemetry is not None:
             self.telemetry.submitted(req, queue_depth=len(self._queue))
@@ -869,6 +996,12 @@ class ServingEngine:
         for r in self._queue:
             if r.rid == rid:
                 return r
+        if self._inflight is not None:
+            # budget-predicted retirement detached from the slot table but
+            # not yet drained — still live, still streamable
+            for lane in self._inflight.lanes:
+                if lane.retiring and lane.slot.req.rid == rid:
+                    return lane.slot.req
         return None
 
     def cancel(self, rid: int) -> bool:
@@ -879,6 +1012,16 @@ class ServingEngine:
         use this to prune snapshot-restored requests they already resolved
         elsewhere, so a revived replica does not decode zombies.  Returns
         True when the rid was found."""
+        # quiesce only when the rid is actually riding the pipeline (slot
+        # or in-flight lane): the common router case — pruning an
+        # already-finished or queued zombie — must not stall a healthy
+        # in-flight dispatch.  The drain may retire the rid itself; the
+        # finished-dict pop below still resolves it.
+        if any(sl is not None and sl.req.rid == rid for sl in self._slots) \
+                or (self._inflight is not None
+                    and any(ln.slot.req.rid == rid
+                            for ln in self._inflight.lanes)):
+            self.quiesce()     # cancellation acts on exact host state
         for s, slot in enumerate(self._slots):
             if slot is not None and slot.req.rid == rid:
                 self._register_slot(s, with_partial=True)
@@ -965,20 +1108,22 @@ class ServingEngine:
             self.telemetry.evicted(requested=n, freed=freed)
         return freed
 
-    def _register_slot(self, s: int, with_partial: bool):
-        """Index the slot's written-so-far KV into the prefix cache (full
-        blocks always; the trailing partial block too on retire/preempt,
-        since nothing will write into it anymore)."""
-        if self.cache is None:
-            return
-        slot = self._slots[s]
-        valid = int(self._lengths[s])
-        if valid <= 0:
+    def _register_pages(self, slot, valid: int, with_partial: bool):
+        """Index a slot's written-so-far KV (first `valid` tokens) into the
+        prefix cache — host-list hashing only, no device access."""
+        if self.cache is None or valid <= 0:
             return
         seq = np.concatenate(
             [slot.req.prompt,
              np.asarray(slot.req.generated, np.int32)])[:valid]
         self.cache.register(seq, slot.pages, with_partial=with_partial)
+
+    def _register_slot(self, s: int, with_partial: bool):
+        """Index the slot's written-so-far KV into the prefix cache (full
+        blocks always; the trailing partial block too on retire/preempt,
+        since nothing will write into it anymore)."""
+        self._register_pages(self._slots[s], int(self._lengths[s]),
+                             with_partial)
 
     def _release_slot(self, s: int):
         slot = self._slots[s]
@@ -1024,8 +1169,18 @@ class ServingEngine:
 
     def _retire_overdue(self):
         """Deadline enforcement: retire overdue requests wherever they live
-        (running slot or admission queue), marking them timed_out."""
+        (running slot or admission queue), marking them timed_out.  An
+        overdue request currently riding the in-flight dispatch forces a
+        quiesce first — the deadline acts on the drained step (bounded
+        lag), never on a half-visible one."""
         now = self._clock()
+        if self._inflight is not None:
+            live = [sl.req for sl in self._slots if sl is not None]
+            live += [ln.slot.req for ln in self._inflight.lanes
+                     if ln.retiring]
+            if any(r.deadline is not None and now > r.deadline
+                   for r in live):
+                self.quiesce()
         for s, slot in enumerate(self._slots):
             if slot is not None and slot.req.deadline is not None \
                     and now > slot.req.deadline:
@@ -1047,13 +1202,15 @@ class ServingEngine:
                     keep.append(req)
             self._queue = keep
 
-    def _record_token(self, s: int, tok: int) -> bool:  # graftlint: hot
-        """Append a sampled token; returns True when the request finished."""
-        slot = self._slots[s]
+    def _emit_token(self, slot, tok: int) -> bool:     # graftlint: hot
+        """Append one sampled token (a PYTHON int — callers fetch at the
+        annotated batched sync/drain boundaries and `.tolist()` rows, so
+        no per-token device round-trip happens here), fire the streaming
+        callback, and return True when the request just finished
+        (EOS/budget).  Finishing bookkeeping stays with the caller — the
+        slot may be attached (sync path) or detached (overlap drain of a
+        pre-retired lane)."""
         req = slot.req
-        # normalizes an already-fetched host scalar to a python int (the
-        # device sync happened at the annotated np.asarray fetch sites)
-        tok = int(tok)  # graftlint: disable=SYNC001
         req.generated.append(tok)
         if slot.draft is not None:
             slot.draft.append(tok)
@@ -1063,14 +1220,34 @@ class ServingEngine:
                 # once per request, inside the first-token branch — the
                 # per-token fast path stays telemetry-free
                 self.telemetry.first_token(req)
+        if req.on_token is not None:
+            req.on_token(tok)
         self.tokens_generated += 1
-        done = (req.eos_token_id is not None and tok == req.eos_token_id) \
+        return (req.eos_token_id is not None and tok == req.eos_token_id) \
             or len(req.generated) >= req.max_new_tokens
+
+    def _record_token(self, s: int, tok: int) -> bool:  # graftlint: hot
+        """Append a sampled token (already a host int); returns True when
+        the request finished (and retires it in place)."""
+        slot = self._slots[s]
+        done = self._emit_token(slot, tok)
         if done:
             self._finish(s)
         else:
             slot.pending = tok
         return done
+
+    def _finish_detached(self, slot, valid: int):
+        """Retire a slot already DETACHED from the slot table (a budget-
+        predicted retirement handed its lane to a successor while its
+        final tokens were still in flight): park the written KV in the
+        prefix cache, return the page references, record the result."""
+        self._register_pages(slot, valid, with_partial=True)
+        self.pool.free(slot.pages)
+        slot.req.finish_time = self._clock()
+        self._finished[slot.req.rid] = slot.req
+        if self.telemetry is not None:
+            self.telemetry.retired(slot.req)
 
     def _cow(self, s: int, idx: int, src: int | None = None):
         """Copy-on-write: give slot s its own copy of the (shared) page at
@@ -1078,6 +1255,7 @@ class ServingEngine:
         the copy source (admission attaches a cached partial page without
         ever putting the shared id in the table)."""
         jnp = self._jnp
+        self._join_dispatch()      # the copy chains on concrete pages
         slot = self._slots[s]
         dst = slot.pages[idx]
         if src is None:
@@ -1213,6 +1391,7 @@ class ServingEngine:
                         else (lambda *a: fn(*a, greedy=False)),
                         donate_argnums=(4, 5))
                     self._prefill_jit[(Tb, greedy)] = pf
+                self._join_dispatch()   # prefill chains on concrete pages
                 if tel is not None:
                     t_pf0 = tel.clock()
                     ann = tel.bridge_begin("prefill_dense")
@@ -1264,9 +1443,17 @@ class ServingEngine:
             self.cache.register(ctx, pages)
         if resuming:
             # the re-prefill rebuilt the cache; the last emitted token is
-            # still the pending one (a python int — _record_token
-            # normalizes) — discard the redundant sample
+            # still the pending one (a python int) — discard the
+            # redundant sample
             slot.pending = slot.req.generated[-1]
+        elif self.overlap:
+            # on-device token carry: the fused prefill+sample's first
+            # token never round-trips — the next decode dispatch consumes
+            # it directly and the drain records it (bounded lag).  The
+            # per-admission host sync the synchronous path pays below is
+            # structurally GONE here.
+            slot.pending = None
+            slot.pending_dev = tok
         else:
             # the ONE per-admission sync: the fused prefill+sample's
             # first token  # graftlint: disable=SYNC001
@@ -1278,6 +1465,7 @@ class ServingEngine:
         the prompt's full blocks into the cache and sample the first
         token."""
         jnp = self._jnp
+        self._join_dispatch()      # the chunk chains on concrete pages
         slot = self._slots[s]
         req = slot.req
         pos = slot.prefill_pos
@@ -1308,7 +1496,10 @@ class ServingEngine:
                 self._chunk_jit,
                 self.params, jnp.asarray(ids), jnp.asarray(pos, jnp.int32),
                 jnp.asarray(c, jnp.int32),
-                jnp.asarray(self._page_tables[s, :Pb]),
+                # .copy(): the row slice is a VIEW of the mutable host
+                # table — an async in-flight chunk must not see later
+                # host-side table growth (CPU jnp.asarray can alias)
+                jnp.asarray(self._page_tables[s, :Pb].copy()),
                 self._pages_k, self._pages_v)
         finally:
             if tel is not None:
@@ -1344,8 +1535,14 @@ class ServingEngine:
                     raise
                 self._record_token(s, int(np.asarray(e.result)))  # graftlint: disable=SYNC001
                 raise
-            # the ONE final-chunk sync: the sampled first token
-            self._record_token(s, int(np.asarray(tok)))  # graftlint: disable=SYNC001
+            if self.overlap:
+                # on-device carry: no final-chunk host sync — the next
+                # decode dispatch consumes the device scalar directly
+                slot.pending = None
+                slot.pending_dev = tok
+            else:
+                # the ONE final-chunk sync: the sampled first token
+                self._record_token(s, int(np.asarray(tok)))  # graftlint: disable=SYNC001
 
     def _sampler(self, greedy: bool):
         """Jitted single-logits sampler, cached per greedy flag (the final
@@ -1362,8 +1559,11 @@ class ServingEngine:
         return sf
 
     def _remaining(self, s: int) -> int:
-        req = self._slots[s].req
-        return req.max_new_tokens - len(req.generated)
+        slot = self._slots[s]
+        n = slot.req.max_new_tokens - len(slot.req.generated)
+        # an admission-deferred first token (overlap mode) is spoken for
+        # but not yet in `generated` — it counts against the budget
+        return n - 1 if slot.pending_dev is not None else n
 
     def _provision(self, steps):
         """Lazy page growth for up to `steps` decode steps ahead: every
@@ -1481,14 +1681,13 @@ class ServingEngine:
             for s in run:
                 tel.request_event(self._slots[s].req.rid, "verify_dispatch",
                                   drafted=len(drafts.get(s, ())))
+        lens = self._lengths.tolist()    # host mirror -> python ints
         for s in run:
             slot = self._slots[s]
             req = slot.req
             d = list(drafts.get(s, ()))
             nd = len(d)
-            # _lengths is the HOST numpy mirror (its device fetch is the
-            # annotated horizon/verify sync), so this read is free
-            old = int(self._lengths[s])  # graftlint: disable=SYNC001
+            old = lens[s]
             if req.temperature > 0.0:
                 try:
                     tok = self._sampler(False)(
@@ -1551,6 +1750,309 @@ class ServingEngine:
             self._horizon_jit[(K, greedy)] = fn
         return fn
 
+    # -- double-buffered host loop (overlap=True; ROADMAP item 5) ----------
+    @property
+    def inflight_depth(self) -> int:
+        """Decode dispatches in flight and not yet drained (0 or 1 — the
+        pipeline is double-buffered, not arbitrarily deep)."""
+        return 0 if self._inflight is None else 1
+
+    def quiesce(self) -> bool:
+        """Drain the pipeline to an EXACT host-visible step boundary:
+        fetch and record any in-flight dispatch's tokens (retiring what
+        finished) and flush any admission-deferred first tokens back to
+        host ints.  After quiesce(), `Request.generated`, slot pendings,
+        the length mirror, and the page accounting are precisely what a
+        synchronous engine would hold — `snapshot()`, `cancel()`,
+        deadline sweeps of in-flight work, speculative verify, and the
+        degradation ladder all call this first.  Returns True when
+        anything was actually in flight.  No-op (and free) on a
+        synchronous engine."""
+        rec, self._inflight = self._inflight, None
+        flushed = False
+        if rec is not None:
+            self._drain(rec)
+            self.quiesces += 1
+            flushed = True
+        for s, slot in enumerate(self._slots):
+            if slot is not None and slot.pending_dev is not None:
+                # materialized long ago (the dispatch that would consume
+                # it never went out) — this fetch waits on nothing new
+                tok0 = int(np.asarray(slot.pending_dev))
+                slot.pending_dev = None
+                if self._emit_token(slot, tok0):
+                    self._finish(s)
+                else:
+                    slot.pending = tok0
+                flushed = True
+        return flushed
+
+    def _flush_exhausted(self):
+        """Record admission-deferred first tokens that already EXHAUST
+        their request's budget (max_new_tokens == 1): such a lane must
+        never enter a decode dispatch, so its token is fetched here —
+        rare, and the fetch waits only on the admission prefill."""
+        for s, slot in enumerate(self._slots):
+            if slot is not None and slot.pending_dev is not None \
+                    and slot.prefill_pos is None and self._remaining(s) <= 0:
+                tok0 = int(np.asarray(slot.pending_dev))
+                slot.pending_dev = None
+                self._emit_token(slot, tok0)
+                self._finish(s)      # budget-exhausted by construction
+
+    def _detach_predicted(self):
+        """Budget-predicted retirement: a lane whose IN-FLIGHT dispatch is
+        guaranteed to finish its request — remaining budget <= the
+        dispatched horizon; an EOS could only finish it sooner — hands
+        its slot to the admission queue NOW instead of idling a full
+        dispatch.  The predecessor's pages stay referenced by the lane
+        record until the drain registers + frees them; the successor's
+        prefill writes disjoint fresh pages, so the in-flight dispatch
+        (which holds its own device copy of the page table) is
+        untouched."""
+        rec = self._inflight
+        if rec is None:
+            return
+        for lane in rec.lanes:
+            s, slot = lane.s, lane.slot
+            if lane.retiring or self._slots[s] is not slot \
+                    or slot.prefill_pos is not None:
+                continue
+            if self._remaining(s) <= rec.K:
+                lane.retiring = True
+                lane.base_len = int(self._lengths[s])
+                self._slots[s] = None
+                self._page_tables[s] = 0
+                self._lengths[s] = 0
+
+    def _dispatch_decode(self, run, K: int, greedy: bool):  # graftlint: hot
+        """Issue one decode-horizon dispatch over the runnable lanes and
+        return its `_Inflight` record WITHOUT fetching anything.  Lanes
+        whose slot also rode the previous (possibly still in-flight)
+        dispatch take their token/length/budget/done inputs from that
+        dispatch's DEVICE outputs (the on-device carry); freshly admitted
+        lanes merge in host values — and an admission-deferred first
+        token joins as a device scalar, so it never round-trips either.
+
+        Synchronous engines call the executable inline (donation makes
+        that blocking on CPU — unchanged behavior).  Overlap engines
+        submit the call to the one-worker thread, chaining on the
+        previous dispatch's future INSIDE the worker, so the main thread
+        returns immediately and the engine's page binding lives in the
+        future until someone `_join_dispatch()`s or drains."""
+        jnp = self._jnp
+        S = self.num_slots
+        prev = self._inflight
+        active = np.zeros((S,), bool)
+        active[run] = True
+        toks = np.zeros((S,), np.int32)
+        remaining = np.ones((S,), np.int32)
+        eos_ids = np.full((S,), -1, np.int32)
+        lanes = []
+        carried = []
+        deferred = []
+        for s in run:
+            slot = self._slots[s]
+            remaining[s] = self._remaining(s)
+            if slot.req.eos_token_id is not None:
+                eos_ids[s] = slot.req.eos_token_id
+            take_first = False
+            if prev is not None and prev.srcs.get(s) is slot:
+                carried.append(s)
+            elif slot.pending_dev is not None:
+                deferred.append((s, slot.pending_dev))
+                take_first = True
+            else:
+                toks[s] = slot.pending
+            lanes.append(_LaneRec(s, slot, take_first))
+        cm = None
+        if carried:
+            cm = np.zeros((S,), bool)
+            cm[carried] = True
+        # .copy() the persistent host mirrors: the dispatch may execute
+        # after the host has already mutated them (admissions, drains,
+        # detaches), and jnp.asarray can ALIAS numpy memory on the CPU
+        # backend.  The freshly built per-dispatch arrays need no copy.
+        lengths_host = self._lengths.copy()
+        tables = self._page_tables.copy()
+        temps = self._temps.copy()
+        top_ps = self._top_ps.copy()
+        key = self._split_key()        # main thread: keeps the key stream
+        fn = self._horizon_exec(K, greedy)
+
+        def merge(prev_state):
+            """Build the dispatch inputs; `prev_state` is (toks, lengths,
+            rem, done) device arrays of the previous dispatch (None when
+            nothing is carried).  Runs on the dispatching thread."""
+            toks_in = jnp.asarray(toks)
+            lengths_in = jnp.asarray(lengths_host)
+            rem_in = jnp.asarray(remaining)
+            done_in = jnp.zeros((S,), bool)
+            if prev_state is not None and cm is not None:
+                cmj = jnp.asarray(cm)
+                toks_in = jnp.where(cmj, prev_state[0], toks_in)
+                lengths_in = jnp.where(cmj, prev_state[1], lengths_in)
+                rem_in = jnp.where(cmj, prev_state[2], rem_in)
+                done_in = cmj & prev_state[3]
+            for ds, dev in deferred:
+                toks_in = toks_in.at[ds].set(dev)
+            return toks_in, lengths_in, rem_in, done_in
+
+        def call(pk, pv, toks_in, lengths_in, rem_in, done_in):
+            return self._call_paged(
+                fn, self.params, toks_in, lengths_in, jnp.asarray(tables),
+                pk, pv, jnp.asarray(active), key, jnp.asarray(temps),
+                jnp.asarray(top_ps), rem_in, jnp.asarray(eos_ids), done_in)
+
+        tel = self.telemetry
+        phase = "overlap_dispatch" if self.overlap else "decode_dispatch"
+        if tel is not None:
+            t_d0 = tel.clock()
+            ann = tel.bridge_begin(phase)
+        # carry sources are EXACTLY the dispatched lanes: only they got
+        # real inputs merged in (a slot skipped by _provision this step
+        # has default-filler rows in this dispatch — toks 0, remaining 1 —
+        # and the horizon clobbers an inactive lane's token carry with the
+        # eos filler), so a skipped lane must fall back to its host state,
+        # which the previous drain left exact
+        srcs = {lane.s: lane.slot for lane in lanes}
+        rec = _Inflight(K, greedy, lanes, srcs, self.overlap)
+        try:
+            if not self.overlap:
+                res = call(self._pages_k, self._pages_v, *merge(
+                    None if prev is None
+                    else (prev.toks, prev.lengths, prev.rem, prev.done)))
+                rec.out, rec.toks, rec.lengths, rec.rem, rec.done = res[:5]
+                self._pages_k, self._pages_v = res[-2], res[-1]
+            elif prev is not None and prev.fut is not None:
+                # chain INSIDE the worker: the previous dispatch's outputs
+                # (pages + carry) flow worker-to-worker, never through the
+                # main thread
+                pfut = prev.fut
+
+                def work_chained():
+                    pres = pfut.result()
+                    return call(pres[-2], pres[-1], *merge(
+                        (pres[1], pres[2], pres[3], pres[4])))
+
+                rec.fut = self._executor.submit(work_chained)
+            else:
+                # pipeline empty (or already joined by an admission): the
+                # page binding and any carry state are concrete arrays
+                pk0, pv0 = self._pages_k, self._pages_v
+                pstate = None if prev is None \
+                    else (prev.toks, prev.lengths, prev.rem, prev.done)
+                rec.fut = self._executor.submit(
+                    lambda: call(pk0, pv0, *merge(pstate)))
+        finally:
+            if tel is not None:
+                tel.bridge_end(ann)
+        self.steps_run += 1
+        if prev is not None:
+            self.overlap_steps += 1
+        if tel is not None:
+            tel.phase(phase, t_d0, tel.clock(), slots=len(run), k=K)
+            for s in run:
+                tel.request_event(self._slots[s].req.rid, "decode_dispatch",
+                                  k=K)
+        return rec
+
+    def _resolve(self, rec, rebind: bool):
+        """Materialize an overlap dispatch's outputs from its future (and
+        rebind the engine page buffers to them when `rec` is still the
+        NEWEST dispatch — a superseded record's pages were already donated
+        onward).  Re-raises the worker's exception (RecompileBudgetError:
+        the worker's `_call_paged` already rebound the pages from the
+        executed call, and the dispatch's tokens are discarded exactly as
+        on the synchronous path)."""
+        if rec.fut is None:
+            return
+        fut, rec.fut = rec.fut, None
+        res = fut.result()
+        rec.out, rec.toks, rec.lengths, rec.rem, rec.done = res[:5]
+        if rebind:
+            self._pages_k, self._pages_v = res[-2], res[-1]
+
+    def _join_dispatch(self):
+        """Block until the pending async dispatch's output binding is
+        concrete (overlap mode), so a page-consuming executable — an
+        admission prefill, a chunk, a COW copy — can chain on real
+        arrays.  The drain of its TOKENS still happens later; joining is
+        about the page buffers, not the step results."""
+        rec = self._inflight
+        if rec is None or rec.fut is None:
+            return
+        tel = self.telemetry
+        t0 = tel.clock() if tel is not None else 0.0
+        try:
+            self._resolve(rec, rebind=True)
+            if tel is not None:
+                tel.join_wait(t0, tel.clock())
+        except RecompileBudgetError:
+            # the dispatch is discarded (its tokens were never recorded;
+            # lengths never advanced — the rewind invariant); the worker
+            # already rebound the page buffers, so the engine stays usable
+            self._inflight = None
+            raise
+
+    def _drain(self, rec, rebind: bool = True):       # graftlint: hot
+        """Fetch one dispatch's emitted tokens (ONE batched device sync)
+        and replay the engine's freeze logic on the host: record tokens
+        until each lane's EOS/budget stop — exactly mirroring the
+        device-side freeze, so the host length mirror is reconstructed
+        without fetching `lengths` at all — then retire what finished.
+        Lanes whose slot was already retired by an earlier drain (an
+        unpredicted EOS that rode one extra dispatch frozen) are
+        skipped: their rows hold frozen `eos_ids` filler by
+        construction.  `rebind=False` marks a record superseded by a
+        newer dispatch (its page outputs were donated onward and must
+        not re-bind)."""
+        tel = self.telemetry
+        t0 = tel.clock() if tel is not None else 0.0
+        self._resolve(rec, rebind=rebind)
+        # the ONE per-step sync: every lane's K tokens in one batched fetch
+        out = np.asarray(rec.out)  # graftlint: disable=SYNC001
+        t1 = tel.clock() if tel is not None else 0.0
+        lens = self._lengths.tolist()     # host mirror -> python ints
+        for lane in rec.lanes:
+            s, slot = lane.s, lane.slot
+            if not lane.retiring and self._slots[s] is not slot:
+                continue           # retired by an earlier drain
+            if slot.req.finish_time:
+                continue
+            base = lane.base_len if lane.retiring else lens[s]
+            row = out[s].tolist()  # host ints, no per-token conversion
+            done = False
+            if lane.take_first and slot.pending_dev is not None:
+                # the admission-deferred first token: materialized when
+                # its dispatch ran — this fetch waits on nothing new
+                tok0 = int(np.asarray(slot.pending_dev))  # graftlint: disable=SYNC001
+                slot.pending_dev = None
+                done = self._emit_token(slot, tok0)
+            emitted = 0
+            if not done:
+                for tok in row:
+                    emitted += 1
+                    done = self._emit_token(slot, tok)
+                    if done:
+                        break
+            if done:
+                if lane.retiring:
+                    self._finish_detached(slot, base + emitted)
+                else:
+                    self._lengths[s] = base + emitted
+                    self._finish(s)
+            else:
+                # still live: the lane's last emitted token is the next
+                # pending one; the device carry holds the same state
+                self._lengths[s] = base + emitted
+                slot.pending = row[emitted - 1]
+        if tel is not None:
+            pre = "overlap" if rec.overlapped else "decode"
+            t2 = tel.clock()
+            tel.phase(f"{pre}_sync", t0, t1)
+            tel.phase(f"{pre}_record", t1, t2)
+
     # -- the serving loop --------------------------------------------------
     @property
     def num_active(self) -> int:
@@ -1601,8 +2103,15 @@ class ServingEngine:
             return False
         self._pressure = fault_point("serve.pool_pressure",
                                      step=self.steps_run) is not None
+        pre_tokens = self.tokens_generated
+        pre_finished = len(self._finished)
+        # overlap: hand budget-predicted retiring lanes to the admission
+        # queue before admitting, so a retirement costs zero lane idleness
+        self._detach_predicted()
         self._retire_overdue()
         self._admit()
+        if self.overlap:
+            self._flush_exhausted()
         # serve.crash phase="sched": die mid-step AFTER admissions mutated
         # slot/pool state but BEFORE any token was produced this step — the
         # raising InjectedFault models the process dying; host state is
@@ -1639,6 +2148,17 @@ class ServingEngine:
         if self.speculative:
             drafts = self._propose_drafts()
             if drafts:
+                # verify acceptance is HOST logic by design — the pipeline
+                # drains first so every pending token is an exact host int
+                # (overlap engines speculate on draftful steps at sync
+                # pacing and double-buffer the draftless ones; drafts are
+                # re-proposed on the drained state)
+                if self._inflight is not None or any(
+                        sl is not None and sl.pending_dev is not None
+                        for sl in self._slots):
+                    self.quiesce()
+                    drafts = self._propose_drafts()
+            if drafts:
                 # per-slot need: 1 + draft length covers every K/V write
                 # (padding lanes hit the trash page); draftless ride-along
                 # lanes need a single token — no K+1 over-provisioning
@@ -1654,7 +2174,25 @@ class ServingEngine:
                                 step=self._step_seq, phase="record")
                     return True
         K = self.decode_horizon
-        run = self._provision(K)
+        prev = self._inflight
+        if prev is not None:
+            # host lengths lag the in-flight dispatch by up to K tokens:
+            # provision carried lanes for BOTH the in-flight writes and
+            # this dispatch's (min(2K, remaining) is exact worst case);
+            # fresh lanes provision the usual K
+            want = {}
+            for s, sl in enumerate(self._slots):
+                if sl is not None and sl.prefill_pos is None:
+                    want[s] = 2 * K if prev.srcs.get(s) is sl else K
+            run = self._provision(want) if want else []
+        else:
+            run = self._provision(K)
+        if not run and self._inflight is not None:
+            # the pool cannot cover anyone while a step is in flight —
+            # drain it (its retirements may free pages) and let the
+            # degradation ladder act on exact state
+            self.quiesce()
+            run = self._provision(K)
         if not run and K > 1:
             # the pool cannot cover a full horizon for anyone — fall back to
             # single-step pacing so retirements can still free pages
@@ -1678,59 +2216,34 @@ class ServingEngine:
             run = self._provision(1)
         if not run:
             # pure-prefill step, pool-pressure window, or nothing to do
-            return prefilled
-        S = self.num_slots
-        active = np.zeros((S,), bool)
-        active[run] = True
-        toks = np.zeros((S,), np.int32)
-        remaining = np.ones((S,), np.int32)
-        eos_ids = np.full((S,), -1, np.int32)
-        for s in run:
-            slot = self._slots[s]
-            toks[s] = slot.pending
-            remaining[s] = self._remaining(s)
-            if slot.req.eos_token_id is not None:
-                eos_ids[s] = slot.req.eos_token_id
+            # (any in-flight work was already drained above, so tokens /
+            # retirements it produced still count as progress)
+            return prefilled or self.tokens_generated > pre_tokens \
+                or len(self._finished) > pre_finished
         greedy = all(self._temps[s] <= 0.0 for s in run)
-        if tel is not None:
-            t_d0 = tel.clock()
-            ann = tel.bridge_begin("decode_dispatch")
         try:
-            out, new_lengths, self._pages_k, self._pages_v = \
-                self._call_paged(
-                    self._horizon_exec(K, greedy),
-                    self.params, jnp.asarray(toks),
-                    jnp.asarray(self._lengths),
-                    jnp.asarray(self._page_tables), self._pages_k,
-                    self._pages_v, jnp.asarray(active), self._split_key(),
-                    jnp.asarray(self._temps), jnp.asarray(self._top_ps),
-                    jnp.asarray(remaining), jnp.asarray(eos_ids))
-        finally:
-            if tel is not None:
-                tel.bridge_end(ann)
-        t_d1 = tel.clock() if tel is not None else 0.0
-        # the TWO per-horizon syncs: K tokens/slot + lengths in one batch
-        # each — the whole point of the K-step horizon (PERF.md §8)
-        out = np.asarray(out)  # graftlint: disable=SYNC001
-        # inactive slots (stalled or mid-prefill) echo their input length
-        # through the horizon unchanged, so the wholesale copy is safe
-        self._lengths = np.asarray(new_lengths).astype(np.int32).copy()  # graftlint: disable=SYNC001
-        self.steps_run += 1
-        if tel is not None:
-            # per-phase host timing at the EXISTING sync boundaries only
-            # (the justified SYNC001 fetches above) — no telemetry sync
-            t_d2 = tel.clock()
-            tel.phase("decode_dispatch", t_d0, t_d1, slots=len(run), k=K)
-            tel.phase("decode_sync", t_d1, t_d2)
-            for s in run:
-                tel.request_event(self._slots[s].req.rid, "decode_dispatch",
-                                  k=K)
-        for s in run:
-            for tok in out[s]:
-                if self._record_token(s, tok):
-                    break
-        if tel is not None:
-            tel.phase("decode_record", t_d2, tel.clock())
+            rec = self._dispatch_decode(run, K, greedy)
+            prev, self._inflight = self._inflight, rec
+            if prev is not None:
+                # drain step N-1's tokens WHILE step N runs: the fetch
+                # waits only for N-1, and all host record/retire work
+                # overlaps N
+                self._drain(prev, rebind=False)
+            if not self.overlap:
+                # synchronous pacing: drain the dispatch we just issued
+                self._inflight = None
+                self._drain(rec)
+        except RecompileBudgetError:
+            # the raising dispatch's tokens are DISCARDED (lengths were
+            # never advanced; K/V above lengths is never attended — the
+            # rewind invariant), exactly as a synchronous engine discards
+            # them; anything still drainable is drained so the pipeline
+            # is empty when the error propagates
+            try:
+                self.quiesce()
+            except RecompileBudgetError:
+                pass           # the same failed dispatch, re-surfaced
+            raise
         # serve.crash phase="record": die after this horizon's tokens were
         # recorded (and finished requests retired) but before any caller
         # observed them — a router that re-prefills from what it last
@@ -1751,7 +2264,7 @@ class ServingEngine:
         preemption and can no longer raise."""
         steps = 0
         stalled = 0
-        while self._queue or self.num_active:
+        while self._queue or self.num_active or self._inflight is not None:
             progressed = self.step()
             stalled = 0 if progressed else stalled + 1
             if stalled >= max_stall_steps:
@@ -1837,7 +2350,8 @@ class ServingEngine:
                       "timeouts", "rejections", "cache_hits",
                       "cache_hit_tokens", "prefill_tokens",
                       "cache_evictions", "cow_copies", "verify_steps",
-                      "draft_tokens_proposed", "draft_tokens_accepted")
+                      "draft_tokens_proposed", "draft_tokens_accepted",
+                      "overlap_steps", "quiesces")
 
     def snapshot(self, mode: str = "full_kv",
                  include_finished: bool = True) -> dict:
@@ -1852,6 +2366,10 @@ class ServingEngine:
         so a restored engine's ``run()`` still returns them."""
         if mode not in ("full_kv", "compact"):
             raise ValueError(f"unknown snapshot mode {mode!r}")
+        # a snapshot is an EXACT state: drain the double-buffered pipeline
+        # (in-flight tokens recorded, deferred first tokens flushed) so
+        # the serialized pendings/lengths/pool are host-true
+        self.quiesce()
         requests: dict[str, dict] = {}
 
         def _ref(r: Request) -> int:
@@ -2083,6 +2601,11 @@ class ServingEngine:
             "preemptions": self.preemptions,
             "timeouts": self.timeouts,
             "rejections": self.rejections,
+            # double-buffered host loop (overlap=True): dispatches that
+            # went out while the previous step was still in flight, and
+            # forced pipeline drains (exactness points)
+            "overlap_steps": self.overlap_steps,
+            "quiesces": self.quiesces,
             # per-model-fn compile-cache misses (analysis.sanitize
             # instrumentation) — a warmed steady state must hold these
             # flat; bench --json artifacts embed them via engine_stats
@@ -2117,6 +2640,13 @@ class ServingEngine:
                 continue
             for p in slot.pages:
                 expect[p] = expect.get(p, 0) + 1
+        if self._inflight is not None:
+            # budget-predicted retirements detached from the slot table
+            # hold their pages through the lane record until drained
+            for lane in self._inflight.lanes:
+                if lane.retiring:
+                    for p in lane.slot.pages:
+                        expect[p] = expect.get(p, 0) + 1
         if self.cache is not None:
             for p in self.cache.pages():
                 expect[p] = expect.get(p, 0) + 1

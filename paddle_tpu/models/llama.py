@@ -28,8 +28,8 @@ from ..incubate.nn.functional import fused_rotary_position_embedding
 __all__ = ["LlamaConfig", "LlamaForCausalLM", "LlamaModel", "LlamaDecoderLayer",
            "build_functional_llama", "llama_microbatch_fns", "llama_block_specs",
            "llama_config_7b", "llama_config_tiny", "build_llama_decode",
-           "build_llama_paged_decode", "functional_params_from_layer",
-           "llama_generate"]
+           "build_llama_paged_decode", "make_paged_decode_horizon",
+           "functional_params_from_layer", "llama_generate"]
 
 
 @dataclass
@@ -990,6 +990,82 @@ def _sample_per_request(logits, key, temps, top_ps):
     masked = _top_p_mask(scaled, top_ps)
     sampled = jax.random.categorical(key, masked, axis=-1).astype(jnp.int32)
     return jnp.where(temps <= 0.0, greedy, sampled)
+
+
+def make_paged_decode_horizon(decode_step, sample_fn=None):
+    """Build the K-step decode-horizon loop with ON-DEVICE token feedback
+    (the serving engine's one decode executable; ROADMAP item 5).
+
+    K decode+sample steps fuse into one ``fori_loop`` dispatch, and the
+    loop state that used to round-trip through the host between dispatches
+    — the last sampled token per slot, the cache lengths, the remaining
+    generation budget, and the per-slot done flags — is both ACCEPTED and
+    RETURNED as device values.  A double-buffered engine feeds dispatch
+    N+1 directly from dispatch N's ``(toks, lengths, remaining, done)``
+    outputs, so the decode feedback token never touches the host and the
+    host-side drain of dispatch N's emitted tokens moves off the critical
+    path.  A synchronous engine passes host values and ``done0=False``
+    everywhere; the math (and therefore greedy output) is bit-identical
+    either way.
+
+    Per-slot freeze semantics inside the loop (mirrors
+    ``llama_generate_fused``'s masking, so greedy outputs are step-exact
+    at any K): a slot freezes once it emits ``eos_ids[s]`` (where >= 0)
+    or its ``remaining`` budget hits zero; frozen slots echo ``eos_ids``
+    into ``out``, stop advancing ``lengths``/``remaining``, and carry
+    their state through unchanged — including slots frozen at ENTRY via
+    ``done0`` (a lane whose EOS the overlapped host has not yet drained)
+    and inactive slots (``active=False``), whose returned ``done`` is the
+    ``done0`` passthrough so a momentarily stalled lane is never
+    permanently frozen by one inactive dispatch.
+
+    ``decode_step`` is the paged single-token executable from
+    :func:`build_llama_paged_decode`; ``sample_fn`` defaults to
+    :func:`_sample_per_request` (only consulted when ``greedy=False``).
+
+    Returns ``horizon(params, toks, lengths, page_tables, pk, pv, active,
+    key, temps, top_ps, remaining, eos_ids, done0, *, K, greedy) ->
+    (out [S, K], toks, lengths, remaining, done, pk, pv)`` — the page
+    buffers stay the LAST two outputs (the engine's ``_call_paged``
+    rebind convention)."""
+    if sample_fn is None:
+        sample_fn = _sample_per_request
+
+    def horizon(params, toks, lengths, page_tables, pk, pv, active, key,
+                temps, top_ps, remaining, eos_ids, done0, *, K, greedy):  # graftlint: jit
+        S = toks.shape[0]
+        out = jnp.zeros((S, K), jnp.int32)
+
+        def body(t, carry):
+            toks, lengths, rem, pk, pv, done, key, out = carry
+            live = ~done
+            logits, pk, pv = decode_step(params, toks, lengths,
+                                         page_tables, pk, pv, live)
+            if greedy:
+                # static fast path when every running request decodes
+                # greedily (the common serving default): skips the
+                # sort/cumsum of the nucleus mask — the same shortcut
+                # _sample_token takes for temperature == 0.0
+                tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            else:
+                key, sub = jax.random.split(key)
+                tok = sample_fn(logits, sub, temps, top_ps)
+            tok = jnp.where(done, eos_ids, tok)
+            out = out.at[:, t].set(tok)
+            lengths = lengths + live.astype(lengths.dtype)
+            rem = rem - live.astype(rem.dtype)
+            done = done | ((eos_ids >= 0) & (tok == eos_ids)) | (rem <= 0)
+            return (tok, lengths, rem, pk, pv, done, key, out)
+
+        carry = (toks, lengths, remaining, pk, pv, ~active | done0, key, out)
+        toks, lengths, rem, pk, pv, done, key, out = jax.lax.fori_loop(
+            0, K, body, carry)
+        # inactive lanes pass done0 through untouched: ~active folded into
+        # the in-loop freeze must not leak into the carried done state
+        done = jnp.where(active, done, done0)
+        return out, toks, lengths, rem, done, pk, pv
+
+    return horizon
 
 
 def functional_params_from_layer(model: "LlamaForCausalLM"):

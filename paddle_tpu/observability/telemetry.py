@@ -17,7 +17,12 @@ Metric catalog (README §Observability):
     compile + first run), ``engine.phase.<name>_s`` for phases
     ``sched`` (retire+admit host work), ``prefill_chunk``,
     ``decode_dispatch`` / ``decode_sync`` / ``decode_record``,
-    ``verify_dispatch`` / ``verify_sync`` / ``verify_record``
+    ``verify_dispatch`` / ``verify_sync`` / ``verify_record``, and — on
+    a double-buffered engine (``overlap=True``) — ``overlap_dispatch``
+    / ``overlap_sync`` / ``overlap_record`` (dispatch issue, the
+    one batched drain fetch, and the host replay of the drained step);
+    the suffix convention keeps them in the right
+    ``utilization_report`` buckets automatically
   counters: ``serve.requests_submitted``, ``serve.requests_retired``,
     ``serve.requests_timed_out``, ``serve.rejections``,
     ``serve.preemptions``, ``serve.cache_evictions``, ``serve.cow_copies``,
@@ -111,6 +116,10 @@ class Telemetry:
         self._g_frag = r.gauge("mem.fragmentation_frac")
         self._g_cache = r.gauge("mem.cache_page_refs")
         self._g_queue = r.gauge("mem.queue_depth")
+        # double-buffered host loop: decode dispatches in flight at the
+        # step's end (0 on a synchronous engine, 0/1 at depth 1) — the
+        # liveness companion to the engine.phase.overlap_* histograms
+        self._g_inflight = r.gauge("engine.inflight_depth")
         self._device = None      # lazy jax device handle; False = no stats
         self._nested_dispatch_s = 0.0   # dispatch time inside a sched span
 
@@ -146,6 +155,16 @@ class Telemetry:
         # prefill spans draw inside it on the engine track)
         self.tracer.engine_span("sched", t0, t1,
                                 nested_dispatch_s=round(nested, 6))
+
+    def join_wait(self, t0: float, t1: float):
+        """An overlap-mode `_join_dispatch` block (waiting for the async
+        dispatch's page binding before a prefill/COW can chain on it):
+        recorded as ``overlap_join_sync`` — the ``_sync`` suffix lands it
+        in the device-wait bucket — and accumulated into the nested-
+        dispatch subtraction so the enclosing ``sched`` span stays pure
+        host time (the buckets must remain disjoint)."""
+        self._nested_dispatch_s += t1 - t0
+        self.phase("overlap_join_sync", t0, t1)
 
     def bridge_begin(self, name: str):
         """Enter a ``paddle_tpu.profiler.host_annotation`` span (bridge on
@@ -429,11 +448,14 @@ class Telemetry:
         # memory observatory sample BEFORE the step/fault records, so a
         # pool-pressure dump's ramp already includes this step's occupancy
         self.sample_memory(engine)
+        inflight = getattr(engine, "inflight_depth", 0)
+        self._g_inflight.set(inflight)
         self.flight.record("step", step=engine._step_seq,
                            progressed=progressed, tokens=tokens,
                            active=engine.num_active,
                            queued=len(engine._queue),
-                           free_pages=engine.pool.num_free)
+                           free_pages=engine.pool.num_free,
+                           inflight=inflight)
         if engine._pressure:
             self.flight.record("fault", point="serve.pool_pressure",
                                step=engine._step_seq)

@@ -285,7 +285,11 @@ class ReplicaFleet:
             if not rep.alive:
                 continue
             eng = rep.engine
-            if not (eng.num_active or eng._queue):
+            # a double-buffered engine with nothing queued may still hold
+            # an in-flight dispatch whose tokens only land at the next
+            # drain — keep heartbeating it (step() reports the in-flight
+            # progress) instead of parking it un-drained
+            if not (eng.num_active or eng._queue or eng.inflight_depth):
                 rep.stall = 0
                 continue
             try:

@@ -133,10 +133,15 @@ def trend(root: str = ".", verbose: bool = True) -> int:
                  if v is not None), None),
             "ttft_p95_ms": (serving or {}).get("ttft_p95_ms"),
             "goodput": (serving or {}).get("goodput_fraction"),
+            # ISSUE 10 headline: double-buffered vs synchronous host loop,
+            # best paired tokens/s ratio ('-' for pre-overlap rounds)
+            "overlap_ratio": ((serving or {}).get("overlap") or {})
+            .get("best_paired_ratio"),
         })
     if verbose:
         hdr = (f"{'round':>5}  {'tokens/s':>10}  {'vs_base':>8}  "
-               f"{'serve tok/s':>11}  {'ttft_p95_ms':>11}  {'goodput':>7}")
+               f"{'serve tok/s':>11}  {'ttft_p95_ms':>11}  {'goodput':>7}  "
+               f"{'overlap':>7}")
         print(hdr)
         print("-" * len(hdr))
         for r in rows:
@@ -144,7 +149,8 @@ def trend(root: str = ".", verbose: bool = True) -> int:
                   f"{_fmt(r['vs_baseline'], 3):>8}  "
                   f"{_fmt(r['serving_tps']):>11}  "
                   f"{_fmt(r['ttft_p95_ms'], 2):>11}  "
-                  f"{_fmt(r['goodput'], 3):>7}")
+                  f"{_fmt(r['goodput'], 3):>7}  "
+                  f"{_fmt(r['overlap_ratio'], 3):>7}")
         v0, v1 = rows[0]["value"], rows[-1]["value"]
         if len(rows) >= 2 \
                 and all(isinstance(v, (int, float))

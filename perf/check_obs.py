@@ -61,6 +61,19 @@ UTILIZATION_KEYS = ("steps", "host_busy_s", "dispatch_s", "device_wait_s",
                     "per_phase")
 MEMORY_KEYS = ("samples", "last", "peak_occupancy_frac",
                "peak_fragmentation_frac", "min_free_pages", "prefix_cache")
+# ISSUE 10: the double-buffered host-loop A/B section the serving trace
+# must carry (bench_serving's overlap report), plus its perf gate below
+OVERLAP_KEYS = ("enabled", "rounds", "tokens_per_sec_on",
+                "tokens_per_sec_off", "best_paired_ratio", "pair_ratios",
+                "median_ratio", "step_host_p50_ms_on",
+                "step_host_p50_ms_off", "step_host_p50_reduced",
+                "outputs_bit_exact", "overlap_steps", "host_cpu_count")
+# paired-ratio floor for the overlap win: >= 1.0 where the host has cores
+# to overlap with; a SINGLE-core host time-slices host work against XLA
+# compute, so parity (the telemetry-gate 0.97 no-regression bound) is the
+# honest bar there
+OVERLAP_MIN_RATIO_MULTICORE = 1.0
+OVERLAP_MIN_RATIO_SINGLECORE = 0.97
 MEMORY_LAST_KEYS = ("step", "total_pages", "free_pages", "allocated_pages",
                     "referenced", "cache_page_refs", "occupancy_frac",
                     "fragmentation_frac", "queue_depth", "active")
@@ -244,6 +257,52 @@ def validate_artifact(art: dict, trace: str) -> list[str]:
                         or "total_s" not in e:
                     problems.append(f"{label}: compile.per_fn[{fn!r}] "
                                     f"missing count/total_s")
+    if trace == "serving":
+        problems.extend(_validate_overlap(art))
+    return problems
+
+
+def _validate_overlap(art: dict) -> list[str]:
+    """The ISSUE 10 overlap section: schema + the measured-win gate.
+
+    Bit-exactness is non-negotiable everywhere.  The throughput gate is
+    host-aware: on a multi-core host the double-buffered loop must hold
+    BEST paired on/off tokens-per-sec >= 1.0 (it reclaims real idle
+    time) and reduce the best step-latency p50; a single-core host
+    time-slices host work against XLA compute, so the gate degrades to
+    the 0.97 no-regression bound (same spirit as the telemetry-overhead
+    gate) and the p50 check is informational."""
+    problems = []
+    ov = art.get("overlap")
+    if not isinstance(ov, dict):
+        return ["missing section 'overlap' (the ISSUE 10 double-buffered "
+                "host-loop A/B)"]
+    for k in OVERLAP_KEYS:
+        if k not in ov:
+            problems.append(f"overlap: missing {k!r}")
+    if ov.get("outputs_bit_exact") is not True:
+        problems.append("overlap.outputs_bit_exact is not True — greedy "
+                        "outputs must match overlap-off bit-for-bit")
+    if not ov.get("overlap_steps"):
+        problems.append("overlap.overlap_steps is 0 — the pipeline never "
+                        "actually double-buffered")
+    ratio = ov.get("best_paired_ratio")
+    cores = ov.get("host_cpu_count") or 1
+    multicore = isinstance(cores, int) and cores > 1
+    floor = OVERLAP_MIN_RATIO_MULTICORE if multicore \
+        else OVERLAP_MIN_RATIO_SINGLECORE
+    if not isinstance(ratio, (int, float)) or ratio < floor:
+        problems.append(
+            f"overlap.best_paired_ratio {ratio!r} < {floor} "
+            f"({'multi' if multicore else 'single'}-core gate; "
+            f"host_cpu_count={cores})")
+    if multicore and ov.get("step_host_p50_reduced") is not True:
+        problems.append(
+            "overlap.step_host_p50_reduced is not True on a multi-core "
+            "host — the host loop must come off the step critical path")
+    metrics = _dig(art, ("metrics",))
+    if isinstance(metrics, dict) and "engine.inflight_depth" not in metrics:
+        problems.append("metrics: missing 'engine.inflight_depth' gauge")
     return problems
 
 

@@ -559,6 +559,19 @@ def _window_for(tel):
     return max(1.0, accounted * 1.25)
 
 
+def _overlap_section(ratio=1.05, cores=1, steps=10, reduced=True):
+    """A bench_serving-shaped ISSUE 10 overlap A/B section (the serving
+    trace must carry one; perf/check_obs gates its paired ratio)."""
+    return {"enabled": True, "rounds": 3,
+            "tokens_per_sec_on": 105.0, "tokens_per_sec_off": 100.0,
+            "best_paired_ratio": ratio, "pair_ratios": [ratio, 0.99, 1.0],
+            "median_ratio": 1.0, "step_host_p50_ms_on": 9.5,
+            "step_host_p50_ms_off": 10.0, "step_host_p50_reduced": reduced,
+            "outputs_bit_exact": True, "overlap_steps": steps,
+            "quiesces": 1, "inflight_depth_max": 1,
+            "host_cpu_count": cores, "arrival_pacing": "step-replay"}
+
+
 class TestObsCheckValidator:
     def test_real_engine_section_passes(self):
         cfg, params = _llama()
@@ -566,12 +579,49 @@ class TestObsCheckValidator:
         eng.submit(rng.integers(1, 64, (5,)).astype(np.int32),
                    max_new_tokens=3)
         eng.run()
-        art = {"metric": "trace_serving", **_section_from_engine(eng)}
+        art = {"metric": "trace_serving", **_section_from_engine(eng),
+               "overlap": _overlap_section()}
         assert validate_artifact(art, "serving") == []
         sp = {"metric": "trace_shared_prefix",
               "prefix_cache": _section_from_engine(eng),
               "pr1_engine": _section_from_engine(eng)}
         assert validate_artifact(sp, "shared-prefix") == []
+
+    def test_overlap_gate_pos_neg(self):
+        """The ISSUE 10 overlap gate: schema, bit-exactness, and the
+        machine-aware paired-ratio floor (>= 1.0 multi-core; 0.97
+        no-regression on a single-core host where overlap physically
+        cannot beat time-slicing)."""
+        cfg, params = _llama()
+        eng = _engine(cfg, params, telemetry=True)
+        eng.submit(rng.integers(1, 64, (5,)).astype(np.int32),
+                   max_new_tokens=3)
+        eng.run()
+        base = {"metric": "trace_serving", **_section_from_engine(eng)}
+        # missing section is a failure
+        assert any("overlap" in p
+                   for p in validate_artifact(dict(base), "serving"))
+        ok = dict(base, overlap=_overlap_section(ratio=0.98, cores=1))
+        assert validate_artifact(ok, "serving") == []   # single-core bar
+        multi_bad = dict(base,
+                         overlap=_overlap_section(ratio=0.98, cores=8))
+        assert any("best_paired_ratio" in p
+                   for p in validate_artifact(multi_bad, "serving"))
+        single_bad = dict(base,
+                          overlap=_overlap_section(ratio=0.9, cores=1))
+        assert any("best_paired_ratio" in p
+                   for p in validate_artifact(single_bad, "serving"))
+        p50_bad = dict(base, overlap=_overlap_section(cores=8,
+                                                      reduced=False))
+        assert any("step_host_p50" in p
+                   for p in validate_artifact(p50_bad, "serving"))
+        never = dict(base, overlap=_overlap_section(steps=0))
+        assert any("never actually double-buffered" in p
+                   for p in validate_artifact(never, "serving"))
+        inexact = dict(base, overlap=dict(_overlap_section(),
+                                          outputs_bit_exact=False))
+        assert any("bit" in p
+                   for p in validate_artifact(inexact, "serving"))
 
     def test_missing_fields_are_reported(self):
         cfg, params = _llama()
