@@ -62,13 +62,23 @@ bench-trend:
 	$(PY) perf/bench_trend.py
 
 OBS_ARTIFACT ?= /tmp/_obs_serving.json
+OBS_FRONTEND_ARTIFACT ?= /tmp/_obs_frontend.json
 
+# obs-check additionally runs the ISSUE 11 frontend trace (AsyncFrontend
+# bit-equality + zero-leak asserts, predictive-vs-depth admission A/B on
+# bursty + diurnal traffic) and schema-gates its artifact — admission
+# counters, fraction-sum, prediction-error stats, and the machine-aware
+# goodput-under-SLO gate all live in perf/check_obs.py --trace frontend.
 obs-check:
 	set -o pipefail; \
 	env JAX_PLATFORMS=cpu $(PY) bench.py --trace serving \
 		--json $(OBS_ARTIFACT) && \
 	env JAX_PLATFORMS=cpu $(PY) perf/check_obs.py \
-		--artifact $(OBS_ARTIFACT) --trace serving --gate
+		--artifact $(OBS_ARTIFACT) --trace serving --gate && \
+	env JAX_PLATFORMS=cpu $(PY) bench.py --trace frontend \
+		--json $(OBS_FRONTEND_ARTIFACT) && \
+	env JAX_PLATFORMS=cpu $(PY) perf/check_obs.py \
+		--artifact $(OBS_FRONTEND_ARTIFACT) --trace frontend
 
 lint:
 	$(GRAFTLINT) --fail-on-stale $(if $(DIFF),--diff $(DIFF))

@@ -571,7 +571,8 @@ class Request:
         return np.concatenate([self.prompt,
                                np.asarray(self.generated, np.int32)])
 
-    def stream(self, max_stall_steps: int = 1000):
+    def stream(self, max_stall_steps: int = 1000,
+               cancel_on_close: bool = True):
         """Iterate this request's tokens in emission order, DRIVING the
         owning engine between yields until the request retires (the
         single-threaded analog of an async token stream; fed from the
@@ -581,28 +582,41 @@ class Request:
         Safe to call after retirement (yields the recorded tokens and
         returns).  Raises :class:`EngineStalledError` after
         ``max_stall_steps`` consecutive no-progress engine steps (only
-        reachable under a never-clearing injected fault window)."""
+        reachable under a never-clearing injected fault window).
+
+        A consumer that exits EARLY — ``break``, generator ``close()``,
+        or the generator being garbage-collected — CANCELS the request
+        (``cancel_on_close=False`` opts out): a disconnected client must
+        free its pages mid-decode, not keep a slot decoding to nobody.
+        Normal exhaustion retires the request first, so completion never
+        cancels anything."""
         i = 0
         stalled = 0
-        while True:
-            while i < len(self.generated):
-                yield self.generated[i]
-                i += 1
-            if self.finish_time:
-                return
-            eng = self._engine() if self._engine is not None else None
-            if eng is None:
-                raise RuntimeError(
-                    "Request.stream: the owning engine is gone and the "
-                    "request never retired")
-            # consecutive ENGINE no-progress steps, same as run(): a step
-            # that progressed other requests resets the counter even if
-            # this request yielded nothing yet
-            stalled = 0 if eng.step() else stalled + 1
-            if stalled >= max_stall_steps:
-                raise EngineStalledError(
-                    f"Request.stream: no engine progress for {stalled} "
-                    f"consecutive steps waiting on rid={self.rid}")
+        try:
+            while True:
+                while i < len(self.generated):
+                    yield self.generated[i]
+                    i += 1
+                if self.finish_time:
+                    return
+                eng = self._engine() if self._engine is not None else None
+                if eng is None:
+                    raise RuntimeError(
+                        "Request.stream: the owning engine is gone and the "
+                        "request never retired")
+                # consecutive ENGINE no-progress steps, same as run(): a
+                # step that progressed other requests resets the counter
+                # even if this request yielded nothing yet
+                stalled = 0 if eng.step() else stalled + 1
+                if stalled >= max_stall_steps:
+                    raise EngineStalledError(
+                        f"Request.stream: no engine progress for {stalled} "
+                        f"consecutive steps waiting on rid={self.rid}")
+        finally:
+            if cancel_on_close and not self.finish_time:
+                eng = self._engine() if self._engine is not None else None
+                if eng is not None:
+                    eng.cancel(self.rid)
 
 
 class _Slot:
@@ -2109,6 +2123,7 @@ class ServingEngine:
         # queue before admitting, so a retirement costs zero lane idleness
         self._detach_predicted()
         self._retire_overdue()
+        pre_admit_seq = self._admit_seq
         self._admit()
         if self.overlap:
             self._flush_exhausted()
@@ -2210,7 +2225,14 @@ class ServingEngine:
         # injected pool-pressure window hides every page), per-step
         # budgeting bounds the wasted re-prefills to one victim per
         # stalled step.
-        if not run and not prefilled and self.num_active > 0:
+        # an admission THIS step ran its first prefill chunk inside _admit
+        # (chunk_step guard) — that is progress, not a stall: without this,
+        # a lone chunked-prefill admission with no decodable neighbor would
+        # be preempted on its own admission step and thrash admit -> chunk
+        # -> preempt until the prefix cache converged the re-prefills
+        admitted = self._admit_seq != pre_admit_seq
+        if not run and not prefilled and not admitted \
+                and self.num_active > 0:
             self._preempt(self._pick_victim())
             K = 1
             run = self._provision(1)
@@ -2218,7 +2240,8 @@ class ServingEngine:
             # pure-prefill step, pool-pressure window, or nothing to do
             # (any in-flight work was already drained above, so tokens /
             # retirements it produced still count as progress)
-            return prefilled or self.tokens_generated > pre_tokens \
+            return prefilled or admitted \
+                or self.tokens_generated > pre_tokens \
                 or len(self._finished) > pre_finished
         greedy = all(self._temps[s] <= 0.0 for s in run)
         try:

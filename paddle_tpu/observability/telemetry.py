@@ -88,6 +88,14 @@ class Telemetry:
         self._h_prefill = r.histogram("serve.prefill_s")
         self._h_e2e = r.histogram("serve.e2e_s")
         self._h_step = r.histogram("engine.step_host_s")
+        # tokens per prefill dispatch, WINDOW-scoped like the phase
+        # histograms (reset together): its total over the prefill phase
+        # totals is the windowed prefill tokens/s the admission
+        # predictor needs — the engine's prefill_tokens counter is
+        # lifetime-cumulative and would inflate the rate after any
+        # reset_window()
+        self._h_prefill_tok = r.histogram(
+            "engine.prefill_tokens_per_dispatch", unit="tokens", lo=1.0)
         self._phase_h = {}
         self._c_submitted = r.counter("serve.requests_submitted")
         self._c_retired = r.counter("serve.requests_retired")
@@ -363,6 +371,7 @@ class Telemetry:
         whole-prompt prefill+sample)."""
         t1 = self.clock()
         self._nested_dispatch_s += t1 - t0
+        self._h_prefill_tok.observe(tokens)
         self.phase(kind, t0, t1, rid=rid, tokens=tokens)
         self.tracer.request_event(rid, kind, t=t1, pos=pos,
                                   tokens=tokens, dur=t1 - t0)
@@ -476,7 +485,7 @@ class Telemetry:
         self.request_summaries.clear()
         for h in (self._h_ttft, self._h_tpot, self._h_queue,
                   self._h_prefill, self._h_e2e, self._h_step,
-                  *self._phase_h.values()):
+                  self._h_prefill_tok, *self._phase_h.values()):
             h.reset()
         self.memory.reset()
 

@@ -1,5 +1,5 @@
-"""Serving fleet layer (ROADMAP item 4): durable engine snapshots +
-replica-fleet router with live request migration.
+"""Serving plane (ROADMAP item 4): durable engine snapshots, the
+replica-fleet router, and the async front end + traffic harness.
 
 * :class:`EngineSnapshotManager` — crash-consistent
   ``ServingEngine.snapshot()`` persistence through the checkpoint commit
@@ -9,10 +9,31 @@ replica-fleet router with live request migration.
   least-loaded routing, health watchdog (crash + wedge detection),
   snapshot-restore / re-prefill failover with zero request loss and
   greedy-bit-exact outputs, fleet-wide degradation ladder
-  (route -> queue -> reject).
+  (route -> queue -> reject), router-authoritative token streaming
+  (``submit(on_token=...)`` survives failover without double emission).
+* :class:`AsyncFrontend` — the asyncio transport (ISSUE 11): ``await
+  submit()`` returns a bounded async token stream with per-client
+  backpressure; client disconnect cancels the request mid-decode; the
+  engine steps on one worker thread.  :class:`AdmissionController` /
+  :class:`TTFTPredictor` add SLO-aware admission — reject on PREDICTED
+  TTFT (typed :class:`SLORejected`) instead of raw queue depth, with the
+  prediction error itself tracked (``frontend.ttft_pred_err_s``).
+* :mod:`.traffic` — seeded, replayable scenario generators (Poisson
+  bursty + diurnal arrivals, shared-prefix user fleets, mixed
+  greedy/sampled/long-context, streaming-abandon clients) plus engine
+  and virtual-clock replays reporting goodput-under-SLO.
 """
 from .fleet import FleetFailedError, ReplicaFleet
+from .frontend import (AdmissionController, AdmissionView, AsyncFrontend,
+                       AsyncStream, SLORejected, TTFTPredictor,
+                       admission_view)
 from .snapshot import EngineSnapshotManager, load_engine_snapshot
+from .traffic import (ClientRequest, Scenario, goodput_report,
+                      make_scenario, replay_engine, replay_sim)
 
 __all__ = ["ReplicaFleet", "FleetFailedError", "EngineSnapshotManager",
-           "load_engine_snapshot"]
+           "load_engine_snapshot", "AsyncFrontend", "AsyncStream",
+           "SLORejected", "AdmissionController", "AdmissionView",
+           "TTFTPredictor", "admission_view", "ClientRequest", "Scenario",
+           "make_scenario", "replay_engine", "replay_sim",
+           "goodput_report"]

@@ -72,6 +72,10 @@ class _FleetRequest:
     replica: str | None = None
     handle: Request | None = None  # live engine-side Request object
     streamed: list = field(default_factory=list)
+    on_token: object | None = None  # router-fired streaming hook: called
+                                    #   once per token as the ROUTER log
+                                    #   extends, so a failover re-decode
+                                    #   never double-emits
     result: Request | None = None
     first_token_t: float = 0.0
     finish_t: float = 0.0
@@ -190,12 +194,22 @@ class ReplicaFleet:
     def submit(self, prompt, max_new_tokens: int = 32,
                temperature: float = 0.0, top_p: float = 1.0,
                eos_token_id: int | None = None,
-               timeout: float | None = None) -> int:
+               timeout: float | None = None, on_token=None) -> int:
         """Queue one request with the fleet; returns the fleet request id.
         Routing tries every live replica least-loaded-first; when all
         reject (their admission queues are full), the request waits in the
         bounded fleet queue; when THAT is full, typed
-        ``AdmissionRejected`` backpressure."""
+        ``AdmissionRejected`` backpressure.
+
+        ``on_token`` is the fleet-level streaming hook: fired once per
+        token as the ROUTER's authoritative log extends (at the fleet
+        heartbeat that drained the token), in emission order.  It is
+        deliberately NOT passed to the replica engines: after a failover
+        a revived/migrated engine RE-decodes tokens the router already
+        streamed (greedy-identical by the bit-exactness guarantee), and
+        an engine-side hook would re-fire them — the router log only ever
+        extends, so the fleet hook emits each position exactly once
+        across any number of crashes and migrations."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         now = self._clock()
         fr = _FleetRequest(
@@ -204,7 +218,7 @@ class ReplicaFleet:
                     temperature=float(temperature), top_p=float(top_p),
                     eos_token_id=eos_token_id),
             deadline=None if timeout is None else now + float(timeout),
-            submit_t=now)
+            submit_t=now, on_token=on_token)
         self._next_frid += 1
         self.flight.record("submit", frid=fr.frid,
                            prompt_tokens=len(prompt))
@@ -228,6 +242,27 @@ class ReplicaFleet:
         self._requests[fr.frid] = fr
         self._c_submitted.inc()
         return fr.frid
+
+    def cancel(self, frid: int) -> bool:
+        """Drop a fleet request wherever it lives (client disconnect from
+        the async front end): cancel it on its replica engine (pages free
+        mid-decode), remove it from the fleet queue and the router record.
+        Returns True when the frid was known.  Already-resolved requests
+        are forgotten (their result is discarded)."""
+        fr = self._requests.pop(frid, None)
+        if fr is None:
+            return False
+        self._waiting = [w for w in self._waiting if w.frid != frid]
+        if fr.replica is not None:
+            self._assigned.get(fr.replica, set()).discard(frid)
+            for rep in self._replicas:
+                if rep.name == fr.replica and rep.alive \
+                        and fr.handle is not None:
+                    rep.engine.cancel(fr.handle.rid)
+                    break
+        self.flight.record("cancel", frid=frid,
+                           streamed=len(fr.streamed))
+        return True
 
     def _alive(self):
         return [rep for rep in self._replicas if rep.alive]
@@ -343,7 +378,14 @@ class ReplicaFleet:
             if len(gen) > len(fr.streamed):
                 if fr.first_token_t == 0.0:
                     fr.first_token_t = now
-                fr.streamed.extend(int(t) for t in gen[len(fr.streamed):])
+                for t in gen[len(fr.streamed):]:
+                    t = int(t)
+                    fr.streamed.append(t)
+                    if fr.on_token is not None:
+                        # router-authoritative emission: fires exactly once
+                        # per position, even when a migrated engine
+                        # re-decodes already-streamed tokens
+                        fr.on_token(t)
             if req.finish_time:
                 self._resolve(fr, req, now)
 

@@ -32,6 +32,10 @@ BASE_KEYS = ("n", "cmd", "rc", "parsed")
 PARSED_KEYS = ("metric", "value", "unit")
 # a serving-trace section is recognized by carrying ALL of these
 SERVING_KEYS = ("ttft_p95_ms", "goodput_fraction")
+# the ISSUE 11 frontend trace's goodput-under-SLO (over OFFERED requests,
+# rejects in the denominator) — the column every admission/fleet PR is
+# judged on; recognized wherever a round's artifact nests it
+FRONTEND_KEY = "goodput_under_slo"
 
 
 def find_artifacts(root: str) -> list[tuple[int, str]]:
@@ -84,6 +88,24 @@ def find_serving_section(d) -> dict | None:
     return None
 
 
+def find_slo_goodput(d):
+    """First (depth-first) ``goodput_under_slo`` value — the ISSUE 11
+    frontend trace's offered-load goodput, wherever the round nests it."""
+    if isinstance(d, dict):
+        if FRONTEND_KEY in d:
+            return d[FRONTEND_KEY]
+        for v in d.values():
+            hit = find_slo_goodput(v)
+            if hit is not None:
+                return hit
+    elif isinstance(d, list):
+        for v in d:
+            hit = find_slo_goodput(v)
+            if hit is not None:
+                return hit
+    return None
+
+
 def _fmt(v, nd=1):
     if v is None:
         return "-"
@@ -100,6 +122,7 @@ def trend(root: str = ".", verbose: bool = True) -> int:
     problems: list[str] = []
     rows = []
     prev_serving = False
+    prev_frontend = False
     for rnd, path in arts:
         try:
             with open(path) as f:
@@ -119,6 +142,12 @@ def trend(root: str = ".", verbose: bool = True) -> int:
                             f"goodput_fraction) present in an earlier round "
                             f"but missing here")
         prev_serving = prev_serving or serving is not None
+        slo_goodput = find_slo_goodput(parsed)
+        if slo_goodput is None and prev_frontend:
+            problems.append(f"{path}: goodput-under-SLO "
+                            f"({FRONTEND_KEY}) present in an earlier "
+                            f"round but missing here")
+        prev_frontend = prev_frontend or slo_goodput is not None
         rows.append({
             "round": rnd,
             "metric": parsed.get("metric"),
@@ -137,11 +166,14 @@ def trend(root: str = ".", verbose: bool = True) -> int:
             # best paired tokens/s ratio ('-' for pre-overlap rounds)
             "overlap_ratio": ((serving or {}).get("overlap") or {})
             .get("best_paired_ratio"),
+            # ISSUE 11 headline: goodput-under-SLO over OFFERED requests
+            # on the frontend trace ('-' for pre-frontend rounds)
+            "slo_goodput": slo_goodput,
         })
     if verbose:
         hdr = (f"{'round':>5}  {'tokens/s':>10}  {'vs_base':>8}  "
                f"{'serve tok/s':>11}  {'ttft_p95_ms':>11}  {'goodput':>7}  "
-               f"{'overlap':>7}")
+               f"{'overlap':>7}  {'slo_gput':>8}")
         print(hdr)
         print("-" * len(hdr))
         for r in rows:
@@ -150,7 +182,8 @@ def trend(root: str = ".", verbose: bool = True) -> int:
                   f"{_fmt(r['serving_tps']):>11}  "
                   f"{_fmt(r['ttft_p95_ms'], 2):>11}  "
                   f"{_fmt(r['goodput'], 3):>7}  "
-                  f"{_fmt(r['overlap_ratio'], 3):>7}")
+                  f"{_fmt(r['overlap_ratio'], 3):>7}  "
+                  f"{_fmt(r['slo_goodput'], 3):>8}")
         v0, v1 = rows[0]["value"], rows[-1]["value"]
         if len(rows) >= 2 \
                 and all(isinstance(v, (int, float))

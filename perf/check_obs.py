@@ -89,7 +89,29 @@ TRACE_SECTIONS = {
     # _validate_failover below (ISSUE 9 — zero lost requests, bit-equal
     # outputs, recovery time + goodput through the shared slo_report keys)
     "failover": [],
+    # frontend is scenario-shaped (bursty + diurnal sections with an
+    # admission A/B): validated by _validate_frontend below (ISSUE 11 —
+    # async bit-equality, zero leaked pages, admission counters whose
+    # fractions sum to 1, predictive >= depth goodput-under-SLO)
+    "frontend": [],
 }
+
+# ISSUE 11: the frontend trace's per-scenario sections + the admission A/B
+FRONTEND_SCENARIOS = ("bursty", "diurnal")
+FRONTEND_SLO_KEYS = ("goodput_under_slo", "offered_requests",
+                     "rejected_requests", "abandoned_requests")
+FRONTEND_ADMISSION_KEYS = ("policy", "offered", "admitted", "queued",
+                           "rejected_slo", "rejected_depth",
+                           "fraction_sum", "ttft_pred_err_s")
+FRONTEND_PRED_ERR_KEYS = ("count", "mean_s", "p95_s")
+FRONTEND_AB_KEYS = ("rounds", "goodput_pred", "goodput_depth",
+                    "pair_ratios", "best_paired_ratio")
+# paired-goodput floor for predictive-vs-depth admission: the predictive
+# controller must match-or-beat the depth baseline where the host can
+# time anything reliably; a single-core host gets the same slack the
+# other timing gates get (this container's throughput varies ~2x)
+FRONTEND_MIN_RATIO_MULTICORE = 1.0
+FRONTEND_MIN_RATIO_SINGLECORE = 0.9
 
 # the failover artifact's fleet-stats block must carry these
 FLEET_KEYS = ("failovers", "migrations", "torn_snapshots",
@@ -147,6 +169,88 @@ def _validate_failover(art: dict) -> list[str]:
     return problems
 
 
+def _validate_frontend(art: dict) -> list[str]:
+    """The ISSUE 11 frontend trace: per-scenario TTFT/SLO/admission
+    sections + the predictive-vs-depth A/B gate."""
+    problems = []
+    if "metric" not in art:
+        problems.append("missing top-level 'metric'")
+    if art.get("outputs_bit_exact") is not True:
+        problems.append("outputs_bit_exact is not True — greedy outputs "
+                        "served through AsyncFrontend must match direct "
+                        "submit() bit-for-bit")
+    if art.get("leaked_pages") != 0:
+        problems.append(f"leaked_pages is {art.get('leaked_pages')!r} — "
+                        f"abandoned/cancelled requests must free every "
+                        f"page (zero leaks)")
+    cores = art.get("host_cpu_count") or 1
+    multicore = isinstance(cores, int) and cores > 1
+    floor = FRONTEND_MIN_RATIO_MULTICORE if multicore \
+        else FRONTEND_MIN_RATIO_SINGLECORE
+    scenarios = art.get("scenarios")
+    if not isinstance(scenarios, dict):
+        return problems + ["missing 'scenarios' (bursty + diurnal "
+                           "sections)"]
+    for name in FRONTEND_SCENARIOS:
+        sec = scenarios.get(name)
+        if not isinstance(sec, dict):
+            problems.append(f"scenarios missing {name!r}")
+            continue
+        for k in TTFT_KEYS:
+            if k not in sec:
+                problems.append(f"{name}: missing TTFT report key {k!r}")
+        slo = sec.get("slo_report")
+        if not isinstance(slo, dict):
+            problems.append(f"{name}: missing slo_report")
+        else:
+            for block in ("ttft", "tpot", "e2e"):
+                b = slo.get(block)
+                if not isinstance(b, dict):
+                    problems.append(f"{name}: slo_report missing {block!r}")
+                    continue
+                for f in SLO_QUANTILE_KEYS:
+                    if f not in b:
+                        problems.append(f"{name}: slo_report[{block!r}] "
+                                        f"missing {f!r}")
+            for f in FRONTEND_SLO_KEYS:
+                if f not in slo:
+                    problems.append(f"{name}: slo_report missing {f!r}")
+        adm = sec.get("admission")
+        if not isinstance(adm, dict):
+            problems.append(f"{name}: missing admission section")
+        else:
+            for f in FRONTEND_ADMISSION_KEYS:
+                if f not in adm:
+                    problems.append(f"{name}: admission missing {f!r}")
+            fs = adm.get("fraction_sum")
+            if isinstance(fs, (int, float)) and not 0.99 <= fs <= 1.01:
+                problems.append(
+                    f"{name}: admission fraction_sum {fs:.4f} != ~1.0 "
+                    f"(admit/queue/reject must decompose offered)")
+            err = adm.get("ttft_pred_err_s")
+            if isinstance(err, dict):
+                for f in FRONTEND_PRED_ERR_KEYS:
+                    if f not in err:
+                        problems.append(f"{name}: admission."
+                                        f"ttft_pred_err_s missing {f!r}")
+        ab = sec.get("ab")
+        if not isinstance(ab, dict):
+            problems.append(f"{name}: missing admission A/B section 'ab'")
+        else:
+            for f in FRONTEND_AB_KEYS:
+                if f not in ab:
+                    problems.append(f"{name}: ab missing {f!r}")
+            ratio = ab.get("best_paired_ratio")
+            if not isinstance(ratio, (int, float)) or ratio < floor:
+                problems.append(
+                    f"{name}: ab.best_paired_ratio {ratio!r} < {floor} "
+                    f"({'multi' if multicore else 'single'}-core gate; "
+                    f"host_cpu_count={cores}) — predictive admission must "
+                    f"match-or-beat depth-based goodput-under-SLO at "
+                    f"equal offered load")
+    return problems
+
+
 def _dig(d: dict, path):
     for k in path:
         if not isinstance(d, dict) or k not in d:
@@ -165,6 +269,8 @@ def validate_artifact(art: dict, trace: str) -> list[str]:
         return ["artifact is not a JSON object"]
     if trace == "failover":
         return _validate_failover(art)
+    if trace == "frontend":
+        return _validate_frontend(art)
     if "metric" not in art:
         problems.append("missing top-level 'metric'")
     for path in TRACE_SECTIONS[trace]:

@@ -638,6 +638,83 @@ class TestReplicaFleet:
 
 
 # ---------------------------------------------------------------------------
+# Fleet-level streaming (ISSUE 11 satellite): on_token through
+# ReplicaFleet.submit, router log authoritative across failover
+# ---------------------------------------------------------------------------
+class TestFleetStreaming:
+    def test_on_token_matches_final_record(self):
+        fleet = ReplicaFleet(_factory(), num_replicas=2)
+        got: dict[int, list] = {}
+        rids = [fleet.submit(p, max_new_tokens=8,
+                             on_token=got.setdefault(i, []).append)
+                for i, p in enumerate(_PROMPTS)]
+        done = _check_fleet(fleet, rids, _refs(8))
+        for i, rid in enumerate(rids):
+            assert got[i] == list(done[rid].generated)
+
+    def test_stream_survives_failover_without_double_emission(self):
+        """Kill r0 mid-trace: the revived/migrated engines RE-decode
+        tokens the router already streamed (greedy-identical), but the
+        fleet hook — fired only as the authoritative router log extends —
+        must emit every position exactly once, in order."""
+        fleet = ReplicaFleet(_factory(), num_replicas=2)
+        got: dict[int, list] = {}
+        with inject({"serve.crash": dict(match={"engine": "r0"},
+                                         at=2)}) as plan:
+            rids = [fleet.submit(p, max_new_tokens=8,
+                                 on_token=got.setdefault(i, []).append)
+                    for i, p in enumerate(_PROMPTS)]
+            done = _check_fleet(fleet, rids, _refs(8))
+        assert plan.fired("serve.crash") == 1
+        assert fleet.stats()["failovers"] == 1
+        assert fleet.stats()["migrations"] >= 1
+        for i, (rid, ref) in enumerate(zip(rids, _refs(8))):
+            # exactly the final record — no duplicates, no gaps, in order
+            assert got[i] == list(done[rid].generated)
+            assert got[i] == list(ref[len(_PROMPTS[i]):])
+
+    @pytest.mark.slow   # tier-1 budget: the crash-migration variant above
+    # pins the no-double-emission contract; this re-runs it on the
+    # snapshot-restore re-decode path
+    def test_stream_survives_snapshot_restore_failover(self, tmp_path):
+        """Same contract when the revived replica restores from a
+        snapshot and re-decodes from an OLDER state than the router had
+        streamed: the re-decoded overlap is suppressed by the log."""
+        fleet = ReplicaFleet(_factory(), num_replicas=2,
+                             snapshot_root=str(tmp_path),
+                             snapshot_every=2)
+        got: dict[int, list] = {}
+        with inject({"serve.crash": dict(match={"engine": "r0"},
+                                         at=5)}) as plan:
+            rids = [fleet.submit(p, max_new_tokens=10,
+                                 on_token=got.setdefault(i, []).append)
+                    for i, p in enumerate(_PROMPTS)]
+            done = _check_fleet(fleet, rids, _refs(10))
+        assert plan.fired("serve.crash") == 1
+        for i, rid in enumerate(rids):
+            assert got[i] == list(done[rid].generated)
+
+    def test_fleet_cancel(self):
+        """cancel(frid) drops the request wherever it lives — replica
+        slot, fleet queue — freeing engine pages (conftest leak guard
+        re-checks every replica engine)."""
+        fleet = ReplicaFleet(_factory(), num_replicas=2)
+        keep = fleet.submit(_PROMPTS[0], max_new_tokens=8)
+        drop = fleet.submit(_PROMPTS[1], max_new_tokens=48)
+        for _ in range(2):
+            fleet.step()
+        assert fleet.cancel(drop) is True
+        assert fleet.cancel(drop) is False          # already gone
+        assert fleet.cancel(99_999) is False        # unknown frid
+        done = fleet.run()
+        assert drop not in done and keep in done
+        np.testing.assert_array_equal(done[keep].output_ids, _refs(8)[0])
+        for rep in fleet._replicas:
+            rep.engine.release_cache()
+            assert rep.engine.pool.num_free == rep.engine.pool.num_pages
+
+
+# ---------------------------------------------------------------------------
 # bench --trace failover artifact schema (perf/check_obs.py)
 # ---------------------------------------------------------------------------
 def test_check_obs_failover_validator_pos_neg():

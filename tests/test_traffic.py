@@ -1,0 +1,293 @@
+"""Traffic-harness determinism + the simulated admission A/B (ISSUE 11).
+
+The replayability contract: one integer seed pins the ENTIRE scenario —
+arrival schedule, prompts, sampling params, abandon points — with zero
+wall-clock leakage, so two policies / engines / PRs compare on identical
+offered load.  The virtual-clock replay exercises the real
+AdmissionController/TTFTPredictor at 10k+ requests (the scale the tier-1
+lane cannot push through a real engine; that variant is slow-marked)."""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu  # noqa: F401 — jax compat shims
+from paddle_tpu.serving.frontend import (AdmissionController, AdmissionView,
+                                         SLORejected, TTFTPredictor)
+from paddle_tpu.serving.traffic import (Scenario, goodput_report,
+                                        make_scenario, replay_sim)
+
+ARRIVALS = ("poisson", "bursty", "diurnal")
+
+
+def _mk(seed, n=300, arrival="bursty", **kw):
+    base = dict(seed=seed, n_requests=n, vocab=128, arrival=arrival,
+                mean_interarrival_s=0.05, burst_every_s=2.0, burst_size=16,
+                burst_spread_s=0.2, diurnal_period_s=10.0,
+                diurnal_amplitude=0.9, prompt_len=(4, 24), max_new=(4, 16),
+                long_context_frac=0.1, long_prompt_len=(48, 96),
+                sampled_frac=0.2, shared_prefix_users=4,
+                system_prompt_len=16, abandon_frac=0.15,
+                abandon_range=(1, 6))
+    base.update(kw)
+    return make_scenario(arrival, **base)
+
+
+class TestDeterminism:
+    @pytest.mark.parametrize("arrival", ARRIVALS)
+    def test_same_seed_same_scenario(self, arrival):
+        """Identical seed => identical arrival schedule, prompts, budgets,
+        sampling params, AND abandon points — field by field, not just
+        the signature."""
+        a = _mk(11, arrival=arrival)
+        b = _mk(11, arrival=arrival)
+        assert a.signature() == b.signature()
+        assert len(a) == len(b) == 300
+        for ra, rb in zip(a.requests, b.requests):
+            assert ra.arrival_s == rb.arrival_s
+            np.testing.assert_array_equal(ra.prompt, rb.prompt)
+            assert ra.max_new_tokens == rb.max_new_tokens
+            assert ra.temperature == rb.temperature
+            assert ra.abandon_after == rb.abandon_after
+            assert ra.user == rb.user
+            assert ra.kind == rb.kind
+
+    @pytest.mark.parametrize("arrival", ARRIVALS)
+    def test_different_seed_differs(self, arrival):
+        assert _mk(11, arrival=arrival).signature() \
+            != _mk(12, arrival=arrival).signature()
+
+    def test_no_wall_clock_leakage(self, monkeypatch):
+        """Generation must never read a clock — a scenario generated today
+        and one generated tomorrow from the same seed are identical."""
+        def _bomb():
+            raise AssertionError("make_scenario read the wall clock")
+        monkeypatch.setattr(time, "time", _bomb)
+        monkeypatch.setattr(time, "perf_counter", _bomb)
+        monkeypatch.setattr(time, "monotonic", _bomb)
+        s = _mk(7)
+        assert len(s) == 300
+
+    def test_arrivals_sorted_from_zero(self):
+        for arrival in ARRIVALS:
+            at = [r.arrival_s for r in _mk(3, arrival=arrival).requests]
+            assert at[0] == 0.0
+            assert all(b >= a for a, b in zip(at, at[1:]))
+
+    @pytest.mark.slow   # ~13 s in-suite; determinism is covered at n=300
+    def test_10k_generation_fast_and_deterministic(self):
+        t0 = time.perf_counter()
+        a = _mk(5, n=10_000)
+        b = _mk(5, n=10_000)
+        assert len(a) == 10_000
+        assert a.signature() == b.signature()
+        assert time.perf_counter() - t0 < 60.0
+
+
+class TestScenarioShapes:
+    def test_bursty_has_bursts(self):
+        """The bursty process must actually pack arrivals: some
+        burst_spread window holds >= burst_size arrivals (a homogeneous
+        poisson at this rate essentially never does)."""
+        s = _mk(2, arrival="bursty", abandon_frac=0.0)
+        at = np.asarray([r.arrival_s for r in s.requests])
+        packed = max(int(np.sum((at >= t) & (at <= t + 0.2)))
+                     for t in at)
+        assert packed >= 8
+
+    def test_diurnal_rate_varies(self):
+        """Peak-vs-trough arrival counts over the period must differ
+        visibly (amplitude 0.9)."""
+        s = _mk(2, arrival="diurnal", n=2000, abandon_frac=0.0)
+        at = np.asarray([r.arrival_s for r in s.requests])
+        period = 10.0
+        phase = (at % period) / period
+        peak = int(np.sum((phase >= 0.1) & (phase < 0.4)))     # sin > 0
+        trough = int(np.sum((phase >= 0.6) & (phase < 0.9)))   # sin < 0
+        assert peak > 2 * trough
+
+    def test_shared_prefix_users_share_system_prompt(self):
+        s = _mk(4, shared_prefix_users=3, system_prompt_len=16)
+        short = [r for r in s.requests if r.user is not None]
+        assert len(short) > 10
+        sys0 = short[0].prompt[:16]
+        for r in short:
+            np.testing.assert_array_equal(r.prompt[:16], sys0)
+        # a user's later prompts embed their earlier turns (history grows)
+        by_user = {}
+        for r in short:
+            by_user.setdefault(r.user, []).append(r)
+        grew = any(len(rs) >= 2 and len(rs[-1].prompt) > len(rs[0].prompt)
+                   for rs in by_user.values())
+        assert grew
+
+    def test_abandon_clamped_to_budget(self):
+        """abandon_range above a short request's budget must clamp, not
+        crash generation (regression: rng.integers(lo >= hi))."""
+        s = make_scenario("clamp", seed=3, n_requests=200, vocab=64,
+                          max_new=(2, 6), abandon_frac=0.9,
+                          abandon_range=(4, 8))
+        abandons = [r for r in s.requests if r.abandon_after is not None]
+        assert abandons
+        for r in abandons:
+            assert 1 <= r.abandon_after <= r.max_new_tokens
+
+    def test_mix_fractions_present(self):
+        s = _mk(9, n=600)
+        kinds = {r.kind for r in s.requests}
+        assert {"short", "long", "sampled"} <= kinds
+        abandons = [r for r in s.requests if r.abandon_after is not None]
+        assert abandons
+        for r in abandons:
+            assert 1 <= r.abandon_after <= r.max_new_tokens
+        for r in s.requests:
+            assert (r.temperature > 0) == (r.kind == "sampled")
+
+
+SIM_KW = dict(num_slots=4, prefill_rate_tps=4000.0, step_s=0.02,
+              decode_horizon=8, slo_ttft_s=0.35)
+
+
+def _heavy(seed, n=2000, arrival="bursty"):
+    return make_scenario(
+        arrival, seed=seed, n_requests=n, vocab=128, arrival=arrival,
+        mean_interarrival_s=0.011, burst_every_s=4.0, burst_size=48,
+        burst_spread_s=0.2, diurnal_period_s=20.0, diurnal_amplitude=0.95,
+        prompt_len=(4, 24), max_new=(8, 24), abandon_frac=0.1)
+
+
+class TestSimReplay:
+    def test_sim_deterministic(self):
+        a = replay_sim(_heavy(1), policy="predictive", **SIM_KW)
+        b = replay_sim(_heavy(1), policy="predictive", **SIM_KW)
+        assert a["report"] == b["report"]
+        assert a["admission"] == b["admission"]
+
+    @pytest.mark.parametrize("arrival", ["bursty", "diurnal"])
+    def test_predictive_beats_depth_under_overload(self, arrival):
+        """At oversubscribed offered load, SLO-aware rejection turns
+        queue-rotted requests into fast rejections and keeps the admitted
+        ones on time: goodput-under-SLO (over OFFERED requests, rejects
+        in the denominator) must beat the depth-cap baseline."""
+        sc = _heavy(3, arrival=arrival)
+        pred = replay_sim(sc, policy="predictive", **SIM_KW)
+        depth = replay_sim(sc, policy="depth", max_queue_depth=200,
+                           **SIM_KW)
+        gp = pred["report"]["goodput_under_slo"]
+        gd = depth["report"]["goodput_under_slo"]
+        assert gp >= gd, (gp, gd)
+        assert pred["admission"]["rejected_slo"] > 0
+        assert pred["admission"]["fraction_sum"] == pytest.approx(1.0,
+                                                                  abs=1e-3)
+
+    def test_prediction_error_tracked(self):
+        rep = replay_sim(_heavy(5), policy="predictive",
+                         **SIM_KW)["admission"]
+        err = rep["ttft_pred_err_s"]
+        assert err["count"] > 0
+        # the sim server matches the predictor's model, so error stays
+        # bounded (waiting-set approximation error only; deterministic)
+        assert err["p50_s"] < 0.1
+
+    @pytest.mark.slow
+    def test_10k_replay(self):
+        """The full-scale replay: 10k+ requests through the real
+        controller on the virtual clock (slow lane; the tier-1 variant
+        above runs 2k)."""
+        for arrival in ("bursty", "diurnal"):
+            sc = _heavy(8, n=10_000, arrival=arrival)
+            pred = replay_sim(sc, policy="predictive", **SIM_KW)
+            depth = replay_sim(sc, policy="depth", max_queue_depth=500,
+                               **SIM_KW)
+            assert pred["report"]["offered_requests"] == 10_000
+            assert pred["report"]["goodput_under_slo"] \
+                >= depth["report"]["goodput_under_slo"]
+            # determinism at scale
+            again = replay_sim(sc, policy="predictive", **SIM_KW)
+            assert again["report"] == pred["report"]
+
+
+class TestPredictorAndController:
+    def test_predictor_idle_engine_is_prefill_only(self):
+        v = AdmissionView(free_slots=4, active=[], queued=[],
+                          prefill_rate_tps=1000.0, step_s=0.02,
+                          decode_horizon=8)
+        assert TTFTPredictor().predict(v, 100) == pytest.approx(0.1)
+
+    def test_predictor_monotone_in_queue(self):
+        p = TTFTPredictor()
+        base = dict(free_slots=0, active=[(0, 16)] * 4,
+                    prefill_rate_tps=1000.0, step_s=0.02, decode_horizon=8)
+        v0 = AdmissionView(queued=[], **base)
+        v4 = AdmissionView(queued=[(16, 16)] * 4, **base)
+        v8 = AdmissionView(queued=[(16, 16)] * 8, **base)
+        t0, t4, t8 = (p.predict(v, 16) for v in (v0, v4, v8))
+        assert t0 < t4 < t8
+
+    def test_depth_policy_rejects_at_cap(self):
+        from paddle_tpu.inference.paged import AdmissionRejected
+        c = AdmissionController(policy="depth", max_queue_depth=2)
+        v = AdmissionView(free_slots=0, active=[(0, 8)],
+                          queued=[(8, 8), (8, 8)])
+        with pytest.raises(AdmissionRejected):
+            c.decide(v, 8)
+        rep = c.report()
+        assert rep["rejected_depth"] == 1 and rep["offered"] == 1
+
+    def test_slo_rejected_is_admission_rejected(self):
+        from paddle_tpu.inference.paged import AdmissionRejected
+        assert issubclass(SLORejected, AdmissionRejected)
+        c = AdmissionController(policy="predictive", slo_ttft_s=1e-6)
+        v = AdmissionView(free_slots=0, active=[(0, 64)] * 4,
+                          queued=[(32, 32)] * 6)
+        with pytest.raises(SLORejected):
+            c.decide(v, 32)
+
+    def test_fraction_sum_over_mixed_decisions(self):
+        c = AdmissionController(policy="predictive", slo_ttft_s=0.5)
+        free = AdmissionView(free_slots=2, active=[], queued=[])
+        busy = AdmissionView(free_slots=0, active=[(0, 8)] * 4,
+                             queued=[(8, 8)])
+        jam = AdmissionView(free_slots=0, active=[(0, 512)] * 4,
+                            queued=[(64, 512)] * 32)
+        c.decide(free, 8)
+        c.decide(busy, 8)
+        with pytest.raises(SLORejected):
+            c.decide(jam, 64)
+        rep = c.report()
+        assert rep["offered"] == 3
+        assert rep["admitted"] == 1 and rep["queued"] == 1 \
+            and rep["rejected_slo"] == 1
+        assert rep["fraction_sum"] == pytest.approx(1.0, abs=1e-3)
+
+    def test_goodput_counts_rejects_in_denominator(self):
+        recs = [
+            {"idx": 0, "ttft_s": 0.1, "tokens": 8},
+            {"idx": 1, "ttft_s": 0.9, "tokens": 8},          # late
+            {"idx": 2, "rejected": True, "tokens": 0},       # rejected
+            {"idx": 3, "ttft_s": 0.2, "tokens": 4,
+             "abandoned": True},                             # on-time abandon
+        ]
+        rep = goodput_report(recs, slo_ttft_s=0.5)
+        assert rep["offered_requests"] == 4
+        assert rep["on_time_requests"] == 2
+        assert rep["goodput_under_slo"] == 0.5
+        assert rep["rejected_requests"] == 1
+        assert rep["abandoned_requests"] == 1
+
+
+def test_scenario_signature_covers_abandons():
+    """Two scenarios differing ONLY in abandon points must fingerprint
+    differently (the replay-relevant surface is complete)."""
+    a = _mk(21, abandon_frac=0.3)
+    b = Scenario(name=a.name, seed=a.seed,
+                 requests=[type(r)(**{**r.__dict__}) for r in a.requests],
+                 meta=dict(a.meta))
+    changed = False
+    for r in b.requests:
+        if r.abandon_after is not None:
+            r.abandon_after += 1
+            changed = True
+            break
+    assert changed
+    assert a.signature() != b.signature()
