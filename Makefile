@@ -63,12 +63,21 @@ bench-trend:
 
 OBS_ARTIFACT ?= /tmp/_obs_serving.json
 OBS_FRONTEND_ARTIFACT ?= /tmp/_obs_frontend.json
+OBS_FAILOVER_ARTIFACT ?= /tmp/_obs_failover.json
+OBS_FAILOVER_PERFETTO ?= /tmp/_obs_failover_perfetto.json
 
 # obs-check additionally runs the ISSUE 11 frontend trace (AsyncFrontend
 # bit-equality + zero-leak asserts, predictive-vs-depth admission A/B on
 # bursty + diurnal traffic) and schema-gates its artifact — admission
 # counters, fraction-sum, prediction-error stats, and the machine-aware
 # goodput-under-SLO gate all live in perf/check_obs.py --trace frontend.
+# Since ISSUE 12 it also runs the failover trace with the fleet-wide
+# observability plane on: the artifact's `fleet` block must carry the
+# bucket-wise MERGED replica histograms + per-replica telemetry, the
+# `stitched` block must show the crashed request as ONE cross-component
+# timeline (>= 3 tracks), and the stitched Perfetto JSON is written to
+# $(OBS_FAILOVER_PERFETTO) for ui.perfetto.dev.  The overhead gate's ON
+# arm runs stitching + fleet aggregation + memory sampling (<2% bar).
 obs-check:
 	set -o pipefail; \
 	env JAX_PLATFORMS=cpu $(PY) bench.py --trace serving \
@@ -78,7 +87,12 @@ obs-check:
 	env JAX_PLATFORMS=cpu $(PY) bench.py --trace frontend \
 		--json $(OBS_FRONTEND_ARTIFACT) && \
 	env JAX_PLATFORMS=cpu $(PY) perf/check_obs.py \
-		--artifact $(OBS_FRONTEND_ARTIFACT) --trace frontend
+		--artifact $(OBS_FRONTEND_ARTIFACT) --trace frontend && \
+	env JAX_PLATFORMS=cpu $(PY) bench.py --trace failover \
+		--json $(OBS_FAILOVER_ARTIFACT) \
+		--perfetto $(OBS_FAILOVER_PERFETTO) && \
+	env JAX_PLATFORMS=cpu $(PY) perf/check_obs.py \
+		--artifact $(OBS_FAILOVER_ARTIFACT) --trace failover
 
 lint:
 	$(GRAFTLINT) --fail-on-stale $(if $(DIFF),--diff $(DIFF))

@@ -1046,7 +1046,7 @@ def bench_serving_spec_decode(seed=0):
     }
 
 
-def bench_serving_failover(seed=0):
+def bench_serving_failover(seed=0, perfetto=None):
     """Replica-failover drill trace (ISSUE 9; PERF.md §16): a 2-replica
     ``serving.ReplicaFleet`` with periodic full-KV engine snapshots serves
     a mixed-length greedy trace while a seeded ``serve.crash`` kills
@@ -1059,12 +1059,23 @@ def bench_serving_failover(seed=0):
     artifact then carries the measured recovery time (the failover
     handler's wall clock: detect -> restore -> migrate) and
     goodput-at-deadline through the shared ``slo_report`` schema
-    (validated by ``perf/check_obs.py --trace failover``)."""
+    (validated by ``perf/check_obs.py --trace failover``).
+
+    Since ISSUE 12 the replicas run with telemetry ON and the artifact
+    additionally carries the fleet-wide observability plane: the
+    ``fleet`` block gains bucket-wise MERGED replica histograms +
+    per-replica gauges (``ReplicaFleet.stats_snapshot``), and the
+    ``stitched`` block summarizes the cross-component Perfetto trace —
+    the crashed request must read as ONE timeline (router span ->
+    replica r0 -> migration flow-event -> surviving/revived replica).
+    ``perfetto`` (or ``--perfetto PATH``) writes the stitched trace
+    JSON for ui.perfetto.dev."""
     import tempfile
     import jax
     import jax.numpy as jnp
     from paddle_tpu.models.llama import LlamaConfig, build_functional_llama
     from paddle_tpu.inference.paged import ServingEngine
+    from paddle_tpu.observability import Telemetry
     from paddle_tpu.serving import ReplicaFleet
     from paddle_tpu.resilience import inject
 
@@ -1088,7 +1099,8 @@ def bench_serving_failover(seed=0):
                              page_size=page_size, num_pages=96,
                              max_pages_per_seq=16, dtype=dtype,
                              attention_impl="auto" if on_tpu else "ref",
-                             prompt_bucket=16, decode_horizon=horizon)
+                             prompt_bucket=16, decode_horizon=horizon,
+                             telemetry=Telemetry())
 
     # the uninterrupted single-engine reference (the bit-exactness bar)
     eng = factory()
@@ -1124,9 +1136,21 @@ def bench_serving_failover(seed=0):
     for frid, ref in zip(frids, refs):
         np.testing.assert_array_equal(np.asarray(done[frid].output_ids),
                                       ref)
-    st = fleet.stats()
+    # fleet-wide observability plane (ISSUE 12): the stats_snapshot merges
+    # replica histograms bucket-wise + keeps gauges per replica; the
+    # stitcher produces ONE Perfetto view whose flow events bind the
+    # crashed request's spans across router/r0(crashed)/survivor tracks
+    st = fleet.stats_snapshot(ttft_deadline_s=slo_ttft)
     useful = sum(max_news)
     ev = [e["event"] for e in fleet.flight.events()]
+    stitcher = fleet.stitcher()
+    stitched = stitcher.summary()
+    assert len(stitched["max_chain"]) >= 3, \
+        f"crashed request did not stitch across components: {stitched}"
+    if perfetto:
+        stitcher.export_chrome(perfetto)
+        stitched["perfetto_path"] = perfetto
+    dump = fleet.flight.last_dump()
     return {
         "trace": {"n_requests": n_req, "num_replicas": 2,
                   "snapshot_every": 4, "crash_at_consult": crash_at,
@@ -1139,6 +1163,16 @@ def bench_serving_failover(seed=0):
         "recovery_ms_p50": st["recovery"]["p50_ms"],
         "recovered_from_snapshot": "restore" in ev,
         "fleet": st,
+        "stitched": stitched,
+        # the merged failover dump (dying replica's flight ring + the
+        # router's last-N routing decisions in ONE artifact)
+        "failover_dump": {
+            "reason": dump["reason"] if dump else None,
+            "routing_decisions": len((dump or {}).get("extra", {})
+                                     .get("routing_decisions") or []),
+            "replica_ring_events": len((dump or {}).get("extra", {})
+                                       .get("replica_ring") or []),
+        },
         "slo_report": fleet.slo_report(slo_ttft, window_s=dt),
         "metrics": fleet.metrics_snapshot(),
     }
@@ -1173,7 +1207,7 @@ def bench_serving_frontend(seed=0):
     import jax.numpy as jnp
     from paddle_tpu.models.llama import LlamaConfig, build_functional_llama
     from paddle_tpu.inference.paged import ServingEngine
-    from paddle_tpu.observability import Telemetry
+    from paddle_tpu.observability import FleetTelemetry, Telemetry
     from paddle_tpu.serving import (AdmissionController, AsyncFrontend,
                                     make_scenario, replay_engine)
 
@@ -1300,6 +1334,7 @@ def bench_serving_frontend(seed=0):
                            abandon_frac=0.1, abandon_range=(2, 6),
                            **arr_kw, **scen_kw)
         pred_runs, depth_runs, ratios = [], [], []
+        fleet_snaps = []
         for _ in range(rounds):
             eng.release_cache()
             eng.telemetry.reset_window()
@@ -1310,11 +1345,18 @@ def bench_serving_frontend(seed=0):
                 load_tps=load_tps, slo_ttft_s=slo_ttft))
             eng.release_cache()
             eng.telemetry.reset_window()
+            ctrl = AdmissionController(
+                policy="predictive", slo_ttft_s=slo_ttft, **ctrl_kw)
             pred_runs.append(replay_engine(
-                eng, sc,
-                AdmissionController(policy="predictive",
-                                    slo_ttft_s=slo_ttft, **ctrl_kw),
+                eng, sc, ctrl,
                 load_tps=load_tps, slo_ttft_s=slo_ttft))
+            # fleet-aggregation snapshot captured IN-ROUND, so the merged
+            # engine histograms and the frontend admission counters in
+            # one snapshot describe the SAME round's window (the engine
+            # telemetry resets at the next round's start)
+            fleet_snaps.append(FleetTelemetry(
+                {"engine": eng.telemetry}, frontend=ctrl.metrics)
+                .snapshot())
             gp = pred_runs[-1]["report"]["goodput_under_slo"]
             gd = depth_runs[-1]["report"]["goodput_under_slo"]
             # depth goodput 0: predictive serving ANYTHING on time wins
@@ -1323,6 +1365,7 @@ def bench_serving_frontend(seed=0):
             ratios.append(gp / gd if gd else (2.0 if gp > 0 else 0.0))
         best = max(range(rounds), key=lambda r: ratios[r])
         pr, dr = pred_runs[best], depth_runs[best]
+        fleet_block = fleet_snaps[best]
         ttfts = [r["ttft_s"] for r in pr["records"]
                  if r["ttft_s"] is not None]
         scenarios[name] = {
@@ -1350,6 +1393,11 @@ def bench_serving_frontend(seed=0):
     return {
         "outputs_bit_exact": True,        # asserted above
         "leaked_pages": 0,                # asserted above
+        # fleet-wide aggregation (ISSUE 12; schema-gated): engine
+        # telemetry + predictive-controller registries merged, captured
+        # in-round from the LAST scenario's best paired round — both
+        # sides of the snapshot describe one measurement window
+        "fleet": fleet_block,
         "host_cpu_count": os.cpu_count(),
         "async_harness": {
             "n_requests": n_async,
@@ -1390,13 +1438,15 @@ def main():
                  ("serving", bench_serving, 250),
                  ("serving_shared_prefix", bench_serving_shared_prefix, 250),
                  ("serving_spec_decode", bench_serving_spec_decode, 250),
-                 ("serving_frontend", bench_serving_frontend, 250)) \
+                 ("serving_frontend", bench_serving_frontend, 250),
+                 ("serving_failover", bench_serving_failover, 250)) \
         if on_tpu else (("serving", bench_serving, 250),
                         ("serving_shared_prefix",
                          bench_serving_shared_prefix, 250),
                         ("serving_spec_decode",
                          bench_serving_spec_decode, 250),
-                        ("serving_frontend", bench_serving_frontend, 250))
+                        ("serving_frontend", bench_serving_frontend, 250),
+                        ("serving_failover", bench_serving_failover, 250))
     import signal
 
     def _alarm(_sig, _frm):
@@ -1476,11 +1526,18 @@ if __name__ == "__main__":
                     help="seed for trace generation (default: each trace's "
                          "own fixed seed, so unseeded runs reproduce the "
                          "published numbers)")
+    ap.add_argument("--perfetto", metavar="PATH", default=None,
+                    help="failover trace only: also write the stitched "
+                         "cross-component Perfetto trace (frontend/router/"
+                         "replica tracks + per-request flow events) to "
+                         "PATH — load it at https://ui.perfetto.dev")
     args = ap.parse_args()
     if args.trace is None and (args.json or args.seed is not None):
         ap.error("--json/--seed only apply to a serving trace; "
                  "pass --trace "
                  "{shared-prefix,serving,spec-decode,failover,frontend}")
+    if args.perfetto is not None and args.trace != "failover":
+        ap.error("--perfetto applies to --trace failover only")
     if args.trace is not None:
         _setup_compile_cache()
         fn = {"shared-prefix": bench_serving_shared_prefix,
@@ -1488,7 +1545,12 @@ if __name__ == "__main__":
               "spec-decode": bench_serving_spec_decode,
               "failover": bench_serving_failover,
               "frontend": bench_serving_frontend}[args.trace]
-        res = fn() if args.seed is None else fn(seed=args.seed)
+        kw = {}
+        if args.seed is not None:
+            kw["seed"] = args.seed
+        if args.perfetto is not None:
+            kw["perfetto"] = args.perfetto
+        res = fn(**kw)
         out = {"metric": f"trace_{args.trace.replace('-', '_')}", **res}
         print(json.dumps(out))
         if args.json:

@@ -39,6 +39,8 @@ budgets and variant counts are untouched.
 from __future__ import annotations
 
 import contextlib
+import json
+import time
 
 from .dataflow import SYNC_COLLECTIVES
 
@@ -92,12 +94,29 @@ def _sig_of(x):
 
 
 class SpmdSanitizer:
-    """Recorded trace-order collective schedule + the per-rank verifier."""
+    """Recorded trace-order collective schedule + the per-rank verifier.
 
-    def __init__(self, n_ranks=1, flight=None):
+    With ``profile=True`` (the ISSUE 12 collective timeline profiler)
+    every recorded event additionally stamps per-rank wall/trace time —
+    ``timings[i]`` is ``(t0, dur_s)`` for ``events[i]``, measured around
+    the patched call at trace time.  :meth:`skew_report` turns the
+    per-rank timelines into ``dist.collective_s`` histograms per kind, a
+    max-rank-skew gauge, and a straggler flag; :meth:`timeline_chrome`
+    exports one Perfetto timeline with a track per rank.  This is the
+    measurement rail the ROADMAP item-1 TP-decode work gates its
+    "one collective per layer" claim on: the SPMD sanitizer records
+    ORDER, the profiler records DURATION — a straggler rank or a
+    collective tax is invisible without the latter."""
+
+    def __init__(self, n_ranks=1, flight=None, profile=False):
         self.n_ranks = int(n_ranks)
         self.flight = flight
+        self.profile = bool(profile)
         self.events: list[tuple] = []     # (kind, axis, shape, dtype)
+        self.timings: list[tuple] = []    # (t0, dur_s) per event (profile)
+        self._rank_drops: dict[int, set] = {}   # rank -> dropped indexes
+                                          # (fault consults are one-shot —
+                                          # cache so schedule + skew agree)
 
     def _record(self, kind, args, kwargs):
         op = args[0] if args else None
@@ -106,22 +125,137 @@ class SpmdSanitizer:
                             dtype))
 
     # -- per-rank schedules -------------------------------------------------
+    def _dropped(self, rank: int) -> set:
+        """Indexes a seeded `spmd.collective` fault drops for this rank
+        (emulating the rank skipping the collective — the multi-controller
+        divergence drill).  Computed ONCE per rank: fault `at=k` rules
+        count consults, so re-consulting would change the answer."""
+        drops = self._rank_drops.get(rank)
+        if drops is None:
+            from paddle_tpu.resilience.faults import fault_point
+            drops = set()
+            for i, ev in enumerate(self.events):
+                spec = fault_point("spmd.collective", rank=int(rank),
+                                   index=i, kind=ev[0])
+                if spec is not None:
+                    drops.add(i)
+            self._rank_drops[rank] = drops
+        return drops
+
     def schedule_for_rank(self, rank: int) -> list:
         """This rank's schedule: the recorded trace, minus any events a
-        seeded `spmd.collective` fault drops (emulating the rank skipping
-        the collective — the multi-controller divergence drill)."""
-        from paddle_tpu.resilience.faults import fault_point
-        out = []
-        for i, ev in enumerate(self.events):
-            spec = fault_point("spmd.collective", rank=int(rank), index=i,
-                               kind=ev[0])
-            if spec is not None:
-                continue                  # this rank skipped the collective
-            out.append(ev)
-        return out
+        seeded `spmd.collective` fault drops."""
+        drops = self._dropped(rank)
+        return [ev for i, ev in enumerate(self.events) if i not in drops]
 
     def schedules(self) -> dict:
         return {r: self.schedule_for_rank(r) for r in range(self.n_ranks)}
+
+    # -- collective timeline profiler (ISSUE 12) ----------------------------
+    def rank_timeline(self, rank: int) -> list[dict]:
+        """This rank's timed collective events (profile mode): one row per
+        retained event — {kind, axis, shape, dtype, index, t0, dur_s}."""
+        drops = self._dropped(rank)
+        out = []
+        for i, ev in enumerate(self.events):
+            if i in drops or i >= len(self.timings):
+                continue
+            t0, dur = self.timings[i]
+            out.append({"kind": ev[0], "axis": ev[1],
+                        "shape": list(ev[2]), "dtype": ev[3],
+                        "index": i, "t0": t0, "dur_s": dur})
+        return out
+
+    def skew_report(self, registry=None, straggler_factor: float = 1.5) -> dict:
+        """Per-kind collective timing + cross-rank skew (profile mode).
+
+        ``per_kind`` aggregates each recorded event's wall/trace duration
+        once (ranks share the recorded trace; divergence enters through
+        fault-dropped events).  ``per_rank_total_s`` sums each rank's
+        RETAINED events; ``max_rank_skew_s`` is max-min across ranks and
+        any rank whose total deviates from the median by more than
+        ``straggler_factor - 1`` (relative) is flagged a straggler.  With
+        a ``MetricsRegistry``, the report also lands as
+        ``dist.collective_s.<kind>`` histograms, a
+        ``dist.max_rank_skew_s`` gauge, and a ``dist.collectives``
+        counter — the fleet aggregation rail picks them up like any other
+        metric."""
+        from paddle_tpu.observability.metrics import Histogram
+        n = min(len(self.events), len(self.timings))
+        per_kind: dict[str, Histogram] = {}
+        total = 0.0
+        for i in range(n):
+            kind = self.events[i][0]
+            dur = self.timings[i][1]
+            h = per_kind.get(kind)
+            if h is None:
+                h = Histogram(f"dist.collective_s.{kind}")
+                per_kind[kind] = h
+            h.observe(dur)
+            total += dur
+        per_rank = []
+        for r in range(self.n_ranks):
+            drops = self._dropped(r)
+            per_rank.append(sum(self.timings[i][1] for i in range(n)
+                                if i not in drops))
+        skew = (max(per_rank) - min(per_rank)) if per_rank else 0.0
+        med = sorted(per_rank)[len(per_rank) // 2] if per_rank else 0.0
+        stragglers = []
+        if med > 0.0:
+            stragglers = [r for r, t in enumerate(per_rank)
+                          if abs(t - med) > (straggler_factor - 1.0) * med]
+        rep = {
+            "n_ranks": self.n_ranks,
+            "events": n,
+            "total_s": round(total, 6),
+            "per_kind": {k: {"count": h.count,
+                             "total_s": round(h.total, 6),
+                             "mean_s": round(h.mean, 9),
+                             "p50_s": round(h.quantile(0.5), 9),
+                             "p95_s": round(h.quantile(0.95), 9),
+                             "max_s": round(h.max, 9) if h.count else 0.0}
+                        for k, h in sorted(per_kind.items())},
+            "per_rank_total_s": [round(t, 6) for t in per_rank],
+            "max_rank_skew_s": round(skew, 9),
+            "skew_frac": round(skew / med, 4) if med else 0.0,
+            "straggler_ranks": stragglers,
+            "straggler": bool(stragglers),
+        }
+        if registry is not None:
+            for k, h in per_kind.items():
+                registry.histogram(f"dist.collective_s.{k}").merge_from(h)
+            registry.gauge("dist.max_rank_skew_s").set(skew)
+            registry.counter("dist.collectives").inc(n)
+        return rep
+
+    def timeline_chrome(self, path: str | None = None) -> dict:
+        """Per-rank Perfetto timeline (profile mode): one track per rank,
+        one slice per retained collective, named by kind with the
+        (axis, shape, dtype) signature in args.  Loads directly in
+        https://ui.perfetto.dev; optionally written to ``path``."""
+        us = 1e6
+        events: list[dict] = [
+            {"name": "process_name", "ph": "M", "pid": 0,
+             "args": {"name": "spmd collective timeline"}},
+        ]
+        for r in range(self.n_ranks):
+            events.append({"name": "thread_name", "ph": "M", "pid": 0,
+                           "tid": r, "args": {"name": f"rank {r}"}})
+            for row in self.rank_timeline(r):
+                events.append({
+                    "name": row["kind"], "cat": "collective", "ph": "X",
+                    "pid": 0, "tid": r,
+                    "ts": round(row["t0"] * us, 3),
+                    "dur": round(max(0.0, row["dur_s"]) * us, 3),
+                    "args": {"axis": row["axis"], "shape": row["shape"],
+                             "dtype": row["dtype"],
+                             "index": row["index"]},
+                })
+        out = {"traceEvents": events, "displayTimeUnit": "ms"}
+        if path is not None:
+            with open(path, "w") as f:
+                json.dump(out, f)
+        return out
 
     # -- verification -------------------------------------------------------
     def verify(self):
@@ -175,22 +309,36 @@ def _wrap(kind, orig):
     def wrapper(*args, **kwargs):
         for s in _ACTIVE:
             s._record(kind, args, kwargs)
-        return orig(*args, **kwargs)
+        profs = [s for s in _ACTIVE if s.profile]
+        if not profs:
+            return orig(*args, **kwargs)
+        # collective timeline profiler: stamp wall/trace time around the
+        # patched call so every (kind, axis, shape, dtype) event carries a
+        # duration — the per-rank timeline + skew report read these
+        t0 = time.perf_counter()
+        out = orig(*args, **kwargs)
+        dur = time.perf_counter() - t0
+        for s in profs:
+            s.timings.append((t0, dur))
+        return out
     wrapper.__name__ = f"spmd_sanitized_{kind}"
     wrapper.__wrapped__ = orig
     return wrapper
 
 
 @contextlib.contextmanager
-def spmd_sanitize(n_ranks=1, flight=None):
+def spmd_sanitize(n_ranks=1, flight=None, profile=False):
     """Record the collective schedule issued (at trace time) inside the
     context.  Yields the :class:`SpmdSanitizer`; call ``.verify()`` after
-    the block (or inspect ``.events``).  Nestable; patches ``jax.lax``
-    once for the outermost context."""
+    the block (or inspect ``.events``).  ``profile=True`` additionally
+    stamps per-event wall/trace durations (``timings``) for the
+    collective timeline profiler (:meth:`SpmdSanitizer.skew_report` /
+    :meth:`SpmdSanitizer.timeline_chrome`).  Nestable; patches
+    ``jax.lax`` once for the outermost context."""
     global _DEPTH
     import jax
 
-    san = SpmdSanitizer(n_ranks=n_ranks, flight=flight)
+    san = SpmdSanitizer(n_ranks=n_ranks, flight=flight, profile=profile)
     if _DEPTH == 0:
         for kind in COLLECTIVES:
             orig = getattr(jax.lax, kind, None)

@@ -519,6 +519,12 @@ class Request:
     draft_accepted: int = 0            #   ... greedy-verified AND emitted
                                        #   (an EOS/budget freeze mid-run
                                        #   discards the tail uncounted)
+    trace_id: int | None = None        # fleet-wide stitching id: one per
+                                       #   END-TO-END request, shared by the
+                                       #   frontend/router/replica trace
+                                       #   records across migrations and
+                                       #   snapshot restores (observability
+                                       #   .distributed.TraceStitcher)
     # async-streaming front end (not serialized; a restored Request
     # streams through a fresh subscription)
     on_token: object | None = field(default=None, repr=False, compare=False)
@@ -904,7 +910,8 @@ class ServingEngine:
     # -- submission --------------------------------------------------------
     def submit(self, prompt, max_new_tokens: int = 32, temperature: float = 0.0,
                top_p: float = 1.0, eos_token_id: int | None = None,
-               timeout: float | None = None, on_token=None) -> int:
+               timeout: float | None = None, on_token=None,
+               trace_id: int | None = None) -> int:
         """Queue one request.  Raises `PoolCapacityError` for requests that
         can NEVER fit the pool geometry, `AdmissionRejected` when the bounded
         queue is full (backpressure), plain ValueError for malformed input.
@@ -913,17 +920,20 @@ class ServingEngine:
         streaming hook: called as ``on_token(tok)`` for every emitted token
         in emission order, at the step's host-sync boundary (or the overlap
         drain — bounded lag, same order); `Request.stream()` is the
-        pull-style equivalent."""
+        pull-style equivalent.  `trace_id` (optional) is the fleet-wide
+        stitching id the frontend/router minted for this end-to-end
+        request (observability.distributed)."""
         now = self._clock()
         return self._enqueue(
             prompt, [], max_new_tokens, temperature, top_p, eos_token_id,
             None if timeout is None else now + float(timeout), now,
-            on_token=on_token)
+            on_token=on_token, trace_id=trace_id)
 
     def adopt(self, prompt, generated=(), max_new_tokens: int = 32,
               temperature: float = 0.0, top_p: float = 1.0,
               eos_token_id: int | None = None,
-              deadline: float | None = None) -> int:
+              deadline: float | None = None,
+              trace_id: int | None = None) -> int:
         """Adopt a request MID-FLIGHT: queue `prompt` with `generated`
         tokens already emitted elsewhere (a crashed replica, a snapshot),
         to be continued from exactly that point.  Admission takes the
@@ -944,10 +954,12 @@ class ServingEngine:
                 "adopt: generated already contains eos_token_id — the "
                 "request is complete, nothing to continue")
         return self._enqueue(prompt, generated, max_new_tokens, temperature,
-                             top_p, eos_token_id, deadline, self._clock())
+                             top_p, eos_token_id, deadline, self._clock(),
+                             trace_id=trace_id)
 
     def _enqueue(self, prompt, generated, max_new_tokens, temperature,
-                 top_p, eos_token_id, deadline, now, on_token=None) -> int:
+                 top_p, eos_token_id, deadline, now, on_token=None,
+                 trace_id=None) -> int:
         """Shared admission-queue entry for submit (fresh request, relative
         timeout already resolved to an absolute deadline) and adopt
         (mid-flight resume): validation, capacity check, backpressure, and
@@ -991,7 +1003,8 @@ class ServingEngine:
                       temperature=float(temperature), top_p=float(top_p),
                       eos_token_id=eos_token_id, submit_time=now,
                       deadline=deadline, generated=list(generated),
-                      on_token=on_token, _engine=weakref.ref(self))
+                      on_token=on_token, _engine=weakref.ref(self),
+                      trace_id=None if trace_id is None else int(trace_id))
         self._queue.append(req)
         if self.telemetry is not None:
             self.telemetry.submitted(req, queue_depth=len(self._queue))
@@ -2350,6 +2363,7 @@ class ServingEngine:
             "cached_prefix_tokens": int(r.cached_prefix_tokens),
             "draft_proposed": int(r.draft_proposed),
             "draft_accepted": int(r.draft_accepted),
+            "trace_id": None if r.trace_id is None else int(r.trace_id),
         }
 
     @staticmethod
@@ -2367,7 +2381,10 @@ class ServingEngine:
             preemptions=int(d["preemptions"]),
             cached_prefix_tokens=int(d["cached_prefix_tokens"]),
             draft_proposed=int(d["draft_proposed"]),
-            draft_accepted=int(d["draft_accepted"]))
+            draft_accepted=int(d["draft_accepted"]),
+            # .get: pre-ISSUE-12 snapshots carry no trace_id (version
+            # unchanged — absent simply means "not stitched")
+            trace_id=d.get("trace_id"))
 
     _COUNTER_ATTRS = ("steps_run", "tokens_generated", "preemptions",
                       "timeouts", "rejections", "cache_hits",
@@ -2519,9 +2536,26 @@ class ServingEngine:
                 and bool(g["prefix_cache"]) == (self.cache is not None))
         if fast:
             self._restore_full(meta, state, reqs)
-            return "full_kv"
-        self._restore_reprefill(meta, reqs)
-        return "reprefill"
+            applied = "full_kv"
+        else:
+            self._restore_reprefill(meta, reqs)
+            applied = "reprefill"
+        if self.telemetry is not None:
+            # stitched-trace continuity: a restored in-flight request gets
+            # a trace record (carrying its trace_id) on THIS engine's
+            # tracer, so a failover revival appears as its own track in
+            # the stitched Perfetto view.  Counters stay untouched — the
+            # request was submitted elsewhere; this engine carries it on.
+            now = self._clock()
+            live = [sl.req for sl in self._slots if sl is not None]
+            live.extend(self._queue)
+            for r in live:
+                attrs = {"restored": True}
+                if r.trace_id is not None:
+                    attrs["trace_id"] = r.trace_id
+                self.telemetry.request_event(r.rid, "submitted", t=now,
+                                             **attrs)
+        return applied
 
     def _restore_full(self, meta, state, reqs):
         jnp = self._jnp

@@ -26,6 +26,9 @@ shaped for the training loop (``TrainStep`` / ``Model.fit`` /
 nonfinite + torn-snapshot flight events with FaultPlan context).
 Telemetry off (the default) is a no-op fast path — one flag check per
 hook site, zero per-token work."""
+from .distributed import FleetTelemetry, TraceStitcher, new_trace_id
+from .export import (MetricsExporter, export_snapshot, render_json,
+                     render_prometheus)
 from .flight import FlightRecorder
 from .metrics import (Counter, EngineStats, Gauge, GaugeSeries, Histogram,
                       MetricsRegistry)
@@ -37,4 +40,8 @@ from .train import TrainTelemetry, fault_context
 __all__ = ["Counter", "Gauge", "GaugeSeries", "Histogram", "MetricsRegistry",
            "EngineStats", "Tracer", "RequestTrace", "FlightRecorder",
            "Telemetry", "TrainTelemetry", "fault_context",
-           "latency_percentiles", "slo_report"]
+           "latency_percentiles", "slo_report",
+           # fleet-wide observability plane (ISSUE 12)
+           "FleetTelemetry", "TraceStitcher", "new_trace_id",
+           "MetricsExporter", "export_snapshot", "render_prometheus",
+           "render_json"]

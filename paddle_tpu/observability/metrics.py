@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import math
 import numbers
+import threading
 import time
 from collections import deque
 from collections.abc import Mapping
@@ -245,6 +246,44 @@ class Histogram:
     def mean(self) -> float:
         return self.total / self.count if self.count else 0.0
 
+    def same_layout(self, other: "Histogram") -> bool:
+        return (self.lo, self.growth) == (other.lo, other.growth)
+
+    def merge_from(self, other: "Histogram") -> "Histogram":
+        """Fold another histogram's observations into this one.  Exact
+        when both share the same (lo, growth) bucket layout — every
+        observation lands in the identical bucket index either way, so a
+        fleet-wide merge of N replica histograms is bucket-wise addition,
+        not an approximation (the FleetTelemetry aggregation rail)."""
+        if not self.same_layout(other):
+            raise ValueError(
+                f"histogram {self.name!r} (lo={self.lo}, "
+                f"growth={self.growth}) cannot merge bucket-wise with "
+                f"{other.name!r} (lo={other.lo}, growth={other.growth}) — "
+                f"layouts differ")
+        self.count += other.count
+        self.total += other.total
+        if other.count:
+            self.min = min(self.min, other.min)
+            self.max = max(self.max, other.max)
+        for idx, n in list(other._buckets.items()):
+            self._buckets[idx] = self._buckets.get(idx, 0) + n
+        return self
+
+    def cumulative_buckets(self) -> list[tuple[float, int]]:
+        """Sparse cumulative bucket readout: ascending (upper_bound,
+        cumulative_count) pairs over the non-empty buckets — the
+        Prometheus ``_bucket{le=...}`` series (the exporter appends the
+        ``+Inf`` row from ``count``, read AFTER the buckets so a
+        concurrent observe can never make the series non-cumulative)."""
+        items = sorted(list(self._buckets.items()))
+        out = []
+        cum = 0
+        for idx, n in items:
+            cum += n
+            out.append((self._bounds(idx)[1], cum))
+        return out
+
     def to_value(self) -> dict:
         p = self.percentiles()
         return {
@@ -269,10 +308,35 @@ class MetricsRegistry:
     def __init__(self, clock=time.perf_counter):
         self.clock = clock
         self._metrics: dict[str, object] = {}
+        self._frozen = False
+
+    def freeze(self):
+        """Registry-freeze invariant: after warmup every hot-path metric
+        must already exist, so any metric-created-at-first-use from a
+        NON-main thread raises from here on.  Metric-at-first-use is a
+        registry mutation; once writer threads (the frontend worker, an
+        exporter scrape, an async checkpoint writer) are live, a lazy
+        first-use from one of them races every concurrent reader — the
+        generalization of the PR 7 ckpt pre-registration fix.  Reads and
+        observes of EXISTING metrics stay lock-free and legal from any
+        thread; main-thread creation (tests, late wiring) stays allowed."""
+        self._frozen = True
+
+    @property
+    def frozen(self) -> bool:
+        return self._frozen
 
     def _get(self, name, cls, **kw):
         m = self._metrics.get(name)
         if m is None:
+            if self._frozen and \
+                    threading.current_thread() is not threading.main_thread():
+                raise RuntimeError(
+                    f"MetricsRegistry is frozen: metric {name!r} would be "
+                    f"created at first use from non-main thread "
+                    f"{threading.current_thread().name!r} — pre-register it "
+                    f"before the writer threads start (registry-freeze "
+                    f"invariant)")
             m = cls(name, **kw)
             self._metrics[name] = m
         elif not isinstance(m, cls):

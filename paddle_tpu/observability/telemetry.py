@@ -52,7 +52,17 @@ from .metrics import MetricsRegistry
 from .slo import slo_report
 from .tracing import NULL_CONTEXT, Tracer
 
-__all__ = ["Telemetry"]
+__all__ = ["Telemetry", "ENGINE_PHASES"]
+
+# every phase name the engine can emit, pre-registered at construction so
+# the registry-freeze invariant holds: once a frontend worker / exporter
+# thread is live, `engine.phase.<name>_s` must never be created at first
+# use from that thread (MetricsRegistry.freeze raises there)
+ENGINE_PHASES = ("sched", "prefill_dense", "prefill_chunk",
+                 "decode_dispatch", "decode_sync", "decode_record",
+                 "verify_dispatch", "verify_sync", "verify_record",
+                 "overlap_dispatch", "overlap_sync", "overlap_record",
+                 "overlap_join_sync")
 
 
 class Telemetry:
@@ -97,6 +107,12 @@ class Telemetry:
         self._h_prefill_tok = r.histogram(
             "engine.prefill_tokens_per_dispatch", unit="tokens", lo=1.0)
         self._phase_h = {}
+        # pre-register every engine phase histogram (registry-freeze
+        # invariant: phase() must never CREATE a metric from a worker
+        # thread after freeze() — it only fetches these).  _phase_h stays
+        # lazy so utilization_report keeps listing only phases that ran.
+        for name in ENGINE_PHASES:
+            r.histogram(f"engine.phase.{name}_s")
         self._c_submitted = r.counter("serve.requests_submitted")
         self._c_retired = r.counter("serve.requests_retired")
         self._c_timed_out = r.counter("serve.requests_timed_out")
@@ -329,9 +345,15 @@ class Telemetry:
     # -- engine lifecycle hooks --------------------------------------------
     def submitted(self, req, queue_depth: int):
         self._c_submitted.inc()
+        attrs = dict(prompt_tokens=len(req.prompt),
+                     max_new_tokens=req.max_new_tokens)
+        if getattr(req, "trace_id", None) is not None:
+            # cross-component trace stitching: the trace_id rides the
+            # request record so TraceStitcher can bind this engine's span
+            # to the frontend/router spans of the same request
+            attrs["trace_id"] = req.trace_id
         self.tracer.request_event(req.rid, "submitted", t=req.submit_time,
-                                  prompt_tokens=len(req.prompt),
-                                  max_new_tokens=req.max_new_tokens)
+                                  **attrs)
         self.tracer.request_event(req.rid, "queued", t=req.submit_time,
                                   depth=queue_depth)
         self.flight.record("submit", rid=req.rid,
@@ -473,6 +495,14 @@ class Telemetry:
 
     def fault_dump(self, reason: str, **extra) -> dict:
         return self._dump(reason, **extra)
+
+    def freeze(self):
+        """Freeze the registry (registry-freeze invariant): every metric
+        the engine's hot path can touch is pre-registered above, so a
+        frozen registry only rejects NEW names created from non-main
+        threads — the frontend/fleet/exporter wiring calls this once the
+        worker threads are about to start."""
+        self.registry.freeze()
 
     def reset_window(self):
         """Start a fresh measurement window: clear the per-request SLO

@@ -44,9 +44,12 @@ import numpy as np
 
 from ..inference.paged import (AdmissionRejected, EngineStalledError,
                                Request, ServingEngine)
+from ..observability.distributed import (FleetTelemetry, TraceStitcher,
+                                         new_trace_id)
 from ..observability.flight import FlightRecorder
 from ..observability.metrics import MetricsRegistry
 from ..observability.slo import slo_report
+from ..observability.tracing import Tracer
 from ..observability.train import fault_context
 from .snapshot import EngineSnapshotManager
 
@@ -82,6 +85,10 @@ class _FleetRequest:
     retries: int = 0
     next_try_round: int = 0
     migrations: int = 0
+    trace_id: int | None = None    # fleet-wide stitching id; threaded into
+                                   #   every engine-side adopt() so one
+                                   #   Perfetto view binds the request's
+                                   #   spans across replicas + failovers
 
 
 class _Replica:
@@ -137,7 +144,8 @@ class ReplicaFleet:
                  max_backoff_rounds: int = 32,
                  max_failovers_per_replica: int = 4,
                  clock=time.perf_counter,
-                 flight_capacity: int = 256):
+                 flight_capacity: int = 256,
+                 route_dump_last: int = 16):
         if num_replicas < 1:
             raise ValueError("num_replicas must be >= 1")
         self._factory = engine_factory
@@ -160,6 +168,15 @@ class ReplicaFleet:
         self._c_torn = self.metrics.counter("fleet.torn_snapshots")
         self._h_recovery = self.metrics.histogram("fleet.recovery_s")
         self.flight = FlightRecorder(capacity=flight_capacity, clock=clock)
+        # the ROUTER track of the stitched fleet trace: one request record
+        # per frid (submitted -> admitted(replica) -> first_token ->
+        # retired, with migrations re-opening the queued phase), sharing
+        # the fleet clock with every replica tracer
+        self.tracer = Tracer(clock=clock)
+        self.route_dump_last = int(route_dump_last)
+        # tracers of crashed replica generations, kept so the stitched
+        # trace still shows the spans a request ran on a now-dead engine
+        self._dead_tracers: list[tuple[str, Tracer]] = []
         self._requests: dict[int, _FleetRequest] = {}
         self._assigned: dict[str, set[int]] = {}
         self._waiting: list[_FleetRequest] = []
@@ -194,7 +211,8 @@ class ReplicaFleet:
     def submit(self, prompt, max_new_tokens: int = 32,
                temperature: float = 0.0, top_p: float = 1.0,
                eos_token_id: int | None = None,
-               timeout: float | None = None, on_token=None) -> int:
+               timeout: float | None = None, on_token=None,
+               trace_id: int | None = None) -> int:
         """Queue one request with the fleet; returns the fleet request id.
         Routing tries every live replica least-loaded-first; when all
         reject (their admission queues are full), the request waits in the
@@ -209,7 +227,11 @@ class ReplicaFleet:
         streamed (greedy-identical by the bit-exactness guarantee), and
         an engine-side hook would re-fire them — the router log only ever
         extends, so the fleet hook emits each position exactly once
-        across any number of crashes and migrations."""
+        across any number of crashes and migrations.
+
+        ``trace_id`` (optional) is the end-to-end stitching id from an
+        upstream front end; the fleet mints one when none is supplied, so
+        every request is stitchable."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         now = self._clock()
         fr = _FleetRequest(
@@ -218,20 +240,36 @@ class ReplicaFleet:
                     temperature=float(temperature), top_p=float(top_p),
                     eos_token_id=eos_token_id),
             deadline=None if timeout is None else now + float(timeout),
-            submit_t=now, on_token=on_token)
+            submit_t=now, on_token=on_token,
+            trace_id=new_trace_id() if trace_id is None else int(trace_id))
         self._next_frid += 1
         self.flight.record("submit", frid=fr.frid,
-                           prompt_tokens=len(prompt))
+                           prompt_tokens=len(prompt), trace_id=fr.trace_id)
+        self.tracer.request_event(fr.frid, "submitted", t=now,
+                                  prompt_tokens=len(prompt),
+                                  trace_id=fr.trace_id)
+        self.tracer.request_event(fr.frid, "queued", t=now,
+                                  depth=len(self._waiting))
         # place BEFORE registering: a placement-time PoolCapacityError /
         # ValueError (a request that can never fit) must propagate without
         # leaving an unresolvable ghost in self._requests (which would
-        # wedge every later run())
-        if not self._place(fr):
+        # wedge every later run()) — and without leaving a never-terminated
+        # ghost in the router TRACER either (its live table is unbounded
+        # and ghosts would pollute every stitched trace)
+        try:
+            placed = self._place(fr)
+        except BaseException:
+            self.tracer.request_event(fr.frid, "retired", rejected=True,
+                                      error=True, tokens=0)
+            raise
+        if not placed:
             if self.max_queue is not None \
                     and len(self._waiting) >= self.max_queue:
                 self._c_rejections.inc()
                 self.flight.record("reject", frid=fr.frid,
                                    waiting=len(self._waiting))
+                self.tracer.request_event(fr.frid, "retired",
+                                          rejected=True, tokens=0)
                 raise AdmissionRejected(
                     f"fleet queue full ({len(self._waiting)}/"
                     f"{self.max_queue} waiting) — backpressure, retry later")
@@ -262,6 +300,8 @@ class ReplicaFleet:
                     break
         self.flight.record("cancel", frid=frid,
                            streamed=len(fr.streamed))
+        self.tracer.request_event(frid, "retired", cancelled=True,
+                                  tokens=len(fr.streamed))
         return True
 
     def _alive(self):
@@ -288,14 +328,19 @@ class ReplicaFleet:
         for rep in order:
             try:
                 rid = rep.engine.adopt(fr.prompt, fr.streamed,
-                                       deadline=fr.deadline, **fr.kw)
+                                       deadline=fr.deadline,
+                                       trace_id=fr.trace_id, **fr.kw)
             except AdmissionRejected:
                 continue
             fr.replica = rep.name
             fr.handle = rep.engine.lookup(rid)
             self._assigned[rep.name].add(fr.frid)
             self.flight.record("route", frid=fr.frid, replica=rep.name,
-                               resumed_tokens=len(fr.streamed))
+                               resumed_tokens=len(fr.streamed),
+                               trace_id=fr.trace_id)
+            self.tracer.request_event(fr.frid, "admitted",
+                                      replica=rep.name,
+                                      resumed_tokens=len(fr.streamed))
             return True
         return False
 
@@ -378,6 +423,8 @@ class ReplicaFleet:
             if len(gen) > len(fr.streamed):
                 if fr.first_token_t == 0.0:
                     fr.first_token_t = now
+                    self.tracer.request_event(fr.frid, "first_token",
+                                              t=now, replica=rep.name)
                 for t in gen[len(fr.streamed):]:
                     t = int(t)
                     fr.streamed.append(t)
@@ -407,6 +454,9 @@ class ReplicaFleet:
         self.flight.record("resolve", frid=fr.frid, tokens=n,
                            timed_out=req.timed_out,
                            migrations=fr.migrations)
+        self.tracer.request_event(fr.frid, "retired", t=now, tokens=n,
+                                  timed_out=req.timed_out,
+                                  migrations=fr.migrations)
 
     # -- failover ----------------------------------------------------------
     def _fail(self, rep: _Replica, kind: str, exc: BaseException):
@@ -418,11 +468,32 @@ class ReplicaFleet:
         self._c_failovers.inc()
         rep.failures += 1
         rep.alive = False
+        corpse = rep.engine
         rep.engine = None          # the corpse's state is not trusted
         rep.stall = 0
+        # postmortem capture BEFORE the corpse is dropped: its flight ring
+        # (what the replica was doing when it died) and its tracer (so the
+        # stitched fleet trace keeps the spans this generation ran)
+        corpse_ring = None
+        if corpse is not None and corpse.telemetry is not None:
+            corpse_ring = corpse.telemetry.flight.events()
+            self._dead_tracers.append(
+                (f"{rep.name} (crashed#{rep.failures})",
+                 corpse.telemetry.tracer))
         self.flight.record("failover", replica=rep.name, kind=kind,
                            failures=rep.failures, error=str(exc)[:200],
                            fault_plan=fault_context())
+        self.tracer.engine_event("failover", replica=rep.name, kind=kind)
+        # ONE merged postmortem artifact: the dying replica's ring PLUS
+        # the router's last-N routing decisions — a misroute (the request
+        # was on the wrong replica when it died) is diagnosable from this
+        # dump alone, without correlating two files
+        routing = [e for e in self.flight.events()
+                   if e["event"] in ("route", "migrate")]
+        self.flight.dump(
+            "failover", replica=rep.name, kind=kind,
+            routing_decisions=routing[-self.route_dump_last:],
+            replica_ring=corpse_ring)
         outstanding = [self._requests[f]
                        for f in sorted(self._assigned[rep.name])]
         self._assigned[rep.name] = set()
@@ -509,7 +580,12 @@ class ReplicaFleet:
         fr.migrations += 1
         self.flight.record("migrate", frid=fr.frid,
                            tokens=len(fr.streamed),
+                           trace_id=fr.trace_id,
                            fault_plan=fault_context())
+        # "preempted" re-opens the queued phase on the router track — a
+        # migration reads as: left its replica, waiting for placement
+        self.tracer.request_event(fr.frid, "preempted", kind="migrate",
+                                  tokens=len(fr.streamed))
         kw = fr.kw
         eos = kw["eos_token_id"]
         if fr.streamed and (len(fr.streamed) >= kw["max_new_tokens"]
@@ -578,6 +654,53 @@ class ReplicaFleet:
                                        else None)
                             for rep in self._replicas},
         }
+
+    def stats_snapshot(self, ttft_deadline_s: float | None = None) -> dict:
+        """The fleet-wide observability snapshot (ISSUE 12): the router
+        :meth:`stats` plus the :class:`FleetTelemetry` aggregation over
+        every live telemetry-bearing replica — replica histograms merged
+        BUCKET-WISE into fleet quantiles (``merged``), gauges/series/
+        counters side-by-side per replica (``per_replica_telemetry``).
+        With ``ttft_deadline_s``, a fleet-wide SLO report read straight
+        off the merged TTFT histogram rides along (``fleet_slo``)."""
+        ft = FleetTelemetry.from_fleet(self)
+        snap = ft.snapshot()
+        out = dict(self.stats())
+        out["replica_names"] = snap["replicas"]
+        out["merged"] = snap["merged"]
+        out["per_replica_telemetry"] = snap["per_replica"]
+        if ttft_deadline_s is not None:
+            out["fleet_slo"] = ft.slo_report(ttft_deadline_s)
+        return out
+
+    def trace_components(self) -> list:
+        """(name, Tracer) per stitched-trace component: the router track
+        first, then crashed replica generations, then the live replicas
+        (telemetry-bearing only — a tracer lives inside Telemetry)."""
+        comps: list = [("router", self.tracer)]
+        comps.extend(self._dead_tracers)
+        for rep in self._replicas:
+            if rep.alive and rep.engine is not None \
+                    and rep.engine.telemetry is not None:
+                comps.append((rep.name, rep.engine.telemetry.tracer))
+        return comps
+
+    def stitcher(self, frontend=None) -> TraceStitcher:
+        """A :class:`TraceStitcher` over this fleet's components (plus an
+        optional upstream front end's ``(name, tracer)`` first)."""
+        st = TraceStitcher()
+        if frontend is not None:
+            st.add("frontend", frontend.tracer
+                   if hasattr(frontend, "tracer") else frontend)
+        for name, tracer in self.trace_components():
+            st.add(name, tracer)
+        return st
+
+    def stitched_trace(self, frontend=None) -> dict:
+        """ONE Perfetto view of every request across frontend/router/
+        replica tracks, failovers included (crashed generations keep
+        their own tracks; flow events follow each trace_id)."""
+        return self.stitcher(frontend=frontend).to_chrome_trace()
 
     def slo_report(self, ttft_deadline_s: float,
                    window_s: float | None = None) -> dict:

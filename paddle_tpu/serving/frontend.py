@@ -51,7 +51,9 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from ..inference.paged import AdmissionRejected, ServingEngine
+from ..observability.distributed import new_trace_id
 from ..observability.metrics import MetricsRegistry
+from ..observability.tracing import Tracer
 
 __all__ = ["AsyncFrontend", "AsyncStream", "SLORejected", "AdmissionView",
            "TTFTPredictor", "AdmissionController", "admission_view"]
@@ -505,6 +507,7 @@ class AsyncStream:
     def __init__(self, frontend: "AsyncFrontend", buffer: int):
         self._fe = frontend
         self.rid: int | None = None
+        self.trace_id: int | None = None
         self.predicted_ttft_s: float | None = None
         self._q: asyncio.Queue = asyncio.Queue(maxsize=max(1, buffer))
         self._overflow: deque = deque()
@@ -632,6 +635,12 @@ class AsyncFrontend:
                 max_queue_depth=max_queue_depth)
         self.stream_buffer = int(stream_buffer)
         self._poll = float(poll_interval_s)
+        # the FRONTEND track of the stitched trace: one span per request,
+        # from the admission decision to retirement, stamped with the
+        # trace_id that threads through router placement and replica
+        # admission.  All writes happen on the worker thread.
+        self.tracer = Tracer()
+        self.exporter = None
         self._loop: asyncio.AbstractEventLoop | None = None
         self._thread: threading.Thread | None = None
         self._cv = threading.Condition()
@@ -665,6 +674,9 @@ class AsyncFrontend:
     async def aclose(self):
         """Stop the worker thread (after it finishes the step in
         progress).  Outstanding streams are finished with ``None``."""
+        if self.exporter is not None:
+            self.exporter.stop()
+            self.exporter = None
         if self._thread is None:
             return
         with self._cv:
@@ -703,6 +715,11 @@ class AsyncFrontend:
         stream = AsyncStream(self, stream_buffer or self.stream_buffer)
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         sref = weakref.ref(stream)
+        # the end-to-end stitching id: minted HERE (the outermost
+        # component) and threaded through router placement, replica
+        # admission, migration, and snapshot restore
+        trace_id = new_trace_id()
+        stream.trace_id = trace_id
 
         def on_token(tok, _sref=sref, _self=self):
             # worker thread -> event loop, in emission order.  Weak ref
@@ -722,6 +739,7 @@ class AsyncFrontend:
                 if self._error is not None:   # worker died before us
                     raise RuntimeError("frontend worker died") \
                         from self._error
+                t_decide = self.tracer.clock()
                 view = self._adapter.view(self.controller)
                 pred = self.controller.decide(view, len(prompt),
                                               slo_ttft_s=slo_ttft_s)
@@ -729,9 +747,16 @@ class AsyncFrontend:
                     prompt, max_new_tokens=max_new_tokens,
                     temperature=temperature, top_p=top_p,
                     eos_token_id=eos_token_id, timeout=timeout,
-                    on_token=on_token)
+                    on_token=on_token, trace_id=trace_id)
                 self.controller.track(rid, pred)
                 self._tracked[rid] = sref
+                # stamped at the admission DECISION time (before the
+                # engine-side submit), so the frontend span is the
+                # outermost touch in the stitched flow chain
+                self.tracer.request_event(
+                    rid, "submitted", t=t_decide, trace_id=trace_id,
+                    prompt_tokens=len(prompt),
+                    predicted_ttft_s=round(pred, 6))
             except BaseException as exc:  # noqa: BLE001 — delivered async
                 self._post(self._reject_future, fut, exc)
                 return
@@ -762,6 +787,73 @@ class AsyncFrontend:
         rep = self.controller.report()
         rep["open_streams"] = len(self._streams)
         return rep
+
+    # -- live exporter -----------------------------------------------------
+    def _export_registries(self) -> dict:
+        """{label: MetricsRegistry} for every component behind this front
+        end — recomputed per scrape, so failover-revived replicas (fresh
+        registries) appear automatically."""
+        regs = {"frontend": self.controller.metrics}
+        eng = self.engine
+        if isinstance(eng, ServingEngine):
+            if eng.telemetry is not None:
+                regs["engine"] = eng.telemetry.registry
+        else:                                     # ReplicaFleet
+            regs["router"] = eng.metrics
+            for rep in eng._replicas:
+                if rep.alive and rep.engine is not None \
+                        and rep.engine.telemetry is not None:
+                    regs[rep.name] = rep.engine.telemetry.registry
+        return regs
+
+    def start_exporter(self, host: str = "127.0.0.1", port: int = 0,
+                       freeze: bool = True):
+        """Attach the live pull endpoint: ``/metrics`` (Prometheus text,
+        every component labeled), ``/metrics.json``, ``/healthz``, and
+        ``/requests`` (recent request summaries) on a stdlib
+        ``http.server`` daemon thread.  Off by default; ``port=0`` picks
+        a free port (read ``.port`` back from the returned exporter).
+
+        SECURITY: binds ``127.0.0.1`` by default — metrics and request
+        summaries expose workload shape; put real auth in front before
+        binding a routable interface.
+
+        Rendering happens entirely on the HTTP thread from registry
+        snapshots — the engine worker does zero exporter work.  With
+        ``freeze`` (default), every component registry is frozen first
+        (registry-freeze invariant): all hot-path metrics are
+        pre-registered, so a scrape can never race a metric being
+        created at first use from the worker thread."""
+        from ..observability.export import MetricsExporter, export_snapshot
+        if self.exporter is not None:
+            raise RuntimeError("exporter already attached")
+        if freeze:
+            for reg in self._export_registries().values():
+                reg.freeze()
+
+        def snapshot_fn():
+            return {lab: export_snapshot(reg)
+                    for lab, reg in self._export_registries().items()}
+
+        def requests_fn():
+            eng = self.engine
+            if isinstance(eng, ServingEngine):
+                tel = eng.telemetry
+                return list(tel.request_summaries)[-64:] \
+                    if tel is not None else []
+            return list(eng._summaries)[-64:]
+
+        def health_fn():
+            return {"worker_alive": self._thread is not None
+                    and self._thread.is_alive(),
+                    "open_streams": len(self._streams),
+                    "worker_error": None if self._error is None
+                    else str(self._error)[:200]}
+
+        self.exporter = MetricsExporter(
+            snapshot_fn, requests_fn=requests_fn, health_fn=health_fn,
+            host=host, port=port).start()
+        return self.exporter
 
     # -- worker ------------------------------------------------------------
     def _post(self, fn, *args) -> bool:
@@ -796,8 +888,11 @@ class AsyncFrontend:
             if req is None:
                 self._adapter.cancel(rid)
                 self.controller._pending.pop(rid, None)
+                self.tracer.request_event(rid, "retired", cancelled=True)
             else:
                 self.controller.resolve(rid, req)
+                self.tracer.request_event(rid, "retired",
+                                          tokens=len(req.generated))
             if h is not None:
                 self._post(self._finish_stream, h, req)
         self._enqueue_cmd(do_cancel)
@@ -818,6 +913,8 @@ class AsyncFrontend:
                 continue
             stream = self._tracked.pop(rid)()
             self.controller.resolve(rid, req)
+            self.tracer.request_event(rid, "retired",
+                                      tokens=len(req.generated))
             if stream is not None:        # GC-abandoned: finalizer's
                 self._post(self._finish_stream, stream, req)  # cancel
                                           # command races the retirement
@@ -829,6 +926,7 @@ class AsyncFrontend:
             stream = ref()
             if stream is not None:
                 self._post(self._finish_stream, stream, None)
+            self.tracer.request_event(rid, "retired", failed=True)
         self._tracked.clear()
 
     def _drain_cmds_on_exit(self):

@@ -36,6 +36,12 @@ SERVING_KEYS = ("ttft_p95_ms", "goodput_fraction")
 # rejects in the denominator) — the column every admission/fleet PR is
 # judged on; recognized wherever a round's artifact nests it
 FRONTEND_KEY = "goodput_under_slo"
+# ISSUE 12 columns: fleet failover recovery p50 (from the failover
+# artifact's fleet stats) and the frontend admission prediction-error p95
+# (from `ttft_pred_err_s`) — drift-checked like the other columns: once a
+# round reports one, a later round silently losing it fails the gate
+RECOVERY_KEY = "recovery_ms_p50"
+PRED_ERR_KEY = "ttft_pred_err_s"
 
 
 def find_artifacts(root: str) -> list[tuple[int, str]]:
@@ -106,6 +112,49 @@ def find_slo_goodput(d):
     return None
 
 
+def find_recovery_p50(d):
+    """First (depth-first) fleet-failover recovery p50, ms: the flat
+    ``recovery_ms_p50`` the failover trace reports, falling back to a
+    nested ``{"recovery": {"p50_ms": ...}}`` fleet-stats block."""
+    if isinstance(d, dict):
+        if RECOVERY_KEY in d and isinstance(d[RECOVERY_KEY], (int, float)):
+            return d[RECOVERY_KEY]
+        rec = d.get("recovery")
+        if isinstance(rec, dict) \
+                and isinstance(rec.get("p50_ms"), (int, float)):
+            return rec["p50_ms"]
+        for v in d.values():
+            hit = find_recovery_p50(v)
+            if hit is not None:
+                return hit
+    elif isinstance(d, list):
+        for v in d:
+            hit = find_recovery_p50(v)
+            if hit is not None:
+                return hit
+    return None
+
+
+def find_pred_err_p95(d):
+    """First (depth-first) admission prediction-error p95, seconds: the
+    ``ttft_pred_err_s`` block's ``p95_s`` wherever a round nests it."""
+    if isinstance(d, dict):
+        err = d.get(PRED_ERR_KEY)
+        if isinstance(err, dict) \
+                and isinstance(err.get("p95_s"), (int, float)):
+            return err["p95_s"]
+        for v in d.values():
+            hit = find_pred_err_p95(v)
+            if hit is not None:
+                return hit
+    elif isinstance(d, list):
+        for v in d:
+            hit = find_pred_err_p95(v)
+            if hit is not None:
+                return hit
+    return None
+
+
 def _fmt(v, nd=1):
     if v is None:
         return "-"
@@ -123,6 +172,8 @@ def trend(root: str = ".", verbose: bool = True) -> int:
     rows = []
     prev_serving = False
     prev_frontend = False
+    prev_recovery = False
+    prev_pred_err = False
     for rnd, path in arts:
         try:
             with open(path) as f:
@@ -148,6 +199,17 @@ def trend(root: str = ".", verbose: bool = True) -> int:
                             f"({FRONTEND_KEY}) present in an earlier "
                             f"round but missing here")
         prev_frontend = prev_frontend or slo_goodput is not None
+        recovery_p50 = find_recovery_p50(parsed)
+        if recovery_p50 is None and prev_recovery:
+            problems.append(f"{path}: fleet recovery p50 ({RECOVERY_KEY}) "
+                            f"present in an earlier round but missing here")
+        prev_recovery = prev_recovery or recovery_p50 is not None
+        pred_err_p95 = find_pred_err_p95(parsed)
+        if pred_err_p95 is None and prev_pred_err:
+            problems.append(f"{path}: prediction-error p95 "
+                            f"({PRED_ERR_KEY}.p95_s) present in an earlier "
+                            f"round but missing here")
+        prev_pred_err = prev_pred_err or pred_err_p95 is not None
         rows.append({
             "round": rnd,
             "metric": parsed.get("metric"),
@@ -169,11 +231,17 @@ def trend(root: str = ".", verbose: bool = True) -> int:
             # ISSUE 11 headline: goodput-under-SLO over OFFERED requests
             # on the frontend trace ('-' for pre-frontend rounds)
             "slo_goodput": slo_goodput,
+            # ISSUE 12 columns: failover recovery p50 (ms) + admission
+            # prediction-error p95 (ms) ('-' for earlier rounds)
+            "recovery_p50_ms": recovery_p50,
+            "pred_err_p95_ms": None if pred_err_p95 is None
+            else pred_err_p95 * 1e3,
         })
     if verbose:
         hdr = (f"{'round':>5}  {'tokens/s':>10}  {'vs_base':>8}  "
                f"{'serve tok/s':>11}  {'ttft_p95_ms':>11}  {'goodput':>7}  "
-               f"{'overlap':>7}  {'slo_gput':>8}")
+               f"{'overlap':>7}  {'slo_gput':>8}  {'rec_p50':>7}  "
+               f"{'perr_p95':>8}")
         print(hdr)
         print("-" * len(hdr))
         for r in rows:
@@ -183,7 +251,9 @@ def trend(root: str = ".", verbose: bool = True) -> int:
                   f"{_fmt(r['ttft_p95_ms'], 2):>11}  "
                   f"{_fmt(r['goodput'], 3):>7}  "
                   f"{_fmt(r['overlap_ratio'], 3):>7}  "
-                  f"{_fmt(r['slo_goodput'], 3):>8}")
+                  f"{_fmt(r['slo_goodput'], 3):>8}  "
+                  f"{_fmt(r['recovery_p50_ms'], 1):>7}  "
+                  f"{_fmt(r['pred_err_p95_ms'], 2):>8}")
         v0, v1 = rows[0]["value"], rows[-1]["value"]
         if len(rows) >= 2 \
                 and all(isinstance(v, (int, float))

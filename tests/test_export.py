@@ -1,0 +1,433 @@
+"""Fleet-wide observability plane (ISSUE 12): live exporter correctness,
+registry-freeze invariant, bucket-wise histogram merging, and trace
+stitching — all pure host-side units (no engines, no jits; the engine-
+integrated drills live in test_fleet.py / test_frontend.py).
+
+Exporter correctness pins the satellite checklist exactly:
+Prometheus text-format escaping/label rules, histogram bucket
+cumulativity (non-decreasing, ``+Inf`` == count), ``/metrics`` under
+concurrent scrape + live traffic (no torn snapshots), and the JSON and
+Prometheus renders agreeing on every value.  The smoke test is
+tier-1-cheap: no sleeps, a single daemon-thread server on port 0."""
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from paddle_tpu.observability import (FleetTelemetry, Histogram,
+                                      MetricsExporter, MetricsRegistry,
+                                      Telemetry, TraceStitcher, Tracer,
+                                      export_snapshot, new_trace_id,
+                                      render_json, render_prometheus)
+from paddle_tpu.observability.export import prom_escape_label, prom_name
+
+
+def _parse_prom(text: str) -> dict:
+    """{(name, frozen labels): value} over a Prometheus text render."""
+    out = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        head, val = line.rsplit(" ", 1)
+        if "{" in head:
+            name, rest = head.split("{", 1)
+            labels = rest.rstrip("}")
+        else:
+            name, labels = head, ""
+        out[(name, labels)] = float(val)
+    return out
+
+
+def _registry_with_data(n=50):
+    r = MetricsRegistry()
+    h = r.histogram("serve.ttft_s")
+    for i in range(n):
+        h.observe(0.001 * (i + 1))
+    r.counter("serve.requests_retired").inc(n)
+    r.gauge("mem.pool_occupancy_frac").set(0.375)
+    s = r.series("mem.pool", capacity=8)
+    s.sample(1.0, free_pages=10, occupancy_frac=0.5)
+    return r
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text format rules
+# ---------------------------------------------------------------------------
+class TestPromFormat:
+    def test_name_sanitization(self):
+        assert prom_name("serve.ttft_s") == "serve_ttft_s"
+        assert prom_name("a-b c/d") == "a_b_c_d"
+        assert prom_name("0weird") == "_0weird"
+        assert prom_name("ok_name:x") == "ok_name:x"
+
+    def test_label_escaping(self):
+        assert prom_escape_label('a"b') == 'a\\"b'
+        assert prom_escape_label("a\\b") == "a\\\\b"
+        assert prom_escape_label("a\nb") == "a\\nb"
+
+    def test_counter_gauge_lines_and_labels(self):
+        r = _registry_with_data()
+        text = render_prometheus({"r\"0": export_snapshot(r)})
+        vals = _parse_prom(text)
+        assert vals[("serve_requests_retired_total",
+                     'component="r\\"0"')] == 50
+        assert vals[("mem_pool_occupancy_frac",
+                     'component="r\\"0"')] == 0.375
+        # the type header appears exactly once per metric
+        assert text.count("# TYPE serve_ttft_s histogram") == 1
+
+    def test_series_renders_last_numeric_fields(self):
+        r = _registry_with_data()
+        vals = _parse_prom(render_prometheus(export_snapshot(r)))
+        assert vals[("mem_pool_last_free_pages", "")] == 10.0
+
+    def test_empty_registry_renders_not_crashes(self):
+        """A registry scraped before its first metric ({'at': ...} only)
+        must render as an empty snapshot, not be misread as a labeled
+        bundle of floats — and the endpoint must serve 200 for it."""
+        empty = MetricsRegistry()
+        assert _parse_prom(render_prometheus(export_snapshot(empty))) == {}
+        assert _parse_prom(render_prometheus(
+            {"cold": export_snapshot(empty)})) == {}
+        ex = MetricsExporter(lambda: {"cold": export_snapshot(empty)}).start()
+        try:
+            body = urllib.request.urlopen(f"{ex.url}/metrics").read()
+            assert body.decode().strip() == ""
+        finally:
+            ex.stop()
+
+
+class TestBucketCumulativity:
+    def test_buckets_non_decreasing_and_inf_equals_count(self):
+        r = _registry_with_data(200)
+        text = render_prometheus(export_snapshot(r))
+        rows = [(labels, v) for (name, labels), v in _parse_prom(text).items()
+                if name == "serve_ttft_s_bucket"]
+        assert rows, "no bucket lines rendered"
+
+        def le_of(labels):
+            le = dict(kv.split("=", 1) for kv in labels.split(","))["le"]
+            le = le.strip('"')
+            return float("inf") if le == "+Inf" else float(le)
+
+        rows.sort(key=lambda x: le_of(x[0]))
+        counts = [v for _, v in rows]
+        assert counts == sorted(counts), "buckets must be cumulative"
+        assert counts[-1] == 200           # +Inf == count
+        vals = _parse_prom(text)
+        assert vals[("serve_ttft_s_count", "")] == 200
+
+    def test_json_and_prometheus_agree_on_every_value(self):
+        r = _registry_with_data(64)
+        snap = {"eng": export_snapshot(r)}
+        prom = _parse_prom(render_prometheus(snap))
+        js = json.loads(render_json(snap))["eng"]
+        lab = 'component="eng"'
+        for name, entry in js.items():
+            if name == "at":
+                continue
+            base = prom_name(name)
+            if entry["type"] == "counter":
+                assert prom[(f"{base}_total", lab)] == entry["value"]
+            elif entry["type"] == "gauge":
+                assert prom[(base, lab)] == entry["value"]
+            elif entry["type"] == "histogram":
+                assert prom[(f"{base}_count", lab)] == entry["count"]
+                assert prom[(f"{base}_sum", lab)] == pytest.approx(
+                    entry["sum"])
+                for le, n in entry["buckets"]:
+                    key = (f"{base}_bucket",
+                           f'component="eng",le="{le!r}"')
+                    assert prom[key] == n
+
+
+# ---------------------------------------------------------------------------
+# the HTTP endpoint (smoke: no sleeps, < 2 s)
+# ---------------------------------------------------------------------------
+class TestExporterEndpoint:
+    def test_endpoints_smoke(self):
+        r = _registry_with_data()
+        ex = MetricsExporter(
+            lambda: {"engine": export_snapshot(r)},
+            requests_fn=lambda: [{"rid": 1, "tokens": 8}],
+            health_fn=lambda: {"worker_alive": True}).start()
+        try:
+            base = ex.url
+            body = urllib.request.urlopen(f"{base}/metrics").read().decode()
+            assert "serve_ttft_s_bucket" in body \
+                and 'component="engine"' in body
+            js = json.loads(urllib.request.urlopen(
+                f"{base}/metrics.json").read().decode())
+            assert js["engine"]["serve.requests_retired"]["value"] == 50
+            hz = json.loads(urllib.request.urlopen(
+                f"{base}/healthz").read().decode())
+            assert hz["status"] == "ok" and hz["worker_alive"] is True
+            rq = json.loads(urllib.request.urlopen(
+                f"{base}/requests").read().decode())
+            assert rq == [{"rid": 1, "tokens": 8}]
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(f"{base}/nope")
+            assert ei.value.code == 404
+        finally:
+            ex.stop()
+
+    def test_scrape_error_is_500_not_crash(self):
+        def boom():
+            raise RuntimeError("snapshot exploded")
+        ex = MetricsExporter(boom).start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(f"{ex.url}/metrics")
+            assert ei.value.code == 500
+            # the server survives and still answers /healthz
+            hz = json.loads(urllib.request.urlopen(
+                f"{ex.url}/healthz").read().decode())
+            assert hz["status"] == "ok"
+        finally:
+            ex.stop()
+
+    def test_concurrent_scrape_under_live_traffic_no_torn_snapshots(self):
+        """A writer thread hammers observe()/inc() while scrapes render:
+        every render must parse and stay internally consistent — buckets
+        cumulative, +Inf == count, count >= last bucket (the read-order
+        guarantee in Histogram.cumulative_buckets)."""
+        r = MetricsRegistry()
+        h = r.histogram("serve.ttft_s")
+        c = r.counter("serve.requests_retired")
+        stop = threading.Event()
+
+        def writer():
+            i = 0
+            while not stop.is_set():
+                h.observe(0.0001 * (i % 500 + 1))
+                c.inc()
+                i += 1
+
+        t = threading.Thread(target=writer, daemon=True)
+        t.start()
+        try:
+            for _ in range(50):
+                text = render_prometheus(export_snapshot(r))
+                rows = [(labels, v)
+                        for (name, labels), v in _parse_prom(text).items()
+                        if name == "serve_ttft_s_bucket"]
+
+                def le_of(labels):
+                    le = dict(kv.split("=", 1)
+                              for kv in labels.split(","))["le"].strip('"')
+                    return float("inf") if le == "+Inf" else float(le)
+
+                rows.sort(key=lambda x: le_of(x[0]))
+                counts = [v for _, v in rows]
+                assert counts == sorted(counts), "torn: non-cumulative"
+                # +Inf is rendered from count, read AFTER the buckets
+                assert counts[-1] >= counts[-2] if len(counts) > 1 else True
+        finally:
+            stop.set()
+            t.join(timeout=5)
+
+
+# ---------------------------------------------------------------------------
+# registry-freeze invariant (satellite 1)
+# ---------------------------------------------------------------------------
+class TestRegistryFreeze:
+    def _thread_raises(self, fn):
+        box = {}
+
+        def run():
+            try:
+                fn()
+            except BaseException as e:  # noqa: BLE001
+                box["exc"] = e
+
+        t = threading.Thread(target=run)
+        t.start()
+        t.join()
+        return box.get("exc")
+
+    def test_writer_thread_creation_raises_after_freeze(self):
+        r = MetricsRegistry()
+        r.histogram("pre.registered")
+        r.freeze()
+        exc = self._thread_raises(lambda: r.histogram("lazy.new"))
+        assert isinstance(exc, RuntimeError) and "frozen" in str(exc)
+        assert "lazy.new" not in r
+
+    def test_existing_metrics_stay_writable_from_threads(self):
+        r = MetricsRegistry()
+        h = r.histogram("pre.registered")
+        r.freeze()
+        assert self._thread_raises(lambda: h.observe(0.5)) is None
+        assert self._thread_raises(
+            lambda: r.histogram("pre.registered").observe(0.1)) is None
+        assert h.count == 2
+
+    def test_main_thread_creation_still_allowed(self):
+        r = MetricsRegistry()
+        r.freeze()
+        assert r.histogram("late.main").name == "late.main"
+
+    def test_telemetry_preregisters_every_engine_phase(self):
+        """The frontend worker drives the engine on a non-main thread:
+        after freeze(), EVERY phase the engine can emit must already
+        exist — the writer-thread race drill."""
+        tel = Telemetry()
+        tel.freeze()
+        from paddle_tpu.observability.telemetry import ENGINE_PHASES
+
+        def drive():
+            t0 = tel.clock()
+            for name in ENGINE_PHASES:
+                if name == "sched":
+                    tel.sched_done(t0, tel.clock())
+                else:
+                    tel.phase(name, t0, tel.clock())
+
+        assert self._thread_raises(drive) is None
+        # an UNKNOWN phase from the worker thread is exactly the race the
+        # invariant exists to catch
+        exc = self._thread_raises(
+            lambda: tel.phase("brand_new_phase", 0.0, 1.0))
+        assert isinstance(exc, RuntimeError) and "frozen" in str(exc)
+
+
+# ---------------------------------------------------------------------------
+# FleetTelemetry: bucket-wise merge + labeled snapshot (tentpole b)
+# ---------------------------------------------------------------------------
+class TestFleetTelemetry:
+    def test_bucketwise_merge_is_exact(self):
+        """Merging two same-layout histograms equals observing the union
+        into one histogram — same count/sum/min/max AND same quantiles
+        (identical buckets), which is what makes fleet quantiles exact."""
+        obs_a = [0.002 * (i + 1) for i in range(40)]
+        obs_b = [0.05 * (i + 1) for i in range(25)]
+        ra, rb = MetricsRegistry(), MetricsRegistry()
+        for v in obs_a:
+            ra.histogram("serve.ttft_s").observe(v)
+        for v in obs_b:
+            rb.histogram("serve.ttft_s").observe(v)
+        ref = Histogram("serve.ttft_s")
+        for v in obs_a + obs_b:
+            ref.observe(v)
+        merged = FleetTelemetry({"r0": ra, "r1": rb}).merged_histograms()
+        got = merged["serve.ttft_s"]
+        assert got.count == ref.count and got.total == ref.total
+        assert got.min == ref.min and got.max == ref.max
+        for q in (0.1, 0.5, 0.95, 0.99):
+            assert got.quantile(q) == ref.quantile(q)
+        assert got.fraction_below(0.05) == ref.fraction_below(0.05)
+
+    def test_layout_mismatch_raises(self):
+        a = Histogram("x", lo=1e-6)
+        b = Histogram("x", lo=1.0)
+        with pytest.raises(ValueError, match="layout"):
+            a.merge_from(b)
+
+    def test_labeled_snapshot_counters_and_gauges(self):
+        ra, rb = MetricsRegistry(), MetricsRegistry()
+        ra.counter("serve.requests_retired").inc(3)
+        rb.counter("serve.requests_retired").inc(4)
+        ra.gauge("mem.pool_occupancy_frac").set(0.25)
+        rb.gauge("mem.pool_occupancy_frac").set(0.75)
+        snap = FleetTelemetry({"r0": ra, "r1": rb}).snapshot()
+        assert snap["replicas"] == ["r0", "r1"]
+        assert snap["merged"]["serve.requests_retired"] == 7   # summed
+        # gauges stay per-replica side-by-side, never averaged away
+        assert snap["per_replica"]["r0"]["mem.pool_occupancy_frac"] == 0.25
+        assert snap["per_replica"]["r1"]["mem.pool_occupancy_frac"] == 0.75
+
+    def test_slo_report_from_merged_ttft(self):
+        ra, rb = MetricsRegistry(), MetricsRegistry()
+        for v in (0.01, 0.02, 0.03):
+            ra.histogram("serve.ttft_s").observe(v)
+        for v in (0.5, 0.6):
+            rb.histogram("serve.ttft_s").observe(v)
+        ft = FleetTelemetry({"r0": ra, "r1": rb})
+        rep = ft.slo_report(0.1)
+        assert rep["requests"] == 5
+        assert rep["goodput_fraction"] == pytest.approx(0.6, abs=0.01)
+        assert rep["on_time_requests"] == 3
+        assert rep["ttft"]["count"] == 5
+
+    def test_accepts_telemetry_and_frontend_registry(self):
+        tel = Telemetry()
+        tel.registry.histogram("serve.ttft_s").observe(0.01)
+        fr = MetricsRegistry()
+        fr.counter("frontend.offered").inc(9)
+        snap = FleetTelemetry({"engine": tel}, frontend=fr).snapshot()
+        assert snap["replicas"] == ["engine", "frontend"]
+        assert snap["merged"]["frontend.offered"] == 9
+
+
+# ---------------------------------------------------------------------------
+# TraceStitcher (tentpole a, unit level)
+# ---------------------------------------------------------------------------
+class TestTraceStitcher:
+    def test_trace_ids_monotonic_ints(self):
+        a, b = new_trace_id(), new_trace_id()
+        assert isinstance(a, int) and b > a
+
+    def _tracers(self):
+        """frontend(rid 9) -> router(rid 5) -> r0(rid 0) -> r1(rid 0):
+        same trace_id, distinct components, overlapping local rids."""
+        tid = new_trace_id()
+        fe, ro, r0, r1 = Tracer(), Tracer(), Tracer(), Tracer()
+        fe.request_event(9, "submitted", t=1.0, trace_id=tid)
+        fe.request_event(9, "retired", t=9.0)
+        ro.request_event(5, "submitted", t=1.1, trace_id=tid)
+        ro.request_event(5, "retired", t=8.9)
+        r0.request_event(0, "submitted", t=1.2, trace_id=tid)
+        r0.request_event(0, "retired", t=4.0)
+        r1.request_event(0, "submitted", t=4.5, trace_id=tid)
+        r1.request_event(0, "retired", t=8.0)
+        return tid, fe, ro, r0, r1
+
+    def test_flow_events_chain_components_in_time_order(self):
+        tid, fe, ro, r0, r1 = self._tracers()
+        st = (TraceStitcher().add("frontend", fe).add("router", ro)
+              .add("r0", r0).add("r1", r1))
+        trace = st.to_chrome_trace()["traceEvents"]
+        flows = [e for e in trace if e.get("cat") == "request_flow"]
+        assert [e["ph"] for e in flows] == ["s", "t", "t", "f"]
+        assert all(e["id"] == tid for e in flows)
+        # pid order follows touch TIME order: frontend, router, r0, r1
+        assert [e["pid"] for e in flows] == [0, 1, 2, 3]
+        assert flows[-1]["bp"] == "e"
+        chains = st.flow_chains()
+        assert [c for c, _t0, _t1 in chains[tid]] == [
+            "frontend", "router", "r0", "r1"]
+
+    def test_process_names_and_track_isolation(self):
+        _tid, fe, ro, r0, r1 = self._tracers()
+        st = TraceStitcher().add("frontend", fe).add("r0", r0)
+        trace = st.to_chrome_trace()["traceEvents"]
+        procs = {e["pid"]: e["args"]["name"] for e in trace
+                 if e.get("ph") == "M" and e["name"] == "process_name"}
+        assert procs == {0: "frontend", 1: "r0"}
+        # request spans live on their component's pid (no cross-bleed)
+        spans = [e for e in trace if e.get("cat") == "request"]
+        assert {e["pid"] for e in spans} == {0, 1}
+
+    def test_summary_max_chain_and_counts(self):
+        _tid, fe, ro, r0, r1 = self._tracers()
+        # an unrelated, un-stitched request on the router only
+        ro.request_event(77, "submitted", t=2.0, trace_id=new_trace_id())
+        ro.request_event(77, "retired", t=3.0)
+        st = (TraceStitcher().add("frontend", fe).add("router", ro)
+              .add("r0", r0).add("r1", r1))
+        summ = st.summary()
+        assert summ["components"] == ["frontend", "router", "r0", "r1"]
+        assert summ["max_chain"] == ["frontend", "router", "r0", "r1"]
+        assert summ["requests_stitched"] == 1
+        assert summ["flow_events"] == 4
+
+    def test_requests_without_trace_id_are_not_stitched(self):
+        t1, t2 = Tracer(), Tracer()
+        t1.request_event(1, "submitted", t=1.0)
+        t1.request_event(1, "retired", t=2.0)
+        t2.request_event(1, "submitted", t=1.5)
+        t2.request_event(1, "retired", t=2.5)
+        st = TraceStitcher().add("a", t1).add("b", t2)
+        assert st.summary()["flow_events"] == 0

@@ -18,7 +18,8 @@ import paddle_tpu as paddle  # noqa: F401 — jax compat shims
 from paddle_tpu.models.llama import (llama_config_tiny,
                                      build_functional_llama, llama_generate)
 from paddle_tpu.inference.paged import (AdmissionRejected,
-                                        EngineStalledError, ServingEngine)
+                                        EngineStalledError, Request,
+                                        ServingEngine)
 from paddle_tpu.resilience import InjectedFault, inject
 from paddle_tpu.serving import (EngineSnapshotManager, FleetFailedError,
                                 ReplicaFleet)
@@ -440,8 +441,13 @@ class TestReplicaFleet:
         """The tier-1 deterministic failover drill: kill replica r0
         mid-step (post-admission or post-record), requests migrate to r1
         by re-prefill of prompt + streamed tokens, zero lost, outputs
-        bit-equal the uninterrupted engine."""
-        fleet = ReplicaFleet(_factory(), num_replicas=2)
+        bit-equal the uninterrupted engine.  Replicas run telemetry-ON so
+        the drill also pins the ISSUE 12 fleet observability plane: the
+        merged failover dump (router routing decisions + the dying
+        replica's flight ring in ONE artifact), the bucket-wise
+        FleetTelemetry aggregation, and the stitched cross-component
+        trace (the crashed request reads as one timeline)."""
+        fleet = ReplicaFleet(_factory(telemetry=True), num_replicas=2)
         with inject({"serve.crash": dict(match={"engine": "r0",
                                                 "phase": phase},
                                          at=2)}) as plan:
@@ -458,6 +464,75 @@ class TestReplicaFleet:
         assert any(e["event"] == "migrate"
                    and e["fault_plan"] is not None for e in ev)
         assert fleet.stats()["recovery"]["count"] == 1
+        # --- ISSUE 12 satellite: the MERGED failover dump — routing
+        # decisions + dying replica's ring, diagnosable from one artifact
+        dump = fleet.flight.last_dump()
+        assert dump is not None and dump["reason"] == "failover"
+        extra = dump["extra"]
+        routing = extra["routing_decisions"]
+        assert routing and all(e["event"] in ("route", "migrate")
+                               for e in routing)
+        assert any(e["event"] == "route" and e["replica"] == "r0"
+                   for e in routing)
+        assert extra["replica_ring"], \
+            "the dying replica's flight ring must ride the fleet dump"
+        assert any(e["event"] == "step" for e in extra["replica_ring"])
+        # --- ISSUE 12 tentpole: fleet aggregation (bucket-wise merge)
+        snap = fleet.stats_snapshot(ttft_deadline_s=60.0)
+        per_rep = snap["per_replica_telemetry"]
+        merged = snap["merged"]
+        live = [k for k in per_rep if k.startswith("r")
+                and k != "router"]
+        assert len(live) == 2
+        assert merged["serve.ttft_s"]["count"] == sum(
+            1 for _ in rids), "merged TTFT histogram must count every " \
+            "first token exactly once across replicas"
+        assert all("mem.pool_occupancy_frac" in per_rep[k] for k in live)
+        assert snap["fleet_slo"]["goodput_fraction"] == 1.0
+        # --- ISSUE 12 tentpole: trace stitching — the crashed request is
+        # ONE timeline across router -> dead r0 track -> surviving track
+        summ = fleet.stitcher().summary()
+        assert "router" in summ["components"] \
+            and any("crashed" in c for c in summ["components"])
+        assert summ["flow_events"] > 0
+        assert len(summ["max_chain"]) >= 3, summ
+        assert summ["max_chain"][0] == "router"
+        assert any("crashed" in c for c in summ["max_chain"])
+        # every fleet request carries a trace_id end to end
+        assert all(fr.trace_id is not None
+                   for fr in fleet._requests.values())
+
+    def test_rejected_submit_leaves_no_tracer_ghost(self):
+        """A submit that raises at placement (can-never-fit prompt) or at
+        the fleet-queue reject rung must terminate its router trace
+        record — Tracer._live is unbounded and a ghost would pollute
+        every stitched trace."""
+        fleet = ReplicaFleet(_factory(max_queue=2), num_replicas=1,
+                             max_queue=0)
+        with pytest.raises(ValueError):           # can never fit
+            fleet.submit(np.ones(400, np.int32), max_new_tokens=8)
+        assert fleet.tracer._live == {}
+        # fill the replica's bounded admission queue, then overflow the
+        # (zero-length) fleet queue: the reject rung must also terminate
+        # the trace record
+        rids = [fleet.submit(_PROMPTS[i], max_new_tokens=8)
+                for i in range(2)]
+        with pytest.raises(AdmissionRejected):
+            fleet.submit(_PROMPTS[2], max_new_tokens=8)
+        assert set(fleet.tracer._live) <= set(fleet._requests)
+        _check_fleet(fleet, rids, _refs(8)[:2])
+
+    def test_request_state_roundtrips_trace_id(self):
+        """Snapshot serialization carries trace_id (and tolerates
+        pre-ISSUE-12 snapshots without one)."""
+        req = Request(rid=3, prompt=np.arange(4, dtype=np.int32),
+                      trace_id=123)
+        eng_like = object.__new__(ServingEngine)   # _req_state reads only r
+        d = ServingEngine._req_state(eng_like, req)
+        assert d["trace_id"] == 123
+        assert ServingEngine._req_from_state(d).trace_id == 123
+        d.pop("trace_id")
+        assert ServingEngine._req_from_state(d).trace_id is None
 
     @pytest.mark.slow   # tier-1 budget: covered by the tier-1 siblings
     def test_crash_mid_speculation_migrates_bit_exact(self):
@@ -722,13 +797,29 @@ def test_check_obs_failover_validator_pos_neg():
     from pathlib import Path
     sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
     from perf.check_obs import validate_artifact
+    hist = {"count": 4, "sum": 1.0, "mean": 0.25, "min": 0.1, "max": 0.5,
+            "p50": 0.2, "p95": 0.5, "p99": 0.5, "unit": "s"}
     art = {
         "metric": "trace_failover", "lost_requests": 0,
         "outputs_bitexact": True,
         "fleet": {"failovers": 1, "migrations": 2, "torn_snapshots": 0,
                   "requests_submitted": 4, "requests_resolved": 4,
                   "recovery": {"count": 1, "p50_ms": 5.0, "p95_ms": 5.0,
-                               "p99_ms": 5.0}},
+                               "p99_ms": 5.0},
+                  # ISSUE 12: FleetTelemetry aggregation
+                  "merged": {"serve.ttft_s": dict(hist),
+                             "serve.e2e_s": dict(hist),
+                             "engine.step_host_s": dict(hist)},
+                  "per_replica_telemetry": {
+                      "r0": {"mem.pool_occupancy_frac": 0.3},
+                      "r1": {"mem.pool_occupancy_frac": 0.2}}},
+        # ISSUE 12: stitched cross-component trace + merged failover dump
+        "stitched": {"components": ["router", "r0 (crashed#1)", "r1"],
+                     "trace_events": 100, "flow_events": 6,
+                     "requests_stitched": 4,
+                     "max_chain": ["router", "r0 (crashed#1)", "r1"]},
+        "failover_dump": {"reason": "failover", "routing_decisions": 4,
+                          "replica_ring_events": 9},
         "slo_report": {
             "requests": 4, "ttft_deadline_ms": 2000.0,
             "goodput_fraction": 1.0, "on_time_requests": 4,
@@ -748,3 +839,21 @@ def test_check_obs_failover_validator_pos_neg():
     no_slo = {k: v for k, v in art.items() if k != "slo_report"}
     assert any("slo_report" in p
                for p in validate_artifact(no_slo, "failover"))
+    # ISSUE 12 negatives: a crashed request NOT stitched across >= 3
+    # tracks, lost merged histograms, a dump without routing decisions
+    bad = dict(art, stitched=dict(art["stitched"],
+                                  max_chain=["router", "r1"]))
+    assert any("max_chain" in p for p in validate_artifact(bad, "failover"))
+    bad = dict(art, stitched=dict(art["stitched"], flow_events=0))
+    assert any("flow" in p for p in validate_artifact(bad, "failover"))
+    fleet_bad = dict(art["fleet"])
+    fleet_bad.pop("merged")
+    bad = dict(art, fleet=fleet_bad)
+    assert any("merged" in p for p in validate_artifact(bad, "failover"))
+    bad = dict(art, fleet=dict(art["fleet"], per_replica_telemetry={
+        "r0": {"serve.rejections": 0}}))
+    assert any("mem.pool_occupancy_frac" in p
+               for p in validate_artifact(bad, "failover"))
+    bad = dict(art, failover_dump=dict(art["failover_dump"],
+                                       routing_decisions=0))
+    assert any("routing" in p for p in validate_artifact(bad, "failover"))

@@ -405,6 +405,73 @@ class TestEngineReplay:
 
 
 # ---------------------------------------------------------------------------
+# ISSUE 12: live exporter attach + end-to-end trace stitching
+# ---------------------------------------------------------------------------
+class TestFrontendObservabilityPlane:
+    def test_exporter_stitching_and_freeze_through_frontend(self):
+        """One engine-backed drill for the whole plane: AsyncFrontend
+        mints a trace_id per submit (stitchable through the engine
+        tracer), start_exporter() serves labeled live metrics over HTTP
+        from a non-engine thread, and the component registries come back
+        FROZEN (pre-registration makes the worker thread safe)."""
+        import json
+        import urllib.request
+        from paddle_tpu.observability import TraceStitcher
+
+        eng = _mk(telemetry=Telemetry())
+
+        async def main():
+            async with AsyncFrontend(eng) as fe:
+                ex = fe.start_exporter()         # port=0: pick a free port
+                streams = [await fe.submit(_PROMPTS[i],
+                                           max_new_tokens=_NEWS[i])
+                           for i in range(2)]
+                outs = []
+                for s in streams:
+                    outs.append([t async for t in s])
+                await fe.drain()
+                # registries frozen by the exporter attach
+                assert fe.controller.metrics.frozen
+                assert eng.telemetry.registry.frozen
+                body = urllib.request.urlopen(
+                    f"{ex.url}/metrics").read().decode()
+                js = json.loads(urllib.request.urlopen(
+                    f"{ex.url}/metrics.json").read().decode())
+                hz = json.loads(urllib.request.urlopen(
+                    f"{ex.url}/healthz").read().decode())
+                return streams, outs, body, js, hz
+
+        streams, outs, body, js, hz = asyncio.run(main())
+        for i, got in enumerate(outs):
+            assert got == _refs()[i]
+        # live scrape saw both components, labeled
+        assert 'component="frontend"' in body \
+            and 'component="engine"' in body
+        assert "serve_ttft_s_bucket" in body
+        assert js["frontend"]["frontend.offered"]["value"] == 2
+        assert js["engine"]["serve.requests_submitted"]["value"] == 2
+        assert hz["status"] == "ok" and hz["open_streams"] == 0
+        # exporter is torn down with the frontend (aclose)
+        # trace stitching: frontend span -> engine span per request
+        tids = [s.trace_id for s in streams]
+        assert all(isinstance(t, int) for t in tids) \
+            and len(set(tids)) == 2
+        st = (TraceStitcher().add("frontend", _frontend_tracer(streams))
+              .add("engine", eng.telemetry.tracer))
+        summ = st.summary()
+        assert summ["requests_stitched"] == 2
+        assert summ["max_chain"] == ["frontend", "engine"]
+        chains = st.flow_chains()
+        assert set(chains) == set(tids)
+        _leakfree(eng)
+
+
+def _frontend_tracer(streams):
+    """The frontend tracer behind the streams' frontend instance."""
+    return streams[0]._fe.tracer
+
+
+# ---------------------------------------------------------------------------
 # bench --trace frontend artifact schema (perf/check_obs.py)
 # ---------------------------------------------------------------------------
 def _frontend_art():
@@ -431,11 +498,21 @@ def _frontend_art():
         "ab": {"rounds": 2, "goodput_pred": 0.9, "goodput_depth": 0.6,
                "pair_ratios": [1.5, 1.4], "best_paired_ratio": 1.5},
     }
+    hist = {"count": 9, "sum": 1.0, "mean": 0.11, "min": 0.05, "max": 0.3,
+            "p50": 0.1, "p95": 0.3, "p99": 0.3, "unit": "s"}
     return {
         "metric": "trace_frontend",
         "outputs_bit_exact": True,
         "leaked_pages": 0,
         "host_cpu_count": 8,
+        # ISSUE 12: FleetTelemetry aggregation over engine + frontend
+        "fleet": {"replicas": ["engine", "frontend"],
+                  "merged": {"serve.ttft_s": dict(hist),
+                             "serve.e2e_s": dict(hist),
+                             "engine.step_host_s": dict(hist)},
+                  "per_replica": {
+                      "engine": {"mem.pool_occupancy_frac": 0.4},
+                      "frontend": {"frontend.offered": 10}}},
         "scenarios": {"bursty": sec,
                       "diurnal": {k: (dict(v) if isinstance(v, dict) else v)
                                   for k, v in sec.items()}},
@@ -468,3 +545,16 @@ def test_check_obs_frontend_validator_pos_neg():
     bad = _frontend_art()
     del bad["scenarios"]["diurnal"]
     assert any("diurnal" in p for p in validate_artifact(bad, "frontend"))
+    # ISSUE 12 negatives: lost FleetTelemetry aggregation
+    bad = _frontend_art()
+    del bad["fleet"]
+    assert any("FleetTelemetry" in p
+               for p in validate_artifact(bad, "frontend"))
+    bad = _frontend_art()
+    del bad["fleet"]["merged"]["serve.ttft_s"]
+    assert any("serve.ttft_s" in p
+               for p in validate_artifact(bad, "frontend"))
+    bad = _frontend_art()
+    bad["fleet"]["per_replica"] = {"frontend": {"frontend.offered": 10}}
+    assert any("mem.pool_occupancy_frac" in p
+               for p in validate_artifact(bad, "frontend"))

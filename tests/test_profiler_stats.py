@@ -56,6 +56,9 @@ def test_profiler_detaches_on_stop():
     assert len(prof._op_events) == n        # no recording after stop
 
 
+@pytest.mark.slow   # ~10 s of compile on CPU (tier-1 budget, r17);
+# chrome-trace export coverage also lives in test_observability's
+# Tracer/stitcher tests — this drills the legacy profiler.Profiler path
 def test_profiler_export_chrome_trace(tmp_path):
     prof = profiler.Profiler()
     with prof:

@@ -136,6 +136,74 @@ def test_ring_attention_dryrun_program_is_uniform():
     assert all(s == scheds[0] for s in scheds.values())
 
 
+def test_profile_mode_stamps_timings_and_exports_timeline():
+    """ISSUE 12 collective timeline profiler: profile=True records one
+    (t0, dur) per event; the skew report aggregates per kind and per
+    rank; the Perfetto export carries one track per rank with one slice
+    per retained collective."""
+    mesh = _mesh()
+    f = _collective_program(mesh)
+    x = jnp.arange(48, dtype=jnp.float32)       # fresh shape: fresh trace
+    with spmd_sanitize(n_ranks=8, profile=True) as san:
+        f(x)
+    san.verify()
+    assert len(san.timings) == len(san.events) >= 3
+    assert all(dur >= 0.0 for _t0, dur in san.timings)
+    rep = san.skew_report()
+    assert rep["n_ranks"] == 8 and rep["events"] == len(san.events)
+    assert set(rep["per_kind"]) == {e[0] for e in san.events}
+    assert sum(v["count"] for v in rep["per_kind"].values()) \
+        == len(san.events)
+    # uniform schedule: every rank ran every event -> zero skew
+    assert rep["max_rank_skew_s"] == 0.0 and not rep["straggler"]
+    assert len(rep["per_rank_total_s"]) == 8
+    tl = san.timeline_chrome()
+    slices = [e for e in tl["traceEvents"] if e.get("ph") == "X"]
+    assert len(slices) == 8 * len(san.events)
+    tracks = {e["tid"] for e in slices}
+    assert tracks == set(range(8))
+    names = {e["args"]["name"] for e in tl["traceEvents"]
+             if e.get("ph") == "M" and e["name"] == "thread_name"}
+    assert "rank 0" in names and "rank 7" in names
+
+
+def test_profile_skew_report_flags_rank_divergence():
+    """A seeded dropped collective (the skipped-branch drill) makes the
+    diverging rank's timeline shorter: the skew report must show non-zero
+    max rank skew, the per-rank timeline must lose exactly that slice,
+    and verify() still catches the schedule mismatch (the cached drop
+    set keeps fault consults one-shot, so both readouts agree)."""
+    from paddle_tpu.observability.metrics import MetricsRegistry
+
+    mesh = _mesh()
+    f = _collective_program(mesh)
+    x = jnp.arange(56, dtype=jnp.float32)       # fresh shape: fresh trace
+    with faults.inject({"spmd.collective": dict(
+            action="trigger", match={"rank": 5}, at=1)}) as plan:
+        with spmd_sanitize(n_ranks=8, profile=True) as san:
+            f(x)
+        rep = san.skew_report()
+        assert rep["max_rank_skew_s"] > 0.0
+        dropped_dur = san.timings[1][1]
+        totals = rep["per_rank_total_s"]
+        # report totals are rounded to 6 decimals
+        assert totals[5] == pytest.approx(totals[0] - dropped_dur,
+                                          abs=2e-6)
+        assert len(san.rank_timeline(5)) == len(san.events) - 1
+        assert [r["index"] for r in san.rank_timeline(5)] == [
+            i for i in range(len(san.events)) if i != 1]
+        with pytest.raises(CollectiveScheduleMismatch):
+            san.verify()
+        assert plan.fired("spmd.collective") == 1   # one-shot consult
+    # registry sink: dist.* metrics for the fleet aggregation rail
+    reg = MetricsRegistry()
+    rep2 = san.skew_report(registry=reg)
+    assert reg.gauge("dist.max_rank_skew_s").value \
+        == pytest.approx(rep2["max_rank_skew_s"], abs=1e-8)
+    assert any(n.startswith("dist.collective_s.") for n in reg.names())
+    assert reg.counter("dist.collectives").value == rep2["events"]
+
+
 def test_patching_is_scoped():
     orig = jax.lax.psum
     with spmd_sanitize(n_ranks=2):
