@@ -76,8 +76,13 @@ OBS_FAILOVER_PERFETTO ?= /tmp/_obs_failover_perfetto.json
 # bucket-wise MERGED replica histograms + per-replica telemetry, the
 # `stitched` block must show the crashed request as ONE cross-component
 # timeline (>= 3 tracks), and the stitched Perfetto JSON is written to
-# $(OBS_FAILOVER_PERFETTO) for ui.perfetto.dev.  The overhead gate's ON
-# arm runs stitching + fleet aggregation + memory sampling (<2% bar).
+# $(OBS_FAILOVER_PERFETTO) for ui.perfetto.dev.  Since ISSUE 13 both
+# traces run SENTINEL-ON and must carry the `attribution` section
+# (per-request critical-path decomposition; exact_requests == requests
+# is the gate) and the `alerts` section (aggregated health-sentinel
+# report); the overhead gate's ON arm runs stitching + fleet
+# aggregation + memory sampling + the health sentinel + tail capture +
+# a live exporter scrape + the attribution report (<3% bar).
 obs-check:
 	set -o pipefail; \
 	env JAX_PLATFORMS=cpu $(PY) bench.py --trace serving \

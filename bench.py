@@ -1075,7 +1075,7 @@ def bench_serving_failover(seed=0, perfetto=None):
     import jax.numpy as jnp
     from paddle_tpu.models.llama import LlamaConfig, build_functional_llama
     from paddle_tpu.inference.paged import ServingEngine
-    from paddle_tpu.observability import Telemetry
+    from paddle_tpu.observability import HealthSentinel, Telemetry
     from paddle_tpu.serving import ReplicaFleet
     from paddle_tpu.resilience import inject
 
@@ -1095,12 +1095,18 @@ def bench_serving_failover(seed=0, perfetto=None):
     max_news = [int(m) for m in rng.integers(8, 24, n_req)]
 
     def factory():
+        # sentinel-ON replicas (ISSUE 13): every replica watches its own
+        # queue/occupancy/burn trends; fires land in the flight ring the
+        # failover dump captures.  Revived replicas get a fresh sentinel
+        # with the rest of their telemetry.
         return ServingEngine(params, cfg, num_slots=slots,
                              page_size=page_size, num_pages=96,
                              max_pages_per_seq=16, dtype=dtype,
                              attention_impl="auto" if on_tpu else "ref",
                              prompt_bucket=16, decode_horizon=horizon,
-                             telemetry=Telemetry())
+                             telemetry=Telemetry(
+                                 sentinel=HealthSentinel(
+                                     slo_ttft_s=slo_ttft)))
 
     # the uninterrupted single-engine reference (the bit-exactness bar)
     eng = factory()
@@ -1147,6 +1153,16 @@ def bench_serving_failover(seed=0, perfetto=None):
     stitched = stitcher.summary()
     assert len(stitched["max_chain"]) >= 3, \
         f"crashed request did not stitch across components: {stitched}"
+    # ISSUE 13: stitched critical-path attribution across router + crashed
+    # + revived replicas — EVERY end-to-end request (the crashed/migrated
+    # ones included) must decompose into exact disjoint segments summing
+    # to its traced e2e, asserted BEFORE anything is reported
+    attribution = fleet.attribution_report()
+    assert attribution["requests"] == n_req, \
+        f"attribution saw {attribution['requests']}/{n_req} requests"
+    assert attribution["exact_requests"] == attribution["requests"], \
+        f"attribution not exact on {attribution['requests'] - attribution['exact_requests']} request(s)"
+    slow = fleet.slow_requests()
     if perfetto:
         stitcher.export_chrome(perfetto)
         stitched["perfetto_path"] = perfetto
@@ -1164,6 +1180,16 @@ def bench_serving_failover(seed=0, perfetto=None):
         "recovered_from_snapshot": "restore" in ev,
         "fleet": st,
         "stitched": stitched,
+        # ISSUE 13: per-request critical-path attribution (exactness
+        # asserted above) + the aggregated health-sentinel view + the
+        # fleet tail-outlier capture
+        "attribution": attribution,
+        "alerts": st["alerts"],
+        "slow_requests": {
+            "captured": len(slow),
+            "slowest": {k: slow[0][k] for k in
+                        ("component", "rid", "e2e_s")} if slow else None,
+        },
         # the merged failover dump (dying replica's flight ring + the
         # router's last-N routing decisions in ONE artifact)
         "failover_dump": {
@@ -1207,7 +1233,9 @@ def bench_serving_frontend(seed=0):
     import jax.numpy as jnp
     from paddle_tpu.models.llama import LlamaConfig, build_functional_llama
     from paddle_tpu.inference.paged import ServingEngine
-    from paddle_tpu.observability import FleetTelemetry, Telemetry
+    from paddle_tpu.observability import (BurnRateRule, FleetTelemetry,
+                                          HealthSentinel, Telemetry,
+                                          aggregate_alerts)
     from paddle_tpu.serving import (AdmissionController, AsyncFrontend,
                                     make_scenario, replay_engine)
 
@@ -1225,12 +1253,15 @@ def bench_serving_frontend(seed=0):
     params = (ep, bp, hp)
 
     def mk_engine():
+        # sentinel-ON (ISSUE 13): the stock rule set watches every engine
+        # in this trace; the A/B engine additionally gets a calibrated
+        # TTFT burn-rate rule once the SLO deadline is measured below
         return ServingEngine(params, cfg, num_slots=slots,
                              page_size=page_size, num_pages=200,
                              max_pages_per_seq=8, dtype=dtype,
                              attention_impl="auto" if on_tpu else "ref",
                              prompt_bucket=t_bucket, decode_horizon=horizon,
-                             telemetry=Telemetry())
+                             telemetry=Telemetry(sentinel=HealthSentinel()))
 
     scen_kw = dict(vocab=cfg.vocab_size, prompt_len=(5, 14),
                    max_new=(8, 16), mean_interarrival_s=1.0)
@@ -1281,6 +1312,15 @@ def bench_serving_frontend(seed=0):
     leaked = eng_front.pool.num_pages - eng_front.pool.num_free
     assert leaked == 0, f"frontend engine leaked {leaked} pages"
     eng_front.check_invariants()
+    # ISSUE 13: critical-path attribution over the transport-exactness
+    # engine (bounded trace, full span coverage): every retired request
+    # must decompose into exact disjoint segments — asserted BEFORE
+    # reporting (abandoned clients never retire and are excluded)
+    attribution = eng_front.telemetry.attribution_report()
+    assert attribution["requests"] >= 1
+    assert attribution["exact_requests"] == attribution["requests"], \
+        f"attribution not exact: {attribution}"
+    tail_report = eng_front.telemetry.tail.report()
 
     # ---- calibration: unloaded TTFT + step time on a warmed engine ------
     eng = mk_engine()
@@ -1315,6 +1355,11 @@ def bench_serving_frontend(seed=0):
     depth_cap = 2 * slots
     cap_wait = (depth_cap / slots) * mean_new * (step_s / horizon)
     slo_ttft = max(3.0 * ttft_unloaded, ttft_unloaded + cap_wait)
+    # the A/B engine's sentinel gets the calibrated deadline: the TTFT
+    # burn-rate detector (fast/slow dual window) watches the same SLO the
+    # admission controllers are judged on
+    eng.telemetry.sentinel.add_rule(BurnRateRule(
+        "ttft_slo_burn", slo_ttft_s=slo_ttft, severity="page"))
     # offered load ~3x capacity in token time: under sustained load the
     # engine retires ~1 request per mean_new GENERATED tokens (S slots
     # each finish every mean_new of their own tokens, and all S generate
@@ -1393,6 +1438,14 @@ def bench_serving_frontend(seed=0):
     return {
         "outputs_bit_exact": True,        # asserted above
         "leaked_pages": 0,                # asserted above
+        # ISSUE 13: exact per-request latency decomposition (asserted
+        # above), the tail-outlier capture summary, and the aggregated
+        # health-sentinel view from the A/B engine (queue/burn detectors
+        # observed the overloaded rounds; counts are reported, not gated
+        # — calm/pressure determinism is pinned in tests/test_health.py)
+        "attribution": attribution,
+        "tail": tail_report,
+        "alerts": aggregate_alerts({"engine": eng.telemetry.sentinel}),
         # fleet-wide aggregation (ISSUE 12; schema-gated): engine
         # telemetry + predictive-controller registries merged, captured
         # in-round from the LAST scenario's best paired round — both

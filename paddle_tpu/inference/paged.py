@@ -1049,16 +1049,29 @@ class ServingEngine:
                     and any(ln.slot.req.rid == rid
                             for ln in self._inflight.lanes)):
             self.quiesce()     # cancellation acts on exact host state
+        live = False
         for s, slot in enumerate(self._slots):
             if slot is not None and slot.req.rid == rid:
                 self._register_slot(s, with_partial=True)
                 self._release_slot(s)
-                return True
-        for r in self._queue:
-            if r.rid == rid:
-                self._queue.remove(r)
-                return True
-        return self._finished.pop(rid, None) is not None
+                live = True
+                break
+        if not live:
+            for r in self._queue:
+                if r.rid == rid:
+                    self._queue.remove(r)
+                    live = True
+                    break
+        if live and self.telemetry is not None:
+            # terminate the trace record (same ghost fix the router tracer
+            # got in the stitching PR: Tracer._live is unbounded, and a
+            # frontend with many disconnects would grow it forever); the
+            # cancelled request stays attributable — its record moves to
+            # the completed ring with a terminal `retired(cancelled)`.
+            # LIVE paths only: an already-finished rid's record terminated
+            # at retirement, and re-recording would mint a ghost duplicate
+            self.telemetry.cancelled(rid)
+        return live or self._finished.pop(rid, None) is not None
 
     # -- internals ---------------------------------------------------------
     def _jit(self, name, fn, **jit_kw):

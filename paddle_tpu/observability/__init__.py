@@ -26,13 +26,19 @@ shaped for the training loop (``TrainStep`` / ``Model.fit`` /
 nonfinite + torn-snapshot flight events with FaultPlan context).
 Telemetry off (the default) is a no-op fast path — one flag check per
 hook site, zero per-token work."""
+from .attribution import (CriticalPath, TailRecorder, attribute,
+                          attribute_stitched, attribution_report,
+                          merge_tail_dumps, stitched_attribution_report)
 from .distributed import FleetTelemetry, TraceStitcher, new_trace_id
 from .export import (MetricsExporter, export_snapshot, render_json,
                      render_prometheus)
 from .flight import FlightRecorder
+from .health import (Alert, AlertRule, BurnRateRule, DeltaRule,
+                     HealthSentinel, RatioDeltaRule, TrendRule,
+                     aggregate_alerts, default_rules)
 from .metrics import (Counter, EngineStats, Gauge, GaugeSeries, Histogram,
                       MetricsRegistry)
-from .slo import latency_percentiles, slo_report
+from .slo import burn_rate, latency_percentiles, slo_report, windowed_burn
 from .telemetry import Telemetry
 from .tracing import RequestTrace, Tracer
 from .train import TrainTelemetry, fault_context
@@ -44,4 +50,11 @@ __all__ = ["Counter", "Gauge", "GaugeSeries", "Histogram", "MetricsRegistry",
            # fleet-wide observability plane (ISSUE 12)
            "FleetTelemetry", "TraceStitcher", "new_trace_id",
            "MetricsExporter", "export_snapshot", "render_prometheus",
-           "render_json"]
+           "render_json",
+           # latency forensics + health sentinel (ISSUE 13)
+           "CriticalPath", "attribute", "attribute_stitched",
+           "attribution_report", "stitched_attribution_report",
+           "TailRecorder", "merge_tail_dumps",
+           "Alert", "AlertRule", "TrendRule", "DeltaRule", "RatioDeltaRule",
+           "BurnRateRule", "HealthSentinel", "default_rules",
+           "aggregate_alerts", "burn_rate", "windowed_burn"]

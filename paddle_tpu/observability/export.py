@@ -18,11 +18,16 @@ no new dependencies and zero engine-thread work:
     the same values, and a test pins that they agree on every one.
   * :class:`MetricsExporter` — ``ThreadingHTTPServer`` on a daemon
     thread serving ``/metrics`` (Prometheus text), ``/metrics.json``,
-    ``/healthz``, and ``/requests`` (recent request summaries).  Off by
-    default, binds ``127.0.0.1`` by default (metrics can leak workload
-    shape — put real auth in front before binding wider).  All rendering
-    happens on the HTTP thread from snapshots; the serving engine thread
-    does no exporter work at all.
+    ``/healthz`` (degraded-aware since ISSUE 13: status flips to
+    ``degraded`` with an active-alert count while the health sentinel
+    has firing rules — the HTTP code stays 200 so scrapers don't flap),
+    ``/alerts`` (the sentinel report), ``/slow`` (tail-outlier dumps:
+    the top-K slowest requests with their critical-path attribution),
+    and ``/requests`` (recent request summaries).  Off by default, binds
+    ``127.0.0.1`` by default (metrics can leak workload shape — put real
+    auth in front before binding wider).  All rendering happens on the
+    HTTP thread from snapshots; the serving engine thread does no
+    exporter work at all.
 """
 from __future__ import annotations
 
@@ -206,12 +211,25 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(200, render_json(ex.snapshot_fn()),
                            "application/json")
             elif path == "/healthz":
-                health = {"status": "ok",
+                # degraded-aware (ISSUE 13): the health_fn (usually the
+                # sentinel's health()) may flip status to "degraded" and
+                # report the active-alert count — the HTTP code stays 200
+                # either way so scrapers don't flap on a warning
+                health = {"status": "ok", "active_alerts": 0,
                           "uptime_s": round(time.monotonic() - ex._t0, 3),
                           "scrapes": ex.scrapes}
                 if ex.health_fn is not None:
                     health.update(ex.health_fn())
                 self._send(200, json.dumps(health), "application/json")
+            elif path == "/alerts":
+                alerts = ex.alerts_fn() if ex.alerts_fn is not None \
+                    else {"status": "ok", "active_alerts": 0,
+                          "fired_total": 0, "components": {},
+                          "note": "no health sentinel attached"}
+                self._send(200, json.dumps(alerts), "application/json")
+            elif path == "/slow":
+                slow = ex.slow_fn() if ex.slow_fn is not None else []
+                self._send(200, json.dumps(list(slow)), "application/json")
             elif path == "/requests":
                 reqs = ex.requests_fn() if ex.requests_fn is not None else []
                 self._send(200, json.dumps(list(reqs)), "application/json")
@@ -219,7 +237,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(404, json.dumps(
                     {"error": "unknown path", "paths": [
                         "/metrics", "/metrics.json", "/healthz",
-                        "/requests"]}), "application/json")
+                        "/alerts", "/slow", "/requests"]}),
+                    "application/json")
         except Exception as exc:  # noqa: BLE001 — a scrape must never
             # take the server thread down; report the failure to the
             # scraper instead
@@ -240,10 +259,13 @@ class MetricsExporter:
     a routable interface."""
 
     def __init__(self, snapshot_fn, requests_fn=None, health_fn=None,
+                 alerts_fn=None, slow_fn=None,
                  host: str = "127.0.0.1", port: int = 0):
         self.snapshot_fn = snapshot_fn
         self.requests_fn = requests_fn
         self.health_fn = health_fn
+        self.alerts_fn = alerts_fn      # /alerts: the health-sentinel report
+        self.slow_fn = slow_fn          # /slow: tail-outlier dumps
         self.host = host
         self._requested_port = int(port)
         self.scrapes = 0

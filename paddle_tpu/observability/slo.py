@@ -16,7 +16,59 @@ from __future__ import annotations
 
 from .metrics import Histogram
 
-__all__ = ["latency_percentiles", "slo_report"]
+__all__ = ["latency_percentiles", "slo_report", "on_time", "burn_rate",
+           "windowed_burn"]
+
+
+def on_time(summary: dict, ttft_deadline_s: float) -> bool:
+    """THE goodput predicate, shared by :func:`slo_report` and the health
+    sentinel's burn-rate detector (one definition of "good", everywhere):
+    the request was not retired overdue and its first token arrived
+    within the deadline."""
+    return (not summary.get("timed_out")
+            and summary.get("ttft_s") is not None
+            and summary["ttft_s"] <= ttft_deadline_s)
+
+
+def burn_rate(bad_fraction: float, slo_target: float) -> float:
+    """SLO burn rate: the error budget's consumption speed.  With a
+    target of ``slo_target`` (e.g. 0.95 of requests on time), the budget
+    is ``1 - slo_target``; a ``bad_fraction`` equal to the budget burns
+    at exactly 1.0 (on pace), 4x the budget burns at 4.0 (the classic
+    page-worthy burn)."""
+    budget = max(1e-9, 1.0 - float(slo_target))
+    return float(bad_fraction) / budget
+
+
+def windowed_burn(summaries, ttft_deadline_s: float, *, slo_target: float,
+                  window_s: float, now: float) -> dict:
+    """Budget consumption over ONE trailing window: request summaries
+    (``Telemetry.request_summaries`` — each stamped with its retirement
+    time under ``at``, and therefore ASCENDING in ``at``; pass anything
+    else pre-sorted) newer than ``now - window_s`` score through
+    :func:`on_time`; returns the bad fraction and its burn rate.  The
+    health sentinel's fast/slow dual-window TTFT detector calls this
+    twice — same math, two windows, zero duplication."""
+    lo = now - float(window_s)
+    n = 0
+    bad = 0
+    # summaries are retirement-time ordered (Telemetry appends at
+    # retire): walk backwards and stop at the window edge, so a
+    # per-step evaluation over a full 4096-deep deque costs the window
+    # size, not the history size
+    for s in reversed(summaries):
+        at = s.get("at")
+        if at is None:
+            continue
+        if at < lo:
+            break
+        n += 1
+        if not on_time(s, ttft_deadline_s):
+            bad += 1
+    frac = bad / n if n else 0.0
+    return {"requests": n, "bad": bad, "bad_fraction": round(frac, 4),
+            "burn_rate": burn_rate(frac, slo_target) if n else 0.0,
+            "window_s": float(window_s)}
 
 
 def latency_percentiles(values_s, name: str = "latency",
@@ -55,10 +107,7 @@ def slo_report(summaries, ttft_deadline_s: float,
             h_e2e.observe(s["e2e_s"])
         tokens = int(s.get("tokens", 0))
         total_tokens += tokens
-        on_time = (not s.get("timed_out")
-                   and s.get("ttft_s") is not None
-                   and s["ttft_s"] <= ttft_deadline_s)
-        if on_time:
+        if on_time(s, ttft_deadline_s):
             good_req += 1
             good_tokens += tokens
 

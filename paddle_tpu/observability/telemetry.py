@@ -47,6 +47,7 @@ from __future__ import annotations
 import time
 from collections import deque
 
+from .attribution import TailRecorder, attribution_report
 from .flight import FlightRecorder
 from .metrics import MetricsRegistry
 from .slo import slo_report
@@ -78,7 +79,8 @@ class Telemetry:
                  flight_dump_path: str | None = None,
                  storm_threshold: int = 4, storm_window: int = 32,
                  profiler_bridge: bool = False, max_completed: int = 4096,
-                 mem_series_capacity: int = 4096, mem_ramp_events: int = 64):
+                 mem_series_capacity: int = 4096, mem_ramp_events: int = 64,
+                 sentinel=None, tail_k: int = 8):
         self.clock = clock
         self.registry = MetricsRegistry(clock=clock)
         self.tracer = Tracer(clock=clock, bridge=profiler_bridge,
@@ -146,6 +148,59 @@ class Telemetry:
         self._g_inflight = r.gauge("engine.inflight_depth")
         self._device = None      # lazy jax device handle; False = no stats
         self._nested_dispatch_s = 0.0   # dispatch time inside a sched span
+        # -- latency forensics + health sentinel (ISSUE 13) ----------------
+        # tail-outlier capture: the top-K slowest requests auto-captured at
+        # retirement with span chain + attribution + engine-state context
+        # (O(log K) heap check per retire; OFF with tail_k=0)
+        self.tail = TailRecorder(k=tail_k, clock=clock) if tail_k else None
+        # health-sentinel metrics pre-registered (registry-freeze
+        # invariant: a fire from the engine worker thread must never
+        # create a metric)
+        self._c_alerts = r.counter("health.alerts_fired")
+        self._g_active_alerts = r.gauge("health.active_alerts")
+        # the sentinel itself: evaluation rides step_done (right after the
+        # memory-observatory sample), so telemetry-off engines pay nothing
+        # and sentinel-off telemetry pays one None check per step
+        self.sentinel = None
+        if sentinel is not None:
+            self.attach_sentinel(sentinel)
+
+    # -- health sentinel (ISSUE 13) ----------------------------------------
+    def attach_sentinel(self, sentinel) -> "Telemetry":
+        """Attach a :class:`~paddle_tpu.observability.health.
+        HealthSentinel`: it adopts this telemetry's clock (one clock
+        domain) and evaluates at every engine-step end via the existing
+        ``step_done`` hook — zero new jits, telemetry-off stays
+        zero-cost."""
+        self.sentinel = sentinel.attach(self)
+        return self
+
+    def alert_fired(self, alert):
+        """Sentinel fire callback: count it, flight-record it with the
+        active fault-plan context, and auto-dump the ring — the
+        postmortem artifact shows the ramp that tripped the rule."""
+        from .train import fault_context
+        self._c_alerts.inc()
+        self._g_active_alerts.set(
+            len(self.sentinel.active()) if self.sentinel is not None else 1)
+        self.flight.record("alert", rule=alert.rule,
+                           severity=alert.severity,
+                           value=round(alert.value, 6),
+                           threshold=alert.threshold,
+                           fault_plan=fault_context())
+        self._dump("alert", rule=alert.rule, value=round(alert.value, 6),
+                   threshold=alert.threshold, severity=alert.severity)
+
+    def alert_cleared(self, alert):
+        self._g_active_alerts.set(
+            len(self.sentinel.active()) if self.sentinel is not None else 0)
+        self.flight.record("alert_cleared", rule=alert.rule,
+                           value=round(alert.value, 6))
+
+    def attribution_report(self, top_k: int = 5) -> dict:
+        """Aggregate critical-path attribution over every completed
+        request on this engine's tracer (observability.attribution)."""
+        return attribution_report(self.tracer, top_k=top_k)
 
     # -- low-level ---------------------------------------------------------
     def phase(self, name: str, t0: float, t1: float, **attrs):
@@ -301,7 +356,12 @@ class Telemetry:
             referenced=pool.num_referenced, cache_page_refs=cache_refs,
             occupancy_frac=round(occ, 4),
             fragmentation_frac=round(frag, 4), slot_tokens=slot_tokens,
-            queue_depth=len(engine._queue), active=engine.num_active)
+            queue_depth=len(engine._queue), active=engine.num_active,
+            # cumulative prefix-cache accounting per row: the health
+            # sentinel's hit-rate-collapse rule reads WINDOWED deltas of
+            # these (Δhit / Δ(hit+executed)) straight off the series
+            cache_hit_tokens=engine.cache_hit_tokens,
+            prefill_tokens_executed=engine.prefill_tokens)
         dev = self._device_bytes()
         if dev is not None:
             fields["device_bytes_in_use"] = dev
@@ -347,6 +407,11 @@ class Telemetry:
         self._c_submitted.inc()
         attrs = dict(prompt_tokens=len(req.prompt),
                      max_new_tokens=req.max_new_tokens)
+        if req.generated:
+            # a mid-flight adoption (fleet migration / manual adopt): the
+            # record starts with tokens already emitted elsewhere — the
+            # attribution analyzer reads this to label the residency
+            attrs["resumed_tokens"] = len(req.generated)
         if getattr(req, "trace_id", None) is not None:
             # cross-component trace stitching: the trace_id rides the
             # request record so TraceStitcher can bind this engine's span
@@ -461,13 +526,34 @@ class Telemetry:
                                   preemptions=req.preemptions)
         self.flight.record("retire", rid=req.rid, tokens=tokens,
                            timed_out=req.timed_out)
-        self.request_summaries.append({
+        summary = {
             "rid": req.rid, "tokens": tokens, "ttft_s": ttft,
             "tpot_s": tpot, "e2e_s": e2e,
             "queue_s": req.queue_time or None,
             "timed_out": req.timed_out, "preemptions": req.preemptions,
             "cached_prefix_tokens": req.cached_prefix_tokens,
-        })
+            # retirement stamp: the burn-rate detector windows on this
+            "at": t,
+        }
+        self.request_summaries.append(summary)
+        if self.tail is not None:
+            # the record the retired event just completed sits at the top
+            # of the done ring — O(1), no linear rid scan
+            done = self.tracer._done
+            tr = done[-1] if done and done[-1].rid == req.rid \
+                else self.tracer.get(req.rid)
+            if tr is not None:
+                self.tail.offer(summary, tr, self.tracer,
+                                context=self.memory.last)
+
+    def cancelled(self, rid: int):
+        """A request cancelled mid-flight (client disconnect / zombie
+        prune): terminate its trace record — cancels are terminal, and a
+        live-table ghost would grow the tracer unboundedly — and flight-
+        record the cancellation.  No latency histograms: a cancel is not
+        a completion."""
+        self.tracer.request_event(rid, "retired", cancelled=True)
+        self.flight.record("cancel", rid=rid)
 
     def step_done(self, engine, t0: float, progressed: bool,
                   tokens: int):
@@ -492,6 +578,11 @@ class Telemetry:
                                step=engine._step_seq)
             self._dump("injected_fault", point="serve.pool_pressure",
                        step=engine._step_seq)
+        if self.sentinel is not None:
+            # the health sentinel rides THIS hook (right after the
+            # memory-observatory sample, so every rule sees the fresh
+            # series row): no new hook sites, zero cost when absent
+            self.sentinel.on_step(self)
 
     def fault_dump(self, reason: str, **extra) -> dict:
         return self._dump(reason, **extra)
@@ -518,6 +609,17 @@ class Telemetry:
                   self._h_prefill_tok, *self._phase_h.values()):
             h.reset()
         self.memory.reset()
+        if self.tail is not None:
+            # warm-pass outliers (compile-inflated) must not shadow the
+            # measured window's true tail
+            self.tail.reset()
+        if self.sentinel is not None:
+            # rule windows + derived baselines restart with the window;
+            # active alerts are force-cleared, so the live gauge must
+            # follow (a stale nonzero would contradict /alerts until the
+            # next fire/clear event)
+            self.sentinel.reset()
+            self._g_active_alerts.set(0)
 
     # -- readouts ----------------------------------------------------------
     def utilization_report(self, window_s: float | None = None) -> dict:

@@ -662,16 +662,62 @@ class ReplicaFleet:
         BUCKET-WISE into fleet quantiles (``merged``), gauges/series/
         counters side-by-side per replica (``per_replica_telemetry``).
         With ``ttft_deadline_s``, a fleet-wide SLO report read straight
-        off the merged TTFT histogram rides along (``fleet_slo``)."""
+        off the merged TTFT histogram rides along (``fleet_slo``).
+        Since ISSUE 13 the snapshot also carries ``alerts`` — the
+        aggregated health-sentinel view across replicas (empty components
+        when no replica runs a sentinel)."""
         ft = FleetTelemetry.from_fleet(self)
         snap = ft.snapshot()
         out = dict(self.stats())
         out["replica_names"] = snap["replicas"]
         out["merged"] = snap["merged"]
         out["per_replica_telemetry"] = snap["per_replica"]
+        out["alerts"] = self.alerts_report()
         if ttft_deadline_s is not None:
             out["fleet_slo"] = ft.slo_report(ttft_deadline_s)
         return out
+
+    # -- latency forensics + health sentinel (ISSUE 13) --------------------
+    def _sentinels(self) -> dict:
+        out: dict = {}
+        for rep in self._replicas:
+            if rep.alive and rep.engine is not None \
+                    and rep.engine.telemetry is not None \
+                    and rep.engine.telemetry.sentinel is not None:
+                out[rep.name] = rep.engine.telemetry.sentinel
+        return out
+
+    def alerts_report(self) -> dict:
+        """Aggregated health-sentinel view across live replicas (worst
+        status wins, fire counts sum) — the failover artifact's
+        ``alerts`` section and the frontend exporter's ``/alerts``
+        source when the fleet is the backend."""
+        from ..observability.health import aggregate_alerts
+        return aggregate_alerts(self._sentinels())
+
+    def slow_requests(self, k: int = 8) -> list:
+        """Fleet-level tail forensics: the top-``k`` slowest captured
+        requests across every live replica's TailRecorder, slowest
+        first (flight-style outlier dumps with attribution + engine
+        context)."""
+        from ..observability.attribution import merge_tail_dumps
+        tails = [(rep.name, rep.engine.telemetry.tail)
+                 for rep in self._replicas
+                 if rep.alive and rep.engine is not None
+                 and rep.engine.telemetry is not None
+                 and rep.engine.telemetry.tail is not None]
+        return merge_tail_dumps(tails, k=k)
+
+    def attribution_report(self, top_k: int = 5) -> dict:
+        """Stitched critical-path attribution over every END-TO-END
+        request the fleet resolved: each trace_id's residencies attribute
+        on their replica's spans, inter-replica gaps classify as
+        ``migration`` / ``snapshot_restore`` — crashed generations'
+        tracers included, so a failover-migrated request still decomposes
+        exactly (observability.attribution)."""
+        from ..observability.attribution import stitched_attribution_report
+        return stitched_attribution_report(self.trace_components(),
+                                           top_k=top_k)
 
     def trace_components(self) -> list:
         """(name, Tracer) per stitched-trace component: the router track

@@ -789,6 +789,37 @@ class AsyncFrontend:
         return rep
 
     # -- live exporter -----------------------------------------------------
+    def _sentinels(self) -> dict:
+        """{label: HealthSentinel} for every telemetry-bearing component
+        behind this front end that carries one — recomputed per scrape so
+        failover-revived replicas appear automatically, and every
+        sentinel found gets the admission controller's registry attached
+        (the prediction-error drift rule reads it; a revived replica's
+        FRESH sentinel must be wired on discovery, not only at
+        start_exporter time)."""
+        out: dict = {}
+        eng = self.engine
+        if isinstance(eng, ServingEngine):
+            tel = eng.telemetry
+            if tel is not None and tel.sentinel is not None:
+                out["engine"] = tel.sentinel
+        else:                                     # ReplicaFleet
+            out.update(eng._sentinels())
+        for s in out.values():
+            s.registries.setdefault("frontend", self.controller.metrics)
+        return out
+
+    def _slow_dumps(self) -> list:
+        """The /slow body: tail-outlier dumps merged across components."""
+        from ..observability.attribution import merge_tail_dumps
+        eng = self.engine
+        if isinstance(eng, ServingEngine):
+            tel = eng.telemetry
+            if tel is None or tel.tail is None:
+                return []
+            return merge_tail_dumps([("engine", tel.tail)])
+        return eng.slow_requests()                # ReplicaFleet
+
     def _export_registries(self) -> dict:
         """{label: MetricsRegistry} for every component behind this front
         end — recomputed per scrape, so failover-revived replicas (fresh
@@ -809,10 +840,14 @@ class AsyncFrontend:
     def start_exporter(self, host: str = "127.0.0.1", port: int = 0,
                        freeze: bool = True):
         """Attach the live pull endpoint: ``/metrics`` (Prometheus text,
-        every component labeled), ``/metrics.json``, ``/healthz``, and
-        ``/requests`` (recent request summaries) on a stdlib
-        ``http.server`` daemon thread.  Off by default; ``port=0`` picks
-        a free port (read ``.port`` back from the returned exporter).
+        every component labeled), ``/metrics.json``, ``/healthz``
+        (degraded-aware when a health sentinel rides the engine
+        telemetry), ``/alerts`` (the aggregated sentinel report),
+        ``/slow`` (top-K slowest requests with their critical-path
+        attribution, merged across replicas), and ``/requests`` (recent
+        request summaries) on a stdlib ``http.server`` daemon thread.
+        Off by default; ``port=0`` picks a free port (read ``.port``
+        back from the returned exporter).
 
         SECURITY: binds ``127.0.0.1`` by default — metrics and request
         summaries expose workload shape; put real auth in front before
@@ -825,6 +860,7 @@ class AsyncFrontend:
         pre-registered, so a scrape can never race a metric being
         created at first use from the worker thread."""
         from ..observability.export import MetricsExporter, export_snapshot
+        from ..observability.health import aggregate_alerts
         if self.exporter is not None:
             raise RuntimeError("exporter already attached")
         if freeze:
@@ -844,14 +880,29 @@ class AsyncFrontend:
             return list(eng._summaries)[-64:]
 
         def health_fn():
-            return {"worker_alive": self._thread is not None
-                    and self._thread.is_alive(),
-                    "open_streams": len(self._streams),
-                    "worker_error": None if self._error is None
-                    else str(self._error)[:200]}
+            h = {"worker_alive": self._thread is not None
+                 and self._thread.is_alive(),
+                 "open_streams": len(self._streams),
+                 "worker_error": None if self._error is None
+                 else str(self._error)[:200]}
+            sentinels = self._sentinels()
+            if sentinels:
+                # degraded-aware /healthz: worst component status wins,
+                # active alerts counted fleet-wide (HTTP 200 either way)
+                agg = aggregate_alerts(sentinels)
+                h["status"] = agg["status"]
+                h["active_alerts"] = agg["active_alerts"]
+            return h
+
+        def alerts_fn():
+            return aggregate_alerts(self._sentinels())
+
+        def slow_fn():
+            return self._slow_dumps()
 
         self.exporter = MetricsExporter(
             snapshot_fn, requests_fn=requests_fn, health_fn=health_fn,
+            alerts_fn=alerts_fn, slow_fn=slow_fn,
             host=host, port=port).start()
         return self.exporter
 

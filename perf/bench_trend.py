@@ -42,6 +42,13 @@ FRONTEND_KEY = "goodput_under_slo"
 # round reports one, a later round silently losing it fails the gate
 RECOVERY_KEY = "recovery_ms_p50"
 PRED_ERR_KEY = "ttft_pred_err_s"
+# ISSUE 13 columns: total health-sentinel fires (the `alerts` section's
+# `fired_total`) and the e2e-attribution headline — the decode-sync share
+# of end-to-end latency (`attribution.decode_sync_frac`, the number the
+# ROADMAP item 1/2 collective/dequant-tax claims will move).  Drift-
+# checked like the other columns.
+ALERTS_KEY = "fired_total"
+ATTR_KEY = "decode_sync_frac"
 
 
 def find_artifacts(root: str) -> list[tuple[int, str]]:
@@ -155,6 +162,45 @@ def find_pred_err_p95(d):
     return None
 
 
+def find_alerts_fired(d):
+    """First (depth-first) `alerts` section's `fired_total` — the ISSUE 13
+    health-sentinel fire count, wherever a round nests it."""
+    if isinstance(d, dict):
+        al = d.get("alerts")
+        if isinstance(al, dict) and isinstance(al.get(ALERTS_KEY), int) \
+                and not isinstance(al.get(ALERTS_KEY), bool):
+            return al[ALERTS_KEY]
+        for v in d.values():
+            hit = find_alerts_fired(v)
+            if hit is not None:
+                return hit
+    elif isinstance(d, list):
+        for v in d:
+            hit = find_alerts_fired(v)
+            if hit is not None:
+                return hit
+    return None
+
+
+def find_decode_sync_frac(d):
+    """First (depth-first) attribution headline `decode_sync_frac` — the
+    decode device-wait share of e2e latency (ISSUE 13)."""
+    if isinstance(d, dict):
+        v = d.get(ATTR_KEY)
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            return v
+        for v in d.values():
+            hit = find_decode_sync_frac(v)
+            if hit is not None:
+                return hit
+    elif isinstance(d, list):
+        for v in d:
+            hit = find_decode_sync_frac(v)
+            if hit is not None:
+                return hit
+    return None
+
+
 def _fmt(v, nd=1):
     if v is None:
         return "-"
@@ -174,6 +220,8 @@ def trend(root: str = ".", verbose: bool = True) -> int:
     prev_frontend = False
     prev_recovery = False
     prev_pred_err = False
+    prev_alerts = False
+    prev_attr = False
     for rnd, path in arts:
         try:
             with open(path) as f:
@@ -210,6 +258,17 @@ def trend(root: str = ".", verbose: bool = True) -> int:
                             f"({PRED_ERR_KEY}.p95_s) present in an earlier "
                             f"round but missing here")
         prev_pred_err = prev_pred_err or pred_err_p95 is not None
+        alerts_fired = find_alerts_fired(parsed)
+        if alerts_fired is None and prev_alerts:
+            problems.append(f"{path}: health-sentinel fire count "
+                            f"(alerts.{ALERTS_KEY}) present in an earlier "
+                            f"round but missing here")
+        prev_alerts = prev_alerts or alerts_fired is not None
+        dsync_frac = find_decode_sync_frac(parsed)
+        if dsync_frac is None and prev_attr:
+            problems.append(f"{path}: attribution headline ({ATTR_KEY}) "
+                            f"present in an earlier round but missing here")
+        prev_attr = prev_attr or dsync_frac is not None
         rows.append({
             "round": rnd,
             "metric": parsed.get("metric"),
@@ -236,12 +295,15 @@ def trend(root: str = ".", verbose: bool = True) -> int:
             "recovery_p50_ms": recovery_p50,
             "pred_err_p95_ms": None if pred_err_p95 is None
             else pred_err_p95 * 1e3,
+            # ISSUE 13 columns: sentinel fires + decode-sync e2e share
+            "alerts_fired": alerts_fired,
+            "decode_sync_frac": dsync_frac,
         })
     if verbose:
         hdr = (f"{'round':>5}  {'tokens/s':>10}  {'vs_base':>8}  "
                f"{'serve tok/s':>11}  {'ttft_p95_ms':>11}  {'goodput':>7}  "
                f"{'overlap':>7}  {'slo_gput':>8}  {'rec_p50':>7}  "
-               f"{'perr_p95':>8}")
+               f"{'perr_p95':>8}  {'alerts':>6}  {'dsync':>5}")
         print(hdr)
         print("-" * len(hdr))
         for r in rows:
@@ -253,7 +315,9 @@ def trend(root: str = ".", verbose: bool = True) -> int:
                   f"{_fmt(r['overlap_ratio'], 3):>7}  "
                   f"{_fmt(r['slo_goodput'], 3):>8}  "
                   f"{_fmt(r['recovery_p50_ms'], 1):>7}  "
-                  f"{_fmt(r['pred_err_p95_ms'], 2):>8}")
+                  f"{_fmt(r['pred_err_p95_ms'], 2):>8}  "
+                  f"{_fmt(r['alerts_fired']):>6}  "
+                  f"{_fmt(r['decode_sync_frac'], 3):>5}")
         v0, v1 = rows[0]["value"], rows[-1]["value"]
         if len(rows) >= 2 \
                 and all(isinstance(v, (int, float))

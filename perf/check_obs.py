@@ -18,7 +18,9 @@ Two checks, both about keeping the telemetry subsystem honest:
 
 2. **Overhead gate** (`--gate`): runs the SAME small serving trace twice
    per round — telemetry off, telemetry fully on (tracing + histograms +
-   flight recorder) — interleaved over `--rounds` rounds, and requires the
+   flight recorder + health sentinel + tail capture + live exporter with
+   an in-window scrape + attribution report) — interleaved over
+   `--rounds` rounds, and requires the
    BEST per-round paired ratio on/off >= `--min-ratio` (default 0.97:
    telemetry may cost at most ~3%).  The pairing matters on a machine
    whose throughput wobbles ~2x under load (the same caveat as `make
@@ -113,6 +115,15 @@ FRONTEND_AB_KEYS = ("rounds", "goodput_pred", "goodput_depth",
 FRONTEND_MIN_RATIO_MULTICORE = 1.0
 FRONTEND_MIN_RATIO_SINGLECORE = 0.9
 
+# ISSUE 13: the latency-forensics + health-sentinel sections the frontend
+# AND failover artifacts must carry.  `attribution` is the per-request
+# critical-path decomposition — exact_requests == requests is the gate
+# (segments disjoint, summing to the traced e2e, on every request incl.
+# failover-migrated ones); `alerts` is the aggregated sentinel view.
+ATTRIBUTION_KEYS = ("requests", "exact_requests", "e2e_s_total",
+                    "segments", "decode_sync_frac", "slowest")
+ALERTS_KEYS = ("status", "active_alerts", "fired_total", "components")
+
 # the failover artifact's fleet-stats block must carry these
 FLEET_KEYS = ("failovers", "migrations", "torn_snapshots",
               "requests_submitted", "requests_resolved", "recovery")
@@ -164,10 +175,57 @@ def _validate_fleet_telemetry(fleet: dict, merged_key: str = "merged",
     return problems
 
 
+def _validate_forensics(art: dict) -> list[str]:
+    """The ISSUE 13 sections shared by the frontend and failover gates:
+    `attribution` (exactness census + segment shares + slowest capture)
+    and `alerts` (aggregated health-sentinel report)."""
+    problems = []
+    attr = art.get("attribution")
+    if not isinstance(attr, dict):
+        problems.append("missing 'attribution' (per-request critical-path "
+                        "decomposition — ISSUE 13)")
+    else:
+        for k in ATTRIBUTION_KEYS:
+            if k not in attr:
+                problems.append(f"attribution: missing {k!r}")
+        n = attr.get("requests")
+        if not n:
+            problems.append("attribution.requests is 0 — nothing was "
+                            "attributed")
+        elif attr.get("exact_requests") != n:
+            problems.append(
+                f"attribution.exact_requests {attr.get('exact_requests')!r}"
+                f" != requests {n!r} — segments must be disjoint and sum "
+                f"exactly to the traced e2e on EVERY request")
+        seg = attr.get("segments")
+        if not isinstance(seg, dict) or not seg:
+            problems.append("attribution.segments missing/empty")
+        else:
+            for name, e in seg.items():
+                if not isinstance(e, dict) or "total_s" not in e \
+                        or "frac" not in e:
+                    problems.append(f"attribution.segments[{name!r}] "
+                                    f"missing total_s/frac")
+    alerts = art.get("alerts")
+    if not isinstance(alerts, dict):
+        problems.append("missing 'alerts' (aggregated health-sentinel "
+                        "report — ISSUE 13)")
+    else:
+        for k in ALERTS_KEYS:
+            if k not in alerts:
+                problems.append(f"alerts: missing {k!r}")
+        if not isinstance(alerts.get("components"), dict) \
+                or not alerts.get("components"):
+            problems.append("alerts.components is empty — the trace must "
+                            "run sentinel-ON")
+    return problems
+
+
 def _validate_failover(art: dict) -> list[str]:
     problems = []
     if "metric" not in art:
         problems.append("missing top-level 'metric'")
+    problems.extend(_validate_forensics(art))
     if art.get("lost_requests") != 0:
         problems.append(f"lost_requests is {art.get('lost_requests')!r} — "
                         f"the failover drill must lose ZERO requests")
@@ -251,6 +309,7 @@ def _validate_frontend(art: dict) -> list[str]:
     problems = []
     if "metric" not in art:
         problems.append("missing top-level 'metric'")
+    problems.extend(_validate_forensics(art))
     if art.get("outputs_bit_exact") is not True:
         problems.append("outputs_bit_exact is not True — greedy outputs "
                         "served through AsyncFrontend must match direct "
@@ -498,10 +557,13 @@ def _validate_overlap(art: dict) -> list[str]:
 def _overhead_trace(telemetry_on: bool, seed: int = 0) -> float:
     """One small serving trace; returns useful tokens/s.  Same model, same
     prompts, same engine geometry either way — the only variable is the
-    telemetry flag.  The telemetry-ON arm runs the FULL ISSUE 12 plane:
-    trace stitching (a trace_id on every submit), memory sampling (always
-    on with telemetry), and a fleet-aggregation snapshot taken inside the
-    timed window — the <2% overhead bar covers all of it."""
+    telemetry flag.  The telemetry-ON arm runs the FULL observability
+    plane: trace stitching (a trace_id on every submit), memory sampling,
+    the ISSUE 13 health sentinel (stock rules + TTFT burn, evaluated at
+    every step end) and tail-outlier capture, a live exporter serving a
+    real scrape inside the timed window, plus a fleet-aggregation
+    snapshot and the critical-path attribution report — the <3% overhead
+    bar covers all of it."""
     import time
 
     # runnable as `python perf/check_obs.py` from the repo root (sys.path
@@ -514,7 +576,7 @@ def _overhead_trace(telemetry_on: bool, seed: int = 0) -> float:
     from paddle_tpu.inference.paged import ServingEngine
     from paddle_tpu.models.llama import (build_functional_llama,
                                          llama_config_tiny)
-    from paddle_tpu.observability import Telemetry
+    from paddle_tpu.observability import HealthSentinel, Telemetry
 
     cfg = llama_config_tiny(vocab=256, hidden=64, layers=2, heads=4, seq=256)
     ep, bp, hp, *_ = build_functional_llama(cfg, key=jax.random.PRNGKey(7))
@@ -523,29 +585,50 @@ def _overhead_trace(telemetry_on: bool, seed: int = 0) -> float:
     n_req, max_new = 12, 24
     prompts = [rng.integers(1, 256, (int(t),)).astype(np.int32)
                for t in rng.integers(8, 48, n_req)]
+    tel = Telemetry(sentinel=HealthSentinel(slo_ttft_s=2.0)) \
+        if telemetry_on else None
     eng = ServingEngine(
         params, cfg, num_slots=4, page_size=16, num_pages=256,
         attention_impl="ref", prompt_bucket=16, decode_horizon=8,
-        telemetry=Telemetry() if telemetry_on else None)
+        telemetry=tel)
     assert (eng.telemetry is not None) == telemetry_on
-    # warm every prompt bucket + the horizon, then time the real trace
-    for tb in sorted({((len(p) + 15) // 16) * 16 for p in prompts}):
-        eng.submit(rng.integers(1, 256, (tb,)).astype(np.int32),
-                   max_new_tokens=max_new)
-    eng.run()
-    t0 = time.perf_counter()
-    for i, p in enumerate(prompts):
-        # stitching enabled on the ON arm: every request carries a
-        # trace_id (the per-request stitching cost is exactly this)
-        eng.submit(p, max_new_tokens=max_new,
-                   trace_id=seed * 1000 + i if telemetry_on else None)
-    eng.run()
+    exporter = None
     if telemetry_on:
-        # fleet aggregation INSIDE the timed window: the merged snapshot
-        # is part of what the <2% budget must cover
-        from paddle_tpu.observability import FleetTelemetry
-        FleetTelemetry({"r0": eng.telemetry}).snapshot()
-    dt = time.perf_counter() - t0
+        from paddle_tpu.observability import (MetricsExporter,
+                                              aggregate_alerts,
+                                              export_snapshot)
+        exporter = MetricsExporter(
+            lambda: {"engine": export_snapshot(tel.registry)},
+            alerts_fn=lambda: aggregate_alerts(
+                {"engine": tel.sentinel}),
+            slow_fn=lambda: tel.tail.dumps()).start()
+    try:
+        # warm every prompt bucket + the horizon, then time the real trace
+        for tb in sorted({((len(p) + 15) // 16) * 16 for p in prompts}):
+            eng.submit(rng.integers(1, 256, (tb,)).astype(np.int32),
+                       max_new_tokens=max_new)
+        eng.run()
+        t0 = time.perf_counter()
+        for i, p in enumerate(prompts):
+            # stitching enabled on the ON arm: every request carries a
+            # trace_id (the per-request stitching cost is exactly this)
+            eng.submit(p, max_new_tokens=max_new,
+                       trace_id=seed * 1000 + i if telemetry_on else None)
+        eng.run()
+        if telemetry_on:
+            # fleet aggregation + attribution + one REAL scrape INSIDE
+            # the timed window: the merged snapshot, the critical-path
+            # report, and a live /metrics render are all part of what
+            # the overhead budget must cover
+            import urllib.request
+            from paddle_tpu.observability import FleetTelemetry
+            FleetTelemetry({"r0": eng.telemetry}).snapshot()
+            tel.attribution_report()
+            urllib.request.urlopen(f"{exporter.url}/metrics").read()
+        dt = time.perf_counter() - t0
+    finally:
+        if exporter is not None:
+            exporter.stop()
     return n_req * max_new / dt
 
 

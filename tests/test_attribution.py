@@ -1,0 +1,400 @@
+"""Per-request critical-path attribution + tail-outlier capture (ISSUE 13
+tentpole part a, observability/attribution.py).
+
+Acceptance: attribution segments are DISJOINT and sum EXACTLY to the
+traced e2e on every feature intersection — overlap on/off, chunked
+prefill, speculative K in {0, 4}, preemption, and (via the stitched path)
+failover-migrated / snapshot-restored requests — and the TailRecorder
+captures the top-K slowest requests with span chain + attribution +
+engine-state context, bounded and ordered.
+
+Structure: synthetic tracer drills pin the algorithm (nesting, queue
+priority, stitched gap classification, zombie clamping) with zero jax;
+one small real-engine run per feature cell pins exactness on live
+traces (single prompt bucket, tier-1 sized — the heavy intersections
+ride the slow lane)."""
+import math
+
+import numpy as np
+import pytest
+import jax
+
+import paddle_tpu as paddle  # noqa: F401 — jax compat shims
+from paddle_tpu.inference.paged import ServingEngine
+from paddle_tpu.models.llama import build_functional_llama, llama_config_tiny
+from paddle_tpu.observability import Telemetry, Tracer
+from paddle_tpu.observability.attribution import (
+    SEGMENT_KINDS, TailRecorder, attribute, attribute_stitched,
+    attribute_trace, attribution_report, merge_tail_dumps,
+    stitched_attribution_report)
+
+rng = np.random.default_rng(91)
+
+CFG = llama_config_tiny(vocab=64, hidden=32, layers=2, heads=4, seq=128)
+_PARAMS = None
+_ECHO = None
+
+
+def _params():
+    global _PARAMS
+    if _PARAMS is None:
+        ep, bp, hp, *_ = build_functional_llama(CFG,
+                                                key=jax.random.PRNGKey(4))
+        _PARAMS = (ep, bp, hp)
+    return _PARAMS
+
+
+def _echo_params():
+    """Echo-biased weights (test_spec_decode's trick) so the n-gram
+    drafter actually drafts on this tiny config."""
+    global _ECHO
+    if _ECHO is None:
+        ep, bp, hp = _params()
+        bp = {k: (v * 0.05 if k.startswith("w") else v)
+              for k, v in bp.items()}
+        hp = dict(hp, lm=(ep["tok"].T * 4.0).astype(hp["lm"].dtype))
+        _ECHO = (ep, bp, hp)
+    return _ECHO
+
+
+# one prompt bucket (every length <= prompt_bucket=8): one dense-prefill
+# executable per engine — compile-dominated on CPU, tier-1 budget is tight
+_PROMPTS = [rng.integers(1, 64, (t,)).astype(np.int32) for t in (5, 7, 3, 6)]
+_NEWS = [8, 6, 9, 7]
+
+
+def _mk(params=None, **kw):
+    base = dict(num_slots=2, page_size=4, num_pages=120,
+                max_pages_per_seq=16, attention_impl="ref",
+                prompt_bucket=8, decode_horizon=3, telemetry=Telemetry())
+    base.update(kw)
+    return ServingEngine(params or _params(), CFG, **base)
+
+
+def _assert_exact(cp):
+    assert cp.is_exact(), cp.to_dict(segments=True)
+    assert cp.sum_matches(), (cp.e2e_s, cp.traced_e2e_s)
+    assert set(cp.totals()) <= set(SEGMENT_KINDS)
+    # disjoint + contiguous, re-checked from the raw segments
+    for (k0, a0, b0, _c0), (k1, a1, b1, _c1) in zip(cp.segments,
+                                                    cp.segments[1:]):
+        assert b0 == a1 and b0 >= a0 and b1 >= a1
+    assert abs(math.fsum(b - a for _k, a, b, _c in cp.segments)
+               - cp.traced_e2e_s) <= 1e-9 * max(1.0, cp.traced_e2e_s)
+
+
+def _run_and_check(eng, prompts=None, news=None):
+    prompts = _PROMPTS if prompts is None else prompts
+    news = _NEWS if news is None else news
+    rids = [eng.submit(p, max_new_tokens=n) for p, n in zip(prompts, news)]
+    done = eng.run()
+    paths = {}
+    for rid in rids:
+        cp = attribute(eng.telemetry.tracer, rid)
+        _assert_exact(cp)
+        paths[rid] = cp
+    rep = eng.telemetry.attribution_report()
+    assert rep["requests"] == len(rids)
+    assert rep["exact_requests"] == rep["requests"]
+    assert abs(sum(v["frac"] for v in rep["segments"].values()) - 1.0) < 0.02
+    return done, paths, rep
+
+
+# ---------------------------------------------------------------------------
+# synthetic drills (no jax, no engine)
+# ---------------------------------------------------------------------------
+class TestSyntheticAttribution:
+    def _tracer(self):
+        return Tracer(clock=lambda: 0.0)
+
+    def test_basic_decomposition_exact(self):
+        tr = self._tracer()
+        tr.request_event(1, "submitted", t=0.0)
+        tr.engine_span("sched", 1.0, 3.0)
+        tr.request_event(1, "admitted", t=2.0)
+        tr.engine_span("prefill_dense", 2.0, 2.8)
+        tr.request_event(1, "first_token", t=2.8)
+        tr.engine_span("decode_dispatch", 3.0, 3.5)
+        tr.engine_span("decode_sync", 3.5, 4.5)
+        tr.engine_span("decode_record", 4.5, 4.7)
+        tr.request_event(1, "retired", t=4.7, tokens=5)
+        cp = attribute(tr, 1)
+        _assert_exact(cp)
+        kinds = [k for k, *_ in cp.segments]
+        # queue wait (pre-admission) takes priority over the sched span
+        assert kinds == ["queue", "prefill_dense", "admission",
+                         "decode_dispatch", "decode_sync", "decode_record"]
+        t = cp.totals()
+        assert abs(t["queue"] - 2.0) < 1e-12
+        assert abs(t["decode_sync"] - 1.0) < 1e-12
+
+    def test_nested_prefill_inside_sched_innermost_wins(self):
+        tr = self._tracer()
+        tr.request_event(2, "submitted", t=0.0)
+        tr.request_event(2, "admitted", t=0.0)
+        tr.engine_span("sched", 0.0, 4.0)
+        tr.engine_span("prefill_chunk", 1.0, 2.0)
+        tr.engine_span("prefill_chunk", 2.5, 3.0)
+        tr.request_event(2, "retired", t=4.0, tokens=1)
+        cp = attribute(tr, 2)
+        _assert_exact(cp)
+        t = cp.totals()
+        assert abs(t["prefill_chunk"] - 1.5) < 1e-12
+        assert abs(t["admission"] - 2.5) < 1e-12
+
+    def test_preemption_requeue_bills_as_queue(self):
+        tr = self._tracer()
+        tr.request_event(3, "submitted", t=0.0)
+        tr.request_event(3, "admitted", t=0.5)
+        tr.engine_span("decode_dispatch", 0.5, 1.0)
+        tr.request_event(3, "preempted", t=1.0)
+        tr.engine_span("decode_dispatch", 1.0, 3.0)   # others decode
+        tr.request_event(3, "admitted", t=3.0)
+        tr.engine_span("decode_dispatch", 3.0, 3.5)
+        tr.request_event(3, "retired", t=3.5, tokens=2)
+        cp = attribute(tr, 3)
+        _assert_exact(cp)
+        t = cp.totals()
+        # the re-queue window bills as queue even while the engine decoded
+        # OTHER requests through it
+        assert abs(t["queue"] - 2.5) < 1e-12
+        assert abs(t["decode_dispatch"] - 1.0) < 1e-12
+
+    def test_verify_phases_collapse_and_overlap_aliases(self):
+        tr = self._tracer()
+        tr.request_event(4, "submitted", t=0.0)
+        tr.request_event(4, "admitted", t=0.0)
+        tr.engine_span("verify_dispatch", 0.0, 1.0)
+        tr.engine_span("verify_sync", 1.0, 1.5)
+        tr.engine_span("verify_record", 1.5, 2.0)
+        tr.engine_span("overlap_dispatch", 2.0, 2.5)
+        tr.engine_span("overlap_join_sync", 2.5, 3.0)
+        tr.engine_span("overlap_record", 3.0, 3.25)
+        tr.request_event(4, "retired", t=3.25, tokens=3)
+        cp = attribute(tr, 4)
+        _assert_exact(cp)
+        t = cp.totals()
+        assert abs(t["verify"] - 2.0) < 1e-12
+        assert abs(t["decode_dispatch"] - 0.5) < 1e-12
+        assert abs(t["decode_sync"] - 0.5) < 1e-12
+        assert abs(t["decode_record"] - 0.25) < 1e-12
+
+    def test_unknown_rid_raises(self):
+        with pytest.raises(KeyError):
+            attribute(self._tracer(), 404)
+
+    def test_enclosing_span_found_past_nested_one(self):
+        # a long sched span encloses a short prefill span that ENDS
+        # before the request's window starts: the window scan must walk
+        # back past the nested span to the enclosing one (prefix-max of
+        # span ends, not the immediately preceding span's end)
+        tr = self._tracer()
+        tr.engine_span("sched", 0.0, 10.0)
+        tr.engine_span("prefill_dense", 5.0, 5.1)
+        tr.request_event(8, "submitted", t=9.0)
+        tr.request_event(8, "admitted", t=9.0)
+        tr.request_event(8, "retired", t=10.0, tokens=1)
+        cp = attribute(tr, 8)
+        _assert_exact(cp)
+        assert cp.totals() == {"admission": pytest.approx(1.0)}
+
+    def test_report_filters_unretired(self):
+        tr = self._tracer()
+        tr.request_event(1, "submitted", t=0.0)
+        tr.request_event(1, "retired", t=1.0, tokens=1)
+        tr.request_event(2, "submitted", t=0.0)   # still live
+        rep = attribution_report(tr)
+        assert rep["requests"] == 1 and rep["exact_requests"] == 1
+
+    # -- stitched ----------------------------------------------------------
+    def _fleet_tracers(self, restored: bool):
+        router = Tracer(clock=lambda: 0.0)
+        r0 = Tracer(clock=lambda: 0.0)
+        r1 = Tracer(clock=lambda: 0.0)
+        tid = 77
+        router.request_event(0, "submitted", t=0.0, trace_id=tid)
+        router.request_event(0, "admitted", t=0.2, replica="r0")
+        r0.request_event(5, "submitted", t=0.2, trace_id=tid)
+        r0.request_event(5, "admitted", t=0.3)
+        r0.engine_span("prefill_dense", 0.3, 0.6)
+        r0.engine_span("decode_dispatch", 0.6, 1.0)
+        # the engine stamps a per-request decode_dispatch event at each
+        # dispatch (as the real telemetry does) — the residency window
+        # tracks the request's last touch
+        r0.request_event(5, "decode_dispatch", t=1.0, k=3)
+        # r0 crashes at t=1.0 (record frozen mid-flight, never retired)
+        attrs = {"trace_id": tid}
+        if restored:
+            attrs["restored"] = True
+        r1.request_event(9, "submitted", t=1.6, **attrs)
+        r1.request_event(9, "admitted", t=1.7)
+        r1.engine_span("decode_dispatch", 1.7, 2.2)
+        r1.request_event(9, "retired", t=2.2, tokens=4)
+        router.request_event(0, "retired", t=2.4, tokens=4)
+        return [("router", router), ("r0 (crashed#1)", r0), ("r1", r1)], tid
+
+    @pytest.mark.parametrize("restored", [False, True])
+    def test_stitched_gap_classification(self, restored):
+        comps, tid = self._fleet_tracers(restored)
+        cp = attribute_stitched(comps, tid)
+        _assert_exact(cp)
+        t = cp.totals()
+        gap_kind = "snapshot_restore" if restored else "migration"
+        # r0 end (1.0) -> r1 start (1.6) is the failover gap
+        assert abs(t[gap_kind] - 0.6) < 1e-12
+        # queue = router placement wait (0.0-0.2) + r0 pre-admission
+        # (0.2-0.3) + r1 re-admission (1.6-1.7); the router tail
+        # (2.2 -> 2.4, heartbeat observing retirement) is host_other
+        assert abs(t["queue"] - 0.4) < 1e-12
+        assert abs(t["host_other"] - 0.2) < 1e-12
+        rep = stitched_attribution_report(comps)
+        assert rep["requests"] == 1 and rep["exact_requests"] == 1
+
+    def test_stitched_zombie_cancel_does_not_reopen_window(self):
+        comps, tid = self._fleet_tracers(False)
+        # a snapshot-restored zombie copy, pruned via cancel AFTER the
+        # router already resolved the request
+        zombie = Tracer(clock=lambda: 0.0)
+        zombie.request_event(5, "submitted", t=5.0, trace_id=tid,
+                             restored=True)
+        zombie.request_event(5, "retired", t=5.1, cancelled=True)
+        cp = attribute_stitched(comps + [("r0'", zombie)], tid)
+        _assert_exact(cp)
+        # clamped at the REAL retirement (router t=2.4), not the zombie
+        assert cp.t1 == 2.4
+
+    def test_stitched_unknown_trace_id_is_none(self):
+        comps, _tid = self._fleet_tracers(False)
+        assert attribute_stitched(comps, 123456) is None
+
+
+# ---------------------------------------------------------------------------
+# TailRecorder
+# ---------------------------------------------------------------------------
+class TestTailRecorder:
+    def _trace(self, tr, rid, t0, t1):
+        tr.request_event(rid, "submitted", t=t0)
+        tr.request_event(rid, "admitted", t=t0)
+        tr.request_event(rid, "retired", t=t1, tokens=1)
+        return tr.get(rid)
+
+    def test_topk_bounded_and_ordered(self):
+        tr = Tracer(clock=lambda: 0.0)
+        rec = TailRecorder(k=3, clock=lambda: 9.0)
+        for rid, e2e in enumerate([0.5, 2.0, 0.1, 3.0, 1.0, 0.2]):
+            trace = self._trace(tr, rid, 0.0, e2e)
+            rec.offer({"rid": rid, "e2e_s": e2e}, trace, tr,
+                      context={"queue_depth": rid})
+        assert len(rec) == 3 and rec.offered == 6
+        ds = rec.dumps()
+        assert [d["e2e_s"] for d in ds] == [3.0, 2.0, 1.0]
+        assert [d["rid"] for d in ds] == [3, 1, 4]
+        d = ds[0]
+        assert d["reason"] == "slow_request"
+        assert d["attribution"]["exact"] is True
+        assert d["context"] == {"queue_depth": 3}
+        assert d["events"][0]["event"] == "submitted"
+        rep = rec.report()
+        assert rep["captured"] == 3 and rep["slowest_e2e_s"] == 3.0
+
+    def test_fast_requests_skip_without_attribution(self):
+        tr = Tracer(clock=lambda: 0.0)
+        rec = TailRecorder(k=1, clock=lambda: 0.0)
+        rec.offer({"e2e_s": 5.0}, self._trace(tr, 0, 0.0, 5.0), tr)
+        assert rec.offer({"e2e_s": 0.1},
+                         self._trace(tr, 1, 0.0, 0.1), tr) is None
+        assert rec.report()["rids"] == [0]
+
+    def test_reset_clears(self):
+        tr = Tracer(clock=lambda: 0.0)
+        rec = TailRecorder(k=2)
+        rec.offer({"e2e_s": 1.0}, self._trace(tr, 0, 0.0, 1.0), tr)
+        rec.reset()
+        assert len(rec) == 0 and rec.offered == 0
+
+    def test_merge_tail_dumps(self):
+        tr = Tracer(clock=lambda: 0.0)
+        a, b = TailRecorder(k=2), TailRecorder(k=2)
+        a.offer({"e2e_s": 1.0}, self._trace(tr, 0, 0.0, 1.0), tr)
+        b.offer({"e2e_s": 2.0}, self._trace(tr, 1, 0.0, 2.0), tr)
+        merged = merge_tail_dumps([("r0", a), ("r1", b)], k=2)
+        assert [d["component"] for d in merged] == ["r1", "r0"]
+        assert merged[0]["e2e_s"] == 2.0
+
+
+# ---------------------------------------------------------------------------
+# real-engine feature matrix (exactness on live traces)
+# ---------------------------------------------------------------------------
+class TestEngineAttribution:
+    def test_default_dense_prefill(self):
+        _done, paths, rep = _run_and_check(_mk())
+        assert "prefill_dense" in rep["segments"]
+        assert "decode_dispatch" in rep["segments"]
+        # retirement-ordered summaries + tail capture rode along
+        assert rep["requests"] == len(_PROMPTS)
+
+    def test_chunked_prefill(self):
+        eng = _mk(prefill_chunk=8)
+        # prompts longer than one chunk so the chunked path actually runs
+        prompts = [rng.integers(1, 64, (t,)).astype(np.int32)
+                   for t in (17, 21)]
+        _done, paths, rep = _run_and_check(eng, prompts=prompts,
+                                           news=[6, 5])
+        assert "prefill_chunk" in rep["segments"]
+
+    def test_speculative_k4(self):
+        eng = _mk(params=_echo_params(), speculative=4)
+        _done, paths, rep = _run_and_check(eng)
+        assert eng.verify_steps > 0
+        assert "verify" in rep["segments"]
+
+    def test_overlap_on(self):
+        eng = _mk(overlap=True)
+        _done, paths, rep = _run_and_check(eng)
+        assert eng.overlap_steps > 0
+
+    def test_preemption(self):
+        # a pool too small for both long requests: the ladder preempts,
+        # the victim re-queues and re-prefills — attribution must stay
+        # exact and the victim's requeue window must bill as queue
+        eng = _mk(num_pages=6, prefix_cache=False)
+        prompts = [rng.integers(1, 64, (6,)).astype(np.int32)
+                   for _ in range(3)]
+        _done, paths, rep = _run_and_check(eng, prompts=prompts,
+                                           news=[10, 10, 10])
+        assert eng.preemptions > 0
+        assert any(tr.names().count("admitted") > 1
+                   for tr in eng.telemetry.tracer.traces())
+
+    @pytest.mark.slow
+    def test_overlap_chunked_spec_intersection(self):
+        eng = _mk(params=_echo_params(), overlap=True, prefill_chunk=8,
+                  speculative=4)
+        _done, paths, rep = _run_and_check(eng)
+        assert rep["exact_requests"] == rep["requests"]
+
+    def test_cancel_terminates_trace_record(self):
+        # ISSUE 13 fix: a cancelled request must move to the completed
+        # ring (terminal retired(cancelled)) — not ghost in Tracer._live
+        eng = _mk()
+        rid0 = eng.submit(_PROMPTS[0], max_new_tokens=8)
+        rid1 = eng.submit(_PROMPTS[1], max_new_tokens=8)
+        eng.step()
+        assert eng.cancel(rid0)
+        assert rid0 not in eng.telemetry.tracer._live
+        tr = eng.telemetry.tracer.get(rid0)
+        assert tr.events[-1][0] == "retired" \
+            and tr.events[-1][2]["cancelled"] is True
+        cp = attribute_trace(tr, eng.telemetry.tracer)
+        _assert_exact(cp)
+        eng.run()
+        assert eng.lookup(rid1).finish_time
+        # cancel of an ALREADY-RETIRED request must not mint a ghost
+        # duplicate record (its trace terminated at retirement): one
+        # record per rid, attribution census unchanged
+        n_before = len(eng.telemetry.tracer.traces())
+        assert eng.cancel(rid1)          # pops the finished record only
+        traces = eng.telemetry.tracer.traces()
+        assert len(traces) == n_before
+        assert sum(1 for t in traces if t.rid == rid1) == 1
+        assert traces[-1] is not None

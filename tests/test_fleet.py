@@ -501,6 +501,21 @@ class TestReplicaFleet:
         # every fleet request carries a trace_id end to end
         assert all(fr.trace_id is not None
                    for fr in fleet._requests.values())
+        # --- ISSUE 13: stitched critical-path attribution — EVERY end-to-
+        # end request (the crashed/migrated ones included) decomposes into
+        # exact disjoint segments summing to its traced e2e, and the
+        # failover gap itself is attributed (migration / snapshot_restore)
+        attr = fleet.attribution_report()
+        assert attr["requests"] == len(rids)
+        assert attr["exact_requests"] == attr["requests"], attr
+        assert "migration" in attr["segments"] \
+            or "snapshot_restore" in attr["segments"], attr["segments"]
+        # fleet tail forensics: slowest requests captured across replicas
+        slow = fleet.slow_requests()
+        assert slow and slow[0]["attribution"]["exact"] is True
+        # the alerts aggregation rides the stats snapshot (sentinel-less
+        # replicas -> empty components, status ok)
+        assert snap["alerts"]["status"] == "ok"
 
     def test_rejected_submit_leaves_no_tracer_ghost(self):
         """A submit that raises at placement (can-never-fit prompt) or at
@@ -820,6 +835,17 @@ def test_check_obs_failover_validator_pos_neg():
                      "max_chain": ["router", "r0 (crashed#1)", "r1"]},
         "failover_dump": {"reason": "failover", "routing_decisions": 4,
                           "replica_ring_events": 9},
+        # ISSUE 13: critical-path attribution + health-sentinel sections
+        "attribution": {
+            "requests": 4, "exact_requests": 4, "e2e_s_total": 2.0,
+            "segments": {"queue": {"total_s": 0.5, "frac": 0.25},
+                         "decode_sync": {"total_s": 1.0, "frac": 0.5},
+                         "migration": {"total_s": 0.5, "frac": 0.25}},
+            "decode_sync_frac": 0.5,
+            "slowest": [{"key": 1, "e2e_s": 0.9}]},
+        "alerts": {"status": "ok", "active_alerts": 0, "fired_total": 1,
+                   "components": {"r0": {"fired_total": 1},
+                                  "r1": {"fired_total": 0}}},
         "slo_report": {
             "requests": 4, "ttft_deadline_ms": 2000.0,
             "goodput_fraction": 1.0, "on_time_requests": 4,
@@ -857,3 +883,13 @@ def test_check_obs_failover_validator_pos_neg():
     bad = dict(art, failover_dump=dict(art["failover_dump"],
                                        routing_decisions=0))
     assert any("routing" in p for p in validate_artifact(bad, "failover"))
+    # ISSUE 13 negatives: inexact attribution, lost sections, sentinel-off
+    bad = dict(art, attribution=dict(art["attribution"], exact_requests=2))
+    assert any("exact" in p for p in validate_artifact(bad, "failover"))
+    bad = {k: v for k, v in art.items() if k != "attribution"}
+    assert any("attribution" in p for p in validate_artifact(bad,
+                                                             "failover"))
+    bad = dict(art, alerts=dict(art["alerts"], components={}))
+    assert any("sentinel" in p for p in validate_artifact(bad, "failover"))
+    bad = {k: v for k, v in art.items() if k != "alerts"}
+    assert any("alerts" in p for p in validate_artifact(bad, "failover"))

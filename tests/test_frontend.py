@@ -505,6 +505,18 @@ def _frontend_art():
         "outputs_bit_exact": True,
         "leaked_pages": 0,
         "host_cpu_count": 8,
+        # ISSUE 13: critical-path attribution + health-sentinel sections
+        "attribution": {
+            "requests": 10, "exact_requests": 10, "e2e_s_total": 4.0,
+            "segments": {"queue": {"total_s": 1.0, "frac": 0.25},
+                         "decode_sync": {"total_s": 2.0, "frac": 0.5},
+                         "admission": {"total_s": 1.0, "frac": 0.25}},
+            "decode_sync_frac": 0.5,
+            "slowest": [{"key": 3, "e2e_s": 0.8}]},
+        "tail": {"k": 8, "captured": 8, "offered": 10,
+                 "slowest_e2e_s": 0.8, "rids": [3]},
+        "alerts": {"status": "ok", "active_alerts": 0, "fired_total": 2,
+                   "components": {"engine": {"fired_total": 2}}},
         # ISSUE 12: FleetTelemetry aggregation over engine + frontend
         "fleet": {"replicas": ["engine", "frontend"],
                   "merged": {"serve.ttft_s": dict(hist),
@@ -558,3 +570,17 @@ def test_check_obs_frontend_validator_pos_neg():
     bad["fleet"]["per_replica"] = {"frontend": {"frontend.offered": 10}}
     assert any("mem.pool_occupancy_frac" in p
                for p in validate_artifact(bad, "frontend"))
+    # ISSUE 13 negatives: inexact attribution / missing sentinel sections
+    bad = _frontend_art()
+    bad["attribution"]["exact_requests"] = 7
+    assert any("exact" in p for p in validate_artifact(bad, "frontend"))
+    bad = _frontend_art()
+    del bad["attribution"]
+    assert any("attribution" in p
+               for p in validate_artifact(bad, "frontend"))
+    bad = _frontend_art()
+    bad["alerts"]["components"] = {}
+    assert any("sentinel" in p for p in validate_artifact(bad, "frontend"))
+    bad = _frontend_art()
+    bad["attribution"]["segments"] = {}
+    assert any("segments" in p for p in validate_artifact(bad, "frontend"))
