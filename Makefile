@@ -65,6 +65,7 @@ OBS_ARTIFACT ?= /tmp/_obs_serving.json
 OBS_FRONTEND_ARTIFACT ?= /tmp/_obs_frontend.json
 OBS_FAILOVER_ARTIFACT ?= /tmp/_obs_failover.json
 OBS_FAILOVER_PERFETTO ?= /tmp/_obs_failover_perfetto.json
+OBS_ELASTIC_ARTIFACT ?= /tmp/_obs_elastic.json
 
 # obs-check additionally runs the ISSUE 11 frontend trace (AsyncFrontend
 # bit-equality + zero-leak asserts, predictive-vs-depth admission A/B on
@@ -83,6 +84,12 @@ OBS_FAILOVER_PERFETTO ?= /tmp/_obs_failover_perfetto.json
 # report); the overhead gate's ON arm runs stitching + fleet
 # aggregation + memory sampling + the health sentinel + tail capture +
 # a live exporter scrape + the attribution report (<3% bar).
+# Since ISSUE 14 it also runs the elastic trace (sentinel-driven
+# autoscaling + prefix-affinity routing on a virtual-clock diurnal
+# replay): zero-loss + bit-equal asserted across every scale event,
+# elastic >= every fixed-N arm on goodput-per-replica-hour, and the
+# affinity fleet's hit rate >= 0.9x the single engine's — all
+# deterministic (perf/check_obs.py --trace elastic).
 obs-check:
 	set -o pipefail; \
 	env JAX_PLATFORMS=cpu $(PY) bench.py --trace serving \
@@ -97,7 +104,11 @@ obs-check:
 		--json $(OBS_FAILOVER_ARTIFACT) \
 		--perfetto $(OBS_FAILOVER_PERFETTO) && \
 	env JAX_PLATFORMS=cpu $(PY) perf/check_obs.py \
-		--artifact $(OBS_FAILOVER_ARTIFACT) --trace failover
+		--artifact $(OBS_FAILOVER_ARTIFACT) --trace failover && \
+	env JAX_PLATFORMS=cpu $(PY) bench.py --trace elastic \
+		--json $(OBS_ELASTIC_ARTIFACT) && \
+	env JAX_PLATFORMS=cpu $(PY) perf/check_obs.py \
+		--artifact $(OBS_ELASTIC_ARTIFACT) --trace elastic
 
 lint:
 	$(GRAFTLINT) --fail-on-stale $(if $(DIFF),--diff $(DIFF))

@@ -245,6 +245,39 @@ class PagePool:
 _ROOT = b"\x00root"                   # parent digest of block 0
 
 
+def _chain_digest(parent: bytes, block) -> bytes:
+    """One link of the chained block hash: ``sha256(parent + tokens)``.
+    THE block-hash implementation — :class:`PrefixCache` indexing and the
+    fleet's prefix-affinity router both route through here, so a
+    router-side chain computed from a prompt is bit-identical to the
+    cache-side chain the serving replica indexed."""
+    return hashlib.sha256(
+        parent + np.ascontiguousarray(block, np.int32).tobytes()).digest()
+
+
+def prefix_chain_hashes(tokens, page_size: int) -> list[bytes]:
+    """Chained SHA-256 block-hash digests of every full ``page_size``-
+    aligned block of ``tokens``, in chain order (digest i identifies the
+    WHOLE prefix through block i, exactly as :class:`PrefixCache` indexes
+    it).  The trailing partial block is not hashed — partial tails are
+    keyed by exact content, not by chain digest.
+
+    This is the public seam between the cache and the fleet router
+    (serving/routing.py): both sides MUST produce identical chains, so
+    the affinity lookup finds the replica that actually holds the KV.
+    Note :meth:`PrefixCache.lookup` caps its match at ``len(tokens) - 1``
+    (one suffix token must remain to prefill); a router mirroring the
+    attach behavior passes ``tokens[:-1]``."""
+    tokens = np.asarray(tokens, np.int32).reshape(-1)
+    ps = int(page_size)
+    parent = _ROOT
+    out: list[bytes] = []
+    for i in range(len(tokens) // ps):
+        parent = _chain_digest(parent, tokens[i * ps:(i + 1) * ps])
+        out.append(parent)
+    return out
+
+
 class _CacheEntry:
     __slots__ = ("key", "parent", "page", "tokens", "tick", "children")
 
@@ -288,6 +321,12 @@ class PrefixCache:
         self._tick = 0
         self.insertions = 0
         self.evictions = 0
+        # optional ``notify(kind, digests)`` listener (kind "insert" |
+        # "evict", digests = full-block chain digests): the fleet router
+        # keeps its per-replica cached-chain summary current through this
+        # hook.  Partial tails are content-keyed, not chain-keyed, so
+        # they never notify — the summary tracks full blocks only.
+        self.notify = None
 
     def __len__(self) -> int:
         return len(self._full) + sum(len(d) for d in self._partial.values())
@@ -305,8 +344,13 @@ class PrefixCache:
         e.tick = self._tick
 
     def _digest(self, parent: bytes, block) -> bytes:
-        return hashlib.sha256(
-            parent + np.ascontiguousarray(block, np.int32).tobytes()).digest()
+        return _chain_digest(parent, block)
+
+    def chain_digests(self):
+        """Every FULL-block chain digest currently indexed (the router-
+        summary seed for a replica whose cache was built before the
+        listener attached — e.g. a snapshot-restored engine)."""
+        return self._full.keys()
 
     # -- lookup / attach ---------------------------------------------------
     def lookup(self, tokens):
@@ -364,6 +408,7 @@ class PrefixCache:
         ps = self.page_size
         parent = _ROOT
         n_full = len(tokens) // ps
+        inserted: list[bytes] = []
         for i in range(n_full):
             key = self._digest(parent, tokens[i * ps:(i + 1) * ps])
             e = self._full.get(key)
@@ -374,8 +419,11 @@ class PrefixCache:
                 if parent in self._full:
                     self._full[parent].children += 1
                 self.insertions += 1
+                inserted.append(key)
             self._touch(e)
             parent = key
+        if inserted and self.notify is not None:
+            self.notify("insert", inserted)
         if with_partial:
             tail = np.ascontiguousarray(tokens[n_full * ps:], np.int32)
             if len(tail) and n_full < len(pages):
@@ -422,6 +470,8 @@ class PrefixCache:
     def _drop(self, e: _CacheEntry):
         if e.tokens is None:
             del self._full[e.key]
+            if self.notify is not None:
+                self.notify("evict", [e.key])
         else:
             tails = self._partial[e.parent]
             del tails[e.tokens]

@@ -35,7 +35,7 @@ from .export import (MetricsExporter, export_snapshot, render_json,
 from .flight import FlightRecorder
 from .health import (Alert, AlertRule, BurnRateRule, DeltaRule,
                      HealthSentinel, RatioDeltaRule, TrendRule,
-                     aggregate_alerts, default_rules)
+                     aggregate_alerts, autoscale_rules, default_rules)
 from .metrics import (Counter, EngineStats, Gauge, GaugeSeries, Histogram,
                       MetricsRegistry)
 from .slo import burn_rate, latency_percentiles, slo_report, windowed_burn
@@ -57,4 +57,5 @@ __all__ = ["Counter", "Gauge", "GaugeSeries", "Histogram", "MetricsRegistry",
            "TailRecorder", "merge_tail_dumps",
            "Alert", "AlertRule", "TrendRule", "DeltaRule", "RatioDeltaRule",
            "BurnRateRule", "HealthSentinel", "default_rules",
-           "aggregate_alerts", "burn_rate", "windowed_burn"]
+           "autoscale_rules", "aggregate_alerts", "burn_rate",
+           "windowed_burn"]

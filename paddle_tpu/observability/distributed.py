@@ -219,6 +219,11 @@ class FleetTelemetry:
         every live telemetry-bearing replica plus the fleet's own router
         registry (label ``router``)."""
         comps: dict = {}
+        # drain-retired replicas (elastic scale-down) keep their final
+        # registries on the fleet: their service life stays in the merged
+        # fleet quantiles and the per-replica hit-rate series
+        for name, reg in getattr(fleet, "_retired_telemetry", ()):
+            comps[f"{name} (retired)"] = reg
         for rep in fleet._replicas:
             if rep.alive and rep.engine is not None \
                     and rep.engine.telemetry is not None:

@@ -246,6 +246,45 @@ class _Handler(BaseHTTPRequestHandler):
                                                  f"{exc}"}),
                        "application/json")
 
+    def do_POST(self):  # noqa: N802 — http.server API
+        """``POST /generate`` -> Server-Sent-Events token stream (the
+        real-socket serving transport over :class:`~paddle_tpu.serving.
+        frontend.AsyncFrontend`).  ``generate_fn(payload)`` yields
+        SSE-framed strings; a client disconnect mid-stream surfaces here
+        as a broken pipe, and CLOSING the generator is the cancel signal
+        (its ``finally`` abandons the stream -> ``engine.cancel`` frees
+        the pages mid-decode)."""
+        ex = self.server.exporter
+        path = self.path.split("?", 1)[0]
+        if path != "/generate" or ex.generate_fn is None:
+            self._send(404, json.dumps({"error": "unknown path"}),
+                       "application/json")
+            return
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+            payload = json.loads(self.rfile.read(length) or b"{}")
+        except (ValueError, TypeError) as exc:
+            self._send(400, json.dumps({"error": f"bad request body: "
+                                                 f"{exc}"}),
+                       "application/json")
+            return
+        gen = ex.generate_fn(payload)
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.send_header("Connection", "close")
+        self.end_headers()
+        try:
+            for chunk in gen:
+                self.wfile.write(chunk.encode("utf-8"))
+                self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            # client went away mid-stream — closing the generator runs
+            # its finally block, which cancels the live request
+            pass
+        finally:
+            gen.close()
+
 
 class MetricsExporter:
     """``/metrics`` + ``/healthz`` + ``/requests`` on a daemon thread.
@@ -259,13 +298,14 @@ class MetricsExporter:
     a routable interface."""
 
     def __init__(self, snapshot_fn, requests_fn=None, health_fn=None,
-                 alerts_fn=None, slow_fn=None,
+                 alerts_fn=None, slow_fn=None, generate_fn=None,
                  host: str = "127.0.0.1", port: int = 0):
         self.snapshot_fn = snapshot_fn
         self.requests_fn = requests_fn
         self.health_fn = health_fn
         self.alerts_fn = alerts_fn      # /alerts: the health-sentinel report
         self.slow_fn = slow_fn          # /slow: tail-outlier dumps
+        self.generate_fn = generate_fn  # POST /generate: SSE token stream
         self.host = host
         self._requested_port = int(port)
         self.scrapes = 0

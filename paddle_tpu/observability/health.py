@@ -47,7 +47,7 @@ from .slo import windowed_burn
 
 __all__ = ["Alert", "AlertRule", "TrendRule", "DeltaRule", "RatioDeltaRule",
            "BurnRateRule", "HealthSentinel", "default_rules",
-           "aggregate_alerts"]
+           "autoscale_rules", "aggregate_alerts"]
 
 
 @dataclass
@@ -408,6 +408,52 @@ def default_rules(*, slo_ttft_s: float | None = None,
                         "above the bound — the controller's model of the "
                         "engine has rotted"))
     return rules
+
+
+def autoscale_rules(*, depth_fn, load_fn,
+                    queue_growth: float = 4.0,
+                    queue_min_depth: float = 3.0,
+                    growth_window_s: float = 6.0,
+                    growth_fire_frac: float = 0.5,
+                    idle_per_replica: float = 0.5,
+                    idle_window_s: float = 10.0,
+                    min_samples: int = 3,
+                    cooldown_s: float = 0.0) -> list:
+    """The elastic-fleet autoscaler's rule pair (ROADMAP item 5): the
+    same :func:`default_rules` ``queue_growth`` TrendRule shape — here
+    over the FLEET-wide queue pressure ``depth_fn(ctx)`` — as the
+    scale-UP trigger, plus ``fleet_idle`` (windowed per-routable-replica
+    load ``load_fn(ctx)`` sustained below ``idle_per_replica``) as the
+    scale-DOWN trigger.  Both run inside an ordinary
+    :class:`HealthSentinel` under its injectable clock, so seeded
+    traffic drives scaling decisions deterministically
+    (serving/autoscale.py wires a round-based virtual clock by
+    default)."""
+    return [
+        TrendRule(
+            "queue_growth",
+            raw_fn=depth_fn,
+            threshold=queue_growth, min_value=queue_min_depth,
+            window_s=growth_window_s, min_samples=min_samples,
+            fire_frac=growth_fire_frac,
+            # clear once the whole window stops growing (readings < 1) —
+            # a drained-flat queue reads growth 0.0, which must clear the
+            # alert, not hold it active into the next trough
+            clear_threshold=1.0,
+            cooldown_s=cooldown_s,
+            description="fleet-wide admission-queue pressure grew by >= "
+                        "threshold over the window and sits above the "
+                        "min depth — the elastic scale-up trigger"),
+        AlertRule(
+            "fleet_idle",
+            sample_fn=load_fn,
+            threshold=idle_per_replica, direction="below",
+            window_s=idle_window_s, min_samples=min_samples,
+            fire_frac=1.0, cooldown_s=cooldown_s,
+            description="per-routable-replica load sustained below the "
+                        "idle floor for the whole window — the elastic "
+                        "scale-down (drain) trigger"),
+    ]
 
 
 # ---------------------------------------------------------------------------

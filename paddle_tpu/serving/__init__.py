@@ -20,20 +20,35 @@ replica-fleet router, and the async front end + traffic harness.
   prediction error itself tracked (``frontend.ttft_pred_err_s``).
 * :mod:`.traffic` — seeded, replayable scenario generators (Poisson
   bursty + diurnal arrivals, shared-prefix user fleets, mixed
-  greedy/sampled/long-context, streaming-abandon clients) plus engine
-  and virtual-clock replays reporting goodput-under-SLO.
+  greedy/sampled/long-context, streaming-abandon clients) plus engine,
+  fleet, and virtual-clock replays reporting goodput-under-SLO.
+* :mod:`.routing` + :mod:`.autoscale` — the elastic control plane
+  (ROADMAP item 5): pluggable placement strategies
+  (:class:`LeastLoadedRouter`, :class:`PrefixAffinityRouter` — route
+  shared-prefix users to the replica already holding their KV via the
+  cache's own chained block-hash, under a bounded-imbalance guard) and
+  :class:`ElasticFleet` — sentinel-driven replica autoscaling
+  (:class:`AutoscalePolicy` GROW on sustained queue growth / SLO burn,
+  SHRINK on sustained idle) with zero-loss, greedy-bit-exact drain
+  through the live-migration path.
 """
+from .autoscale import AutoscaleDecision, AutoscalePolicy, ElasticFleet
 from .fleet import FleetFailedError, ReplicaFleet
 from .frontend import (AdmissionController, AdmissionView, AsyncFrontend,
                        AsyncStream, SLORejected, TTFTPredictor,
                        admission_view)
+from .routing import (LeastLoadedRouter, PrefixAffinityRouter, Router,
+                      RoutingDecision)
 from .snapshot import EngineSnapshotManager, load_engine_snapshot
-from .traffic import (ClientRequest, Scenario, goodput_report,
-                      make_scenario, replay_engine, replay_sim)
+from .traffic import (ClientRequest, Scenario, VirtualClock,
+                      goodput_report, make_scenario, replay_engine,
+                      replay_fleet, replay_sim)
 
 __all__ = ["ReplicaFleet", "FleetFailedError", "EngineSnapshotManager",
            "load_engine_snapshot", "AsyncFrontend", "AsyncStream",
            "SLORejected", "AdmissionController", "AdmissionView",
            "TTFTPredictor", "admission_view", "ClientRequest", "Scenario",
-           "make_scenario", "replay_engine", "replay_sim",
-           "goodput_report"]
+           "make_scenario", "replay_engine", "replay_fleet", "replay_sim",
+           "goodput_report", "VirtualClock", "Router", "RoutingDecision",
+           "LeastLoadedRouter", "PrefixAffinityRouter", "AutoscalePolicy",
+           "AutoscaleDecision", "ElasticFleet"]

@@ -51,6 +51,7 @@ from ..observability.metrics import MetricsRegistry
 from ..observability.slo import slo_report
 from ..observability.tracing import Tracer
 from ..observability.train import fault_context
+from .routing import LeastLoadedRouter, Router
 from .snapshot import EngineSnapshotManager
 
 __all__ = ["ReplicaFleet", "FleetFailedError"]
@@ -80,7 +81,9 @@ class _FleetRequest:
                                     #   extends, so a failover re-decode
                                     #   never double-emits
     result: Request | None = None
-    first_token_t: float = 0.0
+    # None until the first token streams: a 0.0 sentinel would collide
+    # with a VIRTUAL clock legitimately reading t=0.0 in the first round
+    first_token_t: float | None = None
     finish_t: float = 0.0
     retries: int = 0
     next_try_round: int = 0
@@ -89,18 +92,33 @@ class _FleetRequest:
                                    #   every engine-side adopt() so one
                                    #   Perfetto view binds the request's
                                    #   spans across replicas + failovers
+    route_memo: dict = field(default_factory=dict)
+                                   # per-placement-state routing scratch:
+                                   #   the concatenated token stream and
+                                   #   the router's chain digests, keyed
+                                   #   by streamed length — a backoff
+                                   #   retry must not re-hash an
+                                   #   unchanged prompt every round
 
 
 class _Replica:
-    __slots__ = ("name", "engine", "alive", "stall", "failures", "snapshots")
+    __slots__ = ("name", "engine", "alive", "routable", "stall", "failures",
+                 "snapshots")
 
     def __init__(self, name, engine, snapshots):
         self.name = name
         self.engine = engine
         self.alive = True
+        self.routable = True      # False while drain-retiring (scale-down)
         self.stall = 0            # consecutive no-progress steps w/ work
         self.failures = 0         # failovers consumed
         self.snapshots = snapshots
+
+    def load(self) -> int:
+        """Active + queued requests — THE per-replica load notion,
+        shared by router placement, the autoscaler's idle detector, and
+        drain-victim selection (one definition, three consumers)."""
+        return self.engine.num_active + len(self.engine._queue)
 
 
 class _SnapTel:
@@ -134,6 +152,7 @@ class ReplicaFleet:
     greedy-bit-exact, just a cold KV start for the migrated requests."""
 
     def __init__(self, engine_factory, num_replicas: int = 2, *,
+                 router: Router | None = None,
                  snapshot_root: str | None = None,
                  snapshot_every: int | None = None,
                  snapshot_mode: str = "full_kv",
@@ -150,6 +169,7 @@ class ReplicaFleet:
             raise ValueError("num_replicas must be >= 1")
         self._factory = engine_factory
         self._clock = clock
+        self.router = router if router is not None else LeastLoadedRouter()
         self.snapshot_root = snapshot_root
         self.snapshot_every = snapshot_every
         self.snapshot_mode = snapshot_mode
@@ -166,6 +186,11 @@ class ReplicaFleet:
         self._c_submitted = self.metrics.counter("fleet.requests_submitted")
         self._c_resolved = self.metrics.counter("fleet.requests_resolved")
         self._c_torn = self.metrics.counter("fleet.torn_snapshots")
+        # elastic control plane (ROADMAP item 5): replica add/remove and
+        # drain-migration accounting — fixed fleets report honest zeros
+        self._c_scale_up = self.metrics.counter("fleet.scale_up")
+        self._c_scale_down = self.metrics.counter("fleet.scale_down")
+        self._c_drain_migr = self.metrics.counter("fleet.drain_migrations")
         self._h_recovery = self.metrics.histogram("fleet.recovery_s")
         self.flight = FlightRecorder(capacity=flight_capacity, clock=clock)
         # the ROUTER track of the stitched fleet trace: one request record
@@ -183,13 +208,23 @@ class ReplicaFleet:
         self._summaries: list[dict] = []
         self._next_frid = 0
         self._round = 0
+        # router-observed token counter (one inc per streamed token — a
+        # migrated engine's re-decode of already-streamed tokens does NOT
+        # advance it) and replica-time accounting (integral of live
+        # replica count over fleet heartbeats: the goodput-per-replica-
+        # hour denominator)
+        self.tokens_streamed = 0
+        self.replica_seconds = 0.0
+        self._last_tick: float | None = None
+        # retired (drained) replicas: tracer rides _dead_tracers for the
+        # stitched view; telemetry + final counters stay readable so the
+        # fleet-wide hit-rate accounting covers their whole service life
+        self._retired_telemetry: list[tuple[str, object]] = []
+        self._retired_stats: list[tuple[str, dict]] = []
         self._replicas: list[_Replica] = []
-        for i in range(int(num_replicas)):
-            name = f"r{i}"
-            self._replicas.append(
-                _Replica(name, self._new_engine(name),
-                         self._snapshot_manager(name)))
-            self._assigned[name] = set()
+        self._next_replica_idx = 0
+        for _ in range(int(num_replicas)):
+            self._spawn_replica()
 
     # -- construction helpers ----------------------------------------------
     def _new_engine(self, name: str) -> ServingEngine:
@@ -206,6 +241,137 @@ class ReplicaFleet:
             os.path.join(self.snapshot_root, name),
             keep_last=self.snapshot_keep_last,
             telemetry=_SnapTel(self, name))
+
+    def _spawn_replica(self) -> _Replica:
+        """Build + register one replica under the next monotonic name
+        (names are never reused — a retired r1's tracer track and a later
+        r3 can coexist in one stitched view)."""
+        name = f"r{self._next_replica_idx}"
+        self._next_replica_idx += 1
+        rep = _Replica(name, self._new_engine(name),
+                       self._snapshot_manager(name))
+        self._replicas.append(rep)
+        self._assigned[name] = set()
+        self._wire_router(rep)
+        return rep
+
+    def _wire_router(self, rep: _Replica):
+        """Register a (new or revived) replica with the routing strategy
+        and keep its cached-chain summary current: seed from whatever the
+        engine's prefix cache already indexes (a snapshot-restored engine
+        arrives warm), then subscribe to insert/evict notifications."""
+        eng = rep.engine
+        self.router.configure(page_size=eng.page_size)
+        self.router.on_replica_added(rep.name)
+        if eng.cache is not None:
+            name = rep.name
+
+            def _notify(kind, digests, _name=name):
+                if kind == "insert":
+                    self.router.note_cached(_name, digests)
+                else:
+                    self.router.note_evicted(_name, digests)
+
+            eng.cache.notify = _notify
+            existing = list(eng.cache.chain_digests())
+            if existing:
+                self.router.note_cached(name, existing)
+
+    # -- elastic control plane (ROADMAP item 5) ----------------------------
+    def add_replica(self) -> str:
+        """Scale up: spawn one fresh replica at runtime (the autoscaler's
+        grow action).  Returns the new replica's name; it is routable
+        immediately."""
+        rep = self._spawn_replica()
+        self._c_scale_up.inc()
+        self.flight.record("scale_up", replica=rep.name,
+                           replicas=len(self._alive()))
+        self.tracer.engine_event("scale_up", replica=rep.name)
+        return rep.name
+
+    def retire_replica(self, name: str) -> bool:
+        """Scale down with ZERO request loss: mark the replica
+        unroutable, live-migrate every in-flight request it carries to a
+        surviving replica (engine-side ``cancel`` parks the written KV
+        and quiesces any in-flight dispatch at an exact host state, then
+        the router's authoritative record re-places via ``adopt`` — the
+        streamed-token re-prefill path, greedy-bit-exact by the PR 9
+        guarantee), then destroy the empty engine.  Returns True only
+        when the replica was ACTUALLY retired: False for unknown/dead
+        replicas, when it would drain the last live one, and when the
+        target CRASHES mid-drain — that case falls through to the
+        normal failover path (the requests still migrate, still
+        zero-loss, and the replica is revived instead of retired, so no
+        scale-down happened)."""
+        rep = next((r for r in self._replicas
+                    if r.name == name and r.alive), None)
+        if rep is None or len(self._alive()) <= 1:
+            return False
+        rep.routable = False
+        outstanding = [self._requests[f]
+                       for f in sorted(self._assigned[name])]
+        self.flight.record("drain_begin", replica=name,
+                           inflight=len(outstanding))
+        self.tracer.engine_event("drain", replica=name,
+                                 inflight=len(outstanding))
+        for fr in outstanding:
+            rid = fr.handle.rid if fr.handle is not None else None
+            try:
+                if rid is not None:
+                    rep.engine.cancel(rid)
+            except Exception as exc:  # noqa: BLE001 — the drain target
+                # died mid-migration: the failover path WINS (it migrates
+                # every outstanding request, this one included) and the
+                # replica is revived instead of retired — still
+                # zero-loss, but NOT a scale-down (the caller must not
+                # record a phantom retirement)
+                self._fail(rep, "crash", exc)
+                return False
+            self._assigned[name].discard(fr.frid)
+            fr.replica = None
+            fr.handle = None
+            self._c_drain_migr.inc()
+            self._migrate(fr)
+        # anything else still on the engine is a zombie the router never
+        # tracked (e.g. snapshot-restored requests resolved elsewhere) —
+        # same crash guard as the migration loop: a death HERE must also
+        # fall through to failover, not escape the serve loop
+        try:
+            for rid in [sl.req.rid for sl in rep.engine._slots
+                        if sl is not None] \
+                    + [r.rid for r in rep.engine._queue]:
+                rep.engine.cancel(rid)
+        except Exception as exc:  # noqa: BLE001 — died cancelling zombies
+            self._fail(rep, "crash", exc)
+            return False
+        self._destroy_replica(rep)
+        return True
+
+    def _destroy_replica(self, rep: _Replica):
+        """Tear down a drained (empty) replica: detach the cache feed,
+        keep its tracer (stitched views) + telemetry + final counters
+        (fleet-wide hit-rate accounting spans its whole service life),
+        verify its page accounting one last time, and drop the engine."""
+        eng = rep.engine
+        if eng.cache is not None:
+            eng.cache.notify = None
+        eng.release_cache()
+        eng.check_invariants()      # retired-then-destroyed leak guard
+        if eng.telemetry is not None:
+            self._dead_tracers.append(
+                (f"{rep.name} (retired)", eng.telemetry.tracer))
+            self._retired_telemetry.append(
+                (rep.name, eng.telemetry.registry))
+        self._retired_stats.append((rep.name, eng.stats()))
+        self.router.on_replica_removed(rep.name)
+        rep.alive = False
+        rep.engine = None
+        self._replicas.remove(rep)
+        del self._assigned[rep.name]
+        self._c_scale_down.inc()
+        self.flight.record("scale_down", replica=rep.name,
+                           replicas=len(self._alive()))
+        self.tracer.engine_event("scale_down", replica=rep.name)
 
     # -- submission (fleet ladder: route -> queue -> reject) ---------------
     def submit(self, prompt, max_new_tokens: int = 32,
@@ -316,16 +482,38 @@ class ReplicaFleet:
             self.retry_backoff_rounds * (2 ** min(fr.retries, 10)))
 
     def _place(self, fr: _FleetRequest) -> bool:
-        """Route rung: try each live replica least-loaded-first.  Placement
-        always goes through ``adopt`` so the fleet-anchored absolute
-        deadline is preserved and a migrated request resumes from its
-        streamed tokens (empty stream == fresh submission).  Typed
-        ``PoolCapacityError`` (can NEVER fit) propagates to the caller."""
-        order = sorted(
-            self._alive(),
-            key=lambda rep: (rep.engine.num_active + len(rep.engine._queue),
-                             rep.name))
-        for rep in order:
+        """Route rung: ask the routing strategy for the candidate order
+        (least-loaded by default; prefix-affine with
+        :class:`~paddle_tpu.serving.routing.PrefixAffinityRouter`) and
+        try each candidate in turn.  Placement always goes through
+        ``adopt`` so the fleet-anchored absolute deadline is preserved
+        and a migrated request resumes from its streamed tokens (empty
+        stream == fresh submission).  Typed ``PoolCapacityError`` (can
+        NEVER fit) propagates to the caller."""
+        cands = {rep.name: rep for rep in self._alive() if rep.routable}
+        if not cands:
+            return False
+        # the token stream the placement would prefill: prompt for a
+        # fresh submission, prompt + streamed[:-1] for a migration (the
+        # last streamed token rides as the pending sample, never
+        # written).  Memoized per placement state: a backoff retry of an
+        # unchanged request reuses the concatenation AND the router's
+        # chain digests instead of re-hashing the whole prompt per round
+        memo = fr.route_memo
+        if memo.get("n_streamed") != len(fr.streamed):
+            memo.clear()
+            memo["n_streamed"] = len(fr.streamed)
+            memo["tokens"] = fr.prompt if not fr.streamed \
+                else np.concatenate(
+                    [fr.prompt, np.asarray(fr.streamed[:-1], np.int32)])
+        decision = self.router.decide(
+            memo["tokens"],
+            [(name, rep.load()) for name, rep in cands.items()],
+            memo=memo)
+        for name in decision.order:
+            rep = cands.get(name)
+            if rep is None:
+                continue
             try:
                 rid = rep.engine.adopt(fr.prompt, fr.streamed,
                                        deadline=fr.deadline,
@@ -337,9 +525,13 @@ class ReplicaFleet:
             self._assigned[rep.name].add(fr.frid)
             self.flight.record("route", frid=fr.frid, replica=rep.name,
                                resumed_tokens=len(fr.streamed),
+                               routing=decision.kind,
+                               affinity_blocks=decision.matched_blocks,
                                trace_id=fr.trace_id)
             self.tracer.request_event(fr.frid, "admitted",
                                       replica=rep.name,
+                                      routing=decision.kind,
+                                      affinity_blocks=decision.matched_blocks,
                                       resumed_tokens=len(fr.streamed))
             return True
         return False
@@ -352,6 +544,14 @@ class ReplicaFleet:
         fail over dead replicas, and take periodic snapshots.  Returns
         True when anything progressed."""
         self._round += 1
+        # replica-time accounting: the integral of live-replica count
+        # over fleet heartbeats (draining replicas still cost machine
+        # time until destroyed) — goodput-per-replica-hour's denominator
+        now = self._clock()
+        if self._last_tick is not None:
+            self.replica_seconds += len(self._alive()) \
+                * max(0.0, now - self._last_tick)
+        self._last_tick = now
         progressed = False
         for fr in list(self._waiting):
             if fr.next_try_round > self._round:
@@ -421,13 +621,14 @@ class ReplicaFleet:
             req = fr.handle
             gen = req.generated
             if len(gen) > len(fr.streamed):
-                if fr.first_token_t == 0.0:
+                if fr.first_token_t is None:
                     fr.first_token_t = now
                     self.tracer.request_event(fr.frid, "first_token",
                                               t=now, replica=rep.name)
                 for t in gen[len(fr.streamed):]:
                     t = int(t)
                     fr.streamed.append(t)
+                    self.tokens_streamed += 1
                     if fr.on_token is not None:
                         # router-authoritative emission: fires exactly once
                         # per position, even when a migrated engine
@@ -443,13 +644,14 @@ class ReplicaFleet:
         if fr.replica is not None:
             self._assigned[fr.replica].discard(fr.frid)
         n = len(req.generated)
-        ttft = fr.first_token_t - fr.submit_t if fr.first_token_t else None
+        ttft = fr.first_token_t - fr.submit_t \
+            if fr.first_token_t is not None else None
         tpot = (fr.finish_t - fr.first_token_t) / (n - 1) \
-            if n > 1 and fr.first_token_t else None
+            if n > 1 and fr.first_token_t is not None else None
         self._summaries.append({
             "rid": fr.frid, "tokens": n, "ttft_s": ttft, "tpot_s": tpot,
             "e2e_s": now - fr.submit_t, "timed_out": req.timed_out,
-            "migrations": fr.migrations,
+            "migrations": fr.migrations, "at": now,
         })
         self.flight.record("resolve", frid=fr.frid, tokens=n,
                            timed_out=req.timed_out,
@@ -471,6 +673,10 @@ class ReplicaFleet:
         corpse = rep.engine
         rep.engine = None          # the corpse's state is not trusted
         rep.stall = 0
+        # the dead engine's cached chains died with it: the router must
+        # not keep routing affinity traffic at a corpse (revival re-seeds
+        # from whatever the restored snapshot actually carries)
+        self.router.on_replica_removed(rep.name)
         # postmortem capture BEFORE the corpse is dropped: its flight ring
         # (what the replica was doing when it died) and its tracer (so the
         # stitched fleet trace keeps the spans this generation ran)
@@ -569,6 +775,8 @@ class ReplicaFleet:
                                    mode=applied, requests=len(restored))
         rep.engine = eng
         rep.alive = True
+        rep.routable = True
+        self._wire_router(rep)
         return restored
 
     def _migrate(self, fr: _FleetRequest):
@@ -637,12 +845,20 @@ class ReplicaFleet:
         return {
             "replicas": len(self._replicas),
             "replicas_alive": len(self._alive()),
+            "replicas_routable": sum(1 for rep in self._alive()
+                                     if rep.routable),
+            "replicas_retired": len(self._retired_stats),
             "failovers": self._c_failovers.value,
             "migrations": self._c_migrations.value,
             "rejections": self._c_rejections.value,
             "torn_snapshots": self._c_torn.value,
+            "scale_ups": self._c_scale_up.value,
+            "scale_downs": self._c_scale_down.value,
+            "drain_migrations": self._c_drain_migr.value,
             "requests_submitted": self._c_submitted.value,
             "requests_resolved": self._c_resolved.value,
+            "tokens_streamed": self.tokens_streamed,
+            "replica_seconds": round(self.replica_seconds, 4),
             "waiting": len(self._waiting),
             "recovery": {"count": self._h_recovery.count,
                          "p50_ms": round(q[50] * 1e3, 3),
@@ -650,9 +866,42 @@ class ReplicaFleet:
                          "p99_ms": round(q[99] * 1e3, 3),
                          "max_ms": round(self._h_recovery.max * 1e3, 3)
                          if self._h_recovery.count else 0.0},
-            "per_replica": {rep.name: (rep.engine.stats() if rep.alive
-                                       else None)
+            "per_replica": {rep.name: (dict(rep.engine.stats(),
+                                            routable=rep.routable)
+                                       if rep.alive else None)
                             for rep in self._replicas},
+        }
+
+    @staticmethod
+    def _hit_rate(stats: dict) -> float | None:
+        """One replica's lifetime prefix-cache hit rate: cached tokens
+        over (cached + executed) prefill tokens; None before any
+        prefill."""
+        hit = stats.get("cached_prefix_tokens", 0)
+        ex = stats.get("prefill_tokens_executed", 0)
+        return round(hit / (hit + ex), 4) if hit + ex else None
+
+    def fleet_hit_rate(self) -> dict:
+        """Fleet-wide prefix-cache hit rate over the fleet's WHOLE
+        service history — live replicas plus retired ones (a drained
+        replica's hits must not vanish from the accounting the moment
+        the autoscaler destroys it)."""
+        hit = ex = 0
+        per: dict[str, float | None] = {}
+        for name, st in self._retired_stats:
+            hit += st.get("cached_prefix_tokens", 0)
+            ex += st.get("prefill_tokens_executed", 0)
+            per[name] = self._hit_rate(st)
+        for rep in self._alive():
+            st = rep.engine.stats()
+            hit += st.get("cached_prefix_tokens", 0)
+            ex += st.get("prefill_tokens_executed", 0)
+            per[rep.name] = self._hit_rate(st)
+        return {
+            "cached_prefix_tokens": hit,
+            "prefill_tokens_executed": ex,
+            "hit_rate": round(hit / (hit + ex), 4) if hit + ex else 0.0,
+            "per_replica": per,
         }
 
     def stats_snapshot(self, ttft_deadline_s: float | None = None) -> dict:
@@ -673,6 +922,15 @@ class ReplicaFleet:
         out["merged"] = snap["merged"]
         out["per_replica_telemetry"] = snap["per_replica"]
         out["alerts"] = self.alerts_report()
+        # routing observability (ROADMAP item 5): per-replica hit rates +
+        # the router's affinity-hit/fallback counters ride every snapshot
+        out["cache"] = self.fleet_hit_rate()
+        for rep in self._replicas:
+            if rep.alive:
+                pr = out["per_replica"].get(rep.name)
+                if isinstance(pr, dict):
+                    pr["cache_hit_rate"] = self._hit_rate(pr)
+        out["router"] = self.router.stats()
         if ttft_deadline_s is not None:
             out["fleet_slo"] = ft.slo_report(ttft_deadline_s)
         return out

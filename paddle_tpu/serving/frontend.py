@@ -406,7 +406,9 @@ class _FleetAdapter:
         horizon = 8
         got_rates = False
         for rep in self.fleet._replicas:
-            if not rep.alive:
+            # a drain-retiring (unroutable) replica's slots must not be
+            # promised to admission — new work can never be placed there
+            if not rep.alive or not rep.routable:
                 continue
             v = admission_view(
                 rep.engine,
@@ -837,6 +839,81 @@ class AsyncFrontend:
                     regs[rep.name] = rep.engine.telemetry.registry
         return regs
 
+    # -- HTTP/SSE streaming endpoint (ROADMAP item 4's socket leftover) ----
+    def _sse_generate(self, payload: dict):
+        """``POST /generate`` body -> SSE-framed event strings.  Runs on
+        the exporter's HTTP thread: the submit and every token pull hop
+        onto the asyncio loop via ``run_coroutine_threadsafe``, so the
+        transport semantics (admission, backpressure, cancel path) are
+        EXACTLY :meth:`submit`'s.  A client disconnect closes this
+        generator mid-iteration; the ``finally`` abandons the stream —
+        the same ``engine.cancel()`` path as an async client vanishing,
+        pages freed mid-decode."""
+        import json as _json
+
+        def _ev(event, obj):
+            return f"event: {event}\ndata: {_json.dumps(obj)}\n\n"
+
+        loop = self._loop
+        if loop is None or self._thread is None:
+            yield _ev("error", {"error": "frontend not started"})
+            return
+        try:
+            prompt = np.asarray(payload["prompt"], np.int32).reshape(-1)
+            kw = dict(
+                max_new_tokens=int(payload.get("max_new_tokens", 32)),
+                temperature=float(payload.get("temperature", 0.0)),
+                top_p=float(payload.get("top_p", 1.0)),
+                eos_token_id=payload.get("eos_token_id"),
+                slo_ttft_s=payload.get("slo_ttft_s"))
+        except (KeyError, TypeError, ValueError) as exc:
+            yield _ev("error", {"error": f"bad request: {exc}"})
+            return
+        try:
+            stream = asyncio.run_coroutine_threadsafe(
+                self.submit(prompt, **kw), loop).result()
+        except AdmissionRejected as exc:
+            yield _ev("rejected", {"error": str(exc),
+                                   "slo": isinstance(exc, SLORejected)})
+            return
+        except Exception as exc:  # noqa: BLE001 — surfaced to the client
+            yield _ev("error", {"error": f"{type(exc).__name__}: {exc}"})
+            return
+        done = False
+        err: Exception | None = None
+        n = 0
+        try:
+            yield _ev("start", {"rid": stream.rid,
+                                "trace_id": stream.trace_id,
+                                "predicted_ttft_s": stream.predicted_ttft_s})
+            while True:
+                try:
+                    tok = asyncio.run_coroutine_threadsafe(
+                        stream.__anext__(), loop).result()
+                except StopAsyncIteration:
+                    break
+                except Exception as exc:  # noqa: BLE001 — engine/worker
+                    # died mid-stream: the contract is a TYPED error
+                    # frame, not a silent truncation indistinguishable
+                    # from a network drop (GeneratorExit — the client
+                    # disconnect — is BaseException and still propagates)
+                    err = exc
+                    break
+                n += 1
+                yield f"data: {_json.dumps({'token': int(tok)})}\n\n"
+            done = err is None
+        finally:
+            if not done:
+                # generator closed mid-stream (disconnect) or the stream
+                # errored: cancel any live request through the existing
+                # abandon path
+                loop.call_soon_threadsafe(stream.abandon)
+        if err is not None:
+            yield _ev("error", {"error": f"{type(err).__name__}: {err}",
+                                "tokens": n})
+        else:
+            yield _ev("done", {"tokens": n})
+
     def start_exporter(self, host: str = "127.0.0.1", port: int = 0,
                        freeze: bool = True):
         """Attach the live pull endpoint: ``/metrics`` (Prometheus text,
@@ -845,9 +922,15 @@ class AsyncFrontend:
         telemetry), ``/alerts`` (the aggregated sentinel report),
         ``/slow`` (top-K slowest requests with their critical-path
         attribution, merged across replicas), and ``/requests`` (recent
-        request summaries) on a stdlib ``http.server`` daemon thread.
-        Off by default; ``port=0`` picks a free port (read ``.port``
-        back from the returned exporter).
+        request summaries) on a stdlib ``http.server`` daemon thread —
+        plus the streaming ingress ``POST /generate``: a JSON body
+        (``{"prompt": [...], "max_new_tokens": ...}``) answered with a
+        Server-Sent-Events token stream (``event: start`` ->
+        ``data: {"token": N}`` per token -> ``event: done``; admission
+        rejections arrive as ``event: rejected``), and a mid-stream
+        disconnect cancels the request and frees its pages exactly like
+        an async client vanishing.  Off by default; ``port=0`` picks a
+        free port (read ``.port`` back from the returned exporter).
 
         SECURITY: binds ``127.0.0.1`` by default — metrics and request
         summaries expose workload shape; put real auth in front before
@@ -903,6 +986,7 @@ class AsyncFrontend:
         self.exporter = MetricsExporter(
             snapshot_fn, requests_fn=requests_fn, health_fn=health_fn,
             alerts_fn=alerts_fn, slow_fn=slow_fn,
+            generate_fn=self._sse_generate,
             host=host, port=port).start()
         return self.exporter
 

@@ -44,8 +44,38 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
-__all__ = ["ClientRequest", "Scenario", "make_scenario", "replay_engine",
-           "replay_sim", "goodput_report"]
+__all__ = ["ClientRequest", "Scenario", "VirtualClock", "make_scenario",
+           "replay_engine", "replay_fleet", "replay_sim", "goodput_report"]
+
+
+class VirtualClock:
+    """Round-driven virtual time for fleet replays.
+
+    On a shared host every replica time-slices one CPU, so wall-clock
+    fleet economics are a lie: an N-replica fleet's heartbeat costs ~N×
+    the wall time of a 1-replica fleet's, which would bill the elastic
+    arm for parallelism the simulation cannot express.  The virtual
+    clock models the real deployment instead — each replica is its own
+    machine, all stepping CONCURRENTLY — by advancing a fixed ``dt``
+    per fleet round regardless of replica count.  Inject it as the
+    fleet's ``clock=`` (request timestamps, TTFT, ``replica_seconds``
+    all move to the virtual domain) and hand it to
+    :func:`replay_fleet` (arrival pacing + idle jumps); every metric the
+    elastic A/B gates on then becomes DETERMINISTIC: same seed, same
+    scale-event timeline, same goodput-per-replica-hour, on any host."""
+
+    def __init__(self, dt: float = 1.0):
+        self.t = 0.0
+        self.dt = float(dt)
+
+    def __call__(self) -> float:
+        return self.t
+
+    def tick(self):
+        self.t += self.dt
+
+    def advance_to(self, t: float):
+        self.t = max(self.t, float(t))
 
 
 @dataclass
@@ -407,6 +437,170 @@ def replay_engine(engine, scenario: Scenario, controller=None, *,
         "window_s": window_s,
         "report": goodput_report(records, slo_ttft_s, window_s=window_s),
         "admission": controller.report(),
+    }
+
+
+def replay_fleet(fleet, scenario: Scenario, *, slo_ttft_s: float,
+                 load_tps: float | None = None,
+                 virtual_clock: VirtualClock | None = None,
+                 collect_tokens: bool = False,
+                 max_stall_rounds: int = 4000) -> dict:
+    """Drive a :class:`~paddle_tpu.serving.fleet.ReplicaFleet` (fixed-N
+    or :class:`~paddle_tpu.serving.autoscale.ElasticFleet`) through
+    ``scenario`` — the fleet-shaped twin of :func:`replay_engine`.
+    Exactly one pacing mode:
+
+      * ``load_tps`` — ROUTER token time: request i is submitted once
+        the fleet has streamed ``arrival_s * load_tps`` tokens since the
+        replay began (the router's ``tokens_streamed`` counter advances
+        once per authoritative emission, so a failover/migration
+        re-decode never inflates the clock).  Machine-independent
+        offered load, but fleet-SIZE-normalizing: aggregate generation
+        IS the clock, so capacity differences between fleets cancel out
+        of the queue dynamics — use it for exactness/chaos drills, not
+        capacity A/Bs.
+      * ``virtual_clock`` — ROUND time (:class:`VirtualClock`): each
+        fleet heartbeat advances ``dt`` virtual seconds as if every
+        replica were its own concurrently-stepping host, and idle
+        valleys jump the clock to the next arrival (idle replicas still
+        accrue ``replica_seconds`` across the jump — exactly the cost
+        scale-down exists to shed).  An N-replica fleet then clears an
+        arrival backlog N× faster in virtual time, so capacity and
+        elasticity are measurable — and every reported number is
+        DETERMINISTIC for a given seed.  The fleet must have been built
+        with ``clock=virtual_clock`` (one clock domain for request
+        stamps, replica-time, and pacing); ``slo_ttft_s`` is then in
+        virtual seconds.
+
+    Abandon clients cancel through ``fleet.cancel`` at the round
+    boundary.  Returns the :func:`replay_engine` report shape plus
+    ``replica_seconds`` — the integral of live-replica count over the
+    replay (the goodput-per-replica-hour denominator bench.py's elastic
+    trace A/Bs on)."""
+    import time as _time
+
+    from ..inference.paged import AdmissionRejected
+
+    if (load_tps is None) == (virtual_clock is None):
+        raise ValueError("pass exactly one of load_tps / virtual_clock")
+    if virtual_clock is not None and fleet._clock is not virtual_clock:
+        raise ValueError("virtual-clock replay requires the fleet to run "
+                         "on the SAME clock: ReplicaFleet(clock=vc)")
+    n = len(scenario.requests)
+    records: list[dict] = [
+        {"idx": r.idx, "rejected": False, "abandoned": False, "tokens": 0,
+         "ttft_s": None, "tpot_s": None, "e2e_s": None, "timed_out": False,
+         "migrations": 0, "kind": r.kind}
+        for r in scenario.requests]
+    streams: dict[int, list] = {}
+    to_cancel: list[int] = []
+    frid_of: dict[int, int] = {}
+
+    def _mk_cb(idx: int, abandon_after):
+        toks: list = []
+        streams[idx] = toks
+
+        def cb(tok, _toks=toks, _aa=abandon_after, _idx=idx):
+            _toks.append(tok)
+            if _aa is not None and len(_toks) == _aa:
+                # disconnect mid-decode: the fleet hook fires inside the
+                # router's stream drain — defer to the round boundary
+                to_cancel.append(_idx)
+        return cb
+
+    base_tok = fleet.tokens_streamed
+    rs0 = fleet.replica_seconds
+    i = 0
+    stalled = 0
+
+    def _submit_next():
+        nonlocal i
+        sr = scenario.requests[i]
+        try:
+            frid = fleet.submit(
+                sr.prompt, max_new_tokens=sr.max_new_tokens,
+                temperature=sr.temperature, top_p=sr.top_p,
+                on_token=_mk_cb(sr.idx, sr.abandon_after))
+            frid_of[sr.idx] = frid
+        except AdmissionRejected:
+            records[sr.idx]["rejected"] = True
+        i += 1
+
+    def _busy():
+        return any(fr.result is None for fr in fleet._requests.values())
+
+    def _due() -> bool:
+        if i >= n:
+            return False
+        at = scenario.requests[i].arrival_s
+        if virtual_clock is not None:
+            return at <= virtual_clock()
+        return at * load_tps <= fleet.tokens_streamed - base_tok
+
+    t0 = _time.perf_counter()
+    v0 = virtual_clock() if virtual_clock is not None else 0.0
+    while True:
+        while _due():
+            _submit_next()
+        if i < n and not _busy():
+            # idle jump: the clock cannot advance to the next arrival on
+            # its own — roll forward through the empty valley (virtual
+            # mode jumps the shared clock, so idle replicas keep
+            # accruing replica_seconds across the gap)
+            if virtual_clock is not None:
+                virtual_clock.advance_to(scenario.requests[i].arrival_s)
+            _submit_next()
+            continue
+        if i >= n and not _busy():
+            break
+        progressed = fleet.step()
+        if virtual_clock is not None:
+            virtual_clock.tick()
+        stalled = 0 if progressed else stalled + 1
+        if stalled >= max_stall_rounds:
+            raise RuntimeError(
+                f"replay_fleet: no progress for {stalled} rounds "
+                f"({sum(fr.result is None for fr in fleet._requests.values())}"
+                f" unresolved, {len(fleet._waiting)} waiting)")
+        if to_cancel:
+            for idx in to_cancel:
+                rec = records[idx]
+                if not rec["abandoned"]:
+                    rec["abandoned"] = True
+                    frid = frid_of[idx]
+                    fr = fleet._requests.get(frid)
+                    if fr is not None and fr.first_token_t is not None:
+                        rec["ttft_s"] = fr.first_token_t - fr.submit_t
+                    rec["tokens"] = len(streams[idx])
+                    fleet.cancel(frid)
+            to_cancel.clear()
+    window_s = (virtual_clock() - v0) if virtual_clock is not None \
+        else _time.perf_counter() - t0
+    for idx, frid in frid_of.items():
+        rec = records[idx]
+        if rec["abandoned"]:
+            continue
+        fr = fleet._requests.get(frid)
+        if fr is None or fr.result is None:
+            continue
+        ngen = len(fr.result.generated)
+        rec["tokens"] = ngen
+        rec["ttft_s"] = (fr.first_token_t - fr.submit_t
+                         if fr.first_token_t is not None else None)
+        rec["tpot_s"] = ((fr.finish_t - fr.first_token_t) / (ngen - 1)
+                         if ngen > 1 and fr.first_token_t is not None
+                         else None)
+        rec["e2e_s"] = fr.finish_t - fr.submit_t
+        rec["timed_out"] = fr.result.timed_out
+        rec["migrations"] = fr.migrations
+    if collect_tokens:
+        for idx, toks in streams.items():
+            records[idx]["stream"] = list(toks)
+    return {
+        "records": records,
+        "window_s": window_s,
+        "replica_seconds": fleet.replica_seconds - rs0,
+        "report": goodput_report(records, slo_ttft_s, window_s=window_s),
     }
 
 

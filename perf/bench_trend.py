@@ -49,6 +49,13 @@ PRED_ERR_KEY = "ttft_pred_err_s"
 # checked like the other columns.
 ALERTS_KEY = "fired_total"
 ATTR_KEY = "decode_sync_frac"
+# ISSUE 14 columns: the elastic trace's fleet economics — the elastic
+# arm's goodput-per-replica-hour (on-time requests per replica-hour of
+# virtual uptime) and the affinity fleet's prefix hit rate — both from
+# the elastic artifact's goodput_per_replica_hour/hit_rate blocks.
+# Drift-checked like the other columns.
+GPRH_KEY = "goodput_per_replica_hour"
+FLEET_HIT_KEY = "fleet_hit_rate"
 
 
 def find_artifacts(root: str) -> list[tuple[int, str]]:
@@ -83,122 +90,111 @@ def validate(art, path: str) -> list[str]:
     return problems
 
 
-def find_serving_section(d) -> dict | None:
-    """First (depth-first) dict carrying the serving TTFT/goodput keys —
-    wherever a round's artifact nests its serving-trace section."""
+def _find(d, match):
+    """ONE depth-first walker for every column finder: apply ``match`` to
+    each dict node (it returns the extracted value or None) and return
+    the first non-None hit, recursing through dict values and lists.
+    Every ISSUE adds a column; they differ only in the per-node
+    predicate, never in the traversal."""
     if isinstance(d, dict):
-        if all(k in d for k in SERVING_KEYS):
-            return d
+        hit = match(d)
+        if hit is not None:
+            return hit
         for v in d.values():
-            hit = find_serving_section(v)
+            hit = _find(v, match)
             if hit is not None:
                 return hit
     elif isinstance(d, list):
         for v in d:
-            hit = find_serving_section(v)
+            hit = _find(v, match)
             if hit is not None:
                 return hit
     return None
+
+
+def _num(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def find_serving_section(d) -> dict | None:
+    """First dict carrying the serving TTFT/goodput keys — wherever a
+    round's artifact nests its serving-trace section."""
+    return _find(d, lambda n: n if all(k in n for k in SERVING_KEYS)
+                 else None)
 
 
 def find_slo_goodput(d):
-    """First (depth-first) ``goodput_under_slo`` value — the ISSUE 11
-    frontend trace's offered-load goodput, wherever the round nests it."""
-    if isinstance(d, dict):
-        if FRONTEND_KEY in d:
-            return d[FRONTEND_KEY]
-        for v in d.values():
-            hit = find_slo_goodput(v)
-            if hit is not None:
-                return hit
-    elif isinstance(d, list):
-        for v in d:
-            hit = find_slo_goodput(v)
-            if hit is not None:
-                return hit
-    return None
+    """First ``goodput_under_slo`` value — the ISSUE 11 frontend trace's
+    offered-load goodput, wherever the round nests it."""
+    return _find(d, lambda n: n.get(FRONTEND_KEY))
 
 
 def find_recovery_p50(d):
-    """First (depth-first) fleet-failover recovery p50, ms: the flat
+    """First fleet-failover recovery p50, ms: the flat
     ``recovery_ms_p50`` the failover trace reports, falling back to a
     nested ``{"recovery": {"p50_ms": ...}}`` fleet-stats block."""
-    if isinstance(d, dict):
-        if RECOVERY_KEY in d and isinstance(d[RECOVERY_KEY], (int, float)):
-            return d[RECOVERY_KEY]
-        rec = d.get("recovery")
-        if isinstance(rec, dict) \
-                and isinstance(rec.get("p50_ms"), (int, float)):
+    def match(n):
+        if _num(n.get(RECOVERY_KEY)):
+            return n[RECOVERY_KEY]
+        rec = n.get("recovery")
+        if isinstance(rec, dict) and _num(rec.get("p50_ms")):
             return rec["p50_ms"]
-        for v in d.values():
-            hit = find_recovery_p50(v)
-            if hit is not None:
-                return hit
-    elif isinstance(d, list):
-        for v in d:
-            hit = find_recovery_p50(v)
-            if hit is not None:
-                return hit
-    return None
+        return None
+    return _find(d, match)
 
 
 def find_pred_err_p95(d):
-    """First (depth-first) admission prediction-error p95, seconds: the
+    """First admission prediction-error p95, seconds: the
     ``ttft_pred_err_s`` block's ``p95_s`` wherever a round nests it."""
-    if isinstance(d, dict):
-        err = d.get(PRED_ERR_KEY)
-        if isinstance(err, dict) \
-                and isinstance(err.get("p95_s"), (int, float)):
+    def match(n):
+        err = n.get(PRED_ERR_KEY)
+        if isinstance(err, dict) and _num(err.get("p95_s")):
             return err["p95_s"]
-        for v in d.values():
-            hit = find_pred_err_p95(v)
-            if hit is not None:
-                return hit
-    elif isinstance(d, list):
-        for v in d:
-            hit = find_pred_err_p95(v)
-            if hit is not None:
-                return hit
-    return None
+        return None
+    return _find(d, match)
 
 
 def find_alerts_fired(d):
-    """First (depth-first) `alerts` section's `fired_total` — the ISSUE 13
+    """First `alerts` section's `fired_total` — the ISSUE 13
     health-sentinel fire count, wherever a round nests it."""
-    if isinstance(d, dict):
-        al = d.get("alerts")
+    def match(n):
+        al = n.get("alerts")
         if isinstance(al, dict) and isinstance(al.get(ALERTS_KEY), int) \
                 and not isinstance(al.get(ALERTS_KEY), bool):
             return al[ALERTS_KEY]
-        for v in d.values():
-            hit = find_alerts_fired(v)
-            if hit is not None:
-                return hit
-    elif isinstance(d, list):
-        for v in d:
-            hit = find_alerts_fired(v)
-            if hit is not None:
-                return hit
-    return None
+        return None
+    return _find(d, match)
 
 
 def find_decode_sync_frac(d):
-    """First (depth-first) attribution headline `decode_sync_frac` — the
-    decode device-wait share of e2e latency (ISSUE 13)."""
-    if isinstance(d, dict):
-        v = d.get(ATTR_KEY)
-        if isinstance(v, (int, float)) and not isinstance(v, bool):
-            return v
-        for v in d.values():
-            hit = find_decode_sync_frac(v)
-            if hit is not None:
-                return hit
-    elif isinstance(d, list):
-        for v in d:
-            hit = find_decode_sync_frac(v)
-            if hit is not None:
-                return hit
-    return None
+    """First attribution headline `decode_sync_frac` — the decode
+    device-wait share of e2e latency (ISSUE 13)."""
+    return _find(d, lambda n: n[ATTR_KEY] if _num(n.get(ATTR_KEY))
+                 else None)
+
+
+def find_gprh(d):
+    """First elastic-arm goodput-per-replica-hour: the elastic
+    artifact's ``goodput_per_replica_hour.elastic`` (the block — not the
+    per-arm scalar of the same name, which lacks the ``elastic`` key)."""
+    def match(n):
+        g = n.get(GPRH_KEY)
+        if isinstance(g, dict) and _num(g.get("elastic")):
+            return g["elastic"]
+        return None
+    return _find(d, match)
+
+
+def find_fleet_hit_rate(d):
+    """First affinity-fleet prefix hit rate: the elastic artifact's
+    ``hit_rate.affinity_fixed2`` (the controlled same-N comparison
+    against the single engine)."""
+    def match(n):
+        h = n.get("hit_rate")
+        if isinstance(h, dict) and _num(h.get("affinity_fixed2")):
+            return h["affinity_fixed2"]
+        return None
+    return _find(d, match)
 
 
 def _fmt(v, nd=1):
@@ -222,6 +218,8 @@ def trend(root: str = ".", verbose: bool = True) -> int:
     prev_pred_err = False
     prev_alerts = False
     prev_attr = False
+    prev_gprh = False
+    prev_fleet_hit = False
     for rnd, path in arts:
         try:
             with open(path) as f:
@@ -269,6 +267,18 @@ def trend(root: str = ".", verbose: bool = True) -> int:
             problems.append(f"{path}: attribution headline ({ATTR_KEY}) "
                             f"present in an earlier round but missing here")
         prev_attr = prev_attr or dsync_frac is not None
+        gprh = find_gprh(parsed)
+        if gprh is None and prev_gprh:
+            problems.append(f"{path}: elastic goodput-per-replica-hour "
+                            f"({GPRH_KEY}.elastic) present in an earlier "
+                            f"round but missing here")
+        prev_gprh = prev_gprh or gprh is not None
+        fleet_hit = find_fleet_hit_rate(parsed)
+        if fleet_hit is None and prev_fleet_hit:
+            problems.append(f"{path}: affinity fleet hit rate "
+                            f"(hit_rate.affinity_fixed2) present in an "
+                            f"earlier round but missing here")
+        prev_fleet_hit = prev_fleet_hit or fleet_hit is not None
         rows.append({
             "round": rnd,
             "metric": parsed.get("metric"),
@@ -298,12 +308,16 @@ def trend(root: str = ".", verbose: bool = True) -> int:
             # ISSUE 13 columns: sentinel fires + decode-sync e2e share
             "alerts_fired": alerts_fired,
             "decode_sync_frac": dsync_frac,
+            # ISSUE 14 columns: elastic fleet economics + affinity hit rate
+            "goodput_per_replica_hour": gprh,
+            "fleet_hit_rate": fleet_hit,
         })
     if verbose:
         hdr = (f"{'round':>5}  {'tokens/s':>10}  {'vs_base':>8}  "
                f"{'serve tok/s':>11}  {'ttft_p95_ms':>11}  {'goodput':>7}  "
                f"{'overlap':>7}  {'slo_gput':>8}  {'rec_p50':>7}  "
-               f"{'perr_p95':>8}  {'alerts':>6}  {'dsync':>5}")
+               f"{'perr_p95':>8}  {'alerts':>6}  {'dsync':>5}  "
+               f"{'gprh':>6}  {'f_hit':>5}")
         print(hdr)
         print("-" * len(hdr))
         for r in rows:
@@ -317,7 +331,9 @@ def trend(root: str = ".", verbose: bool = True) -> int:
                   f"{_fmt(r['recovery_p50_ms'], 1):>7}  "
                   f"{_fmt(r['pred_err_p95_ms'], 2):>8}  "
                   f"{_fmt(r['alerts_fired']):>6}  "
-                  f"{_fmt(r['decode_sync_frac'], 3):>5}")
+                  f"{_fmt(r['decode_sync_frac'], 3):>5}  "
+                  f"{_fmt(r['goodput_per_replica_hour'], 0):>6}  "
+                  f"{_fmt(r['fleet_hit_rate'], 3):>5}")
         v0, v1 = rows[0]["value"], rows[-1]["value"]
         if len(rows) >= 2 \
                 and all(isinstance(v, (int, float))

@@ -96,7 +96,129 @@ TRACE_SECTIONS = {
     # async bit-equality, zero leaked pages, admission counters whose
     # fractions sum to 1, predictive >= depth goodput-under-SLO)
     "frontend": [],
+    # elastic is arm-shaped (fixed-N vs ElasticFleet on a virtual-clock
+    # diurnal replay): validated by _validate_elastic below (ISSUE 14 —
+    # zero lost + bit-equal across scale events, non-empty scale-event
+    # timeline, elastic >= every fixed-N arm on goodput-per-replica-hour,
+    # affinity fleet hit rate >= 0.9x the single engine's)
+    "elastic": [],
 }
+
+# ISSUE 14: the elastic trace's gates.  The replay runs on a round-driven
+# VIRTUAL clock (each replica modeled as its own concurrently-stepping
+# host), so both ratios below are deterministic for a given seed — the
+# floors are real bars, not machine-variance accommodations (a tiny
+# epsilon absorbs float-rounding of the reported ratios only).
+ELASTIC_MIN_GPRH_RATIO = 0.999      # elastic vs EVERY fixed-N arm
+ELASTIC_MIN_HIT_RATIO = 0.9         # affinity fleet vs single engine
+ELASTIC_ARM_KEYS = ("on_time_requests", "goodput_fraction",
+                    "replica_seconds_v", "goodput_per_replica_hour",
+                    "hit_rate", "slo_report")
+ELASTIC_ROUTER_KEYS = ("router", "routed", "affinity_hits",
+                       "affinity_fallbacks", "affinity_misses")
+
+
+def _validate_elastic(art: dict) -> list[str]:
+    problems = []
+    if "metric" not in art:
+        problems.append("missing top-level 'metric'")
+    if art.get("lost_requests") != 0:
+        problems.append(f"lost_requests is {art.get('lost_requests')!r} — "
+                        f"every arm (scale events included) must lose "
+                        f"ZERO requests")
+    if art.get("outputs_bitexact") is not True:
+        problems.append("outputs_bitexact is not True — greedy outputs "
+                        "must match the uninterrupted single engine "
+                        "bit-for-bit across every scale-up/drain event")
+    events = art.get("scale_events")
+    if not isinstance(events, list) or not events:
+        problems.append("scale_events timeline is missing/empty — the "
+                        "elastic arm never scaled")
+    if not art.get("scale_ups"):
+        problems.append("scale_ups is 0 — the queue-growth trigger never "
+                        "fired")
+    if not art.get("scale_downs"):
+        problems.append("scale_downs is 0 — the idle-drain trigger never "
+                        "fired")
+    gprh = art.get("goodput_per_replica_hour")
+    if not isinstance(gprh, dict):
+        problems.append("missing 'goodput_per_replica_hour' (the elastic "
+                        "vs fixed-N economics block)")
+    else:
+        fixed = gprh.get("fixed")
+        if not isinstance(fixed, dict) or not fixed:
+            problems.append("goodput_per_replica_hour.fixed missing/empty")
+        else:
+            for arm, v in fixed.items():
+                if not isinstance(v, (int, float)) or v <= 0:
+                    problems.append(
+                        f"goodput_per_replica_hour.fixed[{arm!r}] is "
+                        f"{v!r} — a zero/absent baseline arm is a "
+                        f"degenerate A/B, not a win")
+        ratios = gprh.get("ratios_elastic_vs_fixed")
+        if not isinstance(ratios, dict) or not ratios:
+            problems.append("goodput_per_replica_hour."
+                            "ratios_elastic_vs_fixed missing/empty")
+        else:
+            for arm, r in ratios.items():
+                if not isinstance(r, (int, float)) \
+                        or r < ELASTIC_MIN_GPRH_RATIO:
+                    problems.append(
+                        f"goodput_per_replica_hour: elastic/fixed-{arm} "
+                        f"ratio {r!r} < {ELASTIC_MIN_GPRH_RATIO} — the "
+                        f"elastic fleet must beat every fixed-N arm "
+                        f"(deterministic virtual-clock replay)")
+    hit = art.get("hit_rate")
+    if not isinstance(hit, dict):
+        problems.append("missing 'hit_rate' (the affinity-routing block)")
+    else:
+        for k in ("single_engine", "affinity_fixed2",
+                  "least_loaded_fixed2", "ratio_vs_single"):
+            if k not in hit:
+                problems.append(f"hit_rate: missing {k!r}")
+        r = hit.get("ratio_vs_single")
+        if not isinstance(r, (int, float)) or r < ELASTIC_MIN_HIT_RATIO:
+            problems.append(
+                f"hit_rate.ratio_vs_single {r!r} < {ELASTIC_MIN_HIT_RATIO}"
+                f" — affinity routing must recover the fleet-wide prefix "
+                f"hit rate to >= 0.9x the single engine's")
+        if hit.get("split_demonstrated") is not True:
+            problems.append("hit_rate.split_demonstrated is not True — "
+                            "least-loaded routing no longer splits chains "
+                            "(the A/B lost its baseline contrast)")
+    router = art.get("router")
+    if not isinstance(router, dict):
+        problems.append("missing 'router' (affinity counters)")
+    else:
+        for k in ELASTIC_ROUTER_KEYS:
+            if k not in router:
+                problems.append(f"router: missing {k!r}")
+        if router.get("router") == "prefix_affinity" \
+                and not router.get("affinity_hits"):
+            problems.append("router.affinity_hits is 0 — affinity routing "
+                            "never actually led a placement")
+    arms = art.get("arms")
+    if not isinstance(arms, dict) or "elastic" not in arms:
+        problems.append("missing 'arms' (per-arm readouts incl. "
+                        "'elastic')")
+    else:
+        for name, arm in arms.items():
+            if not isinstance(arm, dict):
+                problems.append(f"arms[{name!r}] is not a section")
+                continue
+            for k in ELASTIC_ARM_KEYS:
+                if k not in arm:
+                    problems.append(f"arms[{name!r}]: missing {k!r}")
+    fleet = art.get("fleet")
+    if not isinstance(fleet, dict):
+        problems.append("missing 'fleet' (elastic-arm stats_snapshot)")
+    else:
+        for k in ("scale_ups", "scale_downs", "drain_migrations",
+                  "replicas_retired", "cache", "router"):
+            if k not in fleet:
+                problems.append(f"fleet: missing {k!r}")
+        problems.extend(_validate_fleet_telemetry(fleet))
+    return problems
 
 # ISSUE 11: the frontend trace's per-scenario sections + the admission A/B
 FRONTEND_SCENARIOS = ("bursty", "diurnal")
@@ -413,6 +535,8 @@ def validate_artifact(art: dict, trace: str) -> list[str]:
         return _validate_failover(art)
     if trace == "frontend":
         return _validate_frontend(art)
+    if trace == "elastic":
+        return _validate_elastic(art)
     if "metric" not in art:
         problems.append("missing top-level 'metric'")
     for path in TRACE_SECTIONS[trace]:

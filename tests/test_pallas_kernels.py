@@ -226,6 +226,7 @@ def test_flash_attention_segment_ids_forward(causal):
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow   # 6s/pair grad compiles; forward segment-id parity stays tier-1
 @pytest.mark.parametrize("causal", [False, True])
 def test_flash_attention_segment_ids_grads(causal):
     B, S, H, D = 1, 128, 2, 64
@@ -477,6 +478,7 @@ def test_flash_attention_dropout_rate0_matches_plain():
     np.testing.assert_array_equal(np.asarray(o0), np.asarray(od))
 
 
+@pytest.mark.slow   # 8s/pair odd-length compiles; tile-pad coverage stays via kernel parity sweeps
 @pytest.mark.parametrize("S", [453, 390])
 def test_flash_attention_pad_to_tile(S):
     """Long untileable sequence lengths pad to the next 128-multiple with a

@@ -40,6 +40,7 @@ def test_ring_attention_matches_full(causal):
 
 
 @requires_8
+@pytest.mark.slow   # 8s/pair compile-heavy; ring-attention parity stays tier-1
 @pytest.mark.parametrize("causal", [False, True])
 def test_ulysses_attention_matches_full(causal):
     mesh = build_mesh({"sep": 8})

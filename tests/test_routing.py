@@ -1,0 +1,255 @@
+"""Fleet routing strategies + the shared chain-hash seam (ISSUE 14).
+
+Acceptance bar: the router-side chained block-hash
+(``prefix_chain_hashes``) is BIT-IDENTICAL to what the engine-side
+``PrefixCache`` indexes (page-boundary and partial-tail prompts pinned);
+``LeastLoadedRouter`` reproduces the PR 9 inline policy;
+``PrefixAffinityRouter`` routes to the replica with the longest cached
+chain, falls back least-loaded under the bounded-imbalance guard, and
+its per-replica summary tracks cache insert/evict notifications — wired
+end-to-end through a live ``ReplicaFleet``."""
+import numpy as np
+import pytest
+import jax
+
+import paddle_tpu as paddle  # noqa: F401 — jax compat shims
+from paddle_tpu.inference.paged import (PagePool, PrefixCache,
+                                        ServingEngine, prefix_chain_hashes)
+from paddle_tpu.models.llama import build_functional_llama, llama_config_tiny
+from paddle_tpu.serving import (LeastLoadedRouter, PrefixAffinityRouter,
+                                ReplicaFleet)
+
+rng = np.random.default_rng(77)
+
+
+# ---------------------------------------------------------------------------
+# the shared chain-hash implementation
+# ---------------------------------------------------------------------------
+class TestPrefixChainHashes:
+    @pytest.mark.parametrize("n_tokens", [8, 12, 16, 17, 23])
+    def test_router_and_cache_chains_equal(self, n_tokens):
+        """Page-boundary (8, 16) and partial-tail (12, 17, 23) prompts:
+        the digests the cache indexes on register() are EXACTLY the
+        helper's chain — one implementation, two callers."""
+        ps = 4
+        tokens = rng.integers(1, 100, (n_tokens,)).astype(np.int32)
+        pool = PagePool(num_pages=16, page_size=ps)
+        cache = PrefixCache(pool, ps)
+        n_pages = (n_tokens + ps - 1) // ps
+        pages = pool.alloc(n_pages)
+        cache.register(tokens, pages, with_partial=True)
+        chain = prefix_chain_hashes(tokens, ps)
+        assert len(chain) == n_tokens // ps
+        assert set(cache.chain_digests()) == set(chain)
+        # chain order: digest i must be the lookup key for block i
+        # (lookup walks exactly these digests parent-chained)
+        full_pages, _partial = cache.lookup(
+            np.concatenate([tokens, tokens[:1]]))
+        assert full_pages == list(pages[:len(chain)])
+        # cleanup so the conftest pool-leak guard stays meaningful
+        cache.evict(n_pages)
+        pool.free(pages)
+
+    def test_chain_is_prefix_sensitive(self):
+        """Chaining: block i's digest identifies the WHOLE prefix — two
+        streams sharing block 1 but not block 0 share no digests."""
+        ps = 4
+        a = np.arange(1, 13, dtype=np.int32)
+        b = a.copy()
+        b[0] = 99
+        ca, cb = prefix_chain_hashes(a, ps), prefix_chain_hashes(b, ps)
+        assert ca != cb and not set(ca) & set(cb)
+        # same stream, longer: the shorter chain is a strict prefix
+        assert prefix_chain_hashes(a[:8], ps) == ca[:2]
+
+    def test_notify_insert_and_evict(self):
+        """The cache's notify hook fires with the same digests the
+        helper computes — on first insert and on LRU-leaf eviction."""
+        ps = 4
+        tokens = rng.integers(1, 100, (8,)).astype(np.int32)
+        pool = PagePool(num_pages=8, page_size=ps)
+        cache = PrefixCache(pool, ps)
+        events = []
+        cache.notify = lambda kind, digs: events.append((kind, list(digs)))
+        pages = pool.alloc(2)
+        cache.register(tokens, pages, with_partial=False)
+        chain = prefix_chain_hashes(tokens, ps)
+        assert events == [("insert", chain)]
+        # re-register: already indexed, no duplicate notification
+        cache.register(tokens, pages, with_partial=False)
+        assert len(events) == 1
+        pool.free(pages)             # cache holds its own refs
+        cache.evict(2)
+        evicted = [d for kind, digs in events[1:] for d in digs
+                   if kind == "evict"]
+        assert sorted(evicted) == sorted(chain)
+
+
+# ---------------------------------------------------------------------------
+# routers (pure units)
+# ---------------------------------------------------------------------------
+class TestRouters:
+    def test_least_loaded_order(self):
+        r = LeastLoadedRouter()
+        d = r.decide([1, 2, 3], [("r1", 3), ("r0", 1), ("r2", 1)])
+        assert d.order == ["r0", "r2", "r1"]    # load, then name
+        assert d.kind == "least_loaded" and d.target == "r0"
+
+    def _affinity(self, ps=4, **kw):
+        r = PrefixAffinityRouter(page_size=ps, **kw)
+        for name in ("r0", "r1"):
+            r.on_replica_added(name)
+        return r
+
+    def test_affinity_routes_to_cached_replica(self):
+        ps = 4
+        tokens = rng.integers(1, 100, (13,)).astype(np.int32)
+        r = self._affinity(ps)
+        # r1 holds the prompt's chain (cap at len-1: 3 full blocks)
+        r.note_cached("r1", prefix_chain_hashes(tokens[:-1], ps))
+        d = r.decide(tokens, [("r0", 0), ("r1", 1)])
+        assert d.kind == "affinity" and d.target == "r1"
+        assert d.order == ["r1", "r0"]
+        assert d.matched_blocks == 3
+        assert r.affinity_hits == 1 and r.affinity_fallbacks == 0
+
+    def test_affinity_longest_chain_wins(self):
+        ps = 4
+        tokens = rng.integers(1, 100, (17,)).astype(np.int32)
+        chain = prefix_chain_hashes(tokens[:-1], ps)
+        r = self._affinity(ps)
+        r.note_cached("r0", chain[:1])
+        r.note_cached("r1", chain)
+        d = r.decide(tokens, [("r0", 0), ("r1", 0)])
+        assert d.target == "r1" and d.matched_blocks == len(chain)
+
+    def test_affinity_chain_must_be_contiguous(self):
+        """A replica holding block 1 but not block 0 matches NOTHING —
+        the chain walks from the root."""
+        ps = 4
+        tokens = rng.integers(1, 100, (13,)).astype(np.int32)
+        chain = prefix_chain_hashes(tokens[:-1], ps)
+        r = self._affinity(ps)
+        r.note_cached("r1", chain[1:])
+        d = r.decide(tokens, [("r0", 0), ("r1", 0)])
+        assert d.kind == "least_loaded" and r.affinity_misses == 1
+
+    def test_imbalance_guard(self):
+        ps = 4
+        tokens = rng.integers(1, 100, (13,)).astype(np.int32)
+        r = self._affinity(ps, max_imbalance=2)
+        r.note_cached("r1", prefix_chain_hashes(tokens[:-1], ps))
+        # affinity target 3 deeper than the idlest: guard overrides
+        d = r.decide(tokens, [("r0", 0), ("r1", 3)])
+        assert d.kind == "affinity_fallback" and d.order[0] == "r0"
+        assert r.affinity_fallbacks == 1
+        # exactly at the bound: affinity still wins
+        d = r.decide(tokens, [("r0", 0), ("r1", 2)])
+        assert d.kind == "affinity" and d.target == "r1"
+
+    def test_evict_and_removal_update_summary(self):
+        ps = 4
+        tokens = rng.integers(1, 100, (13,)).astype(np.int32)
+        chain = prefix_chain_hashes(tokens[:-1], ps)
+        r = self._affinity(ps)
+        r.note_cached("r1", chain)
+        r.note_evicted("r1", chain)
+        d = r.decide(tokens, [("r0", 0), ("r1", 0)])
+        assert d.kind == "least_loaded"
+        r.note_cached("r1", chain)
+        r.on_replica_removed("r1")          # crash/retire wipes the slate
+        d = r.decide(tokens, [("r0", 0)])
+        assert d.kind == "least_loaded"
+        assert r.summary_blocks("r1") == 0
+
+    def test_memo_skips_rehash(self):
+        """A fleet-owned memo caches the chain: a backoff retry of an
+        unchanged request must not recompute the SHA chain."""
+        ps = 4
+        tokens = rng.integers(1, 100, (13,)).astype(np.int32)
+        r = self._affinity(ps)
+        r.note_cached("r1", prefix_chain_hashes(tokens[:-1], ps))
+        memo: dict = {}
+        d1 = r.decide(tokens, [("r0", 0), ("r1", 1)], memo=memo)
+        assert d1.target == "r1" and "chain" in memo
+        # poison the token stream: a cached chain must be what decides
+        d2 = r.decide(np.zeros((13,), np.int32),
+                      [("r0", 0), ("r1", 1)], memo=memo)
+        assert d2.target == "r1" and d2.matched_blocks == 3
+
+    def test_stats_shape(self):
+        r = self._affinity()
+        s = r.stats()
+        for k in ("router", "routed", "affinity_hits",
+                  "affinity_fallbacks", "affinity_misses",
+                  "summary_blocks"):
+            assert k in s
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: a live fleet keeps the summary current and routes affine
+# ---------------------------------------------------------------------------
+CFG = llama_config_tiny(vocab=64, hidden=32, layers=2, heads=4, seq=64)
+_PARAMS = None
+
+
+def _params():
+    global _PARAMS
+    if _PARAMS is None:
+        ep, bp, hp, *_ = build_functional_llama(CFG,
+                                                key=jax.random.PRNGKey(9))
+        _PARAMS = (ep, bp, hp)
+    return _PARAMS
+
+
+def _factory():
+    return ServingEngine(_params(), CFG, num_slots=2, page_size=4,
+                         num_pages=40, max_pages_per_seq=16,
+                         attention_impl="ref", prompt_bucket=8,
+                         decode_horizon=2)
+
+
+class TestFleetAffinityWiring:
+    def test_second_turn_lands_on_cached_replica(self):
+        """Two users, two replicas: each user's second turn must route to
+        the replica that served (and cached) their first turn, and hit
+        its prefix cache."""
+        router = PrefixAffinityRouter()
+        fleet = ReplicaFleet(_factory, num_replicas=2, router=router)
+        base = [rng.integers(1, 64, (8,)).astype(np.int32)
+                for _ in range(2)]
+        f1 = [fleet.submit(p, max_new_tokens=4) for p in base]
+        fleet.run()
+        first = {frid: fleet._requests[frid].replica for frid in f1}
+        assert set(first.values()) == {"r0", "r1"}   # users split
+        # turn 2: first prompt + the streamed reply + a fresh suffix —
+        # the chain of turn 1's (prompt+reply) is cached on its replica
+        turn2 = [np.concatenate([base[i],
+                                 np.asarray(fleet._requests[f1[i]].streamed,
+                                            np.int32)[:-1],
+                                 rng.integers(1, 64, (3,)).astype(np.int32)])
+                 for i in range(2)]
+        f2 = [fleet.submit(p, max_new_tokens=4) for p in turn2]
+        fleet.run()
+        for i in range(2):
+            assert fleet._requests[f2[i]].replica == first[f1[i]], \
+                "affinity did not follow the cached chain"
+        assert router.affinity_hits >= 2
+        # the affine placements actually HIT the engine-side cache
+        hits = sum(rep.engine.stats()["cached_prefix_tokens"]
+                   for rep in fleet._replicas)
+        assert hits > 0
+
+    def test_least_loaded_router_matches_pr9_policy(self):
+        """router=None defaults to LeastLoadedRouter and places exactly
+        like the old inline sort (ascending load, name tie-break)."""
+        fleet = ReplicaFleet(_factory, num_replicas=2)
+        assert isinstance(fleet.router, LeastLoadedRouter)
+        frids = [fleet.submit(p, max_new_tokens=4)
+                 for p in (rng.integers(1, 64, (5,)).astype(np.int32),
+                           rng.integers(1, 64, (6,)).astype(np.int32))]
+        # both replicas idle at submit: r0 takes the first (name
+        # tie-break), r1 the second (r0 now loaded)
+        assert fleet._requests[frids[0]].replica == "r0"
+        assert fleet._requests[frids[1]].replica == "r1"
+        fleet.run()

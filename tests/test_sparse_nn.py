@@ -93,6 +93,7 @@ def test_sparse_conv2d_matches_dense():
                                atol=1e-4)
 
 
+@pytest.mark.slow   # 4.5s 3d-pool compile; same class as the r8 conv3d demotions
 def test_sparse_maxpool3d_matches_dense():
     st, dense = _random_sparse_volume(D=4, H=4, W=4, density=0.4)
     pool = sparse.nn.MaxPool3D(kernel_size=2, stride=2)

@@ -67,6 +67,7 @@ def test_mp2_loss_matches_mp1():
     assert losses_tp[-1] < losses_tp[0]
 
 
+@pytest.mark.slow   # 8s compile-heavy; TP training/loss coverage stays tier-1 above
 def test_mp_shards_halve_block_param_bytes():
     _, step_rep = _run_steps({"pp": 2, "mp": 1}, mp_axis=None, n_steps=1)
     _, step_tp = _run_steps({"pp": 2, "mp": 2}, mp_axis="mp", n_steps=1)
