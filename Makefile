@@ -66,6 +66,7 @@ OBS_FRONTEND_ARTIFACT ?= /tmp/_obs_frontend.json
 OBS_FAILOVER_ARTIFACT ?= /tmp/_obs_failover.json
 OBS_FAILOVER_PERFETTO ?= /tmp/_obs_failover_perfetto.json
 OBS_ELASTIC_ARTIFACT ?= /tmp/_obs_elastic.json
+OBS_QUANT_ARTIFACT ?= /tmp/_obs_quant.json
 
 # obs-check additionally runs the ISSUE 11 frontend trace (AsyncFrontend
 # bit-equality + zero-leak asserts, predictive-vs-depth admission A/B on
@@ -90,6 +91,12 @@ OBS_ELASTIC_ARTIFACT ?= /tmp/_obs_elastic.json
 # elastic >= every fixed-N arm on goodput-per-replica-hour, and the
 # affinity fleet's hit rate >= 0.9x the single engine's — all
 # deterministic (perf/check_obs.py --trace elastic).
+# Since ISSUE 15 it also runs the quant trace (the int8-KV + int8-weight
+# serving plane): greedy exact-match >= 0.99 vs the f32 engine on the
+# parity scenarios, >= 1.8x concurrent users at FIXED pool bytes,
+# dequant-tax tokens/s >= 0.95x (best paired), and the failover/elastic/
+# ladder drills re-run with quantized pages — zero-lost, bit-equal,
+# ladder order preserved (perf/check_obs.py --trace quant).
 obs-check:
 	set -o pipefail; \
 	env JAX_PLATFORMS=cpu $(PY) bench.py --trace serving \
@@ -108,7 +115,11 @@ obs-check:
 	env JAX_PLATFORMS=cpu $(PY) bench.py --trace elastic \
 		--json $(OBS_ELASTIC_ARTIFACT) && \
 	env JAX_PLATFORMS=cpu $(PY) perf/check_obs.py \
-		--artifact $(OBS_ELASTIC_ARTIFACT) --trace elastic
+		--artifact $(OBS_ELASTIC_ARTIFACT) --trace elastic && \
+	env JAX_PLATFORMS=cpu $(PY) bench.py --trace quant \
+		--json $(OBS_QUANT_ARTIFACT) && \
+	env JAX_PLATFORMS=cpu $(PY) perf/check_obs.py \
+		--artifact $(OBS_QUANT_ARTIFACT) --trace quant
 
 lint:
 	$(GRAFTLINT) --fail-on-stale $(if $(DIFF),--diff $(DIFF))

@@ -1403,6 +1403,337 @@ def bench_serving_elastic(seed=0):
     }
 
 
+def bench_serving_quant(seed=0):
+    """Quantized serving plane trace (ROADMAP item 2; PERF.md §22):
+    int8-KV pages with per-(page, head, row) absmax scales + per-channel
+    int8 serving weights, measured against the f32 engine on four axes —
+    all asserted/schema-gated by ``perf/check_obs.py --trace quant``:
+
+      * **parity** — greedy exact-match rate and max teacher-forced logit
+        drift on the standard parity scenarios
+        (``serving.quant.parity_report``).  Gate: exact_match >= 0.99.
+        The parity model is margin-engineered (embedding-dominated
+        residual, tied LM head — the spec-decode trace's construction):
+        argmax-under-perturbation on a raw random-weight model measures
+        the noise floor of near-uniform logits, not serving quality;
+        PERF.md §22 records the raw-model number for honesty.
+      * **capacity** — concurrent users sustained at FIXED pool bytes:
+        both arms get the same byte budget, the int8 arm simply fits
+        ~3.6x more pages (page_bytes accounting includes the scales).
+        Gate: peak concurrent active users >= 1.8x f32, zero lost.
+      * **throughput** — the dequant tax: same workload, same page
+        COUNT, paired rounds; gate best-paired int8/f32 tokens/s >= 0.95.
+      * **resilience re-runs** — the failover drill (2-replica quantized
+        fleet, seeded ``serve.crash``, full-KV snapshots shipping scales)
+        and a mini elastic drill (quantized ``ElasticFleet`` on the
+        virtual-clock diurnal trace) both hold zero-lost + bit-equal vs
+        the uninterrupted QUANTIZED single engine — per-row scales make
+        quantization write-order independent, so the engine's whole
+        self-exactness matrix survives quantization; plus a pool-pressure
+        drill asserting the degradation ladder still walks admit ->
+        evict -> preempt in order with bit-identical outputs."""
+    import tempfile
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.models.llama import LlamaConfig, build_functional_llama
+    from paddle_tpu.inference.paged import ServingEngine
+    from paddle_tpu.observability import Telemetry
+    from paddle_tpu.resilience import inject
+    from paddle_tpu.serving import (AutoscalePolicy, ElasticFleet,
+                                    ReplicaFleet, VirtualClock,
+                                    make_scenario, replay_fleet)
+    from paddle_tpu.serving.quant import page_bytes, parity_report
+
+    on_tpu = any(d.platform == "tpu" for d in jax.devices())
+    cfg = LlamaConfig(vocab_size=512, hidden_size=128,
+                      intermediate_size=384, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=2,
+                      max_position_embeddings=256)
+    dtype = jnp.bfloat16 if on_tpu else jnp.float32
+    page_size, horizon, t_bucket = 8, 4, 16
+    # margin-engineered parity/serving model (see docstring + PERF.md §22)
+    ep, bp, hp, *_ = build_functional_llama(cfg, dtype=dtype, n_micro=1,
+                                            key=jax.random.PRNGKey(7))
+    bp = {k: (v * 0.15 if k.startswith("w") else v) for k, v in bp.items()}
+    hp = dict(hp, lm=(ep["tok"].T * 4.0).astype(hp["lm"].dtype))
+    params = (ep, bp, hp)
+    rng = np.random.default_rng(seed)
+
+    def sync_pages(eng):
+        leaf = jax.tree_util.tree_leaves(eng._pages_k)[0]
+        _sync(leaf.reshape(-1)[0].astype(jnp.float32))
+
+    # ---- 1. parity harness (the subsystem's contract) -------------------
+    parity = parity_report(params, cfg, kv_dtype="int8", quantize=8,
+                           engine_kw=dict(attention_impl="auto" if on_tpu
+                                          else "ref"))
+    assert parity["exact_match"] >= 0.99, \
+        f"quantized greedy exact-match {parity['exact_match']} < 0.99: " \
+        f"{parity}"
+
+    # ---- 2. capacity at FIXED pool bytes --------------------------------
+    pb_f32 = page_bytes(cfg, page_size, dtype=dtype)
+    pb_q = page_bytes(cfg, page_size, kv_dtype="int8")
+    n_users = 12
+    prompts = [rng.integers(1, cfg.vocab_size, (int(t),)).astype(np.int32)
+               for t in rng.integers(12, 21, n_users)]
+    max_new = 12
+    per_user = max(
+        (len(p) + max_new - 1 + page_size - 1) // page_size for p in prompts)
+    pool_bytes = (3 * per_user + 1) * pb_f32       # ~3 users' worth of f32
+    pages_f32 = pool_bytes // pb_f32
+    pages_q = pool_bytes // pb_q
+
+    def mk_engine(kv_dtype, num_pages, slots=n_users, telemetry=None,
+                  max_pages=None, **kw):
+        return ServingEngine(
+            params, cfg, num_slots=slots, page_size=page_size,
+            num_pages=int(num_pages),
+            max_pages_per_seq=max_pages or per_user + 1,
+            dtype=dtype, attention_impl="auto" if on_tpu else "ref",
+            prompt_bucket=t_bucket, decode_horizon=horizon,
+            kv_dtype=kv_dtype, quantize=8 if kv_dtype else None,
+            telemetry=telemetry, **kw)
+
+    def drive_capacity(kv_dtype, num_pages, telemetry=None):
+        eng = mk_engine(kv_dtype, num_pages, telemetry=telemetry)
+        # warm the executables outside the measured drive
+        eng.submit(rng.integers(1, cfg.vocab_size,
+                                (t_bucket,)).astype(np.int32),
+                   max_new_tokens=horizon + 1)
+        eng.run()
+        eng.release_cache()
+        rids = [eng.submit(p, max_new_tokens=max_new) for p in prompts]
+        peak = 0
+        steps = 0
+        while eng._queue or eng.num_active or eng.inflight_depth:
+            eng.step()
+            peak = max(peak, eng.num_active)
+            steps += 1
+            assert steps < 10_000, "capacity drive wedged"
+        done = {r: req for r in rids
+                if (req := eng._finished.get(r)) is not None}
+        assert len(done) == n_users, \
+            f"capacity arm lost {n_users - len(done)} requests"
+        return eng, peak, done
+
+    eng_f32, users_f32, done_f32 = drive_capacity(None, pages_f32)
+    tel_q = Telemetry()
+    eng_q, users_q, done_q = drive_capacity("int8", pages_q,
+                                            telemetry=tel_q)
+    capacity_ratio = users_q / users_f32
+    assert capacity_ratio >= 1.8, \
+        f"int8 sustained {users_q} users vs f32 {users_f32} at " \
+        f"{pool_bytes} pool bytes — ratio {capacity_ratio:.2f} < 1.8"
+    eng_f32.check_invariants()
+    eng_q.check_invariants()
+    capacity = {
+        "pool_bytes": int(pool_bytes),
+        "page_bytes_f32": int(pb_f32),
+        "page_bytes_int8": int(pb_q),
+        "pages_f32": int(pages_f32),
+        "pages_int8": int(pages_q),
+        "n_users_offered": n_users,
+        "users_f32": int(users_f32),
+        "users_int8": int(users_q),
+        "capacity_ratio": round(capacity_ratio, 3),
+        "preemptions_f32": eng_f32.preemptions,
+        "preemptions_int8": eng_q.preemptions,
+        "completed_f32": len(done_f32),
+        "completed_int8": len(done_q),
+    }
+    # the telemetry memory observatory must report the capacity win in
+    # BYTES (pages x page_bytes for the active kv_dtype)
+    mem_q = tel_q.memory_report(eng_q.stats())
+    assert mem_q["last"]["page_bytes"] == pb_q, mem_q["last"]
+
+    # ---- 3. throughput: the dequant tax (same page COUNT, paired) -------
+    ample = (n_users + 2) * per_user
+
+    def drive_tps(eng):
+        t0 = time.perf_counter()
+        rids = [eng.submit(p, max_new_tokens=max_new) for p in prompts]
+        done = eng.run()
+        sync_pages(eng)
+        dt = time.perf_counter() - t0
+        outs = [list(done[r].generated) for r in rids]
+        eng.release_cache()
+        return n_users * max_new / dt, outs
+
+    te_f32 = mk_engine(None, ample)
+    te_q = mk_engine("int8", ample)
+    for e in (te_f32, te_q):                # warm pass
+        drive_tps(e)
+    pair_ratios = []
+    tps_f32_all, tps_q_all = [], []
+    outs_q0 = None
+    for _ in range(3):
+        tps_f, _o = drive_tps(te_f32)
+        tps_q, outs_q = drive_tps(te_q)
+        if outs_q0 is None:
+            outs_q0 = outs_q
+        assert outs_q == outs_q0, "quantized outputs drifted across rounds"
+        tps_f32_all.append(tps_f)
+        tps_q_all.append(tps_q)
+        pair_ratios.append(tps_q / tps_f)
+    best = max(range(len(pair_ratios)), key=lambda i: pair_ratios[i])
+    assert pair_ratios[best] >= 0.95, \
+        f"int8 tokens/s best paired ratio {pair_ratios[best]:.3f} < 0.95 " \
+        f"(f32 {tps_f32_all}, int8 {tps_q_all})"
+    throughput = {
+        "rounds": len(pair_ratios),
+        "tokens_per_sec_f32": round(tps_f32_all[best], 1),
+        "tokens_per_sec_int8": round(tps_q_all[best], 1),
+        "best_paired_ratio": round(pair_ratios[best], 4),
+        "pair_ratios": [round(r, 4) for r in pair_ratios],
+        "median_ratio": round(sorted(pair_ratios)[len(pair_ratios) // 2], 4),
+        "host_cpu_count": os.cpu_count(),
+    }
+
+    # ---- 4a. degradation ladder under pool pressure, quantized ----------
+    # its own TIGHT geometry (page_size 4, horizon 2): growth must cross a
+    # page boundary INSIDE the pressure window for the preempt rung to be
+    # reachable — the same shape the resilience ladder drills use
+    lp = [rng.integers(1, cfg.vocab_size, (int(t),)).astype(np.int32)
+          for t in (10, 14, 9, 12)]
+
+    def mk_ladder(telemetry=None):
+        return ServingEngine(params, cfg, num_slots=2, page_size=4,
+                             num_pages=40, max_pages_per_seq=16,
+                             dtype=dtype,
+                             attention_impl="auto" if on_tpu else "ref",
+                             prompt_bucket=8, decode_horizon=2,
+                             kv_dtype="int8", quantize=8,
+                             telemetry=telemetry)
+
+    l_ref = mk_ladder()
+    ref_rids = [l_ref.submit(p, max_new_tokens=8) for p in lp]
+    l_refs = [list(l_ref.run()[r].generated) for r in ref_rids]
+    l_eng = mk_ladder(telemetry=Telemetry())
+    l_rids = [l_eng.submit(p, max_new_tokens=8) for p in lp]
+    with inject({"serve.pool_pressure": dict(action="trigger", after=1,
+                                             count=4)}, seed=seed):
+        for _ in range(8):
+            l_eng.step()
+    l_done = l_eng.run()
+    assert [list(l_done[r].generated) for r in l_rids] == l_refs, \
+        "pool-pressure ladder changed quantized greedy outputs"
+    ev = [e["event"] for e in l_eng.telemetry.flight.events()]
+    assert "evict" in ev and "preempt" in ev \
+        and ev.index("evict") < ev.index("preempt"), \
+        f"ladder order not preserved under quantized pages: {ev}"
+    l_eng.check_invariants()
+    ladder = {"order_preserved": True, "outputs_bitexact": True,
+              "evictions": l_eng.cache_evictions,
+              "preemptions": l_eng.preemptions}
+
+    # ---- 4b. failover re-run with quantized pages -----------------------
+    fo_prompts = [rng.integers(1, cfg.vocab_size, (int(t),)).astype(np.int32)
+                  for t in rng.integers(8, 24, 8)]
+    fo_new = [int(m) for m in rng.integers(8, 16, 8)]
+
+    def factory():
+        return mk_engine("int8", 96, slots=2, telemetry=Telemetry(),
+                         max_pages=16, name="engine")
+
+    fo_ref = factory()
+    fr = [fo_ref.submit(p, max_new_tokens=m)
+          for p, m in zip(fo_prompts, fo_new)]
+    fo_done = fo_ref.run()
+    fo_refs = [np.asarray(fo_done[r].output_ids) for r in fr]
+    crash_at = int(rng.integers(5, 10))
+    with tempfile.TemporaryDirectory() as snap_root:
+        fleet = ReplicaFleet(factory, num_replicas=2,
+                             snapshot_root=snap_root, snapshot_every=4,
+                             snapshot_mode="full_kv")
+        with inject({"serve.crash": dict(match={"engine": "r0"},
+                                         at=crash_at)}, seed=seed) as plan:
+            frids = [fleet.submit(p, max_new_tokens=m)
+                     for p, m in zip(fo_prompts[:5], fo_new[:5])]
+            fleet.run(max_rounds=4)
+            frids += [fleet.submit(p, max_new_tokens=m)
+                      for p, m in zip(fo_prompts[5:], fo_new[5:])]
+            fdone = fleet.run()
+    assert plan.fired("serve.crash") == 1, "the crash drill did not fire"
+    assert len(fdone) == len(frids), \
+        f"quantized failover lost {len(frids) - len(fdone)} requests"
+    for frid, ref in zip(frids, fo_refs):
+        np.testing.assert_array_equal(np.asarray(fdone[frid].output_ids),
+                                      ref)
+    fo_ev = [e["event"] for e in fleet.flight.events()]
+    failover_q = {
+        "lost_requests": 0,
+        "outputs_bitexact": True,
+        "recovered_from_snapshot": "restore" in fo_ev,
+        "failovers": fleet.stats()["failovers"],
+        "snapshot_mode": "full_kv (quantized pages + per-row scales ship "
+                         "together)",
+    }
+
+    # ---- 4c. elastic re-run with quantized pages ------------------------
+    sc = make_scenario("quant-elastic", seed=seed + 5, n_requests=24,
+                       vocab=cfg.vocab_size, arrival="diurnal",
+                       mean_interarrival_s=0.8, diurnal_period_s=24.0,
+                       diurnal_amplitude=0.97, prompt_len=(5, 12),
+                       max_new=(8, 14), shared_prefix_users=4,
+                       system_prompt_len=16)
+    el_ref = mk_engine("int8", 160, slots=2, max_pages=16)
+    el_rids = [el_ref.submit(r.prompt, max_new_tokens=r.max_new_tokens)
+               for r in sc.requests]
+    el_done = el_ref.run()
+    el_refs = {r.idx: list(el_done[rid].generated)
+               for r, rid in zip(sc.requests, el_rids)}
+    dt_round = 0.5
+    vc = VirtualClock(dt_round)
+    efleet = ElasticFleet(
+        lambda: mk_engine("int8", 160, slots=2, telemetry=Telemetry(),
+                          max_pages=16),
+        policy=AutoscalePolicy(
+            min_replicas=1, max_replicas=3, queue_growth=2.0,
+            queue_min_depth=3.0, growth_window_s=2.0, growth_fire_frac=0.34,
+            idle_per_replica=1.0, idle_window_s=2.5, min_samples=3,
+            scale_cooldown_s=2.0, dt_per_round=dt_round),
+        clock=vc)
+    res = replay_fleet(efleet, sc, slo_ttft_s=3.0, virtual_clock=vc,
+                       collect_tokens=True)
+    lost = [rec["idx"] for rec in res["records"]
+            if rec["rejected"] or rec["tokens"] == 0]
+    assert not lost, f"quantized elastic lost/empty requests {lost}"
+    for rec in res["records"]:
+        assert rec["stream"] == el_refs[rec["idx"]], \
+            f"quantized elastic request {rec['idx']} diverged"
+    est = efleet.stats()
+    assert est["scale_ups"] >= 1 and est["scale_downs"] >= 1, \
+        f"quantized elastic never scaled: {est['scale_ups']} up / " \
+        f"{est['scale_downs']} down"
+    elastic_q = {
+        "lost_requests": 0,
+        "outputs_bitexact": True,
+        "scale_ups": est["scale_ups"],
+        "scale_downs": est["scale_downs"],
+        "drain_migrations": est["drain_migrations"],
+    }
+
+    return {
+        "trace": {"n_users": n_users, "max_new_tokens": max_new,
+                  "page_size": page_size, "decode_horizon": horizon,
+                  "kv_dtype": "int8", "weight_bits": 8, "seed": int(seed),
+                  "model": "margin-engineered (blocks x0.15, tied LM head "
+                           "x4 — PERF.md §22 methodology)"},
+        "parity": parity,
+        "capacity": capacity,
+        "throughput": throughput,
+        "ladder": ladder,
+        "failover_q": failover_q,
+        "elastic_q": elastic_q,
+        # telemetry sections from the int8 CAPACITY engine: the memory
+        # observatory must carry the bytes-denominated pool gauges
+        "engine_stats": eng_q.stats(),
+        "memory": mem_q,
+        "metrics": tel_q.snapshot(eng_q.stats()),
+    }
+
+
 def bench_serving_frontend(seed=0):
     """Async front end + SLO-aware admission trace (ISSUE 11; PERF.md
     §18): the AsyncFrontend transport and the predictive-vs-depth
@@ -1692,7 +2023,8 @@ def main():
                  ("serving_spec_decode", bench_serving_spec_decode, 250),
                  ("serving_frontend", bench_serving_frontend, 250),
                  ("serving_failover", bench_serving_failover, 250),
-                 ("serving_elastic", bench_serving_elastic, 250)) \
+                 ("serving_elastic", bench_serving_elastic, 250),
+                 ("serving_quant", bench_serving_quant, 450)) \
         if on_tpu else (("serving", bench_serving, 250),
                         ("serving_shared_prefix",
                          bench_serving_shared_prefix, 250),
@@ -1700,7 +2032,8 @@ def main():
                          bench_serving_spec_decode, 250),
                         ("serving_frontend", bench_serving_frontend, 250),
                         ("serving_failover", bench_serving_failover, 250),
-                        ("serving_elastic", bench_serving_elastic, 250))
+                        ("serving_elastic", bench_serving_elastic, 250),
+                        ("serving_quant", bench_serving_quant, 450))
     import signal
 
     def _alarm(_sig, _frm):
@@ -1760,7 +2093,7 @@ if __name__ == "__main__":
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--trace",
                     choices=["shared-prefix", "serving", "spec-decode",
-                             "failover", "frontend", "elastic"],
+                             "failover", "frontend", "elastic", "quant"],
                     default=None,
                     help="run ONE serving trace and print its JSON line "
                          "(shared-prefix: prefix-cache hit-rate / "
@@ -1776,7 +2109,12 @@ if __name__ == "__main__":
                          "elastic: sentinel-driven autoscaling + prefix-"
                          "affinity routing on a diurnal shared-prefix "
                          "trace — zero-loss drains, bit-equal outputs, "
-                         "goodput-per-replica-hour vs fixed-N fleets)")
+                         "goodput-per-replica-hour vs fixed-N fleets; "
+                         "quant: the int8-KV + int8-weight serving plane "
+                         "— greedy exact-match parity vs f32, concurrent "
+                         "users at fixed pool bytes, dequant-tax tokens/s "
+                         "A/B, and the failover/elastic drills re-run "
+                         "with quantized pages)")
     ap.add_argument("--json", metavar="PATH", default=None,
                     help="also dump the metrics dict to PATH as a JSON "
                          "artifact (BENCH_r0x-style)")
@@ -1803,7 +2141,8 @@ if __name__ == "__main__":
               "spec-decode": bench_serving_spec_decode,
               "failover": bench_serving_failover,
               "frontend": bench_serving_frontend,
-              "elastic": bench_serving_elastic}[args.trace]
+              "elastic": bench_serving_elastic,
+              "quant": bench_serving_quant}[args.trace]
         kw = {}
         if args.seed is not None:
             kw["seed"] = args.seed

@@ -779,7 +779,17 @@ class ServingEngine:
     the whole feature matrix.  `telemetry=True` (or a configured
     `observability.Telemetry`) records request-lifecycle traces, latency
     histograms, and the crash flight recorder — also without touching
-    outputs."""
+    outputs.
+
+    `kv_dtype="int8"|"fp8"` stores KV pages quantized with per-(page,
+    head, token-row) absmax scales held in the pool (~4x more pages per
+    byte at int8 — PagePool capacity is the admission bottleneck, so this
+    is a direct concurrent-user win); `quantize=8` snaps the serving
+    weights onto the per-channel int8 grid (serving/quant.py).  Both keep
+    the engine deterministic and bit-exact against ITSELF across every
+    feature above; parity vs the f32 engine is exact-match-rate gated
+    (`serving.quant.parity_report`, `bench.py --trace quant`), not
+    bit-equality — quantization is lossy by definition."""
 
     def __init__(self, params, config, num_slots: int = 4,
                  page_size: int = 16, num_pages: int | None = None,
@@ -791,13 +801,31 @@ class ServingEngine:
                  speculative: int | None = None, spec_max_ngram: int = 3,
                  overlap: bool = False,
                  telemetry: "Telemetry | bool | None" = None,
-                 name: str = "engine"):
+                 name: str = "engine", kv_dtype: str | None = None,
+                 quantize=None):
         import jax
         import jax.numpy as jnp
         from ..models.llama import (build_llama_paged_decode,
                                     make_paged_decode_horizon,
                                     _sample_per_request)
         self._jax, self._jnp = jax, jnp
+        # quantized serving plane (ROADMAP item 2): kv_dtype stores KV
+        # pages int8/fp8 with per-(page, head, row) absmax scales held in
+        # the pool's device arrays; quantize=<bits|True|"int8"> snaps the
+        # serving weights onto the per-channel int grid at construction
+        # (serving/quant.py).  Both knobs keep the engine deterministic
+        # and self-bit-exact across the whole feature matrix — parity vs
+        # the f32 engine is gated by serving.quant.parity_report instead
+        # of bit-equality (quantization is lossy by definition).
+        self.kv_dtype = None if kv_dtype is None else str(kv_dtype)
+        if quantize:
+            bits = 8 if quantize is True or quantize == "int8" \
+                else int(quantize)
+            from ..serving.quant import quantize_params
+            params = quantize_params(params, bits=bits)
+            self.quantize_bits = bits
+        else:
+            self.quantize_bits = None
         # replica identity: rides the serve.crash / serve.wedge fault-point
         # ctx so a fleet drill can target one replica (match={"engine": ...})
         self.name = str(name)
@@ -858,9 +886,16 @@ class ServingEngine:
         init_pages, prefill, prefill_chunk_fn, decode_step, verify_step = \
             build_llama_paged_decode(
                 config, page_size=page_size, num_pages=num_pages, dtype=dtype,
-                attention_impl=attention_impl, interpret=interpret)
+                attention_impl=attention_impl, interpret=interpret,
+                kv_dtype=self.kv_dtype)
         cache = init_pages()
+        # each side is a raw [L, Hkv, NP+1, ps, D] array (f32/bf16) or a
+        # {"q": data, "s": scales} dict (kv_dtype set); the engine treats
+        # them as opaque pytrees everywhere except snapshot/restore
         self._pages_k, self._pages_v = cache["k"], cache["v"]
+        self._kv_compute_dtype = jnp.dtype(dtype) if dtype is not None \
+            else jnp.float32
+        self._page_bytes = None        # lazy page_bytes cache
 
         # decode HORIZON: K decode+sample steps fused into one fori_loop
         # dispatch (admission/retirement happen between horizons).  The
@@ -899,10 +934,15 @@ class ServingEngine:
                                        top_p[None])[0]
 
         # copy-on-write page copy (src/dst are traced scalars: ONE
-        # executable covers every copy)
+        # executable covers every copy).  tree_map keeps it generic over
+        # the page-store layout: a raw array copies its page rows, a
+        # quantized {"q","s"} store copies data AND scales — the page axis
+        # is axis 2 of every leaf by construction.
         def _copy_page(pk, pv, src, dst):             # graftlint: jit
-            return (pk.at[:, :, dst].set(pk[:, :, src]),
-                    pv.at[:, :, dst].set(pv[:, :, src]))
+            def cp(a):
+                return a.at[:, :, dst].set(a[:, :, src])
+            return (jax.tree_util.tree_map(cp, pk),
+                    jax.tree_util.tree_map(cp, pv))
 
         self._horizon_fn = _horizon
         self._horizon_jit = {}         # (K, greedy) -> jitted horizon
@@ -2148,6 +2188,23 @@ class ServingEngine:
     def num_active(self) -> int:
         return sum(1 for sl in self._slots if sl is not None)
 
+    @property
+    def page_bytes(self) -> int:
+        """Bytes ONE pool page costs on device (K + V across all layers;
+        per-page scales included when ``kv_dtype`` is set) — the unit the
+        telemetry memory observatory multiplies page counts by, so
+        capacity wins from quantized pages are visible in BYTES, not just
+        page counts (`mem.pool_allocated_bytes` / `mem.pool_capacity_bytes`
+        gauges, fleet snapshots).  Pure geometry — computed once and
+        cached (the telemetry memory sampler reads it every step)."""
+        pb = self._page_bytes
+        if pb is None:
+            from ..serving.quant import page_bytes
+            pb = self._page_bytes = page_bytes(
+                self.config, self.page_size, kv_dtype=self.kv_dtype,
+                dtype=self._kv_compute_dtype)
+        return pb
+
     def step(self) -> bool:                           # graftlint: hot
         """One engine step: retire overdue requests, admit queued requests
         into free slots (attaching cached prefixes), advance each
@@ -2506,6 +2563,11 @@ class ServingEngine:
                 "num_pages": self.pool.num_pages,
                 "max_pages_per_seq": self.max_pages_per_seq,
                 "prefix_cache": self.cache is not None,
+                # a full-KV snapshot's raw pages only scatter back into a
+                # pool of the SAME kv_dtype (the stored bytes are that
+                # dtype's codes + scales); any mismatch falls back to the
+                # re-prefill path, which requantizes for the new store
+                "kv_dtype": self.kv_dtype,
             },
             "requests": requests,
             "slots": slots,
@@ -2546,8 +2608,17 @@ class ServingEngine:
             # DEVICE first so the host transfer (snapshot IS a sync point)
             # is proportional to live context, not pool capacity.
             idx = self._jnp.asarray(ids, self._jnp.int32)
-            state["kv_k"] = np.asarray(self._pages_k[:, :, idx])
-            state["kv_v"] = np.asarray(self._pages_v[:, :, idx])
+            if self.kv_dtype is not None:
+                # quantized store: the data pages AND their per-row scales
+                # ship together — a full-KV restore that lost the scales
+                # would scatter back garbage magnitudes
+                state["kv_k_q"] = np.asarray(self._pages_k["q"][:, :, idx])
+                state["kv_k_s"] = np.asarray(self._pages_k["s"][:, :, idx])
+                state["kv_v_q"] = np.asarray(self._pages_v["q"][:, :, idx])
+                state["kv_v_s"] = np.asarray(self._pages_v["s"][:, :, idx])
+            else:
+                state["kv_k"] = np.asarray(self._pages_k[:, :, idx])
+                state["kv_v"] = np.asarray(self._pages_v[:, :, idx])
         state["meta"] = json.dumps(meta)
         return state
 
@@ -2596,7 +2667,10 @@ class ServingEngine:
                 and g["page_size"] == self.page_size
                 and g["num_pages"] == self.pool.num_pages
                 and g["max_pages_per_seq"] == self.max_pages_per_seq
-                and bool(g["prefix_cache"]) == (self.cache is not None))
+                and bool(g["prefix_cache"]) == (self.cache is not None)
+                # .get: pre-quant snapshots carry no kv_dtype (== f32/bf16
+                # raw pages, the None default)
+                and g.get("kv_dtype") == self.kv_dtype)
         if fast:
             self._restore_full(meta, state, reqs)
             applied = "full_kv"
@@ -2628,10 +2702,19 @@ class ServingEngine:
         pool._refs = {int(p): int(c) for p, c in meta["pool"]["refs"]}
         ids = np.asarray(state["kv_pages"], np.int32)
         if len(ids):
-            self._pages_k = self._pages_k.at[:, :, ids].set(
-                jnp.asarray(state["kv_k"], self._pages_k.dtype))
-            self._pages_v = self._pages_v.at[:, :, ids].set(
-                jnp.asarray(state["kv_v"], self._pages_v.dtype))
+            if self.kv_dtype is not None:
+                def put(store, qkey, skey):
+                    return {"q": store["q"].at[:, :, ids].set(
+                                jnp.asarray(state[qkey], store["q"].dtype)),
+                            "s": store["s"].at[:, :, ids].set(
+                                jnp.asarray(state[skey], store["s"].dtype))}
+                self._pages_k = put(self._pages_k, "kv_k_q", "kv_k_s")
+                self._pages_v = put(self._pages_v, "kv_v_q", "kv_v_s")
+            else:
+                self._pages_k = self._pages_k.at[:, :, ids].set(
+                    jnp.asarray(state["kv_k"], self._pages_k.dtype))
+                self._pages_v = self._pages_v.at[:, :, ids].set(
+                    jnp.asarray(state["kv_v"], self._pages_v.dtype))
         for s, sd in enumerate(meta["slots"]):
             if sd is None:
                 continue

@@ -666,7 +666,7 @@ def build_llama_decode(config: LlamaConfig, max_seq: int = None, dtype=None):
 def build_llama_paged_decode(config: LlamaConfig, page_size: int = 16,
                              num_pages: int = 64, dtype=None,
                              attention_impl: str = "auto",
-                             interpret: bool = False):
+                             interpret: bool = False, kv_dtype=None):
     """Paged-KV decode path (the `block_multihead_attention` serving analog;
     Ragged Paged Attention arxiv 2604.15464): the KV cache lives in a pool of
     fixed-size pages shared by every in-flight request, so mixed-length
@@ -727,6 +727,22 @@ def build_llama_paged_decode(config: LlamaConfig, page_size: int = 16,
     All shapes static; jit once and every decode step of a whole serving
     run reuses the same executable regardless of which requests occupy
     which slots.
+
+    ``kv_dtype`` ("int8" / "fp8", ROADMAP item 2): the page store holds
+    QUANTIZED K/V — each side becomes a ``{"q": [L, Hkv, NP+1, ps, D]
+    storage-dtype, "s": [L, Hkv, NP+1, ps] f32}`` dict of data pages plus
+    per-(page, head, token-row) absmax scales.  Every scatter path
+    (prefill / prefill_chunk / decode_step / verify_step) quantizes
+    through ``serving.quant.quantize_kv`` before writing, and every
+    attention path dequantizes through the ONE ``dequantize_kv``
+    expression — fused inside the Pallas kernel on TPU, applied to the
+    gathered rows on the jnp paths.  Per-row scales make quantization
+    write-order independent, so the engine's whole bit-exactness matrix
+    (cache on/off, chunked, preemption re-prefill, COW, snapshot, spec
+    decode) holds for the quantized engine against itself.  The dense
+    ``prefill`` additionally fake-quants its LOCAL K/V before attending
+    (quantize -> dequantize round trip), so its numerics equal a chunked
+    prefill of the same prompt reading the rows back from the pages.
     """
     from ..ops.pallas.paged_attention import (ragged_paged_attention_decode,
                                               paged_attention_decode_ref)
@@ -737,6 +753,9 @@ def build_llama_paged_decode(config: LlamaConfig, page_size: int = 16,
     nkv = c.num_key_value_heads
     nh = c.num_attention_heads
     TRASH = num_pages
+    if kv_dtype is not None:
+        from ..serving.quant import dequantize_kv, kv_spec, quantize_kv
+        kv_storage, kv_qmax = kv_spec(kv_dtype)
     sin_t, cos_t = _rope_tables(c.max_position_embeddings, head_dim,
                                 c.rope_theta, d)
     if attention_impl == "auto":
@@ -751,13 +770,68 @@ def build_llama_paged_decode(config: LlamaConfig, page_size: int = 16,
 
     def init_pages():
         shape = (L, nkv, num_pages + 1, page_size, head_dim)
-        return {"k": jnp.zeros(shape, d), "v": jnp.zeros(shape, d)}
+        if kv_dtype is None:
+            return {"k": jnp.zeros(shape, d), "v": jnp.zeros(shape, d)}
+        sshape = (L, nkv, num_pages + 1, page_size)
+
+        def side():
+            return {"q": jnp.zeros(shape, kv_storage),
+                    "s": jnp.zeros(sshape, jnp.float32)}
+        return {"k": side(), "v": side()}
+
+    def _scatter(store, vals, page, off):
+        """Write per-token K or V rows (``vals [..., nkv, D]``) into the
+        (per-layer) page store at ``[:, page, off]``; returns the updated
+        store plus the LOCAL view of what was written — ``vals`` itself
+        on the f32/bf16 path, the dequantized round trip on a quantized
+        store (so a caller attending over its own fresh rows sees exactly
+        what any later gather of the pages will see)."""
+        if kv_dtype is None:
+            return store.at[:, page, off].set(
+                jnp.moveaxis(vals.astype(d), -2, 0)), vals
+        qv, sv = quantize_kv(vals, qmax=kv_qmax, dtype=kv_storage)
+        new = {"q": store["q"].at[:, page, off].set(jnp.moveaxis(qv, -2, 0)),
+               "s": store["s"].at[:, page, off].set(jnp.moveaxis(sv, -1, 0))}
+        # .astype(d): the jnp paths consume dequantized rows in the
+        # COMPUTE dtype, exactly like the f32/bf16 store — activations
+        # keep their dtype (no silent f32 promotion) and decode/chunk/
+        # verify/dense all see the same rounded values on a bf16 engine
+        return new, dequantize_kv(qv, sv).astype(d)
+
+    def _gather_row(store, page_row, P):
+        """One request's whole context through its page table
+        ([P] row) -> [nkv, P*ps, D], dequantized on a quantized store."""
+        if kv_dtype is None:
+            return store[:, page_row].reshape(nkv, P * page_size, head_dim)
+        g = store["q"][:, page_row].reshape(nkv, P * page_size, head_dim)
+        s = store["s"][:, page_row].reshape(nkv, P * page_size)
+        return dequantize_kv(g, s).astype(d)
+
+    def _gather_tables(store, page_tables, S, P):
+        """Batched gather for the verify path: [S, P] tables ->
+        [S, nkv, P*ps, D], dequantized on a quantized store."""
+        if kv_dtype is None:
+            return store[:, page_tables].transpose(1, 0, 2, 3, 4) \
+                .reshape(S, nkv, P * page_size, head_dim)
+        g = store["q"][:, page_tables].transpose(1, 0, 2, 3, 4) \
+            .reshape(S, nkv, P * page_size, head_dim)
+        s = store["s"][:, page_tables].transpose(1, 0, 2, 3) \
+            .reshape(S, nkv, P * page_size)
+        return dequantize_kv(g, s).astype(d)
 
     def _attn(q, kc_l, vc_l, page_tables, eff_len):
+        if kv_dtype is not None:
+            kq, vq = kc_l["q"], vc_l["q"]
+            scale_kw = dict(k_scales=kc_l["s"], v_scales=vc_l["s"])
+        else:
+            kq, vq = kc_l, vc_l
+            scale_kw = {}
         if use_kernel:
-            return ragged_paged_attention_decode(q, kc_l, vc_l, page_tables,
-                                                 eff_len, interpret=interpret)
-        return paged_attention_decode_ref(q, kc_l, vc_l, page_tables, eff_len)
+            return ragged_paged_attention_decode(q, kq, vq, page_tables,
+                                                 eff_len, interpret=interpret,
+                                                 **scale_kw)
+        return paged_attention_decode_ref(q, kq, vq, page_tables, eff_len,
+                                          **scale_kw)
 
     def _rope_at(x, sin_p, cos_p):
         # x: [..., H, D]; sin_p/cos_p: [..., D] (per-row positions — the
@@ -791,13 +865,11 @@ def build_llama_paged_decode(config: LlamaConfig, page_size: int = 16,
             v = (h @ lp["wv"]).reshape(T, nkv, head_dim)
             q = _rope_at(q, sin, cos)
             k = _rope_at(k, sin, cos)
-            kc_l = kc_l.at[:, page, off].set(
-                k.astype(d).transpose(1, 0, 2))
-            vc_l = vc_l.at[:, page, off].set(
-                v.astype(d).transpose(1, 0, 2))
+            kc_l, k_loc = _scatter(kc_l, k, page, off)
+            vc_l, v_loc = _scatter(vc_l, v, page, off)
             rep = nh // nkv
-            kf = jnp.repeat(k, rep, axis=1) if rep > 1 else k
-            vf = jnp.repeat(v, rep, axis=1) if rep > 1 else v
+            kf = jnp.repeat(k_loc, rep, axis=1) if rep > 1 else k_loc
+            vf = jnp.repeat(v_loc, rep, axis=1) if rep > 1 else v_loc
             s = jnp.einsum("qhd,khd->hqk", q.astype(jnp.float32),
                            kf.astype(jnp.float32)) / math.sqrt(head_dim)
             mask = (t_idx[None, :] <= t_idx[:, None]) & valid[None, :]
@@ -842,11 +914,11 @@ def build_llama_paged_decode(config: LlamaConfig, page_size: int = 16,
             v = (h @ lp["wv"]).reshape(C, nkv, head_dim)
             q = _rope_at(q, sin, cos)
             k = _rope_at(k, sin, cos)
-            kc_l = kc_l.at[:, page, off].set(k.astype(d).transpose(1, 0, 2))
-            vc_l = vc_l.at[:, page, off].set(v.astype(d).transpose(1, 0, 2))
+            kc_l, _ = _scatter(kc_l, k, page, off)
+            vc_l, _ = _scatter(vc_l, v, page, off)
             # gather this request's whole context through its page table
-            kf = kc_l[:, page_row].reshape(nkv, P * page_size, head_dim)
-            vf = vc_l[:, page_row].reshape(nkv, P * page_size, head_dim)
+            kf = _gather_row(kc_l, page_row, P)
+            vf = _gather_row(vc_l, page_row, P)
             rep = nh // nkv
             if rep > 1:
                 kf = jnp.repeat(kf, rep, axis=0)
@@ -887,8 +959,8 @@ def build_llama_paged_decode(config: LlamaConfig, page_size: int = 16,
             v = (h @ lp["wv"]).reshape(S, nkv, head_dim)
             q = _rope_at(q, sin_p, cos_p)
             k = _rope_at(k, sin_p, cos_p)
-            kc_l = kc_l.at[:, page, off].set(k.astype(d).transpose(1, 0, 2))
-            vc_l = vc_l.at[:, page, off].set(v.astype(d).transpose(1, 0, 2))
+            kc_l, _ = _scatter(kc_l, k, page, off)
+            vc_l, _ = _scatter(vc_l, v, page, off)
             o = _attn(q, kc_l, vc_l, page_tables, eff_len)
             xc = xc + o.reshape(S, nh * head_dim) @ lp["wo"]
             h = rms_norm_ref(xc, lp["ln2"], c.rms_norm_eps)
@@ -945,17 +1017,13 @@ def build_llama_paged_decode(config: LlamaConfig, page_size: int = 16,
             v = (h @ lp["wv"]).reshape(S, Q, nkv, head_dim)
             q = _rope_at(q, sin, cos)
             k = _rope_at(k, sin, cos)
-            kc_l = kc_l.at[:, page, off].set(
-                k.astype(d).transpose(2, 0, 1, 3))
-            vc_l = vc_l.at[:, page, off].set(
-                v.astype(d).transpose(2, 0, 1, 3))
+            kc_l, _ = _scatter(kc_l, k, page, off)
+            vc_l, _ = _scatter(vc_l, v, page, off)
             # gather each slot's whole context through its page table —
             # ONE gather serves all Q queries (the per-token decode path
             # pays it per token)
-            kf = kc_l[:, page_tables].transpose(1, 0, 2, 3, 4) \
-                .reshape(S, nkv, P * page_size, head_dim)
-            vf = vc_l[:, page_tables].transpose(1, 0, 2, 3, 4) \
-                .reshape(S, nkv, P * page_size, head_dim)
+            kf = _gather_tables(kc_l, page_tables, S, P)
+            vf = _gather_tables(vc_l, page_tables, S, P)
             rep = nh // nkv
             if rep > 1:
                 kf = jnp.repeat(kf, rep, axis=1)

@@ -100,7 +100,12 @@ class GaugeSeries:
         self._seq += 1
         row = {"seq": self._seq, "t": float(t)}
         for k, v in fields.items():
-            if isinstance(v, bool) or v is None:
+            # exact-type fast path first: this runs at every engine-step
+            # end with ~20 plain int/float fields, and the numbers.*
+            # ABC isinstance checks dominate the whole sampler's cost
+            # (bool subclasses int, so `type(v) is int` stays False for it)
+            tv = type(v)
+            if tv is int or tv is float or tv is bool or v is None:
                 row[k] = v
             elif isinstance(v, numbers.Integral):
                 row[k] = int(v)

@@ -142,6 +142,11 @@ class Telemetry:
         self._g_frag = r.gauge("mem.fragmentation_frac")
         self._g_cache = r.gauge("mem.cache_page_refs")
         self._g_queue = r.gauge("mem.queue_depth")
+        # BYTES, not just page counts: pages × page_bytes for the engine's
+        # active kv_dtype — the gauge a quantized page store (ROADMAP item
+        # 2) moves, where a page count alone would hide the capacity win
+        self._g_alloc_bytes = r.gauge("mem.pool_allocated_bytes")
+        self._g_cap_bytes = r.gauge("mem.pool_capacity_bytes")
         # double-buffered host loop: decode dispatches in flight at the
         # step's end (0 on a synchronous engine, 0/1 at depth 1) — the
         # liveness companion to the engine.phase.overlap_* histograms
@@ -350,10 +355,17 @@ class Telemetry:
         frag = 1.0 - slot_tokens / (slot_pages * pool.page_size) \
             if slot_pages else 0.0
         occ = (total - free) / total
+        # occupancy in BYTES (pages x page_bytes for the active kv_dtype):
+        # a quantized page store's capacity win must be visible in mem.*
+        # gauges and fleet snapshots, not just in page counts
+        pb = int(getattr(engine, "page_bytes", 0) or 0)
         fields = dict(
             step=engine._step_seq, total_pages=total, free_pages=free,
             allocated_pages=pool.num_allocated,
             referenced=pool.num_referenced, cache_page_refs=cache_refs,
+            page_bytes=pb,
+            pool_allocated_bytes=pool.num_allocated * pb,
+            pool_capacity_bytes=total * pb,
             occupancy_frac=round(occ, 4),
             fragmentation_frac=round(frag, 4), slot_tokens=slot_tokens,
             queue_depth=len(engine._queue), active=engine.num_active,
@@ -371,6 +383,8 @@ class Telemetry:
         self._g_frag.set(frag)
         self._g_cache.set(cache_refs)
         self._g_queue.set(len(engine._queue))
+        self._g_alloc_bytes.set(pool.num_allocated * pb)
+        self._g_cap_bytes.set(total * pb)
         # Perfetto counter tracks next to the PR 6 request spans
         self.tracer.counter("pagepool.pages", t, used=total - free,
                             free=free, cached=cache_refs)

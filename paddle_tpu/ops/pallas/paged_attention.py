@@ -44,46 +44,34 @@ from jax.experimental.pallas import tpu as pltpu
 from . import _compat  # noqa: F401  (pltpu.CompilerParams alias, jax<=0.4)
 
 __all__ = ["ragged_paged_attention_decode", "paged_attention_decode_ref",
-           "paged_gather_kv"]
+           "paged_gather_kv", "paged_gather_scales"]
 
 NEG_INF = -1e30
 
 
-def _decode_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
-                   m_scr, l_scr, acc_scr, *, page_size, sm_scale):
-    b = pl.program_id(0)          # sequence slot
-    i = pl.program_id(2)          # logical page index (innermost, reduction)
-    n_pages = pl.num_programs(2)
+def _attend_page(q, k, v, i, length, page_size, sm_scale,
+                 m_scr, l_scr, acc_scr):
+    """One online-softmax update over one (already dequantized, f32) K/V
+    page — shared by the plain and fused-dequant kernel bodies so the
+    accumulator math can never drift between them."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * sm_scale         # [rep, ps]
+    pos = i * page_size + jax.lax.broadcasted_iota(
+        jnp.int32, s.shape, 1)
+    s = jnp.where(pos < length, s, NEG_INF)
+    m_prev = m_scr[:]                             # [rep, 1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    alpha = jnp.exp(m_prev - m_new)
+    l_scr[:] = alpha * l_scr[:] + jnp.sum(p, axis=1, keepdims=True)
+    acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    m_scr[:] = m_new
 
-    @pl.when(i == 0)
-    def _init():
-        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
-        l_scr[:] = jnp.zeros_like(l_scr)
-        acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    length = len_ref[b]
-
-    @pl.when(i * page_size < length)
-    def _body():
-        q = q_ref[0].astype(jnp.float32)          # [rep, D]
-        k = k_ref[0, 0]                           # [ps, D]
-        v = v_ref[0, 0]                           # [ps, D]
-        s = jax.lax.dot_general(
-            q, k.astype(jnp.float32), (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * sm_scale     # [rep, ps]
-        pos = i * page_size + jax.lax.broadcasted_iota(
-            jnp.int32, s.shape, 1)
-        s = jnp.where(pos < length, s, NEG_INF)
-        m_prev = m_scr[:]                         # [rep, 1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m_prev - m_new)
-        l_scr[:] = alpha * l_scr[:] + jnp.sum(p, axis=1, keepdims=True)
-        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
-            p, v.astype(jnp.float32), (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        m_scr[:] = m_new
-
+def _finalize_out(i, n_pages, o_ref, m_scr, l_scr, acc_scr):
     @pl.when(i == n_pages - 1)
     def _finalize():
         l = l_scr[:]
@@ -91,9 +79,65 @@ def _decode_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0] = (acc_scr[:] * inv).astype(o_ref.dtype)
 
 
+def _init_scratch(i, m_scr, l_scr, acc_scr):
+    @pl.when(i == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+
+def _decode_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_scr, l_scr, acc_scr, *, page_size, sm_scale):
+    b = pl.program_id(0)          # sequence slot
+    i = pl.program_id(2)          # logical page index (innermost, reduction)
+    n_pages = pl.num_programs(2)
+    _init_scratch(i, m_scr, l_scr, acc_scr)
+    length = len_ref[b]
+
+    @pl.when(i * page_size < length)
+    def _body():
+        _attend_page(q_ref[0].astype(jnp.float32),
+                     k_ref[0, 0].astype(jnp.float32),
+                     v_ref[0, 0].astype(jnp.float32),
+                     i, length, page_size, sm_scale, m_scr, l_scr, acc_scr)
+
+    _finalize_out(i, n_pages, o_ref, m_scr, l_scr, acc_scr)
+
+
+def _decode_kernel_quant(pt_ref, len_ref, q_ref, k_ref, ks_ref, v_ref,
+                         vs_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                         page_size, sm_scale):
+    """Fused-dequant variant (ROADMAP item 2): K/V pages arrive in their
+    int8/fp8 STORAGE dtype plus a per-row f32 absmax scale page, and the
+    dequant happens here, on the page tile already resident in VMEM —
+    quantized K/V never materialize as an f32 tensor anywhere (DTYPE001
+    polices the host-side paths).  The dequant expression mirrors
+    ``serving.quant.dequantize_kv`` exactly (astype f32, multiply by the
+    broadcast row scale) so the kernel and every jnp gather path see
+    identical values for identical stored rows."""
+    b = pl.program_id(0)
+    i = pl.program_id(2)
+    n_pages = pl.num_programs(2)
+    _init_scratch(i, m_scr, l_scr, acc_scr)
+    length = len_ref[b]
+
+    @pl.when(i * page_size < length)
+    def _body():
+        k = k_ref[0, 0].astype(jnp.float32) \
+            * ks_ref[0, 0].astype(jnp.float32)[:, None]        # [ps, D]
+        v = v_ref[0, 0].astype(jnp.float32) \
+            * vs_ref[0, 0].astype(jnp.float32)[:, None]
+        _attend_page(q_ref[0].astype(jnp.float32), k, v,
+                     i, length, page_size, sm_scale, m_scr, l_scr, acc_scr)
+
+    _finalize_out(i, n_pages, o_ref, m_scr, l_scr, acc_scr)
+
+
 def ragged_paged_attention_decode(q, k_pages, v_pages, page_table, lengths,
                                   sm_scale=None, interpret=False,
-                                  out_dtype=None):
+                                  out_dtype=None, k_scales=None,
+                                  v_scales=None):
     """One attention step per sequence slot over that slot's page list.
 
     q [S, Hq, D], k_pages/v_pages [Hkv, NP, ps, D], page_table [S, P] int32
@@ -103,6 +147,13 @@ def ragged_paged_attention_decode(q, k_pages, v_pages, page_table, lengths,
     out_dtype: output dtype (default q.dtype).  Accumulation is f32 either
     way; pass jnp.float32 with bf16 inputs to read the un-downcast result
     (the parity tests' bf16→f32 bound).
+
+    k_scales/v_scales (both or neither): per-row absmax scale pages
+    [Hkv, NP, ps] f32 for int8/fp8-quantized k_pages/v_pages — dequant
+    then FUSES into the kernel (each page tile dequantizes in VMEM right
+    before its online-softmax update; the f32 K/V never exist outside the
+    kernel).  The scale pages ride the same page-table indirection as the
+    data pages.
     """
     s_slots, hq, d = q.shape
     hkv, _np_, page_size, _d = k_pages.shape
@@ -110,6 +161,8 @@ def ragged_paged_attention_decode(q, k_pages, v_pages, page_table, lengths,
     if hq % hkv != 0:
         raise ValueError(f"num q heads ({hq}) must be a multiple of kv "
                          f"heads ({hkv})")
+    if (k_scales is None) != (v_scales is None):
+        raise ValueError("pass both k_scales and v_scales, or neither")
     rep = hq // hkv
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(d)
@@ -122,14 +175,25 @@ def ragged_paged_attention_decode(q, k_pages, v_pages, page_table, lengths,
     def kv_idx(b, h, i, pt, lens):
         return (h, pt[b, i], 0, 0)
 
+    def sc_idx(b, h, i, pt, lens):
+        return (h, pt[b, i], 0)
+
+    kv_spec = pl.BlockSpec((1, 1, page_size, d), kv_idx)
+    sc_spec = pl.BlockSpec((1, 1, page_size), sc_idx)
+    quant = k_scales is not None
+    if quant:
+        in_specs = [pl.BlockSpec((1, rep, d), q_idx),
+                    kv_spec, sc_spec, kv_spec, sc_spec]
+        inputs = (q, k_pages, k_scales, v_pages, v_scales)
+        body = _decode_kernel_quant
+    else:
+        in_specs = [pl.BlockSpec((1, rep, d), q_idx), kv_spec, kv_spec]
+        inputs = (q, k_pages, v_pages)
+        body = _decode_kernel
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, rep, d), q_idx),
-            pl.BlockSpec((1, 1, page_size, d), kv_idx),
-            pl.BlockSpec((1, 1, page_size, d), kv_idx),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, rep, d), q_idx),
         scratch_shapes=[
             pltpu.VMEM((rep, 1), jnp.float32),
@@ -137,7 +201,7 @@ def ragged_paged_attention_decode(q, k_pages, v_pages, page_table, lengths,
             pltpu.VMEM((rep, d), jnp.float32),
         ],
     )
-    kernel = functools.partial(_decode_kernel, page_size=page_size,
+    kernel = functools.partial(body, page_size=page_size,
                                sm_scale=sm_scale)
     return pl.pallas_call(
         kernel,
@@ -147,8 +211,7 @@ def ragged_paged_attention_decode(q, k_pages, v_pages, page_table, lengths,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary", "arbitrary")),
         interpret=interpret,
-    )(page_table.astype(jnp.int32), lengths.astype(jnp.int32),
-      q, k_pages, v_pages)
+    )(page_table.astype(jnp.int32), lengths.astype(jnp.int32), *inputs)
 
 
 def paged_gather_kv(pages, page_table):
@@ -160,10 +223,21 @@ def paged_gather_kv(pages, page_table):
     return g.transpose(1, 2, 3, 0, 4).reshape(s, p * ps, hkv, d)
 
 
+def paged_gather_scales(scales, page_table):
+    """Scale-page analog of :func:`paged_gather_kv`: [Hkv, NP, ps] pages +
+    [S, P] table -> slot-major [S, P*ps, Hkv] per-row scales."""
+    g = scales[:, page_table]                     # [Hkv, S, P, ps]
+    hkv, s, p, ps = g.shape
+    return g.transpose(1, 2, 3, 0).reshape(s, p * ps, hkv)
+
+
 def paged_attention_decode_ref(q, k_pages, v_pages, page_table, lengths,
-                               sm_scale=None, out_dtype=None):
+                               sm_scale=None, out_dtype=None, k_scales=None,
+                               v_scales=None):
     """jnp reference/fallback with identical semantics to the kernel
-    (gathers pages dense, masks positions >= length, zeros length-0 slots).
+    (gathers pages dense, masks positions >= length, zeros length-0 slots;
+    with k_scales/v_scales the gathered int8/fp8 rows dequantize by the
+    same astype-f32-times-row-scale expression the kernel fuses).
     This is the CPU path the serving engine uses off-TPU."""
     s_slots, hq, d = q.shape
     hkv = k_pages.shape[0]
@@ -172,6 +246,20 @@ def paged_attention_decode_ref(q, k_pages, v_pages, page_table, lengths,
         sm_scale = 1.0 / math.sqrt(d)
     k = paged_gather_kv(k_pages, page_table)      # [S, T, Hkv, D]
     v = paged_gather_kv(v_pages, page_table)
+    if k_scales is not None:
+        ks = paged_gather_scales(k_scales, page_table)   # [S, T, Hkv]
+        vs = paged_gather_scales(v_scales, page_table)
+        k = k.astype(jnp.float32) * ks.astype(jnp.float32)[..., None]
+        v = v.astype(jnp.float32) * vs.astype(jnp.float32)[..., None]
+        # round to the QUERY's compute dtype before attending: on a bf16
+        # engine every jnp consumer (this ref, the chunk/verify gathers)
+        # then sees identical rounded rows — the engine's self-exactness
+        # across decode/re-prefill paths needs one value per stored row.
+        # No-op at f32.  (The fused TPU kernel keeps f32 dequant in VMEM —
+        # decode runs ONE impl per engine, so per-engine exactness holds;
+        # kernel-vs-jnp agreement stays the §11 argmax-gated caveat.)
+        k = k.astype(q.dtype)
+        v = v.astype(q.dtype)
     if hq != hkv:
         repn = hq // hkv
         k = jnp.repeat(k, repn, axis=2)

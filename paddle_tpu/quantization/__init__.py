@@ -110,18 +110,35 @@ class EMAObserver(AbsMaxObserver):
             self.absmax = self.momentum * self.absmax + (1 - self.momentum) * cur
 
 
-def quantize_weight(w, bits=8):
-    """-> (int values, scale): symmetric per-tensor quantization (int8
-    storage up to 8 bits, int32 above)."""
+def quantize_weight(w, bits=8, axis=None):
+    """-> (int values, scale): symmetric absmax quantization (int8 storage
+    up to 8 bits, int32 above).
+
+    ``axis=None`` keeps the historical PER-TENSOR behavior (scalar scale).
+    ``axis`` (an int or tuple of ints) selects PER-CHANNEL quantization:
+    the absmax reduces over exactly those axes and the returned scale
+    keeps them as size-1 dims (``keepdims``), so ``q * scale`` broadcasts
+    back without bookkeeping.  For a ``[in, out]`` matmul weight,
+    ``axis=-2`` (reduce the contraction axis) gives one scale per output
+    channel — the granularity attention projections need: with one
+    per-tensor scale, a single hot channel flattens every other head's
+    resolution to a handful of int8 codes."""
     qmax = 2.0 ** (bits - 1) - 1
-    scale = jnp.maximum(jnp.max(jnp.abs(w)), 1e-8) / qmax
+    if axis is None:
+        absmax = jnp.max(jnp.abs(w))
+    else:
+        absmax = jnp.max(jnp.abs(w), axis=axis, keepdims=True)
+    scale = jnp.maximum(absmax, 1e-8) / qmax
     idtype = jnp.int8 if bits <= 8 else jnp.int32
     q = jnp.clip(jnp.round(w / scale), -qmax - 1, qmax).astype(idtype)
     return q, scale
 
 
 def dequantize_weight(q, scale, dtype=jnp.float32):
-    return q.astype(dtype) * scale
+    """Inverse of :func:`quantize_weight`: ``scale`` is the scalar (per-
+    tensor) or keepdims array (per-channel) that function returned —
+    either broadcasts straight through the multiply."""
+    return q.astype(dtype) * jnp.asarray(scale, dtype)
 
 
 class PTQ(QAT):

@@ -22,6 +22,13 @@ replica-fleet router, and the async front end + traffic harness.
   bursty + diurnal arrivals, shared-prefix user fleets, mixed
   greedy/sampled/long-context, streaming-abandon clients) plus engine,
   fleet, and virtual-clock replays reporting goodput-under-SLO.
+* :mod:`.quant` — the quantized serving plane (ROADMAP item 2):
+  the one int8/fp8 KV codec (per-page, per-head, per-token-row absmax
+  scales — write-order independent, so the quantized engine keeps every
+  self-exactness invariant), per-channel int8 serving weights, page-byte
+  accounting for the memory observatory, and :func:`parity_report` —
+  greedy exact-match + teacher-forced logit drift vs the f32 engine on
+  the standard parity scenarios (`bench.py --trace quant` gates it).
 * :mod:`.routing` + :mod:`.autoscale` — the elastic control plane
   (ROADMAP item 5): pluggable placement strategies
   (:class:`LeastLoadedRouter`, :class:`PrefixAffinityRouter` — route
@@ -33,6 +40,8 @@ replica-fleet router, and the async front end + traffic harness.
   through the live-migration path.
 """
 from .autoscale import AutoscaleDecision, AutoscalePolicy, ElasticFleet
+from .quant import (dequantize_kv, kv_spec, page_bytes, parity_report,
+                    parity_scenarios, quantize_kv, quantize_params)
 from .fleet import FleetFailedError, ReplicaFleet
 from .frontend import (AdmissionController, AdmissionView, AsyncFrontend,
                        AsyncStream, SLORejected, TTFTPredictor,
@@ -51,4 +60,6 @@ __all__ = ["ReplicaFleet", "FleetFailedError", "EngineSnapshotManager",
            "make_scenario", "replay_engine", "replay_fleet", "replay_sim",
            "goodput_report", "VirtualClock", "Router", "RoutingDecision",
            "LeastLoadedRouter", "PrefixAffinityRouter", "AutoscalePolicy",
-           "AutoscaleDecision", "ElasticFleet"]
+           "AutoscaleDecision", "ElasticFleet", "quantize_kv",
+           "dequantize_kv", "kv_spec", "page_bytes", "quantize_params",
+           "parity_report", "parity_scenarios"]

@@ -56,6 +56,12 @@ ATTR_KEY = "decode_sync_frac"
 # Drift-checked like the other columns.
 GPRH_KEY = "goodput_per_replica_hour"
 FLEET_HIT_KEY = "fleet_hit_rate"
+# ISSUE 15 columns: the quantized serving plane's capacity win (int8 vs
+# f32 concurrent users at FIXED pool bytes, from the quant artifact's
+# capacity block) and its greedy exact-match rate vs the f32 engine (the
+# parity block).  Drift-checked like the other columns.
+QUANT_CAP_KEY = "capacity_ratio"
+QUANT_MATCH_KEY = "exact_match"
 
 
 def find_artifacts(root: str) -> list[tuple[int, str]]:
@@ -197,6 +203,29 @@ def find_fleet_hit_rate(d):
     return _find(d, match)
 
 
+def find_quant_capacity_ratio(d):
+    """First quantized-capacity ratio: the quant artifact's
+    ``capacity.capacity_ratio`` (int8 vs f32 concurrent users at fixed
+    pool bytes, ISSUE 15)."""
+    def match(n):
+        c = n.get("capacity")
+        if isinstance(c, dict) and _num(c.get(QUANT_CAP_KEY)):
+            return c[QUANT_CAP_KEY]
+        return None
+    return _find(d, match)
+
+
+def find_quant_exact_match(d):
+    """First quantized greedy exact-match rate: the quant artifact's
+    ``parity.exact_match`` (ISSUE 15)."""
+    def match(n):
+        p = n.get("parity")
+        if isinstance(p, dict) and _num(p.get(QUANT_MATCH_KEY)):
+            return p[QUANT_MATCH_KEY]
+        return None
+    return _find(d, match)
+
+
 def _fmt(v, nd=1):
     if v is None:
         return "-"
@@ -220,6 +249,8 @@ def trend(root: str = ".", verbose: bool = True) -> int:
     prev_attr = False
     prev_gprh = False
     prev_fleet_hit = False
+    prev_quant_cap = False
+    prev_quant_match = False
     for rnd, path in arts:
         try:
             with open(path) as f:
@@ -279,6 +310,18 @@ def trend(root: str = ".", verbose: bool = True) -> int:
                             f"(hit_rate.affinity_fixed2) present in an "
                             f"earlier round but missing here")
         prev_fleet_hit = prev_fleet_hit or fleet_hit is not None
+        quant_cap = find_quant_capacity_ratio(parsed)
+        if quant_cap is None and prev_quant_cap:
+            problems.append(f"{path}: quantized capacity ratio "
+                            f"(capacity.{QUANT_CAP_KEY}) present in an "
+                            f"earlier round but missing here")
+        prev_quant_cap = prev_quant_cap or quant_cap is not None
+        quant_match = find_quant_exact_match(parsed)
+        if quant_match is None and prev_quant_match:
+            problems.append(f"{path}: quantized exact-match rate "
+                            f"(parity.{QUANT_MATCH_KEY}) present in an "
+                            f"earlier round but missing here")
+        prev_quant_match = prev_quant_match or quant_match is not None
         rows.append({
             "round": rnd,
             "metric": parsed.get("metric"),
@@ -311,13 +354,16 @@ def trend(root: str = ".", verbose: bool = True) -> int:
             # ISSUE 14 columns: elastic fleet economics + affinity hit rate
             "goodput_per_replica_hour": gprh,
             "fleet_hit_rate": fleet_hit,
+            # ISSUE 15 columns: quantized capacity win + exact-match rate
+            "quant_capacity_ratio": quant_cap,
+            "quant_exact_match": quant_match,
         })
     if verbose:
         hdr = (f"{'round':>5}  {'tokens/s':>10}  {'vs_base':>8}  "
                f"{'serve tok/s':>11}  {'ttft_p95_ms':>11}  {'goodput':>7}  "
                f"{'overlap':>7}  {'slo_gput':>8}  {'rec_p50':>7}  "
                f"{'perr_p95':>8}  {'alerts':>6}  {'dsync':>5}  "
-               f"{'gprh':>6}  {'f_hit':>5}")
+               f"{'gprh':>6}  {'f_hit':>5}  {'q_cap':>5}  {'q_em':>5}")
         print(hdr)
         print("-" * len(hdr))
         for r in rows:
@@ -333,7 +379,9 @@ def trend(root: str = ".", verbose: bool = True) -> int:
                   f"{_fmt(r['alerts_fired']):>6}  "
                   f"{_fmt(r['decode_sync_frac'], 3):>5}  "
                   f"{_fmt(r['goodput_per_replica_hour'], 0):>6}  "
-                  f"{_fmt(r['fleet_hit_rate'], 3):>5}")
+                  f"{_fmt(r['fleet_hit_rate'], 3):>5}  "
+                  f"{_fmt(r['quant_capacity_ratio'], 2):>5}  "
+                  f"{_fmt(r['quant_exact_match'], 3):>5}")
         v0, v1 = rows[0]["value"], rows[-1]["value"]
         if len(rows) >= 2 \
                 and all(isinstance(v, (int, float))

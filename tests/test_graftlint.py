@@ -1139,6 +1139,41 @@ class TestDtype001:
         """)
         assert res.new == []
 
+    # -- ISSUE 15: the int8-KV dequant path fixture ------------------------
+    # Pins that a quantized page store multiplied by its f32 scales
+    # WITHOUT the explicit astype cannot slip through a jitted fn: the
+    # int8 x f32 binop silently promotes the whole page tensor to f32
+    # outside the kernel, erasing the capacity win the quantized serving
+    # plane exists for.
+    def test_positive_quant_kv_page_dequant_without_cast(self):
+        res = _lint("""
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def attend(pages, page_scales):
+                q = pages.astype(jnp.int8)
+                s = page_scales.astype(jnp.float32)
+                return q * s                      # silent int8 -> f32
+        """)
+        assert _rules(res) == ["DTYPE001"]
+        assert "quantization" in res.new[0].message
+
+    def test_negative_quant_kv_sanctioned_dequant(self):
+        # the serving.quant.dequantize_kv shape: an EXPLICIT astype to
+        # f32 before the scale multiply — deliberate, and clean
+        res = _lint("""
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def attend(pages, page_scales):
+                deq = pages.astype(jnp.float32)
+                s = page_scales.astype(jnp.float32)
+                return deq * s
+        """)
+        assert res.new == []
+
 
 # ---------------------------------------------------------------------------
 # CLI v2: stale-entry failure, --diff mode, JSON artifact

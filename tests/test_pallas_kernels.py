@@ -529,3 +529,44 @@ def test_paged_attention_decode_parity_vs_ref():
     ref = paged_attention_decode_ref(q, kp, vp, pt, lens)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("kv_dtype", ["int8", "fp8"])
+def test_paged_attention_decode_quantized_parity_vs_ref(kv_dtype):
+    """ISSUE 15 fused-dequant path (PAR001 pairing): the quantized kernel
+    (int8/fp8 pages + per-row scales, dequant fused in VMEM) must agree
+    with the scale-aware jnp ref — and the scale-aware ref must agree
+    BIT-EXACTLY with manual dequantization fed to the plain ref, pinning
+    that both use the one sanctioned dequant expression."""
+    from paddle_tpu.ops.pallas.paged_attention import (
+        ragged_paged_attention_decode, paged_attention_decode_ref)
+    from paddle_tpu.serving.quant import kv_spec, quantize_kv
+    S, Hq, Hkv, D, ps, NP, P = 4, 8, 2, 64, 16, 13, 3
+    storage, qmax = kv_spec(kv_dtype)
+    q = jnp.asarray(rng.standard_normal((S, Hq, D)).astype(np.float32))
+    kf = jnp.asarray(rng.standard_normal((Hkv, NP, ps, D))
+                     .astype(np.float32))
+    vf = jnp.asarray(rng.standard_normal((Hkv, NP, ps, D))
+                     .astype(np.float32))
+    kq, ks = quantize_kv(kf, qmax=qmax, dtype=storage)
+    vq, vs = quantize_kv(vf, qmax=qmax, dtype=storage)
+    pt = jnp.asarray(rng.permutation(NP - 1)[: S * P].reshape(S, P)
+                     .astype(np.int32))
+    lens = jnp.asarray(np.array([0, 5, ps, P * ps], np.int32))
+    out = ragged_paged_attention_decode(q, kq, vq, pt, lens, interpret=True,
+                                        k_scales=ks, v_scales=vs)
+    ref = paged_attention_decode_ref(q, kq, vq, pt, lens,
+                                     k_scales=ks, v_scales=vs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    # length-0 slot produces exact zeros on both paths
+    assert not np.asarray(out[0]).any() and not np.asarray(ref[0]).any()
+    # the scale-aware ref == manual dequant + plain ref, bit-for-bit
+    kd = kq.astype(jnp.float32) * ks[..., None]
+    vd = vq.astype(jnp.float32) * vs[..., None]
+    ref2 = paged_attention_decode_ref(q, kd, vd, pt, lens)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(ref2))
+    # scales-without-partner is a usage error, not silent garbage
+    with pytest.raises(ValueError):
+        ragged_paged_attention_decode(q, kq, vq, pt, lens, interpret=True,
+                                      k_scales=ks)
