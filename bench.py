@@ -94,6 +94,21 @@ def _ttft_report(ttfts_s, slo_ttft_s):
     }
 
 
+def _fused_sampling_report(stats):
+    """Tokens-not-logits steady-state indicator (ISSUE 16): of all engine
+    dispatches, how many emitted their tokens on-device (fused greedy
+    argmax / in-horizon sampling) instead of returning logits for host
+    sampling.  Greedy-only traffic must report fused_frac 1.0; drift below
+    a trace's established value is a regression bench_trend flags."""
+    steps = stats["decode_steps"] + stats["verify_steps"]
+    fused = stats["fused_sample_steps"]
+    return {
+        "fused_sample_steps": int(fused),
+        "dispatches": int(steps),
+        "fused_frac": round(fused / steps, 4) if steps else 0.0,
+    }
+
+
 def _chip_peak_flops(device):
     kind = device.device_kind.lower()
     for key, peak in _PEAK_BF16:
@@ -755,6 +770,11 @@ def bench_serving(seed=0):
         "decode_horizon": horizon,
         "page_size": page_size,
         "num_slots": slots,
+        # ISSUE 16 tokens-not-logits steady state: dispatches whose tokens
+        # were consumed on-device (fused greedy argmax / in-horizon
+        # sampling) vs total steady-state dispatches — greedy traffic
+        # should pin fused_frac at 1.0 (no logits ever leave the device)
+        "fused_sampling": _fused_sampling_report(eng.stats()),
         "engine_stats": eng.stats(),
         # full telemetry snapshot + SLO report + observatory sections,
         # ALL captured from the best paired round's window — every figure
@@ -1015,6 +1035,9 @@ def bench_serving_spec_decode(seed=0):
             - base_stats["verify_steps"],
             "decode_steps": stats["decode_steps"]
             - base_stats["decode_steps"],
+            # greedy spec traffic: every dispatch (horizon AND verify)
+            # must be token-emitting — fused_frac 1.0
+            "fused_sampling": _fused_sampling_report(stats),
             "engine_stats": stats,
             # full telemetry snapshot + SLO report over the timed window
             "metrics": eng.telemetry.snapshot(stats),

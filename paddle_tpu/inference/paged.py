@@ -953,7 +953,7 @@ class ServingEngine:
         self._chunk_jit = self._jit("prefill_chunk", prefill_chunk_fn,
                                     donate_argnums=(5, 6))
         self._sample_fn = _sample_logits
-        self._sample_jit = {}          # greedy -> jitted sampler
+        self._sample_jit = None        # lazily jitted nucleus sampler
         self._copy_jit = self._jit("page_copy", _copy_page,
                                    donate_argnums=(0, 1))
         # one wrapper: drafts pad to the STATIC K+1 query width, so the
@@ -992,6 +992,13 @@ class ServingEngine:
         self.draft_tokens_accepted = 0  # ... whose argmax matched
         self.overlap_steps = 0         # dispatches issued double-buffered
                                        #   (a previous step still in flight)
+        self.fused_sample_steps = 0    # steady-state dispatches that emitted
+                                       #   TOKENS on-device (fused greedy
+                                       #   argmax / in-horizon sampling) —
+                                       #   steps_run minus this = dispatches
+                                       #   that returned logits for host-
+                                       #   side sampling (sampled verify
+                                       #   lanes)
         self.quiesces = 0              # pipeline drains forced by a
                                        #   host-exactness point (snapshot/
                                        #   cancel/deadline/ladder/verify)
@@ -1622,7 +1629,7 @@ class ServingEngine:
             t_ck0 = tel.clock()
             ann = tel.bridge_begin("prefill_chunk")
         try:
-            logits, self._pages_k, self._pages_v = self._call_paged(
+            logits, tok_g, self._pages_k, self._pages_v = self._call_paged(
                 self._chunk_jit,
                 self.params, jnp.asarray(ids), jnp.asarray(pos, jnp.int32),
                 jnp.asarray(c, jnp.int32),
@@ -1651,9 +1658,21 @@ class ServingEngine:
             # the re-prefill rebuilt the cache; the last emitted token is
             # still the pending one (a python int) — no fresh sample needed
             slot.pending = req.generated[-1]
+        elif req.temperature <= 0.0:
+            # fused greedy sampling: the chunk dispatch already emitted the
+            # argmax token — no separate sample executable ever compiles
+            # for the greedy final-chunk path
+            if self.overlap:
+                # on-device carry: no final-chunk host sync — the next
+                # decode dispatch consumes the device scalar directly
+                slot.pending = None
+                slot.pending_dev = tok_g
+            else:
+                # the ONE final-chunk sync: the fused first token
+                self._record_token(s, int(np.asarray(tok_g)))  # graftlint: disable=SYNC001
         else:
             try:
-                tok = self._sampler(req.temperature <= 0.0)(
+                tok = self._sampler(False)(
                     logits, self._split_key(),
                     jnp.asarray(req.temperature, jnp.float32),
                     jnp.asarray(req.top_p, jnp.float32))
@@ -1666,8 +1685,6 @@ class ServingEngine:
                 self._record_token(s, int(np.asarray(e.result)))  # graftlint: disable=SYNC001
                 raise
             if self.overlap:
-                # on-device carry: no final-chunk host sync — the next
-                # decode dispatch consumes the device scalar directly
                 slot.pending = None
                 slot.pending_dev = tok
             else:
@@ -1675,17 +1692,18 @@ class ServingEngine:
                 self._record_token(s, int(np.asarray(tok)))  # graftlint: disable=SYNC001
 
     def _sampler(self, greedy: bool):
-        """Jitted single-logits sampler, cached per greedy flag (the final
-        chunk of a chunked/suffix prefill and the sampled lanes of a
-        speculative verify share it)."""
-        sf = self._sample_jit.get(greedy)
+        """Jitted single-logits NUCLEUS sampler (the sampled final chunk
+        of a chunked/suffix prefill and the sampled lanes of a speculative
+        verify share it).  Greedy lanes never reach here — their argmax is
+        FUSED into the chunk/verify/decode dispatch itself (tokens, not
+        logits, leave the device), so the greedy sampler variant of the
+        pre-unification engine no longer exists; `greedy` must be False."""
+        assert not greedy, "greedy sampling is fused into the dispatch"
+        sf = self._sample_jit
         if sf is None:
             fn = self._sample_fn
-            sf = self._jit(
-                "sample",
-                (lambda *a: fn(*a, greedy=True)) if greedy
-                else (lambda *a: fn(*a, greedy=False)))
-            self._sample_jit[greedy] = sf
+            sf = self._jit("sample", lambda *a: fn(*a, greedy=False))
+            self._sample_jit = sf
         return sf
 
     def _remaining(self, s: int) -> int:
@@ -1804,6 +1822,11 @@ class ServingEngine:
         gtoks = np.asarray(gtoks)  # graftlint: disable=SYNC001
         self.steps_run += 1
         self.verify_steps += 1
+        if all(self._slots[s].req.temperature <= 0.0 for s in run):
+            # every participating lane consumed the dispatch's own fused
+            # argmax row — a token-emitting step; one sampled ride-along
+            # lane makes it a logit-path dispatch instead
+            self.fused_sample_steps += 1
         if tel is not None:
             t_v2 = tel.clock()
             tel.phase("verify_dispatch", t_v0, t_v1, slots=len(run))
@@ -2078,6 +2101,9 @@ class ServingEngine:
             if tel is not None:
                 tel.bridge_end(ann)
         self.steps_run += 1
+        # horizon dispatches always emit tokens on-device (fused greedy
+        # argmax or in-loop sampling) — logits never leave the device
+        self.fused_sample_steps += 1
         if prev is not None:
             self.overlap_steps += 1
         if tel is not None:
@@ -2511,7 +2537,7 @@ class ServingEngine:
                       "cache_hit_tokens", "prefill_tokens",
                       "cache_evictions", "cow_copies", "verify_steps",
                       "draft_tokens_proposed", "draft_tokens_accepted",
-                      "overlap_steps", "quiesces")
+                      "overlap_steps", "quiesces", "fused_sample_steps")
 
     def snapshot(self, mode: str = "full_kv",
                  include_finished: bool = True) -> dict:
@@ -2793,6 +2819,11 @@ class ServingEngine:
             "tokens_generated": self.tokens_generated,
             "decode_steps": self.steps_run - self.verify_steps,
             "verify_steps": self.verify_steps,
+            # steady-state dispatches whose tokens were consumed from the
+            # dispatch itself (fused greedy argmax / in-horizon sampling)
+            # vs `steps_run` total: the remainder returned logits for
+            # host-side sampling (sampled verify ride-along lanes)
+            "fused_sample_steps": self.fused_sample_steps,
             "draft_tokens_proposed": prop,
             "draft_tokens_accepted": acc,
             "draft_accept_rate": round(acc / prop, 4) if prop else 0.0,

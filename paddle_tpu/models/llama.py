@@ -687,22 +687,23 @@ def build_llama_paged_decode(config: LlamaConfig, page_size: int = 16,
           over the prompt; post-RoPE K/V scatter into the request's pages;
           logits [vocab] for the LAST real token.
 
-      logits, pages_k, pages_v = prefill_chunk(params, ids, start, chunk_len,
-                                               page_row, pages_k, pages_v)
+      logits, greedy_tok, pages_k, pages_v = prefill_chunk(
+              params, ids, start, chunk_len, page_row, pages_k, pages_v)
           CHUNKED / SUFFIX prefill for the prefix cache + chunked-prefill
           scheduler: ids [1, C_pad] right-padded chunk of the prompt, start
           the number of tokens ALREADY in this request's pages (a cached
           prefix and/or earlier chunks), chunk_len the real chunk length.
           The chunk's K/V scatter into the pages at absolute positions
-          start..start+chunk_len-1 (RoPE at those positions), then each
-          chunk token attends over the WHOLE cached context gathered
-          through the page table (causal across cache + chunk).  Returns
-          logits [vocab] for the LAST real chunk token — only the final
-          chunk's logits feed sampling.  `prefill_chunk(.., start=0,
-          chunk_len=T)` is semantically identical to `prefill` (the engine
-          keeps the dense path for the no-cache-hit whole-prompt case
-          purely so its numerics stay byte-identical with the pre-cache
-          engine).
+          start..start+chunk_len-1 (RoPE at those positions), then the
+          chunk attends as ONE ragged query segment of the unified kernel
+          (causal across cache + chunk).  Returns logits [vocab] for the
+          LAST real chunk token plus its fused greedy argmax token (int32
+          scalar) — a greedy request's final chunk consumes the token
+          directly (no separate sample dispatch); only sampled lanes read
+          the logits.  `prefill_chunk(.., start=0, chunk_len=T)` is
+          semantically identical to `prefill` (the engine keeps the dense
+          path for the no-cache-hit whole-prompt case purely so its
+          numerics stay byte-identical with the pre-cache engine).
 
       logits, pages_k, pages_v = decode_step(params, toks, lengths,
                                              page_tables, pages_k, pages_v,
@@ -710,9 +711,13 @@ def build_llama_paged_decode(config: LlamaConfig, page_size: int = 16,
           One token per slot: toks [S], lengths [S] (tokens already cached —
           the new token lands at position lengths[s]), page_tables [S, P],
           active [S] bool.  Inactive slots write to the trash page and
-          produce garbage logits the engine discards.  Attention runs the
-          Pallas ragged paged kernel (attention_impl "pallas"/"auto"-on-TPU)
-          or its jnp gather fallback ("ref"/"auto"-off-TPU).
+          produce garbage logits the engine discards.
+
+      Decode, verify, AND chunked prefill all dispatch the ONE ragged
+      paged-attention kernel (attention_impl "pallas"/"auto"-on-TPU) or
+      its ONE jnp ref ("ref"/"auto"-off-TPU) — decode is the q_len = 1
+      segment, verify q_len = K+1, a chunk q_len = chunk_len.  There is
+      no per-path attention implementation anywhere in the paged family.
 
       logits0, greedy, pages_k, pages_v = verify_step(params, toks, lengths,
                                                       page_tables, pages_k,
@@ -735,8 +740,9 @@ def build_llama_paged_decode(config: LlamaConfig, page_size: int = 16,
     (prefill / prefill_chunk / decode_step / verify_step) quantizes
     through ``serving.quant.quantize_kv`` before writing, and every
     attention path dequantizes through the ONE ``dequantize_kv``
-    expression — fused inside the Pallas kernel on TPU, applied to the
-    gathered rows on the jnp paths.  Per-row scales make quantization
+    expression — fused inside the unified ragged kernel on TPU (decode,
+    verify, and chunked prefill alike), applied to the gathered rows in
+    its jnp ref off-TPU.  Per-row scales make quantization
     write-order independent, so the engine's whole bit-exactness matrix
     (cache on/off, chunked, preemption re-prefill, COW, snapshot, spec
     decode) holds for the quantized engine against itself.  The dense
@@ -744,8 +750,8 @@ def build_llama_paged_decode(config: LlamaConfig, page_size: int = 16,
     (quantize -> dequantize round trip), so its numerics equal a chunked
     prefill of the same prompt reading the rows back from the pages.
     """
-    from ..ops.pallas.paged_attention import (ragged_paged_attention_decode,
-                                              paged_attention_decode_ref)
+    from ..ops.pallas.paged_attention import (ragged_paged_attention,
+                                              ragged_paged_attention_ref)
     c = config
     d = jnp.dtype(dtype) if dtype is not None else jnp.float32
     head_dim = c.hidden_size // c.num_attention_heads
@@ -798,28 +804,15 @@ def build_llama_paged_decode(config: LlamaConfig, page_size: int = 16,
         # verify/dense all see the same rounded values on a bf16 engine
         return new, dequantize_kv(qv, sv).astype(d)
 
-    def _gather_row(store, page_row, P):
-        """One request's whole context through its page table
-        ([P] row) -> [nkv, P*ps, D], dequantized on a quantized store."""
-        if kv_dtype is None:
-            return store[:, page_row].reshape(nkv, P * page_size, head_dim)
-        g = store["q"][:, page_row].reshape(nkv, P * page_size, head_dim)
-        s = store["s"][:, page_row].reshape(nkv, P * page_size)
-        return dequantize_kv(g, s).astype(d)
-
-    def _gather_tables(store, page_tables, S, P):
-        """Batched gather for the verify path: [S, P] tables ->
-        [S, nkv, P*ps, D], dequantized on a quantized store."""
-        if kv_dtype is None:
-            return store[:, page_tables].transpose(1, 0, 2, 3, 4) \
-                .reshape(S, nkv, P * page_size, head_dim)
-        g = store["q"][:, page_tables].transpose(1, 0, 2, 3, 4) \
-            .reshape(S, nkv, P * page_size, head_dim)
-        s = store["s"][:, page_tables].transpose(1, 0, 2, 3) \
-            .reshape(S, nkv, P * page_size)
-        return dequantize_kv(g, s).astype(d)
-
-    def _attn(q, kc_l, vc_l, page_tables, eff_len):
+    def _attn(q, kc_l, vc_l, page_tables, q_start, q_len, kv_len):
+        """THE attention dispatch: every paged path (decode, speculative
+        verify, chunked prefill) routes its ragged query segments
+        ``q [S, Qmax, nh, D]`` through the ONE ragged paged-attention
+        kernel (or, off-TPU, its ONE jnp ref) — impl-uniformity is what
+        makes speculative verify lossless by construction rather than by
+        bench assert.  On a quantized store the int8/fp8 pages and their
+        per-row scales pass straight through; dequant fuses inside the
+        kernel (and inside the ref's gather) for every path."""
         if kv_dtype is not None:
             kq, vq = kc_l["q"], vc_l["q"]
             scale_kw = dict(k_scales=kc_l["s"], v_scales=vc_l["s"])
@@ -827,11 +820,11 @@ def build_llama_paged_decode(config: LlamaConfig, page_size: int = 16,
             kq, vq = kc_l, vc_l
             scale_kw = {}
         if use_kernel:
-            return ragged_paged_attention_decode(q, kq, vq, page_tables,
-                                                 eff_len, interpret=interpret,
-                                                 **scale_kw)
-        return paged_attention_decode_ref(q, kq, vq, page_tables, eff_len,
+            return ragged_paged_attention(q, kq, vq, page_tables, q_start,
+                                          q_len, kv_len, interpret=interpret,
                                           **scale_kw)
+        return ragged_paged_attention_ref(q, kq, vq, page_tables, q_start,
+                                          q_len, kv_len, **scale_kw)
 
     def _rope_at(x, sin_p, cos_p):
         # x: [..., H, D]; sin_p/cos_p: [..., D] (per-row positions — the
@@ -890,7 +883,6 @@ def build_llama_paged_decode(config: LlamaConfig, page_size: int = 16,
                       pages_v):                       # graftlint: jit
         ep, bp, hp = params
         C = ids.shape[1]
-        P = page_row.shape[0]
         x = ep["tok"][ids[0]].astype(d)               # [C, H]
         i_idx = jnp.arange(C)
         valid = i_idx < chunk_len
@@ -898,12 +890,16 @@ def build_llama_paged_decode(config: LlamaConfig, page_size: int = 16,
         page = jnp.where(valid, page_row[pos // page_size], TRASH)
         off = pos % page_size
         sin, cos = jnp.take(sin_t, pos, axis=0), jnp.take(cos_t, pos, axis=0)
-        # key side: every position the page table can address, causal-masked
-        # against each chunk query's absolute position.  Slots past the
-        # written region (or recycled-page garbage) can never be <= a query
-        # position, so the mask alone keeps them out of the softmax.
-        kv_pos = jnp.arange(P * page_size)            # [P*ps] logical pos
-        mask = (kv_pos[None, :] <= pos[:, None]) & valid[:, None]  # [C, P*ps]
+        # the whole chunk is ONE ragged query segment of the unified
+        # kernel: queries at absolute positions start..start+chunk_len-1
+        # attend every page-table position <= their own (causal across the
+        # cached prefix + earlier chunk tokens).  Positions past the
+        # written region (or recycled-page garbage) can never be <= a
+        # query position, so the segment mask alone keeps them out.
+        start_r = jnp.reshape(start, (1,)).astype(jnp.int32)
+        clen_r = jnp.reshape(chunk_len, (1,)).astype(jnp.int32)
+        kvlen_r = start_r + clen_r
+        page_tab = page_row[None]                     # [1, P]
 
         def body(carry, layer_in):
             xc, = carry
@@ -916,19 +912,9 @@ def build_llama_paged_decode(config: LlamaConfig, page_size: int = 16,
             k = _rope_at(k, sin, cos)
             kc_l, _ = _scatter(kc_l, k, page, off)
             vc_l, _ = _scatter(vc_l, v, page, off)
-            # gather this request's whole context through its page table
-            kf = _gather_row(kc_l, page_row, P)
-            vf = _gather_row(vc_l, page_row, P)
-            rep = nh // nkv
-            if rep > 1:
-                kf = jnp.repeat(kf, rep, axis=0)
-                vf = jnp.repeat(vf, rep, axis=0)
-            s = jnp.einsum("qhd,hkd->hqk", q.astype(jnp.float32),
-                           kf.astype(jnp.float32)) / math.sqrt(head_dim)
-            s = jnp.where(mask[None, :, :], s, -jnp.inf)
-            p = jax.nn.softmax(s, axis=-1).astype(xc.dtype)
-            o = jnp.einsum("hqk,hkd->qhd", p, vf).reshape(C, nh * head_dim)
-            xc = xc + o @ lp["wo"]
+            o = _attn(q[None], kc_l, vc_l, page_tab,
+                      start_r, clen_r, kvlen_r)[0]
+            xc = xc + o.reshape(C, nh * head_dim) @ lp["wo"]
             h = rms_norm_ref(xc, lp["ln2"], c.rms_norm_eps)
             ff = jax.nn.silu(h @ lp["wgate"]) * (h @ lp["wup"])
             return (xc + ff @ lp["wdown"],), (kc_l, vc_l)
@@ -936,7 +922,12 @@ def build_llama_paged_decode(config: LlamaConfig, page_size: int = 16,
         (x,), (ks, vs) = jax.lax.scan(body, (x,), (bp, pages_k, pages_v))
         h_last = jax.lax.dynamic_index_in_dim(x, chunk_len - 1, 0,
                                               keepdims=False)
-        return _head(hp, h_last), ks, vs
+        logits = _head(hp, h_last)
+        # fused greedy sampling: the chunk dispatch also emits the argmax
+        # token, so a greedy request's FINAL chunk needs no separate
+        # sample executable — the engine consumes this token directly and
+        # the logits feed only sampled-temperature lanes
+        return logits, jnp.argmax(logits).astype(jnp.int32), ks, vs
 
     def decode_step(params, toks, lengths, page_tables, pages_k, pages_v,
                     active):                          # graftlint: jit
@@ -948,6 +939,7 @@ def build_llama_paged_decode(config: LlamaConfig, page_size: int = 16,
             page_tables, (pos // page_size)[:, None], 1)[:, 0], TRASH)
         off = pos % page_size
         eff_len = jnp.where(active, lengths + 1, 0)
+        n_q = active.astype(jnp.int32)                # q_len: 1 live, 0 idle
         sin_p, cos_p = sin_t[pos], cos_t[pos]         # [S, D]
 
         def body(carry, layer_in):
@@ -961,7 +953,9 @@ def build_llama_paged_decode(config: LlamaConfig, page_size: int = 16,
             k = _rope_at(k, sin_p, cos_p)
             kc_l, _ = _scatter(kc_l, k, page, off)
             vc_l, _ = _scatter(vc_l, v, page, off)
-            o = _attn(q, kc_l, vc_l, page_tables, eff_len)
+            # decode is the q_len = 1 segment of the unified ragged kernel
+            o = _attn(q[:, None], kc_l, vc_l, page_tables,
+                      pos, n_q, eff_len)[:, 0]
             xc = xc + o.reshape(S, nh * head_dim) @ lp["wo"]
             h = rms_norm_ref(xc, lp["ln2"], c.rms_norm_eps)
             ff = jax.nn.silu(h @ lp["wgate"]) * (h @ lp["wup"])
@@ -980,9 +974,10 @@ def build_llama_paged_decode(config: LlamaConfig, page_size: int = 16,
         lanes write to the trash page and return garbage the engine
         ignores).  Every valid query's K/V scatters into the slot's pages
         at absolute positions lengths[s]..lengths[s]+n_q[s]-1 (RoPE at
-        those positions), then attends over the page-table-gathered
-        context under an intra-chunk causal mask — `prefill_chunk`'s
-        machinery, batched over slots.  Returns (logits0 [S, vocab] f32 —
+        those positions), then each slot attends as one ragged segment of
+        the UNIFIED paged-attention kernel — the very callable decode and
+        chunked prefill dispatch, so verify-vs-decode losslessness is
+        impl-uniform by construction.  Returns (logits0 [S, vocab] f32 —
         position-0 logits for sampled slots; greedy [S, Q] int32 — argmax
         per position, the engine's acceptance test; pages_k; pages_v).
 
@@ -992,7 +987,6 @@ def build_llama_paged_decode(config: LlamaConfig, page_size: int = 16,
         writes before any query can ever attend to them."""
         ep, bp, hp = params
         S, Q = toks.shape
-        P = page_tables.shape[1]
         x = ep["tok"][toks].astype(d)                 # [S, Q, H]
         q_idx = jnp.arange(Q)
         valid = q_idx[None, :] < n_q[:, None]         # [S, Q]
@@ -1003,10 +997,11 @@ def build_llama_paged_decode(config: LlamaConfig, page_size: int = 16,
             page_tables, pos // page_size, axis=1), TRASH)
         off = pos % page_size
         sin, cos = sin_t[pos], cos_t[pos]             # [S, Q, D]
-        kv_pos = jnp.arange(P * page_size)            # [P*ps] logical pos
-        mask = (kv_pos[None, None, :] <= pos[:, :, None]) \
-            & valid[:, :, None]                       # [S, Q, P*ps]
-        scale = 1.0 / math.sqrt(head_dim)
+        # each slot is one ragged segment of the unified kernel: n_q
+        # queries starting at absolute position lengths[s], causal among
+        # themselves and over the cached context — the SAME kernel (and
+        # off-TPU the same ref) decode dispatches with q_len = 1
+        kv_len = lengths + n_q
 
         def body(carry, layer_in):
             xc, = carry
@@ -1019,20 +1014,7 @@ def build_llama_paged_decode(config: LlamaConfig, page_size: int = 16,
             k = _rope_at(k, sin, cos)
             kc_l, _ = _scatter(kc_l, k, page, off)
             vc_l, _ = _scatter(vc_l, v, page, off)
-            # gather each slot's whole context through its page table —
-            # ONE gather serves all Q queries (the per-token decode path
-            # pays it per token)
-            kf = _gather_tables(kc_l, page_tables, S, P)
-            vf = _gather_tables(vc_l, page_tables, S, P)
-            rep = nh // nkv
-            if rep > 1:
-                kf = jnp.repeat(kf, rep, axis=1)
-                vf = jnp.repeat(vf, rep, axis=1)
-            s = jnp.einsum("sqhd,shkd->shqk", q.astype(jnp.float32),
-                           kf.astype(jnp.float32)) * scale
-            s = jnp.where(mask[:, None, :, :], s, -jnp.inf)
-            p = jax.nn.softmax(s, axis=-1).astype(xc.dtype)
-            o = jnp.einsum("shqk,shkd->sqhd", p, vf) \
+            o = _attn(q, kc_l, vc_l, page_tables, lengths, n_q, kv_len) \
                 .reshape(S, Q, nh * head_dim)
             xc = xc + o @ lp["wo"]
             h = rms_norm_ref(xc, lp["ln2"], c.rms_norm_eps)

@@ -1,35 +1,52 @@
-"""Pallas TPU ragged paged attention (decode shape).
+"""Pallas TPU ragged paged attention — ONE kernel for every serving path.
 
 The TPU-native analog of the reference's `block_multihead_attention`
 serving kernel (paddle/phi/kernels/fusion/gpu/block_multi_head_attention*)
 in the shape Ragged Paged Attention (arxiv 2604.15464) describes: the KV
 cache lives in fixed-size PAGES of `page_size` tokens, each sequence owns a
-per-sequence page table, and the kernel's grid walks each query's OWN page
-list — a ragged batch of mixed-length sequences therefore spends zero FLOPs
-(and zero DMA beyond one clamped dummy fetch) on padding to the longest
-sequence.
+per-sequence page table, and each slot contributes a RAGGED QUERY SEGMENT
+`(q_start, q_len, kv_len)` — `q_len` fresh query tokens whose absolute
+positions are `q_start .. q_start + q_len - 1`, attending the slot's paged
+context under an intra-segment causal mask.  The three serving dispatch
+shapes are all special cases of the one grid:
+
+  q_len = 1        decode       (the new token attends everything before it)
+  q_len = K+1      spec verify  (pending + K draft tokens, causal between)
+  q_len = chunk    chunked prefill (one chunk of the prompt, causal over
+                                    cached context + earlier chunk tokens)
+
+so decode, verify, and chunked prefill score through the SAME kernel body
+(and, off-TPU, the same `*_ref`) — the impl-uniformity the speculative
+losslessness guarantee rests on.
 
 Layout (lane-tiled — no 128x padding cliffs like PERF.md §7.2):
 
-  q          [S, Hq, D]          one query token per active sequence slot
+  q          [S, Qmax, Hq, D]    ragged query segments, right-padded to Qmax
   k_pages    [Hkv, NP, ps, D]    page-pooled keys; last two dims are the
   v_pages    [Hkv, NP, ps, D]    (sublane, lane) tile => D=128-friendly
   page_table [S, P] int32        physical page of each logical page slot
-  lengths    [S]   int32         valid KV tokens per slot (0 = inactive)
+  q_start    [S]   int32         absolute position of query 0 per slot
+  q_len      [S]   int32         valid queries per slot (0 = inactive)
+  kv_len     [S]   int32         total valid KV tokens (incl. the segment)
 
 Grid: (S, Hkv, P) with the page dim innermost ("arbitrary" semantics) so
 the per-slot online-softmax scratch survives across a sequence's pages.
-The page table and lengths ride scalar prefetch
+The page table and segment descriptors ride scalar prefetch
 (`pltpu.PrefetchScalarGridSpec`), so the K/V BlockSpec index maps resolve
 the PHYSICAL page to DMA before the kernel body runs — the indirection
 costs no kernel time.  GQA is native: the q block for grid step (s, h) is
 the `Hq // Hkv` query heads sharing kv head h, and K/V pages are fetched
 once per kv head, never materialized per q head.
 
-Pages past a sequence's length are skipped via `pl.when` (their table
-entries are clamped to a valid page id by the cache manager, so the
-speculative DMA stays in bounds); the final page is mask-tailed inside the
-kernel.  A slot with length 0 produces exact zeros.
+Pages past a slot's `kv_len` are skipped via `pl.when` (their table entries
+are clamped to a valid page id by the cache manager, so the speculative DMA
+stays in bounds); partial pages and the causal frontier are mask-tailed
+inside the kernel.  Padding query rows (>= q_len) and inactive slots
+(q_len = 0) produce exact zeros, matching the reference.
+
+int8/fp8 pages (`k_scales`/`v_scales`) dequantize INSIDE the kernel for
+every path — the per-(page, head, token-row) scale pages ride the same
+page-table indirection, and the f32 K/V never exist outside VMEM.
 """
 from __future__ import annotations
 
@@ -43,26 +60,31 @@ from jax.experimental.pallas import tpu as pltpu
 
 from . import _compat  # noqa: F401  (pltpu.CompilerParams alias, jax<=0.4)
 
-__all__ = ["ragged_paged_attention_decode", "paged_attention_decode_ref",
+__all__ = ["ragged_paged_attention", "ragged_paged_attention_ref",
+           "ragged_paged_attention_decode", "paged_attention_decode_ref",
            "paged_gather_kv", "paged_gather_scales"]
 
 NEG_INF = -1e30
 
 
-def _attend_page(q, k, v, i, length, page_size, sm_scale,
-                 m_scr, l_scr, acc_scr):
+def _attend_page(q, k, v, mask, sm_scale, m_scr, l_scr, acc_scr):
     """One online-softmax update over one (already dequantized, f32) K/V
     page — shared by the plain and fused-dequant kernel bodies so the
-    accumulator math can never drift between them."""
+    accumulator math can never drift between them.  ``q`` is the flattened
+    [Qmax*rep, D] query block, ``mask`` the [Qmax*rep, ps] validity of each
+    (query row, kv position) pair; a row with no valid position EVER (a
+    padding query) keeps m = NEG_INF and l = 0, so the finalizer emits
+    exact zeros for it."""
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32) * sm_scale         # [rep, ps]
-    pos = i * page_size + jax.lax.broadcasted_iota(
-        jnp.int32, s.shape, 1)
-    s = jnp.where(pos < length, s, NEG_INF)
-    m_prev = m_scr[:]                             # [rep, 1]
+        preferred_element_type=jnp.float32) * sm_scale     # [Qmax*rep, ps]
+    s = jnp.where(mask, s, NEG_INF)
+    m_prev = m_scr[:]                                      # [Qmax*rep, 1]
     m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-    p = jnp.exp(s - m_new)
+    # re-mask p explicitly: on a row whose every position is masked,
+    # exp(NEG_INF - NEG_INF) would be 1, silently averaging garbage V rows
+    # into the padding-query output
+    p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
     alpha = jnp.exp(m_prev - m_new)
     l_scr[:] = alpha * l_scr[:] + jnp.sum(p, axis=1, keepdims=True)
     acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
@@ -71,12 +93,24 @@ def _attend_page(q, k, v, i, length, page_size, sm_scale,
     m_scr[:] = m_new
 
 
+def _segment_mask(shape, i, page_size, rep, q_start, q_len, kv_len):
+    """[Qmax*rep, ps] validity of page i's positions against the slot's
+    ragged segment: kv position `col` is visible to query row `r` (query
+    index r // rep) iff it is causally before-or-at that query's absolute
+    position, the query is real, and the position holds valid KV."""
+    row = jax.lax.broadcasted_iota(jnp.int32, shape, 0)
+    col = i * page_size + jax.lax.broadcasted_iota(jnp.int32, shape, 1)
+    qi = row // rep
+    return (col <= q_start + qi) & (qi < q_len) & (col < kv_len)
+
+
 def _finalize_out(i, n_pages, o_ref, m_scr, l_scr, acc_scr):
     @pl.when(i == n_pages - 1)
     def _finalize():
         l = l_scr[:]
         inv = jnp.where(l > 0.0, 1.0 / jnp.where(l > 0.0, l, 1.0), 0.0)
-        o_ref[0] = (acc_scr[:] * inv).astype(o_ref.dtype)
+        o_ref[0] = (acc_scr[:] * inv).reshape(o_ref.shape[1:]) \
+            .astype(o_ref.dtype)
 
 
 def _init_scratch(i, m_scr, l_scr, acc_scr):
@@ -87,62 +121,74 @@ def _init_scratch(i, m_scr, l_scr, acc_scr):
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
 
-def _decode_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
-                   m_scr, l_scr, acc_scr, *, page_size, sm_scale):
+def _ragged_kernel(pt_ref, qs_ref, ql_ref, kl_ref, q_ref, k_ref, v_ref,
+                   o_ref, m_scr, l_scr, acc_scr, *, page_size, sm_scale,
+                   rep):
     b = pl.program_id(0)          # sequence slot
     i = pl.program_id(2)          # logical page index (innermost, reduction)
     n_pages = pl.num_programs(2)
     _init_scratch(i, m_scr, l_scr, acc_scr)
-    length = len_ref[b]
+    q_start, q_len, kv_len = qs_ref[b], ql_ref[b], kl_ref[b]
 
-    @pl.when(i * page_size < length)
+    @pl.when(i * page_size < kv_len)
     def _body():
-        _attend_page(q_ref[0].astype(jnp.float32),
-                     k_ref[0, 0].astype(jnp.float32),
+        q = q_ref[0].astype(jnp.float32)
+        qmax = q.shape[0]
+        q2 = q.reshape(qmax * rep, q.shape[-1])
+        mask = _segment_mask((qmax * rep, page_size), i, page_size, rep,
+                             q_start, q_len, kv_len)
+        _attend_page(q2, k_ref[0, 0].astype(jnp.float32),
                      v_ref[0, 0].astype(jnp.float32),
-                     i, length, page_size, sm_scale, m_scr, l_scr, acc_scr)
+                     mask, sm_scale, m_scr, l_scr, acc_scr)
 
     _finalize_out(i, n_pages, o_ref, m_scr, l_scr, acc_scr)
 
 
-def _decode_kernel_quant(pt_ref, len_ref, q_ref, k_ref, ks_ref, v_ref,
-                         vs_ref, o_ref, m_scr, l_scr, acc_scr, *,
-                         page_size, sm_scale):
-    """Fused-dequant variant (ROADMAP item 2): K/V pages arrive in their
-    int8/fp8 STORAGE dtype plus a per-row f32 absmax scale page, and the
-    dequant happens here, on the page tile already resident in VMEM —
-    quantized K/V never materialize as an f32 tensor anywhere (DTYPE001
-    polices the host-side paths).  The dequant expression mirrors
-    ``serving.quant.dequantize_kv`` exactly (astype f32, multiply by the
-    broadcast row scale) so the kernel and every jnp gather path see
-    identical values for identical stored rows."""
+def _ragged_kernel_quant(pt_ref, qs_ref, ql_ref, kl_ref, q_ref, k_ref,
+                         ks_ref, v_ref, vs_ref, o_ref, m_scr, l_scr,
+                         acc_scr, *, page_size, sm_scale, rep):
+    """Fused-dequant variant: K/V pages arrive in their int8/fp8 STORAGE
+    dtype plus a per-row f32 absmax scale page, and the dequant happens
+    here, on the page tile already resident in VMEM — quantized K/V never
+    materialize as an f32 tensor anywhere (DTYPE001 polices the host-side
+    paths).  The dequant expression mirrors ``serving.quant.dequantize_kv``
+    exactly (astype f32, multiply by the broadcast row scale) so the kernel
+    and every jnp gather path see identical values for identical stored
+    rows — on EVERY dispatch path, not just decode."""
     b = pl.program_id(0)
     i = pl.program_id(2)
     n_pages = pl.num_programs(2)
     _init_scratch(i, m_scr, l_scr, acc_scr)
-    length = len_ref[b]
+    q_start, q_len, kv_len = qs_ref[b], ql_ref[b], kl_ref[b]
 
-    @pl.when(i * page_size < length)
+    @pl.when(i * page_size < kv_len)
     def _body():
         k = k_ref[0, 0].astype(jnp.float32) \
             * ks_ref[0, 0].astype(jnp.float32)[:, None]        # [ps, D]
         v = v_ref[0, 0].astype(jnp.float32) \
             * vs_ref[0, 0].astype(jnp.float32)[:, None]
-        _attend_page(q_ref[0].astype(jnp.float32), k, v,
-                     i, length, page_size, sm_scale, m_scr, l_scr, acc_scr)
+        q = q_ref[0].astype(jnp.float32)
+        qmax = q.shape[0]
+        q2 = q.reshape(qmax * rep, q.shape[-1])
+        mask = _segment_mask((qmax * rep, page_size), i, page_size, rep,
+                             q_start, q_len, kv_len)
+        _attend_page(q2, k, v, mask, sm_scale, m_scr, l_scr, acc_scr)
 
     _finalize_out(i, n_pages, o_ref, m_scr, l_scr, acc_scr)
 
 
-def ragged_paged_attention_decode(q, k_pages, v_pages, page_table, lengths,
-                                  sm_scale=None, interpret=False,
-                                  out_dtype=None, k_scales=None,
-                                  v_scales=None):
-    """One attention step per sequence slot over that slot's page list.
+def ragged_paged_attention(q, k_pages, v_pages, page_table, q_start, q_len,
+                           kv_len, sm_scale=None, interpret=False,
+                           out_dtype=None, k_scales=None, v_scales=None):
+    """Ragged-segment paged attention over each slot's page list.
 
-    q [S, Hq, D], k_pages/v_pages [Hkv, NP, ps, D], page_table [S, P] int32
-    (entries past a sequence's pages must hold any in-range page id),
-    lengths [S] int32 -> o [S, Hq, D].  Requires Hq % Hkv == 0.
+    q [S, Qmax, Hq, D], k_pages/v_pages [Hkv, NP, ps, D], page_table
+    [S, P] int32 (entries past a slot's pages must hold any in-range page
+    id), q_start/q_len/kv_len [S] int32 -> o [S, Qmax, Hq, D].  Query j of
+    slot s sits at absolute position q_start[s] + j and attends kv
+    positions <= its own (and < kv_len[s]); rows past q_len[s] — and every
+    row of a q_len = 0 slot — come back exactly zero.  Requires
+    Hq % Hkv == 0.
 
     out_dtype: output dtype (default q.dtype).  Accumulation is f32 either
     way; pass jnp.float32 with bf16 inputs to read the un-downcast result
@@ -155,7 +201,7 @@ def ragged_paged_attention_decode(q, k_pages, v_pages, page_table, lengths,
     kernel).  The scale pages ride the same page-table indirection as the
     data pages.
     """
-    s_slots, hq, d = q.shape
+    s_slots, qmax, hq, d = q.shape
     hkv, _np_, page_size, _d = k_pages.shape
     n_ptab = page_table.shape[1]
     if hq % hkv != 0:
@@ -169,49 +215,50 @@ def ragged_paged_attention_decode(q, k_pages, v_pages, page_table, lengths,
 
     grid = (s_slots, hkv, n_ptab)
 
-    def q_idx(b, h, i, pt, lens):
-        return (b, h, 0)
+    def q_idx(b, h, i, pt, qs, ql, kl):
+        return (b, 0, h, 0)
 
-    def kv_idx(b, h, i, pt, lens):
+    def kv_idx(b, h, i, pt, qs, ql, kl):
         return (h, pt[b, i], 0, 0)
 
-    def sc_idx(b, h, i, pt, lens):
+    def sc_idx(b, h, i, pt, qs, ql, kl):
         return (h, pt[b, i], 0)
 
+    q_spec = pl.BlockSpec((1, qmax, rep, d), q_idx)
     kv_spec = pl.BlockSpec((1, 1, page_size, d), kv_idx)
     sc_spec = pl.BlockSpec((1, 1, page_size), sc_idx)
     quant = k_scales is not None
     if quant:
-        in_specs = [pl.BlockSpec((1, rep, d), q_idx),
-                    kv_spec, sc_spec, kv_spec, sc_spec]
+        in_specs = [q_spec, kv_spec, sc_spec, kv_spec, sc_spec]
         inputs = (q, k_pages, k_scales, v_pages, v_scales)
-        body = _decode_kernel_quant
+        body = _ragged_kernel_quant
     else:
-        in_specs = [pl.BlockSpec((1, rep, d), q_idx), kv_spec, kv_spec]
+        in_specs = [q_spec, kv_spec, kv_spec]
         inputs = (q, k_pages, v_pages)
-        body = _decode_kernel
+        body = _ragged_kernel
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=4,
         grid=grid,
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, rep, d), q_idx),
+        out_specs=q_spec,
         scratch_shapes=[
-            pltpu.VMEM((rep, 1), jnp.float32),
-            pltpu.VMEM((rep, 1), jnp.float32),
-            pltpu.VMEM((rep, d), jnp.float32),
+            pltpu.VMEM((qmax * rep, 1), jnp.float32),
+            pltpu.VMEM((qmax * rep, 1), jnp.float32),
+            pltpu.VMEM((qmax * rep, d), jnp.float32),
         ],
     )
     kernel = functools.partial(body, page_size=page_size,
-                               sm_scale=sm_scale)
+                               sm_scale=sm_scale, rep=rep)
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((s_slots, hq, d),
+        out_shape=jax.ShapeDtypeStruct((s_slots, qmax, hq, d),
                                        out_dtype or q.dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary", "arbitrary")),
         interpret=interpret,
-    )(page_table.astype(jnp.int32), lengths.astype(jnp.int32), *inputs)
+    )(page_table.astype(jnp.int32), q_start.astype(jnp.int32),
+      q_len.astype(jnp.int32), kv_len.astype(jnp.int32), *inputs)
 
 
 def paged_gather_kv(pages, page_table):
@@ -231,17 +278,24 @@ def paged_gather_scales(scales, page_table):
     return g.transpose(1, 2, 3, 0).reshape(s, p * ps, hkv)
 
 
-def paged_attention_decode_ref(q, k_pages, v_pages, page_table, lengths,
-                               sm_scale=None, out_dtype=None, k_scales=None,
-                               v_scales=None):
-    """jnp reference/fallback with identical semantics to the kernel
-    (gathers pages dense, masks positions >= length, zeros length-0 slots;
-    with k_scales/v_scales the gathered int8/fp8 rows dequantize by the
-    same astype-f32-times-row-scale expression the kernel fuses).
-    This is the CPU path the serving engine uses off-TPU."""
-    s_slots, hq, d = q.shape
+def ragged_paged_attention_ref(q, k_pages, v_pages, page_table, q_start,
+                               q_len, kv_len, sm_scale=None, out_dtype=None,
+                               k_scales=None, v_scales=None):
+    """jnp reference/fallback with identical semantics to the ragged
+    kernel (gathers pages dense, masks causally inside each slot's
+    segment, zeros padding query rows and q_len-0 slots; with
+    k_scales/v_scales the gathered int8/fp8 rows dequantize by the same
+    astype-f32-times-row-scale expression the kernel fuses).  This is the
+    CPU path the serving engine dispatches for decode, verify, AND
+    chunked prefill — one implementation per engine, every path."""
+    s_slots, qmax, hq, d = q.shape
     hkv = k_pages.shape[0]
     page_size = k_pages.shape[2]
+    if hq % hkv != 0:
+        raise ValueError(f"num q heads ({hq}) must be a multiple of kv "
+                         f"heads ({hkv})")
+    if (k_scales is None) != (v_scales is None):
+        raise ValueError("pass both k_scales and v_scales, or neither")
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(d)
     k = paged_gather_kv(k_pages, page_table)      # [S, T, Hkv, D]
@@ -252,23 +306,62 @@ def paged_attention_decode_ref(q, k_pages, v_pages, page_table, lengths,
         k = k.astype(jnp.float32) * ks.astype(jnp.float32)[..., None]
         v = v.astype(jnp.float32) * vs.astype(jnp.float32)[..., None]
         # round to the QUERY's compute dtype before attending: on a bf16
-        # engine every jnp consumer (this ref, the chunk/verify gathers)
-        # then sees identical rounded rows — the engine's self-exactness
-        # across decode/re-prefill paths needs one value per stored row.
-        # No-op at f32.  (The fused TPU kernel keeps f32 dequant in VMEM —
-        # decode runs ONE impl per engine, so per-engine exactness holds;
-        # kernel-vs-jnp agreement stays the §11 argmax-gated caveat.)
+        # engine every jnp consumer then sees identical rounded rows — the
+        # engine's self-exactness across decode/verify/chunk/re-prefill
+        # paths needs one value per stored row.  No-op at f32.  (The fused
+        # TPU kernel keeps f32 dequant in VMEM — each engine runs ONE impl
+        # on every path, so per-engine exactness holds.)
         k = k.astype(q.dtype)
         v = v.astype(q.dtype)
     if hq != hkv:
         repn = hq // hkv
         k = jnp.repeat(k, repn, axis=2)
         v = jnp.repeat(v, repn, axis=2)
-    s = jnp.einsum("shd,sthd->sht", q.astype(jnp.float32),
+    s = jnp.einsum("sqhd,sthd->shqt", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * sm_scale
-    t_pos = jnp.arange(s.shape[-1])[None, None, :]
-    s = jnp.where(t_pos < lengths[:, None, None], s, NEG_INF)
+    t_pos = jnp.arange(s.shape[-1])[None, None, None, :]
+    qi = jnp.arange(qmax)[None, None, :, None]
+    ok = (t_pos <= q_start[:, None, None, None] + qi) \
+        & (qi < q_len[:, None, None, None]) \
+        & (t_pos < kv_len[:, None, None, None])
+    # NEG_INF (not -inf): a fully masked row softmaxes to uniform garbage
+    # instead of NaN, and the q_len mask below zeroes it either way
+    s = jnp.where(ok, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
-    o = jnp.einsum("sht,sthd->shd", p, v.astype(jnp.float32))
-    o = jnp.where(lengths[:, None, None] > 0, o, 0.0)
+    o = jnp.einsum("shqt,sthd->sqhd", p, v.astype(jnp.float32))
+    o = jnp.where(jnp.arange(qmax)[None, :, None, None]
+                  < q_len[:, None, None, None], o, 0.0)
     return o.astype(out_dtype or q.dtype)
+
+
+def ragged_paged_attention_decode(q, k_pages, v_pages, page_table, lengths,
+                                  sm_scale=None, interpret=False,
+                                  out_dtype=None, k_scales=None,
+                                  v_scales=None):
+    """Decode-shape convenience wrapper: one query per slot (`q [S, Hq,
+    D]`, `lengths [S]` = valid KV INCLUDING the freshly written token) is
+    the `q_len = 1` special case of :func:`ragged_paged_attention` — kept
+    as an API so callers with a flat decode batch don't hand-build the
+    segment descriptors.  A slot with length 0 produces exact zeros."""
+    lengths = lengths.astype(jnp.int32)
+    o = ragged_paged_attention(
+        q[:, None], k_pages, v_pages, page_table,
+        jnp.maximum(lengths - 1, 0), (lengths > 0).astype(jnp.int32),
+        lengths, sm_scale=sm_scale, interpret=interpret,
+        out_dtype=out_dtype, k_scales=k_scales, v_scales=v_scales)
+    return o[:, 0]
+
+
+def paged_attention_decode_ref(q, k_pages, v_pages, page_table, lengths,
+                               sm_scale=None, out_dtype=None, k_scales=None,
+                               v_scales=None):
+    """Decode-shape wrapper over :func:`ragged_paged_attention_ref` — the
+    same `q_len = 1` specialization as the kernel-side wrapper, so the
+    decode pair stays a pure delegation to the ONE ragged pair."""
+    lengths = lengths.astype(jnp.int32)
+    o = ragged_paged_attention_ref(
+        q[:, None], k_pages, v_pages, page_table,
+        jnp.maximum(lengths - 1, 0), (lengths > 0).astype(jnp.int32),
+        lengths, sm_scale=sm_scale, out_dtype=out_dtype,
+        k_scales=k_scales, v_scales=v_scales)
+    return o[:, 0]

@@ -62,6 +62,12 @@ FLEET_HIT_KEY = "fleet_hit_rate"
 # parity block).  Drift-checked like the other columns.
 QUANT_CAP_KEY = "capacity_ratio"
 QUANT_MATCH_KEY = "exact_match"
+# ISSUE 16 column: the tokens-not-logits steady state — the serving
+# trace's ``fused_sampling.fused_frac`` (share of steady-state dispatches
+# whose tokens were consumed on-device instead of returning logits for
+# host sampling; greedy traffic pins it at 1.0).  Drift-checked like the
+# other columns.
+FUSED_KEY = "fused_frac"
 
 
 def find_artifacts(root: str) -> list[tuple[int, str]]:
@@ -226,6 +232,19 @@ def find_quant_exact_match(d):
     return _find(d, match)
 
 
+def find_fused_frac(d):
+    """First fused-sampling fraction: the serving artifact's
+    ``fused_sampling.fused_frac`` — share of steady-state dispatches
+    (decode + verify) whose token was emitted on-device instead of
+    returning logits for host sampling (ISSUE 16)."""
+    def match(n):
+        fs = n.get("fused_sampling")
+        if isinstance(fs, dict) and _num(fs.get(FUSED_KEY)):
+            return fs[FUSED_KEY]
+        return None
+    return _find(d, match)
+
+
 def _fmt(v, nd=1):
     if v is None:
         return "-"
@@ -251,6 +270,7 @@ def trend(root: str = ".", verbose: bool = True) -> int:
     prev_fleet_hit = False
     prev_quant_cap = False
     prev_quant_match = False
+    prev_fused = False
     for rnd, path in arts:
         try:
             with open(path) as f:
@@ -322,6 +342,12 @@ def trend(root: str = ".", verbose: bool = True) -> int:
                             f"(parity.{QUANT_MATCH_KEY}) present in an "
                             f"earlier round but missing here")
         prev_quant_match = prev_quant_match or quant_match is not None
+        fused_frac = find_fused_frac(parsed)
+        if fused_frac is None and prev_fused:
+            problems.append(f"{path}: fused-sampling indicator "
+                            f"(fused_sampling.{FUSED_KEY}) present in an "
+                            f"earlier round but missing here")
+        prev_fused = prev_fused or fused_frac is not None
         rows.append({
             "round": rnd,
             "metric": parsed.get("metric"),
@@ -357,13 +383,17 @@ def trend(root: str = ".", verbose: bool = True) -> int:
             # ISSUE 15 columns: quantized capacity win + exact-match rate
             "quant_capacity_ratio": quant_cap,
             "quant_exact_match": quant_match,
+            # ISSUE 16 column: on-device greedy sampling share of
+            # steady-state dispatches (tokens, not logits)
+            "fused_frac": fused_frac,
         })
     if verbose:
         hdr = (f"{'round':>5}  {'tokens/s':>10}  {'vs_base':>8}  "
                f"{'serve tok/s':>11}  {'ttft_p95_ms':>11}  {'goodput':>7}  "
                f"{'overlap':>7}  {'slo_gput':>8}  {'rec_p50':>7}  "
                f"{'perr_p95':>8}  {'alerts':>6}  {'dsync':>5}  "
-               f"{'gprh':>6}  {'f_hit':>5}  {'q_cap':>5}  {'q_em':>5}")
+               f"{'gprh':>6}  {'f_hit':>5}  {'q_cap':>5}  {'q_em':>5}  "
+               f"{'fused':>5}")
         print(hdr)
         print("-" * len(hdr))
         for r in rows:
@@ -381,7 +411,8 @@ def trend(root: str = ".", verbose: bool = True) -> int:
                   f"{_fmt(r['goodput_per_replica_hour'], 0):>6}  "
                   f"{_fmt(r['fleet_hit_rate'], 3):>5}  "
                   f"{_fmt(r['quant_capacity_ratio'], 2):>5}  "
-                  f"{_fmt(r['quant_exact_match'], 3):>5}")
+                  f"{_fmt(r['quant_exact_match'], 3):>5}  "
+                  f"{_fmt(r['fused_frac'], 3):>5}")
         v0, v1 = rows[0]["value"], rows[-1]["value"]
         if len(rows) >= 2 \
                 and all(isinstance(v, (int, float))

@@ -56,8 +56,11 @@ TTFT_KEYS = ("ttft_p50_ms", "ttft_p95_ms", "ttft_p99_ms", "slo_ttft_ms",
 REQUIRED_METRICS = ("serve.ttft_s", "serve.tpot_s", "serve.queue_s",
                     "serve.e2e_s", "engine.step_host_s")
 # engine counters that must ride along in the snapshot
+# (fused_sample_steps: ISSUE 16 tokens-not-logits steady state — dispatches
+# whose tokens were consumed on-device instead of returning logits)
 REQUIRED_ENGINE_COUNTERS = ("engine.tokens_generated", "engine.decode_steps",
-                            "engine.prefill_tokens_executed")
+                            "engine.prefill_tokens_executed",
+                            "engine.fused_sample_steps")
 # ISSUE 7 sections: host/device step decomposition, memory observatory,
 # compile accounting — every serving trace section must carry all three
 UTILIZATION_KEYS = ("steps", "host_busy_s", "dispatch_s", "device_wait_s",

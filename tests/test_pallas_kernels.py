@@ -570,3 +570,150 @@ def test_paged_attention_decode_quantized_parity_vs_ref(kv_dtype):
     with pytest.raises(ValueError):
         ragged_paged_attention_decode(q, kq, vq, pt, lens, interpret=True,
                                       k_scales=ks)
+
+# ---------------------------------------------------------------------------
+# UNIFIED ragged paged-attention kernel (ISSUE 16): decode / speculative
+# verify / chunked prefill are all ragged (q_start, q_len, kv_len) segments
+# of ONE kernel — these sweeps pin kernel-vs-ref parity across the segment
+# shapes the serving engine actually dispatches
+# ---------------------------------------------------------------------------
+def _mk_ragged(S, Hq, Hkv, D, ps, NP, P, dtype=np.float32, seed_off=0):
+    lr = np.random.default_rng(11 + seed_off)
+    q = jnp.asarray(lr.standard_normal((S, 8, Hq, D)).astype(dtype))
+    kp = jnp.asarray(lr.standard_normal((Hkv, NP, ps, D)).astype(dtype))
+    vp = jnp.asarray(lr.standard_normal((Hkv, NP, ps, D)).astype(dtype))
+    # random (possibly shared) physical pages — parity only needs valid ids
+    pt = jnp.asarray(lr.integers(0, NP, (S, P)).astype(np.int32))
+    return q, kp, vp, pt
+
+
+def test_ragged_paged_attention_parity_vs_ref():
+    """One batch mixing every serving segment shape: q_len=1 (decode),
+    q_len=K+1 (verify), q_len=chunk (chunked prefill, full Qmax), and an
+    inactive q_len=0 slot — with a verify segment STRADDLING a page
+    boundary (queries at positions 14..18, ps=16)."""
+    from paddle_tpu.ops.pallas.paged_attention import (
+        ragged_paged_attention, ragged_paged_attention_ref)
+    S, Hq, Hkv, D, ps, NP, P = 4, 8, 2, 64, 16, 13, 3
+    q, kp, vp, pt = _mk_ragged(S, Hq, Hkv, D, ps, NP, P)
+    q_start = jnp.asarray(np.array([7, 14, 16, 0], np.int32))
+    q_len = jnp.asarray(np.array([1, 5, 8, 0], np.int32))
+    kv_len = jnp.asarray(np.array([8, 19, 24, 0], np.int32))
+    out = ragged_paged_attention(q, kp, vp, pt, q_start, q_len, kv_len,
+                                 interpret=True)
+    ref = ragged_paged_attention_ref(q, kp, vp, pt, q_start, q_len, kv_len)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    # padding query rows (>= q_len) and the inactive slot are exact zeros
+    # on BOTH paths — garbage here would poison the residual stream
+    assert not np.asarray(out[0, 1:]).any() and not np.asarray(ref[0, 1:]).any()
+    assert not np.asarray(out[1, 5:]).any() and not np.asarray(ref[1, 5:]).any()
+    assert not np.asarray(out[3]).any() and not np.asarray(ref[3]).any()
+
+
+@pytest.mark.parametrize(
+    "hq,hkv",
+    [pytest.param(4, 4, marks=pytest.mark.slow),   # MHA 1x: the mixed-widths
+     (8, 2),                                       #   parity sweep covers it
+     pytest.param(16, 2, marks=pytest.mark.slow)])  # 8x: same grouping math
+def test_ragged_paged_attention_gqa_ratios(hq, hkv):
+    """GQA head ratios 1x/4x/8x: the kernel fetches K/V once per kv head
+    and flattens the query-head group into the scratch rows."""
+    from paddle_tpu.ops.pallas.paged_attention import (
+        ragged_paged_attention, ragged_paged_attention_ref)
+    S, D, ps, NP, P = 3, 32, 8, 11, 4
+    q, kp, vp, pt = _mk_ragged(S, hq, hkv, D, ps, NP, P, seed_off=hq)
+    q_start = jnp.asarray(np.array([0, 6, 20], np.int32))
+    q_len = jnp.asarray(np.array([4, 1, 8], np.int32))
+    kv_len = jnp.asarray(np.array([4, 7, 28], np.int32))
+    out = ragged_paged_attention(q, kp, vp, pt, q_start, q_len, kv_len,
+                                 interpret=True)
+    ref = ragged_paged_attention_ref(q, kp, vp, pt, q_start, q_len, kv_len)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.slow   # interpret-mode bf16 compile; f32 + quant parity stay tier-1
+def test_ragged_paged_attention_bf16():
+    """bf16 inputs, f32 accumulation: read the un-downcast result via
+    out_dtype=f32 and bound kernel-vs-ref drift at 2e-4 (the same
+    acceptance bound as the decode-shaped bf16 parity test)."""
+    from paddle_tpu.ops.pallas.paged_attention import (
+        ragged_paged_attention, ragged_paged_attention_ref)
+    S, Hq, Hkv, D, ps, NP, P = 3, 8, 2, 64, 16, 13, 3
+    lr = np.random.default_rng(23)
+    q = jnp.asarray(lr.standard_normal((S, 8, Hq, D)), jnp.bfloat16)
+    kp = jnp.asarray(lr.standard_normal((Hkv, NP, ps, D)), jnp.bfloat16)
+    vp = jnp.asarray(lr.standard_normal((Hkv, NP, ps, D)), jnp.bfloat16)
+    pt = jnp.asarray(lr.permutation(NP - 1)[: S * P].reshape(S, P)
+                     .astype(np.int32))
+    q_start = jnp.asarray(np.array([3, 12, 16], np.int32))
+    q_len = jnp.asarray(np.array([1, 5, 8], np.int32))
+    kv_len = jnp.asarray(np.array([4, 17, 24], np.int32))
+    out = ragged_paged_attention(q, kp, vp, pt, q_start, q_len, kv_len,
+                                 interpret=True, out_dtype=jnp.float32)
+    ref = ragged_paged_attention_ref(q, kp, vp, pt, q_start, q_len, kv_len,
+                                     out_dtype=jnp.float32)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize(
+    "kv_dtype",
+    ["int8",
+     pytest.param("fp8", marks=pytest.mark.slow)])  # same codepath, 2nd dtype
+def test_ragged_paged_attention_quantized_parity(kv_dtype):
+    """Fused dequant on EVERY path (the ISSUE 16 extension of the ISSUE 15
+    decode-only fusion): int8/fp8 pages + per-row scales through the
+    ragged kernel across decode/verify/chunk segment shapes, and the
+    scale-aware ref must equal manual-dequant + plain ref BIT-EXACTLY
+    (both route through the one sanctioned dequant expression)."""
+    from paddle_tpu.ops.pallas.paged_attention import (
+        ragged_paged_attention, ragged_paged_attention_ref)
+    from paddle_tpu.serving.quant import kv_spec, quantize_kv
+    S, Hq, Hkv, D, ps, NP, P = 4, 8, 2, 64, 16, 13, 3
+    storage, qmax = kv_spec(kv_dtype)
+    q, kf, vf, pt = _mk_ragged(S, Hq, Hkv, D, ps, NP, P, seed_off=3)
+    kq, ks = quantize_kv(kf, qmax=qmax, dtype=storage)
+    vq, vs = quantize_kv(vf, qmax=qmax, dtype=storage)
+    q_start = jnp.asarray(np.array([7, 14, 16, 0], np.int32))
+    q_len = jnp.asarray(np.array([1, 5, 8, 0], np.int32))
+    kv_len = jnp.asarray(np.array([8, 19, 24, 0], np.int32))
+    out = ragged_paged_attention(q, kq, vq, pt, q_start, q_len, kv_len,
+                                 interpret=True, k_scales=ks, v_scales=vs)
+    ref = ragged_paged_attention_ref(q, kq, vq, pt, q_start, q_len, kv_len,
+                                     k_scales=ks, v_scales=vs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    assert not np.asarray(out[3]).any() and not np.asarray(ref[3]).any()
+    kd = kq.astype(jnp.float32) * ks[..., None]
+    vd = vq.astype(jnp.float32) * vs[..., None]
+    ref2 = ragged_paged_attention_ref(q, kd, vd, pt, q_start, q_len, kv_len)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(ref2))
+    with pytest.raises(ValueError):
+        ragged_paged_attention(q, kq, vq, pt, q_start, q_len, kv_len,
+                               interpret=True, k_scales=ks)
+
+
+def test_ragged_decode_wrappers_delegate():
+    """The decode-shaped API is a PURE q_len=1 delegation to the unified
+    ragged pair — wrapper output must equal hand-built segment descriptors
+    fed to the ragged fns, bit-for-bit (no second decode implementation)."""
+    from paddle_tpu.ops.pallas.paged_attention import (
+        ragged_paged_attention, ragged_paged_attention_ref,
+        ragged_paged_attention_decode, paged_attention_decode_ref)
+    S, Hq, Hkv, D, ps, NP, P = 4, 8, 2, 64, 16, 13, 3
+    q, kp, vp, pt = _mk_ragged(S, Hq, Hkv, D, ps, NP, P, seed_off=5)
+    qd = q[:, 0]
+    lens = jnp.asarray(np.array([0, 5, ps, P * ps], np.int32))
+    q_start = jnp.maximum(lens - 1, 0)
+    q_len = (lens > 0).astype(jnp.int32)
+    wrap = ragged_paged_attention_decode(qd, kp, vp, pt, lens,
+                                         interpret=True)
+    direct = ragged_paged_attention(qd[:, None], kp, vp, pt, q_start,
+                                    q_len, lens, interpret=True)[:, 0]
+    np.testing.assert_array_equal(np.asarray(wrap), np.asarray(direct))
+    wrap_r = paged_attention_decode_ref(qd, kp, vp, pt, lens)
+    direct_r = ragged_paged_attention_ref(qd[:, None], kp, vp, pt, q_start,
+                                          q_len, lens)[:, 0]
+    np.testing.assert_array_equal(np.asarray(wrap_r), np.asarray(direct_r))

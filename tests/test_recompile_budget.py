@@ -160,11 +160,15 @@ class TestServingSteadyState:
         #                    slots ride verify dispatches on this traffic,
         #                    so only the mixed-batch horizon compiles)
         #   verify_step   1  static [S, K+1] lanes
-        #   sample        2  greedy + nucleus single-logits samplers
+        #   sample        1  the NUCLEUS single-logits sampler only —
+        #                    greedy sampling is fused into the chunk/
+        #                    verify/horizon dispatches (argmax in-
+        #                    executable), so no greedy sampler variant
+        #                    exists post-kernel-unification
         #   page_copy     1  traced-src/dst COW copy
         assert warm_variants == {"prefill": 1, "prefill_chunk": 1,
                                  "decode_step": 1, "verify_step": 1,
-                                 "sample": 2, "page_copy": 1}, warm_variants
+                                 "sample": 1, "page_copy": 1}, warm_variants
 
     def test_steady_state_recompile_raises(self):
         """A decode/verify/prefill variant that recompiles under the

@@ -405,6 +405,98 @@ class TestSpecDecodeEngineParity:
         # disjoint dispatch counts: plain horizons + verifies = all steps
         assert st["verify_steps"] + st["decode_steps"] == eng.steps_run
         assert st["verify_steps"] > 0
+        # all-greedy traffic: EVERY steady-state dispatch emitted tokens
+        # on-device (fused argmax) — none returned logits for host sampling
+        assert st["fused_sample_steps"] == eng.steps_run > 0
         req = done[r]
         assert req.draft_accepted == st["draft_tokens_accepted"]
         assert req.draft_proposed == st["draft_tokens_proposed"]
+
+
+# ---------------------------------------------------------------------------
+# Impl-uniform losslessness (ISSUE 16): verify, decode, AND chunked prefill
+# must score through the ONE ragged attention callable — no jnp-reference
+# fallback special to the verify path
+# ---------------------------------------------------------------------------
+class TestImplUniformAttention:
+    def test_verify_decode_chunk_share_one_attention_callable(self):
+        """Monkeypatch the unified ragged ref with a recorder BEFORE
+        building the paged fns (the builder binds it at build time): one
+        chunked prefill, one decode step, and one verify dispatch must all
+        route through that single recorded callable, with segment widths
+        Qmax = chunk, 1, and K+1 — there is no per-path attention
+        implementation left to drift."""
+        import paddle_tpu.ops.pallas.paged_attention as pa
+        calls = []
+        real = pa.ragged_paged_attention_ref
+
+        def recorder(q, *a, **kw):
+            calls.append(q.shape[1])          # Qmax of this dispatch
+            return real(q, *a, **kw)
+
+        cfg = llama_config_tiny(vocab=64, hidden=32, layers=2, heads=4,
+                                seq=64)
+        params = _params(cfg, seed=5)
+        ps, NP, P = 4, 16, 8
+        orig = pa.ragged_paged_attention_ref
+        pa.ragged_paged_attention_ref = recorder
+        try:
+            init_pages, _prefill, prefill_chunk, decode_step, verify_step = \
+                build_llama_paged_decode(cfg, page_size=ps, num_pages=NP,
+                                         attention_impl="ref")
+            cache = init_pages()
+            row = np.zeros((P,), np.int32)
+            row[:4] = [3, 7, 1, 5]
+            ids = rng.integers(1, 64, (1, 8)).astype(np.int32)
+            # chunked prefill: the whole prompt as one chunk (Qmax = 8)
+            logits, tok_g, pk, pv = prefill_chunk(
+                params, jnp.asarray(ids), jnp.asarray(0, jnp.int32),
+                jnp.asarray(8, jnp.int32), jnp.asarray(row),
+                cache["k"], cache["v"])
+            assert int(tok_g) == int(jnp.argmax(logits))
+            chunk_widths = set(calls)
+            assert chunk_widths == {8}, calls
+            calls.clear()
+            # decode: Qmax = 1
+            tables = jnp.asarray(row[None])
+            _lg, pk, pv = decode_step(
+                params, jnp.asarray([int(tok_g)], jnp.int32),
+                jnp.asarray([8], jnp.int32), tables, pk, pv,
+                jnp.ones((1,), bool))
+            assert set(calls) == {1}, calls
+            calls.clear()
+            # speculative verify: Qmax = K+1 = 4
+            toks = np.zeros((1, 4), np.int32)
+            toks[0, 0] = int(tok_g)
+            toks[0, 1:] = [1, 2, 3]
+            verify_step(params, jnp.asarray(toks),
+                        jnp.asarray([9], jnp.int32), tables, pk, pv,
+                        jnp.asarray([4], jnp.int32))
+            assert set(calls) == {4}, calls
+        finally:
+            pa.ragged_paged_attention_ref = orig
+
+    @pytest.mark.slow   # 3s engine compile; counter consistency stays tier-1
+    def test_sampled_lane_keeps_logit_path_counter(self):
+        """A sampled (temperature > 0) ride-along lane makes its verify
+        dispatches logit-path: fused_sample_steps stays strictly below
+        steps_run, while decode/verify disjointness is untouched.
+        (Drafting is greedy-only, so the speculation is driven by a
+        greedy echo-traffic request sharing the batch.)"""
+        cfg = llama_config_tiny(vocab=64, hidden=32, layers=2, heads=4,
+                                seq=96)
+        params = _echo_params(cfg, seed=17)
+        eng = _mk(cfg, params, speculative=3, num_pages=64,
+                  max_pages_per_seq=12)
+        eng.submit(np.tile(np.array([5, 9, 2], np.int32), 4),
+                   max_new_tokens=12)                       # greedy, drafts
+        eng.submit(rng.integers(1, 64, (8,)).astype(np.int32),
+                   max_new_tokens=12, temperature=0.8, top_p=0.9)
+        eng.run()
+        st = eng.stats()
+        assert st["verify_steps"] + st["decode_steps"] == eng.steps_run
+        assert st["verify_steps"] > 0
+        # horizon dispatches are always token-emitting; a verify carrying
+        # the sampled lane is logit-path, one after it retires is fused
+        assert st["decode_steps"] <= st["fused_sample_steps"]
+        assert st["fused_sample_steps"] < eng.steps_run
