@@ -45,7 +45,8 @@ PYTEST_T1 = env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
 # before committing it.
 #
 # `check` is the aggregate local gate: lint (writing the JSON report
-# artifact next to the BENCH jsons) -> tier1-budget -> obs-check.
+# artifact next to the BENCH jsons) -> tier1-budget -> obs-check ->
+# proc-smoke (the ISSUE 17 cross-process SIGKILL drill).
 
 GRAFTLINT = $(PY) -m paddle_tpu.analysis paddle_tpu \
 	--baseline graftlint.baseline.json
@@ -53,7 +54,7 @@ GRAFTLINT = $(PY) -m paddle_tpu.analysis paddle_tpu \
 LINT_ARTIFACT ?= GRAFTLINT_report.json
 
 .PHONY: tier1 tier1-budget check-budget bench bench-trend lint \
-	lint-baseline obs-check check
+	lint-baseline obs-check proc-smoke check
 
 # `bench-trend` reads every BENCH_r*.json driver artifact at the repo root
 # and prints the headline tokens/s + serving TTFT-p95 + goodput trajectory
@@ -121,6 +122,23 @@ obs-check:
 	env JAX_PLATFORMS=cpu $(PY) perf/check_obs.py \
 		--artifact $(OBS_QUANT_ARTIFACT) --trace quant
 
+# `proc-smoke` is the ISSUE 17 cross-process CI lane: spawn 2 REAL worker
+# processes (each hosting a full ServingEngine behind the length-prefixed
+# RPC), SIGKILL one mid-decode, and assert zero-loss + bit-equal recovery
+# + a measured wall-clock failover + passing invariants reports for every
+# spawned generation (the killed one vouched by its replacement) BEFORE
+# the artifact is reported; perf/check_obs.py --proc then schema-gates it.
+# The spawn-heavy pytest drills (tests/test_procfleet.py) stay in the slow
+# lane — this target is the fast deterministic smoke that runs in `check`.
+OBS_FAILOVER_PROC_ARTIFACT ?= /tmp/_obs_failover_proc.json
+
+proc-smoke:
+	set -o pipefail; \
+	env JAX_PLATFORMS=cpu $(PY) bench.py --trace failover --proc \
+		--json $(OBS_FAILOVER_PROC_ARTIFACT) && \
+	env JAX_PLATFORMS=cpu $(PY) perf/check_obs.py \
+		--artifact $(OBS_FAILOVER_PROC_ARTIFACT) --trace failover --proc
+
 lint:
 	$(GRAFTLINT) --fail-on-stale $(if $(DIFF),--diff $(DIFF))
 
@@ -131,6 +149,7 @@ check:
 	$(GRAFTLINT) --fail-on-stale --json-artifact $(LINT_ARTIFACT)
 	$(MAKE) tier1-budget
 	$(MAKE) obs-check
+	$(MAKE) proc-smoke
 
 tier1:
 	timeout -k 10 870 $(PYTEST_T1)

@@ -1227,6 +1227,147 @@ def bench_serving_failover(seed=0, perfetto=None):
     }
 
 
+def bench_serving_failover_proc(seed=0):
+    """Cross-PROCESS failover drill (ISSUE 17; `--trace failover --proc`):
+    the same zero-loss bar as :func:`bench_serving_failover`, but the
+    replica boundary is a real OS process and the crash is a real
+    ``SIGKILL`` — no injected exception, no shared address space, the
+    dead worker's host state is simply GONE and recovery runs over the
+    wire (newest intact snapshot restore + adopt re-prefill).
+
+    Three paired arms from ONE deterministic spec (`paddle.seed` +
+    explicit PRNG key, so every process builds bit-identical weights):
+
+      * **single** — the uninterrupted in-process engine: the
+        bit-exactness reference and the no-fleet throughput bar.
+      * **thread** — a 2-replica ``ReplicaFleet`` (thread boundary) with
+        an injected ``serve.crash``: what PR 9's failover costs when the
+        supervisor can reach into the replica's memory.
+      * **proc** — a 2-worker ``ProcessFleet``; one worker is
+        SIGKILL'ed mid-decode and the supervisor recovers it zero-loss.
+
+    ZERO lost requests and bit-equal greedy outputs are ASSERTED for
+    both fleet arms BEFORE anything is reported; the proc arm
+    additionally asserts wall-clock recovery was measured, the RPC plane
+    carried real traffic, the stitched trace crosses the process
+    boundary, and EVERY spawned worker generation (the killed one
+    included) filed a passing invariants report."""
+    import signal
+    import tempfile
+    from paddle_tpu.inference.paged import ServingEngine
+    from paddle_tpu.serving import ProcessFleet, ReplicaFleet
+    from paddle_tpu.serving.worker import build_from_spec
+    from paddle_tpu.resilience import inject
+
+    spec = {
+        "seed": 2024,
+        "model": {"config": dict(vocab_size=128, hidden_size=64,
+                                 intermediate_size=192,
+                                 num_hidden_layers=2,
+                                 num_attention_heads=4,
+                                 num_key_value_heads=4,
+                                 max_position_embeddings=128),
+                  "prng_key": 1, "n_micro": 1},
+        "engine": dict(num_slots=2, page_size=4, num_pages=64,
+                       max_pages_per_seq=24, attention_impl="ref",
+                       prompt_bucket=8, decode_horizon=2),
+    }
+    n_req, n_new = 8, 16
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(1, 128, (int(t),)).astype(np.int32)
+               for t in rng.integers(3, 8, n_req)]
+    useful = n_req * n_new
+
+    # single: the uninterrupted reference (and the no-fleet throughput bar)
+    params, cfg, ekw = build_from_spec(spec)
+    eng = ServingEngine(params, cfg, **ekw)
+    rids = [eng.submit(p, max_new_tokens=n_new) for p in prompts]
+    t0 = time.perf_counter()
+    ref_done = eng.run()
+    single_dt = time.perf_counter() - t0
+    refs = [list(ref_done[r].generated) for r in rids]
+    eng.release_cache()
+
+    # thread: PR 9's in-process replica fleet under an injected crash
+    fleet = ReplicaFleet(lambda: ServingEngine(params, cfg, **ekw),
+                         num_replicas=2)
+    t0 = time.perf_counter()
+    with inject({"serve.crash": dict(match={"engine": "r0"}, at=6)},
+                seed=seed) as plan:
+        tfrids = [fleet.submit(p, max_new_tokens=n_new) for p in prompts]
+        tdone = fleet.run()
+    thread_dt = time.perf_counter() - t0
+    assert plan.fired("serve.crash") == 1
+    assert len(tdone) == len(tfrids), "thread arm lost requests"
+    for frid, ref in zip(tfrids, refs):
+        assert list(tdone[frid].generated) == ref, \
+            "thread arm diverged from the uninterrupted engine"
+    thread_st = fleet.stats()
+
+    # proc: real worker processes, real SIGKILL mid-decode
+    with tempfile.TemporaryDirectory() as workdir:
+        fl = ProcessFleet(spec, workdir=workdir, num_workers=2,
+                          snapshot_every=3, trace_every=2)
+        try:
+            t0 = time.perf_counter()
+            pfrids = [fl.submit(p, max_new_tokens=n_new) for p in prompts]
+            while fl.tokens_streamed < 6:
+                fl.step()
+            victim = fl._workers[0]
+            dead_key = victim.key()
+            os.kill(victim.pid, signal.SIGKILL)
+            pdone = fl.run()
+            proc_dt = time.perf_counter() - t0
+            assert len(pdone) == len(pfrids), "proc arm lost requests"
+            for frid, ref in zip(pfrids, refs):
+                assert list(pdone[frid].generated) == ref, \
+                    "proc arm diverged from the uninterrupted engine"
+            st = fl.stats()
+            assert st["failovers"] >= 1, "the SIGKILL drill never failed over"
+            assert st["worker_restarts"].get("w0", 0) >= 1
+            assert st["recovery"]["count"] >= 1 \
+                and st["recovery"]["p50_ms"] > 0.0, \
+                "no wall-clock recovery time was measured"
+            assert st["rpc"]["calls"] > 0
+            stitched = fl.stitcher().summary()
+            assert len(stitched["max_chain"]) >= 2, \
+                f"trace did not cross the process boundary: {stitched}"
+        finally:
+            fl.shutdown()
+        fl.assert_worker_invariants()
+        reports = {k: {kk: r.get(kk) for kk in
+                       ("invariants_ok", "kind", "via")}
+                   for k, r in sorted(fl.final_reports.items())}
+    assert reports[dead_key]["via"] == "replacement_restore"
+
+    proc_tps = useful / proc_dt
+    thread_tps = useful / thread_dt
+    return {
+        "trace": {"n_requests": n_req, "max_new_tokens": n_new,
+                  "num_workers": 2, "snapshot_every": 3,
+                  "seed": int(seed), "kill": "SIGKILL mid-decode"},
+        "lost_requests": 0,
+        "outputs_bitexact": True,
+        "useful_tokens": int(useful),
+        "single": {"tokens_per_sec": round(useful / single_dt, 1)},
+        "thread": {"tokens_per_sec": round(thread_tps, 1),
+                   "failovers": thread_st["failovers"],
+                   "migrations": thread_st["migrations"]},
+        "proc": {"tokens_per_sec": round(proc_tps, 1),
+                 "failovers": st["failovers"],
+                 "worker_restarts": st["worker_restarts"],
+                 "spawns": st["spawns"],
+                 "rpc": st["rpc"],
+                 "recovery": st["recovery"]},
+        "boundary_overhead_x": round(thread_tps / proc_tps, 2),
+        "stitched": {"max_chain": stitched["max_chain"],
+                     "components": stitched.get("components"),
+                     "flow_events": stitched.get("flow_events")},
+        "worker_invariants_ok": True,
+        "final_reports": reports,
+    }
+
+
 def bench_serving_elastic(seed=0):
     """Elastic cache-affinity fleet trace (ISSUE 14; PERF.md §21): a
     seeded DIURNAL shared-prefix scenario replayed against four fleet
@@ -2150,6 +2291,11 @@ if __name__ == "__main__":
                          "cross-component Perfetto trace (frontend/router/"
                          "replica tracks + per-request flow events) to "
                          "PATH — load it at https://ui.perfetto.dev")
+    ap.add_argument("--proc", action="store_true",
+                    help="failover trace only: run the CROSS-PROCESS "
+                         "drill (real worker processes, real SIGKILL "
+                         "mid-decode, zero-loss recovery over the RPC "
+                         "wire — ISSUE 17)")
     args = ap.parse_args()
     if args.trace is None and (args.json or args.seed is not None):
         ap.error("--json/--seed only apply to a serving trace; "
@@ -2157,6 +2303,10 @@ if __name__ == "__main__":
                  "{shared-prefix,serving,spec-decode,failover,frontend}")
     if args.perfetto is not None and args.trace != "failover":
         ap.error("--perfetto applies to --trace failover only")
+    if args.proc and args.trace != "failover":
+        ap.error("--proc applies to --trace failover only")
+    if args.proc and args.perfetto is not None:
+        ap.error("--perfetto is not wired for the --proc drill")
     if args.trace is not None:
         _setup_compile_cache()
         fn = {"shared-prefix": bench_serving_shared_prefix,
@@ -2166,13 +2316,18 @@ if __name__ == "__main__":
               "frontend": bench_serving_frontend,
               "elastic": bench_serving_elastic,
               "quant": bench_serving_quant}[args.trace]
+        if args.proc:
+            fn = bench_serving_failover_proc
         kw = {}
         if args.seed is not None:
             kw["seed"] = args.seed
         if args.perfetto is not None:
             kw["perfetto"] = args.perfetto
         res = fn(**kw)
-        out = {"metric": f"trace_{args.trace.replace('-', '_')}", **res}
+        metric = f"trace_{args.trace.replace('-', '_')}"
+        if args.proc:
+            metric += "_proc"
+        out = {"metric": metric, **res}
         print(json.dumps(out))
         if args.json:
             with open(args.json, "w") as f:

@@ -255,6 +255,14 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
         _merge_metadata(staging)
         _write_manifest(staging)
         _fsync_dir(staging)
+        # the PARENT directory entry for the staging dir must be durable
+        # BEFORE the rename: fsyncing only the staging dir persists its
+        # contents, not its own name — after a host crash the journal may
+        # replay the rename against a directory entry that was never
+        # written, losing a fully-written snapshot.  `ckpt.dirsync` lets
+        # the chaos harness kill the commit exactly at this window.
+        fault_point("ckpt.dirsync", path=path, phase="parent")
+        _fsync_dir(os.path.dirname(os.path.abspath(staging)) or ".")
         fault_point("ckpt.commit", path=path, phase="pre")
         old = path + ".old"
         if os.path.exists(path):

@@ -18,6 +18,10 @@ Fault-point catalog (the consulting subsystem documents exact ctx keys):
 ``ckpt.commit``             just before the atomic staging->final rename
                             (ctx: ``path``) — ``raise`` simulates preemption
                             after a complete write but before the commit point
+``ckpt.dirsync``            just before the parent-directory-entry fsync that
+                            precedes the rename (ctx: ``path``, ``phase``) —
+                            ``raise`` kills the commit in the window where the
+                            staging dir's NAME is not yet durable
 ``train.nonfinite``         once per TrainStep call (ctx: ``step``) —
                             ``trigger`` poisons that step's loss+grads with NaN
 ``pagepool.alloc``          PagePool.alloc (ctx: ``n``, ``free``) — ``raise``
@@ -48,6 +52,21 @@ Fault-point catalog (the consulting subsystem documents exact ctx keys):
 ``comm.ready``              wait_with_timeout readiness check (ctx: ``op``) —
                             ``trigger`` simulates a collective that never
                             becomes ready (CommTimeoutError)
+``rpc.drop_frame``          RpcClient, once per send attempt (ctx: ``method``,
+                            ``attempt``) — ``trigger`` loses the request frame
+                            before the wire; the client burns the attempt
+                            timeout waiting, then backs off and retries
+``rpc.delay_frame``         RpcClient (ctx: ``method``, ``attempt``) —
+                            ``trigger`` sends the frame ``fault_delay_s`` late
+``rpc.truncate_frame``      RpcClient (ctx: ``method``, ``attempt``) —
+                            ``trigger`` sends half the body then kills the
+                            connection; the server must drop the torn frame
+                            WITHOUT invoking the handler
+``rpc.half_open``           RpcClient (ctx: ``method``, ``attempt``) —
+                            ``trigger`` delivers the request fully but dies
+                            before the reply: the handler runs exactly once
+                            and the retry must hit the idempotency cache (the
+                            no-double-submit drill)
 ==========================  ====================================================
 
 Firing rules per spec: ``at=k`` fires exactly on the k-th matching consult

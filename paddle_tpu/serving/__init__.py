@@ -38,6 +38,13 @@ replica-fleet router, and the async front end + traffic harness.
   (:class:`AutoscalePolicy` GROW on sustained queue growth / SLO burn,
   SHRINK on sustained idle) with zero-loss, greedy-bit-exact drain
   through the live-migration path.
+* :mod:`.rpc` + :mod:`.worker` + :mod:`.procfleet` — the cross-process
+  fleet (ISSUE 17): replicas as real worker processes behind a
+  length-prefixed loopback wire (deadline-per-call timeouts,
+  exponential backoff with jitter, idempotent retry keys), with
+  :class:`ProcessFleet` supervising spawn/reap/failover under real
+  ``SIGKILL``/``SIGSTOP`` — same zero-loss, greedy-bit-exact recovery
+  bar, now across an actual process boundary.
 """
 from .autoscale import AutoscaleDecision, AutoscalePolicy, ElasticFleet
 from .quant import (dequantize_kv, kv_spec, page_bytes, parity_report,
@@ -48,6 +55,8 @@ from .frontend import (AdmissionController, AdmissionView, AsyncFrontend,
                        admission_view)
 from .routing import (LeastLoadedRouter, PrefixAffinityRouter, Router,
                       RoutingDecision)
+from .procfleet import ProcessFleet, WorkerDiedError
+from .rpc import RpcClient, RpcError, RpcRemoteError, RpcServer, RpcTimeout
 from .snapshot import EngineSnapshotManager, load_engine_snapshot
 from .traffic import (ClientRequest, Scenario, VirtualClock,
                       goodput_report, make_scenario, replay_engine,
@@ -62,4 +71,6 @@ __all__ = ["ReplicaFleet", "FleetFailedError", "EngineSnapshotManager",
            "LeastLoadedRouter", "PrefixAffinityRouter", "AutoscalePolicy",
            "AutoscaleDecision", "ElasticFleet", "quantize_kv",
            "dequantize_kv", "kv_spec", "page_bytes", "quantize_params",
-           "parity_report", "parity_scenarios"]
+           "parity_report", "parity_scenarios", "ProcessFleet",
+           "WorkerDiedError", "RpcClient", "RpcServer", "RpcError",
+           "RpcTimeout", "RpcRemoteError"]

@@ -670,9 +670,37 @@ class ReplicaFleet:
         self._c_failovers.inc()
         rep.failures += 1
         rep.alive = False
+        # the unroutable mark happens-before EVERYTHING else in the
+        # failover — placement candidates are filtered on it, so no
+        # adopt can race a replica the supervisor already condemned
+        rep.routable = False
         corpse = rep.engine
         rep.engine = None          # the corpse's state is not trusted
         rep.stall = 0
+        # wedge-race quiesce (ISSUE 17 satellite): a wedged-but-ALIVE
+        # engine can un-wedge after the failover decision — and anything
+        # still holding a reference (an autoscaler sweep, a frontend
+        # worker thread) could step it and keep decoding requests the
+        # fleet is about to migrate: double emission through any
+        # engine-level hook, pages pinned on the corpse.  Cancel the
+        # outstanding requests ON THE CORPSE before any adopt happens,
+        # so the quiesce happens-before the migration.  Crash corpses
+        # are not trusted (possibly corrupt host state) — best-effort,
+        # first failure aborts the sweep.
+        if kind == "wedge" and corpse is not None:
+            quiesced = 0
+            for frid in sorted(self._assigned[rep.name]):
+                fr = self._requests[frid]
+                if fr.handle is None:
+                    continue
+                try:
+                    corpse.cancel(fr.handle.rid)
+                    quiesced += 1
+                except BaseException:  # noqa: BLE001 — corpse may be wedged
+                    break              # beyond cooperation; migration still
+                                       # proceeds (router log is authoritative)
+            self.flight.record("wedge_quiesce", replica=rep.name,
+                               cancelled=quiesced)
         # the dead engine's cached chains died with it: the router must
         # not keep routing affinity traffic at a corpse (revival re-seeds
         # from whatever the restored snapshot actually carries)

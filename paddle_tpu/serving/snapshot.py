@@ -25,6 +25,13 @@ Fault drills (resilience/faults.py catalog):
     points fire on this path too (engine snapshots go through the same
     writer), so mid-write and mid-commit crash windows are drilled by the
     existing checkpoint chaos machinery.
+  * ``ckpt.dirsync`` — consulted just before the writer fsyncs the
+    PARENT directory entry ahead of the atomic rename (ISSUE 17
+    satellite): fsyncing the staging dir alone persists its contents but
+    not its *name*, so a host crash in this window could lose a
+    fully-written snapshot.  ``action="raise"`` kills the commit there;
+    ``find_latest_complete()`` must fall back to the previous intact
+    snapshot.
 """
 from __future__ import annotations
 

@@ -573,6 +573,85 @@ def _validate_failover(art: dict) -> list[str]:
     return problems
 
 
+def _validate_failover_proc(art: dict) -> list[str]:
+    """The ISSUE 17 cross-process drill (`bench --trace failover --proc`):
+    real worker processes, a real SIGKILL, recovery over the RPC wire.
+    The schema gate re-checks everything the bench asserted: zero loss,
+    bit-exactness, a measured wall-clock recovery, real RPC traffic, a
+    stitched trace that crossed the process boundary, and a passing
+    invariants report for EVERY spawned worker generation — the killed
+    one vouched by its replacement's post-restore check."""
+    problems = []
+    if "metric" not in art:
+        problems.append("missing top-level 'metric'")
+    if art.get("lost_requests") != 0:
+        problems.append(f"lost_requests is {art.get('lost_requests')!r} — "
+                        f"the SIGKILL drill must lose ZERO requests")
+    if art.get("outputs_bitexact") is not True:
+        problems.append("outputs_bitexact is not True — greedy outputs "
+                        "must match the uninterrupted engine bit-for-bit")
+    proc = art.get("proc")
+    if not isinstance(proc, dict):
+        problems.append("missing 'proc' (ProcessFleet stats block)")
+    else:
+        if not proc.get("failovers"):
+            problems.append("proc.failovers is 0 — the SIGKILL never "
+                            "triggered a failover")
+        restarts = proc.get("worker_restarts")
+        if not isinstance(restarts, dict) \
+                or not any(restarts.values()):
+            problems.append(f"proc.worker_restarts is {restarts!r} — no "
+                            f"worker was respawned")
+        rpc = proc.get("rpc")
+        if not isinstance(rpc, dict) or not rpc.get("calls"):
+            problems.append("proc.rpc.calls is 0 — the drill never "
+                            "exercised the wire protocol")
+        rec = proc.get("recovery")
+        if not isinstance(rec, dict):
+            problems.append("proc.recovery missing")
+        else:
+            for k in RECOVERY_KEYS:
+                if k not in rec:
+                    problems.append(f"proc.recovery: missing {k!r}")
+            if not rec.get("count") or not rec.get("p50_ms"):
+                problems.append("proc.recovery measured nothing — the "
+                                "failover wall clock must be observed")
+        if not proc.get("tokens_per_sec"):
+            problems.append("proc.tokens_per_sec missing/zero")
+    thread = art.get("thread")
+    if not isinstance(thread, dict) or not thread.get("tokens_per_sec"):
+        problems.append("missing 'thread' pairing arm (thread-boundary "
+                        "ReplicaFleet tokens_per_sec)")
+    stitched = art.get("stitched")
+    if not isinstance(stitched, dict):
+        problems.append("missing 'stitched' (cross-process trace summary)")
+    else:
+        chain = stitched.get("max_chain")
+        if not isinstance(chain, list) or len(chain) < 2:
+            problems.append(
+                f"stitched.max_chain is {chain!r} — the trace must stitch "
+                f"across the process boundary (supervisor + worker track)")
+    if art.get("worker_invariants_ok") is not True:
+        problems.append("worker_invariants_ok is not True")
+    reports = art.get("final_reports")
+    if not isinstance(reports, dict) or not reports:
+        problems.append("missing 'final_reports' (per-generation "
+                        "invariants reports)")
+    else:
+        bad = [k for k, r in reports.items()
+               if not isinstance(r, dict) or r.get("invariants_ok")
+               is not True]
+        if bad:
+            problems.append(f"final_reports failing for {sorted(bad)}")
+        if not any(isinstance(r, dict)
+                   and r.get("via") == "replacement_restore"
+                   for r in reports.values()):
+            problems.append("no generation was vouched via "
+                            "'replacement_restore' — the killed worker's "
+                            "invariants were never re-checked")
+    return problems
+
+
 def _validate_frontend(art: dict) -> list[str]:
     """The ISSUE 11 frontend trace: per-scenario TTFT/SLO/admission
     sections + the predictive-vs-depth A/B gate."""
@@ -671,7 +750,7 @@ def _dig(d: dict, path):
     return d
 
 
-def validate_artifact(art: dict, trace: str) -> list[str]:
+def validate_artifact(art: dict, trace: str, proc: bool = False) -> list[str]:
     """Returns a list of problems (empty == valid)."""
     problems = []
     if trace not in TRACE_SECTIONS:
@@ -680,7 +759,8 @@ def validate_artifact(art: dict, trace: str) -> list[str]:
     if not isinstance(art, dict):
         return ["artifact is not a JSON object"]
     if trace == "failover":
-        return _validate_failover(art)
+        return _validate_failover_proc(art) if proc \
+            else _validate_failover(art)
     if trace == "frontend":
         return _validate_frontend(art)
     if trace == "elastic":
@@ -974,6 +1054,9 @@ def main(argv=None) -> int:
     ap.add_argument("--trace", choices=sorted(TRACE_SECTIONS),
                     default="serving",
                     help="which trace produced the artifact")
+    ap.add_argument("--proc", action="store_true",
+                    help="failover trace only: validate the CROSS-PROCESS "
+                         "drill artifact (bench --trace failover --proc)")
     ap.add_argument("--gate", action="store_true",
                     help="run the telemetry-overhead gate")
     ap.add_argument("--min-ratio", type=float, default=0.97,
@@ -983,11 +1066,13 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     if not args.artifact and not args.gate:
         ap.error("nothing to do: pass --artifact and/or --gate")
+    if args.proc and args.trace != "failover":
+        ap.error("--proc applies to --trace failover only")
     rc = 0
     if args.artifact:
         with open(args.artifact) as f:
             art = json.load(f)
-        problems = validate_artifact(art, args.trace)
+        problems = validate_artifact(art, args.trace, proc=args.proc)
         if problems:
             print(f"obs-check: artifact {args.artifact} FAILED "
                   f"({len(problems)} problem(s)):")

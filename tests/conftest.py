@@ -47,6 +47,36 @@ def _no_page_refcount_leak():
 
 
 @pytest.fixture(autouse=True)
+def _no_worker_process_leak():
+    """The refcount leak guard, extended across the process boundary
+    (ISSUE 17): every worker process a test spawned must have filed a
+    final PagePool/page-table/cache invariants report over the RPC wire
+    — directly at teardown (stop/retire/shutdown), or, for workers
+    killed mid-drill, through their replacement's post-restore check —
+    and every report must hold.  A fleet left running is itself a leak:
+    it is force-killed here and the test fails."""
+    yield
+    import sys
+    procfleet = sys.modules.get("paddle_tpu.serving.procfleet")
+    if procfleet is None:
+        return
+    problems = []
+    for fl in list(procfleet._LIVE_FLEETS):
+        # each fleet is judged exactly once (by the test that made it)
+        procfleet._LIVE_FLEETS.discard(fl)
+        if not fl.closed:
+            fl.shutdown(drain=False, force=True)
+            problems.append("test leaked a running ProcessFleet "
+                            "(never shut down; workers force-killed)")
+            continue
+        try:
+            fl.assert_worker_invariants()
+        except AssertionError as e:
+            problems.append(str(e))
+    assert not problems, "; ".join(problems)
+
+
+@pytest.fixture(autouse=True)
 def _no_fault_plan_leak():
     """A test that exits with a live FaultPlan (inject() scope not closed)
     would silently corrupt every later test's behavior — fail it here,

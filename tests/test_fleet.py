@@ -385,6 +385,30 @@ class TestEngineSnapshotManager:
         for rid, ref in zip(rids, _refs(8)):
             np.testing.assert_array_equal(done[rid].output_ids, ref)
 
+    def test_dirsync_crash_never_commits_previous_stays_latest(
+            self, tmp_path):
+        """Pre-rename parent-entry durability (ISSUE 17 satellite): the
+        ``ckpt.dirsync`` fault point sits between the staging-tree fsync
+        and the atomic rename — the window where the snapshot CONTENTS
+        are durable but the parent directory entry that will NAME the
+        committed snapshot is not.  A crash there must leave the commit
+        unhappened: discovery falls back to the previous intact snapshot
+        and restore replays it bit-exactly."""
+        eng, rids = self._partway()
+        mgr = EngineSnapshotManager(str(tmp_path))
+        first = mgr.save_engine(eng, mode="full_kv")
+        eng.step()
+        with inject({"ckpt.dirsync": dict(at=0)}) as plan:
+            with pytest.raises(InjectedFault):
+                mgr.save_engine(eng, mode="full_kv")
+        assert plan.fired("ckpt.dirsync") == 1
+        assert mgr.find_latest_complete() == first
+        eng2 = _mk()
+        assert mgr.restore_engine(eng2)[0] == first
+        done = eng2.run()
+        for rid, ref in zip(rids, _refs(8)):
+            np.testing.assert_array_equal(done[rid].output_ids, ref)
+
     def test_serve_snapshot_torn_rejected_via_manifest(self, tmp_path):
         """serve.snapshot action="trigger" tears the COMMITTED snapshot:
         verification must reject it and discovery must fall back to the
@@ -647,6 +671,37 @@ class TestReplicaFleet:
         fo = [e for e in fleet.flight.events() if e["event"] == "failover"]
         assert fo and fo[0]["kind"] == "wedge"
 
+    def test_wedge_unroutable_happens_before_adopt(self):
+        """Regression for the wedge race (ISSUE 17 satellite): a
+        wedged-but-ALIVE replica can un-wedge after the watchdog condemns
+        it — anything still stepping the corpse would keep decoding
+        requests the fleet is about to migrate (double emission through
+        engine-level hooks, pages pinned forever).  The fix quiesces the
+        corpse — cancels its outstanding requests ON the condemned engine
+        — strictly before any adopt.  The flight recorder proves the
+        ordering, and the corpse ends the failover carrying nothing."""
+        fleet = ReplicaFleet(_factory(), num_replicas=2, stall_threshold=4)
+        corpse = next(r.engine for r in fleet._replicas if r.name == "r1")
+        with inject({"serve.wedge": dict(action="trigger",
+                                         match={"engine": "r1"},
+                                         count=None)}):
+            rids = [fleet.submit(p, max_new_tokens=8) for p in _PROMPTS]
+            _check_fleet(fleet, rids, _refs(8))
+        ev = fleet.flight.events()
+        q = [i for i, e in enumerate(ev) if e["event"] == "wedge_quiesce"]
+        f = [i for i, e in enumerate(ev) if e["event"] == "failover"]
+        m = [i for i, e in enumerate(ev) if e["event"] == "migrate"]
+        assert q and ev[q[0]]["replica"] == "r1"
+        assert ev[q[0]]["cancelled"] >= 1
+        # quiesce happens-before the failover record and before EVERY
+        # migration — no adopt can race the condemned replica
+        assert f and q[0] < f[0]
+        assert m and q[0] < min(m)
+        # the corpse carries nothing: every cancelled request's pages
+        # parked in its cache and drain to fully-free
+        corpse.release_cache()
+        assert corpse.pool.num_free == corpse.pool.num_pages
+
     @pytest.mark.slow   # tier-1 budget: covered by the tier-1 siblings
     def test_transient_wedge_tolerated(self):
         """A stall shorter than the watchdog threshold self-recovers: no
@@ -762,6 +817,73 @@ class TestFleetStreaming:
             # exactly the final record — no duplicates, no gaps, in order
             assert got[i] == list(done[rid].generated)
             assert got[i] == list(ref[len(_PROMPTS[i]):])
+
+    def test_stream_disconnect_during_failover_migration(self):
+        """ISSUE 17 satellite: a consumer iterating ``Request.stream()``
+        on a replica handle disconnects DURING a failover migration —
+        after the crash condemned its home replica and the request was
+        adopted elsewhere.  The early-exit close must be clean (the
+        victim's pages free on the corpse, the stream is not
+        resurrected), and the client-gone cancel propagated through the
+        fleet must land on the ADOPTED replica: its engine observes the
+        cancel, no orphaned request keeps decoding to nobody."""
+        fleet = ReplicaFleet(_factory(), num_replicas=2)
+        emitted: list = []
+        victim = fleet.submit(_PROMPTS[0], max_new_tokens=24,
+                              on_token=emitted.append)
+        others = [fleet.submit(p, max_new_tokens=8) for p in _PROMPTS[1:]]
+        fr = fleet._requests[victim]
+        for _ in range(60):
+            fleet.step()
+            if fr.handle is not None and len(fr.streamed) >= 2:
+                break
+        assert fr.handle is not None and len(fr.streamed) >= 2
+        home = fr.replica
+        corpse = next(r.engine for r in fleet._replicas if r.name == home)
+        old_handle = fr.handle
+        old_rid = old_handle.rid
+        gen = fr.handle.stream()            # the consumer's token stream
+        assert next(gen) == fr.streamed[0]  # buffered: no engine stepping
+        # crash the victim's home replica: failover + adopt-migration
+        with inject({"serve.crash": dict(match={"engine": home},
+                                         at=0)}) as plan:
+            for _ in range(30):
+                fleet.step()
+                if fleet.stats()["failovers"] == 1 \
+                        and fr.handle is not None:
+                    break
+        assert plan.fired("serve.crash") == 1
+        # migrated: a NEW engine-side request on a NEW engine (rids are
+        # per-engine counters, so only object identity discriminates)
+        assert fr.handle is not None and fr.handle is not old_handle, \
+            "victim was not migrated"
+        adopted_eng = next(r.engine for r in fleet._replicas
+                           if r.name == fr.replica)
+        assert adopted_eng is not corpse
+        # the consumer disconnects mid-migration, mid-decode
+        n_at_disconnect = len(emitted)
+        free_before = corpse.pool.num_free
+        gen.close()                 # early-exit cancel lands on the corpse
+        assert corpse.lookup(old_rid) is None
+        corpse.release_cache()
+        assert corpse.pool.num_free > free_before, \
+            "disconnect did not free the victim's pages on the corpse"
+        corpse.check_invariants()
+        # the disconnect propagates fleet-level onto the ADOPTED replica
+        adopted_rid = fr.handle.rid
+        assert fleet.cancel(victim) is True
+        assert adopted_eng.lookup(adopted_rid) is None, \
+            "adopted replica never observed the cancel"
+        # survivors complete bit-exact; the orphan never streamed again
+        done = fleet.run()
+        assert victim not in done
+        assert len(emitted) == n_at_disconnect, \
+            "orphaned stream kept emitting after the disconnect"
+        for f, ref in zip(others, _refs(8)[1:]):
+            np.testing.assert_array_equal(done[f].output_ids, ref)
+        for rep in fleet._replicas:
+            rep.engine.release_cache()
+            assert rep.engine.pool.num_free == rep.engine.pool.num_pages
 
     @pytest.mark.slow   # tier-1 budget: the crash-migration variant above
     # pins the no-double-emission contract; this re-runs it on the
