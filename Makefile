@@ -3,9 +3,9 @@
 # `tier1` is the ROADMAP tier-1 verify lane; `tier1-budget` re-runs it with
 # per-test durations and gates the ROADMAP 870 s budget through
 # perf/check_tier1_budget.py (fails when cumulative runtime exceeds 90% of
-# the budget — check_tier1_budget.py's default --fraction — or any single
-# non-slow test exceeds 20 s, so slow-marker demotions stop regressing
-# silently).  A failing SUITE also fails the target (pipefail + propagated
+# the budget — 97% on a single-core host, where quiet-run wall drifts
+# ~±10% day to day — or any single non-slow test exceeds 20 s, so
+# slow-marker demotions stop regressing silently).  A failing SUITE also fails the target (pipefail + propagated
 # pytest status): a red run within budget must not exit green.
 # `check-budget LOG=path` gates an EXISTING log without re-running the suite.
 #
@@ -98,9 +98,17 @@ OBS_QUANT_ARTIFACT ?= /tmp/_obs_quant.json
 # dequant-tax tokens/s >= 0.95x (best paired), and the failover/elastic/
 # ladder drills re-run with quantized pages — zero-lost, bit-equal,
 # ladder order preserved (perf/check_obs.py --trace quant).
+# Since ISSUE 18 the serving trace runs with --tp 2 (XLA forced-host
+# devices): the tensor-parallel engine must be greedy BIT-EXACT vs the
+# single-chip engine with f32 collectives, the quantized-AllReduce arm
+# must hold exact_match >= 0.99 on the parity scenarios, and the
+# artifact's `tp` block (collective profile + rank skew + attribution
+# decode_sync_frac) is schema-gated.  Forced-host TP time-slices one
+# CPU, so tokens_per_sec_tp measures dispatch overhead, not speedup —
+# the gate is on correctness + schema, never on the paired ratio.
 obs-check:
 	set -o pipefail; \
-	env JAX_PLATFORMS=cpu $(PY) bench.py --trace serving \
+	env JAX_PLATFORMS=cpu $(PY) bench.py --trace serving --tp 2 \
 		--json $(OBS_ARTIFACT) && \
 	env JAX_PLATFORMS=cpu $(PY) perf/check_obs.py \
 		--artifact $(OBS_ARTIFACT) --trace serving --gate && \

@@ -513,7 +513,7 @@ def bench_llama_decode():
     return out
 
 
-def bench_serving(seed=0):
+def bench_serving(seed=0, tp=None):
     """Paged-KV continuous-batching serving throughput on a mixed-length
     Poisson-ish request trace, vs the static-batch `llama_generate_fused`
     baseline (PERF.md §8) — and, since ISSUE 10, an A/B of the
@@ -755,7 +755,7 @@ def bench_serving(seed=0):
     run_baseline()                         # compile warm-up
     dt_base, base_done = run_baseline()
     base_tps = useful / dt_base
-    return {
+    res = {
         # the overlapped engine's best paired round (its sync twin rides
         # in the `overlap` section for the A/B)
         "serving_tokens_per_sec": round(serving_tps, 1),
@@ -781,6 +781,210 @@ def bench_serving(seed=0):
         # in the artifact describes the same round (ISSUE 7 sections,
         # schema-gated by perf/check_obs.py)
         **sections_all[best],
+    }
+    if tp:
+        res["tp"] = _bench_serving_tp_block(seed, int(tp))
+    return res
+
+
+def _bench_serving_tp_block(seed, tp):
+    """Tensor-parallel serving arm (``--trace serving --tp N``; ROADMAP
+    item 1, PERF.md §25): shard ONE ServingEngine over an ``mp`` mesh of
+    the first N devices (CPU hosts: N forced-host virtual devices, set by
+    ``__main__`` before jax imports) and report the ``tp`` artifact block:
+
+      * greedy outputs of the f32-collective TP engine BIT-EXACT vs the
+        single-chip engine on the same mixed trace — asserted every
+        round, then reported (the overlap A/B's bar);
+      * paired tokens/s single vs TP.  On a forced-host mesh all "chips"
+        time-slice one CPU, so the ratio measures sharding dispatch
+        overhead, not a speedup — PERF.md §25 records that framing; on a
+        real multi-chip host the same arm reads as the TP speedup;
+      * the per-rank collective profile from the SPMD sanitizer's
+        profiled trace of the TP engine's executables
+        (``dist.collective_s`` per kind, ``max_rank_skew_s``) plus the
+        execution-side ``decode_sync_frac`` attribution for both arms.
+        ``tp_collective_frac`` — the TP arm's decode_sync_frac, the
+        ceiling on the collective tax — is the bench_trend drift column;
+      * the quantized (EQuARX int8) AllReduce arm: ``parity_report``
+        reused with per-arm engine/build kwargs so the ONLY delta under
+        measurement is the per-layer AllReduce grid (gated
+        exact_match >= 0.99, teacher-forced logit drift reported), plus
+        its paired tokens/s vs the f32-collective TP engine."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.analysis.spmd_sanitize import spmd_sanitize
+    from paddle_tpu.distributed.topology import build_mesh
+    from paddle_tpu.inference.paged import ServingEngine
+    from paddle_tpu.models.llama import LlamaConfig, build_functional_llama
+    from paddle_tpu.observability import Telemetry
+    from paddle_tpu.serving.quant import parity_report
+
+    devs = jax.devices()
+    if len(devs) < tp:
+        raise SystemExit(f"--tp {tp}: only {len(devs)} devices visible "
+                         "(CPU hosts need the forced-host flag set before "
+                         "jax import — run via bench.py __main__)")
+    if 8 % tp:
+        raise SystemExit(f"--tp {tp} must divide the TP config's 8 "
+                         "attention heads (use 2, 4 or 8)")
+    on_tpu = any(d.platform == "tpu" for d in devs)
+    mesh = build_mesh({"mp": tp}, devices=devs[:tp])
+    dtype = jnp.bfloat16 if on_tpu else jnp.float32
+    nkv = max(2, tp)             # one KV-head group per rank once tp > 2
+    cfg = LlamaConfig(vocab_size=2048, hidden_size=256,
+                      intermediate_size=768, num_hidden_layers=3,
+                      num_attention_heads=8, num_key_value_heads=nkv,
+                      max_position_embeddings=512)
+    page_size, horizon, t_bucket, slots = 16, 8, 32, 4
+    # margin-engineered model (the quant/spec-decode construction):
+    # embedding-dominated residual + tied LM head keep greedy argmax
+    # margins far above both psum reassociation noise and the int8
+    # AllReduce grid, so bit-exactness measures the ENGINE, not the
+    # noise floor of near-uniform random logits
+    ep, bp, hp, *_ = build_functional_llama(cfg, dtype=dtype, n_micro=1,
+                                            key=jax.random.PRNGKey(7))
+    bp = {k: (v * 0.15 if k.startswith("w") else v) for k, v in bp.items()}
+    hp = dict(hp, lm=(ep["tok"].T * 4.0).astype(hp["lm"].dtype))
+    params = (ep, bp, hp)
+
+    rng = np.random.default_rng(seed)
+    n_req = 8
+    prompts = [rng.integers(1, cfg.vocab_size, (int(t),)).astype(np.int32)
+               for t in rng.integers(12, 90, n_req)]
+    max_news = [int(m) for m in rng.integers(8, 25, n_req)]
+    useful = sum(max_news)
+    worst = (max(t_bucket * ((len(p) + t_bucket - 1) // t_bucket)
+                 for p in prompts) + max(max_news) + horizon) \
+        // page_size + 2
+
+    def mk_engine(mesh_=None, telemetry=None, **kw):
+        return ServingEngine(params, cfg, num_slots=slots,
+                             page_size=page_size,
+                             num_pages=(slots + 2) * worst,
+                             max_pages_per_seq=worst, dtype=dtype,
+                             decode_horizon=horizon, prompt_bucket=t_bucket,
+                             attention_impl="auto" if on_tpu else "ref",
+                             mesh=mesh_, telemetry=telemetry, **kw)
+
+    def warm(eng):
+        for Tb in sorted({((len(p) + t_bucket - 1) // t_bucket) * t_bucket
+                          for p in prompts}):
+            eng.submit(rng.integers(1, cfg.vocab_size,
+                                    (Tb,)).astype(np.int32),
+                       max_new_tokens=horizon + 1)
+        eng.run()
+        eng.release_cache()
+
+    def drive(eng):
+        t0 = time.perf_counter()
+        rids = [eng.submit(p, max_new_tokens=m)
+                for p, m in zip(prompts, max_news)]
+        done = eng.run()
+        _sync(jax.tree_util.tree_leaves(eng._pages_k)[0]
+              .reshape(-1)[0].astype(jnp.float32))
+        dt = time.perf_counter() - t0
+        outs = [list(done[r].generated) for r in rids]
+        eng.release_cache()
+        return useful / dt, outs
+
+    tel_s = Telemetry()
+    tel_tp = Telemetry()
+    eng_s = mk_engine(telemetry=tel_s)
+    eng_tp = mk_engine(mesh_=mesh, telemetry=tel_tp)
+    warm(eng_s)
+    # the TP engine's warm pass traces every executable — run it under
+    # the profiled SPMD sanitizer so the artifact carries the per-rank
+    # collective schedule/skew profile (the multichip dryrun's readout,
+    # landed in the bench artifact)
+    with spmd_sanitize(n_ranks=tp, profile=True) as san:
+        warm(eng_tp)
+    san.verify()
+    coll = san.skew_report()
+
+    rounds = 3
+    tps_s_all, tps_tp_all = [], []
+    outs0 = None
+    for _ in range(rounds):
+        tps_s, outs_s = drive(eng_s)
+        tps_t, outs_t = drive(eng_tp)
+        assert outs_s == outs_t, \
+            "TP engine changed greedy outputs vs single-chip"
+        if outs0 is None:
+            outs0 = outs_s
+        assert outs_s == outs0, "greedy outputs drifted across rounds"
+        tps_s_all.append(tps_s)
+        tps_tp_all.append(tps_t)
+    pair_ratios = [t / s for t, s in zip(tps_tp_all, tps_s_all)]
+    best = max(range(rounds), key=lambda r: pair_ratios[r])
+
+    # execution-side attribution: decode_sync_frac is the share of
+    # request latency blocked on device sync during decode — on the TP
+    # arm that sync INCLUDES the per-layer AllReduce, so the TP number is
+    # the ceiling on the collective tax (subtract the single-chip arm's
+    # to isolate it)
+    dsync_s = tel_s.attribution_report()["decode_sync_frac"]
+    dsync_tp = tel_tp.attribution_report()["decode_sync_frac"]
+
+    # quantized-AllReduce arm: same engine, int8 wire format
+    eng_q = mk_engine(mesh_=mesh, quantized_allreduce=True)
+    warm(eng_q)
+    tps_q_all = []
+    for _ in range(rounds):
+        tps_q, outs_q = drive(eng_q)
+        assert outs_q == outs0, \
+            "quantized AllReduce flipped greedy outputs vs the f32-" \
+            "collective TP engine"
+        tps_q_all.append(tps_q)
+
+    # the parity harness, re-aimed: both arms TP, kv_dtype/quantize OFF —
+    # the only difference under measurement is the AllReduce grid
+    parity = parity_report(
+        params, cfg, kv_dtype=None, quantize=None,
+        engine_kw=dict(attention_impl="auto" if on_tpu else "ref",
+                       dtype=dtype),
+        ref_engine_kw={"mesh": mesh},
+        q_engine_kw={"mesh": mesh, "quantized_allreduce": True},
+        ref_build_kw={"mesh": mesh},
+        q_build_kw={"mesh": mesh, "quantized_allreduce": True})
+    assert parity["exact_match"] >= 0.99, \
+        f"quantized-AllReduce greedy exact-match " \
+        f"{parity['exact_match']} < 0.99: {parity}"
+
+    st = eng_tp.stats()
+    assert st["tp_degree"] == tp
+    eng_tp.check_invariants()
+    return {
+        "tp_degree": tp,
+        "devices": {"count": len(devs), "platform": devs[0].platform,
+                    "forced_host": not on_tpu},
+        "outputs_bit_exact": True,
+        "rounds": rounds,
+        "tokens_per_sec_tp": round(tps_tp_all[best], 1),
+        "tokens_per_sec_single": round(tps_s_all[best], 1),
+        "best_paired_ratio": round(pair_ratios[best], 4),
+        "pair_ratios": [round(x, 4) for x in pair_ratios],
+        "tokens_per_sec_quantized": round(max(tps_q_all), 1),
+        "quantized_vs_f32_ratio": round(max(tps_q_all)
+                                        / tps_tp_all[best], 4),
+        # bench_trend drift column: the TP arm's decode_sync_frac
+        "tp_collective_frac": round(float(dsync_tp), 4),
+        "attribution": {
+            "decode_sync_frac_tp": round(float(dsync_tp), 4),
+            "decode_sync_frac_single": round(float(dsync_s), 4),
+        },
+        # trace-time per-rank collective profile (dist.collective_s /
+        # dist.max_rank_skew_s — the skew_report metric names)
+        "collectives": {
+            "events": coll["events"],
+            "total_s": coll["total_s"],
+            "per_kind": coll["per_kind"],
+            "max_rank_skew_s": coll["max_rank_skew_s"],
+            "per_rank_total_s": coll["per_rank_total_s"],
+            "straggler": coll["straggler"],
+        },
+        "quantized_parity": parity,
+        "engine_stats": st,
     }
 
 
@@ -2296,6 +2500,15 @@ if __name__ == "__main__":
                          "drill (real worker processes, real SIGKILL "
                          "mid-decode, zero-loss recovery over the RPC "
                          "wire — ISSUE 17)")
+    ap.add_argument("--tp", type=int, default=None, metavar="N",
+                    help="serving trace only: add the tensor-parallel arm "
+                         "— shard one engine over an mp mesh of N devices "
+                         "(CPU hosts get N forced-host virtual devices) "
+                         "and report the `tp` block: greedy bit-exactness "
+                         "vs the single-chip engine, the per-rank "
+                         "collective profile (dist.collective_s / "
+                         "max_rank_skew_s), decode_sync_frac attribution, "
+                         "and the quantized-AllReduce parity gate")
     args = ap.parse_args()
     if args.trace is None and (args.json or args.seed is not None):
         ap.error("--json/--seed only apply to a serving trace; "
@@ -2307,6 +2520,19 @@ if __name__ == "__main__":
         ap.error("--proc applies to --trace failover only")
     if args.proc and args.perfetto is not None:
         ap.error("--perfetto is not wired for the --proc drill")
+    if args.tp is not None:
+        if args.trace != "serving":
+            ap.error("--tp applies to --trace serving only")
+        if args.tp < 2:
+            ap.error("--tp wants N >= 2 (N=1 is the single-chip engine)")
+        # BEFORE any jax import: a CPU host needs N virtual devices for
+        # the mp mesh (inert on a real multi-chip host — the flag only
+        # affects the host platform)
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags +
+                f" --xla_force_host_platform_device_count={args.tp}").strip()
     if args.trace is not None:
         _setup_compile_cache()
         fn = {"shared-prefix": bench_serving_shared_prefix,
@@ -2323,6 +2549,8 @@ if __name__ == "__main__":
             kw["seed"] = args.seed
         if args.perfetto is not None:
             kw["perfetto"] = args.perfetto
+        if args.tp is not None:
+            kw["tp"] = args.tp
         res = fn(**kw)
         metric = f"trace_{args.trace.replace('-', '_')}"
         if args.proc:

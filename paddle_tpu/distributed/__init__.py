@@ -10,6 +10,7 @@ from .communication import (Group, new_group, get_group, destroy_process_group,
                             send, recv, isend, irecv, batch_isend_irecv, P2POp,
                             gather, ReduceOp)
 from . import topology
+from . import quant_collectives
 from . import fleet
 from . import auto_parallel
 from .auto_parallel.api import (shard_tensor, reshard, shard_layer, shard_optimizer,

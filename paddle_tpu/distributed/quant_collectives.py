@@ -1,0 +1,105 @@
+"""Quantized AllReduce for tensor-parallel serving (EQuARX, arxiv
+2506.17615): each rank's partial sum is snapped onto an int8 per-chunk
+absmax grid BEFORE the reduction, so the bytes a real TP ring moves per
+hop are ~4x smaller than f32 — the collective is the only cross-chip
+traffic a one-AllReduce-per-layer decode pays, which makes it the whole
+TP tax (PERF.md §25).
+
+Emulation semantics (exact on any backend, including the forced-host
+CPU mesh): ``quantized_allreduce(x, axis)`` fake-quantizes the LOCAL
+partial — per-chunk absmax scale riding ``serving/quant.py``'s symmetric
+codec (``quantize_kv``/``dequantize_kv``, the PR 15 page codec) — then
+issues ONE ``jax.lax.psum`` of the dequantized partials.  That computes
+bit-for-bit what an EQuARX ring computes when every hop carries int8
+payloads + f32 scales and accumulates in f32: the quantization error
+enters per RANK (bounded below), the reduction itself is exact.  The
+``jax.lax`` attribute lookup happens at call time, so the SPMD
+collective-schedule sanitizer (analysis/spmd_sanitize.py) sees the psum
+like any hand-written one — a quantized AllReduce is still exactly one
+schedule event per call.
+
+Error bound: symmetric absmax rounding gives per-element error
+``<= scale/2 = chunk_absmax / (2*qmax)`` per rank, so the reduced value
+is within ``n_ranks * max_r(chunk_absmax_r) / (2*qmax)`` of the f32
+psum — asserted by the parity test (tests/test_tp_serving.py) against
+``quantized_allreduce_ref``, the single-device jnp reference that pairs
+with the collective the way every Pallas kernel pairs with its ``*_ref``
+(the PAR001 convention).
+
+``allreduce(x, axis, quantized=False)`` is the f32 escape hatch the
+serving engine's ``quantized_allreduce=False`` knob routes through: a
+plain ``psum``, zero quantization, bit-exact reassociation-for-
+reassociation with the quantized path's reduction order.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..serving.quant import dequantize_kv, quantize_kv
+
+__all__ = ["allreduce", "fake_quant_chunks", "quantized_allreduce",
+           "quantized_allreduce_ref"]
+
+# int8 symmetric grid (the serving/quant.py KV_DTYPES int8 row); 256
+# elements per absmax chunk keeps the scale overhead at f32/256 per
+# element (~1.6% of the int8 payload) while tracking local dynamic range
+DEFAULT_CHUNK = 256
+DEFAULT_QMAX = 127.0
+
+
+def fake_quant_chunks(x, *, chunk: int = DEFAULT_CHUNK,
+                      qmax: float = DEFAULT_QMAX, dtype=jnp.int8):
+    """Round ``x`` onto the per-chunk absmax int grid and back: the value
+    an EQuARX hop would reconstruct from the wire payload.  The flattened
+    tensor is split into ``chunk``-wide rows (zero-padded tail — zeros
+    round-trip exactly through the symmetric codec), each row quantized
+    with its own absmax scale via the PR 15 page codec, dequantized, and
+    reshaped back.  Output dtype follows the input."""
+    shape, d = x.shape, x.dtype
+    flat = x.reshape(-1)
+    n = flat.shape[0]
+    c = max(1, int(chunk))
+    pad = (-n) % c
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    rows = flat.reshape(-1, c)
+    q, s = quantize_kv(rows, qmax=qmax, dtype=dtype)
+    deq = dequantize_kv(q, s).reshape(-1)[:n]
+    return deq.reshape(shape).astype(d)
+
+
+def quantized_allreduce(x, axis_name, *, chunk: int = DEFAULT_CHUNK,
+                        qmax: float = DEFAULT_QMAX, dtype=jnp.int8):
+    """EQuARX-style AllReduce over mesh axis ``axis_name``: quantize the
+    local partial per-chunk, sum the dequantized partials with ONE psum.
+    Call only inside a shard_map/pmap region binding ``axis_name``."""
+    return jax.lax.psum(fake_quant_chunks(x, chunk=chunk, qmax=qmax,
+                                          dtype=dtype), axis_name)
+
+
+def allreduce(x, axis_name, *, quantized: bool = False,
+              chunk: int = DEFAULT_CHUNK, qmax: float = DEFAULT_QMAX,
+              dtype=jnp.int8):
+    """The serving engine's one per-layer AllReduce: f32 ``psum`` by
+    default (bit-exact partial reduction), the EQuARX int8 grid with
+    ``quantized=True``.  Either way it is exactly ONE collective event in
+    the SPMD sanitizer's schedule."""
+    if quantized:
+        return quantized_allreduce(x, axis_name, chunk=chunk, qmax=qmax,
+                                   dtype=dtype)
+    return jax.lax.psum(x, axis_name)
+
+
+def quantized_allreduce_ref(partials, *, chunk: int = DEFAULT_CHUNK,
+                            qmax: float = DEFAULT_QMAX, dtype=jnp.int8):
+    """Single-device jnp reference: ``partials [R, ...]`` stacked per-rank
+    partial sums -> the value every rank holds after
+    :func:`quantized_allreduce` (sum of per-rank fake-quantized
+    partials).  The parity pair for the collective — the f32 comparison
+    point is ``partials.sum(0)`` and the error bound is
+    ``R * max_chunk_absmax / (2*qmax)`` per element."""
+    partials = jnp.asarray(partials)
+    deq = jax.vmap(lambda p: fake_quant_chunks(p, chunk=chunk, qmax=qmax,
+                                               dtype=dtype))(partials)
+    return deq.sum(axis=0)

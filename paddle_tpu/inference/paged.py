@@ -802,7 +802,8 @@ class ServingEngine:
                  overlap: bool = False,
                  telemetry: "Telemetry | bool | None" = None,
                  name: str = "engine", kv_dtype: str | None = None,
-                 quantize=None):
+                 quantize=None, mesh=None, mp_axis: str = "mp",
+                 quantized_allreduce: bool = False):
         import jax
         import jax.numpy as jnp
         from ..models.llama import (build_llama_paged_decode,
@@ -818,6 +819,17 @@ class ServingEngine:
         # the f32 engine is gated by serving.quant.parity_report instead
         # of bit-equality (quantization is lossy by definition).
         self.kv_dtype = None if kv_dtype is None else str(kv_dtype)
+        # tensor-parallel serving (ROADMAP item 1): mesh=<Mesh binding
+        # mp_axis> shards Q/KV heads, KV pages, and the MLP weight columns/
+        # rows over mp — the whole horizon runs under shard_map with ONE
+        # AllReduce per transformer layer (f32 psum, or the EQuARX int8
+        # grid with quantized_allreduce=True; distributed/quant_collectives).
+        # The dispatch/drain loop below is mesh-oblivious: every scalar the
+        # host touches is replicated.
+        self.mesh = mesh
+        self.mp_axis = str(mp_axis)
+        self.tp = 1 if mesh is None else int(mesh.shape[mp_axis])
+        self.quantized_allreduce = bool(quantized_allreduce and self.tp > 1)
         if quantize:
             bits = 8 if quantize is True or quantize == "int8" \
                 else int(quantize)
@@ -887,12 +899,29 @@ class ServingEngine:
             build_llama_paged_decode(
                 config, page_size=page_size, num_pages=num_pages, dtype=dtype,
                 attention_impl=attention_impl, interpret=interpret,
-                kv_dtype=self.kv_dtype)
+                kv_dtype=self.kv_dtype, mesh=mesh, mp_axis=self.mp_axis,
+                quantized_allreduce=self.quantized_allreduce)
         cache = init_pages()
         # each side is a raw [L, Hkv, NP+1, ps, D] array (f32/bf16) or a
         # {"q": data, "s": scales} dict (kv_dtype set); the engine treats
         # them as opaque pytrees everywhere except snapshot/restore
         self._pages_k, self._pages_v = cache["k"], cache["v"]
+        if self.tp > 1:
+            # commit params + pages onto the mesh with the same specs the
+            # shard_map region expects, so every jitted fn compiles ONE
+            # variant against stably-placed operands (no silent resharding,
+            # no per-call device_put of the weights)
+            from jax.sharding import NamedSharding, PartitionSpec
+            from ..models.llama import (llama_paged_page_spec,
+                                        llama_paged_param_specs)
+            self.params = params = jax.tree_util.tree_map(
+                lambda s, x: jax.device_put(x, NamedSharding(mesh, s)),
+                llama_paged_param_specs(self.mp_axis), params,
+                is_leaf=lambda s: isinstance(s, PartitionSpec))
+            pg = NamedSharding(mesh, llama_paged_page_spec(self.mp_axis))
+            place = lambda a: jax.device_put(a, pg)
+            self._pages_k = jax.tree_util.tree_map(place, self._pages_k)
+            self._pages_v = jax.tree_util.tree_map(place, self._pages_v)
         self._kv_compute_dtype = jnp.dtype(dtype) if dtype is not None \
             else jnp.float32
         self._page_bytes = None        # lazy page_bytes cache
@@ -2222,13 +2251,15 @@ class ServingEngine:
         capacity wins from quantized pages are visible in BYTES, not just
         page counts (`mem.pool_allocated_bytes` / `mem.pool_capacity_bytes`
         gauges, fleet snapshots).  Pure geometry — computed once and
-        cached (the telemetry memory sampler reads it every step)."""
+        cached (the telemetry memory sampler reads it every step).  Under
+        tensor parallelism this is the PER-CHIP cost: the KV-head axis is
+        sharded over mp, so each chip holds 1/tp of every page."""
         pb = self._page_bytes
         if pb is None:
             from ..serving.quant import page_bytes
             pb = self._page_bytes = page_bytes(
                 self.config, self.page_size, kv_dtype=self.kv_dtype,
-                dtype=self._kv_compute_dtype)
+                dtype=self._kv_compute_dtype) // self.tp
         return pb
 
     def step(self) -> bool:                           # graftlint: hot
@@ -2840,6 +2871,11 @@ class ServingEngine:
             # forced pipeline drains (exactness points)
             "overlap_steps": self.overlap_steps,
             "quiesces": self.quiesces,
+            # tensor-parallel serving: mesh degree over mp (1 = single
+            # chip) and whether the per-layer AllReduce rides the EQuARX
+            # int8 grid (distributed/quant_collectives)
+            "tp_degree": self.tp,
+            "quantized_allreduce": self.quantized_allreduce,
             # per-model-fn compile-cache misses (analysis.sanitize
             # instrumentation) — a warmed steady state must hold these
             # flat; bench --json artifacts embed them via engine_stats

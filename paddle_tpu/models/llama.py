@@ -663,10 +663,41 @@ def build_llama_decode(config: LlamaConfig, max_seq: int = None, dtype=None):
 # ---------------------------------------------------------------------------
 # Paged-KV serving decode path (ragged paged attention + page-pool cache)
 # ---------------------------------------------------------------------------
+def llama_paged_param_specs(mp_axis: str = "mp"):
+    """Per-leaf PartitionSpec for the paged-decode ``(ep, bp, hp)`` params
+    tree under tensor parallelism over ``mp_axis``: column-parallel wq/wk/wv
+    and wgate/wup (output dim sharded = heads / FFN columns), ROW-parallel
+    wdown (input dim sharded — its matmul produces the partial sums the
+    layer's ONE AllReduce combines), and wo REPLICATED: it multiplies the
+    all_gathered head outputs, so its matmul is bit-identical to the
+    single-chip engine's (the gather is exact; see _gather_heads).  The
+    leading dim of every bp leaf is the stacked layer axis (unsharded).
+    Returned as a pytree matching (ep, bp, hp) for shard_map in_specs and
+    NamedSharding placement alike."""
+    from jax.sharding import PartitionSpec as P
+    col = P(None, None, mp_axis)
+    bp = {"ln1": P(), "wq": col, "wk": col, "wv": col, "wo": P(),
+          "ln2": P(), "wgate": col, "wup": col,
+          "wdown": P(None, mp_axis, None)}
+    return ({"tok": P()}, bp, {"ln_f": P(), "lm": P()})
+
+
+def llama_paged_page_spec(mp_axis: str = "mp"):
+    """PartitionSpec for one side of the paged-KV store: shard the KV-head
+    axis (dim 1 of the ``[L, Hkv, NP+1, ps, D]`` data pages and of the
+    ``[L, Hkv, NP+1, ps]`` scale pages) over ``mp_axis``.  A single spec
+    works as a pytree prefix for both the raw-array and the quantized
+    ``{"q","s"}`` page stores — every leaf shards the same axis."""
+    from jax.sharding import PartitionSpec as P
+    return P(None, mp_axis)
+
+
 def build_llama_paged_decode(config: LlamaConfig, page_size: int = 16,
                              num_pages: int = 64, dtype=None,
                              attention_impl: str = "auto",
-                             interpret: bool = False, kv_dtype=None):
+                             interpret: bool = False, kv_dtype=None,
+                             mesh=None, mp_axis: str = "mp",
+                             quantized_allreduce: bool = False):
     """Paged-KV decode path (the `block_multihead_attention` serving analog;
     Ragged Paged Attention arxiv 2604.15464): the KV cache lives in a pool of
     fixed-size pages shared by every in-flight request, so mixed-length
@@ -749,6 +780,21 @@ def build_llama_paged_decode(config: LlamaConfig, page_size: int = 16,
     ``prefill`` additionally fake-quants its LOCAL K/V before attending
     (quantize -> dequantize round trip), so its numerics equal a chunked
     prefill of the same prompt reading the rows back from the pages.
+
+    ``mesh`` (ROADMAP item 1, TP serving): when a Mesh binding ``mp_axis``
+    with size > 1 is given, the four jitted fns come back wrapped in
+    ``shard_map`` over that axis — Q/KV heads and KV pages sharded over
+    ``mp`` (specs: llama_paged_param_specs / llama_paged_page_spec), every
+    scalar/logits input and output replicated.  Per layer the sharded body
+    pays exactly ONE AllReduce (the row-parallel wdown partial reduction;
+    f32 psum by default, the EQuARX int8 grid with
+    ``quantized_allreduce=True`` — distributed/quant_collectives) plus one
+    exact all_gather of the per-rank attention head outputs, after which wo
+    applies replicated — so with f32 collectives every matmul is
+    bit-identical to the single-chip engine and the only divergence source
+    is the psum's fixed summation order.  Requires mp | num_key_value_heads
+    (hence mp | num_attention_heads); MoE blocks are not supported under
+    TP serving.
     """
     from ..ops.pallas.paged_attention import (ragged_paged_attention,
                                               ragged_paged_attention_ref)
@@ -759,6 +805,38 @@ def build_llama_paged_decode(config: LlamaConfig, page_size: int = 16,
     nkv = c.num_key_value_heads
     nh = c.num_attention_heads
     TRASH = num_pages
+    tp = 1 if mesh is None else int(mesh.shape[mp_axis])
+    if tp > 1:
+        if c.num_experts > 1:
+            raise NotImplementedError(
+                "tensor-parallel paged decode does not support MoE blocks")
+        if nkv % tp or nh % tp:
+            raise ValueError(
+                f"mp={tp} must divide num_key_value_heads={nkv} (and "
+                f"num_attention_heads={nh}) to head-shard paged decode")
+        from ..distributed.quant_collectives import allreduce as _allreduce
+
+    def _gather_heads(o):  # graftlint: spmd=mp
+        """Head-sharded attention epilogue: each rank pushed its LOCAL
+        heads through the one ragged dispatch; the tiled all_gather over
+        the head axis (second-to-last) restores the full [..., nh, D] in
+        global head order — NamedSharding hands rank r the contiguous head
+        block r*nh_l..(r+1)*nh_l-1, which is exactly the r-th tile of the
+        gather.  The gather moves bits unchanged, so the replicated wo
+        matmul that follows is bit-identical to single-chip.  NOT an
+        AllReduce: the layer's one psum stays the wdown reduction."""
+        if tp == 1:
+            return o
+        return jax.lax.all_gather(o, mp_axis, axis=o.ndim - 2, tiled=True)
+
+    def _mp_reduce(y):  # graftlint: spmd=mp
+        """THE one AllReduce per transformer layer: sum the row-parallel
+        wdown partials over mp — plain f32 psum by default (the bit-exact
+        escape hatch), the EQuARX int8 per-chunk grid when the engine asks
+        for quantized collectives."""
+        if tp == 1:
+            return y
+        return _allreduce(y, mp_axis, quantized=quantized_allreduce)
     if kv_dtype is not None:
         from ..serving.quant import dequantize_kv, kv_spec, quantize_kv
         kv_storage, kv_qmax = kv_spec(kv_dtype)
@@ -852,15 +930,19 @@ def build_llama_paged_decode(config: LlamaConfig, page_size: int = 16,
         def body(carry, layer_in):
             xc, = carry
             lp, kc_l, vc_l = layer_in
+            # head counts from the LOCAL weight shards: under shard_map
+            # each rank holds nh/tp q heads and nkv/tp kv heads
+            nh_l = lp["wq"].shape[-1] // head_dim
+            nkv_l = lp["wk"].shape[-1] // head_dim
             h = rms_norm_ref(xc, lp["ln1"], c.rms_norm_eps)
-            q = (h @ lp["wq"]).reshape(T, nh, head_dim)
-            k = (h @ lp["wk"]).reshape(T, nkv, head_dim)
-            v = (h @ lp["wv"]).reshape(T, nkv, head_dim)
+            q = (h @ lp["wq"]).reshape(T, nh_l, head_dim)
+            k = (h @ lp["wk"]).reshape(T, nkv_l, head_dim)
+            v = (h @ lp["wv"]).reshape(T, nkv_l, head_dim)
             q = _rope_at(q, sin, cos)
             k = _rope_at(k, sin, cos)
             kc_l, k_loc = _scatter(kc_l, k, page, off)
             vc_l, v_loc = _scatter(vc_l, v, page, off)
-            rep = nh // nkv
+            rep = nh_l // nkv_l
             kf = jnp.repeat(k_loc, rep, axis=1) if rep > 1 else k_loc
             vf = jnp.repeat(v_loc, rep, axis=1) if rep > 1 else v_loc
             s = jnp.einsum("qhd,khd->hqk", q.astype(jnp.float32),
@@ -868,11 +950,11 @@ def build_llama_paged_decode(config: LlamaConfig, page_size: int = 16,
             mask = (t_idx[None, :] <= t_idx[:, None]) & valid[None, :]
             s = jnp.where(mask[None, :, :], s, -jnp.inf)
             p = jax.nn.softmax(s, axis=-1).astype(xc.dtype)
-            o = jnp.einsum("hqk,khd->qhd", p, vf).reshape(T, nh * head_dim)
-            xc = xc + o @ lp["wo"]
+            o = jnp.einsum("hqk,khd->qhd", p, vf)
+            xc = xc + _gather_heads(o).reshape(T, nh * head_dim) @ lp["wo"]
             h = rms_norm_ref(xc, lp["ln2"], c.rms_norm_eps)
             ff = jax.nn.silu(h @ lp["wgate"]) * (h @ lp["wup"])
-            return (xc + ff @ lp["wdown"],), (kc_l, vc_l)
+            return (xc + _mp_reduce(ff @ lp["wdown"]),), (kc_l, vc_l)
 
         (x,), (ks, vs) = jax.lax.scan(body, (x,), (bp, pages_k, pages_v))
         h_last = jax.lax.dynamic_index_in_dim(x, true_len - 1, 0,
@@ -904,20 +986,22 @@ def build_llama_paged_decode(config: LlamaConfig, page_size: int = 16,
         def body(carry, layer_in):
             xc, = carry
             lp, kc_l, vc_l = layer_in
+            nh_l = lp["wq"].shape[-1] // head_dim
+            nkv_l = lp["wk"].shape[-1] // head_dim
             h = rms_norm_ref(xc, lp["ln1"], c.rms_norm_eps)
-            q = (h @ lp["wq"]).reshape(C, nh, head_dim)
-            k = (h @ lp["wk"]).reshape(C, nkv, head_dim)
-            v = (h @ lp["wv"]).reshape(C, nkv, head_dim)
+            q = (h @ lp["wq"]).reshape(C, nh_l, head_dim)
+            k = (h @ lp["wk"]).reshape(C, nkv_l, head_dim)
+            v = (h @ lp["wv"]).reshape(C, nkv_l, head_dim)
             q = _rope_at(q, sin, cos)
             k = _rope_at(k, sin, cos)
             kc_l, _ = _scatter(kc_l, k, page, off)
             vc_l, _ = _scatter(vc_l, v, page, off)
             o = _attn(q[None], kc_l, vc_l, page_tab,
                       start_r, clen_r, kvlen_r)[0]
-            xc = xc + o.reshape(C, nh * head_dim) @ lp["wo"]
+            xc = xc + _gather_heads(o).reshape(C, nh * head_dim) @ lp["wo"]
             h = rms_norm_ref(xc, lp["ln2"], c.rms_norm_eps)
             ff = jax.nn.silu(h @ lp["wgate"]) * (h @ lp["wup"])
-            return (xc + ff @ lp["wdown"],), (kc_l, vc_l)
+            return (xc + _mp_reduce(ff @ lp["wdown"]),), (kc_l, vc_l)
 
         (x,), (ks, vs) = jax.lax.scan(body, (x,), (bp, pages_k, pages_v))
         h_last = jax.lax.dynamic_index_in_dim(x, chunk_len - 1, 0,
@@ -945,10 +1029,12 @@ def build_llama_paged_decode(config: LlamaConfig, page_size: int = 16,
         def body(carry, layer_in):
             xc, = carry
             lp, kc_l, vc_l = layer_in
+            nh_l = lp["wq"].shape[-1] // head_dim
+            nkv_l = lp["wk"].shape[-1] // head_dim
             h = rms_norm_ref(xc, lp["ln1"], c.rms_norm_eps)
-            q = (h @ lp["wq"]).reshape(S, nh, head_dim)
-            k = (h @ lp["wk"]).reshape(S, nkv, head_dim)
-            v = (h @ lp["wv"]).reshape(S, nkv, head_dim)
+            q = (h @ lp["wq"]).reshape(S, nh_l, head_dim)
+            k = (h @ lp["wk"]).reshape(S, nkv_l, head_dim)
+            v = (h @ lp["wv"]).reshape(S, nkv_l, head_dim)
             q = _rope_at(q, sin_p, cos_p)
             k = _rope_at(k, sin_p, cos_p)
             kc_l, _ = _scatter(kc_l, k, page, off)
@@ -956,10 +1042,10 @@ def build_llama_paged_decode(config: LlamaConfig, page_size: int = 16,
             # decode is the q_len = 1 segment of the unified ragged kernel
             o = _attn(q[:, None], kc_l, vc_l, page_tables,
                       pos, n_q, eff_len)[:, 0]
-            xc = xc + o.reshape(S, nh * head_dim) @ lp["wo"]
+            xc = xc + _gather_heads(o).reshape(S, nh * head_dim) @ lp["wo"]
             h = rms_norm_ref(xc, lp["ln2"], c.rms_norm_eps)
             ff = jax.nn.silu(h @ lp["wgate"]) * (h @ lp["wup"])
-            return (xc + ff @ lp["wdown"],), (kc_l, vc_l)
+            return (xc + _mp_reduce(ff @ lp["wdown"]),), (kc_l, vc_l)
 
         (x,), (ks, vs) = jax.lax.scan(body, (x,), (bp, pages_k, pages_v))
         return _head(hp, x), ks, vs
@@ -1006,25 +1092,52 @@ def build_llama_paged_decode(config: LlamaConfig, page_size: int = 16,
         def body(carry, layer_in):
             xc, = carry
             lp, kc_l, vc_l = layer_in
+            nh_l = lp["wq"].shape[-1] // head_dim
+            nkv_l = lp["wk"].shape[-1] // head_dim
             h = rms_norm_ref(xc, lp["ln1"], c.rms_norm_eps)
-            q = (h @ lp["wq"]).reshape(S, Q, nh, head_dim)
-            k = (h @ lp["wk"]).reshape(S, Q, nkv, head_dim)
-            v = (h @ lp["wv"]).reshape(S, Q, nkv, head_dim)
+            q = (h @ lp["wq"]).reshape(S, Q, nh_l, head_dim)
+            k = (h @ lp["wk"]).reshape(S, Q, nkv_l, head_dim)
+            v = (h @ lp["wv"]).reshape(S, Q, nkv_l, head_dim)
             q = _rope_at(q, sin, cos)
             k = _rope_at(k, sin, cos)
             kc_l, _ = _scatter(kc_l, k, page, off)
             vc_l, _ = _scatter(vc_l, v, page, off)
-            o = _attn(q, kc_l, vc_l, page_tables, lengths, n_q, kv_len) \
+            o = _gather_heads(
+                _attn(q, kc_l, vc_l, page_tables, lengths, n_q, kv_len)) \
                 .reshape(S, Q, nh * head_dim)
             xc = xc + o @ lp["wo"]
             h = rms_norm_ref(xc, lp["ln2"], c.rms_norm_eps)
             ff = jax.nn.silu(h @ lp["wgate"]) * (h @ lp["wup"])
-            return (xc + ff @ lp["wdown"],), (kc_l, vc_l)
+            return (xc + _mp_reduce(ff @ lp["wdown"]),), (kc_l, vc_l)
 
         (x,), (ks, vs) = jax.lax.scan(body, (x,), (bp, pages_k, pages_v))
         logits = _head(hp, x)                         # [S, Q, V] f32
         greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         return logits[:, 0], greedy, ks, vs
+
+    if tp > 1:
+        # TP serving region: the four paged fns run under shard_map over
+        # mp — params/pages per the spec helpers, every scalar + logits
+        # input/output replicated.  All replicated outputs are computed
+        # identically on every rank (the last op touching the residual is
+        # the psum), so check_vma=False only skips re-proving what the
+        # per-layer collective structure already guarantees.
+        from jax.sharding import PartitionSpec
+        p_specs = llama_paged_param_specs(mp_axis)
+        pg = llama_paged_page_spec(mp_axis)
+        r = PartitionSpec()
+
+        def _smap(fn, in_specs, out_specs):
+            return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_vma=False)
+
+        prefill = _smap(prefill, (p_specs, r, r, r, pg, pg), (r, pg, pg))
+        prefill_chunk = _smap(prefill_chunk, (p_specs, r, r, r, r, pg, pg),
+                              (r, r, pg, pg))
+        decode_step = _smap(decode_step, (p_specs, r, r, r, pg, pg, r),
+                            (r, pg, pg))
+        verify_step = _smap(verify_step, (p_specs, r, r, r, pg, pg, r),
+                            (r, r, pg, pg))
 
     return init_pages, prefill, prefill_chunk, decode_step, verify_step
 

@@ -200,6 +200,18 @@ def ragged_paged_attention(q, k_pages, v_pages, page_table, q_start, q_len,
     before its online-softmax update; the f32 K/V never exist outside the
     kernel).  The scale pages ride the same page-table indirection as the
     data pages.
+
+    Head-sharded (TP) dispatch: every shape here may be the mp-LOCAL
+    shard — Hq = nh/tp query heads against Hkv = nkv/tp KV-head pages.
+    Nothing in the kernel knows about the mesh: the grid, the GQA
+    replication factor (rep = Hq // Hkv), and the block specs all derive
+    from the operand shapes, so the tensor-parallel serving engine calls
+    the SAME dispatch per rank inside shard_map that the single-chip
+    engine calls globally.  Correctness of the local GQA pairing needs
+    mp | nkv (then local q head j reads local kv head j // rep, exactly
+    the global mapping restricted to rank r's contiguous head block) —
+    the divisibility guard below enforces the local ratio, the builder
+    (models/llama.build_llama_paged_decode) enforces mp | nkv.
     """
     s_slots, qmax, hq, d = q.shape
     hkv, _np_, page_size, _d = k_pages.shape
@@ -287,7 +299,10 @@ def ragged_paged_attention_ref(q, k_pages, v_pages, page_table, q_start,
     k_scales/v_scales the gathered int8/fp8 rows dequantize by the same
     astype-f32-times-row-scale expression the kernel fuses).  This is the
     CPU path the serving engine dispatches for decode, verify, AND
-    chunked prefill — one implementation per engine, every path."""
+    chunked prefill — one implementation per engine, every path.  Like
+    the kernel it is head-shard agnostic: under TP serving each rank
+    passes its mp-local Hq/Hkv shapes and the ref computes that rank's
+    heads exactly (same guard, same local GQA pairing)."""
     s_slots, qmax, hq, d = q.shape
     hkv = k_pages.shape[0]
     page_size = k_pages.shape[2]

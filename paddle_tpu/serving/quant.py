@@ -194,13 +194,21 @@ def _run_engine(factory, scenarios):
 
 
 def logit_drift(params_ref, params_q, config, prompts, *, kv_dtype,
-                page_size: int = 8, steps: int = 8, dtype=None):
+                page_size: int = 8, steps: int = 8, dtype=None,
+                ref_build_kw=None, q_build_kw=None):
     """Max |logits_q - logits_ref| over a TEACHER-FORCED greedy decode:
     both page stores replay the REFERENCE engine's token trajectory, so
     the drift number measures the quantization error of each step's
     logits in isolation (a free-running comparison would conflate one
     early argmax flip with everything after it).  Returns (max_drift,
-    per-step max drifts)."""
+    per-step max drifts).
+
+    ``ref_build_kw`` / ``q_build_kw``: extra build_llama_paged_decode
+    kwargs per arm — how the TP serving bench drifts the quantized
+    AllReduce against the f32-collective build (both arms
+    ``mesh=<mesh>``, the q arm additionally ``quantized_allreduce=True``,
+    with ``kv_dtype=None`` so page quantization stays out of the
+    measurement)."""
     import jax.numpy as jnp
     from ..models.llama import build_llama_paged_decode
 
@@ -208,11 +216,11 @@ def logit_drift(params_ref, params_q, config, prompts, *, kv_dtype,
     n_pages = per + 1
     drifts = []
     builds = {}
-    for tag, prm, kvd in (("ref", params_ref, None),
-                          ("q", params_q, kv_dtype)):
+    for tag, prm, kvd, bkw in (("ref", params_ref, None, ref_build_kw),
+                               ("q", params_q, kv_dtype, q_build_kw)):
         builds[tag] = build_llama_paged_decode(
             config, page_size=page_size, num_pages=n_pages, dtype=dtype,
-            attention_impl="ref", kv_dtype=kvd)
+            attention_impl="ref", kv_dtype=kvd, **(bkw or {}))
     for prompt in prompts:
         T = len(prompt)
         ids = jnp.asarray(np.asarray(prompt, np.int32)[None])
@@ -251,7 +259,8 @@ def logit_drift(params_ref, params_q, config, prompts, *, kv_dtype,
 
 def parity_report(params, config, *, kv_dtype="int8", quantize=8,
                   scenarios=None, engine_kw=None, drift_steps=8,
-                  drift_prompts=2):
+                  drift_prompts=2, ref_engine_kw=None, q_engine_kw=None,
+                  ref_build_kw=None, q_build_kw=None):
     """Greedy exact-match rate + max logit drift of the quantized serving
     plane vs the f32 engine on the standard parity scenarios.
 
@@ -267,6 +276,14 @@ def parity_report(params, config, *, kv_dtype="int8", quantize=8,
       * ``max_logit_drift`` — max |Δlogits| over a teacher-forced decode
         of the first ``drift_prompts`` scenarios (the raw numeric error
         the argmax survived).
+
+    ``ref_engine_kw`` / ``q_engine_kw`` merge per-arm ON TOP of
+    ``engine_kw`` — this is how the TP serving bench reuses the harness
+    for quantized-vs-f32 COLLECTIVES instead of quantized-vs-f32 pages:
+    both arms ``mesh=<mesh>``, the q arm ``quantized_allreduce=True``,
+    with ``kv_dtype=None, quantize=None`` so the only difference under
+    measurement is the per-layer AllReduce grid.  ``ref_build_kw`` /
+    ``q_build_kw`` forward to :func:`logit_drift` the same way.
 
     Deterministic for a given params/config/scenario seed."""
     from ..inference.paged import ServingEngine
@@ -287,10 +304,12 @@ def parity_report(params, config, *, kv_dtype="int8", quantize=8,
     params_q = quantize_params(params, bits=int(quantize)) if quantize \
         else params
 
+    ref_kw = dict(kw, **(ref_engine_kw or {}))
+    q_kw = dict(kw, **(q_engine_kw or {}))
     ref_outs, ref_eng = _run_engine(
-        lambda: ServingEngine(params, config, **kw), scenarios)
+        lambda: ServingEngine(params, config, **ref_kw), scenarios)
     q_outs, q_eng = _run_engine(
-        lambda: ServingEngine(params_q, config, kv_dtype=kv_dtype, **kw),
+        lambda: ServingEngine(params_q, config, kv_dtype=kv_dtype, **q_kw),
         scenarios)
 
     matches = [a == b for a, b in zip(ref_outs, q_outs)]
@@ -305,7 +324,8 @@ def parity_report(params, config, *, kv_dtype="int8", quantize=8,
         max_drift, _ = logit_drift(
             params, params_q, config,
             [p for p, _m in scenarios[:drift_prompts]], kv_dtype=kv_dtype,
-            page_size=kw["page_size"], steps=drift_steps)
+            page_size=kw["page_size"], steps=drift_steps,
+            ref_build_kw=ref_build_kw, q_build_kw=q_build_kw)
     else:
         max_drift = 0.0        # drift pass skipped (cheap smoke mode)
     ref_eng.check_invariants()

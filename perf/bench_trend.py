@@ -68,6 +68,12 @@ QUANT_MATCH_KEY = "exact_match"
 # host sampling; greedy traffic pins it at 1.0).  Drift-checked like the
 # other columns.
 FUSED_KEY = "fused_frac"
+# Tensor-parallel serving column: the TP arm's collective tax —
+# ``tp.tp_collective_frac`` from the serving artifact's --tp block (the
+# TP engine's decode_sync_frac; the ceiling on the per-layer-AllReduce
+# share of request latency).  Drift-checked like the other columns: once
+# a round publishes a TP arm, a later round silently losing it fails.
+TP_COLL_KEY = "tp_collective_frac"
 
 
 def find_artifacts(root: str) -> list[tuple[int, str]]:
@@ -245,6 +251,19 @@ def find_fused_frac(d):
     return _find(d, match)
 
 
+def find_tp_collective_frac(d):
+    """First TP collective-tax fraction: the serving artifact's
+    ``tp.tp_collective_frac`` (the --tp arm's decode_sync_frac — the
+    device-sync share of TP request latency, which on the TP engine
+    includes the one per-layer AllReduce)."""
+    def match(n):
+        t = n.get("tp")
+        if isinstance(t, dict) and _num(t.get(TP_COLL_KEY)):
+            return t[TP_COLL_KEY]
+        return None
+    return _find(d, match)
+
+
 def _fmt(v, nd=1):
     if v is None:
         return "-"
@@ -271,6 +290,7 @@ def trend(root: str = ".", verbose: bool = True) -> int:
     prev_quant_cap = False
     prev_quant_match = False
     prev_fused = False
+    prev_tp_coll = False
     for rnd, path in arts:
         try:
             with open(path) as f:
@@ -348,6 +368,12 @@ def trend(root: str = ".", verbose: bool = True) -> int:
                             f"(fused_sampling.{FUSED_KEY}) present in an "
                             f"earlier round but missing here")
         prev_fused = prev_fused or fused_frac is not None
+        tp_coll = find_tp_collective_frac(parsed)
+        if tp_coll is None and prev_tp_coll:
+            problems.append(f"{path}: TP collective tax "
+                            f"(tp.{TP_COLL_KEY}) present in an earlier "
+                            f"round but missing here")
+        prev_tp_coll = prev_tp_coll or tp_coll is not None
         rows.append({
             "round": rnd,
             "metric": parsed.get("metric"),
@@ -386,6 +412,8 @@ def trend(root: str = ".", verbose: bool = True) -> int:
             # ISSUE 16 column: on-device greedy sampling share of
             # steady-state dispatches (tokens, not logits)
             "fused_frac": fused_frac,
+            # TP serving column: the --tp arm's collective tax
+            "tp_collective_frac": tp_coll,
         })
     if verbose:
         hdr = (f"{'round':>5}  {'tokens/s':>10}  {'vs_base':>8}  "
@@ -393,7 +421,7 @@ def trend(root: str = ".", verbose: bool = True) -> int:
                f"{'overlap':>7}  {'slo_gput':>8}  {'rec_p50':>7}  "
                f"{'perr_p95':>8}  {'alerts':>6}  {'dsync':>5}  "
                f"{'gprh':>6}  {'f_hit':>5}  {'q_cap':>5}  {'q_em':>5}  "
-               f"{'fused':>5}")
+               f"{'fused':>5}  {'tp_coll':>7}")
         print(hdr)
         print("-" * len(hdr))
         for r in rows:
@@ -412,7 +440,8 @@ def trend(root: str = ".", verbose: bool = True) -> int:
                   f"{_fmt(r['fleet_hit_rate'], 3):>5}  "
                   f"{_fmt(r['quant_capacity_ratio'], 2):>5}  "
                   f"{_fmt(r['quant_exact_match'], 3):>5}  "
-                  f"{_fmt(r['fused_frac'], 3):>5}")
+                  f"{_fmt(r['fused_frac'], 3):>5}  "
+                  f"{_fmt(r['tp_collective_frac'], 3):>7}")
         v0, v1 = rows[0]["value"], rows[-1]["value"]
         if len(rows) >= 2 \
                 and all(isinstance(v, (int, float))

@@ -82,6 +82,18 @@ OVERLAP_KEYS = ("enabled", "rounds", "tokens_per_sec_on",
 # honest bar there
 OVERLAP_MIN_RATIO_MULTICORE = 1.0
 OVERLAP_MIN_RATIO_SINGLECORE = 0.97
+# the tensor-parallel serving arm (bench.py --trace serving --tp N): the
+# block is OPTIONAL (only present when --tp ran) but fully gated when it
+# is — bit-exactness vs single-chip, the per-rank collective profile, the
+# attribution readout, and the quantized-AllReduce parity gate
+TP_KEYS = ("tp_degree", "outputs_bit_exact", "rounds", "tokens_per_sec_tp",
+           "tokens_per_sec_single", "best_paired_ratio", "pair_ratios",
+           "tokens_per_sec_quantized", "quantized_vs_f32_ratio",
+           "tp_collective_frac", "attribution", "collectives",
+           "quantized_parity", "engine_stats")
+TP_COLLECTIVE_KEYS = ("events", "total_s", "per_kind", "max_rank_skew_s",
+                      "per_rank_total_s", "straggler")
+TP_QUANT_MIN_EXACT_MATCH = 0.99
 MEMORY_LAST_KEYS = ("step", "total_pages", "free_pages", "allocated_pages",
                     "referenced", "cache_page_refs", "occupancy_frac",
                     "fragmentation_frac", "queue_depth", "active",
@@ -861,6 +873,7 @@ def validate_artifact(art: dict, trace: str, proc: bool = False) -> list[str]:
                                     f"missing count/total_s")
     if trace == "serving":
         problems.extend(_validate_overlap(art))
+        problems.extend(_validate_tp(art))
     return problems
 
 
@@ -905,6 +918,77 @@ def _validate_overlap(art: dict) -> list[str]:
     metrics = _dig(art, ("metrics",))
     if isinstance(metrics, dict) and "engine.inflight_depth" not in metrics:
         problems.append("metrics: missing 'engine.inflight_depth' gauge")
+    return problems
+
+
+def _validate_tp(art: dict) -> list[str]:
+    """The tensor-parallel serving arm (``--tp N``): schema + gates.
+
+    The block is OPTIONAL — bench.py only emits it when run with ``--tp``
+    — but when present every gate applies: the f32-collective TP engine
+    must be greedy-bit-exact vs single-chip, the SPMD sanitizer's
+    per-rank collective profile must show the per-layer psum actually
+    traced, ``tp_collective_frac`` must be a sane fraction, and the
+    quantized-AllReduce arm must hold parity_report exact_match >= 0.99."""
+    tp = art.get("tp")
+    if tp is None:
+        return []
+    if not isinstance(tp, dict):
+        return ["tp: present but not a dict"]
+    problems = []
+    for k in TP_KEYS:
+        if k not in tp:
+            problems.append(f"tp: missing {k!r}")
+    deg = tp.get("tp_degree")
+    if not isinstance(deg, int) or deg < 2:
+        problems.append(f"tp.tp_degree {deg!r} is not an int >= 2")
+    if tp.get("outputs_bit_exact") is not True:
+        problems.append("tp.outputs_bit_exact is not True — the f32-"
+                        "collective TP engine must match the single-chip "
+                        "engine token-for-token")
+    coll = tp.get("collectives")
+    if not isinstance(coll, dict):
+        problems.append("tp: 'collectives' is not the skew_report profile")
+    else:
+        for k in TP_COLLECTIVE_KEYS:
+            if k not in coll:
+                problems.append(f"tp.collectives: missing {k!r}")
+        if not coll.get("events"):
+            problems.append("tp.collectives.events is 0 — the sanitizer "
+                            "saw no collectives on a TP trace")
+        pk = coll.get("per_kind")
+        if isinstance(pk, dict) and "psum" not in pk:
+            problems.append("tp.collectives.per_kind has no 'psum' — the "
+                            "per-layer AllReduce never traced")
+        skew = coll.get("max_rank_skew_s")
+        if not isinstance(skew, (int, float)) or skew < 0:
+            problems.append(f"tp.collectives.max_rank_skew_s {skew!r} is "
+                            "not a non-negative number")
+    frac = tp.get("tp_collective_frac")
+    if not isinstance(frac, (int, float)) or not 0.0 <= frac <= 1.0:
+        problems.append(f"tp.tp_collective_frac {frac!r} not in [0, 1]")
+    attr = tp.get("attribution")
+    if not isinstance(attr, dict) \
+            or "decode_sync_frac_tp" not in attr \
+            or "decode_sync_frac_single" not in attr:
+        problems.append("tp.attribution missing decode_sync_frac_tp/"
+                        "decode_sync_frac_single")
+    par = tp.get("quantized_parity")
+    if not isinstance(par, dict):
+        problems.append("tp: missing quantized_parity (the quantized-"
+                        "AllReduce parity_report)")
+    else:
+        em = par.get("exact_match")
+        if not isinstance(em, (int, float)) \
+                or em < TP_QUANT_MIN_EXACT_MATCH:
+            problems.append(f"tp.quantized_parity.exact_match {em!r} < "
+                            f"{TP_QUANT_MIN_EXACT_MATCH}")
+        if "max_logit_drift" not in par:
+            problems.append("tp.quantized_parity missing max_logit_drift")
+    st = tp.get("engine_stats")
+    if isinstance(st, dict) and st.get("tp_degree") != deg:
+        problems.append(f"tp.engine_stats.tp_degree "
+                        f"{st.get('tp_degree')!r} != tp.tp_degree {deg!r}")
     return problems
 
 

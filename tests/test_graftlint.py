@@ -828,6 +828,140 @@ class TestDist002:
 
 
 # ---------------------------------------------------------------------------
+# DIST001/DIST002 — the serving TP shard_map region (tensor-parallel
+# paged decode fixtures: the models/llama.py wiring, distilled)
+# ---------------------------------------------------------------------------
+class TestDistServingTP:
+    """Fixture pairs mirroring the serving engine's TP region: a builder
+    closes over (mesh, mp_axis, tp), the ONE per-layer AllReduce routes
+    through a quant_collectives-style ``allreduce`` wrapper with a STATIC
+    ``quantized`` knob, the tp==1 escape is an EARLY RETURN (the psum is
+    never nested under the branch), and the head re-gather uses a literal
+    axis checked against the build_mesh dict env."""
+
+    SERVING_SHAPE = """
+        def allreduce(x, axis_name, quantized=False):
+            if quantized:
+                return jax.lax.psum(fake_quant(x), axis_name)
+            return jax.lax.psum(x, axis_name)
+
+        def fake_quant(x):
+            return x
+
+        def build(x, devs, build_mesh, tp=2, quantized_allreduce=False):
+            mesh = build_mesh({{"mp": tp}})
+            mp_axis = "mp"
+
+            def _mp_reduce(y):  # graftlint: spmd=mp
+                if tp == 1:
+                    return y
+                return allreduce(y, mp_axis,
+                                 quantized=quantized_allreduce)
+
+            def decode_step(x):
+                o = jax.lax.all_gather(x, {gather_axis!r}, axis=0,
+                                       tiled=True)
+                return _mp_reduce(o)
+
+            return shard_map(decode_step, mesh=mesh, in_specs=(P("mp"),),
+                             out_specs=P())(x)
+    """
+
+    def test_negative_serving_region_is_clean(self):
+        # the real wiring: literal gather axis resolves against the mesh
+        # env, the wrapper's param-passed psum axis is unresolvable (and
+        # so skipped, exactly like distributed/quant_collectives.py), the
+        # static tp/quantized knobs guard nothing rank-dependent
+        res = _lint_dist(self.SERVING_SHAPE.format(gather_axis="mp"))
+        assert res.new == []
+
+    def test_positive_gather_axis_not_in_serving_mesh(self):
+        # same wiring, head re-gather over an axis the serving mesh does
+        # not bind -> DIST001
+        res = _lint_dist(self.SERVING_SHAPE.format(gather_axis="model"))
+        assert _rules(res) == ["DIST001"]
+        assert "'model'" in res.new[0].message
+
+    def test_positive_wrong_axis_through_reduce_helper(self):
+        # the per-layer reduce helper hardcodes an axis the mesh lacks;
+        # DIST001 resolves it through the shard_map body's call edge
+        res = _lint_dist("""
+            def reduce_partials(y):
+                return jax.lax.psum(y, "model")
+
+            def build(x, devs, build_mesh):
+                mesh = build_mesh({"mp": 2})
+
+                def decode_step(x):
+                    return reduce_partials(x)
+
+                return shard_map(decode_step, mesh=mesh,
+                                 in_specs=(P("mp"),), out_specs=P())(x)
+        """)
+        assert _rules(res) == ["DIST001"]
+
+    def test_positive_rank_gated_layer_reduce(self):
+        # the divergence the SPMD sanitizer drills at dryrun time, as
+        # lint: only rank 0 reduces the wdown partials -> DIST002
+        res = _lint_dist("""
+            def build(x, devs, build_mesh):
+                mesh = build_mesh({"mp": 2})
+
+                def decode_step(x):  # graftlint: spmd=mp
+                    r = jax.lax.axis_index("mp")
+                    if r == 0:
+                        x = jax.lax.psum(x, "mp")
+                    return x
+
+                return shard_map(decode_step, mesh=mesh,
+                                 in_specs=(P("mp"),), out_specs=P())(x)
+        """)
+        assert _rules(res) == ["DIST002"]
+
+    def test_negative_quantized_knob_is_static(self):
+        # quant_collectives.allreduce distilled: the `quantized` knob
+        # selects WHICH uniform collective runs, never whether one runs —
+        # not rank-dependent, so DIST002 stays quiet even inside a marked
+        # SPMD region
+        res = _lint_dist("""
+            def fake_quant(x):
+                return x
+
+            def allreduce(x, axis_name, quantized=False):  # graftlint: spmd=mp
+                if quantized:
+                    return jax.lax.psum(fake_quant(x), axis_name)
+                return jax.lax.psum(x, axis_name)
+        """)
+        assert res.new == []
+
+    def test_quant_collectives_pairs_like_a_kernel(self):
+        # distributed/quant_collectives.py follows the PAR001 convention
+        # (collective + single-device *_ref + parity test asserting the
+        # int8 error bound, tests/test_tp_serving.py).  The same shape
+        # placed under ops/pallas lints clean with its ref + registered
+        # test — and stripped of the ref it is a PAR001 like any kernel.
+        paired = textwrap.dedent("""
+            def quantized_allreduce(x, axis_name):
+                return x
+
+            def quantized_allreduce_ref(partials):
+                return partials.sum(0)
+        """)
+        res = lint_sources(
+            [("pkg/ops/pallas/quant_allreduce.py", paired)],
+            kernel_test_src="from pkg.ops.pallas.quant_allreduce import "
+                            "quantized_allreduce  # int8 bound asserted")
+        assert res.new == []
+        res = lint_sources(
+            [("pkg/ops/pallas/quant_allreduce.py", textwrap.dedent("""
+                def quantized_allreduce(x, axis_name):
+                    return x
+             """))],
+            kernel_test_src="nothing relevant")
+        assert _rules(res) == ["PAR001", "PAR001"]
+
+
+# ---------------------------------------------------------------------------
 # DONATE001 — use-after-donate
 # ---------------------------------------------------------------------------
 class TestDonate001:

@@ -170,6 +170,54 @@ class TestServingSteadyState:
                                  "decode_step": 1, "verify_step": 1,
                                  "sample": 1, "page_copy": 1}, warm_variants
 
+    def test_warmed_tp_engine_zero_steady_state_misses(self):
+        """ISSUE 18 re-pin for the mesh-wrapped fns: the TENSOR-PARALLEL
+        engine (2-way mp shard_map around every model fn, params + pages
+        committed with NamedSharding) holds the SAME steady-state variant
+        table as the single-chip engine — the shard_map wrapper adds no
+        cache key of its own, and the stably-placed operands mean no
+        silent resharding variant ever compiles.  Zero misses under
+        sanitize(budget=0) on the identical mixed-traffic replay."""
+        from paddle_tpu.distributed.topology import build_mesh
+        cfg = llama_config_tiny(vocab=64, hidden=32, layers=2, heads=4,
+                                seq=128)
+        params = _echo_params(cfg, seed=11)
+        mesh = build_mesh({"mp": 2}, devices=jax.devices()[:2])
+        eng = ServingEngine(params, cfg, num_slots=3, page_size=16,
+                            num_pages=96, prompt_bucket=16,
+                            decode_horizon=4, prefill_chunk=16,
+                            speculative=2, seed=3, mesh=mesh)
+        r = np.random.default_rng(23)
+        A = r.integers(1, 64, (40,)).astype(np.int32)
+        B = r.integers(1, 64, (40,)).astype(np.int32)
+        C = r.integers(1, 64, (10,)).astype(np.int32)
+        D = np.tile(np.array([5, 9, 2, 13], np.int32), 6)
+
+        def one_round():
+            rids = [eng.submit(A, max_new_tokens=8),
+                    eng.submit(B, max_new_tokens=12, temperature=0.8,
+                               top_p=0.9),
+                    eng.submit(C, max_new_tokens=8),
+                    eng.submit(D, max_new_tokens=8)]
+            done = eng.run()
+            return [list(done[i].generated) for i in rids]
+
+        g1 = one_round()              # cold: compile the working set
+        g2 = one_round()              # cache-hit paths
+        warm_variants = dict(eng.jit_variants())
+        with sanitize(budget=0) as s:
+            g3 = one_round()          # steady state: ZERO recompiles
+        assert s.misses == {}
+        for i in (0, 2, 3):
+            assert g1[i] == g2[i] == g3[i]
+        assert eng.verify_steps > 0 and eng.cow_copies > 0 \
+            and eng.cache_hits > 0
+        # the SAME pinned table as the single-chip engine above: one
+        # variant per model fn, mesh-wrapped or not
+        assert warm_variants == {"prefill": 1, "prefill_chunk": 1,
+                                 "decode_step": 1, "verify_step": 1,
+                                 "sample": 1, "page_copy": 1}, warm_variants
+
     def test_steady_state_recompile_raises(self):
         """A decode/verify/prefill variant that recompiles under the
         steady-state budget is a hard failure: an unwarmed chunk shape
