@@ -68,6 +68,7 @@ OBS_FAILOVER_ARTIFACT ?= /tmp/_obs_failover.json
 OBS_FAILOVER_PERFETTO ?= /tmp/_obs_failover_perfetto.json
 OBS_ELASTIC_ARTIFACT ?= /tmp/_obs_elastic.json
 OBS_QUANT_ARTIFACT ?= /tmp/_obs_quant.json
+OBS_DISAGG_ARTIFACT ?= /tmp/_obs_disagg.json
 
 # obs-check additionally runs the ISSUE 11 frontend trace (AsyncFrontend
 # bit-equality + zero-leak asserts, predictive-vs-depth admission A/B on
@@ -106,6 +107,14 @@ OBS_QUANT_ARTIFACT ?= /tmp/_obs_quant.json
 # decode_sync_frac) is schema-gated.  Forced-host TP time-slices one
 # CPU, so tokens_per_sec_tp measures dispatch overhead, not speedup —
 # the gate is on correctness + schema, never on the paired ratio.
+# Since ISSUE 19 it also runs the disagg trace (prefill/decode on
+# separate mp=2 submeshes, 4 forced-host chips): colocated-TP vs
+# disaggregated arms replay the SAME prefill-heavy scenario at FIXED
+# chip count on the shared virtual clock, greedy bit-exactness vs the
+# single-chip engine is asserted in BOTH arms before anything is
+# reported, and the artifact's TTFT win ratio, rank-local handoff
+# telemetry, and EXACT kv_transfer attribution segment are schema-gated
+# (perf/check_obs.py --trace disagg) — all deterministic.
 obs-check:
 	set -o pipefail; \
 	env JAX_PLATFORMS=cpu $(PY) bench.py --trace serving --tp 2 \
@@ -128,7 +137,11 @@ obs-check:
 	env JAX_PLATFORMS=cpu $(PY) bench.py --trace quant \
 		--json $(OBS_QUANT_ARTIFACT) && \
 	env JAX_PLATFORMS=cpu $(PY) perf/check_obs.py \
-		--artifact $(OBS_QUANT_ARTIFACT) --trace quant
+		--artifact $(OBS_QUANT_ARTIFACT) --trace quant && \
+	env JAX_PLATFORMS=cpu $(PY) bench.py --trace disagg \
+		--json $(OBS_DISAGG_ARTIFACT) && \
+	env JAX_PLATFORMS=cpu $(PY) perf/check_obs.py \
+		--artifact $(OBS_DISAGG_ARTIFACT) --trace disagg
 
 # `proc-smoke` is the ISSUE 17 cross-process CI lane: spawn 2 REAL worker
 # processes (each hosting a full ServingEngine behind the length-prefixed

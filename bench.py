@@ -36,6 +36,7 @@ a TTFT deadline) and are schema-validated by perf/check_obs.py.
 """
 from __future__ import annotations
 
+import itertools
 import json
 import os
 import time
@@ -1564,6 +1565,10 @@ def bench_serving_failover_proc(seed=0):
                  "rpc": st["rpc"],
                  "recovery": st["recovery"]},
         "boundary_overhead_x": round(thread_tps / proc_tps, 2),
+        # check_obs gates recovery p50 under a HOST-AWARE ceiling
+        # (single-core hosts get slack) — the wall-clock half of the
+        # elastic trace's virtual-clock economics (ROADMAP item 5)
+        "host_cpu_count": os.cpu_count(),
         "stitched": {"max_chain": stitched["max_chain"],
                      "components": stitched.get("components"),
                      "flow_events": stitched.get("flow_events")},
@@ -1767,6 +1772,231 @@ def bench_serving_elastic(seed=0):
         "autoscale": est["autoscale"],
         "fleet": efleet.stats_snapshot(ttft_deadline_s=slo_v),
         "slo_report": elastic["slo_report"],
+        # ROADMAP item-5 leftover (closed in ISSUE 19): this trace's
+        # economics are VIRTUAL-clock — each replica modeled as its own
+        # concurrently-stepping host, which today's autoscaler (threads on
+        # one process) cannot deliver in wall time.  The artifact says so
+        # explicitly, and the wall-clock side of the story lives in the
+        # --proc failover arm (real worker processes, real SIGKILL, a
+        # HOST-AWARE recovery ceiling in check_obs) — so the elastic gate
+        # stays deterministic while proc-smoke carries the machine-varying
+        # measurement, instead of the two drifting apart as hosts vary.
+        "parallelism": {
+            "model": "virtual (round-driven clock; replicas modeled as "
+                     "concurrent hosts)",
+            "wall_clock_arm": "bench.py --trace failover --proc "
+                              "(ProcessFleet; host-aware recovery ceiling "
+                              "in check_obs)",
+            "note": "re-measure this trace on wall clock when the "
+                    "autoscaler scales ProcessFleet workers "
+                    "(ROADMAP item 5 runway)"},
+        "host_cpu_count": os.cpu_count(),
+    }
+
+
+def bench_serving_disagg(seed=0):
+    """Disaggregated prefill/decode A/B (ISSUE 19; PERF.md §26): a
+    PREFILL-HEAVY trace (long prompts, short generations) replayed
+    against two fleet arms at a FIXED chip count of 4:
+
+      * colocated-TP — 2 interchangeable replicas, each a ServingEngine
+        TP-sharded over its own mp=2 submesh, running CHUNKED prefill
+        (the TPOT-protecting configuration: a colocated replica must
+        interleave long prefills with its resident decodes);
+      * disaggregated — 1 prefill-role replica (DENSE prefill + first
+        tokens, mp=2 on chips 0-1) handing head-sharded KV pages to 1
+        decode-role replica (mp=2 on chips 2-3) via
+        ``export_kv``/``import_kv``.  Equal mp degree on both sides, so
+        every handoff is RANK-LOCAL.
+
+    Both arms run on a round-driven VirtualClock shared by the fleet AND
+    every replica's Telemetry (one clock domain: request stamps, TTFT,
+    the kv_transfer gap, deadlines), so every reported number is
+    deterministic for a given seed.  Asserted BEFORE reporting, per arm:
+    zero lost requests and greedy streams bit-equal the uninterrupted
+    single-chip engine (the TP arms add psum reassociation; the
+    margin-engineered params keep argmax above that noise).  Gates
+    (check_obs ``--trace disagg``): TTFT p95 win ratio at fixed chips,
+    every handoff rank-local with zero fallbacks, the transfer visible
+    as an EXACT ``kv_transfer`` attribution segment, and the
+    ``kv_transfer_frac`` / ``disagg_ttft_p95_ms`` bench_trend columns.
+
+    Methodology caveat (the §25 framing, carried): forced-host "chips"
+    time-slice one CPU, so WALL-clock throughput is dispatch overhead,
+    not speedup — every gated number here is virtual-clock.  And the
+    round model prices a dense-prefill round and a chunk round
+    identically (dt each), so the colocated arm's chunked prefill is
+    charged only its ROUND COUNT — the TPOT stall dense prefill would
+    inflict on co-resident decodes is the reason colocated serving
+    chunks, but it is not itself priced by this clock."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.distributed.topology import build_mesh
+    from paddle_tpu.inference.paged import ServingEngine
+    from paddle_tpu.models.llama import LlamaConfig, build_functional_llama
+    from paddle_tpu.observability import Telemetry
+    from paddle_tpu.serving import (ReplicaFleet, VirtualClock,
+                                    make_scenario, replay_fleet)
+
+    devs = jax.devices()
+    if len(devs) < 4:
+        raise RuntimeError(
+            "disagg trace needs 4 devices (2 submeshes of mp=2) — CPU "
+            "hosts get them via the forced-host flag bench.py __main__ "
+            "sets for --trace disagg")
+    on_tpu = any(d.platform == "tpu" for d in devs)
+    dtype = jnp.bfloat16 if on_tpu else jnp.float32
+    cfg = LlamaConfig(vocab_size=512, hidden_size=128,
+                      intermediate_size=384, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=2,
+                      max_position_embeddings=512)
+    page_size, horizon, t_bucket = 16, 4, 32
+    dt = 0.5            # virtual seconds per fleet round
+    slo_v = 6.0         # virtual-seconds TTFT deadline
+    n_req = 24
+
+    # margin-engineered params (the TP/quant construction): greedy argmax
+    # stays far above psum reassociation noise, so bit-exactness measures
+    # the ENGINES, not the noise floor of near-uniform random logits
+    ep, bp, hp, *_ = build_functional_llama(cfg, dtype=dtype, n_micro=1,
+                                            key=jax.random.PRNGKey(7))
+    bp = {k: (v * 0.15 if k.startswith("w") else v) for k, v in bp.items()}
+    hp = dict(hp, lm=(ep["tok"].T * 4.0).astype(hp["lm"].dtype))
+    params = (ep, bp, hp)
+
+    # prefill-heavy: 40-88 token prompts, 8-13 new tokens — the workload
+    # disaggregation exists for (prefill rounds dominate a colocated
+    # slot's dwell time)
+    sc = make_scenario("disagg-prefill-heavy", seed=seed + 9,
+                       n_requests=n_req, vocab=cfg.vocab_size,
+                       arrival="poisson", mean_interarrival_s=0.8,
+                       prompt_len=(40, 88), max_new=(8, 13))
+    worst = (96 + 13 + horizon) // page_size + 2
+
+    def mk_engine(mesh, vc, slots, **kw):
+        return ServingEngine(params, cfg, num_slots=slots,
+                             page_size=page_size,
+                             num_pages=(slots + 2) * worst,
+                             max_pages_per_seq=worst, dtype=dtype,
+                             attention_impl="auto" if on_tpu else "ref",
+                             prompt_bucket=t_bucket, decode_horizon=horizon,
+                             mesh=mesh, telemetry=Telemetry(clock=vc), **kw)
+
+    # uninterrupted single-chip reference: the bit-equality bar for BOTH
+    # TP arms (a request's greedy continuation depends only on its prompt)
+    ref_eng = ServingEngine(params, cfg, num_slots=2, page_size=page_size,
+                            num_pages=4 * worst, max_pages_per_seq=worst,
+                            dtype=dtype,
+                            attention_impl="auto" if on_tpu else "ref",
+                            prompt_bucket=t_bucket, decode_horizon=horizon)
+    rids = [ref_eng.submit(r.prompt, max_new_tokens=r.max_new_tokens)
+            for r in sc.requests]
+    ref_done = ref_eng.run()
+    refs = {r.idx: list(ref_done[rid].generated)
+            for r, rid in zip(sc.requests, rids)}
+
+    def run_arm(label, *, roles):
+        vc = VirtualClock(dt)
+        if roles is None:
+            # colocated: interchangeable replicas, chips 0-1 and 2-3,
+            # chunked prefill (one page-sized chunk per round), 3 slots
+            # each — 6 slots / 4 chips total
+            nxt = itertools.cycle((devs[:2], devs[2:4]))
+
+            def factory(role="any"):
+                mesh = build_mesh({"mp": 2}, devices=next(nxt))
+                return mk_engine(mesh, vc, 3, prefill_chunk=page_size)
+            fleet = ReplicaFleet(factory, num_replicas=2, clock=vc)
+        else:
+            # disagg: prefill on chips 0-1 (2 slots, DENSE prefill),
+            # decode on chips 2-3 (4 slots) — 6 slots / 4 chips total
+            def factory(role="any"):
+                if role == "prefill":
+                    return mk_engine(build_mesh({"mp": 2},
+                                                devices=devs[:2]), vc, 2)
+                return mk_engine(build_mesh({"mp": 2},
+                                            devices=devs[2:4]), vc, 4)
+            fleet = ReplicaFleet(factory, num_replicas=2, roles=roles,
+                                 clock=vc)
+        res = replay_fleet(fleet, sc, slo_ttft_s=slo_v, virtual_clock=vc,
+                           collect_tokens=True)
+        lost = [rec["idx"] for rec in res["records"]
+                if rec["rejected"] or rec["tokens"] == 0]
+        assert not lost, f"{label}: lost/empty requests {lost}"
+        for rec in res["records"]:
+            assert rec["stream"] == refs[rec["idx"]], \
+                f"{label}: request {rec['idx']} diverged from the " \
+                f"uninterrupted single-chip reference"
+        ttfts = [rec["ttft_s"] for rec in res["records"]]
+        rep = res["report"]
+        section = {
+            "requests": n_req,
+            "on_time_requests": rep["on_time_requests"],
+            "goodput_fraction": rep["goodput_fraction"],
+            "ttft_p50_v_ms": round(float(np.percentile(ttfts, 50)) * 1e3, 1),
+            "ttft_p95_v_ms": round(float(np.percentile(ttfts, 95)) * 1e3, 1),
+            "window_v_s": round(res["window_s"], 2),
+            "replica_seconds_v": round(res["replica_seconds"], 2),
+            "migrations": fleet.stats()["migrations"],
+            "slo_report": rep,
+        }
+        return fleet, section
+
+    _, col = run_arm("colocated-tp", roles=None)
+    fleet_d, dis = run_arm("disagg", roles=["prefill", "decode"])
+
+    dst = fleet_d.stats()
+    assert dst["handoffs"] == n_req and dst["handoffs_pending"] == 0, \
+        f"disagg arm: {dst['handoffs']}/{n_req} handoffs " \
+        f"({dst['handoffs_pending']} pending)"
+    attr = fleet_d.attribution_report(top_k=4)
+    # the virtual clock's TTFT resolution is ONE ROUND (dt): dense
+    # prefill + first token land within the submit round, so the disagg
+    # arm's measured TTFT quantizes to 0.  The win ratio floors BOTH
+    # arms at one round — a conservative ratio, not a divide-by-zero win
+    q = dt * 1e3
+    win = round(max(col["ttft_p95_v_ms"], q) / max(dis["ttft_p95_v_ms"], q),
+                4)
+    kv = dict(dst["kv_transfer"])
+    kv_frac = attr["segments"].get("kv_transfer", {}).get("frac", 0.0)
+    return {
+        "trace": {"n_requests": n_req, "arrival": "poisson",
+                  "mean_interarrival_s": 0.8, "prompt_len": [40, 88],
+                  "max_new": [8, 13], "dt_round_s": dt,
+                  "slo_ttft_v_s": slo_v, "seed": int(seed),
+                  "scenario_signature": sc.signature()[:16],
+                  "clock": "round-driven virtual, shared by fleet AND "
+                           "replica telemetry (one clock domain; "
+                           "deterministic)"},
+        "chips": {"total": 4, "colocated": "2 replicas x mp=2",
+                  "disagg": "prefill mp=2 (chips 0-1) + decode mp=2 "
+                            "(chips 2-3)"},
+        "lost_requests": 0,           # asserted per arm above
+        "outputs_bitexact": True,     # asserted per arm above
+        "arms": {"colocated_tp": col, "disagg": dis},
+        "ttft": {"colocated_p95_v_ms": col["ttft_p95_v_ms"],
+                 "disagg_p95_v_ms": dis["ttft_p95_v_ms"],
+                 "colocated_p50_v_ms": col["ttft_p50_v_ms"],
+                 "disagg_p50_v_ms": dis["ttft_p50_v_ms"],
+                 "resolution_v_ms": q,
+                 "win_ratio": win,
+                 "note": "virtual TTFT quantizes to whole rounds; the "
+                         "ratio floors both arms at one round (dt)"},
+        "kv_transfer": {"handoffs": dst["handoffs"],
+                        "fallbacks": dst["handoff_fallbacks"],
+                        "pending": dst["handoffs_pending"], **kv,
+                        "kv_transfer_frac": kv_frac,
+                        "frac_note": "share of stitched virtual e2e "
+                                     "spent in the handoff gap (1 round "
+                                     "per handoff; compute spans are "
+                                     "zero-width on the round clock)"},
+        "roles": dst["roles"],
+        "attribution": {"requests": attr["requests"],
+                        "exact_requests": attr["exact_requests"],
+                        "segments": attr["segments"]},
+        # flat bench_trend columns (drift-checked once present)
+        "disagg_ttft_p95_ms": dis["ttft_p95_v_ms"],
+        "kv_transfer_frac": kv_frac,
         "host_cpu_count": os.cpu_count(),
     }
 
@@ -2402,6 +2632,11 @@ def main():
                         ("serving_failover", bench_serving_failover, 250),
                         ("serving_elastic", bench_serving_elastic, 250),
                         ("serving_quant", bench_serving_quant, 450))
+    if len(jax.devices()) >= 4:
+        # the disagg A/B needs 2 disjoint mp=2 submeshes; standalone runs
+        # get forced-host devices via --trace disagg, but main() takes
+        # whatever the host exposes
+        secondary += (("serving_disagg", bench_serving_disagg, 450),)
     import signal
 
     def _alarm(_sig, _frm):
@@ -2461,7 +2696,8 @@ if __name__ == "__main__":
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--trace",
                     choices=["shared-prefix", "serving", "spec-decode",
-                             "failover", "frontend", "elastic", "quant"],
+                             "failover", "frontend", "elastic", "quant",
+                             "disagg"],
                     default=None,
                     help="run ONE serving trace and print its JSON line "
                          "(shared-prefix: prefix-cache hit-rate / "
@@ -2482,7 +2718,12 @@ if __name__ == "__main__":
                          "— greedy exact-match parity vs f32, concurrent "
                          "users at fixed pool bytes, dequant-tax tokens/s "
                          "A/B, and the failover/elastic drills re-run "
-                         "with quantized pages)")
+                         "with quantized pages; "
+                         "disagg: disaggregated prefill/decode on "
+                         "disjoint mp=2 submeshes at a fixed 4 chips — "
+                         "prefill-heavy virtual-clock trace, rank-local "
+                         "KV page handoff, TTFT p95 win vs the "
+                         "colocated-TP fleet, bit-exactness asserted)")
     ap.add_argument("--json", metavar="PATH", default=None,
                     help="also dump the metrics dict to PATH as a JSON "
                          "artifact (BENCH_r0x-style)")
@@ -2525,14 +2766,19 @@ if __name__ == "__main__":
             ap.error("--tp applies to --trace serving only")
         if args.tp < 2:
             ap.error("--tp wants N >= 2 (N=1 is the single-chip engine)")
+    n_forced = args.tp if args.tp is not None \
+        else (4 if args.trace == "disagg" else None)
+    if n_forced is not None:
         # BEFORE any jax import: a CPU host needs N virtual devices for
-        # the mp mesh (inert on a real multi-chip host — the flag only
-        # affects the host platform)
+        # the mp mesh(es) (inert on a real multi-chip host — the flag
+        # only affects the host platform).  The disagg trace wants 4: two
+        # disjoint mp=2 submeshes.
         flags = os.environ.get("XLA_FLAGS", "")
         if "xla_force_host_platform_device_count" not in flags:
             os.environ["XLA_FLAGS"] = (
                 flags +
-                f" --xla_force_host_platform_device_count={args.tp}").strip()
+                f" --xla_force_host_platform_device_count={n_forced}"
+            ).strip()
     if args.trace is not None:
         _setup_compile_cache()
         fn = {"shared-prefix": bench_serving_shared_prefix,
@@ -2541,7 +2787,8 @@ if __name__ == "__main__":
               "failover": bench_serving_failover,
               "frontend": bench_serving_frontend,
               "elastic": bench_serving_elastic,
-              "quant": bench_serving_quant}[args.trace]
+              "quant": bench_serving_quant,
+              "disagg": bench_serving_disagg}[args.trace]
         if args.proc:
             fn = bench_serving_failover_proc
         kw = {}

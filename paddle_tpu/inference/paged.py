@@ -124,7 +124,7 @@ from ..resilience.faults import InjectedFault, fault_point
 
 __all__ = ["PagePool", "PrefixCache", "Request", "ServingEngine",
            "serve_requests", "PoolCapacityError", "AdmissionRejected",
-           "EngineStalledError", "PageDoubleFreeError"]
+           "EngineStalledError", "PageDoubleFreeError", "KVHandoffError"]
 
 
 class PoolCapacityError(ValueError):
@@ -144,6 +144,14 @@ class EngineStalledError(RuntimeError):
 class PageDoubleFreeError(RuntimeError):
     """free()/share() saw a page holding no reference (double free or
     foreign page), or the same page id twice within one free() batch."""
+
+
+class KVHandoffError(RuntimeError):
+    """An ``export_kv`` packet cannot splice into this engine: mismatched
+    page geometry, KV dtype, or tensor-parallel degree.  The caller's
+    fallback is re-prefill (``adopt``), which walks the normal admission
+    ladder and requantizes/reshards for THIS engine — greedy outputs stay
+    bit-exact either way."""
 
 
 class PagePool:
@@ -1031,6 +1039,10 @@ class ServingEngine:
         self.quiesces = 0              # pipeline drains forced by a
                                        #   host-exactness point (snapshot/
                                        #   cancel/deadline/ladder/verify)
+        self.kv_exports = 0            # export_kv packets produced
+        self.kv_imports = 0            # import_kv packets spliced in
+        self.kv_pages_exported = 0     # pages shipped in those packets
+        self.kv_pages_imported = 0
         _LIVE_ENGINES.add(self)
 
     # -- submission --------------------------------------------------------
@@ -2568,7 +2580,9 @@ class ServingEngine:
                       "cache_hit_tokens", "prefill_tokens",
                       "cache_evictions", "cow_copies", "verify_steps",
                       "draft_tokens_proposed", "draft_tokens_accepted",
-                      "overlap_steps", "quiesces", "fused_sample_steps")
+                      "overlap_steps", "quiesces", "fused_sample_steps",
+                      "kv_exports", "kv_imports", "kv_pages_exported",
+                      "kv_pages_imported")
 
     def snapshot(self, mode: str = "full_kv",
                  include_finished: bool = True) -> dict:
@@ -2659,25 +2673,229 @@ class ServingEngine:
                 meta["cache"] = None
             ids = sorted(self.pool._refs)
             state["kv_pages"] = np.asarray(ids, np.int32)
-            # the page axis is axis 2 of [L, Hkv, NP+1, ps, D]; only pages
-            # holding a reference carry information (free pages are dead
-            # state, the trash page is garbage by contract).  Gather ON
-            # DEVICE first so the host transfer (snapshot IS a sync point)
-            # is proportional to live context, not pool capacity.
-            idx = self._jnp.asarray(ids, self._jnp.int32)
-            if self.kv_dtype is not None:
-                # quantized store: the data pages AND their per-row scales
-                # ship together — a full-KV restore that lost the scales
-                # would scatter back garbage magnitudes
-                state["kv_k_q"] = np.asarray(self._pages_k["q"][:, :, idx])
-                state["kv_k_s"] = np.asarray(self._pages_k["s"][:, :, idx])
-                state["kv_v_q"] = np.asarray(self._pages_v["q"][:, :, idx])
-                state["kv_v_s"] = np.asarray(self._pages_v["s"][:, :, idx])
-            else:
-                state["kv_k"] = np.asarray(self._pages_k[:, :, idx])
-                state["kv_v"] = np.asarray(self._pages_v[:, :, idx])
+            state.update(self._gather_pages(ids))
         state["meta"] = json.dumps(meta)
         return state
+
+    def _gather_pages(self, ids) -> dict:
+        """Pull pages `ids` to the host as named planes — the read half of
+        the full-KV transfer primitive snapshot() and export_kv() share.
+        The page axis is axis 2 of [L, Hkv, NP+1, ps, D] (the
+        models/llama.gather_kv_pages contract); only pages holding a
+        reference carry information (free pages are dead state, the trash
+        page is garbage by contract).  Gather ON DEVICE first so the host
+        transfer (both callers stand at a quiesced sync point) is
+        proportional to live context, not pool capacity.  A quantized
+        store ships data pages AND their per-row scales together — a
+        splice that lost the scales would write back garbage magnitudes."""
+        from ..models.llama import gather_kv_pages
+        idx = self._jnp.asarray(np.asarray(ids, np.int32))
+        gk = gather_kv_pages(self._pages_k, idx)
+        gv = gather_kv_pages(self._pages_v, idx)
+        if self.kv_dtype is not None:
+            return {"kv_k_q": np.asarray(gk["q"]), "kv_k_s": np.asarray(gk["s"]),
+                    "kv_v_q": np.asarray(gv["q"]), "kv_v_s": np.asarray(gv["s"])}
+        return {"kv_k": np.asarray(gk), "kv_v": np.asarray(gv)}
+
+    def _scatter_pages(self, ids, planes: dict):
+        """Splice host planes (a `_gather_pages` result, same page order)
+        into this engine's store at page ids `ids` — the write half of the
+        transfer primitive `_restore_full` and `import_kv` share."""
+        from ..models.llama import scatter_kv_pages
+        idx = self._jnp.asarray(np.asarray(ids, np.int32))
+        if self.kv_dtype is not None:
+            self._pages_k = scatter_kv_pages(
+                self._pages_k, idx,
+                {"q": planes["kv_k_q"], "s": planes["kv_k_s"]})
+            self._pages_v = scatter_kv_pages(
+                self._pages_v, idx,
+                {"q": planes["kv_v_q"], "s": planes["kv_v_s"]})
+        else:
+            self._pages_k = scatter_kv_pages(self._pages_k, idx,
+                                             planes["kv_k"])
+            self._pages_v = scatter_kv_pages(self._pages_v, idx,
+                                             planes["kv_v"])
+
+    # -- KV handoff (disaggregated prefill/decode) -------------------------
+    KV_HANDOFF_VERSION = 1
+
+    def handoff_ready(self, rid: int) -> bool:
+        """True when `rid` rides a slot whose prefill is COMPLETE (dense,
+        or every chunk executed) — the state a prefill-role replica hands
+        to a decode replica.  First token is already banked (TTFT charged
+        to the prefill engine); mid-chunked-prefill slots keep prefilling
+        here.  Cheap host predicate — no quiesce, no device access."""
+        for slot in self._slots:
+            if slot is not None and slot.req.rid == rid:
+                return (slot.prefill_pos is None and slot.ctx is None
+                        and len(slot.req.generated) > 0)
+        return False
+
+    def export_kv(self, rids) -> dict:
+        """Serialize the in-flight state of `rids` (slot-resident requests)
+        plus exactly the KV pages their page tables reference, as one
+        handoff packet for :meth:`import_kv` on another engine — the
+        full-KV gather :meth:`snapshot` uses, scoped to a request subset.
+
+        READ-ONLY on this engine: the caller decides when (whether) to
+        `cancel` the source requests — cancelling parks their written KV
+        in this engine's prefix cache, so a fallback re-prefill can still
+        hit.  Raises KeyError for a rid not currently riding a slot
+        (queued, finished, or unknown — nothing to hand off)."""
+        # exact host state: drain the double-buffered pipeline first (the
+        # drain itself may RETIRE a rid — the KeyError below reports it)
+        self.quiesce()
+        by_rid = {slot.req.rid: (s, slot)
+                  for s, slot in enumerate(self._slots) if slot is not None}
+        entries = []
+        for rid in rids:
+            if rid not in by_rid:
+                raise KeyError(
+                    f"export_kv: rid {rid} holds no slot (queued, finished "
+                    "or unknown) — nothing to hand off")
+            s, slot = by_rid[rid]
+            entries.append({
+                "req": self._req_state(slot.req),
+                "pages": [int(p) for p in slot.pages],
+                "pending": int(slot.pending),
+                "prefill_pos": None if slot.prefill_pos is None
+                else int(slot.prefill_pos),
+                "ctx": None if slot.ctx is None
+                else np.asarray(slot.ctx).tolist(),
+                "resuming": bool(slot.resuming),
+                "chunk_step": int(slot.chunk_step),
+                "length": int(self._lengths[s]),
+            })
+        ids = sorted({p for e in entries for p in e["pages"]})
+        planes = self._gather_pages(ids)
+        packet = {
+            "version": self.KV_HANDOFF_VERSION,
+            "page_size": self.page_size,
+            "kv_dtype": self.kv_dtype,
+            "tp": self.tp,
+            "kv_pages": [int(p) for p in ids],
+            "planes": planes,
+            "requests": entries,
+            "bytes": int(sum(np.asarray(v).nbytes for v in planes.values())),
+        }
+        self.kv_exports += 1
+        self.kv_pages_exported += len(ids)
+        return packet
+
+    def import_kv(self, packet: dict) -> dict:
+        """Splice an :meth:`export_kv` packet into this RUNNING engine:
+        allocate fresh pages, scatter the shipped planes into them, remap
+        every request's page table onto the new ids, and seat the requests
+        in free slots to continue decoding from exactly where the source
+        engine stood — zero re-prefill, greedy bit-exact.
+
+        Raises :class:`KVHandoffError` when the packet can NEVER splice
+        here (page geometry / kv_dtype / tensor-parallel degree mismatch:
+        head-sharded planes land rank-local only at EQUAL mp degree — the
+        caller's fallback is re-prefill via `adopt`), and
+        :class:`AdmissionRejected` for transient pressure (no free slot /
+        no free pages even after the cache-eviction rung) — the ladder
+        order of :meth:`_admit` is preserved.  Returns {source rid: rid
+        minted here}."""
+        if packet.get("version") != self.KV_HANDOFF_VERSION:
+            raise KVHandoffError(
+                f"kv handoff version {packet.get('version')!r} != "
+                f"{self.KV_HANDOFF_VERSION}")
+        if packet["page_size"] != self.page_size:
+            raise KVHandoffError(
+                f"page_size {packet['page_size']} != {self.page_size}: "
+                "shipped pages cannot re-block without a device pass")
+        if packet["kv_dtype"] != self.kv_dtype:
+            raise KVHandoffError(
+                f"kv_dtype {packet['kv_dtype']!r} != {self.kv_dtype!r}: "
+                "stored codes/scales are the source dtype's — re-prefill "
+                "requantizes for this store")
+        if packet["tp"] != self.tp:
+            raise KVHandoffError(
+                f"mp degree {packet['tp']} != {self.tp}: head-sharded "
+                "planes are rank-local only at equal mp degree — "
+                "re-prefill (adopt) reshards for this submesh")
+        entries = packet["requests"]
+        if any(len(e["pages"]) > self.max_pages_per_seq for e in entries):
+            raise KVHandoffError(
+                "request page table exceeds this engine's "
+                f"max_pages_per_seq={self.max_pages_per_seq}")
+        # splice at an exact step boundary of THIS engine
+        self.quiesce()
+        free_slots = [i for i, sl in enumerate(self._slots) if sl is None]
+        if len(entries) > len(free_slots):
+            raise AdmissionRejected(
+                f"import_kv: {len(entries)} requests > {len(free_slots)} "
+                "free slots")
+        old_ids = [int(p) for p in packet["kv_pages"]]
+        n = len(old_ids)
+        if n > self._avail():
+            # ladder: evict unreferenced cached pages before giving up
+            self._evict(n - self._avail())
+        if n > self._avail():
+            raise AdmissionRejected(
+                f"import_kv: need {n} pages, {self._avail()} free after "
+                "eviction")
+        new_ids = self.pool.alloc(n)
+        remap = dict(zip(old_ids, new_ids))
+        self._scatter_pages(new_ids, packet["planes"])
+        # extra references for pages shared by several shipped tables
+        # (handed-off requests that shared a cached prefix on the source)
+        nrefs: dict[int, int] = {}
+        for e in entries:
+            for p in e["pages"]:
+                nrefs[p] = nrefs.get(p, 0) + 1
+        extra = [remap[p] for p, c in nrefs.items() for _ in range(c - 1)]
+        if extra:
+            self.pool.share(extra)
+        mapping: dict[int, int] = {}
+        now = self._clock()
+        for e, s in zip(entries, free_slots):
+            d = dict(e["req"])
+            src_rid = int(d["rid"])
+            d["rid"] = self._next_rid
+            self._next_rid += 1
+            req = self._req_from_state(d)
+            mapping[src_rid] = req.rid
+            pages = [remap[p] for p in e["pages"]]
+            slot = _Slot(req, pages, int(e["pending"]),
+                         admit_seq=self._admit_seq)
+            self._admit_seq += 1
+            slot.prefill_pos = e["prefill_pos"]
+            slot.ctx = None if e["ctx"] is None \
+                else np.asarray(e["ctx"], np.int32)
+            slot.resuming = bool(e["resuming"])
+            slot.chunk_step = int(e["chunk_step"])
+            if self.speculative and req.temperature <= 0.0:
+                # drafting is THIS engine's capability (verify executables
+                # compile per engine K): rebuild the pure-function n-gram
+                # index from the shipped token stream
+                slot.spec_k = self.speculative
+                slot.draft = _NgramDraft(
+                    np.concatenate([req.prompt,
+                                    np.asarray(req.generated, np.int32)]),
+                    max_n=self.spec_max_ngram)
+            self._slots[s] = slot
+            row = np.zeros((self.max_pages_per_seq,), np.int32)
+            row[:len(pages)] = pages
+            self._page_tables[s] = row
+            self._lengths[s] = int(e["length"])
+            self._temps[s] = req.temperature
+            self._top_ps[s] = req.top_p
+            if self.telemetry is not None:
+                # stitched-trace continuity (the restore convention): the
+                # handed-off request opens a track on THIS engine's tracer
+                # whose first event carries handoff=True — the attribution
+                # gap classifier turns the inter-engine gap into a
+                # `kv_transfer` segment
+                attrs = {"handoff": True}
+                if req.trace_id is not None:
+                    attrs["trace_id"] = req.trace_id
+                self.telemetry.request_event(req.rid, "submitted", t=now,
+                                             **attrs)
+        self.kv_imports += 1
+        self.kv_pages_imported += n
+        return mapping
 
     def restore(self, state: dict) -> str:
         """Load a :meth:`snapshot` state dict into this FRESH engine
@@ -2752,26 +2970,13 @@ class ServingEngine:
         return applied
 
     def _restore_full(self, meta, state, reqs):
-        jnp = self._jnp
         self._step_seq = int(meta["step_seq"])
         pool = self.pool
         pool._free = [int(p) for p in meta["pool"]["free"]]
         pool._refs = {int(p): int(c) for p, c in meta["pool"]["refs"]}
         ids = np.asarray(state["kv_pages"], np.int32)
         if len(ids):
-            if self.kv_dtype is not None:
-                def put(store, qkey, skey):
-                    return {"q": store["q"].at[:, :, ids].set(
-                                jnp.asarray(state[qkey], store["q"].dtype)),
-                            "s": store["s"].at[:, :, ids].set(
-                                jnp.asarray(state[skey], store["s"].dtype))}
-                self._pages_k = put(self._pages_k, "kv_k_q", "kv_k_s")
-                self._pages_v = put(self._pages_v, "kv_v_q", "kv_v_s")
-            else:
-                self._pages_k = self._pages_k.at[:, :, ids].set(
-                    jnp.asarray(state["kv_k"], self._pages_k.dtype))
-                self._pages_v = self._pages_v.at[:, :, ids].set(
-                    jnp.asarray(state["kv_v"], self._pages_v.dtype))
+            self._scatter_pages(ids, state)
         for s, sd in enumerate(meta["slots"]):
             if sd is None:
                 continue
@@ -2871,6 +3076,12 @@ class ServingEngine:
             # forced pipeline drains (exactness points)
             "overlap_steps": self.overlap_steps,
             "quiesces": self.quiesces,
+            # disaggregated prefill/decode: export_kv/import_kv traffic
+            # through this engine (pages = post-dedup shipped page count)
+            "kv_exports": self.kv_exports,
+            "kv_imports": self.kv_imports,
+            "kv_pages_exported": self.kv_pages_exported,
+            "kv_pages_imported": self.kv_pages_imported,
             # tensor-parallel serving: mesh degree over mp (1 = single
             # chip) and whether the per-layer AllReduce rides the EQuARX
             # int8 grid (distributed/quant_collectives)

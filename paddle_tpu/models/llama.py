@@ -29,7 +29,8 @@ __all__ = ["LlamaConfig", "LlamaForCausalLM", "LlamaModel", "LlamaDecoderLayer",
            "build_functional_llama", "llama_microbatch_fns", "llama_block_specs",
            "llama_config_7b", "llama_config_tiny", "build_llama_decode",
            "build_llama_paged_decode", "make_paged_decode_horizon",
-           "functional_params_from_layer", "llama_generate"]
+           "functional_params_from_layer", "llama_generate",
+           "gather_kv_pages", "scatter_kv_pages"]
 
 
 @dataclass
@@ -690,6 +691,35 @@ def llama_paged_page_spec(mp_axis: str = "mp"):
     ``{"q","s"}`` page stores — every leaf shards the same axis."""
     from jax.sharding import PartitionSpec as P
     return P(None, mp_axis)
+
+
+def gather_kv_pages(store, idx):
+    """Gather pages ``idx`` from one side of the paged-KV store (raw array
+    or quantized ``{"q","s"}`` dict alike).  The page axis is AXIS 2 of the
+    ``[L, Hkv, NP+1, ps, D]`` data planes and ``[L, Hkv, NP+1, ps]`` scale
+    planes — this function is the one place that contract lives for
+    transfers (snapshot, restore, and the disaggregated prefill->decode
+    handoff all ride it).  The KV-head axis (dim 1) is what
+    ``llama_paged_page_spec`` shards over ``mp``, and a page gather never
+    touches it: at equal ``mp`` degree the gathered planes land rank-local
+    on the destination submesh with no re-sharding.  Returns planes in
+    ``idx`` order."""
+    if isinstance(store, dict):
+        return {k: v[:, :, idx] for k, v in store.items()}
+    return store[:, :, idx]
+
+
+def scatter_kv_pages(store, ids, planes):
+    """Splice ``planes`` (a :func:`gather_kv_pages` result, same page
+    order) into the store at page ids ``ids`` — the inverse transfer used
+    by full-KV restore and by ``import_kv`` on a foreign engine.  A
+    quantized store splices data AND scale planes together: int8/fp8 codes
+    without their per-row scales are garbage magnitudes."""
+    if isinstance(store, dict):
+        return {k: store[k].at[:, :, ids].set(
+                    jnp.asarray(planes[k], store[k].dtype))
+                for k in store}
+    return store.at[:, :, ids].set(jnp.asarray(planes, store.dtype))
 
 
 def build_llama_paged_decode(config: LlamaConfig, page_size: int = 16,

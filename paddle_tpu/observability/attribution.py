@@ -73,7 +73,7 @@ _SPAN_KIND = {
 
 SEGMENT_KINDS = ("queue", "admission", "prefill_dense", "prefill_chunk",
                  "decode_dispatch", "decode_sync", "decode_record", "verify",
-                 "migration", "snapshot_restore", "host_other")
+                 "migration", "snapshot_restore", "kv_transfer", "host_other")
 
 
 class CriticalPath:
@@ -313,8 +313,10 @@ def attribute_stitched(components, trace_id: int) -> CriticalPath | None:
     component to its LAST.  Engine residencies attribute locally (the
     component's own phase spans); the gap before the first residency is
     ``queue`` (router/frontend placement), a gap BETWEEN residencies is
-    ``snapshot_restore`` when the successor record was re-recorded by
-    ``ServingEngine.restore()`` (``restored=True``) and ``migration``
+    ``kv_transfer`` when the successor record was opened by
+    ``ServingEngine.import_kv()`` (``handoff=True`` — the disaggregated
+    prefill->decode page transfer), ``snapshot_restore`` when re-recorded
+    by ``ServingEngine.restore()`` (``restored=True``), and ``migration``
     otherwise (adopt / re-prefill placement), and the tail after the last
     residency (the router heartbeat observing the retirement) is
     ``host_other``.  Returns None when no component saw the trace_id."""
@@ -331,6 +333,7 @@ def attribute_stitched(components, trace_id: int) -> CriticalPath | None:
                 "t0": tr.events[0][1], "t1": tr.events[-1][1],
                 "engine": is_engine,
                 "restored": bool((tr.events[0][2] or {}).get("restored")),
+                "handoff": bool((tr.events[0][2] or {}).get("handoff")),
             })
     if not touches:
         return None
@@ -362,6 +365,8 @@ def attribute_stitched(components, trace_id: int) -> CriticalPath | None:
         if w_lo > cursor:
             if i == 0:
                 kind = "queue"
+            elif tc["handoff"]:
+                kind = "kv_transfer"
             else:
                 kind = "snapshot_restore" if tc["restored"] else "migration"
             segments.append((kind, cursor, w_lo, "fleet"))

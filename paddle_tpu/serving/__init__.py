@@ -38,6 +38,16 @@ replica-fleet router, and the async front end + traffic harness.
   (:class:`AutoscalePolicy` GROW on sustained queue growth / SLO burn,
   SHRINK on sustained idle) with zero-loss, greedy-bit-exact drain
   through the live-migration path.
+* Disaggregated prefill/decode (ISSUE 19): ``ReplicaFleet(roles=
+  ["prefill", "decode", ...])`` splits the fleet into prefill replicas
+  (dense/chunked prefill + first token on their own TP submesh) and
+  decode replicas that receive the head-sharded KV pages via
+  ``ServingEngine.export_kv``/``import_kv`` — rank-local at equal ``mp``
+  degree, scale planes included, with re-prefill fallback on any
+  geometry mismatch (:class:`~paddle_tpu.inference.paged.KVHandoffError`)
+  and the transfer itself visible as the ``kv_transfer`` attribution
+  segment plus fleet counters/histograms.  ``ElasticFleet(role_policies=
+  {"prefill": ..., "decode": ...})`` scales each role independently.
 * :mod:`.rpc` + :mod:`.worker` + :mod:`.procfleet` — the cross-process
   fleet (ISSUE 17): replicas as real worker processes behind a
   length-prefixed loopback wire (deadline-per-call timeouts,
@@ -46,6 +56,7 @@ replica-fleet router, and the async front end + traffic harness.
   ``SIGKILL``/``SIGSTOP`` — same zero-loss, greedy-bit-exact recovery
   bar, now across an actual process boundary.
 """
+from ..inference.paged import KVHandoffError
 from .autoscale import AutoscaleDecision, AutoscalePolicy, ElasticFleet
 from .quant import (dequantize_kv, kv_spec, page_bytes, parity_report,
                     parity_scenarios, quantize_kv, quantize_params)
@@ -73,4 +84,4 @@ __all__ = ["ReplicaFleet", "FleetFailedError", "EngineSnapshotManager",
            "dequantize_kv", "kv_spec", "page_bytes", "quantize_params",
            "parity_report", "parity_scenarios", "ProcessFleet",
            "WorkerDiedError", "RpcClient", "RpcServer", "RpcError",
-           "RpcTimeout", "RpcRemoteError"]
+           "RpcTimeout", "RpcRemoteError", "KVHandoffError"]

@@ -130,10 +130,10 @@ class AutoscalePolicy:
         if self.max_replicas < self.min_replicas:
             raise ValueError("max_replicas must be >= min_replicas")
 
-    def build_rules(self, fleet: "ElasticFleet") -> list:
+    def build_rules(self, fleet: "ElasticFleet", role=None) -> list:
         rules = autoscale_rules(
-            depth_fn=lambda ctx: fleet.queue_pressure(),
-            load_fn=lambda ctx: fleet.load_per_replica(),
+            depth_fn=lambda ctx: fleet.queue_pressure(role),
+            load_fn=lambda ctx: fleet.load_per_replica(role),
             queue_growth=self.queue_growth,
             queue_min_depth=self.queue_min_depth,
             growth_window_s=self.growth_window_s,
@@ -161,15 +161,18 @@ class AutoscalePolicy:
         return rules
 
     def decide(self, sentinel: HealthSentinel, fleet: "ElasticFleet",
-               now: float, last_action_t: float) -> AutoscaleDecision:
+               now: float, last_action_t: float,
+               role=None) -> AutoscaleDecision:
         """Map active alerts to a capacity direction.  GROW wins over
         SHRINK (pressure evidence beats idleness evidence — both can be
         momentarily active around a load edge), and every action honors
-        the shared cooldown."""
+        the shared cooldown.  With ``role``, every reading is scoped to
+        that role's slice of the fleet (a disaggregated fleet scales
+        prefill and decode capacity independently)."""
         if now < last_action_t + self.scale_cooldown_s:
             return AutoscaleDecision.HOLD
         active = {a.rule for a in sentinel.active()}
-        routable = fleet.routable_replicas()
+        routable = fleet.routable_replicas(role)
         if "queue_growth" in active or "ttft_slo_burn" in active:
             # a live pressure signal NEVER shrinks — even at max
             # capacity (where growing is impossible) an also-active idle
@@ -194,50 +197,104 @@ class ElasticFleet(ReplicaFleet):
     (the drain path IS the PR 9 migration path)."""
 
     def __init__(self, engine_factory, *, policy: AutoscalePolicy | None = None,
+                 role_policies: dict | None = None,
                  sentinel_clock=None, **kw):
         if "num_replicas" in kw:
             raise TypeError("ElasticFleet sizes itself — set "
                             "policy.min_replicas/max_replicas instead of "
                             "num_replicas")
-        self.policy = policy if policy is not None else AutoscalePolicy()
-        super().__init__(engine_factory,
-                         num_replicas=self.policy.min_replicas, **kw)
+        if role_policies:
+            # disaggregated elastic (ISSUE 19): one AutoscalePolicy PER
+            # ROLE, each with its own sentinel, readings, and cooldown —
+            # a prefill burst grows prefill capacity without touching
+            # the decode pool, and vice versa
+            if policy is not None:
+                raise TypeError("pass either policy= (role-less) or "
+                                "role_policies= (disaggregated), not both")
+            if "roles" in kw:
+                raise TypeError("role_policies owns the role layout — "
+                                "do not also pass roles=")
+            bad = sorted(set(map(str, role_policies))
+                         - {"any", "prefill", "decode"})
+            if bad:
+                raise ValueError(f"unknown roles in role_policies: {bad}")
+            self.policy = None
+            self.role_policies = {str(r): p
+                                  for r, p in role_policies.items()}
+            roles = [r for r in sorted(self.role_policies)
+                     for _ in range(self.role_policies[r].min_replicas)]
+            super().__init__(engine_factory, num_replicas=len(roles),
+                             roles=roles, **kw)
+        else:
+            self.policy = policy if policy is not None else AutoscalePolicy()
+            self.role_policies = None
+            super().__init__(engine_factory,
+                             num_replicas=self.policy.min_replicas, **kw)
         self._vclock = 0.0
         self._sentinel_clock = sentinel_clock
-        self.sentinel = HealthSentinel(
-            rules=self.policy.build_rules(self),
-            clock=(sentinel_clock if sentinel_clock is not None
-                   else (lambda: self._vclock)))
+        clock = (sentinel_clock if sentinel_clock is not None
+                 else (lambda: self._vclock))
+        if self.role_policies is not None:
+            self.sentinel = None
+            self.sentinels = {
+                role: HealthSentinel(rules=pol.build_rules(self, role=role),
+                                     clock=clock)
+                for role, pol in sorted(self.role_policies.items())}
+            self._last_scale_by_role = {r: float("-inf")
+                                        for r in self.role_policies}
+        else:
+            self.sentinel = HealthSentinel(
+                rules=self.policy.build_rules(self), clock=clock)
+            self.sentinels = {None: self.sentinel}
         self._last_scale_t = float("-inf")
         self.scale_events: list[dict] = []
 
     # -- the policy's fleet readings ---------------------------------------
-    def routable_replicas(self) -> int:
-        return sum(1 for rep in self._alive() if rep.routable)
+    def _role_replicas(self, role=None):
+        return [rep for rep in self._alive()
+                if rep.routable and (role is None or rep.role == role)]
 
-    def queue_pressure(self) -> int:
+    def routable_replicas(self, role=None) -> int:
+        return len(self._role_replicas(role))
+
+    def queue_pressure(self, role=None) -> int:
         """Fleet-wide queued work: the fleet queue plus every routable
         replica's engine-side admission queue (work that has a home but
-        no slot yet)."""
-        n = len(self._waiting)
-        for rep in self._alive():
-            if rep.routable:
-                n += len(rep.engine._queue)
+        no slot yet).  Role-scoped readings split it by who would absorb
+        the work: fresh admissions always prefill, so the fleet queue is
+        PREFILL pressure; exported-but-unplaced KV packets are DECODE
+        pressure."""
+        n = 0
+        if role is None or role in ("prefill", "any"):
+            n += len(self._waiting)
+        if role is None or role in ("decode", "any"):
+            n += len(self._pending_handoffs)
+        for rep in self._role_replicas(role):
+            n += len(rep.engine._queue)
         return n
 
-    def load_per_replica(self) -> float | None:
+    def load_per_replica(self, role=None) -> float | None:
         """Mean (active + queued) requests per routable replica — the
         idle detector's reading."""
-        routable = [rep for rep in self._alive() if rep.routable]
+        routable = self._role_replicas(role)
         if not routable:
             return None
-        load = len(self._waiting) + sum(rep.load() for rep in routable)
+        load = sum(rep.load() for rep in routable)
+        if role is None or role in ("prefill", "any"):
+            load += len(self._waiting)
+        if role is None or role in ("decode", "any"):
+            load += len(self._pending_handoffs)
         return load / len(routable)
 
     # -- the loop ----------------------------------------------------------
+    def _dt_per_round(self) -> float:
+        if self.role_policies is not None:
+            return next(iter(self.role_policies.values())).dt_per_round
+        return self.policy.dt_per_round
+
     def step(self) -> bool:
         progressed = super().step()
-        self._vclock = self._round * self.policy.dt_per_round
+        self._vclock = self._round * self._dt_per_round()
         self._autoscale()
         return progressed
 
@@ -247,34 +304,83 @@ class ElasticFleet(ReplicaFleet):
 
     def _autoscale(self):
         now = self._sentinel_now()
-        self.sentinel.evaluate(telemetry=None, now=now)
-        decision = self.policy.decide(self.sentinel, self, now,
-                                      self._last_scale_t)
+        if self.role_policies is None:
+            self.sentinel.evaluate(telemetry=None, now=now)
+            decision = self.policy.decide(self.sentinel, self, now,
+                                          self._last_scale_t)
+            self._act(decision, now, role=None, policy=self.policy,
+                      sentinel=self.sentinel)
+            return
+        # disaggregated: each role runs its own sentinel + cooldown —
+        # deterministic role order so a seeded trace replays identically
+        for role in sorted(self.role_policies):
+            pol = self.role_policies[role]
+            sen = self.sentinels[role]
+            sen.evaluate(telemetry=None, now=now)
+            decision = pol.decide(sen, self, now,
+                                  self._last_scale_by_role[role],
+                                  role=role)
+            self._act(decision, now, role=role, policy=pol, sentinel=sen)
+
+    def _act(self, decision: AutoscaleDecision, now: float, *, role,
+             policy: AutoscalePolicy, sentinel: HealthSentinel):
         if decision is AutoscaleDecision.GROW:
-            name = self.add_replica()
-            self._record_scale("scale_up", name, now)
+            name = self.add_replica(role if role is not None else "any")
+            self._record_scale("scale_up", name, now, role=role,
+                               sentinel=sentinel)
         elif decision is AutoscaleDecision.SHRINK:
-            # drain the idlest routable replica (fewest active+queued;
-            # deterministic name tie-break) — never below min_replicas,
-            # and retire_replica itself refuses the last live one
-            routable = [rep for rep in self._alive() if rep.routable]
+            # drain the idlest routable replica OF THIS ROLE (fewest
+            # active+queued; deterministic name tie-break) — never below
+            # the role policy's min_replicas, and retire_replica itself
+            # refuses the last live one
+            routable = self._role_replicas(role)
+            if not routable:
+                return
             victim = min(routable,
                          key=lambda rep: (rep.load(), rep.name))
             if self.retire_replica(victim.name):
-                self._record_scale("scale_down", victim.name, now)
+                self._record_scale("scale_down", victim.name, now,
+                                   role=role, sentinel=sentinel)
 
-    def _record_scale(self, action: str, replica: str, now: float):
+    def _record_scale(self, action: str, replica: str, now: float, *,
+                      role=None, sentinel: HealthSentinel):
         self._last_scale_t = now
-        self.scale_events.append({
+        if role is not None:
+            self._last_scale_by_role[role] = now
+        ev = {
             "action": action, "replica": replica, "round": self._round,
             "t": round(now, 4),
             "replicas_alive": len(self._alive()),
-            "active_alerts": sorted(a.rule for a in self.sentinel.active()),
-        })
+            "active_alerts": sorted(a.rule for a in sentinel.active()),
+        }
+        if role is not None:
+            ev["role"] = role
+        self.scale_events.append(ev)
 
     # -- readouts ----------------------------------------------------------
     def stats(self) -> dict:
         out = super().stats()
+        if self.role_policies is not None:
+            out["autoscale"] = {
+                "scale_events": len(self.scale_events),
+                "peak_replicas": max(
+                    [e["replicas_alive"] for e in self.scale_events],
+                    default=len(self._alive())),
+                "per_role": {
+                    role: {
+                        "min_replicas": pol.min_replicas,
+                        "max_replicas": pol.max_replicas,
+                        "routable": self.routable_replicas(role),
+                        "scale_events": sum(
+                            1 for e in self.scale_events
+                            if e.get("role") == role),
+                        "rule_fires": {
+                            rule.name:
+                                self.sentinels[role]._states[rule.name].fires
+                            for rule in self.sentinels[role].rules},
+                    } for role, pol in sorted(self.role_policies.items())},
+            }
+            return out
         out["autoscale"] = {
             "min_replicas": self.policy.min_replicas,
             "max_replicas": self.policy.max_replicas,

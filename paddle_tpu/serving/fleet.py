@@ -43,7 +43,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from ..inference.paged import (AdmissionRejected, EngineStalledError,
-                               Request, ServingEngine)
+                               KVHandoffError, Request, ServingEngine)
 from ..observability.distributed import (FleetTelemetry, TraceStitcher,
                                          new_trace_id)
 from ..observability.flight import FlightRecorder
@@ -88,6 +88,10 @@ class _FleetRequest:
     retries: int = 0
     next_try_round: int = 0
     migrations: int = 0
+    no_handoff: bool = False       # set after a handoff fallback so the
+                                   #   request finishes wherever it lands
+                                   #   instead of ping-ponging export /
+                                   #   re-prefill forever
     trace_id: int | None = None    # fleet-wide stitching id; threaded into
                                    #   every engine-side adopt() so one
                                    #   Perfetto view binds the request's
@@ -103,9 +107,9 @@ class _FleetRequest:
 
 class _Replica:
     __slots__ = ("name", "engine", "alive", "routable", "stall", "failures",
-                 "snapshots")
+                 "snapshots", "role")
 
-    def __init__(self, name, engine, snapshots):
+    def __init__(self, name, engine, snapshots, role="any"):
         self.name = name
         self.engine = engine
         self.alive = True
@@ -113,6 +117,9 @@ class _Replica:
         self.stall = 0            # consecutive no-progress steps w/ work
         self.failures = 0         # failovers consumed
         self.snapshots = snapshots
+        self.role = role          # "any" | "prefill" | "decode" — sticky
+                                  #   across failover revival (the replica
+                                  #   is the same submesh either way)
 
     def load(self) -> int:
         """Active + queued requests — THE per-replica load notion,
@@ -152,6 +159,8 @@ class ReplicaFleet:
     greedy-bit-exact, just a cold KV start for the migrated requests."""
 
     def __init__(self, engine_factory, num_replicas: int = 2, *,
+                 roles=None,
+                 handoff_retry_rounds: int = 8,
                  router: Router | None = None,
                  snapshot_root: str | None = None,
                  snapshot_every: int | None = None,
@@ -167,7 +176,44 @@ class ReplicaFleet:
                  route_dump_last: int = 16):
         if num_replicas < 1:
             raise ValueError("num_replicas must be >= 1")
+        # disaggregated prefill/decode (ISSUE 19): one role per replica.
+        # "prefill" replicas run prefill + the first token, then hand
+        # their head-sharded KV pages to a "decode"/"any" replica;
+        # "any" replicas (the default) behave exactly like the colocated
+        # fleets of PR 9-18 — no roles, no handoffs, no new behavior.
+        if roles is None:
+            roles = ["any"] * int(num_replicas)
+        else:
+            roles = [str(r) for r in roles]
+            if len(roles) != int(num_replicas):
+                raise ValueError(
+                    f"roles needs one entry per replica: got {len(roles)} "
+                    f"for num_replicas={num_replicas}")
+            bad = sorted(set(roles) - {"any", "prefill", "decode"})
+            if bad:
+                raise ValueError(f"unknown replica roles {bad} "
+                                 f"(valid: any/prefill/decode)")
+            if "prefill" in roles \
+                    and not any(r in ("decode", "any") for r in roles):
+                raise ValueError(
+                    "a disaggregated fleet needs at least one decode-"
+                    "capable replica ('decode' or 'any') to receive "
+                    "prefill handoffs")
         self._factory = engine_factory
+        # factories that accept a role= keyword get told which submesh
+        # they are building for (prefill and decode engines may want
+        # different chunking / horizons); legacy factories are called
+        # bare.  Detected ONCE here — a TypeError raised inside the
+        # factory at spawn time must not be mistaken for "takes no role"
+        try:
+            import inspect
+            params = inspect.signature(engine_factory).parameters.values()
+            self._factory_takes_role = any(
+                p.kind is inspect.Parameter.VAR_KEYWORD or p.name == "role"
+                for p in params)
+        except (TypeError, ValueError):
+            self._factory_takes_role = False
+        self.handoff_retry_rounds = int(handoff_retry_rounds)
         self._clock = clock
         self.router = router if router is not None else LeastLoadedRouter()
         self.snapshot_root = snapshot_root
@@ -192,6 +238,24 @@ class ReplicaFleet:
         self._c_scale_down = self.metrics.counter("fleet.scale_down")
         self._c_drain_migr = self.metrics.counter("fleet.drain_migrations")
         self._h_recovery = self.metrics.histogram("fleet.recovery_s")
+        # disaggregated KV handoff accounting (ISSUE 19): role-less
+        # fleets report honest zeros, same contract as the elastic block
+        self._c_handoffs = self.metrics.counter("fleet.kv_handoffs")
+        self._c_handoff_fallbacks = self.metrics.counter(
+            "fleet.kv_handoff_fallbacks")
+        self._c_kv_pages = self.metrics.counter(
+            "fleet.kv_pages_transferred")
+        self._c_kv_bytes = self.metrics.counter(
+            "fleet.kv_bytes_transferred")
+        self._c_kv_rank_local = self.metrics.counter(
+            "fleet.kv_rank_local_handoffs")
+        self._h_kv_transfer = self.metrics.histogram("fleet.kv_transfer_s")
+        # exported-but-not-yet-imported packets: export happens at the
+        # END of a round (phase B, after streams), import at the START of
+        # the next (phase A) — the one-round gap between the source and
+        # destination residencies is what attribution classifies as the
+        # kv_transfer segment
+        self._pending_handoffs: list[dict] = []
         self.flight = FlightRecorder(capacity=flight_capacity, clock=clock)
         # the ROUTER track of the stitched fleet trace: one request record
         # per frid (submitted -> admitted(replica) -> first_token ->
@@ -223,12 +287,13 @@ class ReplicaFleet:
         self._retired_stats: list[tuple[str, dict]] = []
         self._replicas: list[_Replica] = []
         self._next_replica_idx = 0
-        for _ in range(int(num_replicas)):
-            self._spawn_replica()
+        for role in roles:
+            self._spawn_replica(role=role)
 
     # -- construction helpers ----------------------------------------------
-    def _new_engine(self, name: str) -> ServingEngine:
-        eng = self._factory()
+    def _new_engine(self, name: str, role: str = "any") -> ServingEngine:
+        eng = self._factory(role=role) if self._factory_takes_role \
+            else self._factory()
         if not isinstance(eng, ServingEngine):
             raise TypeError("engine_factory must return a ServingEngine")
         eng.name = name
@@ -242,14 +307,14 @@ class ReplicaFleet:
             keep_last=self.snapshot_keep_last,
             telemetry=_SnapTel(self, name))
 
-    def _spawn_replica(self) -> _Replica:
+    def _spawn_replica(self, role: str = "any") -> _Replica:
         """Build + register one replica under the next monotonic name
         (names are never reused — a retired r1's tracer track and a later
         r3 can coexist in one stitched view)."""
         name = f"r{self._next_replica_idx}"
         self._next_replica_idx += 1
-        rep = _Replica(name, self._new_engine(name),
-                       self._snapshot_manager(name))
+        rep = _Replica(name, self._new_engine(name, role),
+                       self._snapshot_manager(name), role=role)
         self._replicas.append(rep)
         self._assigned[name] = set()
         self._wire_router(rep)
@@ -278,15 +343,18 @@ class ReplicaFleet:
                 self.router.note_cached(name, existing)
 
     # -- elastic control plane (ROADMAP item 5) ----------------------------
-    def add_replica(self) -> str:
+    def add_replica(self, role: str = "any") -> str:
         """Scale up: spawn one fresh replica at runtime (the autoscaler's
         grow action).  Returns the new replica's name; it is routable
-        immediately."""
-        rep = self._spawn_replica()
+        immediately.  ``role`` lets a role-aware autoscaler grow prefill
+        and decode capacity independently."""
+        if role not in ("any", "prefill", "decode"):
+            raise ValueError(f"unknown replica role {role!r}")
+        rep = self._spawn_replica(role=role)
         self._c_scale_up.inc()
-        self.flight.record("scale_up", replica=rep.name,
+        self.flight.record("scale_up", replica=rep.name, role=role,
                            replicas=len(self._alive()))
-        self.tracer.engine_event("scale_up", replica=rep.name)
+        self.tracer.engine_event("scale_up", replica=rep.name, role=role)
         return rep.name
 
     def retire_replica(self, name: str) -> bool:
@@ -473,6 +541,13 @@ class ReplicaFleet:
     def _alive(self):
         return [rep for rep in self._replicas if rep.alive]
 
+    @property
+    def _has_roles(self) -> bool:
+        """True when ANY replica carries a non-"any" role — checked per
+        placement (not cached) so an elastic fleet that grows its first
+        prefill replica at runtime becomes role-aware on the spot."""
+        return any(rep.role != "any" for rep in self._replicas)
+
     def _backoff(self, fr: _FleetRequest):
         """One failed placement attempt: exponential backoff (capped) until
         the next retry round."""
@@ -489,8 +564,21 @@ class ReplicaFleet:
         ``adopt`` so the fleet-anchored absolute deadline is preserved
         and a migrated request resumes from its streamed tokens (empty
         stream == fresh submission).  Typed ``PoolCapacityError`` (can
-        NEVER fit) propagates to the caller."""
+        NEVER fit) propagates to the caller.
+
+        Role-aware fleets filter the candidates to prefill-capable
+        replicas first (adopt ALWAYS prefills — prompt, or prompt +
+        streamed for a migration); when none survives, every routable
+        replica is eligible again: role is a throughput preference and
+        must never become a reason to drop or strand work."""
         cands = {rep.name: rep for rep in self._alive() if rep.routable}
+        role = None
+        if self._has_roles:
+            role = "prefill"
+            pref = {n: r for n, r in cands.items()
+                    if r.role in ("prefill", "any")}
+            if pref:
+                cands = pref
         if not cands:
             return False
         # the token stream the placement would prefill: prompt for a
@@ -509,7 +597,7 @@ class ReplicaFleet:
         decision = self.router.decide(
             memo["tokens"],
             [(name, rep.load()) for name, rep in cands.items()],
-            memo=memo)
+            memo=memo, role=role)
         for name in decision.order:
             rep = cands.get(name)
             if rep is None:
@@ -553,6 +641,12 @@ class ReplicaFleet:
                 * max(0.0, now - self._last_tick)
         self._last_tick = now
         progressed = False
+        # phase A of the KV handoff: packets exported at the END of the
+        # previous round splice into a decode replica BEFORE any other
+        # placement this round (the handed-off request must not lose its
+        # slot to a fresh admission racing it out of the fleet queue)
+        if self._import_pending_handoffs():
+            progressed = True
         for fr in list(self._waiting):
             if fr.next_try_round > self._round:
                 continue
@@ -592,6 +686,12 @@ class ReplicaFleet:
                         f"replica {rep.name}: no progress for {rep.stall} "
                         f"consecutive heartbeats with work pending"))
                     progressed = True
+        # phase B: prefill-role replicas export finished prefills AFTER
+        # their streams drained (the router log must already cover every
+        # token the packet carries, so the decode replica's re-emission
+        # only ever EXTENDS it)
+        if self._begin_handoffs():
+            progressed = True
         if self.snapshot_root is not None and self.snapshot_every \
                 and self._round % self.snapshot_every == 0:
             for rep in self._replicas:
@@ -608,6 +708,172 @@ class ReplicaFleet:
                     # the heartbeat crash path — the stall watchdog must
                     # not starve on rounds that spent their time recovering)
         return progressed
+
+    # -- disaggregated KV handoff (ISSUE 19) -------------------------------
+    def _begin_handoffs(self) -> bool:
+        """Phase B of the disaggregated handoff: every prefill-role
+        replica exports each request whose prefill is DONE (first token
+        decoded, no chunk in flight — ``ServingEngine.handoff_ready``)
+        as a KV packet (head-sharded page planes + scale planes + exact
+        request state), cancels it locally (the written KV parks in the
+        prefix cache: an affinity bonus if the fallback path ever
+        re-prefills here), and queues the packet for phase-A import next
+        round.  Pure host work — no engine steps, no device transfers
+        beyond the page gather itself."""
+        if not self._has_roles:
+            return False
+        progressed = False
+        for rep in self._replicas:
+            if not rep.alive or rep.role != "prefill":
+                continue
+            for frid in sorted(self._assigned[rep.name]):
+                if frid not in self._assigned[rep.name]:
+                    continue       # resolved by a _stream below
+                fr = self._requests.get(frid)
+                if fr is None or fr.result is not None \
+                        or fr.handle is None or fr.no_handoff:
+                    continue
+                eng = rep.engine
+                if not eng.handoff_ready(fr.handle.rid):
+                    continue
+                t0 = self._clock()
+                try:
+                    packet = eng.export_kv([fr.handle.rid])
+                except KeyError:
+                    # retired during the export quiesce (deadline race) —
+                    # the drain below observes the retirement; nothing to
+                    # hand off
+                    self._stream(rep)
+                    continue
+                # drain tokens decoded up to the quiesce point FIRST: the
+                # router log must cover everything the packet carries
+                self._stream(rep)
+                if fr.result is not None:
+                    continue       # finished at the quiesce edge
+                eng.cancel(fr.handle.rid)
+                self._assigned[rep.name].discard(frid)
+                fr.replica = None
+                fr.handle = None
+                self._pending_handoffs.append({
+                    "fr": fr, "packet": packet, "src": rep.name,
+                    "src_tp": int(packet["tp"]), "t0": t0, "tries": 0})
+                self.flight.record("handoff_export", frid=frid,
+                                   src=rep.name,
+                                   pages=len(packet["kv_pages"]),
+                                   bytes=int(packet["bytes"]),
+                                   trace_id=fr.trace_id)
+                # "preempted" re-opens the queued phase on the router
+                # track; the import closes it with routing="handoff"
+                self.tracer.request_event(frid, "preempted",
+                                          kind="handoff",
+                                          tokens=len(fr.streamed))
+                progressed = True
+        return progressed
+
+    def _import_pending_handoffs(self) -> bool:
+        """Phase A: splice every pending KV packet into a decode-capable
+        replica.  Admission pressure retries for ``handoff_retry_rounds``
+        rounds, then falls back to re-prefill migration; a geometry/
+        dtype/mp-degree mismatch (``KVHandoffError`` — the packet can
+        NEVER splice there) falls back immediately.  Either fallback
+        rides the normal degradation ladder (route -> queue -> reject
+        exempt: migrations are never dropped)."""
+        if not self._pending_handoffs:
+            return False
+        progressed = False
+        still: list[dict] = []
+        for h in self._pending_handoffs:
+            fr = h["fr"]
+            if fr.result is not None or fr.frid not in self._requests:
+                continue           # resolved or client-cancelled in flight
+            outcome = self._import_one(h)
+            if outcome == "retry":
+                h["tries"] += 1
+                if h["tries"] >= self.handoff_retry_rounds:
+                    fr.no_handoff = True
+                    self._c_handoff_fallbacks.inc()
+                    self.flight.record("handoff_fallback", frid=fr.frid,
+                                       src=h["src"],
+                                       reason="no_decode_capacity",
+                                       tries=h["tries"])
+                    self._migrate(fr)
+                    progressed = True
+                else:
+                    still.append(h)
+            else:
+                progressed = True  # placed, or fallback-migrated inline
+        self._pending_handoffs = still
+        return progressed
+
+    def _import_one(self, h: dict) -> str:
+        """Try one packet: returns ``"placed"`` (spliced into a decode
+        replica), ``"fallback"`` (mismatch — already re-prefill-migrated),
+        or ``"retry"`` (admission pressure / no decode capacity now)."""
+        fr = h["fr"]
+        cands = {rep.name: rep for rep in self._alive()
+                 if rep.routable and rep.role == "decode"}
+        if not cands:
+            # no decode replica alive (mid-failover): "any" replicas can
+            # decode too — never strand the packet on role purity.
+            # Never a PREFILL replica: importing there would undo the
+            # disaggregation the export just paid for.
+            cands = {rep.name: rep for rep in self._alive()
+                     if rep.routable and rep.role == "any"}
+        if not cands:
+            return "retry"
+        memo = fr.route_memo
+        if memo.get("n_streamed") != len(fr.streamed):
+            memo.clear()
+            memo["n_streamed"] = len(fr.streamed)
+            memo["tokens"] = fr.prompt if not fr.streamed \
+                else np.concatenate(
+                    [fr.prompt, np.asarray(fr.streamed[:-1], np.int32)])
+        decision = self.router.decide(
+            memo["tokens"],
+            [(name, rep.load()) for name, rep in cands.items()],
+            memo=memo, role="decode")
+        for name in decision.order:
+            rep = cands.get(name)
+            if rep is None:
+                continue
+            try:
+                mapping = rep.engine.import_kv(h["packet"])
+            except AdmissionRejected:
+                continue
+            except KVHandoffError as exc:
+                fr.no_handoff = True
+                self._c_handoff_fallbacks.inc()
+                self.flight.record("handoff_fallback", frid=fr.frid,
+                                   src=h["src"], dst=name,
+                                   reason=str(exc)[:160])
+                self._migrate(fr)
+                return "fallback"
+            rid = next(iter(mapping.values()))
+            fr.replica = rep.name
+            fr.handle = rep.engine.lookup(rid)
+            self._assigned[rep.name].add(fr.frid)
+            dt = max(0.0, self._clock() - h["t0"])
+            rank_local = int(rep.engine.tp) == h["src_tp"]
+            self._c_handoffs.inc()
+            self._c_kv_pages.inc(len(h["packet"]["kv_pages"]))
+            self._c_kv_bytes.inc(int(h["packet"]["bytes"]))
+            if rank_local:
+                self._c_kv_rank_local.inc()
+            self._h_kv_transfer.observe(dt)
+            self.flight.record("handoff", frid=fr.frid, src=h["src"],
+                               dst=rep.name,
+                               pages=len(h["packet"]["kv_pages"]),
+                               bytes=int(h["packet"]["bytes"]),
+                               rank_local=rank_local,
+                               transfer_s=round(dt, 6),
+                               trace_id=fr.trace_id)
+            self.tracer.request_event(fr.frid, "admitted",
+                                      replica=rep.name,
+                                      routing="handoff",
+                                      rank_local=rank_local,
+                                      resumed_tokens=len(fr.streamed))
+            return "placed"
+        return "retry"
 
     def _stream(self, rep: _Replica):
         """Drain newly emitted tokens from the replica into the router's
@@ -780,7 +1046,7 @@ class ReplicaFleet:
         replacement), or None when the replacement could not be built
         (the replica stays dead)."""
         try:
-            eng = self._new_engine(rep.name)
+            eng = self._new_engine(rep.name, rep.role)
         except Exception as exc:  # noqa: BLE001 — factory failure
             self.flight.record("revive_failed", replica=rep.name,
                                error=str(exc)[:200])
@@ -870,6 +1136,8 @@ class ReplicaFleet:
     # -- readouts ----------------------------------------------------------
     def stats(self) -> dict:
         q = self._h_recovery.percentiles()
+        tq = self._h_kv_transfer.percentiles()
+        handoffs = self._c_handoffs.value
         return {
             "replicas": len(self._replicas),
             "replicas_alive": len(self._alive()),
@@ -883,6 +1151,25 @@ class ReplicaFleet:
             "scale_ups": self._c_scale_up.value,
             "scale_downs": self._c_scale_down.value,
             "drain_migrations": self._c_drain_migr.value,
+            "handoffs": handoffs,
+            "handoff_fallbacks": self._c_handoff_fallbacks.value,
+            "handoffs_pending": len(self._pending_handoffs),
+            "kv_transfer": {
+                "pages": self._c_kv_pages.value,
+                "bytes": self._c_kv_bytes.value,
+                "rank_local": self._c_kv_rank_local.value,
+                "rank_local_hit_rate":
+                    round(self._c_kv_rank_local.value / handoffs, 4)
+                    if handoffs else None,
+                "transfer_s": {
+                    "count": self._h_kv_transfer.count,
+                    "p50_ms": round(tq[50] * 1e3, 3),
+                    "p95_ms": round(tq[95] * 1e3, 3),
+                    "p99_ms": round(tq[99] * 1e3, 3),
+                    "max_ms": round(self._h_kv_transfer.max * 1e3, 3)
+                    if self._h_kv_transfer.count else 0.0},
+            },
+            "roles": {rep.name: rep.role for rep in self._replicas},
             "requests_submitted": self._c_submitted.value,
             "requests_resolved": self._c_resolved.value,
             "tokens_streamed": self.tokens_streamed,
@@ -895,7 +1182,8 @@ class ReplicaFleet:
                          "max_ms": round(self._h_recovery.max * 1e3, 3)
                          if self._h_recovery.count else 0.0},
             "per_replica": {rep.name: (dict(rep.engine.stats(),
-                                            routable=rep.routable)
+                                            routable=rep.routable,
+                                            role=rep.role)
                                        if rep.alive else None)
                             for rep in self._replicas},
         }

@@ -78,12 +78,33 @@ class Router:
         others ignore it)."""
 
     # -- placement ---------------------------------------------------------
-    def decide(self, tokens, candidates, memo=None) -> RoutingDecision:
+    def decide(self, tokens, candidates, memo=None,
+               role=None) -> RoutingDecision:
         """``memo`` (optional dict) is per-request scratch the FLEET
         clears whenever the request's token stream changes — routers may
         park derived state there (the affinity chain digests) so a
-        backoff retry of an unchanged request costs no re-hashing."""
+        backoff retry of an unchanged request costs no re-hashing.
+
+        ``role`` (disaggregated fleets) names the replica role this
+        placement targets — ``"prefill"`` for fresh admissions,
+        ``"decode"`` for KV handoffs, None for a role-less fleet.  The
+        FLEET pre-filters ``candidates`` to that role; routers order what
+        they are given and record the role for ``stats()``."""
         raise NotImplementedError
+
+    def _note_role(self, role):
+        """Per-role placement accounting (lazy: a role-less fleet never
+        allocates the dict)."""
+        if role is None:
+            return
+        counts = getattr(self, "_role_counts", None)
+        if counts is None:
+            counts = self._role_counts = {}
+        counts[role] = counts.get(role, 0) + 1
+
+    def _role_stats(self) -> dict:
+        counts = getattr(self, "_role_counts", None)
+        return {} if not counts else {"routed_by_role": dict(counts)}
 
     # -- replica lifecycle -------------------------------------------------
     def on_replica_added(self, name: str):
@@ -103,7 +124,7 @@ class Router:
         """``digests`` were evicted from ``name``'s prefix cache."""
 
     def stats(self) -> dict:
-        return {"router": self.name}
+        return {"router": self.name, **self._role_stats()}
 
 
 class LeastLoadedRouter(Router):
@@ -112,7 +133,9 @@ class LeastLoadedRouter(Router):
 
     name = "least_loaded"
 
-    def decide(self, tokens, candidates, memo=None) -> RoutingDecision:
+    def decide(self, tokens, candidates, memo=None,
+               role=None) -> RoutingDecision:
+        self._note_role(role)
         order = [n for n, _load in sorted(candidates,
                                           key=lambda c: (c[1], c[0]))]
         return RoutingDecision(order=order, kind="least_loaded",
@@ -191,7 +214,9 @@ class PrefixAffinityRouter(Router):
             n += 1
         return n
 
-    def decide(self, tokens, candidates, memo=None) -> RoutingDecision:
+    def decide(self, tokens, candidates, memo=None,
+               role=None) -> RoutingDecision:
+        self._note_role(role)
         by_load = sorted(candidates, key=lambda c: (c[1], c[0]))
         order = [n for n, _load in by_load]
         if not order or self.page_size is None:
@@ -243,4 +268,5 @@ class PrefixAffinityRouter(Router):
             "matched_blocks_total": self.matched_blocks_total,
             "summary_blocks": {n: len(s)
                                for n, s in sorted(self._summary.items())},
+            **self._role_stats(),
         }

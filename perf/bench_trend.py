@@ -74,6 +74,16 @@ FUSED_KEY = "fused_frac"
 # share of request latency).  Drift-checked like the other columns: once
 # a round publishes a TP arm, a later round silently losing it fails.
 TP_COLL_KEY = "tp_collective_frac"
+# ISSUE 19 columns: the disaggregation plane — the disagg artifact's
+# flat ``kv_transfer_frac`` (share of stitched virtual e2e spent in the
+# prefill->decode KV handoff gap) and flat ``disagg_ttft_p95_ms`` (the
+# disagg arm's TTFT p95 in virtual ms; 0.0 is a REAL value here — the
+# round clock quantizes a within-round first token to zero, so the
+# finder must not treat it as missing).  Drift-checked like the other
+# columns: once a round publishes the disagg trace, a later round
+# silently losing either fails.
+KV_FRAC_KEY = "kv_transfer_frac"
+DISAGG_TTFT_KEY = "disagg_ttft_p95_ms"
 
 
 def find_artifacts(root: str) -> list[tuple[int, str]]:
@@ -264,6 +274,27 @@ def find_tp_collective_frac(d):
     return _find(d, match)
 
 
+def find_kv_transfer_frac(d):
+    """First KV-transfer share of stitched e2e: the disagg artifact's
+    flat ``kv_transfer_frac`` (ISSUE 19 — the prefill->decode handoff
+    gap as a fraction of virtual end-to-end latency)."""
+    def match(n):
+        v = n.get(KV_FRAC_KEY)
+        return v if _num(v) else None
+    return _find(d, match)
+
+
+def find_disagg_ttft_p95(d):
+    """First disagg-arm TTFT p95, virtual ms: the disagg artifact's flat
+    ``disagg_ttft_p95_ms``.  0.0 is a legitimate reading (the round
+    clock floors a within-round first token to zero), so the match
+    gates on numeric type, never on truthiness."""
+    def match(n):
+        v = n.get(DISAGG_TTFT_KEY)
+        return v if _num(v) else None
+    return _find(d, match)
+
+
 def _fmt(v, nd=1):
     if v is None:
         return "-"
@@ -291,6 +322,8 @@ def trend(root: str = ".", verbose: bool = True) -> int:
     prev_quant_match = False
     prev_fused = False
     prev_tp_coll = False
+    prev_kv_frac = False
+    prev_disagg_ttft = False
     for rnd, path in arts:
         try:
             with open(path) as f:
@@ -374,6 +407,18 @@ def trend(root: str = ".", verbose: bool = True) -> int:
                             f"(tp.{TP_COLL_KEY}) present in an earlier "
                             f"round but missing here")
         prev_tp_coll = prev_tp_coll or tp_coll is not None
+        kv_frac = find_kv_transfer_frac(parsed)
+        if kv_frac is None and prev_kv_frac:
+            problems.append(f"{path}: KV-transfer share "
+                            f"({KV_FRAC_KEY}) present in an earlier "
+                            f"round but missing here")
+        prev_kv_frac = prev_kv_frac or kv_frac is not None
+        disagg_ttft = find_disagg_ttft_p95(parsed)
+        if disagg_ttft is None and prev_disagg_ttft:
+            problems.append(f"{path}: disagg TTFT p95 "
+                            f"({DISAGG_TTFT_KEY}) present in an earlier "
+                            f"round but missing here")
+        prev_disagg_ttft = prev_disagg_ttft or disagg_ttft is not None
         rows.append({
             "round": rnd,
             "metric": parsed.get("metric"),
@@ -414,6 +459,10 @@ def trend(root: str = ".", verbose: bool = True) -> int:
             "fused_frac": fused_frac,
             # TP serving column: the --tp arm's collective tax
             "tp_collective_frac": tp_coll,
+            # ISSUE 19 columns: KV handoff share of stitched e2e +
+            # the disagg arm's TTFT p95 (virtual ms; 0.0 is real)
+            "kv_transfer_frac": kv_frac,
+            "disagg_ttft_p95_ms": disagg_ttft,
         })
     if verbose:
         hdr = (f"{'round':>5}  {'tokens/s':>10}  {'vs_base':>8}  "
@@ -421,7 +470,8 @@ def trend(root: str = ".", verbose: bool = True) -> int:
                f"{'overlap':>7}  {'slo_gput':>8}  {'rec_p50':>7}  "
                f"{'perr_p95':>8}  {'alerts':>6}  {'dsync':>5}  "
                f"{'gprh':>6}  {'f_hit':>5}  {'q_cap':>5}  {'q_em':>5}  "
-               f"{'fused':>5}  {'tp_coll':>7}")
+               f"{'fused':>5}  {'tp_coll':>7}  {'kv_fr':>5}  "
+               f"{'d_ttft':>6}")
         print(hdr)
         print("-" * len(hdr))
         for r in rows:
@@ -441,7 +491,9 @@ def trend(root: str = ".", verbose: bool = True) -> int:
                   f"{_fmt(r['quant_capacity_ratio'], 2):>5}  "
                   f"{_fmt(r['quant_exact_match'], 3):>5}  "
                   f"{_fmt(r['fused_frac'], 3):>5}  "
-                  f"{_fmt(r['tp_collective_frac'], 3):>7}")
+                  f"{_fmt(r['tp_collective_frac'], 3):>7}  "
+                  f"{_fmt(r['kv_transfer_frac'], 3):>5}  "
+                  f"{_fmt(r['disagg_ttft_p95_ms'], 1):>6}")
         v0, v1 = rows[0]["value"], rows[-1]["value"]
         if len(rows) >= 2 \
                 and all(isinstance(v, (int, float))

@@ -952,6 +952,108 @@ class TestDistServingTP:
             kernel_test_src="from pkg.ops.pallas.quant_allreduce import "
                             "quantized_allreduce  # int8 bound asserted")
         assert res.new == []
+
+
+# ---------------------------------------------------------------------------
+# DIST001/DIST002 — the disaggregated prefill/decode dual-submesh region
+# (ISSUE 19): TWO shard_map regions in one serve() over DISJOINT submeshes,
+# each binding only its own role's axis.  The failure class these fixtures
+# pin: a collective referencing the OTHER role's axis — trivially green in
+# colocated TP where there is only one axis name, and exactly what the
+# dryrun's per-role spmd_sanitize scopes verify independently.
+# ---------------------------------------------------------------------------
+class TestDistDisagg:
+    DISAGG_SHAPE = """
+        def serve(x, devs, build_mesh):
+            mesh_p = build_mesh({{"mp_prefill": 4}})
+            mesh_d = build_mesh({{"mp_decode": 4}})
+
+            def prefill_step(x):
+                return jax.lax.psum(x, "mp_prefill")
+
+            def decode_step(x):
+                o = jax.lax.all_gather(x, {decode_axis!r}, axis=0,
+                                       tiled=True)
+                return jax.lax.psum(o, {decode_axis!r})
+
+            y = shard_map(prefill_step, mesh=mesh_p,
+                          in_specs=(P("mp_prefill"),), out_specs=P())(x)
+            return shard_map(decode_step, mesh=mesh_d,
+                             in_specs=(P("mp_decode"),), out_specs=P())(y)
+    """
+
+    def test_negative_each_role_reduces_its_own_axis(self):
+        # the real wiring: each submesh's schedule only names its own
+        # axis — both regions lint clean side by side in one function
+        res = _lint_dist(self.DISAGG_SHAPE.format(decode_axis="mp_decode"))
+        assert res.new == []
+
+    def test_positive_decode_references_prefill_axis(self):
+        # the cross-role bug colocated TP can never exhibit: the decode
+        # body reduces over the PREFILL submesh's axis -> DIST001, and
+        # the message names the one axis the decode region does bind
+        res = _lint_dist(self.DISAGG_SHAPE.format(decode_axis="mp_prefill"))
+        assert _rules(res) == ["DIST001", "DIST001"]
+        assert "'mp_prefill'" in res.new[0].message
+        assert "mp_decode" in res.new[0].message
+
+    def test_positive_import_helper_hardcodes_source_axis(self):
+        # the handoff-import helper keeps the SOURCE engine's axis name;
+        # resolved through the decode shard_map's call edge -> DIST001
+        res = _lint_dist("""
+            def splice_pages(kv):
+                return jax.lax.all_gather(kv, "mp_prefill", axis=0,
+                                          tiled=True)
+
+            def serve(kv, devs, build_mesh):
+                mesh_d = build_mesh({"mp_decode": 4})
+
+                def decode_step(kv):
+                    return splice_pages(kv)
+
+                return shard_map(decode_step, mesh=mesh_d,
+                                 in_specs=(P("mp_decode"),),
+                                 out_specs=P())(kv)
+        """)
+        assert _rules(res) == ["DIST001"]
+
+    def test_positive_rank_gated_import_scatter(self):
+        # "only rank 0 splices the handed-off pages": the import scatter
+        # is a collective, so gating it on axis_index deadlocks the other
+        # decode ranks -> DIST002
+        res = _lint_dist("""
+            def serve(kv, devs, build_mesh):
+                mesh_d = build_mesh({"mp_decode": 4})
+
+                def decode_step(kv):  # graftlint: spmd=mp_decode
+                    r = jax.lax.axis_index("mp_decode")
+                    if r == 0:
+                        kv = jax.lax.psum(kv, "mp_decode")
+                    return kv
+
+                return shard_map(decode_step, mesh=mesh_d,
+                                 in_specs=(P("mp_decode"),),
+                                 out_specs=P())(kv)
+        """)
+        assert _rules(res) == ["DIST002"]
+
+    def test_negative_role_knob_is_static(self):
+        # the role= a factory receives selects WHICH uniform schedule a
+        # replica runs (prefill vs decode), never whether a rank joins
+        # one — a static knob, so DIST002 stays quiet
+        res = _lint_dist("""
+            def serve(x, devs, build_mesh, role="decode"):
+                mesh = build_mesh({"mp": 4})
+
+                def step(x):  # graftlint: spmd=mp
+                    if role == "prefill":
+                        return jax.lax.psum(x, "mp")
+                    return jax.lax.psum(x * 2, "mp")
+
+                return shard_map(step, mesh=mesh, in_specs=(P("mp"),),
+                                 out_specs=P())(x)
+        """)
+        assert res.new == []
         res = lint_sources(
             [("pkg/ops/pallas/quant_allreduce.py", textwrap.dedent("""
                 def quantized_allreduce(x, axis_name):

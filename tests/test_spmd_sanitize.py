@@ -211,3 +211,48 @@ def test_patching_is_scoped():
         with spmd_sanitize(n_ranks=2):          # nested: still one patch
             assert getattr(jax.lax.psum, "__wrapped__", None) is orig
     assert jax.lax.psum is orig                 # fully restored
+
+
+def test_disagg_submesh_schedules_verify_independently():
+    """The ISSUE 19 disaggregation contract, at sanitizer scale: prefill
+    and decode engines run DIFFERENT collective schedules on DISJOINT
+    submeshes, so each role gets its OWN spmd_sanitize scope and a
+    divergence on one submesh must redden only that scope.  A prefill-rank
+    drop fails the prefill verify (naming the rank) while the decode
+    schedule — traced under the same active fault plan — stays green."""
+    devs = jax.devices()
+    assert len(devs) >= 8
+    mesh_p = Mesh(np.array(devs[:4]), ("mp",))
+    mesh_d = Mesh(np.array(devs[4:8]), ("mp",))
+
+    def prefill_body(x):                        # dense prefill: 2 events
+        return jax.lax.psum(jax.lax.all_gather(x, "mp").sum(0), "mp")
+
+    def decode_body(x):                         # decode: 1 AllReduce
+        return jax.lax.psum(x, "mp")
+
+    f_p = jax.jit(shard_map(prefill_body, mesh=mesh_p,
+                            in_specs=(P("mp"),), out_specs=P("mp")))
+    f_d = jax.jit(shard_map(decode_body, mesh=mesh_d,
+                            in_specs=(P("mp"),), out_specs=P("mp")))
+    x = jnp.arange(128, dtype=jnp.float32)      # fresh shape: fresh trace
+    with faults.inject({"spmd.collective": dict(
+            action="trigger", match={"rank": 1}, at=1)}) as plan:
+        with spmd_sanitize(n_ranks=4) as san_p:
+            f_p(x)
+        with spmd_sanitize(n_ranks=4) as san_d:
+            f_d(x)
+        # the drop lands in the PREFILL scope's verify (its rank 1 lost
+        # event index 1) ...
+        with pytest.raises(CollectiveScheduleMismatch) as ei:
+            san_p.verify()
+        assert ei.value.rank == 1
+        assert plan.fired("spmd.collective") == 1
+        # ... and the decode scope is untouched: its own 4 ranks agree
+        scheds = san_d.verify()
+        assert len(scheds) == 4
+        assert all(s == scheds[0] for s in scheds.values())
+    # schedules are per-role, not shared: the decode submesh never saw
+    # the prefill region's all_gather
+    assert {e[0] for e in san_d.events} == {"psum"}
+    assert {e[0] for e in san_p.events} == {"psum", "all_gather"}
