@@ -54,7 +54,7 @@ GRAFTLINT = $(PY) -m paddle_tpu.analysis paddle_tpu \
 LINT_ARTIFACT ?= GRAFTLINT_report.json
 
 .PHONY: tier1 tier1-budget check-budget bench bench-trend lint \
-	lint-baseline obs-check proc-smoke check
+	lint-baseline obs-check proc-smoke race-check check
 
 # `bench-trend` reads every BENCH_r*.json driver artifact at the repo root
 # and prints the headline tokens/s + serving TTFT-p95 + goodput trajectory
@@ -166,9 +166,27 @@ lint:
 lint-baseline:
 	$(GRAFTLINT) --write-baseline
 
+# `race-check` is the graftlint v3 runtime lane (README §Static analysis,
+# ISSUE 20): the thread-heavy drills — fleet failover, the AsyncFrontend
+# worker seam, and the sanitizer's own inversion/interleave fixtures —
+# re-run with GRAFT_THREAD_SANITIZE=1, which wraps every test in
+# thread_sanitize(): threading.Lock/RLock are instrumented, lock-order
+# inversions raise LockOrderViolation with both stacks instead of
+# deadlocking CI, and the seeded thread.interleave fault point makes the
+# schedules reproducible.  The sanitizer is OFF everywhere timed
+# (tier1-budget, obs-check overhead gates) — it is a test-lane tool, not
+# a production tax.
+race-check:
+	env JAX_PLATFORMS=cpu GRAFT_THREAD_SANITIZE=1 timeout -k 10 600 \
+		$(PY) -m pytest tests/test_thread_sanitize.py \
+		tests/test_frontend.py tests/test_fleet.py tests/test_rpc.py \
+		tests/test_procfleet.py \
+		-q -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
+
 check:
 	$(GRAFTLINT) --fail-on-stale --json-artifact $(LINT_ARTIFACT)
 	$(MAKE) tier1-budget
+	$(MAKE) race-check
 	$(MAKE) obs-check
 	$(MAKE) proc-smoke
 

@@ -19,6 +19,13 @@ Runtime half (jax imported lazily):
     san.verify()                      # all ranks agree on the collective
                                       # schedule, or flight-dump + raise
 
+Host-concurrency half (stdlib only — patches threading.Lock/RLock):
+
+    from paddle_tpu.analysis import thread_sanitize
+    with thread_sanitize(flight=recorder):
+        run_fleet_drill()             # lock-order cycles raise
+                                      # LockOrderViolation with both stacks
+
 Rule catalog and suppression syntax: README §Static analysis; engine
 internals: graftlint.py / rules.py docstrings.
 """
@@ -28,9 +35,14 @@ from .sanitize import (RecompileBudgetError, instrument, jit_cache_size,
                        sanitize)
 from .spmd_sanitize import (CollectiveScheduleMismatch, SpmdSanitizer,
                             spmd_sanitize)
+from .thread_sanitize import (LockOrderViolation, OwnershipViolation,
+                              ThreadSanitizer, thread_sanitize)
+from .thread_sanitize import active as thread_sanitizer_active
 
 __all__ = ["Finding", "LintContext", "ModuleInfo", "Rule", "RULES",
            "lint_paths", "lint_sources", "main", "register_rule",
            "RecompileBudgetError", "instrument", "jit_cache_size",
            "sanitize", "CollectiveScheduleMismatch", "SpmdSanitizer",
-           "spmd_sanitize"]
+           "spmd_sanitize", "LockOrderViolation", "OwnershipViolation",
+           "ThreadSanitizer", "thread_sanitize",
+           "thread_sanitizer_active"]

@@ -23,7 +23,11 @@ Mechanics:
     runs every serving step, where SYNC001 polices host syncs);
     ``# graftlint: spmd=dp,mp`` declares the axis names bound while the
     function runs, for SPMD regions the analyzer cannot see (a builder
-    whose product is shard_map'ped by the caller) — DIST001/DIST002 use it.
+    whose product is shard_map'ped by the caller) — DIST001/DIST002 use it;
+    ``# graftlint: owner=worker|main|any`` declares which thread owns the
+    state a function mutates (THREAD001, see ``threadrules.py``) — the
+    marker is inherited along the thread-reachable call closure, so the
+    worker-loop entry point blesses its private helpers.
   * **Baseline** — ``graftlint.baseline.json`` at the repo root grandfathers
     pre-existing findings.  Entries match by (rule, file, stripped source
     line), so unrelated line-number churn never resurrects them, while a
@@ -58,7 +62,8 @@ __all__ = ["Finding", "ModuleInfo", "LintContext", "Rule", "RULES",
            "register_rule", "lint_paths", "lint_sources", "main"]
 
 _SUPPRESS_RE = re.compile(r"#\s*graftlint:\s*disable=([A-Za-z0-9_*,\s]+)")
-_MARKER_RE = re.compile(r"#\s*graftlint:\s*(jit|hot|spmd=[A-Za-z0-9_,]+)\b")
+_MARKER_RE = re.compile(
+    r"#\s*graftlint:\s*(jit|hot|spmd=[A-Za-z0-9_,]+|owner=[A-Za-z0-9_]+)\b")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -183,6 +188,7 @@ def _iter_py_files(paths):
 
 def _load_rules():
     from . import rules as _rules  # noqa: F401  (registers via decorator)
+    from . import threadrules as _threadrules  # noqa: F401  (v3 catalog)
     return RULES
 
 
@@ -446,7 +452,9 @@ def _write_artifact(path, res: LintResult):
     if not path:
         return
     doc = {
-        "schema": "graftlint-report-v1",
+        # v2: the host-concurrency catalog (THREAD001/LOCK001/ASYNC001/
+        # LEAK001, threadrules.py) joined the rule table
+        "schema": "graftlint-report-v2",
         "summary": {"new": len(res.new), "baselined": len(res.baselined),
                     "stale_baseline": len(res.stale), "ok": res.ok},
         "rules": {rid: r.description for rid, r in sorted(RULES.items())},

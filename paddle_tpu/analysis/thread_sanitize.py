@@ -1,0 +1,409 @@
+"""Runtime lock-order / ownership sanitizer (graftlint v3's dynamic half).
+
+The static rules (``threadrules.py``) under-approximate: they cannot see
+locks handed through parameters, dynamic dispatch, or interleavings.
+This module catches at *test time* what the linter cannot prove:
+
+  * **Lock-order recording** — inside a :func:`thread_sanitize` scope,
+    ``threading.Lock()`` / ``threading.RLock()`` return instrumented
+    wrappers (only for locks *created by framework or test code*; stdlib
+    and jax internals keep real locks).  Each acquire records
+    ``held-lock -> acquiring-lock`` edges into one global lock-order
+    graph, keyed by lock **creation site** — all instances created at one
+    line share a node, the same abstraction the static LOCK001 rule uses
+    (class-level keys).  The ordering check runs *before* blocking on the
+    inner lock: a cycle raises :class:`LockOrderViolation` (with the
+    full cycle, the acquiring stack, and the first-seen stack of every
+    reverse edge) instead of deadlocking the drill — and dumps the cycle
+    to a :class:`~paddle_tpu.observability.flight.FlightRecorder` first
+    when one is attached, so the postmortem artifact exists even if the
+    exception is swallowed by a worker thread.
+  * **Ownership watching** — :meth:`ThreadSanitizer.watch` marks an
+    object as owned by one thread (the runtime analog of the
+    ``# graftlint: owner=`` def-marker): any ``__setattr__`` from
+    another thread raises :class:`OwnershipViolation`.
+  * **Deterministic interleave drilling** — every instrumented acquire/
+    release consults the ``thread.interleave`` fault point
+    (:mod:`paddle_tpu.resilience.faults`); a firing ``trigger`` spec
+    injects a sleep-yield at that boundary, forcing context switches at
+    seeded, reproducible points so latent races interleave the same way
+    on every run (same plan seed -> same yield schedule).
+
+CI wiring: ``make race-check`` runs the tier-1 fleet/frontend drills
+with ``GRAFT_THREAD_SANITIZE=1``, which wraps every test in a
+:func:`thread_sanitize` scope (see ``tests/conftest.py``).  The
+sanitizer is a test-lane tool: the perf overhead gates run with it OFF
+(:func:`active` returns None in timed windows).
+"""
+from __future__ import annotations
+
+import sys
+import threading
+import time
+import traceback
+from contextlib import contextmanager
+
+from ..resilience.faults import fault_point
+
+__all__ = ["LockOrderViolation", "OwnershipViolation", "ThreadSanitizer",
+           "thread_sanitize", "active"]
+
+# real factories, captured before any patching
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+
+_MAX_SCHEDULE = 10_000
+_YIELD_S = 0.0005
+
+
+class LockOrderViolation(RuntimeError):
+    """A lock acquisition would close a cycle in the global lock-order
+    graph (ABBA deadlock potential).  Carries ``cycle`` (the ordered
+    creation-site keys) and ``stacks`` ({edge: first-seen stack})."""
+
+    def __init__(self, message: str, cycle=(), stacks=None):
+        super().__init__(message)
+        self.cycle = list(cycle)
+        self.stacks = dict(stacks or {})
+
+
+class OwnershipViolation(RuntimeError):
+    """An attribute of a watched (single-owner) object was written from
+    a thread that does not own it."""
+
+
+def _default_scope(filename: str) -> bool:
+    """Track locks created by framework or test code only — stdlib,
+    site-packages and jax internals keep real, uninstrumented locks."""
+    f = filename.replace("\\", "/")
+    if f.endswith("resilience/faults.py") \
+            or f.endswith("analysis/thread_sanitize.py"):
+        # our own infrastructure: consulting the fault plan on every
+        # instrumented acquire must not re-enter the instrumentation
+        return False
+    return "paddle_tpu" in f or "/tests/" in f or f.startswith("tests/")
+
+
+def _creation_site():
+    """(key, filename) for the frame that called the lock factory,
+    skipping threading.py internals (``Condition()`` default-creates its
+    RLock from inside threading.py — the *user* of the Condition is the
+    interesting site)."""
+    f = sys._getframe(2)
+    while f is not None and f.f_code.co_filename.endswith("threading.py"):
+        f = f.f_back
+    if f is None:
+        return "<unknown>", "<unknown>"
+    fn = f.f_code.co_filename
+    return f"{fn.split('/')[-1]}:{f.f_lineno}", fn
+
+
+class _SanLockBase:
+    """Wrapper around a real lock that reports acquire/release to the
+    sanitizer.  Stays functional (but inert) after the scope exits."""
+
+    _reentrant = False
+
+    def __init__(self, inner, san, key):
+        self._inner = inner
+        self._san = san
+        self._key = key
+
+    def acquire(self, blocking=True, timeout=-1):
+        self._san._before_acquire(self)
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._san._after_acquire(self)
+        return got
+
+    def release(self):
+        self._san._on_release(self)
+        self._inner.release()
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return (f"<{type(self).__name__} {self._key} "
+                f"wrapping {self._inner!r}>")
+
+
+class _SanLock(_SanLockBase):
+    pass
+
+
+class _SanRLock(_SanLockBase):
+    """RLock wrapper — also forwards the private Condition protocol
+    (``Condition(self._cv_rlock)`` and ``Condition()`` both work)."""
+
+    _reentrant = True
+
+    def _is_owned(self):
+        return self._inner._is_owned()
+
+    def _release_save(self):
+        self._san._on_release_save(self)
+        return self._inner._release_save()
+
+    def _acquire_restore(self, state):
+        # Condition.wait re-acquires after waiting: bookkeeping only, no
+        # order edge — the ordering decision was made at the original
+        # acquire, and re-checking here would flag benign wait loops
+        self._inner._acquire_restore(state)
+        self._san._on_acquire_restore(self)
+
+
+class ThreadSanitizer:
+    """One sanitize scope: the lock-order graph, per-thread held sets,
+    watched-object registry, and the interleave schedule."""
+
+    def __init__(self, flight=None, scope=_default_scope):
+        self.flight = flight
+        self.scope = scope
+        self.on = False
+        self._glock = _REAL_LOCK()
+        self._tls = threading.local()
+        self._succ: dict[str, set] = {}         # key -> {key}
+        self._edge_info: dict[tuple, dict] = {}  # (k1, k2) -> stack/thread
+        self.schedule: list[tuple] = []          # (thread, op, key) yields
+        self.violations: list[LockOrderViolation] = []
+        self._watched: dict[int, tuple] = {}     # id(obj) -> (obj, owners)
+
+    # -- factories ----------------------------------------------------------
+    def _make_lock(self):
+        key, fn = _creation_site()
+        if not self.on or not self.scope(fn):
+            return _REAL_LOCK()
+        return _SanLock(_REAL_LOCK(), self, "Lock@" + key)
+
+    def _make_rlock(self):
+        key, fn = _creation_site()
+        if not self.on or not self.scope(fn):
+            return _REAL_RLOCK()
+        return _SanRLock(_REAL_RLOCK(), self, "RLock@" + key)
+
+    # -- held-set bookkeeping ------------------------------------------------
+    def _held(self):
+        h = getattr(self._tls, "held", None)
+        if h is None:
+            h = self._tls.held = {}              # id(lock) -> [lock, count]
+        return h
+
+    def _before_acquire(self, lock):
+        if not self.on:
+            return
+        held = self._held()
+        ent = held.get(id(lock))
+        if ent is not None and lock._reentrant:
+            return                               # re-acquire: no new edge
+        self._maybe_yield("acquire", lock._key)
+        for other_id, (other, _count) in list(held.items()):
+            if other_id == id(lock) or other._key == lock._key:
+                continue                         # same site: one node
+            self._add_edge(other._key, lock._key)
+
+    def _after_acquire(self, lock):
+        if not self.on:
+            return
+        held = self._held()
+        ent = held.get(id(lock))
+        if ent is None:
+            held[id(lock)] = [lock, 1]
+        else:
+            ent[1] += 1
+
+    def _on_release(self, lock):
+        if not self.on:
+            return
+        self._maybe_yield("release", lock._key)
+        held = self._held()
+        ent = held.get(id(lock))
+        if ent is not None:
+            ent[1] -= 1
+            if ent[1] <= 0:
+                del held[id(lock)]
+
+    def _on_release_save(self, lock):
+        # Condition.wait fully releases regardless of recursion depth
+        if self.on:
+            self._held().pop(id(lock), None)
+
+    def _on_acquire_restore(self, lock):
+        if self.on:
+            self._held()[id(lock)] = [lock, 1]
+
+    # -- the order graph -----------------------------------------------------
+    def _add_edge(self, k1, k2):
+        with self._glock:
+            if k2 in self._succ.get(k1, ()):
+                return                           # known-consistent order
+            path = self._find_path(k2, k1)       # would k2 reach back to k1?
+            self._succ.setdefault(k1, set()).add(k2)
+            self._edge_info[(k1, k2)] = {
+                "thread": threading.current_thread().name,
+                "stack": "".join(traceback.format_stack(sys._getframe(2))),
+            }
+        if path is not None:
+            cycle = [k1, k2] + path[1:]
+            stacks = {f"{a}->{b}": self._edge_info.get((a, b), {})
+                      for a, b in zip(cycle, cycle[1:] + cycle[:1])
+                      if (a, b) in self._edge_info}
+            msg = ("lock-order cycle: " + " -> ".join(cycle + [k1])
+                   + f" (new edge {k1} -> {k2} acquired on thread "
+                   f"'{threading.current_thread().name}')")
+            err = LockOrderViolation(msg, cycle=cycle, stacks=stacks)
+            self.violations.append(err)
+            if self.flight is not None:
+                self.flight.record("lock_order_cycle",
+                                   cycle=" -> ".join(cycle + [k1]))
+                self.flight.dump(
+                    "lock_order_cycle", cycle=cycle,
+                    stacks={e: i.get("stack", "")
+                            for e, i in stacks.items()},
+                    threads={e: i.get("thread", "")
+                             for e, i in stacks.items()})
+            raise err
+
+    def _find_path(self, src, dst):
+        """Ordered key list src..dst through the edge set, or None."""
+        if src == dst:
+            return [src]
+        parents = {src: None}
+        work = [src]
+        while work:
+            node = work.pop(0)
+            for nxt in self._succ.get(node, ()):
+                if nxt in parents:
+                    continue
+                parents[nxt] = node
+                if nxt == dst:
+                    path = [nxt]
+                    while parents[path[-1]] is not None:
+                        path.append(parents[path[-1]])
+                    return list(reversed(path))
+                work.append(nxt)
+        return None
+
+    def order_edges(self):
+        with self._glock:
+            return {k: set(v) for k, v in self._succ.items()}
+
+    # -- deterministic interleave -------------------------------------------
+    def _maybe_yield(self, op, key):
+        # reentrancy guard: consulting the plan (or dumping to flight)
+        # acquires locks of its own — those acquires must not re-consult
+        if getattr(self._tls, "in_hook", False):
+            return
+        self._tls.in_hook = True
+        try:
+            spec = fault_point("thread.interleave", op=op, lock=key,
+                               thread=threading.current_thread().name)
+        finally:
+            self._tls.in_hook = False
+        if spec is not None:
+            with self._glock:
+                if len(self.schedule) < _MAX_SCHEDULE:
+                    self.schedule.append(
+                        (threading.current_thread().name, op, key))
+            time.sleep(_YIELD_S)
+
+    # -- ownership watching --------------------------------------------------
+    _watch_classes: dict[type, type] = {}
+
+    def watch(self, obj, owner="current"):
+        """Declare `obj` single-owner: attribute writes from any other
+        thread raise :class:`OwnershipViolation`.  `owner` is a thread
+        name, a ``threading.Thread``, or "current"."""
+        if isinstance(owner, threading.Thread):
+            owner = owner.name
+        elif owner == "current":
+            owner = threading.current_thread().name
+        cls = type(obj)
+        sub = self._watch_classes.get(cls)
+        if sub is None:
+            sub = type("Owned" + cls.__name__, (cls,),
+                       {"__setattr__": _owned_setattr})
+            self._watch_classes[cls] = sub
+        object.__setattr__(obj, "_graft_san", self)
+        object.__setattr__(obj, "_graft_owner", owner)
+        obj.__class__ = sub
+        return obj
+
+    def unwatch(self, obj):
+        cls = type(obj)
+        for orig, sub in self._watch_classes.items():
+            if cls is sub:
+                obj.__class__ = orig
+                break
+        return obj
+
+    # -- scope --------------------------------------------------------------
+    def __enter__(self):
+        self._prev = (threading.Lock, threading.RLock, _current())
+        self.on = True
+        threading.Lock = self._make_lock
+        threading.RLock = self._make_rlock
+        _set_current(self)
+        return self
+
+    def __exit__(self, *exc):
+        threading.Lock, threading.RLock, prev_san = self._prev
+        _set_current(prev_san)
+        self.on = False
+        return False
+
+
+def _owned_setattr(self, name, value):
+    san = object.__getattribute__(self, "_graft_san")
+    owner = object.__getattribute__(self, "_graft_owner")
+    if san.on and not name.startswith("_graft_"):
+        cur = threading.current_thread().name
+        if cur != owner:
+            msg = (f"thread '{cur}' wrote .{name} on an object owned by "
+                   f"thread '{owner}' ({type(self).__name__})")
+            if san.flight is not None:
+                san.flight.record("ownership_violation", attr=name,
+                                  owner=owner, writer=cur)
+                san.flight.dump("ownership_violation", attr=name,
+                                owner=owner, writer=cur)
+            raise OwnershipViolation(msg)
+    object.__setattr__(self, name, value)
+
+
+# innermost active sanitizer (module-global on purpose: worker threads
+# spawned inside the scope must see it, same rationale as faults._ACTIVE)
+_CURRENT: list = [None]
+
+
+def _current():
+    return _CURRENT[0]
+
+
+def _set_current(san):
+    _CURRENT[0] = san
+
+
+def active() -> ThreadSanitizer | None:
+    """The innermost active sanitizer, or None.  Perf gates assert this
+    is None inside timed windows — the sanitizer is a test-lane tool,
+    never a production tax."""
+    san = _current()
+    return san if san is not None and san.on else None
+
+
+@contextmanager
+def thread_sanitize(flight=None, scope=_default_scope):
+    """Instrument ``threading.Lock``/``RLock`` creation for the enclosed
+    scope (nestable; locks created by an outer scope stay instrumented —
+    a wrapper simply wraps a wrapper)."""
+    san = ThreadSanitizer(flight=flight, scope=scope)
+    with san:
+        yield san

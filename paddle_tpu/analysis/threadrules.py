@@ -1,0 +1,692 @@
+"""graftlint v3 — host-concurrency rule catalog (THREAD/LOCK/ASYNC/LEAK).
+
+The serving stack is a multi-threaded, multi-process, asyncio-fronted
+system: an overlap dispatch thread per engine, fleet heartbeats, the
+ProcessFleet supervisor + per-connection RPC threads, exporter HTTP
+threads, checkpoint writer threads, and the AsyncFrontend's single
+worker thread.  Every concurrency bug shipped so far (the host-mirror
+aliasing race, two ``Tracer._live`` ghosts, the wedge-quiesce ordering
+race) was found by hand; these rules make the bug classes a lint
+failure, the same way TRACE001/DIST001 did for trace safety and
+collective deadlocks.
+
+Rules:
+
+  THREAD001  mutable ``self`` state written from a function reachable
+             from a thread entry point (``threading.Thread(target=...)``,
+             ``Timer``, ``executor.submit``, ``run_in_executor``,
+             ``do_GET``/``do_POST`` HTTP handlers) without holding a
+             lock and without a declared owner.  Ownership is declared
+             with a ``# graftlint: owner=worker|main|any`` def-marker
+             and *inherited* along the thread-reachable call closure, so
+             marking the worker-loop entry blesses its private helpers;
+             ``owner=main`` on a thread-reachable function is itself a
+             violation (the function claims the main thread but runs off
+             it).  Callables handed across the documented seams
+             (``call_soon_threadsafe``, ``_post``, ``_enqueue_cmd``,
+             ``_submit_to_worker``, queue ``put``) are re-homed: the
+             closure is cut there, because the callee runs on the
+             *receiving* thread.
+  LOCK001    lock-acquisition-order cycles across modules: an
+             acquires-under graph is built from ``with self._lock:``
+             regions (nested ``with`` blocks, plus calls inside a
+             ``with`` body whose callee transitively acquires another
+             lock, resolved through the cross-module call graph); any
+             strongly-connected component of two or more locks is a
+             potential ABBA deadlock and is reported with the full
+             cycle and one acquisition site per edge.
+  ASYNC001   a blocking call inside an ``async def`` (or a callback
+             handed to ``loop.call_soon*``) outside ``run_in_executor``:
+             ``time.sleep``, socket ops (``recv``/``accept``/
+             ``sendall``/``create_connection``), ``open(...)``,
+             ``Future.result()``, ``thread.join()``, engine
+             ``step``/``submit``, RPC ``client.call`` — each stalls the
+             event loop for every concurrent request.
+  LEAK001    a dict/list attribute grown on a request/step hot path
+             (``submit``/``step``/``record``/``request_event``/... or a
+             ``# graftlint: hot`` marker, closed over call edges) with
+             NO removal path (``pop``/``del``/``remove``/``clear``/
+             reassignment) anywhere in the class and no intrinsic bound
+             (``deque(maxlen=...)``, weak containers) — the
+             ``Tracer._live`` unbounded-ghost class, shipped twice.
+
+All four under-approximate: unresolvable receivers, dynamic dispatch and
+unknown call targets degrade to "don't check".  The runtime half
+(``thread_sanitize.py``) catches what the static rules cannot see.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from .graftlint import Finding, Rule, register_rule
+from .dataflow import _FN_TYPES, callee_name, def_markers, project_graph
+from .rules import _MUTATORS
+
+__all__ = [
+    "ThreadOwnershipRule", "LockOrderRule", "AsyncBlockingRule",
+    "HotPathLeakRule", "marker_owner", "SEAM_CALLS",
+]
+
+# a name "looks like a lock" when its terminal component does — matches
+# self._lock, self._ilock, self._cv (Condition), REGISTRY_LOCK, _mutex;
+# the `cv` arm is anchored so `recv` and friends never qualify
+_LOCKISH_RE = re.compile(r"lock|mutex|cond|(?:^|_)cv$", re.IGNORECASE)
+
+# the documented cross-thread handoff seams: a callable passed as an
+# argument to one of these runs on the RECEIVING thread, so the
+# thread-reachability closure is cut at the call site
+SEAM_CALLS = {
+    "call_soon_threadsafe", "call_soon", "call_later", "call_at",
+    "_post", "_enqueue_cmd", "_submit_to_worker", "add_done_callback",
+    "put", "put_nowait",
+}
+
+# growth ops that enlarge a container; removal ops that shrink it
+_GROWTH_METHODS = {"append", "appendleft", "add", "insert", "setdefault"}
+_REMOVAL_METHODS = {"pop", "popitem", "popleft", "remove", "discard",
+                    "clear"}
+
+# request/step hot-path entry names for LEAK001 (plus `# graftlint: hot`)
+_HOT_ENTRY_NAMES = {"submit", "adopt", "step", "record", "request_event",
+                    "observe"}
+
+# http.server convention: these methods run on the server's handler
+# threads (ThreadingHTTPServer spawns one per request)
+_HTTP_HANDLER_NAMES = {"do_GET", "do_POST", "do_PUT", "do_DELETE",
+                       "do_HEAD"}
+
+
+def marker_owner(markers):
+    """Owner declared by a ``# graftlint: owner=worker`` marker, or None."""
+    for m in markers:
+        if m.startswith("owner="):
+            return m[len("owner="):].strip()
+    return None
+
+
+def _chain_text(node):
+    """'self._lock' for a Name/Attribute chain rooted at a Name, else
+    None (same contract as the rules.py helper; duplicated to keep this
+    module importable without the v1/v2 catalog)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_lockish(expr) -> bool:
+    chain = _chain_text(expr)
+    if not chain:
+        return False
+    return bool(_LOCKISH_RE.search(chain.split(".")[-1]))
+
+
+def _enclosed_by_lock(graph, mod, node, fndef) -> bool:
+    """True when `node` sits inside a ``with <lock-ish>:`` region of
+    `fndef` (walking the parent chain, stopping at the def)."""
+    parents = graph.parent[id(mod)]
+    cur = node
+    while cur is not None and cur is not fndef:
+        cur = parents.get(id(cur))
+        if isinstance(cur, ast.With):
+            for item in cur.items:
+                if _is_lockish(item.context_expr):
+                    return True
+    return False
+
+
+def _resolve_func_ref(graph, mod, ctx_node, expr):
+    """Resolve a function-valued expression (``f``, ``self._worker``) to
+    [(mod2, def2), ...]; unknown shapes resolve to nothing."""
+    if isinstance(expr, ast.Name):
+        return graph._resolve_in_module(mod, expr.id)
+    if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name) \
+            and expr.value.id in ("self", "cls"):
+        fn = graph.enclosing_fn(mod, ctx_node)
+        cls = graph.enclosing_class.get((id(mod), id(fn))) \
+            if fn is not None else None
+        if cls is not None:
+            return [(mod, d) for d in cls.body
+                    if isinstance(d, _FN_TYPES) and d.name == expr.attr]
+    return []
+
+
+def _thread_entries(graph):
+    """[(mod, def, how), ...] — functions that run on a spawned thread."""
+    out, seen = [], set()
+
+    def add(mod, d, how):
+        k = (id(mod), id(d))
+        if k not in seen:
+            seen.add(k)
+            out.append((mod, d, how))
+
+    for mod in graph.modules:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = callee_name(node.func)
+            target = None
+            how = None
+            if name in ("Thread", "Timer"):
+                for kw in node.keywords:
+                    if kw.arg in ("target", "function"):
+                        target = kw.value
+                if target is None and name == "Timer" and len(node.args) > 1:
+                    target = node.args[1]
+                how = f"threading.{name}(target=...)"
+            elif name == "submit" and isinstance(node.func, ast.Attribute):
+                recv = _chain_text(node.func.value) or ""
+                if "executor" in recv.lower() or "pool" in recv.lower():
+                    target = node.args[0] if node.args else None
+                    how = "executor.submit(...)"
+            elif name == "run_in_executor":
+                if len(node.args) > 1:
+                    target = node.args[1]
+                    how = "run_in_executor(...)"
+            if target is not None:
+                for mod2, d2 in _resolve_func_ref(graph, mod, node, target):
+                    add(mod2, d2, how)
+        for d in graph.defs[mod]:
+            if d.name in _HTTP_HANDLER_NAMES and \
+                    graph.enclosing_class.get((id(mod), id(d))) is not None:
+                add(mod, d, "HTTP handler thread")
+    return out
+
+
+def _seam_passed_names(fndef):
+    """Names of callables handed across a thread seam inside `fndef`
+    (args of SEAM_CALLS calls) — nested defs with these names are
+    re-homed and excluded from the thread closure."""
+    names = set()
+    for node in ast.walk(fndef):
+        if isinstance(node, ast.Call) \
+                and callee_name(node.func) in SEAM_CALLS:
+            for a in node.args:
+                if isinstance(a, ast.Name):
+                    names.add(a.id)
+                elif isinstance(a, ast.Attribute):
+                    names.add(a.attr)
+    return names
+
+
+def _flat_targets(targets):
+    for t in targets:
+        if isinstance(t, (ast.Tuple, ast.List)):
+            yield from _flat_targets(t.elts)
+        else:
+            yield t
+
+
+def _self_writes(fndef):
+    """[(node, 'self.attr'), ...] — direct mutable-state writes in
+    `fndef` (nested defs excluded by the caller via enclosing_fn)."""
+    out = []
+    for node in ast.walk(fndef):
+        targets = ()
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AugAssign):
+            targets = (node.target,)
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = (node.target,)
+        for t in _flat_targets(targets):
+            base = t.value if isinstance(t, ast.Subscript) else t
+            chain = _chain_text(base)
+            if chain and chain.startswith("self.") and \
+                    isinstance(base, ast.Attribute):
+                out.append((node, chain))
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute):
+            if node.func.attr in _MUTATORS:
+                chain = _chain_text(node.func.value)
+                if chain and chain.startswith("self."):
+                    out.append((node, chain))
+    return out
+
+
+@register_rule
+class ThreadOwnershipRule(Rule):
+    id = "THREAD001"
+    description = ("mutable state written from a thread entry point's call "
+                   "closure without a lock or a graftlint owner marker "
+                   "(declare `# graftlint: owner=worker|main|any` or hold "
+                   "the lock)")
+
+    def check_project(self, ctx):
+        graph = project_graph(ctx)
+        findings = {}                       # (id(mod), id(node)) -> Finding
+        for emod, edef, how in _thread_entries(graph):
+            entry_owner = marker_owner(def_markers(emod, edef))
+            # BFS over the entry's thread closure with seam cuts;
+            # owner markers are inherited entry -> callee, a callee's
+            # own marker is authoritative for it
+            work = [(emod, edef, entry_owner)]
+            seen = set()
+            while work:
+                mod, d, inherited = work.pop()
+                key = (id(mod), id(d))
+                if key in seen:
+                    continue
+                seen.add(key)
+                own = marker_owner(def_markers(mod, d)) or inherited
+                if own == "main":
+                    fkey = (id(mod), id(d), "main")
+                    if fkey not in findings:
+                        findings[fkey] = Finding(
+                            self.id, mod.path, d.lineno,
+                            f"'{d.name}' is declared owner=main but is "
+                            f"reachable from thread entry '{edef.name}' "
+                            f"({how})")
+                elif own is None:
+                    for node, chain in _self_writes(d):
+                        # nested defs get their own closure entry
+                        if graph.enclosing_fn(mod, node) is not d:
+                            continue
+                        if _enclosed_by_lock(graph, mod, node, d):
+                            continue
+                        fkey = (id(mod), id(node))
+                        if fkey not in findings:
+                            findings[fkey] = Finding(
+                                self.id, mod.path, node.lineno,
+                                f"unlocked write to {chain} in '{d.name}', "
+                                f"reachable from thread entry "
+                                f"'{edef.name}' ({how}); hold the lock, "
+                                f"route through the worker seam, or "
+                                f"declare `# graftlint: owner=`")
+                # successors: resolved callees + nested defs, minus
+                # callables re-homed across a seam
+                seam = _seam_passed_names(d)
+                for call, tgts in graph.callees(mod, d):
+                    if callee_name(call.func) in SEAM_CALLS:
+                        continue
+                    for mod2, d2 in tgts:
+                        if d2.name in seam:
+                            continue
+                        work.append((mod2, d2, own))
+                for n in ast.walk(d):
+                    if isinstance(n, _FN_TYPES) and n is not d \
+                            and graph.enclosing_fn(mod, n) is d \
+                            and n.name not in seam:
+                        work.append((mod, n, own))
+        return sorted(findings.values(), key=lambda f: (f.file, f.line))
+
+
+# ---------------------------------------------------------------------------
+# LOCK001
+# ---------------------------------------------------------------------------
+def _lock_key(graph, mod, fndef, expr):
+    """Stable identity for a lock expression: class-qualified for
+    ``self.X`` (all instances of a class share one ordering discipline),
+    module-qualified for globals — or None when it isn't lock-shaped."""
+    if not _is_lockish(expr):
+        return None
+    chain = _chain_text(expr)
+    parts = chain.split(".")
+    if parts[0] in ("self", "cls"):
+        cls = graph.enclosing_class.get((id(mod), id(fndef))) \
+            if fndef is not None else None
+        cname = cls.name if cls is not None else "?"
+        return (mod.path, cname + "." + ".".join(parts[1:]))
+    if len(parts) == 1:
+        imp = graph.imports.get(mod, {}).get(parts[0])
+        if imp is not None:
+            return ("/".join(imp[0]) + ".py", imp[1])
+        return (mod.path, parts[0])
+    tgt = graph.mod_aliases.get(mod, {}).get(parts[0])
+    if tgt is not None:
+        return ("/".join(tgt) + ".py", ".".join(parts[1:]))
+    return (mod.path, chain)
+
+
+@register_rule
+class LockOrderRule(Rule):
+    id = "LOCK001"
+    description = ("lock-acquisition-order cycle across `with <lock>:` "
+                   "regions (ABBA deadlock): every thread must acquire "
+                   "these locks in one global order")
+
+    def _direct_acquires(self, graph, mod, d):
+        out = []
+        for node in ast.walk(d):
+            if isinstance(node, ast.With) \
+                    and graph.enclosing_fn(mod, node) is d:
+                for item in node.items:
+                    k = _lock_key(graph, mod, d, item.context_expr)
+                    if k is not None:
+                        out.append((k, node))
+        return out
+
+    def _held_closure(self, graph, mod, d, memo, stack, depth=0):
+        """Locks transitively acquired anywhere inside `d` (incl. via
+        resolved callees) — the edge targets for calls under a lock."""
+        key = (id(mod), id(d))
+        if key in memo:
+            return memo[key]
+        if key in stack or depth > 6:
+            return set()
+        stack.add(key)
+        held = {k for k, _ in self._direct_acquires(graph, mod, d)}
+        for _call, tgts in graph.callees(mod, d):
+            for mod2, d2 in tgts:
+                held |= self._held_closure(graph, mod2, d2, memo, stack,
+                                           depth + 1)
+        stack.discard(key)
+        memo[key] = held
+        return held
+
+    def check_project(self, ctx):
+        graph = project_graph(ctx)
+        memo = {}
+        edges = {}          # (k1, k2) -> (path, line, via)
+        for mod in graph.modules:
+            for d in graph.defs[mod]:
+                for k1, w in self._direct_acquires(graph, mod, d):
+                    for node in ast.walk(w):
+                        if node is w or \
+                                graph.enclosing_fn(mod, node) is not d:
+                            continue
+                        if isinstance(node, ast.With):
+                            for item in node.items:
+                                k2 = _lock_key(graph, mod, d,
+                                               item.context_expr)
+                                if k2 is not None and k2 != k1:
+                                    edges.setdefault(
+                                        (k1, k2),
+                                        (mod.path, node.lineno, "with"))
+                        elif isinstance(node, ast.Call):
+                            for mod2, d2 in graph.resolve_call(mod, node):
+                                for k2 in self._held_closure(
+                                        graph, mod2, d2, memo, set()):
+                                    if k2 != k1:
+                                        edges.setdefault(
+                                            (k1, k2),
+                                            (mod.path, node.lineno,
+                                             f"call to {d2.name}"))
+        # cycle = any lock reachable back to itself through the edge set
+        succ = {}
+        for (a, b) in edges:
+            succ.setdefault(a, set()).add(b)
+        findings, reported = [], set()
+        for start in sorted(succ):
+            path = self._find_cycle(start, succ)
+            if path is None:
+                continue
+            canon = frozenset(path)
+            if canon in reported:
+                continue
+            reported.add(canon)
+            names = [f"{k[1]} ({k[0]})" for k in path]
+            sites = []
+            for a, b in zip(path, path[1:] + path[:1]):
+                p, ln, via = edges[(a, b)]
+                sites.append(f"{a[1]}->{b[1]} at {p}:{ln} ({via})")
+            anchor = edges[(path[0], path[1] if len(path) > 1
+                            else path[0])]
+            findings.append(Finding(
+                self.id, anchor[0], anchor[1],
+                "lock-order cycle: " + " -> ".join(names + [names[0]])
+                + "; " + "; ".join(sites)))
+        return findings
+
+    @staticmethod
+    def _find_cycle(start, succ):
+        """A cycle through `start`, as an ordered node list, or None."""
+        stack = [(start, [start])]
+        seen = set()
+        while stack:
+            node, path = stack.pop()
+            for nxt in succ.get(node, ()):
+                if nxt == start:
+                    return path
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, path + [nxt]))
+        return None
+
+
+# ---------------------------------------------------------------------------
+# ASYNC001
+# ---------------------------------------------------------------------------
+_SOCKET_BLOCKING = {"recv", "accept", "sendall", "makefile",
+                    "create_connection"}
+_LOOP_CB_CALLS = {"call_soon", "call_soon_threadsafe", "call_later",
+                  "call_at"}
+_EXECUTOR_ESCAPES = {"run_in_executor", "to_thread"}
+
+
+def _blocking_reason(mod, graph, call):
+    """Why `call` blocks the event loop, or None when it doesn't."""
+    func = call.func
+    name = callee_name(func)
+    if isinstance(func, ast.Name):
+        if name == "open":
+            return "file I/O (open)"
+        imp = graph.imports.get(mod, {}).get(name)
+        if name == "sleep" and imp is not None and imp[1] == "sleep" \
+                and imp[0][-1:] == ("time",):
+            return "time.sleep"
+        return None
+    recv = (_chain_text(func.value) or "").lower()
+    if name == "sleep" and recv.split(".")[-1] == "time":
+        return "time.sleep"
+    if name in _SOCKET_BLOCKING:
+        return f"socket op .{name}()"
+    if name == "result" and not isinstance(func.value, ast.Await):
+        return "Future.result() (blocks until done)"
+    if name == "join" and "thread" in recv:
+        return "thread.join()"
+    if name in ("step", "submit") and any(
+            tok in recv for tok in ("engine", "fleet", "adapter")):
+        return f"engine .{name}() (a full device step on the loop)"
+    if name == "call" and any(
+            tok in recv for tok in ("rpc", "client")):
+        return "RPC client .call() (socket round-trip)"
+    return None
+
+
+@register_rule
+class AsyncBlockingRule(Rule):
+    id = "ASYNC001"
+    description = ("blocking call inside an async def / event-loop "
+                   "callback outside run_in_executor — stalls every "
+                   "concurrent request on the loop")
+
+    def check_project(self, ctx):
+        graph = project_graph(ctx)
+        findings = []
+        for mod in graph.modules:
+            checked = {}                      # id(def) -> (def, why)
+            for d in graph.defs[mod]:
+                if isinstance(d, ast.AsyncFunctionDef):
+                    checked[id(d)] = (d, "async def")
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Call) \
+                        and callee_name(node.func) in _LOOP_CB_CALLS:
+                    pos = 1 if callee_name(node.func) in \
+                        ("call_later", "call_at") else 0
+                    if len(node.args) > pos:
+                        for mod2, d2 in _resolve_func_ref(
+                                graph, mod, node, node.args[pos]):
+                            if mod2 is mod:
+                                checked[id(d2)] = (d2, "event-loop callback")
+            for d, why in checked.values():
+                escaped = set()
+                for n in ast.walk(d):
+                    if isinstance(n, ast.Call) \
+                            and callee_name(n.func) in _EXECUTOR_ESCAPES:
+                        for sub in ast.walk(n):
+                            escaped.add(id(sub))
+                for n in ast.walk(d):
+                    if not isinstance(n, ast.Call) or id(n) in escaped:
+                        continue
+                    if graph.enclosing_fn(mod, n) is not d:
+                        continue
+                    reason = _blocking_reason(mod, graph, n)
+                    if reason is not None:
+                        findings.append(Finding(
+                            self.id, mod.path, n.lineno,
+                            f"{reason} inside {why} '{d.name}'; move it "
+                            f"behind run_in_executor or the worker seam"))
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# LEAK001
+# ---------------------------------------------------------------------------
+_BOUNDED_CTORS = {"WeakSet", "WeakValueDictionary", "WeakKeyDictionary"}
+
+
+def _class_methods(graph, mod, cls):
+    return [d for d in graph.defs[mod]
+            if graph.enclosing_class.get((id(mod), id(d))) is cls]
+
+
+def _attr_of_self_chain(chain):
+    """'self._live' -> '_live' only for single-attribute chains."""
+    parts = chain.split(".")
+    if len(parts) == 2 and parts[0] == "self":
+        return parts[1]
+    return None
+
+
+@register_rule
+class HotPathLeakRule(Rule):
+    id = "LEAK001"
+    description = ("container attribute grows on a request/step hot path "
+                   "with no removal path anywhere in its class and no "
+                   "intrinsic bound (deque(maxlen=)/weak refs) — the "
+                   "Tracer._live unbounded-ghost bug class")
+
+    def _hot_methods(self, graph, mod, methods):
+        """Methods of one class reachable from a hot entry (by name,
+        `hot` marker, or call edges from one)."""
+        hot = set()
+        work = []
+        for d in methods:
+            if d.name in _HOT_ENTRY_NAMES \
+                    or d.name.startswith("_step") \
+                    or "hot" in def_markers(mod, d):
+                hot.add(id(d))
+                work.append(d)
+        by_id = {id(d): d for d in methods}
+        while work:
+            d = work.pop()
+            for _call, tgts in graph.callees(mod, d):
+                for mod2, d2 in tgts:
+                    if mod2 is mod and id(d2) in by_id \
+                            and id(d2) not in hot:
+                        hot.add(id(d2))
+                        work.append(by_id[id(d2)])
+        return hot
+
+    def check_project(self, ctx):
+        graph = project_graph(ctx)
+        findings = []
+        for mod in graph.modules:
+            for cls in ast.walk(mod.tree):
+                if not isinstance(cls, ast.ClassDef):
+                    continue
+                methods = _class_methods(graph, mod, cls)
+                if not methods:
+                    continue
+                hot = self._hot_methods(graph, mod, methods)
+                growth = {}         # attr -> first (node, method)
+                removed, bounded, nondict = set(), set(), set()
+                for d in methods:
+                    in_init = d.name in ("__init__", "__post_init__")
+                    for node in ast.walk(d):
+                        targets = ()
+                        if isinstance(node, ast.Assign):
+                            targets = node.targets
+                        elif isinstance(node, ast.AnnAssign) \
+                                and node.value is not None:
+                            targets = (node.target,)
+                        if isinstance(node, ast.Call) and \
+                                isinstance(node.func, ast.Attribute):
+                            chain = _chain_text(node.func.value)
+                            attr = _attr_of_self_chain(chain) \
+                                if chain else None
+                            if attr is None:
+                                continue
+                            if node.func.attr in _GROWTH_METHODS \
+                                    and id(d) in hot:
+                                growth.setdefault(attr, (node, d))
+                            elif node.func.attr in _REMOVAL_METHODS:
+                                removed.add(attr)
+                        for t in _flat_targets(targets):
+                            if isinstance(t, ast.Subscript):
+                                chain = _chain_text(t.value)
+                                attr = _attr_of_self_chain(chain) \
+                                    if chain else None
+                                if attr is not None and id(d) in hot:
+                                    growth.setdefault(attr, (node, d))
+                            elif isinstance(t, ast.Attribute):
+                                chain = _chain_text(t)
+                                attr = _attr_of_self_chain(chain) \
+                                    if chain else None
+                                if attr is None:
+                                    continue
+                                if in_init:
+                                    value = node.value
+                                    if self._bounded_init(value):
+                                        bounded.add(attr)
+                                    if not self._dict_like(value):
+                                        # a fixed-size slot table / np
+                                        # array: subscript stores do not
+                                        # grow it
+                                        nondict.add(attr)
+                                else:
+                                    # whole-attr reassignment outside
+                                    # __init__ is a reset path
+                                    removed.add(attr)
+                        if isinstance(node, ast.Delete):
+                            for t in node.targets:
+                                base = t.value \
+                                    if isinstance(t, ast.Subscript) else t
+                                chain = _chain_text(base)
+                                attr = _attr_of_self_chain(chain) \
+                                    if chain else None
+                                if attr is not None:
+                                    removed.add(attr)
+                for attr, (node, d) in sorted(growth.items()):
+                    if attr in removed or attr in bounded:
+                        continue
+                    if attr in nondict and not (
+                            isinstance(node, ast.Call)):
+                        # subscript store into a non-dict container
+                        continue
+                    findings.append(Finding(
+                        self.id, mod.path, node.lineno,
+                        f"self.{attr} grows in hot path '{d.name}' with "
+                        f"no removal/pop path anywhere in class "
+                        f"'{cls.name}'; bound it (deque(maxlen=...)) or "
+                        f"add the removal path"))
+        return findings
+
+    @staticmethod
+    def _bounded_init(value):
+        if not isinstance(value, ast.Call):
+            return False
+        name = callee_name(value.func)
+        if name == "deque":
+            return any(kw.arg == "maxlen" and not (
+                isinstance(kw.value, ast.Constant)
+                and kw.value.value is None) for kw in value.keywords)
+        return name in _BOUNDED_CTORS
+
+    @staticmethod
+    def _dict_like(value):
+        """True when an __init__ value is a dict (so ``self.a[k] = v``
+        inserts) rather than a fixed-size list/array (where it stores)."""
+        if isinstance(value, ast.Dict) or isinstance(value, ast.DictComp):
+            return True
+        if isinstance(value, ast.Call):
+            return callee_name(value.func) in (
+                "dict", "OrderedDict", "defaultdict", "Counter")
+        return False

@@ -281,7 +281,8 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
         # and file IO run in the background thread.
         err_box: list[BaseException] = []
 
-        def _write_guarded():
+        # the writer thread owns the staging files + err_box until join
+        def _write_guarded():  # graftlint: owner=worker
             try:
                 _write()
             except BaseException as e:  # noqa: BLE001 — re-raised on join
@@ -294,7 +295,8 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
         if jax.process_count() == 1:
             # single-controller: merge + commit after the write completes;
             # a failed write must never be committed (torn staging stays .tmp)
-            def _finish():
+            # commit runs on its own thread strictly AFTER the writer joins
+            def _finish():  # graftlint: owner=worker
                 th.join()
                 if err_box:
                     return

@@ -1221,6 +1221,8 @@ class ServingEngine:
         attached, every miss's wall cost lands in `engine.compile_s` + a
         flight `compile` event (compile accounting)."""
         jf = self._jax.jit(fn, **jit_kw)
+        # bounded by the (name, bucket) grid the budget gate polices,
+        # not per-request  # graftlint: disable=LEAK001
         self._jit_fns.setdefault(name, []).append(jf)
         return instrument(jf, name=name, counters=self.jit_cache_misses,
                           on_miss=self._on_compile)
@@ -1568,6 +1570,8 @@ class ServingEngine:
                         (lambda *a: fn(*a, greedy=True)) if greedy
                         else (lambda *a: fn(*a, greedy=False)),
                         donate_argnums=(4, 5))
+                    # keyed by (T bucket, greedy): bounded by the
+                    # bucket ladder  # graftlint: disable=LEAK001
                     self._prefill_jit[(Tb, greedy)] = pf
                 self._join_dispatch()   # prefill chains on concrete pages
                 if tel is not None:
@@ -1941,6 +1945,8 @@ class ServingEngine:
                 "decode_step",
                 lambda *a: self._horizon_fn(*a, K=K, greedy=greedy),
                 donate_argnums=(4, 5))
+            # keyed by (K, greedy): bounded by the horizon ladder
+            # graftlint: disable=LEAK001
             self._horizon_jit[(K, greedy)] = fn
         return fn
 

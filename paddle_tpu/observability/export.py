@@ -198,7 +198,8 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(data)
 
-    def do_GET(self):  # noqa: N802 — http.server API
+    # one _Handler instance per request, owned by its server thread
+    def do_GET(self):  # noqa: N802  # graftlint: owner=worker
         ex = self.server.exporter
         path = self.path.split("?", 1)[0]
         try:
@@ -246,7 +247,8 @@ class _Handler(BaseHTTPRequestHandler):
                                                  f"{exc}"}),
                        "application/json")
 
-    def do_POST(self):  # noqa: N802 — http.server API
+    # one _Handler instance per request, owned by its server thread
+    def do_POST(self):  # noqa: N802  # graftlint: owner=worker
         """``POST /generate`` -> Server-Sent-Events token stream (the
         real-socket serving transport over :class:`~paddle_tpu.serving.
         frontend.AsyncFrontend`).  ``generate_fn(payload)`` yields

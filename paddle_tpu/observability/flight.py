@@ -16,6 +16,7 @@ Python values only — no device arrays, no syncs."""
 from __future__ import annotations
 
 import json
+import threading
 import time
 from collections import deque
 
@@ -34,35 +35,46 @@ class FlightRecorder:
         self._ring: deque[dict] = deque(maxlen=self.capacity)
         self._seq = 0
         self.dumps: list[dict] = []
+        # engines, fleet watchdogs and exporter scrape threads all hit
+        # one recorder: the seq counter, the ring snapshot (iterating a
+        # deque while another thread appends raises RuntimeError) and
+        # the dump-history trim must be atomic
+        self._lock = threading.Lock()
 
     def __len__(self):
-        return len(self._ring)
+        with self._lock:
+            return len(self._ring)
 
     def record(self, event: str, **attrs):
-        self._seq += 1
-        rec = {"seq": self._seq, "t": float(self.clock()), "event": event}
-        if attrs:
-            rec.update(attrs)
-        self._ring.append(rec)
+        with self._lock:
+            self._seq += 1
+            rec = {"seq": self._seq, "t": float(self.clock()),
+                   "event": event}
+            if attrs:
+                rec.update(attrs)
+            self._ring.append(rec)
 
     def events(self) -> list[dict]:
-        return list(self._ring)
+        with self._lock:
+            return list(self._ring)
 
     def event_names(self) -> list[str]:
-        return [r["event"] for r in self._ring]
+        with self._lock:
+            return [r["event"] for r in self._ring]
 
     def dump(self, reason: str, **extra) -> dict:
         """Snapshot the ring (the full recent-event window) under `reason`.
         Returns the dump dict; also kept in ``self.dumps`` (last
         ``max_dumps``) and appended as one JSON line to ``dump_path`` when
         configured — the artifact a postmortem actually reads."""
-        d = {"reason": reason, "at": float(self.clock()),
-             "total_events": self._seq, "events": list(self._ring)}
-        if extra:
-            d["extra"] = dict(extra)
-        self.dumps.append(d)
-        if len(self.dumps) > self.max_dumps:
-            del self.dumps[: len(self.dumps) - self.max_dumps]
+        with self._lock:
+            d = {"reason": reason, "at": float(self.clock()),
+                 "total_events": self._seq, "events": list(self._ring)}
+            if extra:
+                d["extra"] = dict(extra)
+            self.dumps.append(d)
+            if len(self.dumps) > self.max_dumps:
+                del self.dumps[: len(self.dumps) - self.max_dumps]
         if self.dump_path:
             try:
                 with open(self.dump_path, "a") as f:

@@ -114,6 +114,8 @@ class Optimizer:
                 if hasattr(p, "optimize_attr") else lr
             new_p, new_state = self._update(pv, gv, state, plr)
             p._set_value(new_p)
+            # keyed per parameter: bounded by the model, not steps
+            # graftlint: disable=LEAK001
             self._accumulators[id(p)] = new_state
         self._global_step += 1
 

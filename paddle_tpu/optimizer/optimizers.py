@@ -141,6 +141,8 @@ class AdamW(Adam):
             new_p, new_state = self._adam_core(p._value, g._value, state, lr,
                                                decoupled_wd=wd)
             p._set_value(new_p)
+            # keyed per parameter: bounded by the model, not steps
+            # graftlint: disable=LEAK001
             self._accumulators[id(p)] = new_state
         self._global_step += 1
 

@@ -67,6 +67,13 @@ Fault-point catalog (the consulting subsystem documents exact ctx keys):
                             before the reply: the handler runs exactly once
                             and the retry must hit the idempotency cache (the
                             no-double-submit drill)
+``thread.interleave``       ThreadSanitizer, once per instrumented lock
+                            acquire/release (ctx: ``op``, ``lock``,
+                            ``thread``) — ``trigger`` injects a short
+                            sleep-yield at that point, steering the OS
+                            scheduler into rare interleavings; with a seeded
+                            plan the yield schedule is reproducible, turning
+                            flaky race reports into deterministic drills
 ==========================  ====================================================
 
 Firing rules per spec: ``at=k`` fires exactly on the k-th matching consult

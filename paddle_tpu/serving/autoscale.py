@@ -346,6 +346,8 @@ class ElasticFleet(ReplicaFleet):
                       role=None, sentinel: HealthSentinel):
         self._last_scale_t = now
         if role is not None:
+            # keyed by role (prefill/decode): bounded
+            # graftlint: disable=LEAK001
             self._last_scale_by_role[role] = now
         ev = {
             "action": action, "replica": replica, "round": self._round,
@@ -355,7 +357,9 @@ class ElasticFleet(ReplicaFleet):
         }
         if role is not None:
             ev["role"] = role
-        self.scale_events.append(ev)
+        # the drill's scale-event audit log: one entry per scale
+        # decision, read whole by bench/check_obs
+        self.scale_events.append(ev)  # graftlint: disable=LEAK001
 
     # -- readouts ----------------------------------------------------------
     def stats(self) -> dict:

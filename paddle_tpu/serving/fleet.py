@@ -914,6 +914,8 @@ class ReplicaFleet:
             if fr.first_token_t is not None else None
         tpot = (fr.finish_t - fr.first_token_t) / (n - 1) \
             if n > 1 and fr.first_token_t is not None else None
+        # per-request result store the drill harness reads whole;
+        # fleet lifetime is one drill  # graftlint: disable=LEAK001
         self._summaries.append({
             "rid": fr.frid, "tokens": n, "ttft_s": ttft, "tpot_s": tpot,
             "e2e_s": now - fr.submit_t, "timed_out": req.timed_out,
@@ -977,6 +979,8 @@ class ReplicaFleet:
         corpse_ring = None
         if corpse is not None and corpse.telemetry is not None:
             corpse_ring = corpse.telemetry.flight.events()
+            # one entry per replica death — failover forensics, read
+            # whole by the stitched export  # graftlint: disable=LEAK001
             self._dead_tracers.append(
                 (f"{rep.name} (crashed#{rep.failures})",
                  corpse.telemetry.tracer))
@@ -1108,7 +1112,10 @@ class ReplicaFleet:
             self._waiting.append(fr)
 
     # -- driving -----------------------------------------------------------
-    def run(self, max_rounds: int | None = None,
+    # the supervisor loop is single-threaded by design: all fleet state
+    # (placement, retries, summaries) is owned by the driving thread —
+    # owner=main turns any future thread reaching it into a lint error
+    def run(self, max_rounds: int | None = None,  # graftlint: owner=main
             max_stall_rounds: int = 1000) -> dict:
         """Drive the fleet until every submitted request resolved; returns
         ``{frid: Request}``.  ``max_stall_rounds`` consecutive no-progress

@@ -1079,7 +1079,10 @@ class AsyncFrontend:
             except BaseException:  # noqa: BLE001 — exit path, best effort
                 pass
 
-    def _worker(self):
+    # the worker thread OWNS _tracked/_cmds-drain/_error: every other
+    # thread reaches them through _enqueue_cmd (loop->worker) or _post
+    # (worker->loop) — never directly (README §Async frontend)
+    def _worker(self):  # graftlint: owner=worker
         adapter = self._adapter
         while True:
             with self._cv:

@@ -575,6 +575,8 @@ class ProcessFleet:
         self.elastic.deregister(w.key())
         dead_key = w.key()
         if w.trace_cache is not None:
+            # one entry per worker death — failover forensics, read
+            # whole by the stitched export  # graftlint: disable=LEAK001
             self._dead_tracers.append(
                 (f"{w.name} (crashed#{self.restarts[w.name] + 1})",
                  tracer_from_wire(w.trace_cache, clock=self.clock)))
@@ -597,6 +599,8 @@ class ProcessFleet:
                          routing_decisions=routing[-16:])
         outstanding = [self._requests[f]
                        for f in sorted(self._assigned[w.name])]
+        # keyed by worker name: bounded by fleet size
+        # graftlint: disable=LEAK001
         self._assigned[w.name] = set()
 
         restored_rids: set[int] = set()
@@ -615,6 +619,9 @@ class ProcessFleet:
                 # the dead generation's final invariants verdict, vouched
                 # by its replacement's post-restore check over the state
                 # the generation actually persisted
+                # keyed per spawned generation — every generation must
+                # file a report (ISSUE 17 gate)
+                # graftlint: disable=LEAK001
                 self.final_reports[dead_key] = {
                     "invariants_ok": bool(hello["restore_invariants_ok"]),
                     "invariants_error": hello.get("restore_error", ""),
@@ -735,7 +742,10 @@ class ProcessFleet:
         self.tracer.engine_event("scale_down", worker=w.name)
 
     # -- driving -----------------------------------------------------------
-    def run(self, max_rounds: int | None = None,
+    # ProcessFleet supervision is deliberately single-threaded (workers are
+    # PROCESSES; the supervisor polls their clients in one loop): owner=main
+    # makes handing this state to a thread a THREAD001 violation
+    def run(self, max_rounds: int | None = None,  # graftlint: owner=main
             max_stall_rounds: int = 2000) -> dict:
         """Drive until every request resolved (or SIGTERM: drain + stop).
         Returns ``{frid: Request}``."""

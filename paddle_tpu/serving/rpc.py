@@ -246,9 +246,19 @@ class RpcServer:
         self._ilock = threading.Lock()
         self._done: OrderedDict[str, dict] = OrderedDict()
         self._inflight: dict[str, threading.Event] = {}
+        # stats lock: every rpc-conn thread bumps these counters and the
+        # supervisor reads them live — unlocked `+=` is a lost-update
+        # race (THREAD001); `_ilock` is not reused so a slow idempotency
+        # sweep never serializes the per-frame accounting
+        self._slock = threading.Lock()
         self.stats = {"frames": 0, "handler_invocations": 0,
                       "dup_hits": 0, "errors": 0, "torn_frames": 0}
+
         self._accept_thread: threading.Thread | None = None
+
+    def _bump(self, key: str, by: float = 1) -> None:
+        with self._slock:
+            self.stats[key] += by
 
     def start(self) -> "RpcServer":
         t = threading.Thread(target=self._accept_loop,
@@ -269,7 +279,9 @@ class RpcServer:
             t.join(timeout=1.0)
 
     # -- internals ---------------------------------------------------------
-    def _accept_loop(self) -> None:
+    # the accept thread owns the conn-thread registry; stop() only
+    # reads _threads after _stop is set and the accept thread joined
+    def _accept_loop(self) -> None:  # graftlint: owner=worker
         while not self._stop.is_set():
             try:
                 conn, _ = self._listener.accept()
@@ -293,9 +305,9 @@ class RpcServer:
                 except socket.timeout:
                     continue
                 except (_WireError, OSError, ValueError):
-                    self.stats["torn_frames"] += 1
+                    self._bump("torn_frames")
                     return
-                self.stats["frames"] += 1
+                self._bump("frames")
                 reply = self._dispatch(frame)
                 try:
                     _send_frame(conn, reply)
@@ -309,14 +321,14 @@ class RpcServer:
         waiter = None
         with self._ilock:
             if key in self._done:
-                self.stats["dup_hits"] += 1
+                self._bump("dup_hits")
                 return self._done[key]
             if key in self._inflight:
                 waiter = self._inflight[key]
             else:
                 self._inflight[key] = threading.Event()
         if waiter is not None:
-            self.stats["dup_hits"] += 1
+            self._bump("dup_hits")
             waiter.wait(timeout=30.0)
             with self._ilock:
                 reply = self._done.get(key)
@@ -324,11 +336,11 @@ class RpcServer:
                 "ok": False, "etype": "RpcTimeout",
                 "error": "duplicate waited but original never finished"}
         try:
-            self.stats["handler_invocations"] += 1
+            self._bump("handler_invocations")
             reply = {"ok": True,
                      "r": self._handler(frame.get("m"), frame.get("p") or {})}
         except BaseException as e:  # noqa: BLE001 — wire boundary
-            self.stats["errors"] += 1
+            self._bump("errors")
             reply = {"ok": False, "etype": type(e).__name__, "error": str(e)}
         with self._ilock:
             self._done[key] = reply

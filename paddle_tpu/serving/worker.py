@@ -137,7 +137,9 @@ class _WorkerHost:
             # snapshot must not take down live decode
             traceback.print_exc()
 
-    def serve_loop(self):
+    # the serve thread owns the engine and the hb counter behind self.lock;
+    # the RPC handler threads only touch them under the same lock
+    def serve_loop(self):  # graftlint: owner=worker
         eng = self.engine
         while not self.stop_event.is_set():
             did = False

@@ -1261,6 +1261,13 @@ def _overhead_trace(telemetry_on: bool, seed: int = 0) -> float:
             alerts_fn=lambda: aggregate_alerts(
                 {"engine": tel.sentinel}),
             slow_fn=lambda: tel.tail.dumps()).start()
+    # the timed window measures TELEMETRY overhead only: the graftlint v3
+    # thread sanitizer (a race-check test-lane tool that instruments every
+    # lock acquire) must never be live here, or its per-acquire hooks
+    # would be billed to the telemetry budget
+    from paddle_tpu.analysis.thread_sanitize import active as _san_active
+    assert _san_active() is None, \
+        "thread_sanitize() active inside the overhead-gate timed window"
     try:
         # warm every prompt bucket + the horizon, then time the real trace
         for tb in sorted({((len(p) + 15) // 16) * 16 for p in prompts}):

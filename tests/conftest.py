@@ -77,6 +77,22 @@ def _no_worker_process_leak():
 
 
 @pytest.fixture(autouse=True)
+def _thread_sanitize_lane():
+    """`make race-check` lane: GRAFT_THREAD_SANITIZE=1 wraps every test in
+    the lock-order/ownership sanitizer, so the fleet failover, frontend and
+    proc-smoke drills run with instrumented threading.Lock/RLock — a
+    lock-order inversion anywhere in the drill fails that test with both
+    stacks instead of deadlocking CI.  Off (the default) this fixture is
+    free: no patching, timed perf windows see raw stdlib locks."""
+    if os.environ.get("GRAFT_THREAD_SANITIZE") != "1":
+        yield
+        return
+    from paddle_tpu.analysis.thread_sanitize import thread_sanitize
+    with thread_sanitize():
+        yield
+
+
+@pytest.fixture(autouse=True)
 def _no_fault_plan_leak():
     """A test that exits with a live FaultPlan (inject() scope not closed)
     would silently corrupt every later test's behavior — fail it here,

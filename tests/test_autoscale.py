@@ -100,7 +100,7 @@ class _StubFleet:
     def __init__(self, routable):
         self._routable = routable
 
-    def routable_replicas(self):
+    def routable_replicas(self, role=None):
         return self._routable
 
 
@@ -397,6 +397,12 @@ def _elastic_art():
                                     "engine.step_host_s")},
             "per_replica_telemetry": {
                 "r0": {"mem.pool_occupancy_frac": 0.5}},
+        },
+        "parallelism": {
+            "model": "virtual (round-driven clock)",
+            "wall_clock_arm": "bench.py --trace failover --proc",
+            "note": "re-measure on wall clock when the autoscaler "
+                    "scales ProcessFleet workers",
         },
     }
 

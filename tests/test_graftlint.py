@@ -1436,7 +1436,7 @@ class TestCliV2:
         art = tmp_path / "report.json"
         assert lint_main([str(bad), "--json-artifact", str(art)]) == 1
         doc = json.loads(art.read_text())
-        assert doc["schema"] == "graftlint-report-v1"
+        assert doc["schema"] == "graftlint-report-v2"
         assert doc["summary"]["new"] == 1 and not doc["summary"]["ok"]
         assert doc["new"][0]["rule"] == "TRACE001"
         assert "DIST001" in doc["rules"] and "DONATE001" in doc["rules"]
@@ -1613,3 +1613,533 @@ class TestCliV2:
         finally:
             os.chdir(cwd)
         capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# THREAD001 — thread-ownership of mutable state (graftlint v3)
+# ---------------------------------------------------------------------------
+class TestThread001:
+    def test_positive_unlocked_write_in_thread_target(self):
+        res = _lint("""
+            import threading
+
+            class W:
+                def __init__(self):
+                    self.count = 0
+
+                def start(self):
+                    threading.Thread(target=self._loop).start()
+
+                def _loop(self):
+                    self.count += 1
+        """)
+        assert _rules(res) == ["THREAD001"]
+        assert "unlocked write to self.count" in res.new[0].message
+
+    def test_positive_owner_main_reachable_from_thread(self):
+        # the function claims the main thread but a Thread targets it
+        res = _lint("""
+            import threading
+
+            class W:
+                def start(self):
+                    threading.Thread(target=self._loop).start()
+
+                def _loop(self):  # graftlint: owner=main
+                    pass
+        """)
+        assert _rules(res) == ["THREAD001"]
+        assert "owner=main" in res.new[0].message
+
+    def test_positive_http_handler_is_a_thread_entry(self):
+        res = _lint("""
+            class Handler:
+                def do_GET(self):
+                    self.hits += 1
+        """)
+        assert _rules(res) == ["THREAD001"]
+
+    def test_positive_executor_submit(self):
+        res = _lint("""
+            class W:
+                def kick(self, executor):
+                    executor.submit(self._work)
+
+                def _work(self):
+                    self.done = True
+        """)
+        assert _rules(res) == ["THREAD001"]
+
+    def test_negative_write_under_lock(self):
+        res = _lint("""
+            import threading
+
+            class W:
+                def start(self):
+                    threading.Thread(target=self._loop).start()
+
+                def _loop(self):
+                    with self._lock:
+                        self.count += 1
+        """)
+        assert res.new == []
+
+    def test_negative_owner_marker_blesses_entry(self):
+        res = _lint("""
+            import threading
+
+            class W:
+                def start(self):
+                    threading.Thread(target=self._loop).start()
+
+                def _loop(self):  # graftlint: owner=worker
+                    self.count += 1
+        """)
+        assert res.new == []
+
+    def test_negative_owner_marker_inherited_by_helper(self):
+        # marking the worker-loop ENTRY blesses its private helpers too
+        res = _lint("""
+            import threading
+
+            class W:
+                def start(self):
+                    threading.Thread(target=self._loop).start()
+
+                def _loop(self):  # graftlint: owner=worker
+                    self._drain()
+
+                def _drain(self):
+                    self.pending = []
+        """)
+        assert res.new == []
+
+    def test_negative_seam_cuts_the_closure(self):
+        # a callable handed across the worker seam runs on the RECEIVING
+        # thread: _finish is re-homed, its write is not the thread's
+        res = _lint("""
+            import threading
+
+            class W:
+                def start(self):
+                    threading.Thread(target=self._loop).start()
+
+                def _loop(self):  # graftlint: owner=worker
+                    self._post(self._finish)
+
+                def _finish(self):
+                    self.result = 1
+        """)
+        assert res.new == []
+
+    def test_positive_interprocedural_cross_module_helper(self):
+        # the unlocked write lives in a helper IMPORTED by the thread loop
+        res = lint_sources([
+            ("pkg/a.py", textwrap.dedent("""
+                import threading
+                from pkg.b import drain
+
+                class W:
+                    def start(self):
+                        threading.Thread(target=self._loop).start()
+
+                    def _loop(self):
+                        drain(self)
+            """)),
+            ("pkg/b.py", textwrap.dedent("""
+                def drain(self):
+                    self.pending += 1
+            """)),
+        ])
+        assert [(f.rule, f.file) for f in res.new] \
+            == [("THREAD001", "pkg/b.py")]
+
+    def test_suppressed(self):
+        res = _lint("""
+            import threading
+
+            class W:
+                def start(self):
+                    threading.Thread(target=self._loop).start()
+
+                def _loop(self):
+                    # benign: torn read tolerated  # graftlint: disable=THREAD001
+                    self.count += 1
+        """)
+        assert res.new == []
+
+    def test_baseline_matched(self):
+        src = textwrap.dedent("""
+            import threading
+
+            class W:
+                def start(self):
+                    threading.Thread(target=self._loop).start()
+
+                def _loop(self):
+                    self.count += 1
+        """)
+        entries = [{"rule": "THREAD001", "file": "pkg/mod.py",
+                    "snippet": "self.count += 1",
+                    "justification": "grandfathered"}]
+        res = lint_sources([("pkg/mod.py", src)], baseline_entries=entries)
+        assert res.new == [] and len(res.baselined) == 1
+
+
+# ---------------------------------------------------------------------------
+# LOCK001 — lock-acquisition-order cycles (graftlint v3)
+# ---------------------------------------------------------------------------
+class TestLock001:
+    def test_positive_abba_nested_with(self):
+        res = _lint("""
+            class S:
+                def a(self):
+                    with self._lock_a:
+                        with self._lock_b:
+                            pass
+
+                def b(self):
+                    with self._lock_b:
+                        with self._lock_a:
+                            pass
+        """)
+        assert _rules(res) == ["LOCK001"]
+        assert "lock-order cycle" in res.new[0].message
+
+    def test_positive_cycle_through_a_call_edge(self):
+        # a() holds lock_a and CALLS something that takes lock_b; b()
+        # nests them the other way — same ABBA, one hop indirect
+        res = _lint("""
+            class S:
+                def a(self):
+                    with self._lock_a:
+                        self.helper()
+
+                def helper(self):
+                    with self._lock_b:
+                        pass
+
+                def b(self):
+                    with self._lock_b:
+                        with self._lock_a:
+                            pass
+        """)
+        assert _rules(res) == ["LOCK001"]
+
+    def test_positive_two_module_cycle(self):
+        res = lint_sources([
+            ("pkg/a.py", textwrap.dedent("""
+                from pkg.b import use_b
+
+                A_LOCK = object()
+
+                def fwd():
+                    with A_LOCK:
+                        use_b()
+
+                def take_a():
+                    with A_LOCK:
+                        pass
+            """)),
+            ("pkg/b.py", textwrap.dedent("""
+                from pkg.a import take_a
+
+                B_LOCK = object()
+
+                def use_b():
+                    with B_LOCK:
+                        pass
+
+                def rev():
+                    with B_LOCK:
+                        take_a()
+            """)),
+        ])
+        assert sorted(f.rule for f in res.new) == ["LOCK001"]
+        assert "A_LOCK" in res.new[0].message \
+            and "B_LOCK" in res.new[0].message
+
+    def test_negative_consistent_order(self):
+        res = _lint("""
+            class S:
+                def a(self):
+                    with self._lock_a:
+                        with self._lock_b:
+                            pass
+
+                def b(self):
+                    with self._lock_a:
+                        with self._lock_b:
+                            pass
+        """)
+        assert res.new == []
+
+    def test_negative_non_lockish_with(self):
+        # `with open(...)` / timers are not locks; no ordering discipline
+        res = _lint("""
+            class S:
+                def a(self):
+                    with self._timer:
+                        with open("f") as fh:
+                            pass
+        """)
+        assert res.new == []
+
+    def test_suppressed(self):
+        res = _lint("""
+            class S:
+                def a(self):
+                    with self._lock_a:
+                        # startup only, single-threaded  # graftlint: disable=LOCK001
+                        with self._lock_b:
+                            pass
+
+                def b(self):
+                    with self._lock_b:
+                        with self._lock_a:
+                            pass
+        """)
+        # one of the two edge anchors may survive depending on direction;
+        # suppressing at the REPORTED anchor silences the finding
+        if res.new:
+            res2 = _lint("""
+                class S:
+                    def a(self):
+                        with self._lock_a:
+                            with self._lock_b:
+                                pass
+
+                    def b(self):
+                        with self._lock_b:
+                            # startup only  # graftlint: disable=LOCK001
+                            with self._lock_a:
+                                pass
+            """)
+            assert res2.new == []
+
+
+# ---------------------------------------------------------------------------
+# ASYNC001 — blocking calls on the event loop (graftlint v3)
+# ---------------------------------------------------------------------------
+class TestAsync001:
+    def test_positive_time_sleep_in_async_def(self):
+        res = _lint("""
+            import time
+
+            class F:
+                async def handler(self, req):
+                    time.sleep(0.1)
+        """)
+        assert _rules(res) == ["ASYNC001"]
+        assert "time.sleep" in res.new[0].message
+
+    def test_positive_blocking_ops_catalog(self):
+        res = _lint("""
+            class F:
+                async def handler(self, sock, fut, engine):
+                    data = sock.recv(4096)
+                    open("log.txt")
+                    fut.result()
+                    engine.step()
+        """)
+        assert _rules(res) == ["ASYNC001"] * 4
+
+    def test_positive_loop_callback(self):
+        # a sync def handed to loop.call_soon runs ON the loop
+        res = _lint("""
+            class F:
+                def wire(self, loop, sock):
+                    loop.call_soon(self._cb)
+
+                def _cb(self):
+                    self.sock.recv(1)
+        """)
+        assert _rules(res) == ["ASYNC001"]
+
+    def test_negative_await_and_executor_escape(self):
+        res = _lint("""
+            import asyncio
+            import time
+
+            class F:
+                async def handler(self, loop):
+                    await asyncio.sleep(0.1)
+                    await loop.run_in_executor(None, lambda: time.sleep(1))
+        """)
+        assert res.new == []
+
+    def test_negative_sync_method_not_checked(self):
+        res = _lint("""
+            import time
+
+            class F:
+                def worker_side(self):
+                    time.sleep(0.1)
+        """)
+        assert res.new == []
+
+    def test_suppressed(self):
+        res = _lint("""
+            import time
+
+            class F:
+                async def handler(self):
+                    # sub-ms, measured  # graftlint: disable=ASYNC001
+                    time.sleep(0.0001)
+        """)
+        assert res.new == []
+
+    def test_baseline_matched(self):
+        src = textwrap.dedent("""
+            import time
+
+            class F:
+                async def handler(self):
+                    time.sleep(0.1)
+        """)
+        entries = [{"rule": "ASYNC001", "file": "pkg/mod.py",
+                    "snippet": "time.sleep(0.1)",
+                    "justification": "grandfathered"}]
+        res = lint_sources([("pkg/mod.py", src)], baseline_entries=entries)
+        assert res.new == [] and len(res.baselined) == 1
+
+
+# ---------------------------------------------------------------------------
+# LEAK001 — unbounded growth on the hot path (graftlint v3)
+# ---------------------------------------------------------------------------
+class TestLeak001:
+    def test_positive_tracer_live_ghost(self):
+        # the bug class this rule exists for: per-request dict entries
+        # with no retirement path anywhere in the class
+        res = _lint("""
+            class Tracer:
+                def __init__(self):
+                    self._live = {}
+
+                def submit(self, req):
+                    self._live[req.rid] = req
+        """)
+        assert _rules(res) == ["LEAK001"]
+        assert "_live" in res.new[0].message
+
+    def test_positive_append_reached_from_hot_entry(self):
+        # growth in a helper CALLED from the hot entry counts
+        res = _lint("""
+            class Engine:
+                def __init__(self):
+                    self.history = []
+
+                def step(self):
+                    self._note()
+
+                def _note(self):
+                    self.history.append(1)
+        """)
+        assert _rules(res) == ["LEAK001"]
+
+    def test_positive_hot_marker(self):
+        res = _lint("""
+            class W:
+                def __init__(self):
+                    self.frames = []
+
+                def drain(self):  # graftlint: hot
+                    self.frames.append(1)
+        """)
+        assert _rules(res) == ["LEAK001"]
+
+    def test_negative_removal_path_in_class(self):
+        res = _lint("""
+            class Tracer:
+                def __init__(self):
+                    self._live = {}
+
+                def submit(self, req):
+                    self._live[req.rid] = req
+
+                def retire(self, rid):
+                    self._live.pop(rid, None)
+        """)
+        assert res.new == []
+
+    def test_negative_bounded_deque(self):
+        res = _lint("""
+            from collections import deque
+
+            class Tracer:
+                def __init__(self):
+                    self._done = deque(maxlen=256)
+
+                def record(self, ev):
+                    self._done.append(ev)
+        """)
+        assert res.new == []
+
+    def test_negative_cold_path_growth(self):
+        # growth outside the hot closure is config/bookkeeping, not a leak
+        res = _lint("""
+            class W:
+                def __init__(self):
+                    self.plugins = []
+
+                def configure(self, p):
+                    self.plugins.append(p)
+        """)
+        assert res.new == []
+
+    def test_negative_fixed_slot_table_store(self):
+        # subscript store into a fixed-size array is a STORE, not growth
+        res = _lint("""
+            import numpy as np
+
+            class W:
+                def __init__(self, n):
+                    self._temps = np.zeros(n)
+
+                def step(self, s, v):
+                    self._temps[s] = v
+        """)
+        assert res.new == []
+
+    def test_negative_drain_by_reassignment(self):
+        # the frontend's tuple-swap drain is a removal path
+        res = _lint("""
+            class W:
+                def __init__(self):
+                    self._cmds = []
+
+                def submit(self, c):
+                    self._cmds.append(c)
+
+                def _drain(self):
+                    cmds, self._cmds = self._cmds, []
+                    return cmds
+        """)
+        assert res.new == []
+
+    def test_suppressed(self):
+        res = _lint("""
+            class W:
+                def __init__(self):
+                    self._jit = {}
+
+                def step(self, key, fn):
+                    # bounded by the bucket grid  # graftlint: disable=LEAK001
+                    self._jit[key] = fn
+        """)
+        assert res.new == []
+
+    def test_baseline_matched(self):
+        src = textwrap.dedent("""
+            class Tracer:
+                def __init__(self):
+                    self._live = {}
+
+                def submit(self, req):
+                    self._live[req.rid] = req
+        """)
+        entries = [{"rule": "LEAK001", "file": "pkg/mod.py",
+                    "snippet": "self._live[req.rid] = req",
+                    "justification": "grandfathered"}]
+        res = lint_sources([("pkg/mod.py", src)], baseline_entries=entries)
+        assert res.new == [] and len(res.baselined) == 1

@@ -1,0 +1,334 @@
+"""Runtime half of graftlint v3 (ISSUE 20): the lock-order/ownership
+sanitizer and the pinning tests it shook out.
+
+`thread_sanitize()` patches ``threading.Lock``/``RLock`` for its scope:
+each acquisition records a held-before edge keyed by the lock's CREATION
+SITE, and the global edge graph must stay acyclic — so a lock-order
+inversion raises :class:`LockOrderViolation` with the full cycle and the
+stacks of both conflicting acquisitions, deterministically, even when the
+actual deadlock interleaving never happens in this run.  The seeded
+``thread.interleave`` fault point turns "rare interleaving" into a
+reproducible schedule.  Pure host threads — tier-1 fast."""
+import threading
+import time
+
+import pytest
+
+from paddle_tpu.analysis.thread_sanitize import (LockOrderViolation,
+                                                 OwnershipViolation, active,
+                                                 thread_sanitize)
+from paddle_tpu.observability.flight import FlightRecorder
+from paddle_tpu.resilience import inject
+
+
+# ---------------------------------------------------------------------------
+# lock-order cycles
+# ---------------------------------------------------------------------------
+class TestLockOrder:
+    def test_inversion_raises_with_cycle_and_both_stacks(self):
+        fr = FlightRecorder(capacity=64)
+        with thread_sanitize(flight=fr) as san:
+            lock_a = threading.Lock()
+            lock_b = threading.Lock()
+            with lock_a:
+                with lock_b:
+                    pass
+            with pytest.raises(LockOrderViolation) as ei:
+                with lock_b:
+                    with lock_a:
+                        pass
+            # the cycle names both creation sites, this file
+            assert len(ei.value.cycle) == 3
+            assert all("test_thread_sanitize" in k for k in ei.value.cycle)
+            # one recorded stack per conflicting edge
+            assert len(ei.value.stacks) == 2
+            for info in ei.value.stacks.values():
+                assert "thread" in info and "stack" in info
+            # the postmortem artifact landed in the flight recorder
+            dump = fr.last_dump()
+            assert dump is not None and dump["reason"] == "lock_order_cycle"
+            assert dump["extra"]["cycle"] == ei.value.cycle
+            assert san.violations and san.violations[-1] is ei.value
+
+    def test_two_thread_abba_caught_without_deadlocking(self):
+        # thread 1 establishes A->B and EXITS; the main thread then runs
+        # B->A.  A real run would only deadlock under the hostile
+        # interleaving — the edge graph catches the inversion every run.
+        with thread_sanitize() as san:
+            lock_a = threading.Lock()
+            lock_b = threading.Lock()
+
+            def forward():
+                with lock_a:
+                    with lock_b:
+                        pass
+
+            t = threading.Thread(target=forward, name="fwd")
+            t.start()
+            t.join()
+            with pytest.raises(LockOrderViolation):
+                with lock_b:
+                    with lock_a:
+                        pass
+            assert len(san.violations) == 1
+
+    def test_consistent_order_stays_clean(self):
+        with thread_sanitize() as san:
+            lock_a = threading.Lock()
+            lock_b = threading.Lock()
+            for _ in range(10):
+                with lock_a:
+                    with lock_b:
+                        pass
+            assert san.violations == []
+
+    def test_rlock_reentrancy_is_not_an_edge(self):
+        with thread_sanitize() as san:
+            r = threading.RLock()
+            with r:
+                with r:        # re-acquire, not a second lock
+                    pass
+            assert san.violations == []
+
+    def test_condition_wait_notify_roundtrip(self):
+        # Condition wraps an RLock through _release_save/_acquire_restore;
+        # the sanitizer must forward those for wait() to work at all
+        with thread_sanitize() as san:
+            cv = threading.Condition()
+            hits = []
+
+            def worker():
+                with cv:
+                    hits.append(1)
+                    cv.notify_all()
+
+            t = threading.Thread(target=worker, name="cv-worker")
+            with cv:
+                t.start()
+                cv.wait(timeout=5.0)
+            t.join()
+            assert hits == [1] and san.violations == []
+
+
+# ---------------------------------------------------------------------------
+# seeded deterministic interleaving
+# ---------------------------------------------------------------------------
+class TestInterleave:
+    @staticmethod
+    def _drill(seed):
+        plan = {"thread.interleave": {"action": "trigger", "prob": 0.5,
+                                      "count": None}}
+        with inject(plan, seed=seed):
+            with thread_sanitize() as san:
+                lock = threading.Lock()
+                for _ in range(40):
+                    with lock:
+                        pass
+                return list(san.schedule)
+
+    def test_same_seed_same_schedule(self):
+        s1 = self._drill(7)
+        s2 = self._drill(7)
+        assert s1 and s1 == s2          # yields happened, reproducibly
+
+    def test_different_seed_different_schedule(self):
+        assert self._drill(7) != self._drill(8)
+
+    def test_no_plan_no_yields(self):
+        with thread_sanitize() as san:
+            lock = threading.Lock()
+            for _ in range(10):
+                with lock:
+                    pass
+            assert san.schedule == []
+
+
+# ---------------------------------------------------------------------------
+# shared-attribute ownership
+# ---------------------------------------------------------------------------
+class _Box:
+    pass
+
+
+class TestOwnership:
+    def test_foreign_write_raises_owner_write_passes(self):
+        fr = FlightRecorder(capacity=16)
+        with thread_sanitize(flight=fr) as san:
+            box = _Box()
+            san.watch(box, owner="current")
+            box.x = 1                   # owner (this thread): fine
+            errs = []
+
+            def intruder():
+                try:
+                    box.y = 2
+                except OwnershipViolation as e:
+                    errs.append(e)
+
+            t = threading.Thread(target=intruder, name="intruder")
+            t.start()
+            t.join()
+            assert len(errs) == 1 and "intruder" in str(errs[0])
+            assert not hasattr(box, "y")
+            assert fr.last_dump()["reason"] == "ownership_violation"
+            san.unwatch(box)
+            box.z = 3                   # unwatched again: plain attrs
+
+    def test_watch_by_thread_name(self):
+        with thread_sanitize() as san:
+            box = _Box()
+            san.watch(box, owner="writer")
+            ok = []
+
+            def writer():
+                box.v = 42
+                ok.append(box.v)
+
+            t = threading.Thread(target=writer, name="writer")
+            t.start()
+            t.join()
+            assert ok == [42]
+            with pytest.raises(OwnershipViolation):
+                box.v = 0               # main thread is not the owner
+
+
+# ---------------------------------------------------------------------------
+# scoping, nesting, restoration
+# ---------------------------------------------------------------------------
+class TestScope:
+    def test_active_and_patch_restore(self):
+        # under `make race-check` an OUTER sanitizer from the autouse
+        # fixture is already active: assert restoration to it, not to
+        # a bare interpreter
+        outer, outer_lock = active(), threading.Lock
+        with thread_sanitize() as san:
+            assert active() is san and san is not outer
+            assert threading.Lock is not outer_lock
+        assert active() is outer
+        assert threading.Lock is outer_lock
+
+    def test_out_of_scope_locks_stay_raw(self):
+        with thread_sanitize(scope=lambda filename: False):
+            lock = threading.Lock()
+            assert not hasattr(lock, "_key")    # raw stdlib lock
+            with lock:
+                pass
+
+    def test_restored_after_violation(self):
+        outer, outer_lock = active(), threading.Lock
+        with pytest.raises(LockOrderViolation):
+            with thread_sanitize():
+                a = threading.Lock()
+                b = threading.Lock()
+                with a:
+                    with b:
+                        pass
+                with b:
+                    with a:
+                        pass
+        assert threading.Lock is outer_lock and active() is outer
+
+
+# ---------------------------------------------------------------------------
+# a clean drill over real serving infrastructure must pass
+# ---------------------------------------------------------------------------
+class TestCleanDrill:
+    def test_rpc_roundtrip_under_sanitizer(self):
+        # the RPC server's accept/conn threads + idempotency cache use
+        # _ilock/_slock in a fixed order; a clean request storm must
+        # produce zero violations (this is the `make race-check` bar,
+        # in miniature)
+        from paddle_tpu.serving.rpc import RpcClient, RpcServer
+
+        calls = []
+
+        def handler(method, params):
+            calls.append(method)
+            return {"m": method}
+
+        with thread_sanitize() as san:
+            srv = RpcServer(handler).start()
+            try:
+                cli = RpcClient(srv.address)
+                for i in range(8):
+                    assert cli.call("ping", i=i)["m"] == "ping"
+                cli.close()
+            finally:
+                srv.stop()
+            assert len(calls) == 8
+            assert san.violations == []
+
+
+# ---------------------------------------------------------------------------
+# pinning tests the sanitizer work shook out (ISSUE 20 satellite)
+# ---------------------------------------------------------------------------
+class TestRpcIdempotencyPinning:
+    def test_concurrent_duplicates_run_handler_once(self):
+        # N threads race the SAME retry key into _dispatch: the handler
+        # must run exactly once, every duplicate must get the cached
+        # reply, and the (now locked) stats must add up exactly
+        from paddle_tpu.serving.rpc import RpcServer
+
+        invocations = []
+
+        def handler(method, params):
+            invocations.append(method)
+            time.sleep(0.05)            # hold the inflight window open
+            return {"n": len(invocations)}
+
+        srv = RpcServer(handler)
+        try:
+            frame = {"k": "dup-key", "m": "submit", "p": {}}
+            replies = []
+            with thread_sanitize() as san:
+                threads = [threading.Thread(
+                    target=lambda: replies.append(srv._dispatch(frame)),
+                    name=f"dup-{i}") for i in range(6)]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                assert san.violations == []
+            assert len(invocations) == 1
+            assert len(replies) == 6
+            assert all(r == replies[0] for r in replies)
+            assert srv.stats["handler_invocations"] == 1
+            assert srv.stats["dup_hits"] == 5
+        finally:
+            srv.stop()
+
+
+class TestFlightRecorderPinning:
+    def test_concurrent_record_and_dump(self):
+        # engines, watchdogs and scrape threads hit one recorder: a dump
+        # snapshotting the ring while writers append must never raise
+        # (iterating a deque during mutation is a RuntimeError) and the
+        # seq counter must not lose updates
+        fr = FlightRecorder(capacity=64, max_dumps=4)
+        n_writers, per_writer = 4, 500
+        errs = []
+
+        def writer(wid):
+            try:
+                for i in range(per_writer):
+                    fr.record("ev", w=wid, i=i)
+            except BaseException as e:  # noqa: BLE001 — reported below
+                errs.append(e)
+
+        with thread_sanitize() as san:
+            threads = [threading.Thread(target=writer, args=(w,),
+                                        name=f"writer-{w}")
+                       for w in range(n_writers)]
+            for t in threads:
+                t.start()
+            for _ in range(50):
+                d = fr.dump("probe")
+                assert len(d["events"]) <= 64
+            for t in threads:
+                t.join()
+            assert san.violations == []
+        assert errs == []
+        assert len(fr) == 64
+        final = fr.dump("final")
+        assert final["total_events"] == n_writers * per_writer
+        assert len(fr.dumps) <= 4
